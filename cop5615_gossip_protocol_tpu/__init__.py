@@ -1,0 +1,25 @@
+"""gossip-tpu — a TPU-native epidemic-protocol simulation framework.
+
+Rebuilds the capabilities of sushanth-777/cop5615-Gossip_protocol (an F# /
+Akka.NET actor-per-node simulator of gossip and push-sum over line / full /
+2D / Imp3D topologies, program.fs) as batched, sharded JAX array programs:
+topologies are neighbor-index tensors, a protocol round is one jit'd
+scatter-add kernel, convergence is a reduction, and scale comes from
+sharding the node dimension over a TPU mesh with shard_map (SURVEY.md).
+"""
+
+from .config import SimConfig, normalize_algorithm, normalize_topology
+from .models.runner import RunResult, run
+from .ops.topology import Topology, build_topology
+
+__all__ = [
+    "SimConfig",
+    "Topology",
+    "RunResult",
+    "build_topology",
+    "normalize_algorithm",
+    "normalize_topology",
+    "run",
+]
+
+__version__ = "0.1.0"

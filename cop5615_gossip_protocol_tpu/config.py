@@ -1,0 +1,220 @@
+"""Simulation configuration.
+
+The reference has no config system: three raw positional CLI args
+(program.fs:19-21) and hard-coded constants — rumor threshold 10
+(program.fs:102), delta = 1e-10 (program.fs:187, 223, 263, 328), push-sum
+termination rounds C = 3 (program.fs:135). This module lifts all of those into
+one frozen dataclass, adds the knobs a real framework needs (seed, dtype,
+mesh, fault injection, checkpointing cadence), and resolves the
+dtype-dependent precision policy: push-sum at delta = 1e-10 requires float64,
+which is emulated/slow on TPU, so under float32 the default delta is rescaled
+(SURVEY.md §5 "Config / flag system").
+
+Two fidelity modes (SURVEY.md §7 design stance):
+
+- ``semantics="batched"`` — honest synchronous rounds, all nodes active: the
+  performant mode the benchmarks measure.
+- ``semantics="reference"`` — replicates the reference's observable quirks
+  (SURVEY.md §2 Q1-Q9) for apples-to-apples validation at small N: N+1
+  population with target N (Q1), gossip convergence on the 11th receipt (Q2),
+  push-sum termRound starting at 1 (Q4), "2D" wired as a line (Q6), Imp3D
+  rounding/orphans/random-extra (C3, Q8, Q9), and single-walk push-sum
+  (one message in flight, SURVEY.md §3.3).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+# Canonical topology kinds. CLI-parity spellings ("2D", "Imp3D") are
+# normalized by `normalize_topology`.
+TOPOLOGIES = (
+    "line",  # program.fs:151-171 — path graph, ends have one neighbor
+    "ring",  # line with wraparound (new; degree-regular variant)
+    "full",  # program.fs:191-206 — complete graph, represented implicitly
+    "grid2d",  # honest 2D 4-neighborhood grid (what the reference "2D" claims to be)
+    "ref2d",  # the reference's actual "2D": N rounded up to a square, wired as a line (Q6)
+    "imp2d",  # 2D grid + one random long-range edge per node (BASELINE.json configs)
+    "grid3d",  # 3D 6-neighborhood grid
+    "torus3d",  # 3D grid with wraparound — degree-regular 6 (BASELINE.json 10M config)
+    "imp3d",  # program.fs:267-313 — 3D grid + one random extra neighbor
+)
+
+ALGORITHMS = ("gossip", "push-sum")
+SEMANTICS = ("batched", "reference")
+
+_CLI_TOPOLOGY_ALIASES = {
+    "line": "line",
+    "ring": "ring",
+    "full": "full",
+    "2d": "grid2d",  # honest mode; reference semantics swaps this to ref2d
+    "grid2d": "grid2d",
+    "ref2d": "ref2d",
+    "imp2d": "imp2d",
+    "3d": "grid3d",
+    "grid3d": "grid3d",
+    "torus3d": "torus3d",
+    "imp3d": "imp3d",
+}
+
+_CLI_ALGORITHM_ALIASES = {
+    "gossip": "gossip",
+    "push-sum": "push-sum",
+    "pushsum": "push-sum",
+    "push_sum": "push-sum",
+}
+
+
+def normalize_topology(name: str, semantics: str = "batched") -> str:
+    """Map a CLI topology spelling to a canonical kind.
+
+    The reference CLI accepts {line, full, 2D, Imp3D} (program.fs:150). In
+    reference semantics "2D" maps to ``ref2d`` — the line-wired grid the
+    reference actually builds (program.fs:242-248) — while in batched
+    semantics it maps to the honest ``grid2d``.
+    """
+    key = name.strip().lower()
+    if key not in _CLI_TOPOLOGY_ALIASES:
+        raise ValueError(
+            f"unknown topology {name!r}; expected one of "
+            f"{sorted(set(_CLI_TOPOLOGY_ALIASES))}"
+        )
+    kind = _CLI_TOPOLOGY_ALIASES[key]
+    if kind == "grid2d" and semantics == "reference" and key == "2d":
+        return "ref2d"
+    return kind
+
+
+def normalize_algorithm(name: str) -> str:
+    key = name.strip().lower()
+    if key not in _CLI_ALGORITHM_ALIASES:
+        raise ValueError(
+            f"unknown algorithm {name!r}; expected one of {sorted(set(_CLI_ALGORITHM_ALIASES))}"
+        )
+    return _CLI_ALGORITHM_ALIASES[key]
+
+
+@dataclasses.dataclass(frozen=True)
+class SimConfig:
+    """Full description of one simulation run.
+
+    ``n`` is the *requested* node count; topology builders may round it
+    (2D up to a square, program.fs:228-229; Imp3D down to a cube,
+    program.fs:27-31) and, in reference semantics, add the extra actor of
+    quirk Q1. The actual population lives on the built Topology.
+    """
+
+    n: int
+    topology: str = "full"
+    algorithm: str = "gossip"
+    semantics: str = "batched"
+    seed: int = 0
+
+    # Precision policy (SURVEY.md §7 hard part 2).
+    dtype: str = "float32"
+    delta: float | None = None  # push-sum stability threshold; None → per-dtype default
+
+    rumor_threshold: int = 10  # program.fs:102
+    term_rounds: int = 3  # program.fs:135
+
+    max_rounds: int = 1_000_000
+    chunk_rounds: int = 4096  # rounds per jit'd while_loop chunk (checkpoint/metrics cadence)
+
+    # Fraction of population that must converge. None → 1.0 in batched mode;
+    # in reference semantics the builder's target_count (N of N+1, Q1) rules.
+    target_frac: float | None = None
+
+    # Gossip: skip sends whose target already converged (the reference's racy
+    # shared dictionary, program.fs:92, made race-free as a read of last
+    # round's converged vector). None → True in reference semantics.
+    suppress_converged: bool | None = None
+
+    # Simulated fault injection: per round, each node fails to send with this
+    # probability (SURVEY.md §5 "Failure detection").
+    fault_rate: float = 0.0
+
+    # Sharding: number of mesh devices for the node dimension; None/1 → single device.
+    n_devices: int | None = None
+
+    def __post_init__(self) -> None:
+        if self.n <= 0:
+            raise ValueError(f"n must be positive, got {self.n}")
+        if self.topology not in TOPOLOGIES:
+            raise ValueError(
+                f"unknown topology {self.topology!r}; expected one of {TOPOLOGIES}"
+            )
+        if self.algorithm not in ALGORITHMS:
+            raise ValueError(
+                f"unknown algorithm {self.algorithm!r}; expected one of {ALGORITHMS}"
+            )
+        if self.semantics not in SEMANTICS:
+            raise ValueError(
+                f"unknown semantics {self.semantics!r}; expected one of {SEMANTICS}"
+            )
+        if self.dtype not in ("float32", "float64", "bfloat16"):
+            raise ValueError(f"unsupported dtype {self.dtype!r}")
+        if self.term_rounds < 1:
+            raise ValueError("term_rounds must be >= 1")
+        if self.rumor_threshold < 1:
+            raise ValueError("rumor_threshold must be >= 1")
+        if not (0.0 <= self.fault_rate < 1.0):
+            raise ValueError("fault_rate must be in [0, 1)")
+        if not (1 <= self.max_rounds <= 2**30):
+            # The upper bound keeps round-indexed PRNG fold_in tags disjoint
+            # from the leader-draw tag (models/runner.py _LEADER_TAG).
+            raise ValueError("max_rounds must be in [1, 2**30]")
+        if self.chunk_rounds < 1:
+            raise ValueError("chunk_rounds must be >= 1")
+
+    # -- resolved policy ---------------------------------------------------
+
+    @property
+    def reference(self) -> bool:
+        return self.semantics == "reference"
+
+    @property
+    def resolved_delta(self) -> float:
+        """Push-sum delta. The reference hard-codes 1e-10 (program.fs:187).
+
+        1e-10 is unreachable below float64 (f32 ratio noise floor is ~1e-7
+        relative), so the float32/bfloat16 default is rescaled; an explicit
+        ``delta`` always wins.
+        """
+        if self.delta is not None:
+            return self.delta
+        if self.dtype == "float64":
+            return 1e-10
+        if self.dtype == "float32":
+            return 1e-6
+        return 1e-2  # bfloat16
+
+    @property
+    def resolved_rumor_target(self) -> int:
+        """Receipt count at which a gossip node converges.
+
+        Reference quirk Q2: the `messageCount = 10` check precedes the
+        increment (program.fs:102-105), so conversion happens on the 11th
+        receipt. Batched mode uses the honest threshold.
+        """
+        return self.rumor_threshold + 1 if self.reference else self.rumor_threshold
+
+    @property
+    def initial_term_round(self) -> int:
+        """Push-sum termRound initial value — 1 in the reference (Q4,
+        program.fs:79), so only two consecutive sub-delta rounds trigger the
+        first conversion; honest mode starts at 0."""
+        return 1 if self.reference else 0
+
+    @property
+    def resolved_suppress(self) -> bool:
+        if self.suppress_converged is not None:
+            return self.suppress_converged
+        return self.reference
+
+    def resolved_target_count(self, population: int, builder_target: int) -> int:
+        """Number of converged nodes that ends the run."""
+        if self.target_frac is not None:
+            return max(1, min(population, int(round(self.target_frac * population))))
+        if self.reference:
+            return builder_target  # Q1: N of N+1
+        return population

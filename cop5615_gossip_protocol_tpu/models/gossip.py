@@ -1,0 +1,73 @@
+"""Gossip (rumor spreading) — batched synchronous-round kernel.
+
+Reference semantics (program.fs:89-105): an informed node perpetually picks a
+uniform random neighbor and sends the rumor unless the target is already
+converged (checked against the racy shared dictionary, C6/program.fs:92); a
+node converges when its receipt count reaches the threshold — on the 11th
+receipt, by quirk Q2 (the `= 10` check precedes the increment,
+program.fs:102-105); converged nodes keep gossiping (Q3 — only the receiving
+side is suppressed).
+
+Batched recast: one round = every informed node samples one target and sends
+once. The converged-target suppression becomes a race-free read of *last
+round's* converged vector — same protocol role as the reference's dictionary
+probe, without the data race. The reference's hot loop burns CPU proportional
+to informed-nodes × dispatcher-rate regardless of progress (SURVEY.md §3.2);
+here a round is one fused scatter-add over all nodes.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from ..ops.delivery import deliver
+
+
+class GossipState(NamedTuple):
+    count: jnp.ndarray  # [n] int32 — rumor receipt count
+    active: jnp.ndarray  # [n] bool — has heard the rumor (spreads forever, Q3)
+    conv: jnp.ndarray  # [n] bool — count reached threshold
+
+
+def init_state(pop: int, leader: jnp.ndarray, leader_counts_receipt: bool) -> GossipState:
+    """Leader kickoff. In the reference, `full` starts the leader with
+    CallChildActor (program.fs:218) — its own kickoff counts as receipt #1 —
+    while line/2D/Imp3D start with ActivateChildActor (program.fs:181, 258,
+    323), which does not (C13)."""
+    ids = jnp.arange(pop)
+    active = ids == leader
+    count = jnp.where(
+        active & leader_counts_receipt, jnp.int32(1), jnp.int32(0)
+    )
+    return GossipState(count=count, active=active, conv=jnp.zeros((pop,), bool))
+
+
+def send_values(state: GossipState, targets, send_ok, suppress: bool, conv_of_target):
+    """int32 delivery values (1 per landed message) for this round.
+
+    ``conv_of_target`` is conv[targets] — on a single device a plain gather;
+    the sharded runner all_gathers conv first. With suppress False it is
+    ignored (honest batched mode default).
+    """
+    sending = state.active & send_ok
+    if suppress:
+        sending = sending & ~conv_of_target
+    return sending.astype(jnp.int32)
+
+
+def absorb(state: GossipState, inbox, rumor_target: int) -> GossipState:
+    count_new = state.count + inbox
+    active_new = state.active | (inbox > 0)
+    conv_new = count_new >= rumor_target
+    return GossipState(count=count_new, active=active_new, conv=conv_new)
+
+
+def round_from_targets(
+    state: GossipState, targets, send_ok, pop: int, rumor_target: int, suppress: bool
+) -> GossipState:
+    conv_of_target = state.conv[targets] if suppress else False
+    vals = send_values(state, targets, send_ok, suppress, conv_of_target)
+    inbox = deliver(vals, targets, pop)
+    return absorb(state, inbox, rumor_target)

@@ -1,0 +1,96 @@
+"""Push-sum (distributed averaging) — batched synchronous-round kernel.
+
+Reference semantics (program.fs:110-143): each node holds (sum, weight) with
+sum initialized to its index (program.fs:107-108, 159) and weight 1
+(program.fs:78); on each message it absorbs the incoming half-masses,
+compares the pre/post ratio s/w against delta, counts consecutive sub-delta
+rounds (C = 3, program.fs:135), then halves its state and forwards one half
+to a uniformly random neighbor. The reference keeps exactly ONE message in
+flight — a single random walk (SURVEY.md §3.3); this module implements the
+standard *synchronous* push-sum instead: every round, every node halves and
+sends to a random neighbor, and all deliveries land as one scatter-add. That
+converges in O(log N) rounds on good expanders and is the mode the
+benchmarks measure; the faithful single-walk lives in models/reference.py.
+
+Key semantic carry-over: in the reference a node's termination counter only
+advances when it *receives* a message (there is no clock — only message
+handlers). The batched kernel keeps that gate (``received = inbox_w > 0``):
+a node that merely halves has a bitwise-unchanged ratio, and counting those
+no-op rounds as "stable" would declare convergence on nodes the mass has
+never reached.
+
+Invariants (tested): Σ sum and Σ weight are conserved by every round up to
+fp error; converged ratios approach the true mean (pop-1)/2.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from ..ops.delivery import deliver
+
+
+class PushSumState(NamedTuple):
+    s: jnp.ndarray  # [n] float — running sum mass
+    w: jnp.ndarray  # [n] float — running weight mass
+    term: jnp.ndarray  # [n] int32 — consecutive sub-delta receipt rounds
+    conv: jnp.ndarray  # [n] bool — latched converged flag
+
+
+def init_state(pop: int, dtype, initial_term: int) -> PushSumState:
+    """s_i = i mirrors `InitializeVariables i` (program.fs:107-108, 159);
+    initial_term = 1 replicates quirk Q4 (program.fs:79) in reference
+    semantics, 0 in honest mode."""
+    return PushSumState(
+        s=jnp.arange(pop, dtype=dtype),
+        w=jnp.ones((pop,), dtype=dtype),
+        term=jnp.full((pop,), initial_term, dtype=jnp.int32),
+        conv=jnp.zeros((pop,), dtype=bool),
+    )
+
+
+def halve_and_send(s, w, send_ok):
+    """Split each sending node's mass in half (program.fs:113-114, 140-141).
+
+    Returns (s_send, w_send, s_keep, w_keep). Nodes with send_ok False
+    (degree-0 orphans, injected faults) keep their full mass — mass is
+    conserved regardless.
+    """
+    s_send = jnp.where(send_ok, s * jnp.asarray(0.5, s.dtype), jnp.zeros((), s.dtype))
+    w_send = jnp.where(send_ok, w * jnp.asarray(0.5, w.dtype), jnp.zeros((), w.dtype))
+    return s_send, w_send, s - s_send, w - w_send
+
+
+def absorb(state: PushSumState, s_keep, w_keep, inbox_s, inbox_w, delta, term_rounds):
+    """Absorb one round of deliveries and advance the termination counters.
+
+    Mirrors the ComputePushSum handler (program.fs:119-143): ratio change is
+    measured pre- vs post-absorb; > delta resets the counter, <= delta
+    increments it (program.fs:130-133); reaching term_rounds latches
+    convergence (program.fs:135-137). The receipt gate stands in for the
+    reference's "no message, no handler" semantics.
+    """
+    s_new = s_keep + inbox_s
+    w_new = w_keep + inbox_w
+    received = inbox_w > 0
+    ratio_old = state.s / state.w
+    ratio_new = s_new / w_new
+    stable = jnp.abs(ratio_new - ratio_old) <= jnp.asarray(delta, state.s.dtype)
+    term_new = jnp.where(
+        received, jnp.where(stable, state.term + 1, 0), state.term
+    )
+    conv_new = state.conv | (term_new >= term_rounds)
+    return PushSumState(s=s_new, w=w_new, term=term_new, conv=conv_new)
+
+
+def round_from_targets(
+    state: PushSumState, targets, send_ok, pop: int, delta, term_rounds
+) -> PushSumState:
+    """One full synchronous round on a single device (sharded delivery lives
+    in parallel/sharded.py, built from the same halve_and_send/absorb)."""
+    s_send, w_send, s_keep, w_keep = halve_and_send(state.s, state.w, send_ok)
+    inbox_s = deliver(s_send, targets, pop)
+    inbox_w = deliver(w_send, targets, pop)
+    return absorb(state, s_keep, w_keep, inbox_s, inbox_w, delta, term_rounds)

@@ -1,0 +1,176 @@
+"""Reference-fidelity push-sum: a single random walk.
+
+The reference's push-sum keeps exactly ONE message in flight: every
+ComputePushSum receipt triggers exactly one send (program.fs:110-143), so the
+protocol is a lone random walk carrying half-masses through the graph
+(SURVEY.md §3.3) — convergence time is walk cover/mixing time, not O(log N)
+synchronous rounds. This mode exists for apples-to-apples validation against
+the reference at small N (SURVEY.md §7 hard part 5); it is inherently
+sequential — a `lax.while_loop` advancing one hop per iteration — and is
+never the benchmark path.
+
+Faithful details carried over:
+
+- Kickoff (PushSum handler, program.fs:110-116): the leader halves (s, w)
+  and sends the halves to a random neighbor.
+- Non-converged receipt (program.fs:119-143): absorb, compare pre/post
+  ratio to delta, reset-or-increment termRound, latch convergence at
+  term_rounds (reporting pre-absorb values — quirk Q5 — which we mirror by
+  latching before the absorb overwrites state), then halve and forward.
+- Converged receipt (program.fs:125-127): relay the incoming (s, w)
+  UNTOUCHED to a random neighbor — mass conservation holds, the node's own
+  state is frozen (Q5).
+- termRound resets to 0 when convergence fires (program.fs:136).
+- Q8: if the walk reaches a degree-0 orphan (possible in Imp3D — random
+  extra edges can point at orphans), the reference actor crashes on the
+  empty-array index and the message is lost in the restart — the walk dies.
+  We model that as a `dead` latch that freezes the walk.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..config import SimConfig
+from ..ops import sampling
+from ..ops.topology import Topology
+
+
+class WalkCarry(NamedTuple):
+    s: jnp.ndarray  # [n]
+    w: jnp.ndarray  # [n]
+    term: jnp.ndarray  # [n] int32
+    conv: jnp.ndarray  # [n] bool
+    cur: jnp.ndarray  # () int32 — node about to process the in-flight message
+    msg_s: jnp.ndarray  # () — in-flight sum mass
+    msg_w: jnp.ndarray  # () — in-flight weight mass
+    steps: jnp.ndarray  # () int32 — hops taken
+    dead: jnp.ndarray  # () bool — walk hit an orphan (Q8)
+
+
+def make_walk(topo: Topology, cfg: SimConfig, base_key: jax.Array, leader: jax.Array):
+    """Build (step_fn, carry0, topo_args) for the single-walk push-sum.
+
+    step_fn(carry, *topo_args) -> carry advances one message hop. carry0 is
+    the post-kickoff state: leader already halved, halves in flight toward a
+    random neighbor of the leader.
+    """
+    dtype = jnp.dtype(cfg.dtype)
+    n = topo.n
+    delta = jnp.asarray(cfg.resolved_delta, dtype)
+    term_rounds = cfg.term_rounds
+
+    if topo.implicit:
+        topo_args = ()
+    else:
+        topo_args = (jnp.asarray(topo.neighbors), jnp.asarray(topo.degree))
+
+    def pick_neighbor(key, node, *targs):
+        """Uniform random neighbor of `node` — Random().Next(0, deg) +
+        index (program.fs:91 et al.). Returns (target, ok) where ok is False
+        for a degree-0 orphan."""
+        bits = jax.random.bits(key, (), jnp.uint32)
+        if topo.implicit:
+            shift = 1 + (bits % jnp.uint32(n - 1)).astype(jnp.int32)
+            return (node + shift) % n, jnp.bool_(True)
+        neighbors, degree = targs
+        deg = degree[node]
+        slot = (bits % jnp.maximum(deg, 1).astype(jnp.uint32)).astype(jnp.int32)
+        return neighbors[node, slot], deg > 0
+
+    # Kickoff: PushSum handler (program.fs:110-116).
+    s0 = jnp.arange(n, dtype=dtype)
+    w0 = jnp.ones((n,), dtype=dtype)
+    half_s = s0[leader] * 0.5
+    half_w = w0[leader] * 0.5
+    s0 = s0.at[leader].set(half_s)
+    w0 = w0.at[leader].set(half_w)
+    first_target, first_ok = pick_neighbor(
+        jax.random.fold_in(base_key, 0), leader, *topo_args
+    )
+    carry0 = WalkCarry(
+        s=s0,
+        w=w0,
+        term=jnp.full((n,), cfg.initial_term_round, dtype=jnp.int32),
+        conv=jnp.zeros((n,), bool),
+        cur=first_target.astype(jnp.int32),
+        msg_s=half_s,
+        msg_w=half_w,
+        steps=jnp.int32(1),
+        dead=~first_ok,
+    )
+
+    def step_fn(c: WalkCarry, *targs) -> WalkCarry:
+        cur = c.cur
+        key = jax.random.fold_in(base_key, c.steps)
+        s_c = c.s[cur]
+        w_c = c.w[cur]
+        newsum = s_c + c.msg_s
+        newweight = w_c + c.msg_w
+        cal = jnp.abs(s_c / w_c - newsum / newweight)
+
+        is_conv = c.conv[cur]
+        # Non-converged branch (program.fs:129-143):
+        term_new = jnp.where(cal > delta, 0, c.term[cur] + 1)
+        fires = term_new >= term_rounds
+        term_new = jnp.where(fires, 0, term_new)  # reset after firing, program.fs:136
+        s_cur_new = newsum * 0.5
+        w_cur_new = newweight * 0.5
+
+        # Converged relay (program.fs:125-127) leaves state untouched and
+        # forwards the incoming message unchanged.
+        s_out = jnp.where(is_conv, c.msg_s, s_cur_new)
+        w_out = jnp.where(is_conv, c.msg_w, w_cur_new)
+        s_new = c.s.at[cur].set(jnp.where(is_conv, s_c, s_cur_new))
+        w_new = c.w.at[cur].set(jnp.where(is_conv, w_c, w_cur_new))
+        term_arr = c.term.at[cur].set(jnp.where(is_conv, c.term[cur], term_new))
+        conv_arr = c.conv.at[cur].set(is_conv | fires)
+
+        target, ok = pick_neighbor(key, cur, *targs)
+        return WalkCarry(
+            s=s_new,
+            w=w_new,
+            term=term_arr,
+            conv=conv_arr,
+            cur=target.astype(jnp.int32),
+            msg_s=s_out,
+            msg_w=w_out,
+            steps=c.steps + 1,
+            dead=c.dead | ~ok,
+        )
+
+    return step_fn, carry0, topo_args
+
+
+def run_walk(topo: Topology, cfg: SimConfig, base_key: jax.Array, leader: jax.Array, target: int):
+    """Drive the walk to convergence / death / cfg.max_rounds hops.
+
+    Returns (final WalkCarry, compile_s, run_s). In walk mode the harness's
+    "rounds" counts message hops — the comparable quantity to the
+    reference's per-message processing (SURVEY.md §3.3).
+    """
+    import time
+
+    step_fn, carry0, topo_args = make_walk(topo, cfg, base_key, leader)
+    max_steps = cfg.max_rounds
+
+    def whole(c: WalkCarry, *targs):
+        def cond(c):
+            return (~c.dead) & (c.steps < max_steps) & (jnp.sum(c.conv) < target)
+
+        def body(c):
+            return step_fn(c, *targs)
+
+        return lax.while_loop(cond, body, c)
+
+    t0 = time.perf_counter()
+    compiled = jax.jit(whole).lower(carry0, *topo_args).compile()
+    compile_s = time.perf_counter() - t0
+    t1 = time.perf_counter()
+    final = jax.block_until_ready(compiled(carry0, *topo_args))
+    run_s = time.perf_counter() - t1
+    return final, compile_s, run_s

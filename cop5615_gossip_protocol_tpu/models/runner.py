@@ -1,0 +1,270 @@
+"""Single-device round-loop harness.
+
+Replaces the reference's L3/L5 machinery — the ParentActor counting
+CompletedMessage/PushSumResult arrivals and killing the process
+(program.fs:38-67), the Stopwatch (program.fs:22), and the per-topology
+kickoff scripts (program.fs:151-330) — with a data-driven loop: global
+convergence is a reduction (`sum(conv) >= target`) evaluated as the
+`lax.while_loop` predicate, and the result is a value returned to the
+caller, not a side-effecting `Environment.Exit`.
+
+The loop runs in jit'd *chunks* of `cfg.chunk_rounds` rounds: each chunk is
+one `lax.while_loop` that early-exits on convergence, and the host syncs only
+at chunk boundaries — where checkpoint/metrics hooks fire. Timing is split
+compile vs run (SURVEY.md §5 tracing plan): XLA compile time would otherwise
+dominate and corrupt small-run comparisons against the reference's
+Stopwatch numbers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..config import SimConfig
+from ..ops import sampling
+from ..ops.topology import Topology
+from . import gossip as gossip_mod
+from . import pushsum as pushsum_mod
+
+# fold_in tag for the leader draw. Round keys are fold_in(base, round) with
+# round < max_rounds <= 2**30 (enforced in SimConfig), so a tag above that
+# range can never collide with a round key.
+_LEADER_TAG = 2**31 - 1
+
+
+@dataclasses.dataclass
+class RunResult:
+    """Structured replacement for the reference's single
+    'Convergence Time: %f ms' print (program.fs:51-52)."""
+
+    algorithm: str
+    topology: str
+    semantics: str
+    n_requested: int
+    population: int
+    target_count: int
+    rounds: int
+    converged_count: int
+    converged: bool
+    compile_s: float
+    run_s: float
+    build_s: float = 0.0
+    # push-sum only:
+    true_mean: Optional[float] = None
+    estimate_mae: Optional[float] = None
+
+    @property
+    def wall_ms(self) -> float:
+        """Steady-state run wall-clock in ms — the number comparable to the
+        reference's convergence-time print (its Stopwatch starts after
+        topology build, program.fs:175)."""
+        return self.run_s * 1e3
+
+    def to_record(self) -> dict:
+        rec = dataclasses.asdict(self)
+        rec["wall_ms"] = self.wall_ms
+        rec["rounds_per_sec"] = self.rounds / self.run_s if self.run_s > 0 else None
+        return rec
+
+
+def _check_dtype(cfg: SimConfig) -> jnp.dtype:
+    dtype = jnp.dtype(cfg.dtype)
+    if cfg.dtype == "float64" and not jax.config.jax_enable_x64:
+        raise ValueError(
+            "dtype=float64 requires jax_enable_x64 "
+            "(jax.config.update('jax_enable_x64', True)); on TPU prefer "
+            "float32 with the rescaled default delta (SimConfig.resolved_delta)"
+        )
+    return dtype
+
+
+def draw_leader(base_key: jax.Array, topo: Topology, cfg: SimConfig) -> jax.Array:
+    """Leader ∈ [0, nodes) — the reference draws Random().Next(0, nodes)
+    where `nodes` excludes the Q1 extra actor (program.fs:173)."""
+    upper = topo.target_count if cfg.reference else topo.n
+    return jax.random.randint(
+        jax.random.fold_in(base_key, _LEADER_TAG), (), 0, upper, dtype=jnp.int32
+    )
+
+
+def make_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array):
+    """Build (round_fn, state0, topo_args).
+
+    ``round_fn(state, round_idx, *topo_args) -> state`` is one synchronous
+    protocol round, pure and jittable — the unit `__graft_entry__.entry`
+    compile-checks. ``topo_args`` carries the neighbor tensors as explicit
+    arguments so multi-hundred-MB adjacency is never baked into the
+    executable as a constant.
+    """
+    dtype = _check_dtype(cfg)
+    n = topo.n
+
+    if topo.implicit:
+        topo_args = ()
+    else:
+        topo_args = (jnp.asarray(topo.neighbors), jnp.asarray(topo.degree))
+
+    def targets_and_gate(round_idx, *targs):
+        # ids generated inside the trace (lax.iota) — never a baked constant.
+        ids = jnp.arange(n, dtype=jnp.int32)
+        kr = sampling.round_key(base_key, round_idx)
+        bits = sampling.uniform_bits(kr, n)
+        if topo.implicit:
+            targets = sampling.targets_full(bits, ids, n)
+            send_ok = jnp.ones((n,), bool)
+        else:
+            neighbors, degree = targs
+            targets = sampling.targets_explicit(bits, neighbors, degree)
+            send_ok = degree > 0
+        gate = sampling.send_gate(kr, n, cfg.fault_rate)
+        if gate is not True:
+            send_ok = send_ok & gate
+        return targets, send_ok
+
+    if cfg.algorithm == "push-sum":
+        state0 = pushsum_mod.init_state(n, dtype, cfg.initial_term_round)
+        delta = cfg.resolved_delta
+        term_rounds = cfg.term_rounds
+
+        def round_fn(state, round_idx, *targs):
+            targets, send_ok = targets_and_gate(round_idx, *targs)
+            return pushsum_mod.round_from_targets(
+                state, targets, send_ok, n, delta, term_rounds
+            )
+
+    else:
+        leader = draw_leader(base_key, topo, cfg)
+        state0 = gossip_mod.init_state(
+            n, leader, leader_counts_receipt=cfg.reference and topo.kind == "full"
+        )
+        rumor_target = cfg.resolved_rumor_target
+        suppress = cfg.resolved_suppress
+
+        def round_fn(state, round_idx, *targs):
+            targets, send_ok = targets_and_gate(round_idx, *targs)
+            return gossip_mod.round_from_targets(
+                state, targets, send_ok, n, rumor_target, suppress
+            )
+
+    return round_fn, state0, topo_args
+
+
+def _run_reference_walk(topo: Topology, cfg: SimConfig, key, target: int) -> RunResult:
+    from . import reference as reference_mod
+
+    _check_dtype(cfg)
+    leader = draw_leader(key, topo, cfg)
+    final, compile_s, run_s = reference_mod.run_walk(topo, cfg, key, leader, target)
+    converged_count = int(jnp.sum(final.conv))
+    result = RunResult(
+        algorithm=cfg.algorithm,
+        topology=topo.kind,
+        semantics=cfg.semantics,
+        n_requested=topo.n_requested,
+        population=topo.n,
+        target_count=target,
+        rounds=int(final.steps),  # message hops, not synchronous rounds
+        converged_count=converged_count,
+        converged=converged_count >= target,
+        compile_s=compile_s,
+        run_s=run_s,
+    )
+    ratio = final.s / final.w
+    true_mean = (topo.n - 1) / 2.0
+    err = jnp.where(final.conv, jnp.abs(ratio - true_mean), 0.0)
+    result.true_mean = true_mean
+    result.estimate_mae = float(jnp.sum(err) / jnp.maximum(converged_count, 1))
+    return result
+
+
+def run(
+    topo: Topology,
+    cfg: SimConfig,
+    key: Optional[jax.Array] = None,
+    on_chunk: Optional[Callable[[int, object], None]] = None,
+) -> RunResult:
+    """Run one simulation to convergence (or cfg.max_rounds) on one device.
+
+    ``on_chunk(rounds_done, state)`` fires at every chunk boundary — the
+    checkpoint/metrics hook point.
+    """
+    if key is None:
+        key = jax.random.PRNGKey(cfg.seed)
+    if cfg.n_devices is not None and cfg.n_devices > 1:
+        raise NotImplementedError(
+            "n_devices > 1 is served by the sharded runner "
+            "(cop5615_gossip_protocol_tpu.parallel); this entry point is "
+            "single-device"
+        )
+    target = cfg.resolved_target_count(topo.n, topo.target_count)
+    if cfg.reference and cfg.algorithm == "push-sum":
+        # Reference fidelity: single-walk push-sum (one message in flight,
+        # SURVEY.md §3.3). Gossip has no such mode — the reference's gossip
+        # is all informed nodes spamming concurrently, which the batched
+        # round (one send per informed node per round) already models.
+        return _run_reference_walk(topo, cfg, key, target)
+    round_fn, state0, topo_args = make_round_fn(topo, cfg, key)
+
+    def chunk(carry, round_end, *targs):
+        def cond(c):
+            _, rnd, done = c
+            return jnp.logical_and(~done, rnd < round_end)
+
+        def body(c):
+            state, rnd, _ = c
+            state = round_fn(state, rnd, *targs)
+            done = jnp.sum(state.conv) >= target
+            return (state, rnd + 1, done)
+
+        return lax.while_loop(cond, body, carry)
+
+    chunk_j = jax.jit(chunk)
+    carry = (state0, jnp.int32(0), jnp.bool_(False))
+
+    t0 = time.perf_counter()
+    carry = jax.block_until_ready(chunk_j(carry, jnp.int32(0), *topo_args))
+    compile_s = time.perf_counter() - t0
+
+    rounds = 0
+    t1 = time.perf_counter()
+    while True:
+        round_end = min(rounds + cfg.chunk_rounds, cfg.max_rounds)
+        carry = chunk_j(carry, jnp.int32(round_end), *topo_args)
+        state, rnd, done = carry
+        rounds = int(rnd)  # forces a host sync at the chunk boundary
+        if on_chunk is not None:
+            on_chunk(rounds, state)
+        if bool(done) or rounds >= cfg.max_rounds:
+            break
+    run_s = time.perf_counter() - t1
+
+    state, _, _ = carry
+    converged_count = int(jnp.sum(state.conv))
+    result = RunResult(
+        algorithm=cfg.algorithm,
+        topology=topo.kind,
+        semantics=cfg.semantics,
+        n_requested=topo.n_requested,
+        population=topo.n,
+        target_count=target,
+        rounds=rounds,
+        converged_count=converged_count,
+        converged=converged_count >= target,
+        compile_s=compile_s,
+        run_s=run_s,
+    )
+    if cfg.algorithm == "push-sum":
+        ratio = state.s / state.w
+        true_mean = (topo.n - 1) / 2.0
+        err = jnp.where(state.conv, jnp.abs(ratio - true_mean), 0.0)
+        result.true_mean = true_mean
+        result.estimate_mae = float(
+            jnp.sum(err) / jnp.maximum(converged_count, 1)
+        )
+    return result

@@ -1,0 +1,338 @@
+"""Topology construction — topologies are data, not wiring code.
+
+The reference wires `IActorRef` neighbor arrays imperatively inside each CLI
+branch (line program.fs:162-171, full program.fs:201-206, "2D"
+program.fs:242-248, Imp3D program.fs:281-313). Here every topology is a pure
+function returning a padded integer neighbor tensor ``[n, max_deg]`` plus a
+degree vector — the layout the TPU kernels gather from — built in NumPy on
+the host (topology build is data prep, not device work).
+
+The complete graph is *implicit* (``neighbors is None``): the reference
+materializes N² actor refs with repeated Array.append — O(N³) copy work, the
+reason it caps out at ~2000 nodes (report.pdf p.3 §4) — whereas the kernels
+here sample a uniform partner j≠i directly via rejection-free index shifting,
+so ``full`` costs O(1) memory at any N (SURVEY.md §7 hard part 3).
+
+Reference-semantics quirks replicated when ``semantics="reference"``:
+
+- Q1: every topology gets population n+1 with convergence target n
+  (Array.zeroCreate (nodes+1), loops [0..nodes]: program.fs:152-154 etc., vs
+  AllNodes(nodes): program.fs:178).
+- Q6: "2D" (``ref2d``) rounds n up to a perfect square (program.fs:228-229)
+  but wires neighbors as {i-1, i+1} only (program.fs:242-248) — a line.
+- C3: Imp3D rounds n down to floor(n**0.33334)**3 (program.fs:27-31) while
+  the lattice uses the *different* exponent floor(n**0.34) (program.fs:268).
+- Q8: Imp3D indices not covered by the lattice are spawned but never wired —
+  degree-0 orphans.
+- Q9: the Imp3D random extra neighbor is drawn from [0, n-1) — excluding the
+  last node — and may be a self-edge or duplicate a grid neighbor
+  (program.fs:308-310).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """Immutable host-side description of a network.
+
+    ``neighbors``/``degree`` are None for implicit kinds (``full``), where
+    kernels sample partners arithmetically instead of gathering rows.
+    ``target_count`` is the number of converged nodes that declares global
+    convergence — n for batched semantics, the reference's N-of-N+1 (Q1)
+    otherwise.
+    """
+
+    kind: str
+    n: int  # actual population (after rounding / +1 quirks)
+    n_requested: int
+    target_count: int
+    max_deg: int
+    neighbors: Optional[np.ndarray]  # [n, max_deg] int32, rows padded with 0
+    degree: Optional[np.ndarray]  # [n] int32
+
+    @property
+    def implicit(self) -> bool:
+        return self.neighbors is None
+
+    def validate(self) -> None:
+        if self.implicit:
+            return
+        assert self.neighbors.shape == (self.n, self.max_deg)
+        assert self.degree.shape == (self.n,)
+        assert self.neighbors.dtype == np.int32 and self.degree.dtype == np.int32
+        assert (self.degree >= 0).all() and (self.degree <= self.max_deg).all()
+        # Every in-degree slot must index a real node.
+        cols = np.arange(self.max_deg)[None, :]
+        live = cols < self.degree[:, None]
+        assert (self.neighbors[live] >= 0).all() and (self.neighbors[live] < self.n).all()
+
+
+def _pack(rows: list[list[int]], kind: str, n_requested: int, target: int) -> Topology:
+    n = len(rows)
+    max_deg = max((len(r) for r in rows), default=0)
+    max_deg = max(max_deg, 1)  # keep a non-degenerate trailing dim for XLA tiling
+    neighbors = np.zeros((n, max_deg), dtype=np.int32)
+    degree = np.zeros((n,), dtype=np.int32)
+    for i, r in enumerate(rows):
+        degree[i] = len(r)
+        neighbors[i, : len(r)] = r
+    topo = Topology(kind, n, n_requested, target, max_deg, neighbors, degree)
+    topo.validate()
+    return topo
+
+
+# ---------------------------------------------------------------------------
+# Builders. Each returns a Topology; `reference=True` applies the Q1
+# population/+1 target quirk (and kind-specific quirks documented per builder).
+# ---------------------------------------------------------------------------
+
+
+def _line_rows(pop: int) -> list[list[int]]:
+    """{i-1, i+1} chain wiring — shared by build_line and build_ref2d (the
+    reference's "2D" uses exactly this wiring, Q6)."""
+    rows = []
+    for i in range(pop):
+        r = []
+        if i > 0:
+            r.append(i - 1)
+        if i < pop - 1:
+            r.append(i + 1)
+        rows.append(r)
+    return rows
+
+
+def build_line(n: int, reference: bool = False) -> Topology:
+    """Path graph: node i ↔ {i-1, i+1}; ends have one neighbor
+    (program.fs:162-171)."""
+    pop = n + 1 if reference else n
+    return _pack(_line_rows(pop), "line", n, n if reference else pop)
+
+
+def build_ring(n: int, reference: bool = False) -> Topology:
+    """Cycle graph — degree-regular line variant (new capability)."""
+    pop = n + 1 if reference else n
+    rows = [[(i - 1) % pop, (i + 1) % pop] for i in range(pop)]
+    return _pack(rows, "ring", n, n if reference else pop)
+
+
+def build_full(n: int, reference: bool = False) -> Topology:
+    """Complete graph, implicit: kernels sample j≠i by index shifting rather
+    than gathering from an adjacency row. Replaces the reference's O(N²)
+    materialized neighbor arrays (program.fs:201-206)."""
+    pop = n + 1 if reference else n
+    if pop < 2:
+        raise ValueError("full topology needs at least 2 nodes")
+    return Topology("full", pop, n, n if reference else pop, 0, None, None)
+
+
+def _grid2d_rows(side: int) -> list[list[int]]:
+    rows = []
+    for y in range(side):
+        for x in range(side):
+            i = y * side + x
+            r = []
+            if x > 0:
+                r.append(i - 1)
+            if x < side - 1:
+                r.append(i + 1)
+            if y > 0:
+                r.append(i - side)
+            if y < side - 1:
+                r.append(i + side)
+            rows.append(r)
+    return rows
+
+
+def build_grid2d(n: int, reference: bool = False) -> Topology:
+    """Honest 2D 4-neighborhood grid — what the reference's "2D" claims to be.
+    n rounds up to the next perfect square (program.fs:228-229)."""
+    side = math.ceil(math.sqrt(n))
+    pop = side * side
+    rows = _grid2d_rows(side)
+    target = pop
+    if reference:
+        # Q1 population quirk: one extra, unwired actor beyond the lattice.
+        rows.append([])
+        pop = pop + 1
+    return _pack(rows, "grid2d", n, target)
+
+
+def build_ref2d(n: int, reference: bool = True) -> Topology:
+    """The reference's actual "2D" (Q6): round n up to gridSize², then wire
+    {i-1, i+1} only (program.fs:227-248) — behaviorally a line over the
+    rounded population."""
+    side = math.ceil(math.sqrt(n))
+    sq = side * side
+    pop = sq + 1 if reference else sq
+    return _pack(_line_rows(pop), "ref2d", n, sq if reference else pop)
+
+
+def build_imp2d(n: int, seed: int = 0, reference: bool = False) -> Topology:
+    """2D grid + one uniformly random long-range edge per node (directed,
+    j ≠ i) — the `imp2D` scaling config from BASELINE.json."""
+    side = math.ceil(math.sqrt(n))
+    pop = side * side
+    rows = _grid2d_rows(side)
+    rng = np.random.default_rng(seed)
+    if pop >= 2:  # a 1-node grid has no possible long-range partner
+        for i in range(pop):
+            j = int(rng.integers(0, pop - 1))
+            if j >= i:
+                j += 1  # uniform over [0, pop) \ {i}
+            rows[i].append(j)
+    target = pop
+    if reference:
+        rows.append([])
+        pop = pop + 1
+    return _pack(rows, "imp2d", n, target)
+
+
+def _cube_side(n: int, min_side: int = 1) -> int:
+    """Largest g with g³ <= n (floored cube side), clamped to min_side.
+    The honest-mode analog of the reference's two inconsistent roundings
+    (program.fs:27-31 vs :268)."""
+    g = round(n ** (1 / 3))
+    if g**3 > n:
+        g -= 1
+    return max(g, min_side)
+
+
+def _grid3d_rows(g: int, limit: int) -> list[list[int]]:
+    """6-neighborhood over a g³ lattice, truncated to indices < limit —
+    mirrors the bounds checks at program.fs:295-306."""
+    rows: list[list[int]] = [[] for _ in range(limit)]
+    z_mul = g * g
+    for z in range(g):
+        for y in range(g):
+            for x in range(g):
+                i = z * z_mul + y * g + x
+                if i >= limit:
+                    continue
+                r = rows[i]
+                if x > 0:
+                    r.append(i - 1)
+                if x < g - 1 and i + 1 < limit:
+                    r.append(i + 1)
+                if y > 0:
+                    r.append(i - g)
+                if y < g - 1 and i + g < limit:
+                    r.append(i + g)
+                if z > 0:
+                    r.append(i - z_mul)
+                if z < g - 1 and i + z_mul < limit:
+                    r.append(i + z_mul)
+    return rows
+
+
+def build_grid3d(n: int, reference: bool = False) -> Topology:
+    """Honest 3D 6-neighborhood grid; n rounds down to a perfect cube."""
+    g = _cube_side(n)
+    pop = g**3
+    rows = _grid3d_rows(g, pop)
+    target = pop
+    if reference:
+        rows.append([])
+        pop += 1
+    return _pack(rows, "grid3d", n, target)
+
+
+def build_torus3d(n: int, reference: bool = False) -> Topology:
+    """3D torus — wraparound grid (BASELINE.json 10M multi-host config).
+    Always 6 neighbor slots per node, so sampling needs no masking; note at
+    g=2 the wraparound makes ±1 along an axis the *same* node, so rows carry
+    multi-edges with doubled sampling weight — the true torus behavior.
+    n rounds down to a perfect cube; n < 8 has no torus and raises."""
+    if n < 8:
+        raise ValueError("torus3d needs at least 8 nodes (cube side >= 2)")
+    g = _cube_side(n, min_side=2)
+    pop = g**3
+    z_mul = g * g
+    idx = np.arange(pop)
+    x = idx % g
+    y = (idx // g) % g
+    z = idx // z_mul
+    nbrs = np.stack(
+        [
+            z * z_mul + y * g + (x - 1) % g,
+            z * z_mul + y * g + (x + 1) % g,
+            z * z_mul + ((y - 1) % g) * g + x,
+            z * z_mul + ((y + 1) % g) * g + x,
+            ((z - 1) % g) * z_mul + y * g + x,
+            ((z + 1) % g) * z_mul + y * g + x,
+        ],
+        axis=1,
+    ).astype(np.int32)
+    degree = np.full((pop,), 6, dtype=np.int32)
+    topo = Topology("torus3d", pop, n, pop, 6, nbrs, degree)
+    topo.validate()
+    return topo
+
+
+def build_imp3d(n: int, seed: int = 0, reference: bool = False) -> Topology:
+    """Imperfect 3D grid: 6-neighborhood lattice + one random extra neighbor
+    per node (program.fs:267-313).
+
+    Reference mode replicates C3/Q8/Q9 exactly: n rounds down via
+    floor(n**0.33334)**3 (program.fs:27-31); the lattice side uses the
+    *different* exponent floor(n**0.34) (program.fs:268), so indices the
+    lattice misses become degree-0 orphans (Q8); population is rounded_n+1
+    (Q1); the random extra is drawn from [0, rounded_n - 1) and may be a
+    self-edge or duplicate (Q9).
+
+    Honest mode: n rounds down to a cube, full lattice coverage, extra edge
+    uniform over j ≠ i.
+    """
+    rng = np.random.default_rng(seed)
+    if reference:
+        rounded = int(math.floor(n**0.33334)) ** 3
+        rounded = max(rounded, 1)
+        g = max(int(math.floor(n**0.34)), 1)
+        pop = rounded + 1
+        rows: list[list[int]] = [[] for _ in range(pop)]
+        lattice = _grid3d_rows(g, min(g**3, rounded))
+        for i, r in enumerate(lattice):
+            rows[i] = list(r)
+            # Q9: Random().Next(0, nodes-1) — upper bound exclusive, so the
+            # draw never selects index rounded-1; self/duplicate edges kept.
+            extra = int(rng.integers(0, max(rounded - 1, 1)))
+            rows[i].append(extra)
+        return _pack(rows, "imp3d", n, rounded)
+    if n < 8:
+        raise ValueError("imp3d needs at least 8 nodes (cube side >= 2)")
+    g = _cube_side(n, min_side=2)
+    pop = g**3
+    rows = _grid3d_rows(g, pop)
+    for i in range(pop):
+        j = int(rng.integers(0, pop - 1))
+        if j >= i:
+            j += 1  # uniform over [0, pop) \ {i}
+        rows[i].append(j)
+    return _pack(rows, "imp3d", n, pop)
+
+
+_BUILDERS = {
+    "line": lambda n, seed, ref: build_line(n, ref),
+    "ring": lambda n, seed, ref: build_ring(n, ref),
+    "full": lambda n, seed, ref: build_full(n, ref),
+    "grid2d": lambda n, seed, ref: build_grid2d(n, ref),
+    "ref2d": lambda n, seed, ref: build_ref2d(n, ref),
+    "imp2d": lambda n, seed, ref: build_imp2d(n, seed, ref),
+    "grid3d": lambda n, seed, ref: build_grid3d(n, ref),
+    "torus3d": lambda n, seed, ref: build_torus3d(n, ref),
+    "imp3d": lambda n, seed, ref: build_imp3d(n, seed, ref),
+}
+
+
+def build_topology(kind: str, n: int, *, seed: int = 0, semantics: str = "batched") -> Topology:
+    """Dispatch to a builder — the TPU-native analog of the `match topology`
+    at program.fs:150, as a pure function instead of a side-effecting script."""
+    if kind not in _BUILDERS:
+        raise ValueError(f"unknown topology kind {kind!r}")
+    return _BUILDERS[kind](n, seed, semantics == "reference")

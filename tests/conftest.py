@@ -1,0 +1,40 @@
+"""Test harness bootstrap.
+
+The reference (program.fs) has no tests at all — its validation story is manual
+timed runs (SURVEY.md §4). This suite is the capability scaffolding the new
+framework adds. Multi-device code paths are exercised without a TPU pod by
+forcing 8 virtual CPU devices, per the distributed-without-a-cluster strategy
+in SURVEY.md §4: the same `shard_map` collective program runs unchanged on CPU
+devices.
+
+This file MUST set the environment before jax is imported anywhere.
+"""
+
+import os
+import sys
+from pathlib import Path
+
+# Repo root importable (package is not pip-installed in this environment).
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# Force CPU-only AFTER importing jax: this container's sitecustomize
+# registers a remote-TPU PJRT plugin and force-overrides jax_platforms at
+# registration time, so the env var alone is not sufficient — a config
+# update after import is. Without this, every pytest process claims the
+# single remote TPU session and concurrent runs deadlock on the tunnel.
+jax.config.update("jax_platforms", "cpu")
+
+# float64 is required to honor the reference's delta = 1e-10 push-sum
+# termination threshold (program.fs:187 et al.); on TPU the framework instead
+# rescales delta for float32 (see SimConfig.resolved_delta). Tests run on CPU
+# where x64 is native.
+jax.config.update("jax_enable_x64", True)

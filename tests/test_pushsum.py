@@ -1,0 +1,142 @@
+"""Push-sum kernel vs a pure-NumPy oracle, plus the §4 invariants: per-round
+mass conservation, convergence to the true mean (pop-1)/2, receipt-gated
+termination counters, and determinism under a seed."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cop5615_gossip_protocol_tpu import SimConfig, build_topology, run
+from cop5615_gossip_protocol_tpu.models import pushsum as P
+from cop5615_gossip_protocol_tpu.models.runner import make_round_fn
+
+
+def np_round(s, w, term, conv, targets, send_ok, delta, term_rounds):
+    """10-line NumPy oracle for one synchronous push-sum round."""
+    s_send = np.where(send_ok, s / 2, 0.0)
+    w_send = np.where(send_ok, w / 2, 0.0)
+    inbox_s = np.zeros_like(s)
+    inbox_w = np.zeros_like(w)
+    np.add.at(inbox_s, targets, s_send)
+    np.add.at(inbox_w, targets, w_send)
+    s_new = (s - s_send) + inbox_s
+    w_new = (w - w_send) + inbox_w
+    received = inbox_w > 0
+    stable = np.abs(s_new / w_new - s / w) <= delta
+    term_new = np.where(received, np.where(stable, term + 1, 0), term)
+    conv_new = conv | (term_new >= term_rounds)
+    return s_new, w_new, term_new, conv_new
+
+
+def test_round_matches_numpy_oracle():
+    rng = np.random.default_rng(0)
+    n = 33
+    s = rng.uniform(0, n, n)
+    w = rng.uniform(0.5, 2.0, n)
+    term = rng.integers(0, 3, n).astype(np.int32)
+    conv = rng.random(n) < 0.2
+    targets = rng.integers(0, n, n).astype(np.int32)
+    send_ok = rng.random(n) < 0.9
+
+    state = P.PushSumState(jnp.asarray(s), jnp.asarray(w), jnp.asarray(term), jnp.asarray(conv))
+    out = P.round_from_targets(state, jnp.asarray(targets), jnp.asarray(send_ok), n, 1e-10, 3)
+    es, ew, et, ec = np_round(s, w, term, conv, targets, send_ok, 1e-10, 3)
+    np.testing.assert_allclose(np.asarray(out.s), es, rtol=1e-12)
+    np.testing.assert_allclose(np.asarray(out.w), ew, rtol=1e-12)
+    np.testing.assert_array_equal(np.asarray(out.term), et)
+    np.testing.assert_array_equal(np.asarray(out.conv), ec)
+
+
+@pytest.mark.parametrize("kind", ["full", "grid2d", "imp3d", "line", "torus3d"])
+def test_mass_conservation(kind):
+    # Σs and Σw are invariant under every round (the reference preserves this
+    # too — converged nodes relay mass untouched, Q5/program.fs:125-127).
+    topo = build_topology(kind, 64, seed=0)
+    cfg = SimConfig(n=64, topology=kind, algorithm="push-sum", dtype="float64")
+    key = jax.random.PRNGKey(0)
+    round_fn, state, targs = make_round_fn(topo, cfg, key)
+    total_s0 = float(jnp.sum(state.s))
+    total_w0 = float(jnp.sum(state.w))
+    for rnd in range(50):
+        state = round_fn(state, jnp.int32(rnd), *targs)
+        assert float(jnp.sum(state.s)) == pytest.approx(total_s0, rel=1e-12)
+        assert float(jnp.sum(state.w)) == pytest.approx(total_w0, rel=1e-12)
+
+
+@pytest.mark.parametrize("kind", ["full", "grid2d", "imp3d", "imp2d", "torus3d"])
+def test_converges_to_true_mean(kind):
+    cfg = SimConfig(
+        n=256, topology=kind, algorithm="push-sum", dtype="float64",
+        max_rounds=100_000, chunk_rounds=2048,
+    )
+    topo = build_topology(kind, 256, seed=0)
+    r = run(topo, cfg)
+    assert r.converged, f"did not converge in {r.rounds} rounds"
+    assert r.estimate_mae < 1e-6 * topo.n
+
+
+def test_receipt_gating():
+    # A node that receives nothing must not advance its termination counter —
+    # in the reference, no message means the handler never runs (SURVEY.md
+    # §3.3). Node 2 is isolated: send_ok False and nobody targets it.
+    s = jnp.asarray([1.0, 2.0, 3.0])
+    w = jnp.ones(3)
+    term = jnp.zeros(3, jnp.int32)
+    conv = jnp.zeros(3, bool)
+    state = P.PushSumState(s, w, term, conv)
+    targets = jnp.asarray([1, 0, 0], jnp.int32)
+    send_ok = jnp.asarray([True, True, False])
+    out = P.round_from_targets(state, targets, send_ok, 3, 1e-10, 3)
+    assert int(out.term[2]) == 0
+    # its ratio is untouched, so a huge delta would otherwise mark it stable
+    out_loose = P.round_from_targets(state, targets, send_ok, 3, 1e6, 3)
+    assert int(out_loose.term[2]) == 0  # still gated
+    assert int(out_loose.term[0]) == 1  # receivers do advance under loose delta
+
+
+def test_term_resets_on_ratio_jump():
+    # Ratio-changing receipt resets the streak (program.fs:130-131).
+    state = P.PushSumState(
+        jnp.asarray([0.0, 100.0]), jnp.ones(2), jnp.asarray([2, 2], jnp.int32),
+        jnp.zeros(2, bool),
+    )
+    targets = jnp.asarray([1, 0], jnp.int32)
+    out = P.round_from_targets(state, targets, jnp.asarray([True, True]), 2, 1e-10, 3)
+    assert int(out.term[0]) == 0 and int(out.term[1]) == 0
+
+
+def test_initial_term_round_quirk_q4():
+    cfg_ref = SimConfig(n=8, semantics="reference", algorithm="push-sum")
+    cfg_hon = SimConfig(n=8, algorithm="push-sum")
+    assert cfg_ref.initial_term_round == 1  # program.fs:79
+    assert cfg_hon.initial_term_round == 0
+
+
+def test_determinism():
+    cfg = SimConfig(n=128, topology="full", algorithm="push-sum", dtype="float64")
+    topo = build_topology("full", 128)
+    r1 = run(topo, cfg)
+    r2 = run(topo, cfg)
+    assert r1.rounds == r2.rounds
+    assert r1.estimate_mae == r2.estimate_mae
+
+
+def test_float32_policy():
+    # delta=1e-10 is unreachable in f32; the resolved default must rescale.
+    cfg = SimConfig(n=64, topology="full", algorithm="push-sum", dtype="float32")
+    assert cfg.resolved_delta == 1e-6
+    topo = build_topology("full", 64)
+    r = run(topo, cfg)
+    assert r.converged
+    assert r.estimate_mae < 1.0
+
+
+def test_fault_injection_still_converges():
+    cfg = SimConfig(
+        n=64, topology="full", algorithm="push-sum", dtype="float64",
+        fault_rate=0.3, max_rounds=50_000,
+    )
+    topo = build_topology("full", 64)
+    r = run(topo, cfg)
+    assert r.converged

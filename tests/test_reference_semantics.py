@@ -1,0 +1,116 @@
+"""Reference-fidelity mode: single-walk push-sum (SURVEY.md §3.3) and the
+observable quirks Q1-Q9 at the run() level."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from cop5615_gossip_protocol_tpu import SimConfig, build_topology, run
+from cop5615_gossip_protocol_tpu.models import reference as R
+from cop5615_gossip_protocol_tpu.models.runner import draw_leader
+
+
+def _cfg(n, kind, **kw):
+    return SimConfig(
+        n=n, topology=kind, algorithm="push-sum", semantics="reference",
+        dtype="float64", **kw,
+    )
+
+
+def test_walk_mass_conservation():
+    # Total mass (arrays + in-flight message) is invariant hop by hop.
+    cfg = _cfg(20, "full")
+    topo = build_topology("full", 20, semantics="reference")
+    key = jax.random.PRNGKey(0)
+    leader = draw_leader(key, topo, cfg)
+    step_fn, carry, targs = R.make_walk(topo, cfg, key, leader)
+    total0 = float(jnp.sum(carry.s) + carry.msg_s)
+    w_total0 = float(jnp.sum(carry.w) + carry.msg_w)
+    assert total0 == pytest.approx(topo.n * (topo.n - 1) / 2)
+    assert w_total0 == pytest.approx(topo.n)
+    for _ in range(200):
+        carry = step_fn(carry, *targs)
+        assert float(jnp.sum(carry.s) + carry.msg_s) == pytest.approx(total0, rel=1e-12)
+        assert float(jnp.sum(carry.w) + carry.msg_w) == pytest.approx(w_total0, rel=1e-12)
+
+
+def test_walk_one_message_in_flight():
+    # Each hop touches exactly one node's state (or none, for a converged
+    # relay) — the defining property of the reference's push-sum.
+    cfg = _cfg(20, "full")
+    topo = build_topology("full", 20, semantics="reference")
+    key = jax.random.PRNGKey(1)
+    leader = draw_leader(key, topo, cfg)
+    step_fn, carry, targs = R.make_walk(topo, cfg, key, leader)
+    for _ in range(100):
+        nxt = step_fn(carry, *targs)
+        changed = int(jnp.sum((nxt.s != carry.s) | (nxt.w != carry.w)))
+        assert changed <= 1
+        assert int(nxt.steps) == int(carry.steps) + 1
+        carry = nxt
+
+
+def test_walk_converges_full_small():
+    # `dotnet run 20 full push-sum` converges in the reference (28.9 ms,
+    # report.pdf p.3); the walk must converge here too.
+    cfg = _cfg(20, "full", max_rounds=500_000)
+    topo = build_topology("full", 20, semantics="reference")
+    r = run(topo, cfg)
+    assert r.converged
+    assert r.target_count == 20 and r.population == 21  # Q1
+    # Walk-mode estimates are stale (Q5 pre-absorb reporting) but bounded.
+    assert r.estimate_mae < topo.n
+
+
+def test_walk_converged_relay_freezes_state():
+    # Q5: a converged node's receipt relays the message untouched.
+    cfg = _cfg(10, "full")
+    topo = build_topology("full", 10, semantics="reference")
+    key = jax.random.PRNGKey(2)
+    leader = draw_leader(key, topo, cfg)
+    step_fn, carry, targs = R.make_walk(topo, cfg, key, leader)
+    carry = carry._replace(conv=carry.conv.at[int(carry.cur)].set(True))
+    nxt = step_fn(carry, *targs)
+    cur = int(carry.cur)
+    assert float(nxt.s[cur]) == float(carry.s[cur])
+    assert float(nxt.msg_s) == float(carry.msg_s)  # relayed unchanged
+    assert float(nxt.msg_w) == float(carry.msg_w)
+
+
+def test_walk_dies_on_orphan_q8():
+    # An orphan (degree 0) kills the walk — the reference actor crashes on
+    # the empty neighbor array and the message is lost in the restart.
+    import numpy as np
+
+    from cop5615_gossip_protocol_tpu.ops.topology import Topology
+
+    neighbors = np.array([[1], [0], [0]], dtype=np.int32)
+    degree = np.array([1, 1, 0], dtype=np.int32)  # node 2 is an orphan
+    topo = Topology("line", 3, 3, 3, 1, neighbors, degree)
+    cfg = _cfg(3, "line")
+    key = jax.random.PRNGKey(0)
+    step_fn, carry, targs = R.make_walk(topo, cfg, key, jnp.int32(0))
+    carry = carry._replace(cur=jnp.int32(2))  # force the walk onto the orphan
+    nxt = step_fn(carry, *targs)
+    assert bool(nxt.dead)
+
+
+def test_reference_run_dispatches_to_walk():
+    # rounds == message hops in walk mode: far more hops than the ~dozens of
+    # synchronous rounds batched mode needs at this size.
+    topo = build_topology("full", 32, semantics="reference")
+    r = run(topo, _cfg(32, "full", max_rounds=500_000))
+    assert r.semantics == "reference"
+    assert r.rounds > 100
+
+
+def test_batched_vs_reference_agree_on_the_answer():
+    # Same protocol, two execution models — both must estimate the mean.
+    kind = "full"
+    t_ref = build_topology(kind, 64, semantics="reference")
+    r_ref = run(t_ref, _cfg(64, kind, max_rounds=1_000_000))
+    t_hon = build_topology(kind, 64)
+    r_hon = run(t_hon, SimConfig(n=64, topology=kind, algorithm="push-sum", dtype="float64"))
+    assert r_ref.converged and r_hon.converged
+    assert r_hon.estimate_mae < 1e-6
+    assert r_ref.estimate_mae < 5.0  # walk-mode staleness (Q5), bounded
