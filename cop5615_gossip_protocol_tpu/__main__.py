@@ -1,0 +1,6 @@
+"""`python -m cop5615_gossip_protocol_tpu N TOPOLOGY ALGORITHM` — the
+reference's `dotnet run N topology algorithm` entry (program.fs:19-21)."""
+
+from .cli import main
+
+raise SystemExit(main())

@@ -1,0 +1,179 @@
+"""CLI — reference-parity positional triple plus real flags.
+
+The reference reads three raw positional args with no validation and no
+flags (`dotnet run <numNodes> <topology> <algorithm>`, program.fs:19-21;
+arg order per report.pdf p.2 §2 — note the reference's own source comments
+at program.fs:20-21 label the two strings backwards). This CLI keeps that
+triple — `python -m cop5615_gossip_protocol_tpu 1000 full gossip` — and
+fails loudly on invalid input instead of the reference's silent
+fall-through-to-ReadLine (program.fs:331-334).
+
+Everything the reference hard-codes is a flag here: rumor threshold
+(program.fs:102), delta (program.fs:187), termination rounds
+(program.fs:135), plus seed/dtype/semantics/devices/fault-rate/
+checkpointing (SURVEY.md §5 "Config / flag system").
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Optional
+
+from .config import SimConfig, normalize_algorithm, normalize_topology
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="gossip-tpu",
+        description=(
+            "TPU-native gossip / push-sum simulator "
+            "(usage parity: numNodes topology algorithm)"
+        ),
+    )
+    p.add_argument("numNodes", type=int, help="requested node count")
+    p.add_argument(
+        "topology",
+        help="line | full | 2D | Imp3D (reference spellings) or "
+        "ring | grid2d | ref2d | imp2d | grid3d | torus3d",
+    )
+    p.add_argument("algorithm", help="gossip | push-sum")
+    p.add_argument(
+        "--semantics",
+        choices=["batched", "reference"],
+        default="batched",
+        help="batched: honest synchronous rounds (benchmark mode); "
+        "reference: replicate the reference's quirks Q1-Q9 incl. "
+        "single-walk push-sum",
+    )
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--dtype", choices=["float32", "float64", "bfloat16"], default=None,
+                   help="default: float32 (float64 on CPU with --x64)")
+    p.add_argument("--delta", type=float, default=None,
+                   help="push-sum stability threshold (default per dtype; reference: 1e-10)")
+    p.add_argument("--rumor-threshold", type=int, default=10)
+    p.add_argument("--term-rounds", type=int, default=3)
+    p.add_argument("--max-rounds", type=int, default=1_000_000)
+    p.add_argument("--chunk-rounds", type=int, default=4096)
+    p.add_argument("--target-frac", type=float, default=None)
+    p.add_argument("--suppress", choices=["auto", "on", "off"], default="auto",
+                   help="suppress gossip sends to converged targets (auto: on in reference semantics)")
+    p.add_argument("--fault-rate", type=float, default=0.0,
+                   help="per-round probability a node fails to send (fault injection)")
+    p.add_argument("--devices", type=int, default=None,
+                   help="shard the node dimension over this many devices")
+    p.add_argument("--platform", choices=["auto", "cpu", "tpu"], default="auto",
+                   help="force a JAX platform (cpu useful for dev boxes)")
+    p.add_argument("--x64", action="store_true", help="enable float64 support")
+    p.add_argument("--distributed", action="store_true",
+                   help="call jax.distributed.initialize for multi-host meshes")
+    p.add_argument("--jsonl", type=str, default=None,
+                   help="append the structured run record to this JSONL file")
+    p.add_argument("--checkpoint", type=str, default=None,
+                   help="write round-state checkpoints to this .npz path")
+    p.add_argument("--checkpoint-every", type=int, default=1,
+                   help="checkpoint every K chunks (with --checkpoint)")
+    p.add_argument("--resume", type=str, default=None,
+                   help="resume from a checkpoint .npz (single-device batched runs)")
+    p.add_argument("--quiet", action="store_true", help="suppress the JSON record on stdout")
+    return p
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    import jax  # deferred so --platform can take effect before backend init
+
+    if args.platform != "auto":
+        jax.config.update("jax_platforms", args.platform)
+    if args.x64:
+        jax.config.update("jax_enable_x64", True)
+    if args.distributed:
+        from .parallel.mesh import initialize_distributed
+
+        initialize_distributed()
+
+    try:
+        algorithm = normalize_algorithm(args.algorithm)
+        kind = normalize_topology(args.topology, args.semantics)
+        dtype = args.dtype or ("float64" if args.x64 else "float32")
+        cfg = SimConfig(
+            n=args.numNodes,
+            topology=kind,
+            algorithm=algorithm,
+            semantics=args.semantics,
+            seed=args.seed,
+            dtype=dtype,
+            delta=args.delta,
+            rumor_threshold=args.rumor_threshold,
+            term_rounds=args.term_rounds,
+            max_rounds=args.max_rounds,
+            chunk_rounds=args.chunk_rounds,
+            target_frac=args.target_frac,
+            suppress_converged=None if args.suppress == "auto" else args.suppress == "on",
+            fault_rate=args.fault_rate,
+            n_devices=args.devices,
+        )
+    except ValueError as e:
+        print(f"Invalid: {e}", file=sys.stderr)
+        return 2
+
+    from .models.runner import run
+    from .ops.topology import build_topology
+    from .utils import checkpoint as ckpt
+    from .utils import metrics
+
+    print(metrics.banner(cfg))
+
+    t0 = time.perf_counter()
+    topo = build_topology(kind, args.numNodes, seed=args.seed, semantics=args.semantics)
+    build_s = time.perf_counter() - t0
+
+    on_chunk = None
+    if args.checkpoint:
+        counter = {"chunks": 0}
+
+        def on_chunk(rounds, state):  # noqa: F811
+            counter["chunks"] += 1
+            if counter["chunks"] % args.checkpoint_every == 0:
+                ckpt.save(args.checkpoint, state, rounds, cfg)
+
+    start_state, start_round = None, 0
+    if args.resume:
+        import dataclasses
+
+        start_state, start_round, saved_cfg = ckpt.load(args.resume)
+        # Resume is only bitwise-faithful if every stream-relevant knob
+        # matches the original run; loop-control knobs may differ.
+        loop_knobs = {"max_rounds": cfg.max_rounds, "chunk_rounds": cfg.chunk_rounds,
+                      "n_devices": cfg.n_devices}
+        if dataclasses.replace(saved_cfg, **loop_knobs) != cfg:
+            print(
+                "Invalid: checkpoint config mismatch — resume requires the "
+                f"original flags (saved: {dataclasses.asdict(saved_cfg)})",
+                file=sys.stderr,
+            )
+            return 2
+
+    try:
+        result = run(
+            topo, cfg, on_chunk=on_chunk, start_state=start_state, start_round=start_round
+        )
+    except (ValueError, NotImplementedError) as e:
+        print(f"Invalid: {e}", file=sys.stderr)
+        return 2
+    result.build_s = build_s
+
+    print(metrics.reference_format(result))
+    record = metrics.run_record(cfg, topo, result)
+    if not args.quiet:
+        print(json.dumps(record))
+    if args.jsonl:
+        metrics.append_jsonl(args.jsonl, record)
+    return 0 if result.converged else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -188,19 +188,31 @@ def run(
     cfg: SimConfig,
     key: Optional[jax.Array] = None,
     on_chunk: Optional[Callable[[int, object], None]] = None,
+    start_state=None,
+    start_round: int = 0,
 ) -> RunResult:
     """Run one simulation to convergence (or cfg.max_rounds) on one device.
 
     ``on_chunk(rounds_done, state)`` fires at every chunk boundary — the
-    checkpoint/metrics hook point.
+    checkpoint/metrics hook point. ``start_state``/``start_round`` resume a
+    checkpointed run: round keys are derived from the absolute round index,
+    so the resumed trajectory is bitwise the one the original run would have
+    taken (utils/checkpoint.py).
     """
     if key is None:
         key = jax.random.PRNGKey(cfg.seed)
     if cfg.n_devices is not None and cfg.n_devices > 1:
-        raise NotImplementedError(
-            "n_devices > 1 is served by the sharded runner "
-            "(cop5615_gossip_protocol_tpu.parallel); this entry point is "
-            "single-device"
+        if cfg.reference and cfg.algorithm == "push-sum":
+            raise ValueError(
+                "reference-semantics push-sum is a single random walk "
+                "(one message in flight) and cannot be sharded; drop "
+                "n_devices or use batched semantics"
+            )
+        from ..parallel.sharded import run_sharded  # circular-import guard
+
+        return run_sharded(
+            topo, cfg, key=key, on_chunk=on_chunk,
+            start_state=start_state, start_round=start_round,
         )
     target = cfg.resolved_target_count(topo.n, topo.target_count)
     if cfg.reference and cfg.algorithm == "push-sum":
@@ -210,6 +222,8 @@ def run(
         # round (one send per informed node per round) already models.
         return _run_reference_walk(topo, cfg, key, target)
     round_fn, state0, topo_args = make_round_fn(topo, cfg, key)
+    if start_state is not None:
+        state0 = jax.tree.map(jnp.asarray, start_state)
 
     def chunk(carry, round_end, *targs):
         def cond(c):
@@ -225,13 +239,13 @@ def run(
         return lax.while_loop(cond, body, carry)
 
     chunk_j = jax.jit(chunk)
-    carry = (state0, jnp.int32(0), jnp.bool_(False))
+    carry = (state0, jnp.int32(start_round), jnp.bool_(False))
 
     t0 = time.perf_counter()
-    carry = jax.block_until_ready(chunk_j(carry, jnp.int32(0), *topo_args))
+    carry = jax.block_until_ready(chunk_j(carry, jnp.int32(start_round), *topo_args))
     compile_s = time.perf_counter() - t0
 
-    rounds = 0
+    rounds = start_round
     t1 = time.perf_counter()
     while True:
         round_end = min(rounds + cfg.chunk_rounds, cfg.max_rounds)

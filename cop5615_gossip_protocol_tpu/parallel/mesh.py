@@ -1,0 +1,58 @@
+"""Device mesh construction — the communication backend's topology.
+
+Replaces the reference's L1 "communication backend" (one in-process Akka
+ActorSystem with a thread-pool dispatcher, program.fs:23; Akka.Cluster is
+referenced in project3.fsproj:13-15 but never configured — SURVEY.md C14).
+Here the backend is a `jax.sharding.Mesh` with a single ``"nodes"`` axis:
+each device owns a contiguous shard of the node dimension, cross-shard
+message traffic is XLA collectives (`psum_scatter`, `all_gather`, `psum`)
+riding ICI within a slice and DCN across slices — no hand-written transport.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+NODE_AXIS = "nodes"
+
+
+def make_mesh(n_devices: int | None = None) -> Mesh:
+    """1-D mesh over the node dimension.
+
+    On a TPU slice the default device order already follows the physical
+    torus, so contiguous node shards map to ICI-adjacent chips — grid
+    topologies' halo traffic stays on-torus.
+    """
+    devices = jax.devices()
+    if n_devices is None:
+        n_devices = len(devices)
+    if n_devices < 1 or n_devices > len(devices):
+        raise ValueError(
+            f"n_devices={n_devices} out of range; {len(devices)} device(s) visible"
+        )
+    return Mesh(np.asarray(devices[:n_devices]), (NODE_AXIS,))
+
+
+def initialize_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Multi-host bring-up: `jax.distributed.initialize` then build the mesh
+    over `jax.devices()` (global). The same `shard_map` program then spans
+    hosts — XLA routes inter-host collective legs over DCN. The reference has
+    no counterpart (its Akka.Cluster dependency is never exercised, C14);
+    this is the capability it only gestured at. No-op if already initialized.
+    """
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError:
+        # Already initialized — idempotent bring-up for notebook/CLI reuse.
+        pass

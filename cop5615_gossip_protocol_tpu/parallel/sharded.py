@@ -1,0 +1,274 @@
+"""Node-dimension-sharded round runner (shard_map over a 1-D TPU mesh).
+
+The scaling recast of the reference's only parallelism — actor-per-node
+concurrency on one machine's thread pool (SURVEY.md C15), capped at ~2000
+nodes (report.pdf p.3 §4). Here each device owns a contiguous shard of the
+per-node state vectors; one synchronous round is:
+
+1. every device draws the round's full-length random words (bit-identical
+   with the single-device runner — see ops/sampling.py) and slices its shard;
+2. local nodes pick global partner indices and scatter their message values
+   into a full-length contribution vector;
+3. one `psum_scatter` (reduce-scatter over the "nodes" axis) simultaneously
+   sums all devices' contributions and hands each device exactly its own
+   shard of the inbox — the entire cross-device "mailbox delivery" is a
+   single XLA collective on ICI;
+4. local absorb/update, then a scalar `psum` of converged counts serves as
+   the global termination predicate (the ParentActor's count-and-exit,
+   program.fs:47-60, as a reduction).
+
+The whole round loop — collectives included — lives inside one jit'd
+`lax.while_loop`, so a chunk of thousands of rounds runs with zero host
+round-trips. Gossip's converged-target suppression (the shared dictionary
+probe, program.fs:92) needs remote reads and becomes an `all_gather` of the
+one-bool-per-node converged vector, only when suppression is enabled.
+
+Population is padded to a device multiple; padded slots are invalid (never
+send, never targeted, never counted). When n_devices divides n, trajectories
+are bit-identical to the single-device runner (exact for gossip's integer
+counts; push-sum reductions differ only in float summation order).
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import SimConfig
+from ..models import gossip as gossip_mod
+from ..models import pushsum as pushsum_mod
+from ..models.runner import RunResult, _check_dtype, draw_leader
+from ..ops import sampling
+from ..ops.topology import Topology
+from .mesh import NODE_AXIS, make_mesh
+
+
+def _pad_to(x: np.ndarray, rows: int, fill=0) -> np.ndarray:
+    if x.shape[0] == rows:
+        return x
+    pad = np.full((rows - x.shape[0],) + x.shape[1:], fill, dtype=x.dtype)
+    return np.concatenate([x, pad], axis=0)
+
+
+def run_sharded(
+    topo: Topology,
+    cfg: SimConfig,
+    mesh: Optional[Mesh] = None,
+    key: Optional[jax.Array] = None,
+    on_chunk: Optional[Callable[[int, object], None]] = None,
+    start_state=None,
+    start_round: int = 0,
+) -> RunResult:
+    """Sharded analog of models.runner.run — same config, same result.
+    ``start_state`` (unpadded, from utils/checkpoint.py) resumes a run;
+    round keys use absolute round indices, so a resumed sharded run follows
+    the same stream as the uninterrupted one."""
+    if mesh is None:
+        mesh = make_mesh(cfg.n_devices)
+    n_dev = mesh.devices.size
+    dtype = _check_dtype(cfg)
+    if key is None:
+        key = jax.random.PRNGKey(cfg.seed)
+
+    n = topo.n
+    n_pad = ((n + n_dev - 1) // n_dev) * n_dev
+    n_loc = n_pad // n_dev
+    target = cfg.resolved_target_count(n, topo.target_count)
+
+    shard = NamedSharding(mesh, P(NODE_AXIS))
+    repl = NamedSharding(mesh, P())
+
+    def dev_put(host_array, sharding=shard):
+        return jax.device_put(jnp.asarray(host_array), sharding)
+
+    valid = dev_put(np.arange(n_pad) < n)
+    if topo.implicit:
+        topo_args = (valid,)
+        topo_specs = (P(NODE_AXIS),)
+    else:
+        neighbors = _pad_to(topo.neighbors, n_pad)
+        degree = _pad_to(topo.degree, n_pad)
+        topo_args = (dev_put(neighbors), dev_put(degree), valid)
+        topo_specs = (P(NODE_AXIS), P(NODE_AXIS), P(NODE_AXIS))
+
+    # --- local round bodies (operate on [n_loc] shards) -------------------
+
+    def targets_and_gate(round_idx, *targs):
+        kr = sampling.round_key(key, round_idx)
+        # Full-length draw on every device, then slice: keeps the stream
+        # identical to the single-device runner and independent of n_dev.
+        bits_full = sampling.uniform_bits(kr, n_pad)
+        dev = lax.axis_index(NODE_AXIS)
+        start = dev * n_loc
+        bits = lax.dynamic_slice(bits_full, (start,), (n_loc,))
+        gids = start + jnp.arange(n_loc, dtype=jnp.int32)
+        if topo.implicit:
+            (valid_loc,) = targs
+            targets = sampling.targets_full(bits, gids, n)
+            send_ok = valid_loc
+        else:
+            neighbors_loc, degree_loc, valid_loc = targs
+            targets = sampling.targets_explicit(bits, neighbors_loc, degree_loc)
+            send_ok = (degree_loc > 0) & valid_loc
+        gate_full = sampling.send_gate(kr, n_pad, cfg.fault_rate)
+        if gate_full is not True:
+            send_ok = send_ok & lax.dynamic_slice(gate_full, (start,), (n_loc,))
+        return targets, send_ok, valid_loc
+
+    def deliver_sharded(values, targets):
+        """Scatter into a full-length contribution vector, then reduce-scatter
+        so each device receives its own summed inbox shard."""
+        contrib = jnp.zeros((n_pad,), values.dtype).at[targets].add(values)
+        return lax.psum_scatter(
+            contrib, NODE_AXIS, scatter_dimension=0, tiled=True
+        )
+
+    if cfg.algorithm == "push-sum":
+        delta = cfg.resolved_delta
+        term_rounds = cfg.term_rounds
+
+        def round_fn(state, round_idx, *targs):
+            targets, send_ok, _ = targets_and_gate(round_idx, *targs)
+            s_send, w_send, s_keep, w_keep = pushsum_mod.halve_and_send(
+                state.s, state.w, send_ok
+            )
+            inbox_s = deliver_sharded(s_send, targets)
+            inbox_w = deliver_sharded(w_send, targets)
+            return pushsum_mod.absorb(
+                state, s_keep, w_keep, inbox_s, inbox_w, delta, term_rounds
+            )
+
+        s0 = np.arange(n_pad, dtype=dtype)
+        s0[n:] = 0.0  # padded slots carry no sum mass...
+        # ...but weight 1 (not 0) so their never-updated ratio is 0/1, not a
+        # NaN that would trip jax_debug_nans; they never send, so the extra
+        # weight is inert and excluded from all real-node accounting.
+        state0 = pushsum_mod.PushSumState(
+            s=dev_put(s0),
+            w=dev_put(np.ones(n_pad, dtype=dtype)),
+            term=dev_put(np.full(n_pad, cfg.initial_term_round, np.int32)),
+            conv=dev_put(np.zeros(n_pad, bool)),
+        )
+    else:
+        rumor_target = cfg.resolved_rumor_target
+        suppress = cfg.resolved_suppress
+        leader = int(draw_leader(key, topo, cfg))
+        count0 = np.zeros(n_pad, np.int32)
+        active0 = np.zeros(n_pad, bool)
+        active0[leader] = True
+        if cfg.reference and topo.kind == "full":
+            count0[leader] = 1  # C13: full kicks off with CallChildActor
+        state0 = gossip_mod.GossipState(
+            count=dev_put(count0), active=dev_put(active0), conv=dev_put(np.zeros(n_pad, bool))
+        )
+
+        def round_fn(state, round_idx, *targs):
+            targets, send_ok, _ = targets_and_gate(round_idx, *targs)
+            if suppress:
+                conv_full = lax.all_gather(state.conv, NODE_AXIS, tiled=True)
+                conv_of_target = conv_full[targets]
+            else:
+                conv_of_target = False
+            vals = gossip_mod.send_values(
+                state, targets, send_ok, suppress, conv_of_target
+            )
+            inbox = deliver_sharded(vals, targets)
+            return gossip_mod.absorb(state, inbox, rumor_target)
+
+    if start_state is not None:
+        fills = {"s": 0.0, "w": 1.0, "term": cfg.initial_term_round,
+                 "conv": False, "count": 0, "active": False}
+        state0 = type(state0)(**{
+            f: dev_put(_pad_to(np.asarray(getattr(start_state, f)), n_pad, fills[f]))
+            for f in state0._fields
+        })
+
+    # --- chunked while_loop under shard_map -------------------------------
+
+    def chunk_local(carry, round_end, *targs):
+        def cond(c):
+            _, rnd, done = c
+            return jnp.logical_and(~done, rnd < round_end)
+
+        def body(c):
+            state, rnd, _ = c
+            state = round_fn(state, rnd, *targs)
+            conv_count = lax.psum(jnp.sum(state.conv), NODE_AXIS)
+            return (state, rnd + 1, conv_count >= target)
+
+        return lax.while_loop(cond, body, carry)
+
+    carry_specs = (
+        jax.tree.map(lambda _: P(NODE_AXIS), state0),
+        P(),
+        P(),
+    )
+    chunk_sharded = jax.jit(
+        jax.shard_map(
+            chunk_local,
+            mesh=mesh,
+            in_specs=(carry_specs, P()) + topo_specs,
+            out_specs=carry_specs,
+            check_vma=False,
+        )
+    )
+
+    carry = (
+        state0,
+        jax.device_put(jnp.int32(start_round), repl),
+        jax.device_put(jnp.bool_(False), repl),
+    )
+
+    t0 = time.perf_counter()
+    carry = jax.block_until_ready(
+        chunk_sharded(carry, jax.device_put(jnp.int32(start_round), repl), *topo_args)
+    )
+    compile_s = time.perf_counter() - t0
+
+    rounds = start_round
+    t1 = time.perf_counter()
+    while True:
+        round_end = min(rounds + cfg.chunk_rounds, cfg.max_rounds)
+        carry = chunk_sharded(
+            carry, jax.device_put(jnp.int32(round_end), repl), *topo_args
+        )
+        state, rnd, done = carry
+        rounds = int(rnd)  # host sync at the chunk boundary
+        if on_chunk is not None:
+            on_chunk(rounds, state)
+        if bool(done) or rounds >= cfg.max_rounds:
+            break
+    run_s = time.perf_counter() - t1
+
+    state, _, _ = carry
+    converged_count = int(jnp.sum(state.conv))
+    result = RunResult(
+        algorithm=cfg.algorithm,
+        topology=topo.kind,
+        semantics=cfg.semantics,
+        n_requested=topo.n_requested,
+        population=n,
+        target_count=target,
+        rounds=rounds,
+        converged_count=converged_count,
+        converged=converged_count >= target,
+        compile_s=compile_s,
+        run_s=run_s,
+    )
+    if cfg.algorithm == "push-sum":
+        s_host = np.asarray(state.s)[:n]
+        w_host = np.asarray(state.w)[:n]
+        conv_host = np.asarray(state.conv)[:n]
+        ratio = np.divide(s_host, w_host, out=np.zeros_like(s_host), where=w_host != 0)
+        true_mean = (n - 1) / 2.0
+        err = np.where(conv_host, np.abs(ratio - true_mean), 0.0)
+        result.true_mean = true_mean
+        result.estimate_mae = float(err.sum() / max(converged_count, 1))
+    return result

@@ -1,0 +1,65 @@
+"""Metrics / logging / observability.
+
+The reference's entire observability story is four printfn banners and one
+'Convergence Time: %f ms' line after a dashed rule (program.fs:50-52, 180,
+186, 217, 222 — SURVEY.md §5). This module keeps that stdout line
+byte-compatible for old-vs-new comparability, and adds what a framework
+needs: a structured JSON run record (config + population + rounds +
+compile/run split + convergence + estimate quality) streamed to stdout
+and/or appended to a JSONL file.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from pathlib import Path
+from typing import Optional
+
+from ..config import SimConfig
+from ..models.runner import RunResult
+from ..ops.topology import Topology
+
+
+def banner(cfg: SimConfig) -> str:
+    """Kickoff banner — role of the reference's 'Starting Protocol Gossip' /
+    'Push Sum Started' prints (program.fs:180, 186, 217, 222)."""
+    return (
+        f"Starting {cfg.algorithm} on {cfg.topology} "
+        f"({cfg.semantics} semantics, dtype={cfg.dtype})"
+    )
+
+
+def reference_format(result: RunResult) -> str:
+    """The reference's convergence print, byte-compatible: dashed rule then
+    'Convergence Time: %f ms' (program.fs:50-52). Timed quantity is the
+    steady-state run wall-clock — the reference's Stopwatch also excludes
+    topology build (started at program.fs:175), and we additionally exclude
+    XLA compile (reported separately in the JSON record)."""
+    return (
+        "-----------------------------------------------------------\n"
+        f"Convergence Time: {result.wall_ms:.6f} ms"
+    )
+
+
+def run_record(
+    cfg: SimConfig, topo: Topology, result: RunResult, extra: Optional[dict] = None
+) -> dict:
+    rec = {
+        "config": dataclasses.asdict(cfg),
+        "topology_kind": topo.kind,
+        "population": topo.n,
+        "max_deg": topo.max_deg,
+        **result.to_record(),
+    }
+    rec["resolved_delta"] = cfg.resolved_delta
+    if extra:
+        rec.update(extra)
+    return rec
+
+
+def append_jsonl(path: str | Path, record: dict) -> None:
+    p = Path(path)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    with p.open("a") as f:
+        f.write(json.dumps(record) + "\n")

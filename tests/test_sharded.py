@@ -1,0 +1,131 @@
+"""Distributed-without-a-cluster (SURVEY.md §4): the same shard_map collective
+program runs on 8 virtual CPU devices. Sharded trajectories must match the
+single-device runner — exactly for gossip's integer counts, up to float
+summation order for push-sum."""
+
+import jax
+import numpy as np
+import pytest
+
+from cop5615_gossip_protocol_tpu import SimConfig, build_topology, run
+from cop5615_gossip_protocol_tpu.parallel.mesh import make_mesh
+from cop5615_gossip_protocol_tpu.parallel.sharded import run_sharded
+
+
+def test_eight_virtual_devices_present():
+    assert len(jax.devices()) == 8
+
+
+@pytest.mark.parametrize("kind", ["full", "torus3d"])
+def test_gossip_sharded_matches_single_device_exactly(kind):
+    # n divisible by 8 → identical random streams → identical integer
+    # trajectories, device count notwithstanding.
+    n = 512
+    cfg = SimConfig(n=n, topology=kind, algorithm="gossip", seed=3)
+    topo = build_topology(kind, n, seed=3)
+    r1 = run(topo, cfg)
+    r8 = run_sharded(topo, cfg, mesh=make_mesh(8))
+    assert r8.rounds == r1.rounds
+    assert r8.converged_count == r1.converged_count
+    assert r8.converged and r1.converged
+
+
+@pytest.mark.parametrize("kind", ["full", "grid2d", "imp2d"])
+def test_pushsum_sharded_matches_single_device(kind):
+    n = 256
+    cfg = SimConfig(
+        n=n, topology=kind, algorithm="push-sum", dtype="float64",
+        max_rounds=100_000,
+    )
+    topo = build_topology(kind, n)
+    r1 = run(topo, cfg)
+    r8 = run_sharded(topo, cfg, mesh=make_mesh(8))
+    assert r8.converged and r1.converged
+    # Summation order may differ; at f64 the trajectories stay aligned.
+    assert abs(r8.rounds - r1.rounds) <= max(2, r1.rounds // 100)
+    assert r8.estimate_mae < 1e-6 * n
+
+
+def test_padding_population_not_divisible():
+    # 250 nodes over 8 devices → 6 padded slots: must run, converge, and
+    # count only real nodes.
+    n = 250
+    cfg = SimConfig(n=n, topology="full", algorithm="push-sum", dtype="float64")
+    topo = build_topology("full", n)
+    r = run_sharded(topo, cfg, mesh=make_mesh(8))
+    assert r.population == n
+    assert r.converged and r.converged_count == n
+    assert r.estimate_mae < 1e-6
+
+
+def test_sharded_suppression_all_gather_path():
+    # Reference-mode gossip exercises the all_gather converged-vector probe.
+    n = 255  # population 256 after the Q1 extra actor
+    cfg = SimConfig(n=n, topology="full", algorithm="gossip", semantics="reference")
+    topo = build_topology("full", n, semantics="reference")
+    r = run_sharded(topo, cfg, mesh=make_mesh(8))
+    assert r.population == 256 and r.target_count == 255
+    assert r.converged
+
+
+def test_run_dispatches_on_n_devices():
+    n = 256
+    cfg = SimConfig(n=n, topology="full", algorithm="gossip", n_devices=8)
+    topo = build_topology("full", n)
+    r = run(topo, cfg)
+    cfg1 = SimConfig(n=n, topology="full", algorithm="gossip")
+    r1 = run(topo, cfg1)
+    assert r.rounds == r1.rounds and r.converged
+
+
+def test_mesh_validation():
+    with pytest.raises(ValueError):
+        make_mesh(99)
+
+
+def test_pushsum_mass_conserved_under_sharding():
+    n = 256
+    cfg = SimConfig(
+        n=n, topology="grid2d", algorithm="push-sum", dtype="float64",
+        chunk_rounds=64, max_rounds=64,  # stop mid-flight to inspect mass
+    )
+    topo = build_topology("grid2d", n)
+    seen = {}
+
+    def on_chunk(rounds, state):
+        seen["s"] = float(np.asarray(state.s).sum())
+        seen["w"] = float(np.asarray(state.w).sum())
+
+    run_sharded(topo, cfg, mesh=make_mesh(8), on_chunk=on_chunk)
+    assert seen["s"] == pytest.approx(n * (n - 1) / 2, rel=1e-12)
+    assert seen["w"] == pytest.approx(n, rel=1e-12)  # no padding at n=256
+
+def test_sharded_resume_continues_stream(tmp_path):
+    # Interrupt a sharded run mid-flight, resume, land on the uninterrupted
+    # round count (absolute-round PRNG indexing).
+    from cop5615_gossip_protocol_tpu.utils import checkpoint as ckpt
+
+    n = 256
+    base = dict(n=n, topology="grid2d", algorithm="push-sum", dtype="float64",
+                chunk_rounds=200)
+    topo = build_topology("grid2d", n)
+    full = run_sharded(topo, SimConfig(**base), mesh=make_mesh(8))
+    assert full.converged and full.rounds > 400
+
+    half = (full.rounds // 2 // 200) * 200
+    saved = {}
+
+    def on_chunk(rounds, state):
+        saved["state"], saved["rounds"] = state, rounds
+
+    cfg_half = SimConfig(**base, max_rounds=half)
+    run_sharded(topo, cfg_half, mesh=make_mesh(8), on_chunk=on_chunk)
+    p = tmp_path / "sharded.npz"
+    # Persist through the real checkpoint layer (unpadded n==256 here).
+    ckpt.save(p, saved["state"], saved["rounds"], cfg_half)
+    state, rounds, _ = ckpt.load(p)
+
+    resumed = run_sharded(topo, SimConfig(**base), mesh=make_mesh(8),
+                          start_state=state, start_round=rounds)
+    assert resumed.converged
+    assert resumed.rounds == full.rounds
