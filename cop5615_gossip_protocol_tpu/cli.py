@@ -88,6 +88,10 @@ def main(argv: Optional[list[str]] = None) -> int:
 
     if args.platform != "auto":
         jax.config.update("jax_platforms", args.platform)
+    if args.platform == "cpu" and args.devices and args.devices > 1:
+        # Virtual CPU devices so sharded runs work on a dev box — the
+        # fake-backend story the reference lacks (SURVEY.md §4).
+        jax.config.update("jax_num_cpu_devices", args.devices)
     if args.x64:
         jax.config.update("jax_enable_x64", True)
     if args.distributed:

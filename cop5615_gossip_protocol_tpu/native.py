@@ -1,0 +1,181 @@
+"""ctypes bindings for the native reference simulator (native/refsim.cpp).
+
+The C++ engine is the runnable stand-in for the reference's
+`dotnet run N topology algorithm` (no .NET runtime in the image): a
+discrete-event model of the Akka actor semantics, bit-reproducible under a
+seed. The comparison harness (benchmarks/compare.py) joins its output against
+the TPU path, and tests use it as an oracle for the reference-semantics JAX
+modes.
+
+The shared library is built lazily with g++ the first time it is needed and
+cached next to the source; `refsim_build()` forces a rebuild.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import dataclasses
+import pathlib
+import subprocess
+import threading
+
+import numpy as np
+
+_NATIVE_DIR = pathlib.Path(__file__).resolve().parent.parent / "native"
+_SRC = _NATIVE_DIR / "refsim.cpp"
+_LIB = _NATIVE_DIR / "librefsim.so"
+
+_lock = threading.RLock()  # reentrant: _load() calls refsim_build() under it
+_lib: ctypes.CDLL | None = None
+
+
+class _CRefSimResult(ctypes.Structure):
+    _fields_ = [
+        ("events", ctypes.c_longlong),
+        ("max_queue", ctypes.c_longlong),
+        ("wall_ms", ctypes.c_double),
+        ("population", ctypes.c_int),
+        ("target", ctypes.c_int),
+        ("converged", ctypes.c_int),
+        ("leader", ctypes.c_int),
+        ("ok", ctypes.c_int),
+    ]
+
+
+@dataclasses.dataclass(frozen=True)
+class RefSimResult:
+    """One native run — the reference's single convergence-time print
+    (program.fs:51-52) plus the observability it lacked."""
+
+    events: int
+    max_queue: int  # peak mailbox depth; 1 proves push-sum is a single walk
+    wall_ms: float
+    population: int
+    target: int
+    converged: int
+    leader: int
+    ok: bool
+
+
+def refsim_build(force: bool = False) -> pathlib.Path:
+    """Build native/refsim.cpp → librefsim.so via the Makefile (single source
+    of truth for the compile recipe). A forced rebuild drops the cached ctypes
+    handle so the next call loads the fresh binary."""
+    global _lib
+    stale = force or not _LIB.exists() or _LIB.stat().st_mtime < _SRC.stat().st_mtime
+    if stale:
+        if force and _LIB.exists():
+            _LIB.unlink()  # make's mtime check would otherwise skip the build
+        subprocess.run(
+            ["make", "-C", str(_NATIVE_DIR), "librefsim.so"],
+            check=True,
+            capture_output=True,
+        )
+        with _lock:
+            _lib = None
+    return _LIB
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    with _lock:
+        if _lib is None:
+            refsim_build()
+            lib = ctypes.CDLL(str(_LIB))
+            lib.refsim_run.restype = ctypes.c_int
+            lib.refsim_run.argtypes = [
+                ctypes.c_int,
+                ctypes.c_char_p,
+                ctypes.c_char_p,
+                ctypes.c_uint64,
+                ctypes.c_longlong,
+                ctypes.POINTER(_CRefSimResult),
+            ]
+            lib.refsim_topology.restype = ctypes.c_int
+            lib.refsim_topology.argtypes = [
+                ctypes.c_int,
+                ctypes.c_char_p,
+                ctypes.c_uint64,
+                ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_int),
+            ]
+            _lib = lib
+    return _lib
+
+
+# CLI-parity names accepted by the C++ side (lowercased).
+NATIVE_TOPOLOGIES = ("line", "2d", "ref2d", "full", "imp3d")
+
+
+def refsim_run(
+    n: int,
+    topology: str,
+    algorithm: str,
+    seed: int = 0,
+    max_events: int = 0,
+) -> RefSimResult:
+    """Run the native reference-semantics simulation to convergence.
+
+    ``max_events`` bounds the mailbox drain (0 → default 5e8); a run that
+    exhausts it returns ok=False — the analog of the reference hanging (its
+    only exit is the parent's Environment.Exit, program.fs:53).
+    """
+    lib = _load()
+    out = _CRefSimResult()
+    rc = lib.refsim_run(
+        int(n),
+        topology.strip().lower().encode(),
+        algorithm.strip().lower().encode(),
+        ctypes.c_uint64(seed),
+        ctypes.c_longlong(max_events),
+        ctypes.byref(out),
+    )
+    if rc != 0:
+        raise ValueError(
+            f"refsim_run rejected (rc={rc}): n={n} topology={topology!r} "
+            f"algorithm={algorithm!r}; native topologies are {NATIVE_TOPOLOGIES}"
+        )
+    return RefSimResult(
+        events=out.events,
+        max_queue=out.max_queue,
+        wall_ms=out.wall_ms,
+        population=out.population,
+        target=out.target,
+        converged=out.converged,
+        leader=out.leader,
+        ok=bool(out.ok),
+    )
+
+
+def refsim_topology(n: int, topology: str, seed: int = 0):
+    """Fetch the native builder's adjacency for cross-validation against
+    ops/topology.py. Returns (population, target, degrees[n], neighbors[n, max_deg]);
+    implicit `full` returns (pop, target, None, None)."""
+    lib = _load()
+    pop = ctypes.c_int()
+    target = ctypes.c_int()
+    max_deg = ctypes.c_int()
+    topo_b = topology.strip().lower().encode()
+    rc = lib.refsim_topology(
+        int(n), topo_b, ctypes.c_uint64(seed),
+        ctypes.byref(pop), ctypes.byref(target), ctypes.byref(max_deg),
+        None, None,
+    )
+    if rc != 0:
+        raise ValueError(f"refsim_topology rejected (rc={rc}): {topology!r}")
+    if max_deg.value == 0:
+        return pop.value, target.value, None, None
+    degrees = np.zeros(pop.value, dtype=np.int32)
+    neighbors = np.zeros((pop.value, max_deg.value), dtype=np.int32)
+    rc = lib.refsim_topology(
+        int(n), topo_b, ctypes.c_uint64(seed),
+        ctypes.byref(pop), ctypes.byref(target), ctypes.byref(max_deg),
+        degrees.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+        neighbors.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+    )
+    if rc != 0:
+        raise ValueError(f"refsim_topology fill failed (rc={rc})")
+    return pop.value, target.value, degrees, neighbors

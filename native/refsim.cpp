@@ -1,0 +1,369 @@
+// refsim — native discrete-event simulator of the reference Akka.NET program.
+//
+// The reference (program.fs, F#/Akka.NET) is a single-process actor system:
+// per-node ChildActors exchange mailbox messages, a ParentActor counts
+// convergence reports and kills the process. This module re-implements that
+// *semantic model* — not the code — as a C++ discrete-event engine: one global
+// FIFO event queue stands in for Akka's fair thread-pool dispatcher, each
+// event is one mailbox message, and actor state lives in flat arrays.
+//
+// Role in the framework (SURVEY.md §7 step 7): the runnable stand-in for
+// `dotnet run N topology algorithm` (no .NET in this image) — the baseline the
+// comparison harness joins against the TPU path — and a deterministic oracle
+// for the reference-semantics JAX modes at small N.
+//
+// Reference-fidelity notes (citations are program.fs:LINE):
+//   Q1  population = nodes+1, convergence target = nodes   (:152-154 vs :178)
+//   Q2  gossip converges on the 11th receipt               (:102-105)
+//   Q3  converged gossip nodes keep spreading              (:92 only gates the target)
+//   Q4  push-sum termRound starts at 1                     (:79)
+//   Q5  push-sum reports pre-absorb (sum, weight)          (:138 before :140-141)
+//   Q6  "2D" is wired as a line over ceil(sqrt N)^2 nodes  (:227-248)
+//   Q8  Imp3D spawns orphan actors the lattice never wires (:267-313)
+//   Q9  Imp3D random extra drawn from [0, nodes-1), self/dup edges kept (:308-310)
+// Deliberate divergence (Q7): the reference constructs a fresh time-seeded
+// Random() per message — irreproducible, correlated streams. Here one seeded
+// mt19937_64 drives everything, so runs are bit-reproducible; partner draws
+// reduce the raw 64-bit word modulo the span (bias <= span/2^64, negligible).
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <chrono>
+#include <deque>
+#include <random>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Topology {
+  int population = 0;   // actors spawned (includes the Q1 extra)
+  int target = 0;       // converged-node count that ends the run
+  bool implicit_full = false;
+  std::vector<std::vector<int>> rows;  // empty when implicit_full
+};
+
+void wire_line(Topology& t, int pop) {
+  t.rows.assign(pop, {});
+  for (int i = 0; i < pop; ++i) {
+    if (i > 0) t.rows[i].push_back(i - 1);
+    if (i < pop - 1) t.rows[i].push_back(i + 1);
+  }
+}
+
+// Mirrors ops/topology.py build_line/build_ref2d/build_full/build_imp3d with
+// reference=True — the same rounding rules, checked against each other in
+// tests/test_native.py.
+bool build_topology(const std::string& kind, int n, uint64_t seed, Topology& t) {
+  if (n <= 0) return false;
+  std::mt19937_64 rng(seed);
+  if (kind == "line") {
+    t.population = n + 1;
+    t.target = n;
+    wire_line(t, t.population);
+    return true;
+  }
+  if (kind == "ref2d" || kind == "2d") {  // Q6: rounded up, wired as a line
+    int side = static_cast<int>(std::ceil(std::sqrt(static_cast<double>(n))));
+    int sq = side * side;
+    t.population = sq + 1;
+    t.target = sq;
+    wire_line(t, t.population);
+    return true;
+  }
+  if (kind == "full") {
+    t.population = n + 1;
+    t.target = n;
+    t.implicit_full = true;  // partner = uniform j != i over the population
+    return true;
+  }
+  if (kind == "imp3d") {
+    // C3: N rounds down via floor(N^0.33334)^3 (:27-31); the lattice side
+    // uses the different exponent floor(N^0.34) (:268) — mismatch makes Q8
+    // orphans possible.
+    int rounded = static_cast<int>(std::floor(std::pow(n, 0.33334)));
+    rounded = rounded * rounded * rounded;
+    if (rounded < 1) rounded = 1;
+    int g = static_cast<int>(std::floor(std::pow(n, 0.34)));
+    if (g < 1) g = 1;
+    t.population = rounded + 1;
+    t.target = rounded;
+    t.rows.assign(t.population, {});
+    long long g3 = static_cast<long long>(g) * g * g;
+    int limit = static_cast<int>(std::min<long long>(g3, rounded));
+    int zmul = g * g;
+    for (int z = 0; z < g; ++z)
+      for (int y = 0; y < g; ++y)
+        for (int x = 0; x < g; ++x) {
+          int i = z * zmul + y * g + x;
+          if (i >= limit) continue;
+          auto& r = t.rows[i];
+          if (x > 0) r.push_back(i - 1);
+          if (x < g - 1 && i + 1 < limit) r.push_back(i + 1);
+          if (y > 0) r.push_back(i - g);
+          if (y < g - 1 && i + g < limit) r.push_back(i + g);
+          if (z > 0) r.push_back(i - zmul);
+          if (z < g - 1 && i + zmul < limit) r.push_back(i + zmul);
+          // Q9: Random().Next(0, nodes-1) — exclusive upper bound, never the
+          // last node; self-edges and duplicates are kept as drawn.
+          int span = rounded - 1 > 0 ? rounded - 1 : 1;
+          r.push_back(static_cast<int>(rng() % static_cast<uint64_t>(span)));
+        }
+    return true;
+  }
+  return false;
+}
+
+enum MsgType : int {
+  kActivate = 0,      // ActivateChildActor — gossip spreader self-loop (:89-95)
+  kCall = 1,          // CallChildActor — rumor receipt (:97-105)
+  kComputePushSum = 2 // ComputePushSum(s, w, delta) (:119-143)
+};
+
+struct Event {
+  int type;
+  int target;
+  double s, w;
+};
+
+struct Engine {
+  const Topology& topo;
+  std::mt19937_64 rng;
+  std::deque<Event> queue;
+  long long events_processed = 0;
+  long long max_queue_depth = 0;  // 1 for push-sum: single walk (SURVEY.md §3.3)
+  int converged_count = 0;
+
+  // ChildActor state (:74-88)
+  std::vector<int> msg_count;       // gossip receipts
+  std::vector<double> sum, weight;  // push-sum mass
+  std::vector<int> term_round;      // consecutive sub-delta receipts
+  std::vector<uint8_t> converged;   // doubles as the shared registry (C6, :71)
+
+  Engine(const Topology& t, uint64_t seed)
+      : topo(t),
+        rng(seed ^ 0x9E3779B97F4A7C15ull),  // decorrelate from topology draws
+        msg_count(t.population, 0),
+        sum(t.population),
+        weight(t.population, 1.0),
+        term_round(t.population, 1),  // Q4
+        converged(t.population, 0) {
+    // InitializeVariables i → sum <- i (:107-108, :159)
+    for (int i = 0; i < t.population; ++i) sum[i] = static_cast<double>(i);
+  }
+
+  int degree(int i) const {
+    if (topo.implicit_full) return topo.population - 1;
+    return static_cast<int>(topo.rows[i].size());
+  }
+
+  // Uniform random neighbor — the reference's neighbours.[Random().Next(0, deg)]
+  // (:91, :112, :126, :142). Returns -1 for a degree-0 orphan: the reference
+  // actor throws IndexOutOfRange there and Akka's supervision restarts it,
+  // silently losing the message (Q8) — callers drop the event to match.
+  int random_neighbor(int i) {
+    int deg = degree(i);
+    if (deg <= 0) return -1;
+    uint64_t r = rng() % static_cast<uint64_t>(deg);
+    if (topo.implicit_full) {
+      // shift-sampling j != i over the population
+      int j = static_cast<int>((i + 1 + r) % topo.population);
+      return j;
+    }
+    return topo.rows[i][static_cast<size_t>(r)];
+  }
+
+  void gossip_activate(int i) {
+    int nbr = random_neighbor(i);
+    if (nbr < 0) return;  // orphan leader: protocol never starts (Q8)
+    if (!converged[nbr]) queue.push_back({kCall, nbr, 0, 0});  // registry probe (:92)
+    queue.push_back({kActivate, i, 0, 0});  // perpetual self-loop (Q3, :95)
+  }
+
+  void gossip_call(int i) {
+    if (msg_count[i] == 0) queue.push_back({kActivate, i, 0, 0});  // join spreaders (:99-100)
+    if (msg_count[i] == 10) {  // Q2: check precedes increment → 11th receipt (:102-105)
+      ++converged_count;
+      converged[i] = 1;
+    }
+    ++msg_count[i];
+  }
+
+  void push_sum_compute(int i, double s_in, double w_in, double delta) {
+    if (converged[i]) {  // relay untouched (:125-127)
+      int nbr = random_neighbor(i);
+      if (nbr >= 0) queue.push_back({kComputePushSum, nbr, s_in, w_in});
+      return;
+    }
+    double new_sum = sum[i] + s_in;
+    double new_weight = weight[i] + w_in;
+    double cal = std::fabs(sum[i] / weight[i] - new_sum / new_weight);
+    if (cal > delta) {
+      term_round[i] = 0;  // reset (:130-131)
+    } else {
+      ++term_round[i];  // (:132-133)
+      if (term_round[i] == 3) {  // C = 3 (:135)
+        converged[i] = 1;
+        ++converged_count;  // Q5: parent sees pre-absorb (sum, weight) (:138)
+      }
+    }
+    sum[i] = new_sum / 2.0;      // (:140)
+    weight[i] = new_weight / 2.0;  // (:141)
+    int nbr = random_neighbor(i);
+    if (nbr >= 0) queue.push_back({kComputePushSum, nbr, sum[i], weight[i]});
+  }
+
+  // Kickoff (C13): gossip leaders get ActivateChildActor except on full,
+  // which sends CallChildActor (:181, :218, :258, :323); push-sum leaders
+  // halve and forward — PushSum delta handler (:110-116). The delta rides
+  // along in run(), matching the reference threading it per message.
+  void kickoff(bool gossip, int leader) {
+    if (gossip) {
+      if (topo.implicit_full) {
+        queue.push_back({kCall, leader, 0, 0});
+      } else {
+        queue.push_back({kActivate, leader, 0, 0});
+      }
+      return;
+    }
+    sum[leader] /= 2.0;
+    weight[leader] /= 2.0;
+    int nbr = random_neighbor(leader);
+    if (nbr >= 0) queue.push_back({kComputePushSum, nbr, sum[leader], weight[leader]});
+  }
+
+  // Drain the mailbox until the parent's count reaches the target
+  // (:49-53, :56-60) or the event budget runs out (the reference would hang).
+  bool run(double delta, long long max_events) {
+    while (!queue.empty() && converged_count < topo.target &&
+           events_processed < max_events) {
+      max_queue_depth =
+          std::max(max_queue_depth, static_cast<long long>(queue.size()));
+      Event e = queue.front();
+      queue.pop_front();
+      ++events_processed;
+      switch (e.type) {
+        case kActivate: gossip_activate(e.target); break;
+        case kCall: gossip_call(e.target); break;
+        case kComputePushSum: push_sum_compute(e.target, e.s, e.w, delta); break;
+      }
+    }
+    return converged_count >= topo.target;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+struct RefSimResult {
+  long long events;     // mailbox messages processed
+  long long max_queue;  // peak mailbox depth (push-sum: 1 — single walk)
+  double wall_ms;       // wall-clock from kickoff to convergence (Stopwatch, :22)
+  int population;       // actors spawned (Q1 includes the extra)
+  int target;           // parent's AllNodes count
+  int converged;        // converged nodes at exit
+  int leader;           // kickoff node drawn this run
+  int ok;               // 1 iff converged
+};
+
+// Run one simulation. topology in {line, 2d/ref2d, full, imp3d} (lowercase),
+// algorithm in {gossip, push-sum}. max_events <= 0 selects a default budget.
+// Returns 0 on success, nonzero on invalid arguments.
+int refsim_run(int n, const char* topology, const char* algorithm,
+               uint64_t seed, long long max_events, RefSimResult* out) {
+  if (!topology || !algorithm || !out) return 1;
+  std::string topo_s(topology), algo_s(algorithm);
+  bool gossip;
+  if (algo_s == "gossip") gossip = true;
+  else if (algo_s == "push-sum" || algo_s == "pushsum") gossip = false;
+  else return 2;
+
+  Topology topo;
+  if (!build_topology(topo_s, n, seed, topo)) return 3;
+  if (max_events <= 0) max_events = 500'000'000LL;
+
+  Engine eng(topo, seed);
+  // leader = Random().Next(0, nodes) — over the target range, not the Q1
+  // extra actor (:173).
+  int leader = static_cast<int>(eng.rng() % static_cast<uint64_t>(topo.target));
+
+  auto t0 = std::chrono::steady_clock::now();
+  eng.kickoff(gossip, leader);  // delta fixed at every kickoff site (:187 etc.)
+  bool ok = eng.run(1e-10, max_events);
+  auto t1 = std::chrono::steady_clock::now();
+
+  out->events = eng.events_processed;
+  out->max_queue = eng.max_queue_depth;
+  out->wall_ms = std::chrono::duration<double, std::milli>(t1 - t0).count();
+  out->population = topo.population;
+  out->target = topo.target;
+  out->converged = eng.converged_count;
+  out->leader = leader;
+  out->ok = ok ? 1 : 0;
+  return 0;
+}
+
+// Topology introspection for cross-validation against the Python builders.
+// First call with degrees == nullptr to learn population/max_deg; then call
+// with buffers of size [population] and [population * max_deg].
+// Implicit `full` reports max_deg 0. Returns 0 on success.
+int refsim_topology(int n, const char* topology, uint64_t seed,
+                    int* population, int* target, int* max_deg,
+                    int* degrees, int* neighbors) {
+  if (!topology || !population || !target || !max_deg) return 1;
+  Topology topo;
+  if (!build_topology(std::string(topology), n, seed, topo)) return 3;
+  *population = topo.population;
+  *target = topo.target;
+  int md = 0;
+  for (const auto& r : topo.rows) md = std::max(md, static_cast<int>(r.size()));
+  *max_deg = md;
+  if (!degrees || !neighbors || topo.implicit_full || md == 0) return 0;
+  for (int i = 0; i < topo.population; ++i) {
+    const auto& r = topo.rows[i];
+    degrees[i] = static_cast<int>(r.size());
+    for (int j = 0; j < static_cast<int>(r.size()); ++j)
+      neighbors[i * md + j] = r[j];
+    for (int j = static_cast<int>(r.size()); j < md; ++j)
+      neighbors[i * md + j] = 0;
+  }
+  return 0;
+}
+
+}  // extern "C"
+
+#ifdef REFSIM_MAIN
+// CLI matching the reference's `dotnet run <numNodes> <topology> <algorithm>`
+// surface, printing its exact convergence banner (:51-52).
+#include <cstdio>
+#include <cstdlib>
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    std::fprintf(stderr, "usage: %s <numNodes> <topology> <algorithm> [seed]\n", argv[0]);
+    return 2;
+  }
+  int n = std::atoi(argv[1]);
+  std::string topo(argv[2]);
+  for (auto& c : topo) c = static_cast<char>(std::tolower(c));
+  uint64_t seed = argc > 4 ? std::strtoull(argv[4], nullptr, 10) : 0;
+  RefSimResult r;
+  int rc = refsim_run(n, topo.c_str(), argv[3], seed, 0, &r);
+  if (rc != 0) {
+    std::fprintf(stderr, "refsim: invalid arguments (rc=%d)\n", rc);
+    return rc;
+  }
+  if (!r.ok) {
+    std::fprintf(stderr, "refsim: did not converge (%d/%d after %lld events)\n",
+                 r.converged, r.target, r.events);
+    return 1;
+  }
+  std::printf("------------------------------------------------\n");
+  std::printf("Convergence Time: %f ms\n", r.wall_ms);
+  std::printf("events: %lld population: %d leader: %d\n", r.events, r.population,
+              r.leader);
+  return 0;
+}
+#endif
