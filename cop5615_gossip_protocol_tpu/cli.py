@@ -62,6 +62,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="suppress gossip sends to converged targets (auto: on in reference semantics)")
     p.add_argument("--fault-rate", type=float, default=0.0,
                    help="per-round probability a node fails to send (fault injection)")
+    p.add_argument("--delivery", choices=["auto", "scatter", "stencil"], default="auto",
+                   help="message delivery: stencil (shift-based, offset-structured "
+                   "topologies) vs scatter-add; auto picks stencil where legal")
     p.add_argument("--devices", type=int, default=None,
                    help="shard the node dimension over this many devices")
     p.add_argument("--platform", choices=["auto", "cpu", "tpu"], default="auto",
@@ -118,6 +121,7 @@ def main(argv: Optional[list[str]] = None) -> int:
             target_frac=args.target_frac,
             suppress_converged=None if args.suppress == "auto" else args.suppress == "on",
             fault_rate=args.fault_rate,
+            delivery=args.delivery,
             n_devices=args.devices,
         )
     except ValueError as e:

@@ -133,6 +133,12 @@ class SimConfig:
     # probability (SURVEY.md §5 "Failure detection").
     fault_rate: float = 0.0
 
+    # Delivery strategy: "scatter" = scatter-add (any topology), "stencil" =
+    # masked circular shifts (offset-structured topologies only — line, ring,
+    # grids, tori; ops/topology.stencil_offsets), "auto" = stencil where the
+    # topology supports it, else scatter.
+    delivery: str = "auto"
+
     # Sharding: number of mesh devices for the node dimension; None/1 → single device.
     n_devices: int | None = None
 
@@ -165,6 +171,10 @@ class SimConfig:
             raise ValueError("max_rounds must be in [1, 2**30]")
         if self.chunk_rounds < 1:
             raise ValueError("chunk_rounds must be >= 1")
+        if self.delivery not in ("auto", "scatter", "stencil"):
+            raise ValueError(
+                f"unknown delivery {self.delivery!r}; expected auto|scatter|stencil"
+            )
 
     # -- resolved policy ---------------------------------------------------
 

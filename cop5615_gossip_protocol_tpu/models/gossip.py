@@ -65,9 +65,12 @@ def absorb(state: GossipState, inbox, rumor_target: int) -> GossipState:
 
 
 def round_from_targets(
-    state: GossipState, targets, send_ok, pop: int, rumor_target: int, suppress: bool
+    state: GossipState, targets, send_ok, pop: int, rumor_target: int, suppress: bool,
+    deliver_fn=None,
 ) -> GossipState:
+    if deliver_fn is None:
+        deliver_fn = lambda v, t: deliver(v, t, pop)  # noqa: E731
     conv_of_target = state.conv[targets] if suppress else False
     vals = send_values(state, targets, send_ok, suppress, conv_of_target)
-    inbox = deliver(vals, targets, pop)
+    inbox = deliver_fn(vals, targets)
     return absorb(state, inbox, rumor_target)

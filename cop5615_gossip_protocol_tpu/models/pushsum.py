@@ -86,11 +86,18 @@ def absorb(state: PushSumState, s_keep, w_keep, inbox_s, inbox_w, delta, term_ro
 
 
 def round_from_targets(
-    state: PushSumState, targets, send_ok, pop: int, delta, term_rounds
+    state: PushSumState, targets, send_ok, pop: int, delta, term_rounds,
+    deliver_fn=None,
 ) -> PushSumState:
     """One full synchronous round on a single device (sharded delivery lives
-    in parallel/sharded.py, built from the same halve_and_send/absorb)."""
+    in parallel/sharded.py, built from the same halve_and_send/absorb).
+
+    ``deliver_fn(values, targets) -> inbox`` overrides the default scatter-add
+    (the runner passes the stencil fast path for offset-structured topologies).
+    """
+    if deliver_fn is None:
+        deliver_fn = lambda v, t: deliver(v, t, pop)  # noqa: E731
     s_send, w_send, s_keep, w_keep = halve_and_send(state.s, state.w, send_ok)
-    inbox_s = deliver(s_send, targets, pop)
-    inbox_w = deliver(w_send, targets, pop)
+    inbox_s = deliver_fn(s_send, targets)
+    inbox_w = deliver_fn(w_send, targets)
     return absorb(state, s_keep, w_keep, inbox_s, inbox_w, delta, term_rounds)

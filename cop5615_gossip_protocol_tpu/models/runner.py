@@ -27,8 +27,9 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..config import SimConfig
+from ..ops import delivery as delivery_mod
 from ..ops import sampling
-from ..ops.topology import Topology
+from ..ops.topology import Topology, stencil_offsets
 from . import gossip as gossip_mod
 from . import pushsum as pushsum_mod
 
@@ -93,6 +94,25 @@ def draw_leader(base_key: jax.Array, topo: Topology, cfg: SimConfig) -> jax.Arra
     )
 
 
+def resolve_deliver_fn(topo: Topology, cfg: SimConfig):
+    """Pick the delivery implementation: stencil (masked circular shifts —
+    no scatter, no sort) where the topology's displacement set is small,
+    scatter-add otherwise. ``delivery="stencil"`` fails loudly on topologies
+    that cannot support it (full is implicit; imp2d/imp3d have random
+    long-range edges)."""
+    offsets = stencil_offsets(topo)
+    if cfg.delivery == "stencil" and offsets is None:
+        raise ValueError(
+            "delivery='stencil' requires an offset-structured topology "
+            "(line/ring/grid2d/ref2d/grid3d/torus3d); "
+            f"{topo.kind!r} has no small displacement set"
+        )
+    n = topo.n
+    if cfg.delivery != "scatter" and offsets is not None:
+        return lambda v, t: delivery_mod.deliver_stencil(v, t, offsets, n)
+    return lambda v, t: delivery_mod.deliver(v, t, n)
+
+
 def make_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array):
     """Build (round_fn, state0, topo_args).
 
@@ -109,6 +129,8 @@ def make_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array):
         topo_args = ()
     else:
         topo_args = (jnp.asarray(topo.neighbors), jnp.asarray(topo.degree))
+
+    deliver_fn = resolve_deliver_fn(topo, cfg)
 
     def targets_and_gate(round_idx, *targs):
         # ids generated inside the trace (lax.iota) — never a baked constant.
@@ -135,7 +157,7 @@ def make_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array):
         def round_fn(state, round_idx, *targs):
             targets, send_ok = targets_and_gate(round_idx, *targs)
             return pushsum_mod.round_from_targets(
-                state, targets, send_ok, n, delta, term_rounds
+                state, targets, send_ok, n, delta, term_rounds, deliver_fn
             )
 
     else:
@@ -149,7 +171,7 @@ def make_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array):
         def round_fn(state, round_idx, *targs):
             targets, send_ok = targets_and_gate(round_idx, *targs)
             return gossip_mod.round_from_targets(
-                state, targets, send_ok, n, rumor_target, suppress
+                state, targets, send_ok, n, rumor_target, suppress, deliver_fn
             )
 
     return round_fn, state0, topo_args
@@ -208,6 +230,13 @@ def run(
                 "(one message in flight) and cannot be sharded; drop "
                 "n_devices or use batched semantics"
             )
+        if cfg.delivery == "stencil":
+            # Keep the fail-loudly contract on the sharded path too.
+            raise ValueError(
+                "delivery='stencil' is not supported with n_devices>1 yet; "
+                "use delivery='auto' (sharded runs deliver via "
+                "scatter + psum_scatter)"
+            )
         from ..parallel.sharded import run_sharded  # circular-import guard
 
         return run_sharded(
@@ -216,6 +245,12 @@ def run(
         )
     target = cfg.resolved_target_count(topo.n, topo.target_count)
     if cfg.reference and cfg.algorithm == "push-sum":
+        if cfg.delivery == "stencil":
+            raise ValueError(
+                "delivery='stencil' does not apply to reference-semantics "
+                "push-sum — the single-walk simulator has no batched "
+                "delivery step"
+            )
         # Reference fidelity: single-walk push-sum (one message in flight,
         # SURVEY.md §3.3). Gossip has no such mode — the reference's gossip
         # is all informed nodes spamming concurrently, which the batched

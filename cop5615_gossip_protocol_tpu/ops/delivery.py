@@ -22,3 +22,30 @@ def deliver(values, targets, n: int):
     with per-dtype tolerances (int32 gossip counts are exact).
     """
     return jnp.zeros((n,), dtype=values.dtype).at[targets].add(values)
+
+
+def deliver_stencil(values, targets, offsets, n: int):
+    """Scatter-free delivery for offset-structured topologies.
+
+    When every edge displacement ``(target - sender) mod n`` lies in the
+    small static set ``offsets`` (ops/topology.stencil_offsets), the inbox is
+
+        inbox[j] = sum over d in offsets of  values[j - d] * [disp[j - d] == d]
+
+    i.e. |offsets| masked circular shifts — one fused elementwise pass per
+    offset, no sort, no scatter, and (in the sharded runner) only
+    max-offset-wide halos to exchange. Accumulation order is the static
+    ``offsets`` order, so results are deterministic (int exact; float differs
+    from `deliver` only by summation order).
+
+    Non-wraparound topologies are safe under the circular shift: a mask slot
+    only fires where a real edge with that displacement exists, so a line's
+    node n-1 never leaks onto node 0 — there is no +1 edge out of n-1.
+    """
+    ids = jnp.arange(n, dtype=targets.dtype)
+    disp = jnp.remainder(targets - ids, n)
+    zero = jnp.zeros((), values.dtype)
+    inbox = jnp.zeros((n,), dtype=values.dtype)
+    for d in offsets:
+        inbox = inbox + jnp.roll(jnp.where(disp == d, values, zero), int(d))
+    return inbox

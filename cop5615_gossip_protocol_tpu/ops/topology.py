@@ -74,6 +74,35 @@ class Topology:
         assert (self.neighbors[live] >= 0).all() and (self.neighbors[live] < self.n).all()
 
 
+def stencil_offsets(topo: Topology, max_offsets: int = 16) -> Optional[np.ndarray]:
+    """Modular neighbor-offset set, if small enough for stencil delivery.
+
+    Regular topologies (line, ring, grids, tori) connect each node only to
+    nodes at a handful of fixed index displacements — line: {±1}, 2D grid:
+    {±1, ±side}, 3D torus: {±1, ±g, ±g²} plus their wraparounds. For those,
+    one round's message delivery needs no scatter at all: it is a stencil of
+    |offsets| masked circular shifts (ops/delivery.deliver_stencil) — pure
+    vectorized elementwise work that XLA fuses, with none of the sort
+    machinery a general scatter-add lowers to on TPU.
+
+    Returns the sorted unique ``(neighbor - node) mod n`` values over all
+    live adjacency slots, or None when the topology is implicit (``full``
+    samples arithmetically), has more than ``max_offsets`` distinct
+    displacements (imp2d/imp3d's random long-range edges), or has a
+    degenerate displacement 0 (a self-loop cannot be expressed as a shift
+    distinct from keeping the value).
+    """
+    if topo.implicit or topo.n < 2:
+        return None
+    cols = np.arange(topo.max_deg)[None, :]
+    live = cols < topo.degree[:, None]
+    ids = np.arange(topo.n, dtype=np.int64)[:, None]
+    diffs = np.unique((topo.neighbors.astype(np.int64) - ids)[live] % topo.n)
+    if diffs.size == 0 or diffs.size > max_offsets or diffs[0] == 0:
+        return None
+    return diffs.astype(np.int32)
+
+
 def _pack(rows: list[list[int]], kind: str, n_requested: int, target: int) -> Topology:
     n = len(rows)
     max_deg = max((len(r) for r in rows), default=0)

@@ -1,0 +1,135 @@
+"""Stencil (shift-based) delivery — the scatter-free fast path for
+offset-structured topologies (ops/topology.stencil_offsets,
+ops/delivery.deliver_stencil).
+
+Oracle: the general scatter-add `deliver`. Gossip counts are int32, so the
+two paths must agree bitwise; push-sum floats may differ only by summation
+order (offsets order vs sort order), so those compare with tight tolerances.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cop5615_gossip_protocol_tpu import SimConfig, build_topology
+from cop5615_gossip_protocol_tpu.models.runner import run
+from cop5615_gossip_protocol_tpu.ops import delivery, sampling
+from cop5615_gossip_protocol_tpu.ops import topology as T
+
+STENCIL_KINDS = ["line", "ring", "grid2d", "ref2d", "grid3d", "torus3d"]
+
+
+@pytest.mark.parametrize("kind", STENCIL_KINDS)
+def test_offsets_detected(kind):
+    topo = build_topology(kind, 64)
+    offs = T.stencil_offsets(topo)
+    assert offs is not None
+    # Every live adjacency slot's displacement is covered.
+    cols = np.arange(topo.max_deg)[None, :]
+    live = cols < topo.degree[:, None]
+    ids = np.arange(topo.n)[:, None]
+    diffs = np.unique((topo.neighbors.astype(np.int64) - ids)[live] % topo.n)
+    assert set(diffs) == set(int(d) for d in offs)
+
+
+def test_offsets_expected_sets():
+    line = T.stencil_offsets(build_topology("line", 100))
+    assert set(int(d) for d in line) == {1, 99}
+    g2 = build_topology("grid2d", 100)  # 10x10
+    offs = T.stencil_offsets(g2)
+    assert set(int(d) for d in offs) == {1, 10, 90, 99}
+
+
+@pytest.mark.parametrize("kind", ["full", "imp3d", "imp2d"])
+def test_offsets_absent_for_unstructured(kind):
+    topo = build_topology(kind, 512, seed=3)
+    assert T.stencil_offsets(topo) is None
+
+
+def test_offsets_reference_mode_quirks():
+    # Q1 extra actor (degree 0) must not break detection; ref2d is line-wired.
+    for kind in ["line", "ref2d", "grid2d", "grid3d"]:
+        topo = build_topology(kind, 30, semantics="reference")
+        assert T.stencil_offsets(topo) is not None, kind
+
+
+@pytest.mark.parametrize("kind", STENCIL_KINDS)
+@pytest.mark.parametrize("dtype", [jnp.int32, jnp.float64])
+def test_stencil_equals_scatter_one_round(kind, dtype):
+    topo = build_topology(kind, 81)
+    offs = T.stencil_offsets(topo)
+    key = jax.random.PRNGKey(7)
+    bits = sampling.uniform_bits(key, topo.n)
+    targets = sampling.targets_explicit(
+        bits, jnp.asarray(topo.neighbors), jnp.asarray(topo.degree)
+    )
+    vals = jax.random.uniform(key, (topo.n,), jnp.float64)
+    if dtype == jnp.int32:
+        vals = (vals * 10).astype(jnp.int32)
+    else:
+        vals = vals.astype(dtype)
+    # Degree-0 nodes (reference-mode orphans) must not send.
+    vals = jnp.where(jnp.asarray(topo.degree) > 0, vals, 0)
+    want = delivery.deliver(vals, targets, topo.n)
+    got = delivery.deliver_stencil(vals, targets, offs, topo.n)
+    if dtype == jnp.int32:
+        assert (np.asarray(want) == np.asarray(got)).all()
+    else:
+        np.testing.assert_allclose(np.asarray(want), np.asarray(got), rtol=1e-12)
+
+
+@pytest.mark.parametrize("kind", ["line", "torus3d"])
+def test_full_run_trajectory_matches_scatter_gossip(kind):
+    # Gossip state is integer — identical targets + exact delivery means the
+    # two delivery strategies must produce the same trajectory bitwise.
+    results = {}
+    for mode in ["scatter", "stencil"]:
+        cfg = SimConfig(n=64, topology=kind, algorithm="gossip",
+                        delivery=mode, max_rounds=5000, chunk_rounds=64)
+        results[mode] = run(build_topology(kind, 64), cfg)
+    a, b = results["scatter"], results["stencil"]
+    assert a.rounds == b.rounds
+    assert a.converged_count == b.converged_count
+    assert a.converged and b.converged
+
+
+def test_full_run_trajectory_matches_scatter_pushsum():
+    kind = "grid2d"
+    results = {}
+    for mode in ["scatter", "stencil"]:
+        cfg = SimConfig(n=49, topology=kind, algorithm="push-sum", dtype="float64",
+                        delivery=mode, max_rounds=20000, chunk_rounds=128)
+        results[mode] = run(build_topology(kind, 49), cfg)
+    a, b = results["scatter"], results["stencil"]
+    assert a.converged and b.converged
+    # Float summation order differs; rounds-to-converge should still agree at
+    # f64 on this scale, and the estimates must both be near-exact.
+    assert a.rounds == b.rounds
+    assert a.estimate_mae < 1e-6 and b.estimate_mae < 1e-6
+
+
+def test_stencil_on_unstructured_topology_raises():
+    cfg = SimConfig(n=64, topology="full", algorithm="gossip", delivery="stencil")
+    with pytest.raises(ValueError, match="stencil"):
+        run(build_topology("full", 64), cfg)
+
+
+def test_stencil_rejected_on_sharded_and_walk_paths():
+    # The fail-loudly contract must hold on run()'s early-exit paths too.
+    topo = build_topology("line", 64)
+    cfg = SimConfig(n=64, topology="line", algorithm="gossip",
+                    delivery="stencil", n_devices=2)
+    with pytest.raises(ValueError, match="n_devices"):
+        run(topo, cfg)
+    topo_ref = build_topology("line", 16, semantics="reference")
+    cfg = SimConfig(n=16, topology="line", algorithm="push-sum", dtype="float64",
+                    semantics="reference", delivery="stencil", max_rounds=100)
+    with pytest.raises(ValueError, match="single-walk"):
+        run(topo_ref, cfg)
+
+
+def test_bad_delivery_name_rejected():
+    with pytest.raises(ValueError, match="delivery"):
+        SimConfig(n=8, delivery="teleport")
