@@ -65,6 +65,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--delivery", choices=["auto", "scatter", "stencil"], default="auto",
                    help="message delivery: stencil (shift-based, offset-structured "
                    "topologies) vs scatter-add; auto picks stencil where legal")
+    p.add_argument("--engine", choices=["auto", "chunked", "fused"], default="auto",
+                   help="round engine: chunked (XLA while_loop) vs fused (Pallas "
+                   "multi-round kernel, VMEM-resident state); auto fuses on TPU "
+                   "where eligible")
     p.add_argument("--devices", type=int, default=None,
                    help="shard the node dimension over this many devices")
     p.add_argument("--platform", choices=["auto", "cpu", "tpu"], default="auto",
@@ -122,6 +126,7 @@ def main(argv: Optional[list[str]] = None) -> int:
             suppress_converged=None if args.suppress == "auto" else args.suppress == "on",
             fault_rate=args.fault_rate,
             delivery=args.delivery,
+            engine=args.engine,
             n_devices=args.devices,
         )
     except ValueError as e:

@@ -133,6 +133,13 @@ class SimConfig:
     # probability (SURVEY.md §5 "Failure detection").
     fault_rate: float = 0.0
 
+    # Round engine: "chunked" = jit'd lax.while_loop dispatching one fused
+    # XLA round program per round; "fused" = the Pallas multi-round kernel
+    # (ops/fused.py — whole chunks of rounds with VMEM-resident state and
+    # in-kernel threefry, offset-structured topologies, float32, n <= ~128k);
+    # "auto" = fused on TPU where eligible, else chunked.
+    engine: str = "auto"
+
     # Delivery strategy: "scatter" = scatter-add (any topology), "stencil" =
     # masked circular shifts (offset-structured topologies only — line, ring,
     # grids, tori; ops/topology.stencil_offsets), "auto" = stencil where the
@@ -174,6 +181,10 @@ class SimConfig:
         if self.delivery not in ("auto", "scatter", "stencil"):
             raise ValueError(
                 f"unknown delivery {self.delivery!r}; expected auto|scatter|stencil"
+            )
+        if self.engine not in ("auto", "chunked", "fused"):
+            raise ValueError(
+                f"unknown engine {self.engine!r}; expected auto|chunked|fused"
             )
 
     # -- resolved policy ---------------------------------------------------

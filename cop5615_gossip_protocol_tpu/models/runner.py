@@ -205,6 +205,110 @@ def _run_reference_walk(topo: Topology, cfg: SimConfig, key, target: int) -> Run
     return result
 
 
+def _finalize_result(topo, cfg, state, rounds, target, compile_s, run_s) -> RunResult:
+    converged_count = int(jnp.sum(state.conv))
+    result = RunResult(
+        algorithm=cfg.algorithm,
+        topology=topo.kind,
+        semantics=cfg.semantics,
+        n_requested=topo.n_requested,
+        population=topo.n,
+        target_count=target,
+        rounds=rounds,
+        converged_count=converged_count,
+        converged=converged_count >= target,
+        compile_s=compile_s,
+        run_s=run_s,
+    )
+    if cfg.algorithm == "push-sum":
+        ratio = state.s / state.w
+        true_mean = (topo.n - 1) / 2.0
+        err = jnp.where(state.conv, jnp.abs(ratio - true_mean), 0.0)
+        result.true_mean = true_mean
+        result.estimate_mae = float(jnp.sum(err) / jnp.maximum(converged_count, 1))
+    return result
+
+
+def _run_fused(
+    topo: Topology,
+    cfg: SimConfig,
+    key: jax.Array,
+    on_chunk,
+    start_state,
+    start_round: int,
+    interpret: bool,
+) -> RunResult:
+    """Chunk loop over the Pallas multi-round engine (ops/fused.py): one
+    kernel launch per cfg.chunk_rounds rounds, state resident in VMEM for
+    the whole chunk."""
+    from ..ops import fused
+
+    target = cfg.resolved_target_count(topo.n, topo.target_count)
+    layout_fill: dict
+    if cfg.algorithm == "push-sum":
+        chunk_fn, layout = fused.make_pushsum_chunk(topo, cfg, interpret=interpret)
+        st = start_state or pushsum_mod.init_state(
+            topo.n, jnp.float32, cfg.initial_term_round
+        )
+        state_dev = (
+            fused._pad2d(jnp.asarray(st.s, jnp.float32), layout, 0.0),
+            fused._pad2d(jnp.asarray(st.w, jnp.float32), layout, 1.0),
+            fused._pad2d(jnp.asarray(st.term, jnp.int32), layout, 0),
+            fused._pad2d(jnp.asarray(st.conv).astype(jnp.int32), layout, 0),
+        )
+
+        def to_canonical(state_dev):
+            s, w, t, c = (x.reshape(-1)[: topo.n] for x in state_dev)
+            return pushsum_mod.PushSumState(s=s, w=w, term=t, conv=c != 0)
+
+    else:
+        chunk_fn, layout = fused.make_gossip_chunk(topo, cfg, interpret=interpret)
+        st = start_state or gossip_mod.init_state(
+            topo.n,
+            draw_leader(key, topo, cfg),
+            leader_counts_receipt=cfg.reference and topo.kind == "full",
+        )
+        state_dev = (
+            fused._pad2d(jnp.asarray(st.count, jnp.int32), layout, 0),
+            fused._pad2d(jnp.asarray(st.active).astype(jnp.int32), layout, 0),
+            fused._pad2d(jnp.asarray(st.conv).astype(jnp.int32), layout, 0),
+        )
+
+        def to_canonical(state_dev):
+            cnt, act, cv = (x.reshape(-1)[: topo.n] for x in state_dev)
+            return gossip_mod.GossipState(count=cnt, active=act != 0, conv=cv != 0)
+
+    chunk_j = jax.jit(chunk_fn, static_argnums=())
+    K = cfg.chunk_rounds
+
+    t0 = time.perf_counter()
+    keys0 = fused.round_keys(key, start_round, K)
+    warm = jax.block_until_ready(
+        chunk_j(state_dev, keys0, jnp.int32(start_round), jnp.int32(start_round))
+    )
+    del warm  # cap == start: executes zero rounds, state untouched
+    compile_s = time.perf_counter() - t0
+
+    rounds = start_round
+    t1 = time.perf_counter()
+    while True:
+        keys = fused.round_keys(key, rounds, K)
+        state_dev, executed = chunk_j(
+            state_dev, keys, jnp.int32(rounds), jnp.int32(cfg.max_rounds)
+        )
+        executed = int(executed)  # host sync at the chunk boundary
+        rounds += executed
+        if on_chunk is not None:
+            on_chunk(rounds, to_canonical(state_dev))
+        if executed < K or rounds >= cfg.max_rounds:
+            break
+    run_s = time.perf_counter() - t1
+
+    return _finalize_result(
+        topo, cfg, to_canonical(state_dev), rounds, target, compile_s, run_s
+    )
+
+
 def run(
     topo: Topology,
     cfg: SimConfig,
@@ -256,6 +360,26 @@ def run(
         # is all informed nodes spamming concurrently, which the batched
         # round (one send per informed node per round) already models.
         return _run_reference_walk(topo, cfg, key, target)
+
+    if cfg.engine != "chunked":
+        from ..ops import fused
+
+        reason = fused.fused_support(topo, cfg)
+        if cfg.engine == "fused":
+            if reason is not None:
+                raise ValueError(f"engine='fused' unavailable: {reason}")
+            # Explicit fused runs everywhere: interpreted off-TPU (tests).
+            return _run_fused(
+                topo, cfg, key, on_chunk, start_state, start_round,
+                interpret=jax.default_backend() != "tpu",
+            )
+        # auto: compiled fused path on TPU only — interpret mode would make
+        # CPU runs slower, and the chunked XLA path is already fast there.
+        if reason is None and cfg.delivery == "auto" and jax.default_backend() == "tpu":
+            return _run_fused(
+                topo, cfg, key, on_chunk, start_state, start_round, interpret=False
+            )
+
     round_fn, state0, topo_args = make_round_fn(topo, cfg, key)
     if start_state is not None:
         state0 = jax.tree.map(jnp.asarray, start_state)
@@ -294,26 +418,4 @@ def run(
     run_s = time.perf_counter() - t1
 
     state, _, _ = carry
-    converged_count = int(jnp.sum(state.conv))
-    result = RunResult(
-        algorithm=cfg.algorithm,
-        topology=topo.kind,
-        semantics=cfg.semantics,
-        n_requested=topo.n_requested,
-        population=topo.n,
-        target_count=target,
-        rounds=rounds,
-        converged_count=converged_count,
-        converged=converged_count >= target,
-        compile_s=compile_s,
-        run_s=run_s,
-    )
-    if cfg.algorithm == "push-sum":
-        ratio = state.s / state.w
-        true_mean = (topo.n - 1) / 2.0
-        err = jnp.where(state.conv, jnp.abs(ratio - true_mean), 0.0)
-        result.true_mean = true_mean
-        result.estimate_mae = float(
-            jnp.sum(err) / jnp.maximum(converged_count, 1)
-        )
-    return result
+    return _finalize_result(topo, cfg, state, rounds, target, compile_s, run_s)

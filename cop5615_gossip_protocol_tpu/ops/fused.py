@@ -1,0 +1,515 @@
+"""Fused multi-round Pallas TPU engine.
+
+The chunked XLA runner (models/runner.py) dispatches one fused round program
+per `lax.while_loop` iteration; at small/medium populations the round is
+dispatch-bound, not bandwidth-bound (measured on v5e: ~19-37 us/round for
+n <= 100k, where the state traffic alone would cost ~1 us). This module
+instead runs an entire chunk of K rounds in ONE `pallas_call`:
+
+- the grid is the round index; per-node state (s, w, term, conv — or gossip
+  counts) lives in VMEM scratch that persists across grid steps, so state
+  never touches HBM between rounds;
+- message delivery reuses the stencil formulation (ops/delivery.deliver_stencil)
+  with circular shifts decomposed into sublane+lane `pltpu.roll` pairs
+  (Mosaic has no 1-D roll);
+- random bits are generated in-kernel by a Threefry-2x32 implementation that
+  replicates `jax.random.bits` bit-for-bit (the default "partitionable"
+  threefry hashes each counter element independently, so the stream is
+  position-wise and padding-invariant; tests/test_fused.py asserts equality
+  against `jax.random`), with the per-round fold_in keys precomputed on the
+  host side of the trace and streamed through SMEM;
+- convergence is checked every round in-kernel; once the converged count
+  reaches the target the remaining grid steps are no-ops, and the number of
+  executed rounds is returned alongside the final state.
+
+Trajectories are therefore bit-identical to the chunked XLA stencil path for
+integer state (gossip) and identical up to compiler float reassociation for
+push-sum.
+
+Eligibility (`fused_support`): explicit offset-structured topology whose
+displacements either never wrap the index space (line/ref2d/grid2d/grid3d)
+or whose population is a multiple of 128 (ring/torus3d then roll cleanly in
+the padded 2-D layout), float32, no fault injection, single device, and
+state small enough to sit in VMEM (~16 MB/core).
+
+Reference mapping: this kernel is the whole of SURVEY.md §3.2/§3.3's hot
+loop — the ChildActor message handlers (program.fs:89-105, 110-143), the
+neighbor sampling (program.fs:91), and the ParentActor convergence count
+(program.fs:47-60) — executed as one resident-state TPU program instead of
+~N*rounds actor mailbox deliveries.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..config import SimConfig
+from .topology import Topology, stencil_offsets
+
+LANES = 128
+# VMEM budget for auto-selection: per-node resident bytes are ~(16 state +
+# 16 out + 16 init + 4*max_deg disp + 4 deg + 4 bits scratch); 128k nodes
+# keeps the footprint ~8 MB with headroom for double buffering.
+MAX_FUSED_NODES = 131_072
+
+
+def _signed(d: int, n: int) -> int:
+    return d if d <= n // 2 else d - n
+
+
+def _has_wrap_edges(topo: Topology) -> bool:
+    """True if any live edge's raw displacement (j - i) differs from its
+    signed modular displacement — i.e. the edge wraps the index space
+    (ring/torus wraparound edges)."""
+    cols = np.arange(topo.max_deg)[None, :]
+    live = cols < topo.degree[:, None]
+    ids = np.arange(topo.n, dtype=np.int64)[:, None]
+    raw = (topo.neighbors.astype(np.int64) - ids)[live]
+    mod = raw % topo.n
+    signed = np.where(mod <= topo.n // 2, mod, mod - topo.n)
+    return bool((raw != signed).any())
+
+
+def fused_support(topo: Topology, cfg: SimConfig) -> Optional[str]:
+    """None if the fused engine can run this config, else the reason not."""
+    if topo.implicit:
+        return "implicit (full) topology has no displacement structure"
+    offsets = stencil_offsets(topo)
+    if offsets is None:
+        return f"topology {topo.kind!r} has no small displacement set"
+    if cfg.dtype != "float32":
+        return "fused engine supports float32 only"
+    if cfg.fault_rate > 0:
+        return "fault injection not supported in the fused kernel"
+    if cfg.n_devices is not None and cfg.n_devices > 1:
+        return "fused engine is single-device"
+    if topo.n > MAX_FUSED_NODES:
+        return f"population {topo.n} exceeds VMEM-resident limit {MAX_FUSED_NODES}"
+    if topo.n % LANES != 0 and _has_wrap_edges(topo):
+        return (
+            "wraparound topology needs population divisible by 128 "
+            f"(n={topo.n}); rolls in the padded layout would misdeliver"
+        )
+    return None
+
+
+# ---------------------------------------------------------------------------
+# In-kernel Threefry-2x32, replicating jax.random.bits for 32-bit draws.
+# ---------------------------------------------------------------------------
+
+_ROT_A = (13, 15, 26, 6)
+_ROT_B = (17, 29, 16, 24)
+
+
+def _rotl(x, r: int):
+    return (x << jnp.uint32(r)) | (x >> jnp.uint32(32 - r))
+
+
+def _threefry_rounds(x0, x1, rots):
+    for r in rots:
+        x0 = x0 + x1
+        x1 = _rotl(x1, r)
+        x1 = x0 ^ x1
+    return x0, x1
+
+
+def threefry_bits_2d(k1, k2, rows: int, cols: int):
+    """uint32 [rows, cols] == jax.random.bits(key, (rows*cols,), uint32)
+    reshaped — the default partitionable threefry hashes counter element i
+    as threefry2x32(key, (hi32(i), lo32(i))) and xors the two outputs, so
+    each position is independent (prefix/padding invariant).
+    """
+    i = (
+        jax.lax.broadcasted_iota(jnp.uint32, (rows, cols), 0) * jnp.uint32(cols)
+        + jax.lax.broadcasted_iota(jnp.uint32, (rows, cols), 1)
+    )
+    ks0 = k1
+    ks1 = k2
+    ks2 = k1 ^ k2 ^ jnp.uint32(0x1BD11BDA)
+    x0 = jnp.zeros((rows, cols), jnp.uint32) + ks0  # counts1 (high bits) = 0
+    x1 = i + ks1
+    x0, x1 = _threefry_rounds(x0, x1, _ROT_A)
+    x0, x1 = x0 + ks1, x1 + ks2 + jnp.uint32(1)
+    x0, x1 = _threefry_rounds(x0, x1, _ROT_B)
+    x0, x1 = x0 + ks2, x1 + ks0 + jnp.uint32(2)
+    x0, x1 = _threefry_rounds(x0, x1, _ROT_A)
+    x0, x1 = x0 + ks0, x1 + ks1 + jnp.uint32(3)
+    x0, x1 = _threefry_rounds(x0, x1, _ROT_B)
+    x0, x1 = x0 + ks1, x1 + ks2 + jnp.uint32(4)
+    x0, x1 = _threefry_rounds(x0, x1, _ROT_A)
+    x0, x1 = x0 + ks2, x1 + ks0 + jnp.uint32(5)
+    return x0 ^ x1
+
+
+# ---------------------------------------------------------------------------
+# Flattened circular shift on the [R, 128] layout.
+# ---------------------------------------------------------------------------
+
+
+def _flat_roll(x, d: int, interpret: bool):
+    """Roll of the row-major flattened [R*128] vector by d (static), on its
+    [R, 128] 2-D representation. Mosaic cannot roll 1-D vectors; a flat roll
+    decomposes into two sublane rolls and two lane rolls blended at the lane
+    where the row boundary falls."""
+    rows, cols = x.shape
+    if interpret:  # pltpu.roll has no interpret-mode lowering
+        return jnp.roll(x.reshape(-1), d).reshape(rows, cols)
+    q, r = divmod(d % (rows * cols), cols)
+    if r == 0:
+        return pltpu.roll(x, q, 0)
+    a = pltpu.roll(pltpu.roll(x, q, 0), r, 1)
+    b = pltpu.roll(pltpu.roll(x, q + 1, 0), r, 1)
+    lane = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    return jnp.where(lane >= r, a, b)
+
+
+# ---------------------------------------------------------------------------
+# Host-side layout prep.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class FusedLayout:
+    n: int
+    n_pad: int
+    rows: int
+    # [(modular displacement, roll shift in the padded flat space), ...]
+    shifts: tuple
+    disp_cols: np.ndarray  # [max_deg, rows, 128] int32; sentinel n = no edge
+    degree2d: np.ndarray  # [rows, 128] int32; 0 on padding
+
+
+def build_layout(topo: Topology) -> FusedLayout:
+    n = topo.n
+    n_pad = ((n + LANES - 1) // LANES) * LANES
+    rows = n_pad // LANES
+    offsets = stencil_offsets(topo)
+    assert offsets is not None
+    if n_pad == n:
+        shifts = tuple((int(d), int(d)) for d in offsets)
+    else:
+        # Non-wrap topologies only (fused_support guarantees it): a negative
+        # signed displacement rolls backward, i.e. forward by n_pad + d.
+        shifts = tuple(
+            (int(d), _signed(int(d), n) % n_pad) for d in offsets
+        )
+    ids = np.arange(n, dtype=np.int64)[:, None]
+    disp = (topo.neighbors.astype(np.int64) - ids) % n
+    cols = np.arange(topo.max_deg)[None, :]
+    disp = np.where(cols < topo.degree[:, None], disp, n)  # sentinel: no match
+    disp_cols = np.full((topo.max_deg, n_pad), n, dtype=np.int32)
+    disp_cols[:, :n] = disp.T
+    degree2d = np.zeros((n_pad,), dtype=np.int32)
+    degree2d[:n] = topo.degree
+    return FusedLayout(
+        n=n,
+        n_pad=n_pad,
+        rows=rows,
+        shifts=shifts,
+        disp_cols=disp_cols.reshape(topo.max_deg, rows, LANES),
+        degree2d=degree2d.reshape(rows, LANES),
+    )
+
+
+def _pad2d(x, layout: FusedLayout, fill):
+    pad = layout.n_pad - layout.n
+    if pad:
+        x = jnp.concatenate([x, jnp.full((pad,), fill, x.dtype)])
+    return x.reshape(layout.rows, LANES)
+
+
+def _sample_disp(bits, disp_ref, deg):
+    """Per-node sampled displacement — mirrors ops/sampling.targets_explicit:
+    slot = bits % max(deg,1), then a branchless select over neighbor slots."""
+    deg_safe = jnp.maximum(deg, 1).astype(jnp.uint32)
+    slot = (bits % deg_safe).astype(jnp.int32)
+    d = disp_ref[0]
+    for j in range(1, disp_ref.shape[0]):
+        d = jnp.where(slot == j, disp_ref[j], d)
+    return d
+
+
+# ---------------------------------------------------------------------------
+# Kernels. Grid = (K rounds,); state in VMEM scratch across steps.
+# ---------------------------------------------------------------------------
+
+
+def make_pushsum_chunk(
+    topo: Topology, cfg: SimConfig, *, interpret: bool = False
+):
+    """Returns (chunk_fn, layout): ``chunk_fn(state4, keys, start, cap)``
+    runs up to K = keys.shape[0] synchronous push-sum rounds in one kernel
+    launch. ``state4`` is (s, w, term, conv_i32) in the padded [rows, 128]
+    layout; ``keys`` is uint32 [K, 2] per-round fold_in keys; ``start`` the
+    absolute round index of keys[0]; ``cap`` the max_rounds bound. Returns
+    (state4', rounds_executed)."""
+    layout = build_layout(topo)
+    R = layout.rows
+    delta = np.float32(cfg.resolved_delta)
+    term_rounds = np.int32(cfg.term_rounds)
+    target = np.int32(cfg.resolved_target_count(topo.n, topo.target_count))
+
+    def kernel(
+        start_ref, keys_ref, disp_ref, deg_ref, s0, w0, t0, c0,
+        s_o, w_o, t_o, c_o, meta_o,
+        s_v, w_v, t_v, c_v, flags,
+    ):
+        k = pl.program_id(0)
+        K = pl.num_programs(0)
+
+        @pl.when(k == 0)
+        def _init():
+            s_v[:] = s0[:]
+            w_v[:] = w0[:]
+            t_v[:] = t0[:]
+            c_v[:] = c0[:]
+            # done must seed from the incoming state, or a launch that starts
+            # already-converged (resume, post-convergence chunk) would run
+            # one extra round the chunked runner would not.
+            flags[0] = jnp.where(jnp.sum(c0[:]) >= target, 1, 0)
+            flags[1] = 0  # rounds executed
+
+        active = (flags[0] == 0) & (start_ref[0] + k < start_ref[1])
+
+        @pl.when(active)
+        def _round():
+            kk = k % 8
+            bits = threefry_bits_2d(keys_ref[kk, 0], keys_ref[kk, 1], R, LANES)
+            deg = deg_ref[:]
+            disp = _sample_disp(bits, disp_ref, deg)
+            send_ok = deg > 0
+            s = s_v[:]
+            w = w_v[:]
+            zero = jnp.float32(0)
+            s_send = jnp.where(send_ok, s * jnp.float32(0.5), zero)
+            w_send = jnp.where(send_ok, w * jnp.float32(0.5), zero)
+            inbox_s = jnp.zeros_like(s)
+            inbox_w = jnp.zeros_like(w)
+            for d_mod, shift in layout.shifts:
+                m = disp == d_mod
+                inbox_s = inbox_s + _flat_roll(
+                    jnp.where(m, s_send, zero), shift, interpret
+                )
+                inbox_w = inbox_w + _flat_roll(
+                    jnp.where(m, w_send, zero), shift, interpret
+                )
+            # Absorb — mirrors models/pushsum.absorb (program.fs:119-143).
+            s_new = (s - s_send) + inbox_s
+            w_new = (w - w_send) + inbox_w
+            received = inbox_w > 0
+            stable = jnp.abs(s_new / w_new - s / w) <= delta
+            term = t_v[:]
+            term_new = jnp.where(
+                received, jnp.where(stable, term + 1, jnp.int32(0)), term
+            )
+            conv_new = jnp.where(
+                (c_v[:] != 0) | (term_new >= term_rounds),
+                jnp.int32(1),
+                jnp.int32(0),
+            )
+            s_v[:] = s_new
+            w_v[:] = w_new
+            t_v[:] = term_new
+            c_v[:] = conv_new
+            flags[1] = flags[1] + 1
+            flags[0] = jnp.where(jnp.sum(conv_new) >= target, 1, 0)
+
+        @pl.when(k == K - 1)
+        def _emit():
+            s_o[:] = s_v[:]
+            w_o[:] = w_v[:]
+            t_o[:] = t_v[:]
+            c_o[:] = c_v[:]
+            meta_o[0] = flags[1]
+
+    disp_cols = jnp.asarray(layout.disp_cols)
+    degree2d = jnp.asarray(layout.degree2d)
+
+    def chunk_fn(state4, keys, start, cap):
+        s, w, t, c = state4
+        if keys.shape[0] % 8:  # SMEM key blocks are 8 rounds wide
+            pad = 8 - keys.shape[0] % 8
+            keys = jnp.concatenate([keys, jnp.zeros((pad, 2), keys.dtype)])
+        K = keys.shape[0]
+        grid = (K,)
+        f32 = jax.ShapeDtypeStruct((R, LANES), jnp.float32)
+        i32 = jax.ShapeDtypeStruct((R, LANES), jnp.int32)
+        outs = pl.pallas_call(
+            kernel,
+            grid=grid,
+            out_shape=(f32, f32, i32, i32, jax.ShapeDtypeStruct((2,), jnp.int32)),
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),  # start/cap
+                pl.BlockSpec((8, 2), lambda k: (k // 8, 0), memory_space=pltpu.SMEM),
+                pl.BlockSpec((disp_cols.shape[0], R, LANES), lambda k: (0, 0, 0)),
+                pl.BlockSpec((R, LANES), lambda k: (0, 0)),
+                pl.BlockSpec((R, LANES), lambda k: (0, 0)),
+                pl.BlockSpec((R, LANES), lambda k: (0, 0)),
+                pl.BlockSpec((R, LANES), lambda k: (0, 0)),
+                pl.BlockSpec((R, LANES), lambda k: (0, 0)),
+            ],
+            out_specs=(
+                pl.BlockSpec((R, LANES), lambda k: (0, 0)),
+                pl.BlockSpec((R, LANES), lambda k: (0, 0)),
+                pl.BlockSpec((R, LANES), lambda k: (0, 0)),
+                pl.BlockSpec((R, LANES), lambda k: (0, 0)),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((R, LANES), jnp.float32),
+                pltpu.VMEM((R, LANES), jnp.float32),
+                pltpu.VMEM((R, LANES), jnp.int32),
+                pltpu.VMEM((R, LANES), jnp.int32),
+                pltpu.SMEM((2,), jnp.int32),
+            ],
+            interpret=interpret,
+        )(
+            jnp.stack([jnp.int32(start), jnp.int32(cap)]),
+            keys,
+            disp_cols,
+            degree2d,
+            s, w, t, c,
+        )
+        s2, w2, t2, c2, meta = outs
+        return (s2, w2, t2, c2), meta[0]
+
+    return chunk_fn, layout
+
+
+def make_gossip_chunk(topo: Topology, cfg: SimConfig, *, interpret: bool = False):
+    """Gossip analog of make_pushsum_chunk. ``state3`` is (count, active_i32,
+    conv_i32). Converged-target suppression (the reference's shared
+    dictionary probe, program.fs:92) reads last round's converged vector at
+    the sampled target via a backward roll per offset."""
+    layout = build_layout(topo)
+    R = layout.rows
+    rumor_target = np.int32(cfg.resolved_rumor_target)
+    suppress = cfg.resolved_suppress
+    target = np.int32(cfg.resolved_target_count(topo.n, topo.target_count))
+    n_pad = layout.n_pad
+
+    def kernel(
+        start_ref, keys_ref, disp_ref, deg_ref, n0, a0, c0,
+        n_o, a_o, c_o, meta_o,
+        n_v, a_v, c_v, flags,
+    ):
+        k = pl.program_id(0)
+        K = pl.num_programs(0)
+
+        @pl.when(k == 0)
+        def _init():
+            n_v[:] = n0[:]
+            a_v[:] = a0[:]
+            c_v[:] = c0[:]
+            flags[0] = jnp.where(jnp.sum(c0[:]) >= target, 1, 0)
+            flags[1] = 0
+
+        active_chunk = (flags[0] == 0) & (start_ref[0] + k < start_ref[1])
+
+        @pl.when(active_chunk)
+        def _round():
+            kk = k % 8
+            bits = threefry_bits_2d(keys_ref[kk, 0], keys_ref[kk, 1], R, LANES)
+            deg = deg_ref[:]
+            disp = _sample_disp(bits, disp_ref, deg)
+            sending = (a_v[:] != 0) & (deg > 0)
+            if suppress:
+                conv = c_v[:]
+                conv_of_target = jnp.zeros_like(conv)
+                for d_mod, shift in layout.shifts:
+                    back = (n_pad - shift) % n_pad
+                    conv_of_target = jnp.where(
+                        disp == d_mod,
+                        _flat_roll(conv, back, interpret),
+                        conv_of_target,
+                    )
+                sending = sending & (conv_of_target == 0)
+            vals = sending.astype(jnp.int32)
+            inbox = jnp.zeros_like(vals)
+            for d_mod, shift in layout.shifts:
+                m = disp == d_mod
+                inbox = inbox + _flat_roll(
+                    jnp.where(m, vals, jnp.int32(0)), shift, interpret
+                )
+            count_new = n_v[:] + inbox
+            active_new = jnp.where(
+                (a_v[:] != 0) | (inbox > 0), jnp.int32(1), jnp.int32(0)
+            )
+            conv_new = jnp.where(count_new >= rumor_target, jnp.int32(1), jnp.int32(0))
+            n_v[:] = count_new
+            a_v[:] = active_new
+            c_v[:] = conv_new
+            flags[1] = flags[1] + 1
+            flags[0] = jnp.where(jnp.sum(conv_new) >= target, 1, 0)
+
+        @pl.when(k == K - 1)
+        def _emit():
+            n_o[:] = n_v[:]
+            a_o[:] = a_v[:]
+            c_o[:] = c_v[:]
+            meta_o[0] = flags[1]
+
+    disp_cols = jnp.asarray(layout.disp_cols)
+    degree2d = jnp.asarray(layout.degree2d)
+
+    def chunk_fn(state3, keys, start, cap):
+        cnt, act, cv = state3
+        if keys.shape[0] % 8:
+            pad = 8 - keys.shape[0] % 8
+            keys = jnp.concatenate([keys, jnp.zeros((pad, 2), keys.dtype)])
+        i32 = jax.ShapeDtypeStruct((R, LANES), jnp.int32)
+        outs = pl.pallas_call(
+            kernel,
+            grid=(keys.shape[0],),
+            out_shape=(i32, i32, i32, jax.ShapeDtypeStruct((2,), jnp.int32)),
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec((8, 2), lambda k: (k // 8, 0), memory_space=pltpu.SMEM),
+                pl.BlockSpec((disp_cols.shape[0], R, LANES), lambda k: (0, 0, 0)),
+                pl.BlockSpec((R, LANES), lambda k: (0, 0)),
+                pl.BlockSpec((R, LANES), lambda k: (0, 0)),
+                pl.BlockSpec((R, LANES), lambda k: (0, 0)),
+                pl.BlockSpec((R, LANES), lambda k: (0, 0)),
+            ],
+            out_specs=(
+                pl.BlockSpec((R, LANES), lambda k: (0, 0)),
+                pl.BlockSpec((R, LANES), lambda k: (0, 0)),
+                pl.BlockSpec((R, LANES), lambda k: (0, 0)),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((R, LANES), jnp.int32),
+                pltpu.VMEM((R, LANES), jnp.int32),
+                pltpu.VMEM((R, LANES), jnp.int32),
+                pltpu.SMEM((2,), jnp.int32),
+            ],
+            interpret=interpret,
+        )(
+            jnp.stack([jnp.int32(start), jnp.int32(cap)]),
+            keys,
+            disp_cols,
+            degree2d,
+            cnt, act, cv,
+        )
+        n2, a2, c2, meta = outs
+        return (n2, a2, c2), meta[0]
+
+    return chunk_fn, layout
+
+
+def round_keys(base_key: jax.Array, start: int, count: int) -> jax.Array:
+    """uint32 [count, 2] fold_in keys for absolute rounds start..start+count,
+    matching ops/sampling.round_key exactly (same fold_in stream)."""
+    rounds = jnp.arange(start, start + count, dtype=jnp.int32)
+    folded = jax.vmap(lambda r: jax.random.fold_in(base_key, r))(rounds)
+    if folded.dtype == jnp.uint32:
+        return folded
+    return jax.random.key_data(folded)

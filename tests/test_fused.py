@@ -1,0 +1,143 @@
+"""Fused multi-round Pallas engine (ops/fused.py), run in interpret mode on
+CPU. Oracles:
+
+- the in-kernel Threefry must equal jax.random.bits bit-for-bit (the whole
+  bit-compatibility story rests on it);
+- full runs must match the chunked XLA runner: gossip bitwise (integer
+  state), push-sum on rounds/estimates (float32 both paths, same op order);
+- eligibility gating must fail loudly.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cop5615_gossip_protocol_tpu import SimConfig, build_topology
+from cop5615_gossip_protocol_tpu.models.runner import run
+from cop5615_gossip_protocol_tpu.ops import fused, sampling
+
+
+def test_threefry_matches_jax_random():
+    key = jax.random.PRNGKey(42)
+    kd = jax.random.key_data(key) if key.dtype != jnp.uint32 else key
+    for m in [128, 384, 1280]:
+        rows = m // 128
+        got = np.asarray(
+            fused.threefry_bits_2d(kd[0], kd[1], rows, 128)
+        ).reshape(-1)
+        want = np.asarray(jax.random.bits(key, (m,), jnp.uint32))
+        assert (got == want).all(), m
+
+
+def test_threefry_prefix_property():
+    # Padding invariance: first n values of an n_pad draw equal the n draw.
+    key = jax.random.PRNGKey(7)
+    a = np.asarray(jax.random.bits(key, (300,), jnp.uint32))
+    b = np.asarray(jax.random.bits(key, (512,), jnp.uint32))
+    assert (a == b[:300]).all()
+
+
+def test_round_keys_match_sampling():
+    key = jax.random.PRNGKey(3)
+    keys = np.asarray(fused.round_keys(key, 5, 4))
+    for i, r in enumerate(range(5, 9)):
+        want = sampling.round_key(key, r)
+        want = jax.random.key_data(want) if want.dtype != jnp.uint32 else want
+        assert (keys[i] == np.asarray(want)).all()
+
+
+@pytest.mark.parametrize("kind", ["line", "grid2d", "grid3d"])
+def test_fused_gossip_matches_chunked_bitwise(kind):
+    n = 144
+    results = {}
+    for engine in ["chunked", "fused"]:
+        cfg = SimConfig(n=n, topology=kind, algorithm="gossip", engine=engine,
+                        max_rounds=4000, chunk_rounds=48)
+        results[engine] = run(build_topology(kind, n), cfg)
+    a, b = results["chunked"], results["fused"]
+    assert a.rounds == b.rounds
+    assert a.converged_count == b.converged_count
+    assert a.converged and b.converged
+
+
+def test_fused_gossip_suppression_reference_mode():
+    n = 100
+    results = {}
+    for engine in ["chunked", "fused"]:
+        cfg = SimConfig(n=n, topology="line", algorithm="gossip", engine=engine,
+                        semantics="reference", max_rounds=6000, chunk_rounds=64)
+        results[engine] = run(build_topology("line", n, semantics="reference"), cfg)
+    a, b = results["chunked"], results["fused"]
+    assert a.rounds == b.rounds and a.converged_count == b.converged_count
+
+
+def test_fused_pushsum_matches_chunked():
+    n = 128  # multiple of 128: no padding, wrap kinds also legal
+    results = {}
+    for engine in ["chunked", "fused"]:
+        cfg = SimConfig(n=n, topology="ring", algorithm="push-sum",
+                        dtype="float32", engine=engine,
+                        max_rounds=60000, chunk_rounds=256)
+        results[engine] = run(build_topology("ring", n), cfg)
+    a, b = results["chunked"], results["fused"]
+    assert a.converged and b.converged
+    # Same f32 op order => identical trajectories up to compiler
+    # reassociation; rounds must agree exactly on this scale.
+    assert a.rounds == b.rounds
+    assert abs(a.estimate_mae - b.estimate_mae) < 1e-3
+
+
+def test_fused_pushsum_padded_nonwrap():
+    n = 49  # grid2d 7x7, padded to 128 in-kernel
+    cfg = SimConfig(n=n, topology="grid2d", algorithm="push-sum",
+                    dtype="float32", engine="fused",
+                    max_rounds=60000, chunk_rounds=256)
+    r = run(build_topology("grid2d", n), cfg)
+    ref = run(build_topology("grid2d", n),
+              SimConfig(n=n, topology="grid2d", algorithm="push-sum",
+                        dtype="float32", engine="chunked",
+                        max_rounds=60000, chunk_rounds=256))
+    assert r.converged and ref.converged
+    assert r.rounds == ref.rounds
+
+
+def test_fused_resume_midway():
+    # Chunk-boundary state from a fused run resumes to the same trajectory.
+    n = 144
+    kind = "grid2d"
+    cfg = SimConfig(n=n, topology=kind, algorithm="gossip", engine="fused",
+                    max_rounds=4000, chunk_rounds=32)
+    topo = build_topology(kind, n)
+    snaps = []
+    full = run(topo, cfg, on_chunk=lambda r, s: snaps.append((r, s)))
+    assert len(snaps) >= 2
+    r0, s0 = snaps[0]
+    resumed = run(topo, cfg, start_state=jax.tree.map(jnp.asarray, s0), start_round=r0)
+    assert resumed.rounds == full.rounds
+    assert resumed.converged_count == full.converged_count
+
+
+def test_fused_support_gating():
+    # wrap topology with n not divisible by 128
+    topo = build_topology("torus3d", 1000)  # pop 729
+    cfg = SimConfig(n=1000, topology="torus3d", algorithm="push-sum",
+                    engine="fused")
+    with pytest.raises(ValueError, match="128"):
+        run(topo, cfg)
+    # implicit full
+    cfg = SimConfig(n=64, topology="full", engine="fused")
+    with pytest.raises(ValueError, match="fused"):
+        run(build_topology("full", 64), cfg)
+    # f64
+    cfg = SimConfig(n=64, topology="line", engine="fused", dtype="float64")
+    with pytest.raises(ValueError, match="float32"):
+        run(build_topology("line", 64), cfg)
+
+
+def test_has_wrap_edges():
+    assert fused._has_wrap_edges(build_topology("ring", 100))
+    assert not fused._has_wrap_edges(build_topology("line", 100))
+    assert not fused._has_wrap_edges(build_topology("grid3d", 64))
+    assert fused._has_wrap_edges(build_topology("torus3d", 64))
