@@ -247,6 +247,15 @@ def _run_fused(
     layout_fill: dict
     if cfg.algorithm == "push-sum":
         chunk_fn, layout = fused.make_pushsum_chunk(topo, cfg, interpret=interpret)
+        if start_state is not None and jnp.asarray(start_state.s).dtype != jnp.float32:
+            # Mirror the strict config-match check at resume (cli.py): a
+            # float64 checkpoint silently downcast to the float32-only fused
+            # engine would lose precision without a trace.
+            raise ValueError(
+                "fused engine resume requires a float32 checkpoint, got "
+                f"{jnp.asarray(start_state.s).dtype}; resume with "
+                "engine='chunked' (matching the checkpoint dtype) instead"
+            )
         st = start_state or pushsum_mod.init_state(
             topo.n, jnp.float32, cfg.initial_term_round
         )
@@ -355,6 +364,13 @@ def run(
                 "push-sum — the single-walk simulator has no batched "
                 "delivery step"
             )
+        if cfg.engine == "fused":
+            raise ValueError(
+                "engine='fused' does not apply to reference-semantics "
+                "push-sum — the single-walk simulator (one message in "
+                "flight) has no multi-round batched kernel; drop the "
+                "engine override or use batched semantics"
+            )
         # Reference fidelity: single-walk push-sum (one message in flight,
         # SURVEY.md §3.3). Gossip has no such mode — the reference's gossip
         # is all informed nodes spamming concurrently, which the batched
@@ -366,6 +382,12 @@ def run(
 
         reason = fused.fused_support(topo, cfg)
         if cfg.engine == "fused":
+            if cfg.delivery == "scatter":
+                raise ValueError(
+                    "engine='fused' delivers via the stencil formulation "
+                    "only; delivery='scatter' would be silently ignored — "
+                    "use delivery='auto'/'stencil' or engine='chunked'"
+                )
             if reason is not None:
                 raise ValueError(f"engine='fused' unavailable: {reason}")
             # Explicit fused runs everywhere: interpreted off-TPU (tests).

@@ -87,6 +87,14 @@ def fused_support(topo: Topology, cfg: SimConfig) -> Optional[str]:
         return f"topology {topo.kind!r} has no small displacement set"
     if cfg.dtype != "float32":
         return "fused engine supports float32 only"
+    if not jax.config.jax_threefry_partitionable:
+        # threefry_bits_2d replicates only the partitionable per-position
+        # stream; with the flag off the in-kernel draws would silently
+        # diverge from the chunked engine's jax.random stream.
+        return (
+            "requires jax_threefry_partitionable=True (the in-kernel "
+            "threefry replicates the partitionable stream only)"
+        )
     if cfg.fault_rate > 0:
         return "fault injection not supported in the fused kernel"
     if cfg.n_devices is not None and cfg.n_devices > 1:
@@ -335,6 +343,13 @@ def make_pushsum_chunk(
 
     def chunk_fn(state4, keys, start, cap):
         s, w, t, c = state4
+        # Clamp the round cap to the rounds that have REAL keys. The SMEM key
+        # stream below is padded to 8-round blocks with zeros; without the
+        # clamp a chunk_rounds not divisible by 8 would execute its padded
+        # grid steps with key (0,0) — identical random bits at the same
+        # positions every chunk, silently diverging from the chunked engine
+        # (tests/test_fused.py::test_chunk_rounds_not_multiple_of_8).
+        cap = jnp.minimum(jnp.int32(cap), jnp.int32(start) + jnp.int32(keys.shape[0]))
         if keys.shape[0] % 8:  # SMEM key blocks are 8 rounds wide
             pad = 8 - keys.shape[0] % 8
             keys = jnp.concatenate([keys, jnp.zeros((pad, 2), keys.dtype)])
@@ -462,6 +477,9 @@ def make_gossip_chunk(topo: Topology, cfg: SimConfig, *, interpret: bool = False
 
     def chunk_fn(state3, keys, start, cap):
         cnt, act, cv = state3
+        # Same padded-key guard as make_pushsum_chunk's chunk_fn: zero-key
+        # padding rounds must never execute.
+        cap = jnp.minimum(jnp.int32(cap), jnp.int32(start) + jnp.int32(keys.shape[0]))
         if keys.shape[0] % 8:
             pad = 8 - keys.shape[0] % 8
             keys = jnp.concatenate([keys, jnp.zeros((pad, 2), keys.dtype)])

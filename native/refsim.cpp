@@ -360,7 +360,7 @@ int main(int argc, char** argv) {
                  r.converged, r.target, r.events);
     return 1;
   }
-  std::printf("------------------------------------------------\n");
+  std::printf("-----------------------------------------------------------\n");  // 59 dashes, program.fs:51
   std::printf("Convergence Time: %f ms\n", r.wall_ms);
   std::printf("events: %lld population: %d leader: %d\n", r.events, r.population,
               r.leader);

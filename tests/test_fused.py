@@ -141,3 +141,50 @@ def test_has_wrap_edges():
     assert not fused._has_wrap_edges(build_topology("line", 100))
     assert not fused._has_wrap_edges(build_topology("grid3d", 64))
     assert fused._has_wrap_edges(build_topology("torus3d", 64))
+
+
+@pytest.mark.parametrize("chunk_rounds", [5, 100])
+def test_chunk_rounds_not_multiple_of_8(chunk_rounds):
+    # Regression: SMEM key blocks are padded to 8 rounds with zero keys; the
+    # padded grid steps must not execute. Before the cap clamp in chunk_fn,
+    # chunk_rounds=5 ran 3 extra rounds per chunk with key (0,0) — identical
+    # random bits every chunk — and diverged from the chunked engine.
+    n = 144
+    results = {}
+    for engine, ck in [("chunked", 48), ("fused", chunk_rounds)]:
+        cfg = SimConfig(n=n, topology="grid2d", algorithm="gossip",
+                        engine=engine, max_rounds=4000, chunk_rounds=ck)
+        results[engine] = run(build_topology("grid2d", n), cfg)
+    a, b = results["chunked"], results["fused"]
+    assert a.rounds == b.rounds
+    assert a.converged_count == b.converged_count
+
+
+def test_fused_rejects_scatter_delivery_and_reference_pushsum():
+    # Silent-ignore combinations must fail loudly (fail-loudly contract).
+    topo = build_topology("line", 64)
+    cfg = SimConfig(n=64, topology="line", algorithm="gossip",
+                    engine="fused", delivery="scatter")
+    with pytest.raises(ValueError, match="scatter"):
+        run(topo, cfg)
+    topo_r = build_topology("line", 64, semantics="reference")
+    cfg_r = SimConfig(n=64, topology="line", algorithm="push-sum",
+                      semantics="reference", engine="fused")
+    with pytest.raises(ValueError, match="single-walk"):
+        run(topo_r, cfg_r)
+
+
+def test_fused_resume_rejects_non_float32():
+    from cop5615_gossip_protocol_tpu.models import pushsum as pushsum_mod
+    from cop5615_gossip_protocol_tpu.models.runner import _run_fused
+
+    topo = build_topology("ring", 128)
+    cfg = SimConfig(n=128, topology="ring", algorithm="push-sum", engine="fused")
+    st64 = pushsum_mod.PushSumState(
+        s=jnp.arange(128, dtype=jnp.float64) if jax.config.jax_enable_x64
+        else jnp.arange(128, dtype=jnp.float16),
+        w=jnp.ones((128,)), term=jnp.zeros((128,), jnp.int32),
+        conv=jnp.zeros((128,), bool),
+    )
+    with pytest.raises(ValueError, match="float32 checkpoint"):
+        _run_fused(topo, cfg, jax.random.PRNGKey(0), None, st64, 0, True)
