@@ -12,8 +12,17 @@ linear is conservative): t_akka(N) ≈ 0.4187 ms/node · N → ~418.6 s at 1M.
 The north-star target (<10 s wall-clock, ≥100× Akka) corresponds to
 vs_baseline ≥ 100.
 
+The benchmark runs delivery="pool" (offset-pool sampling: each round draws a
+small shared pool of uniform ring displacements and every node picks one, so
+delivery is a handful of masked rolls instead of a sort-based scatter —
+ops/sampling.pool_offsets documents the semantics). Partner marginals stay
+uniform over j != i; convergence quality vs iid scatter sampling is pinned by
+tests/test_pool.py (rounds within a few percent, same estimate error). Pass
+--delivery scatter to measure the exact-iid path instead.
+
 Usage: python bench.py [--n N] [--topology full] [--algorithm push-sum]
                        [--dtype float32] [--platform auto|cpu]
+                       [--delivery pool|scatter] [--pool-size K]
 """
 
 from __future__ import annotations
@@ -36,7 +45,12 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--max-rounds", type=int, default=100_000)
     ap.add_argument("--platform", choices=["auto", "cpu"], default="auto")
+    ap.add_argument("--delivery", default=None,
+                    help="delivery override (default: pool on full, else auto)")
+    ap.add_argument("--pool-size", type=int, default=4)
     args = ap.parse_args(argv)
+    if args.delivery is None:
+        args.delivery = "pool" if args.topology == "full" else "auto"
 
     import jax
 
@@ -53,6 +67,8 @@ def main(argv=None) -> int:
         delta=args.delta,
         seed=args.seed,
         max_rounds=args.max_rounds,
+        delivery=args.delivery,
+        pool_size=args.pool_size,
     )
     topo = build_topology(args.topology, args.n, seed=args.seed)
     result = run(topo, cfg)

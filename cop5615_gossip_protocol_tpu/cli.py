@@ -62,9 +62,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="suppress gossip sends to converged targets (auto: on in reference semantics)")
     p.add_argument("--fault-rate", type=float, default=0.0,
                    help="per-round probability a node fails to send (fault injection)")
-    p.add_argument("--delivery", choices=["auto", "scatter", "stencil"], default="auto",
+    p.add_argument("--delivery", choices=["auto", "scatter", "stencil", "pool"],
+                   default="auto",
                    help="message delivery: stencil (shift-based, offset-structured "
-                   "topologies) vs scatter-add; auto picks stencil where legal")
+                   "topologies) vs scatter-add vs pool (offset-pool sampling on "
+                   "the full topology — per-round shared displacement pool, "
+                   "delivery as masked rolls); auto picks stencil where legal")
+    p.add_argument("--pool-size", type=int, default=4,
+                   help="displacement-pool width for --delivery pool (power of two)")
     p.add_argument("--engine", choices=["auto", "chunked", "fused"], default="auto",
                    help="round engine: chunked (XLA while_loop) vs fused (Pallas "
                    "multi-round kernel, VMEM-resident state); auto fuses on TPU "
@@ -126,6 +131,7 @@ def main(argv: Optional[list[str]] = None) -> int:
             suppress_converged=None if args.suppress == "auto" else args.suppress == "on",
             fault_rate=args.fault_rate,
             delivery=args.delivery,
+            pool_size=args.pool_size,
             engine=args.engine,
             n_devices=args.devices,
         )

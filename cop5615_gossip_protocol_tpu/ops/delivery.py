@@ -49,3 +49,41 @@ def deliver_stencil(values, targets, offsets, n: int):
     for d in offsets:
         inbox = inbox + jnp.roll(jnp.where(disp == d, values, zero), int(d))
     return inbox
+
+
+def deliver_pool(channels, choice, offsets):
+    """Scatter-free delivery for offset-pool sampling on the implicit full
+    topology (ops/sampling.pool_offsets).
+
+    ``channels`` is [C, n] — C message channels delivered along the same
+    sampled edges (push-sum stacks s and w so each roll moves both; gossip
+    uses C=1). ``choice`` is the per-node pool slot, ``offsets`` the round's
+    [K] displacement pool (traced values — the rolls are dynamic). The inbox
+    is K masked circular shifts:
+
+        inbox[:, j] = sum over k of  channels[:, j - o_k] * [choice[j - o_k] == k]
+
+    Mass conservation is exact: every sent value lands in exactly one slot.
+    Accumulation order is the static pool-slot order, so results are
+    deterministic given the seed. Equivalent to scatter-add over
+    targets_pool(...) up to float summation order (int channels: exact) —
+    tests/test_pool.py pins both.
+    """
+    inbox = jnp.zeros_like(channels)
+    zero = jnp.zeros((), channels.dtype)
+    for k in range(offsets.shape[0]):
+        masked = jnp.where((choice == k)[None, :], channels, zero)
+        inbox = inbox + jnp.roll(masked, offsets[k], axis=1)
+    return inbox
+
+
+def pool_lookup(vec, choice, offsets):
+    """Per-sender read of ``vec`` at the sampled target — gossip's
+    converged-target suppression (the reference's registry probe,
+    program.fs:92) without a 1M-lane gather: for pool slot k the target sits
+    at displacement o_k, so the remote read is a *backward* roll per slot.
+    Returns out[i] = vec[(i + o_choice[i]) mod n]."""
+    out = jnp.zeros_like(vec)
+    for k in range(offsets.shape[0]):
+        out = jnp.where(choice == k, jnp.roll(vec, -offsets[k]), out)
+    return out

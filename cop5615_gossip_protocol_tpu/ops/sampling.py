@@ -74,6 +74,51 @@ def targets_full(bits: jax.Array, node_ids: jax.Array, n: int) -> jax.Array:
     return (node_ids + shift) % n
 
 
+# fold_in tag for the per-round offset-pool draw. Disjoint from send_gate's
+# 0x5EED tag and from round indices (these fold into the *round* key, whose
+# own stream starts fresh).
+_POOL_TAG = 0x0FF5
+
+
+def pool_offsets(round_k: jax.Array, pool_size: int, n: int) -> jax.Array:
+    """[pool_size] int32 offsets, each uniform on [1, n-1] — the round's
+    shared displacement pool for the implicit full topology.
+
+    Offset-pool sampling is the TPU-first recast of "pick a uniform random
+    partner j != i" (program.fs:91 on the full wiring of program.fs:201-206):
+    instead of every node drawing an independent partner — which forces the
+    delivery into a sort-based scatter — the round draws a small pool of
+    uniform ring displacements and every node picks one. The marginal
+    distribution of each node's partner is exactly uniform over the n-1
+    non-self nodes (up to the documented modulo bias); within a round the
+    draws are correlated (at most pool_size distinct displacements), which
+    leaves per-round communication a union of pool_size circular shifts —
+    deliverable as masked rolls with zero scatter/sort work
+    (ops/delivery.deliver_pool). Random k-out unions of cyclic shifts are
+    expanders for k >= 2, so convergence matches iid sampling to within a
+    few percent of rounds (tests/test_pool.py pins this).
+    """
+    bits = jax.random.bits(
+        jax.random.fold_in(round_k, _POOL_TAG), (pool_size,), jnp.uint32
+    )
+    return 1 + (bits % jnp.uint32(n - 1)).astype(jnp.int32)
+
+
+def pool_choice(bits: jax.Array, pool_size: int) -> jax.Array:
+    """Per-node pool slot in [0, pool_size) from the shared raw word stream.
+    pool_size is a power of two (SimConfig enforces it), so the low bits are
+    an exact uniform choice — no modulo bias."""
+    return (bits & jnp.uint32(pool_size - 1)).astype(jnp.int32)
+
+
+def targets_pool(choice: jax.Array, offsets: jax.Array, node_ids: jax.Array, n: int) -> jax.Array:
+    """Partner indices implied by (choice, offsets) — used by the sharded
+    runner (which delivers by scatter) and by equivalence tests; the
+    single-device pool path never materializes targets."""
+    shift = offsets[choice]
+    return (node_ids + shift) % n
+
+
 def send_gate(key: jax.Array, n: int, fault_rate: float) -> jax.Array | bool:
     """Per-round fault injection: True where the node is allowed to send this
     round. fault_rate == 0 compiles to a constant (no RNG cost)."""

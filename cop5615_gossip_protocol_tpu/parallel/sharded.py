@@ -111,7 +111,17 @@ def run_sharded(
         gids = start + jnp.arange(n_loc, dtype=jnp.int32)
         if topo.implicit:
             (valid_loc,) = targs
-            targets = sampling.targets_full(bits, gids, n)
+            if cfg.delivery == "pool":
+                # Offset-pool sampling (ops/sampling.pool_offsets) with
+                # scatter delivery: every device derives the same per-round
+                # pool from the replicated round key, so targets match the
+                # single-device pool path; the roll fast path stays
+                # single-device (cross-shard rolls land with the halo work).
+                offs = sampling.pool_offsets(kr, cfg.pool_size, n)
+                choice = sampling.pool_choice(bits, cfg.pool_size)
+                targets = sampling.targets_pool(choice, offs, gids, n)
+            else:
+                targets = sampling.targets_full(bits, gids, n)
             send_ok = valid_loc
         else:
             neighbors_loc, degree_loc, valid_loc = targs
