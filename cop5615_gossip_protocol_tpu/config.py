@@ -229,7 +229,11 @@ class SimConfig:
             return 1e-10
         if self.dtype == "float32":
             return 1e-6
-        return 1e-2  # bfloat16
+        # bfloat16: 8-bit mantissa — ratio ulp near mean (n-1)/2 is coarser
+        # than any tighter threshold. Quality envelope pinned by
+        # tests/test_bfloat16.py: <0.5% rel error on expanders (full,
+        # torus3d); few-percent on slow-mixing grids (documented degraded).
+        return 1e-2
 
     @property
     def resolved_rumor_target(self) -> int:

@@ -1,0 +1,55 @@
+"""Convergence-quality evidence for the bfloat16 dtype option.
+
+bfloat16 has an 8-bit mantissa (~2-3 decimal digits), so the push-sum ratio
+s/w near the true mean (n-1)/2 has an ulp far coarser than float32 — the
+1e-2 default delta (SimConfig.resolved_delta) is what makes termination
+meaningful at that resolution. These tests pin what that policy delivers:
+
+- on expander-like topologies (full, torus3d) the estimate lands within
+  0.5% / 1% relative of the true mean — bf16 is a legitimate fast mode there;
+- on slow-mixing topologies (grid2d) coarse rounding makes the ratio look
+  stable before mixing completes, degrading the estimate to the few-percent
+  range — converges, but documented as degraded.
+
+Measured (CPU, seeds 0-2): full n=1024 rel MAE 0.06-0.12%, torus3d n=512
+0.17-0.35%, grid2d n=400 2.4-4.1%.
+"""
+
+import pytest
+
+from cop5615_gossip_protocol_tpu import SimConfig, build_topology, run
+
+
+def _rel_mae(topo_kind: str, n: int, seed: int) -> tuple[float, object]:
+    cfg = SimConfig(
+        n=n, topology=topo_kind, algorithm="push-sum", dtype="bfloat16",
+        seed=seed, engine="chunked",
+    )
+    topo = build_topology(topo_kind, n)
+    result = run(topo, cfg)
+    assert result.converged, f"{topo_kind} n={n} seed={seed} failed to converge"
+    return result.estimate_mae / result.true_mean, result
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_bf16_full_estimate_quality(seed):
+    rel, result = _rel_mae("full", 1024, seed)
+    assert rel < 0.005, f"bf16 full estimate degraded: rel MAE {rel:.4%}"
+    # Sanity: the 1e-2 delta doesn't stall the run (f32 converges in ~50
+    # rounds here; bf16 should be in the same regime, not 10x).
+    assert result.rounds < 200
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_bf16_torus3d_estimate_quality(seed):
+    rel, _ = _rel_mae("torus3d", 512, seed)
+    assert rel < 0.01, f"bf16 torus3d estimate degraded: rel MAE {rel:.4%}"
+
+
+def test_bf16_grid2d_converges_but_degraded():
+    """Slow-mixing topologies: bf16 ratio stability fires before mixing
+    completes. Pin the documented degradation envelope so a silent regression
+    (either direction) surfaces."""
+    rel, _ = _rel_mae("grid2d", 400, seed=0)
+    assert rel < 0.10  # converges with a usable estimate...
+    assert rel > 0.005  # ...but measurably degraded vs expanders (documented)
