@@ -17,6 +17,7 @@ checkpointing (SURVEY.md §5 "Config / flag system").
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import sys
 import time
@@ -81,6 +82,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--x64", action="store_true", help="enable float64 support")
     p.add_argument("--distributed", action="store_true",
                    help="call jax.distributed.initialize for multi-host meshes")
+    p.add_argument("--profile", type=str, default=None, metavar="DIR",
+                   help="capture a jax.profiler trace of the run into DIR "
+                   "(viewable in TensorBoard/Perfetto; round phases are "
+                   "named_scope-tagged: sample / deliver / absorb)")
     p.add_argument("--jsonl", type=str, default=None,
                    help="append the structured run record to this JSONL file")
     p.add_argument("--checkpoint", type=str, default=None,
@@ -176,10 +181,19 @@ def main(argv: Optional[list[str]] = None) -> int:
             )
             return 2
 
+    # SURVEY.md §5 tracing plan: the trace spans compile + run, and the
+    # in-kernel named_scope tags split per-round cost into sample / deliver /
+    # absorb when viewed in TensorBoard/Perfetto.
+    trace_ctx = (
+        jax.profiler.trace(args.profile) if args.profile
+        else contextlib.nullcontext()
+    )
     try:
-        result = run(
-            topo, cfg, on_chunk=on_chunk, start_state=start_state, start_round=start_round
-        )
+        with trace_ctx:
+            result = run(
+                topo, cfg, on_chunk=on_chunk,
+                start_state=start_state, start_round=start_round,
+            )
     except (ValueError, NotImplementedError) as e:
         print(f"Invalid: {e}", file=sys.stderr)
         return 2

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 from typing import NamedTuple
 
+import jax
 import jax.numpy as jnp
 
 from ..ops.delivery import deliver
@@ -70,7 +71,12 @@ def round_from_targets(
 ) -> GossipState:
     if deliver_fn is None:
         deliver_fn = lambda v, t: deliver(v, t, pop)  # noqa: E731
-    conv_of_target = state.conv[targets] if suppress else False
-    vals = send_values(state, targets, send_ok, suppress, conv_of_target)
-    inbox = deliver_fn(vals, targets)
-    return absorb(state, inbox, rumor_target)
+    # named_scope tags flow into profiler traces (cli --profile) so per-round
+    # cost splits into send / deliver / absorb (SURVEY.md §5 tracing plan).
+    with jax.named_scope("gossip_send"):
+        conv_of_target = state.conv[targets] if suppress else False
+        vals = send_values(state, targets, send_ok, suppress, conv_of_target)
+    with jax.named_scope("gossip_deliver"):
+        inbox = deliver_fn(vals, targets)
+    with jax.named_scope("gossip_absorb"):
+        return absorb(state, inbox, rumor_target)

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 from typing import NamedTuple
 
+import jax
 import jax.numpy as jnp
 
 from ..ops.delivery import deliver
@@ -97,7 +98,12 @@ def round_from_targets(
     """
     if deliver_fn is None:
         deliver_fn = lambda v, t: deliver(v, t, pop)  # noqa: E731
-    s_send, w_send, s_keep, w_keep = halve_and_send(state.s, state.w, send_ok)
-    inbox_s = deliver_fn(s_send, targets)
-    inbox_w = deliver_fn(w_send, targets)
-    return absorb(state, s_keep, w_keep, inbox_s, inbox_w, delta, term_rounds)
+    # named_scope tags flow into profiler traces (cli --profile) so per-round
+    # cost splits into halve / deliver / absorb (SURVEY.md §5 tracing plan).
+    with jax.named_scope("pushsum_halve"):
+        s_send, w_send, s_keep, w_keep = halve_and_send(state.s, state.w, send_ok)
+    with jax.named_scope("pushsum_deliver"):
+        inbox_s = deliver_fn(s_send, targets)
+        inbox_w = deliver_fn(w_send, targets)
+    with jax.named_scope("pushsum_absorb"):
+        return absorb(state, s_keep, w_keep, inbox_s, inbox_w, delta, term_rounds)
