@@ -1,0 +1,61 @@
+"""The reference's published benchmark record as data.
+
+Transcribed from report.pdf p.4-5 (digested in BASELINE.md) — the only
+numbers the reference ever published. Hardware unspecified (personal Windows
+machine, .NET Core 3.1, Akka.NET 1.4.25, single process); metric is
+wall-clock convergence time in ms as printed by the parent actor
+(program.fs:51-52, 58-59), timed from protocol kickoff to the N-th
+convergence report.
+
+Topology names use the reference CLI spellings (program.fs:150):
+line / full / 2D / Imp3D.
+"""
+
+from __future__ import annotations
+
+GRID_N = (20, 100, 200, 300, 400, 500, 600, 700, 800, 900, 1000)
+
+REF_TOPOLOGIES = ("line", "full", "2D", "Imp3D")
+
+# report.pdf p.4 — gossip convergence time (ms). The Imp3D value at N=1000
+# duplicates the 2D cell and contradicts the Imp3D trend (~500 ms); kept
+# verbatim, flagged in BASELINE.md as a likely report typo.
+AKKA_GOSSIP_MS = {
+    "line": dict(zip(GRID_N, (20.68, 129.49, 436.40, 875.73, 1992.27, 2618.29,
+                              3214.54, 7548.45, 5522.17, 6626.31, 7322.90))),
+    "full": dict(zip(GRID_N, (18.97, 27.61, 152.29, 150.24, 212.32, 267.38,
+                              367.72, 522.16, 1553.60, 828.07, 1167.20))),
+    "2D": dict(zip(GRID_N, (20.11, 116.36, 860.62, 1063.35, 1092.14, 3226.73,
+                            4851.94, 5207.95, 9621.80, 12614.34, 12203.49))),
+    "Imp3D": dict(zip(GRID_N, (30.04, 33.91, 27.16, 153.85, 130.73, 124.69,
+                               271.62, 261.95, 547.16, 519.38, 12203.49))),
+}
+
+# report.pdf p.5 — push-sum convergence time (ms).
+AKKA_PUSHSUM_MS = {
+    "line": dict(zip(GRID_N, (74.78, 2717.23, 8695.51, 15517.12, 13251.76,
+                              14271.60, 38139.77, 26987.17, 54484.09,
+                              32632.50, 147447.74))),
+    "full": dict(zip(GRID_N, (19.83, 25.84, 46.13, 105.55, 85.54, 112.69,
+                              148.56, 130.43, 151.46, 261.58, 418.63))),
+    "2D": dict(zip(GRID_N, (134.88, 1360.50, 15806.46, 11654.63, 23125.06,
+                            33201.60, 89039.30, 58778.68, 89820.94, 4738.33,
+                            26818.37))),
+    "Imp3D": dict(zip(GRID_N, (27.06, 140.76, 119.85, 128.65, 232.29, 174.68,
+                               302.16, 286.17, 531.63, 434.52, 541.43))),
+}
+
+# report.pdf p.3 §4 — largest network size the reference handled.
+AKKA_MAX_N = {
+    ("full", "gossip"): 2000, ("full", "push-sum"): 2000,
+    ("2D", "gossip"): 1100, ("2D", "push-sum"): 1000,
+    ("line", "gossip"): 1200, ("line", "push-sum"): 1000,
+    ("Imp3D", "gossip"): 2000, ("Imp3D", "push-sum"): 2000,
+}
+
+
+def akka_ms(topology: str, algorithm: str, n: int) -> float | None:
+    """Reference wall-clock for a grid cell, or None if the report has no
+    number for that config."""
+    table = AKKA_GOSSIP_MS if algorithm == "gossip" else AKKA_PUSHSUM_MS
+    return table.get(topology, {}).get(n)
