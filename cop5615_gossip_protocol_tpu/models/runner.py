@@ -141,20 +141,21 @@ def make_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array):
 
     def targets_and_gate(round_idx, *targs):
         # ids generated inside the trace (lax.iota) — never a baked constant.
-        ids = jnp.arange(n, dtype=jnp.int32)
-        kr = sampling.round_key(base_key, round_idx)
-        bits = sampling.uniform_bits(kr, n)
-        if topo.implicit:
-            targets = sampling.targets_full(bits, ids, n)
-            send_ok = jnp.ones((n,), bool)
-        else:
-            neighbors, degree = targs
-            targets = sampling.targets_explicit(bits, neighbors, degree)
-            send_ok = degree > 0
-        gate = sampling.send_gate(kr, n, cfg.fault_rate)
-        if gate is not True:
-            send_ok = send_ok & gate
-        return targets, send_ok
+        with jax.named_scope("sample"):
+            ids = jnp.arange(n, dtype=jnp.int32)
+            kr = sampling.round_key(base_key, round_idx)
+            bits = sampling.uniform_bits(kr, n)
+            if topo.implicit:
+                targets = sampling.targets_full(bits, ids, n)
+                send_ok = jnp.ones((n,), bool)
+            else:
+                neighbors, degree = targs
+                targets = sampling.targets_explicit(bits, neighbors, degree)
+                send_ok = degree > 0
+            gate = sampling.send_gate(kr, n, cfg.fault_rate)
+            if gate is not True:
+                send_ok = send_ok & gate
+            return targets, send_ok
 
     if cfg.algorithm == "push-sum":
         state0 = pushsum_mod.init_state(n, dtype, cfg.initial_term_round)
@@ -195,13 +196,14 @@ def _make_pool_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array, dty
     K = cfg.pool_size
 
     def pool_parts(round_idx):
-        kr = sampling.round_key(base_key, round_idx)
-        bits = sampling.uniform_bits(kr, n)
-        offs = sampling.pool_offsets(kr, K, n)
-        choice = sampling.pool_choice(bits, K)
-        gate = sampling.send_gate(kr, n, cfg.fault_rate)
-        send_ok = jnp.ones((n,), bool) if gate is True else gate
-        return choice, offs, send_ok
+        with jax.named_scope("sample"):
+            kr = sampling.round_key(base_key, round_idx)
+            bits = sampling.uniform_bits(kr, n)
+            offs = sampling.pool_offsets(kr, K, n)
+            choice = sampling.pool_choice(bits, K)
+            gate = sampling.send_gate(kr, n, cfg.fault_rate)
+            send_ok = jnp.ones((n,), bool) if gate is True else gate
+            return choice, offs, send_ok
 
     if cfg.algorithm == "push-sum":
         state0 = pushsum_mod.init_state(n, dtype, cfg.initial_term_round)
@@ -210,15 +212,18 @@ def _make_pool_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array, dty
 
         def round_fn(state, round_idx):
             choice, offs, send_ok = pool_parts(round_idx)
-            s_send, w_send, s_keep, w_keep = pushsum_mod.halve_and_send(
-                state.s, state.w, send_ok
-            )
-            inbox = delivery_mod.deliver_pool(
-                jnp.stack([s_send, w_send]), choice, offs
-            )
-            return pushsum_mod.absorb(
-                state, s_keep, w_keep, inbox[0], inbox[1], delta, term_rounds
-            )
+            with jax.named_scope("pushsum_halve"):
+                s_send, w_send, s_keep, w_keep = pushsum_mod.halve_and_send(
+                    state.s, state.w, send_ok
+                )
+            with jax.named_scope("pushsum_deliver"):
+                inbox = delivery_mod.deliver_pool(
+                    jnp.stack([s_send, w_send]), choice, offs
+                )
+            with jax.named_scope("pushsum_absorb"):
+                return pushsum_mod.absorb(
+                    state, s_keep, w_keep, inbox[0], inbox[1], delta, term_rounds
+                )
 
     else:
         leader = draw_leader(base_key, topo, cfg)
@@ -230,16 +235,19 @@ def _make_pool_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array, dty
 
         def round_fn(state, round_idx):
             choice, offs, send_ok = pool_parts(round_idx)
-            conv_of_target = (
-                delivery_mod.pool_lookup(state.conv, choice, offs)
-                if suppress
-                else False
-            )
-            vals = gossip_mod.send_values(
-                state, None, send_ok, suppress, conv_of_target
-            )
-            inbox = delivery_mod.deliver_pool(vals[None], choice, offs)[0]
-            return gossip_mod.absorb(state, inbox, rumor_target)
+            with jax.named_scope("gossip_send"):
+                conv_of_target = (
+                    delivery_mod.pool_lookup(state.conv, choice, offs)
+                    if suppress
+                    else False
+                )
+                vals = gossip_mod.send_values(
+                    state, None, send_ok, suppress, conv_of_target
+                )
+            with jax.named_scope("gossip_deliver"):
+                inbox = delivery_mod.deliver_pool(vals[None], choice, offs)[0]
+            with jax.named_scope("gossip_absorb"):
+                return gossip_mod.absorb(state, inbox, rumor_target)
 
     return round_fn, state0, ()
 
@@ -410,13 +418,16 @@ def run(
                 "(one message in flight) and cannot be sharded; drop "
                 "n_devices or use batched semantics"
             )
-        if cfg.delivery == "stencil":
-            # Keep the fail-loudly contract on the sharded path too.
+        if cfg.engine == "fused":
             raise ValueError(
-                "delivery='stencil' is not supported with n_devices>1 yet; "
-                "use delivery='auto' (sharded runs deliver via "
-                "scatter + psum_scatter)"
+                "engine='fused' is single-device (the Pallas multi-round "
+                "kernel keeps the whole population in one core's VMEM); "
+                "sharded runs use the chunked collective engine — drop the "
+                "engine override or n_devices"
             )
+        # delivery='stencil' is legal under sharding: the halo-exchange plan
+        # (parallel/halo.py) implements it as local shifts + boundary
+        # ppermutes; run_sharded raises if no exact plan exists.
         from ..parallel.sharded import run_sharded  # circular-import guard
 
         return run_sharded(

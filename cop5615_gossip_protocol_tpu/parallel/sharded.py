@@ -7,21 +7,23 @@ per-node state vectors; one synchronous round is:
 
 1. every device draws the round's full-length random words (bit-identical
    with the single-device runner — see ops/sampling.py) and slices its shard;
-2. local nodes pick global partner indices and scatter their message values
-   into a full-length contribution vector;
-3. one `psum_scatter` (reduce-scatter over the "nodes" axis) simultaneously
-   sums all devices' contributions and hands each device exactly its own
-   shard of the inbox — the entire cross-device "mailbox delivery" is a
-   single XLA collective on ICI;
-4. local absorb/update, then a scalar `psum` of converged counts serves as
+2. local nodes pick global partner indices; delivery is then either
+   **halo exchange** (offset-structured topologies: per displacement class,
+   a local shift plus one `ppermute` of the boundary slice — O(n_loc + halo)
+   per device, parallel/halo.py) or **scatter + psum_scatter** (irregular
+   topologies: scatter into a full-length contribution vector, then one
+   reduce-scatter over the "nodes" axis hands each device its summed inbox
+   shard);
+3. local absorb/update, then a scalar `psum` of converged counts serves as
    the global termination predicate (the ParentActor's count-and-exit,
    program.fs:47-60, as a reduction).
 
 The whole round loop — collectives included — lives inside one jit'd
 `lax.while_loop`, so a chunk of thousands of rounds runs with zero host
 round-trips. Gossip's converged-target suppression (the shared dictionary
-probe, program.fs:92) needs remote reads and becomes an `all_gather` of the
-one-bool-per-node converged vector, only when suppression is enabled.
+probe, program.fs:92) needs remote reads: one backward halo roll per offset
+class on the halo path, an `all_gather` of the one-bool-per-node converged
+vector otherwise — only when suppression is enabled.
 
 Population is padded to a device multiple; padded slots are invalid (never
 send, never targeted, never counted). When n_devices divides n, trajectories
@@ -47,6 +49,7 @@ from ..models import pushsum as pushsum_mod
 from ..models.runner import RunResult, _check_dtype, draw_leader
 from ..ops import sampling
 from ..ops.topology import Topology
+from . import halo as halo_mod
 from .mesh import NODE_AXIS, make_mesh
 
 
@@ -84,6 +87,21 @@ def run_sharded(
 
     shard = NamedSharding(mesh, P(NODE_AXIS))
     repl = NamedSharding(mesh, P())
+
+    # Delivery plan: halo exchange (local shifts + boundary ppermutes —
+    # O(n_loc + halo) per device) for offset-structured topologies, else
+    # scatter into a full-length contrib vector + psum_scatter (O(n_pad)).
+    plan = None
+    if cfg.delivery in ("auto", "stencil") and not topo.implicit:
+        plan = halo_mod.plan_halo(topo, n_dev)
+    if cfg.delivery == "stencil" and plan is None:
+        raise ValueError(
+            "delivery='stencil' under sharding requires an offset-structured "
+            "topology whose halo fits a shard (line/ring/grid2d/ref2d/"
+            "grid3d/torus3d; wrap-edge topologies additionally need the "
+            f"population to divide the mesh) — {topo.kind!r} at n={n} on "
+            f"{n_dev} devices has no exact halo plan; use delivery='auto'"
+        )
 
     def dev_put(host_array, sharding=shard):
         return jax.device_put(jnp.asarray(host_array), sharding)
@@ -130,27 +148,57 @@ def run_sharded(
         gate_full = sampling.send_gate(kr, n_pad, cfg.fault_rate)
         if gate_full is not True:
             send_ok = send_ok & lax.dynamic_slice(gate_full, (start,), (n_loc,))
-        return targets, send_ok, valid_loc
+        return targets, send_ok, valid_loc, gids
 
-    def deliver_sharded(values, targets):
-        """Scatter into a full-length contribution vector, then reduce-scatter
-        so each device receives its own summed inbox shard."""
-        contrib = jnp.zeros((n_pad,), values.dtype).at[targets].add(values)
-        return lax.psum_scatter(
-            contrib, NODE_AXIS, scatter_dimension=0, tiled=True
-        )
+    if plan is not None:
+
+        def deliver_sharded(values, targets, gids):
+            """Halo delivery: per offset class, a local shift plus one
+            ppermute of the boundary slice (parallel/halo.py). ``values``
+            may be [..., n_loc] (stacked channels share the ppermutes).
+            Same static accumulation order as the single-device stencil
+            path — sharded trajectories stay bit-identical."""
+            disp = jnp.remainder(targets - gids, n)
+            return halo_mod.deliver_halo(values, disp, plan, NODE_AXIS)
+
+        def conv_of_target_sharded(conv_loc, targets, gids):
+            disp = jnp.remainder(targets - gids, n)
+            return halo_mod.lookup_halo(conv_loc, disp, plan, NODE_AXIS)
+
+    else:
+
+        def deliver_sharded(values, targets, gids):
+            """Scatter into a full-length contribution vector, then
+            reduce-scatter so each device receives its own summed inbox
+            shard."""
+            contrib = jnp.zeros((n_pad,), values.dtype).at[targets].add(values)
+            return lax.psum_scatter(
+                contrib, NODE_AXIS, scatter_dimension=0, tiled=True
+            )
+
+        def conv_of_target_sharded(conv_loc, targets, gids):
+            conv_full = lax.all_gather(conv_loc, NODE_AXIS, tiled=True)
+            return conv_full[targets]
 
     if cfg.algorithm == "push-sum":
         delta = cfg.resolved_delta
         term_rounds = cfg.term_rounds
 
         def round_fn(state, round_idx, *targs):
-            targets, send_ok, _ = targets_and_gate(round_idx, *targs)
+            targets, send_ok, _, gids = targets_and_gate(round_idx, *targs)
             s_send, w_send, s_keep, w_keep = pushsum_mod.halve_and_send(
                 state.s, state.w, send_ok
             )
-            inbox_s = deliver_sharded(s_send, targets)
-            inbox_w = deliver_sharded(w_send, targets)
+            if plan is not None:
+                # Stack s/w so both channels ride one ppermute per offset
+                # class (halves the per-round collective count).
+                inbox = deliver_sharded(
+                    jnp.stack([s_send, w_send]), targets, gids
+                )
+                inbox_s, inbox_w = inbox[0], inbox[1]
+            else:
+                inbox_s = deliver_sharded(s_send, targets, gids)
+                inbox_w = deliver_sharded(w_send, targets, gids)
             return pushsum_mod.absorb(
                 state, s_keep, w_keep, inbox_s, inbox_w, delta, term_rounds
             )
@@ -180,16 +228,15 @@ def run_sharded(
         )
 
         def round_fn(state, round_idx, *targs):
-            targets, send_ok, _ = targets_and_gate(round_idx, *targs)
+            targets, send_ok, _, gids = targets_and_gate(round_idx, *targs)
             if suppress:
-                conv_full = lax.all_gather(state.conv, NODE_AXIS, tiled=True)
-                conv_of_target = conv_full[targets]
+                conv_of_target = conv_of_target_sharded(state.conv, targets, gids)
             else:
                 conv_of_target = False
             vals = gossip_mod.send_values(
                 state, targets, send_ok, suppress, conv_of_target
             )
-            inbox = deliver_sharded(vals, targets)
+            inbox = deliver_sharded(vals, targets, gids)
             return gossip_mod.absorb(state, inbox, rumor_target)
 
     if start_state is not None:

@@ -172,6 +172,12 @@ def test_fused_rejects_scatter_delivery_and_reference_pushsum():
                       semantics="reference", engine="fused")
     with pytest.raises(ValueError, match="single-walk"):
         run(topo_r, cfg_r)
+    # fused is single-device: an explicit fused request under sharding must
+    # raise, not silently run the chunked collective engine.
+    cfg_s = SimConfig(n=64, topology="line", algorithm="gossip",
+                      engine="fused", n_devices=8)
+    with pytest.raises(ValueError, match="single-device"):
+        run(topo, cfg_s)
 
 
 def test_fused_resume_rejects_non_float32():

@@ -1,0 +1,167 @@
+"""Halo-exchange sharded delivery (parallel/halo.py).
+
+Pins: (a) the host-side plan accepts exactly the topologies it can serve
+exactly; (b) halo_roll is a true global circular roll under shard_map;
+(c) sharded trajectories through the halo path are bit-identical to the
+single-device stencil path; (d) padded populations are exact for non-wrap
+topologies and refused for wrap topologies; (e) delivery='stencil' under
+sharding fails loudly when no exact plan exists.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from cop5615_gossip_protocol_tpu import SimConfig, build_topology, run
+from cop5615_gossip_protocol_tpu.parallel import halo
+from cop5615_gossip_protocol_tpu.parallel.mesh import NODE_AXIS, make_mesh
+from cop5615_gossip_protocol_tpu.parallel.sharded import run_sharded
+
+
+# --- plan_halo ------------------------------------------------------------
+
+
+def test_plan_exists_for_offset_topologies():
+    for kind, n in [("line", 512), ("ring", 512), ("grid2d", 1024),
+                    ("torus3d", 512), ("grid3d", 512)]:
+        topo = build_topology(kind, n)
+        plan = halo.plan_halo(topo, 8)
+        assert plan is not None, kind
+        assert plan.halo_width <= plan.n_loc
+
+
+def test_plan_none_for_irregular_and_implicit():
+    assert halo.plan_halo(build_topology("imp3d", 512), 8) is None
+    assert halo.plan_halo(build_topology("full", 512), 8) is None
+
+
+def test_torus_halo_is_narrow():
+    # Signed offsets turn wrap displacements (mod ~n) into a few lattice
+    # rows: for g=8 (n=512) the widest roll is g^2 = 64, not ~n.
+    topo = build_topology("torus3d", 512)
+    plan = halo.plan_halo(topo, 8)
+    assert plan.halo_width == 64
+
+
+def test_plan_padded_population_wrap_vs_nonwrap():
+    # line has no global-wrap edges: padded population stays exact.
+    assert halo.plan_halo(build_topology("line", 1001), 8) is not None
+    # ring's wrap edge n-1 -> 0 would land in a pad slot: refused.
+    assert halo.plan_halo(build_topology("ring", 1001), 8) is None
+    # ...but an evenly dividing ring population is exact.
+    assert halo.plan_halo(build_topology("ring", 1000), 8) is not None
+
+
+def test_plan_halo_wider_than_shard_refused():
+    # grid2d side ~ sqrt(n): at n=64 (side 8, halo 8) over 8 devices
+    # n_loc = 8, so the plan just fits; over 16 devices it would not —
+    # emulate by asking for more devices than lanes per shard.
+    topo = build_topology("grid2d", 64)
+    assert halo.plan_halo(topo, 8) is not None
+    assert halo.plan_halo(topo, 16) is None
+
+
+# --- halo_roll ------------------------------------------------------------
+
+
+@pytest.mark.parametrize("s", [1, -1, 7, -7, 64, -64])
+def test_halo_roll_is_global_circular_roll(s):
+    n = 512
+    mesh = make_mesh(8)
+    x = np.arange(n, dtype=np.float32)
+
+    def f(x_loc):
+        return halo.halo_roll(x_loc, s, NODE_AXIS, 8)
+
+    rolled = jax.jit(
+        jax.shard_map(f, mesh=mesh, in_specs=P(NODE_AXIS), out_specs=P(NODE_AXIS))
+    )(x)
+    np.testing.assert_array_equal(np.asarray(rolled), np.roll(x, s))
+
+
+def test_halo_roll_single_device():
+    x = jnp.arange(16.0)
+    np.testing.assert_array_equal(
+        np.asarray(halo.halo_roll(x, 3, NODE_AXIS, 1)), np.roll(np.arange(16.0), 3)
+    )
+
+
+# --- end-to-end bit-identity ---------------------------------------------
+
+
+@pytest.mark.parametrize("kind,n", [("torus3d", 512), ("line", 1001), ("grid2d", 1024)])
+def test_gossip_halo_matches_single_device_bitwise(kind, n):
+    cfg = SimConfig(n=n, topology=kind, algorithm="gossip", seed=5)
+    topo = build_topology(kind, n, seed=5)
+    assert halo.plan_halo(topo, 8) is not None  # the path under test
+    r1 = run(topo, cfg)
+    r8 = run_sharded(topo, cfg, mesh=make_mesh(8))
+    assert r8.rounds == r1.rounds
+    assert r8.converged_count == r1.converged_count
+    assert r8.converged and r1.converged
+
+
+def test_pushsum_halo_matches_single_device_bitwise():
+    # Same static accumulation order as the single-device stencil path →
+    # float trajectories are bitwise identical, not merely close.
+    n = 512
+    cfg = SimConfig(n=n, topology="torus3d", algorithm="push-sum",
+                    dtype="float32", max_rounds=50_000)
+    topo = build_topology("torus3d", n)
+
+    final = {}
+
+    def grab(tag):
+        def on_chunk(rounds, state):
+            final[tag] = state
+        return on_chunk
+
+    r1 = run(topo, cfg, on_chunk=grab("single"))
+    r8 = run_sharded(topo, cfg, mesh=make_mesh(8), on_chunk=grab("sharded"))
+    assert r8.rounds == r1.rounds
+    np.testing.assert_array_equal(
+        np.asarray(final["single"].s), np.asarray(final["sharded"].s)[:n]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(final["single"].w), np.asarray(final["sharded"].w)[:n]
+    )
+
+
+def test_sharded_suppression_halo_path_bitwise():
+    # Reference-semantics gossip on a halo topology: the converged-target
+    # probe goes through lookup_halo (backward rolls), not all_gather.
+    n = 511  # population 512 after the Q1 extra actor → divides 8 devices
+    cfg = SimConfig(n=n, topology="line", algorithm="gossip",
+                    semantics="reference", seed=2)
+    topo = build_topology("line", n, semantics="reference")
+    assert halo.plan_halo(topo, 8) is not None
+    r1 = run(topo, cfg)
+    r8 = run_sharded(topo, cfg, mesh=make_mesh(8))
+    assert r8.rounds == r1.rounds
+    assert r8.converged_count == r1.converged_count
+
+
+# --- fail-loudly + fallback ----------------------------------------------
+
+
+def test_sharded_stencil_request_fails_loudly_without_plan():
+    topo = build_topology("imp3d", 512)
+    cfg = SimConfig(n=512, topology="imp3d", algorithm="gossip",
+                    delivery="stencil", n_devices=8)
+    with pytest.raises(ValueError, match="halo"):
+        run(topo, cfg)
+
+
+def test_ring_padded_auto_falls_back_to_scatter():
+    # No exact halo plan (wrap edges + padding) → auto silently uses the
+    # scatter + psum_scatter path and still converges on real nodes only.
+    n = 1001
+    cfg = SimConfig(n=n, topology="ring", algorithm="gossip", seed=1)
+    topo = build_topology("ring", n)
+    assert halo.plan_halo(topo, 8) is None
+    r8 = run_sharded(topo, cfg, mesh=make_mesh(8))
+    r1 = run(topo, cfg)
+    assert r8.converged
+    assert r8.rounds == r1.rounds  # scatter path is also stream-identical

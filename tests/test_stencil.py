@@ -116,13 +116,18 @@ def test_stencil_on_unstructured_topology_raises():
         run(build_topology("full", 64), cfg)
 
 
-def test_stencil_rejected_on_sharded_and_walk_paths():
-    # The fail-loudly contract must hold on run()'s early-exit paths too.
+def test_stencil_sharded_and_walk_paths():
+    # Sharded stencil is now served by the halo-exchange plan
+    # (parallel/halo.py) — explicit delivery='stencil' under n_devices>1
+    # runs and matches the single-device trajectory.
     topo = build_topology("line", 64)
     cfg = SimConfig(n=64, topology="line", algorithm="gossip",
                     delivery="stencil", n_devices=2)
-    with pytest.raises(ValueError, match="n_devices"):
-        run(topo, cfg)
+    r2 = run(topo, cfg)
+    r1 = run(topo, SimConfig(n=64, topology="line", algorithm="gossip",
+                             delivery="stencil"))
+    assert r2.converged and r2.rounds == r1.rounds
+    # The fail-loudly contract still holds on the single-walk early exit.
     topo_ref = build_topology("line", 16, semantics="reference")
     cfg = SimConfig(n=16, topology="line", algorithm="push-sum", dtype="float64",
                     semantics="reference", delivery="stencil", max_rounds=100)
