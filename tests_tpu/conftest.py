@@ -1,0 +1,35 @@
+"""TPU-gated suite bootstrap.
+
+Unlike tests/conftest.py, this file does NOT force the CPU platform: the
+whole point of this suite is the compiled (interpret=False) Pallas path,
+which only exists on a real TPU backend. Every test is skipped when the
+default backend is not TPU, so `pytest tests_tpu` is safe to run anywhere.
+
+x64 is left OFF (TPU has no native f64); push-sum configs below rely on the
+float32 rescaled delta policy (SimConfig.resolved_delta).
+"""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import jax  # noqa: E402
+
+
+def pytest_collection_modifyitems(config, items):
+    # This hook is session-scoped even in a subdirectory conftest: a bare
+    # `pytest` from the repo root hands it tests/ items too, so the skip
+    # must be limited to this suite's own items.
+    if jax.default_backend() == "tpu":
+        return
+    here = Path(__file__).resolve().parent
+    skip = pytest.mark.skip(
+        reason="compiled Pallas path requires a real TPU backend "
+        f"(default_backend={jax.default_backend()!r})"
+    )
+    for item in items:
+        if here in Path(str(item.path)).resolve().parents:
+            item.add_marker(skip)

@@ -1,0 +1,125 @@
+"""Compiled (interpret=False) Pallas fused engine on a real TPU chip.
+
+tests/test_fused.py exercises ops/fused.py in interpret mode on CPU only
+(tests/conftest.py forces the cpu platform). `_flat_roll` has an explicit
+interpret-mode fork, so the `pltpu.roll` sublane+lane decomposition the
+hardware kernel relies on is untouched by that suite. This suite is the
+hardware evidence: the compiled kernel — wraparound rolls included — must
+reproduce the chunked XLA engine's trajectories on the chip.
+
+Oracles mirror tests/test_fused.py:
+- gossip: integer state, bit-identical — rounds, converged count, AND the
+  full final state arrays (count/active/conv) captured at the last chunk
+  boundary must match elementwise;
+- push-sum: same f32 op order on both paths → rounds must agree exactly at
+  these scales, estimates to ~1e-3;
+- resume from a fused chunk-boundary snapshot lands on the full run's exact
+  trajectory;
+- engine='auto' on TPU must actually select the compiled fused path for an
+  eligible config (the default-user route).
+
+Run on a chip: python -m pytest tests_tpu -q
+Latest recorded run: tests_tpu/RUNLOG.md
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cop5615_gossip_protocol_tpu import SimConfig, build_topology
+from cop5615_gossip_protocol_tpu.models.runner import run
+
+
+def _run_with_final_state(topo, cfg):
+    snaps = []
+    res = run(topo, cfg, on_chunk=lambda r, s: snaps.append((r, s)))
+    assert snaps, "on_chunk must fire at least once"
+    return res, snaps[-1][1]
+
+
+def _assert_states_bitwise(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb) > 0
+    for av, bv in zip(la, lb):
+        assert (np.asarray(av) == np.asarray(bv)).all()
+
+
+@pytest.mark.parametrize(
+    "kind,n",
+    [
+        ("torus3d", 4096),  # 16^3, %128==0: wraparound rolls on hardware
+        ("ring", 1280),     # 1-D wraparound
+        ("line", 144),      # padded non-wrap layout
+        ("grid2d", 4096),   # 64x64, in-bounds displacements
+    ],
+)
+def test_compiled_gossip_matches_chunked_bitwise(kind, n):
+    results = {}
+    for engine in ["chunked", "fused"]:
+        cfg = SimConfig(n=n, topology=kind, algorithm="gossip", engine=engine,
+                        max_rounds=20000, chunk_rounds=64)
+        results[engine] = _run_with_final_state(build_topology(kind, n), cfg)
+    (ra, sa), (rb, sb) = results["chunked"], results["fused"]
+    assert ra.converged and rb.converged
+    assert ra.rounds == rb.rounds
+    assert ra.converged_count == rb.converged_count
+    _assert_states_bitwise(sa, sb)
+
+
+@pytest.mark.parametrize(
+    "kind,n",
+    [
+        ("torus3d", 4096),
+        ("ring", 1280),
+        ("grid2d", 1024),  # 32x32
+    ],
+)
+def test_compiled_pushsum_matches_chunked(kind, n):
+    results = {}
+    for engine in ["chunked", "fused"]:
+        cfg = SimConfig(n=n, topology=kind, algorithm="push-sum",
+                        dtype="float32", engine=engine,
+                        max_rounds=100_000, chunk_rounds=256)
+        results[engine] = run(build_topology(kind, n), cfg)
+    a, b = results["chunked"], results["fused"]
+    assert a.converged and b.converged
+    assert a.rounds == b.rounds
+    assert abs(a.estimate_mae - b.estimate_mae) < 1e-3
+
+
+def test_compiled_fused_resume_midway():
+    n = 4096
+    cfg = SimConfig(n=n, topology="torus3d", algorithm="gossip",
+                    engine="fused", max_rounds=20000, chunk_rounds=32)
+    topo = build_topology("torus3d", n)
+    snaps = []
+    full = run(topo, cfg, on_chunk=lambda r, s: snaps.append((r, s)))
+    assert len(snaps) >= 2
+    r0, s0 = snaps[0]
+    resumed = run(topo, cfg, start_state=jax.tree.map(jnp.asarray, s0),
+                  start_round=r0)
+    assert resumed.rounds == full.rounds
+    assert resumed.converged_count == full.converged_count
+
+
+def test_auto_engine_selects_compiled_fused(monkeypatch):
+    # The default-user path: engine='auto' on TPU must route an eligible
+    # config through _run_fused with interpret=False.
+    from cop5615_gossip_protocol_tpu.models import runner as runner_mod
+
+    seen = {}
+    real = runner_mod._run_fused
+
+    def spy(topo, cfg, key, on_chunk, start_state, start_round, interpret):
+        seen["interpret"] = interpret
+        return real(topo, cfg, key, on_chunk, start_state, start_round, interpret)
+
+    monkeypatch.setattr(runner_mod, "_run_fused", spy)
+    n = 1024
+    cfg = SimConfig(n=n, topology="grid2d", algorithm="gossip",
+                    max_rounds=20000, chunk_rounds=64)
+    res = run(build_topology("grid2d", n), cfg)
+    assert res.converged
+    assert seen == {"interpret": False}
