@@ -198,9 +198,11 @@ def _make_pool_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array, dty
     def pool_parts(round_idx):
         with jax.named_scope("sample"):
             kr = sampling.round_key(base_key, round_idx)
-            bits = sampling.uniform_bits(kr, n)
             offs = sampling.pool_offsets(kr, K, n)
-            choice = sampling.pool_choice(bits, K)
+            # Packed draw: one threefry word per 8 nodes instead of one per
+            # node — a choice consumes 4 bits, not 32 (sampling.py). Stream-
+            # identical to the fused pool kernel's in-kernel draw.
+            choice = sampling.pool_choice_packed(kr, n, K)
             gate = sampling.send_gate(kr, n, cfg.fault_rate)
             send_ok = jnp.ones((n,), bool) if gate is True else gate
             return choice, offs, send_ok
@@ -312,16 +314,34 @@ def _run_fused(
     start_state,
     start_round: int,
     interpret: bool,
+    pool: bool = False,
 ) -> RunResult:
-    """Chunk loop over the Pallas multi-round engine (ops/fused.py): one
-    kernel launch per cfg.chunk_rounds rounds, state resident in VMEM for
-    the whole chunk."""
+    """Chunk loop over a Pallas multi-round engine: one kernel launch per
+    cfg.chunk_rounds rounds, state resident in VMEM for the whole chunk.
+    ``pool=False`` drives the stencil engine (ops/fused.py, explicit
+    offset-structured topologies); ``pool=True`` the implicit-full pool
+    engine (ops/fused_pool.py), whose chunks additionally consume the
+    per-round displacement pools."""
     from ..ops import fused
+    from ..ops import fused_pool
 
     target = cfg.resolved_target_count(topo.n, topo.target_count)
-    layout_fill: dict
+    if pool:
+        make_pushsum = fused_pool.make_pushsum_pool_chunk
+        make_gossip = fused_pool.make_gossip_pool_chunk
+
+        def extra_args(start, count):
+            return (fused_pool.round_offsets(key, start, count, cfg.pool_size, topo.n),)
+
+    else:
+        make_pushsum = fused.make_pushsum_chunk
+        make_gossip = fused.make_gossip_chunk
+
+        def extra_args(start, count):
+            return ()
+
     if cfg.algorithm == "push-sum":
-        chunk_fn, layout = fused.make_pushsum_chunk(topo, cfg, interpret=interpret)
+        chunk_fn, layout = make_pushsum(topo, cfg, interpret=interpret)
         if start_state is not None and jnp.asarray(start_state.s).dtype != jnp.float32:
             # Mirror the strict config-match check at resume (cli.py): a
             # float64 checkpoint silently downcast to the float32-only fused
@@ -346,7 +366,7 @@ def _run_fused(
             return pushsum_mod.PushSumState(s=s, w=w, term=t, conv=c != 0)
 
     else:
-        chunk_fn, layout = fused.make_gossip_chunk(topo, cfg, interpret=interpret)
+        chunk_fn, layout = make_gossip(topo, cfg, interpret=interpret)
         st = start_state or gossip_mod.init_state(
             topo.n,
             draw_leader(key, topo, cfg),
@@ -362,13 +382,20 @@ def _run_fused(
             cnt, act, cv = (x.reshape(-1)[: topo.n] for x in state_dev)
             return gossip_mod.GossipState(count=cnt, active=act != 0, conv=cv != 0)
 
-    chunk_j = jax.jit(chunk_fn, static_argnums=())
     K = cfg.chunk_rounds
 
+    def chunk_call(state_dev, start, cap):
+        # Keys/offsets are derived INSIDE the jit: per-chunk eager fold_in
+        # vmaps cost ~120 ms/chunk over a remote-device tunnel, dwarfing the
+        # ~30 ms kernel launch they feed.
+        keys = fused.round_keys(key, start, K)
+        return chunk_fn(state_dev, keys, *extra_args(start, K), start, cap)
+
+    chunk_j = jax.jit(chunk_call)
+
     t0 = time.perf_counter()
-    keys0 = fused.round_keys(key, start_round, K)
     warm = jax.block_until_ready(
-        chunk_j(state_dev, keys0, jnp.int32(start_round), jnp.int32(start_round))
+        chunk_j(state_dev, jnp.int32(start_round), jnp.int32(start_round))
     )
     del warm  # cap == start: executes zero rounds, state untouched
     compile_s = time.perf_counter() - t0
@@ -376,9 +403,8 @@ def _run_fused(
     rounds = start_round
     t1 = time.perf_counter()
     while True:
-        keys = fused.round_keys(key, rounds, K)
         state_dev, executed = chunk_j(
-            state_dev, keys, jnp.int32(rounds), jnp.int32(cfg.max_rounds)
+            state_dev, jnp.int32(rounds), jnp.int32(cfg.max_rounds)
         )
         executed = int(executed)  # host sync at the chunk boundary
         rounds += executed
@@ -456,11 +482,23 @@ def run(
         return _run_reference_walk(topo, cfg, key, target)
 
     if cfg.engine != "chunked":
-        from ..ops import fused
+        # Two Pallas engines share one dispatch: the pool engine for pool
+        # delivery on the implicit full topology (ops/fused_pool.py — the
+        # flagship benchmark path, ~2.7x the chunked pool round on v5e),
+        # the stencil engine otherwise (ops/fused.py).
+        pool = cfg.delivery == "pool"
+        if pool:
+            from ..ops import fused_pool
 
-        reason = fused.fused_support(topo, cfg)
+            reason = fused_pool.pool_fused_support(topo, cfg)
+            auto_ok = reason is None
+        else:
+            from ..ops import fused
+
+            reason = fused.fused_support(topo, cfg)
+            auto_ok = reason is None and cfg.delivery == "auto"
         if cfg.engine == "fused":
-            if cfg.delivery == "scatter":
+            if not pool and cfg.delivery == "scatter":
                 raise ValueError(
                     "engine='fused' delivers via the stencil formulation "
                     "only; delivery='scatter' would be silently ignored — "
@@ -471,13 +509,14 @@ def run(
             # Explicit fused runs everywhere: interpreted off-TPU (tests).
             return _run_fused(
                 topo, cfg, key, on_chunk, start_state, start_round,
-                interpret=jax.default_backend() != "tpu",
+                interpret=jax.default_backend() != "tpu", pool=pool,
             )
-        # auto: compiled fused path on TPU only — interpret mode would make
-        # CPU runs slower, and the chunked XLA path is already fast there.
-        if reason is None and cfg.delivery == "auto" and jax.default_backend() == "tpu":
+        # auto: compiled engines on TPU only — interpret mode would make CPU
+        # runs slower, and the chunked XLA path is already fast there.
+        if auto_ok and jax.default_backend() == "tpu":
             return _run_fused(
-                topo, cfg, key, on_chunk, start_state, start_round, interpret=False
+                topo, cfg, key, on_chunk, start_state, start_round,
+                interpret=False, pool=pool,
             )
 
     round_fn, state0, topo_args = make_round_fn(topo, cfg, key)

@@ -129,14 +129,18 @@ def _threefry_rounds(x0, x1, rots):
     return x0, x1
 
 
-def threefry_bits_2d(k1, k2, rows: int, cols: int):
-    """uint32 [rows, cols] == jax.random.bits(key, (rows*cols,), uint32)
-    reshaped — the default partitionable threefry hashes counter element i
-    as threefry2x32(key, (hi32(i), lo32(i))) and xors the two outputs, so
-    each position is independent (prefix/padding invariant).
+def threefry_bits_2d(k1, k2, rows: int, cols: int, row0=0):
+    """uint32 [rows, cols] == rows [row0, row0+rows) of
+    jax.random.bits(key, ((row0+rows)*cols,), uint32) reshaped — the default
+    partitionable threefry hashes counter element i as
+    threefry2x32(key, (hi32(i), lo32(i))) and xors the two outputs, so each
+    position is independent (prefix/padding invariant). ``row0`` may be a
+    traced scalar — the fused pool kernel (ops/fused_pool.py) generates each
+    tile's words at its global position.
     """
     i = (
-        jax.lax.broadcasted_iota(jnp.uint32, (rows, cols), 0) * jnp.uint32(cols)
+        (jax.lax.broadcasted_iota(jnp.uint32, (rows, cols), 0)
+         + jnp.asarray(row0, jnp.uint32)) * jnp.uint32(cols)
         + jax.lax.broadcasted_iota(jnp.uint32, (rows, cols), 1)
     )
     ks0 = k1
@@ -523,10 +527,13 @@ def make_gossip_chunk(topo: Topology, cfg: SimConfig, *, interpret: bool = False
     return chunk_fn, layout
 
 
-def round_keys(base_key: jax.Array, start: int, count: int) -> jax.Array:
+def round_keys(base_key: jax.Array, start, count: int) -> jax.Array:
     """uint32 [count, 2] fold_in keys for absolute rounds start..start+count,
-    matching ops/sampling.round_key exactly (same fold_in stream)."""
-    rounds = jnp.arange(start, start + count, dtype=jnp.int32)
+    matching ops/sampling.round_key exactly (same fold_in stream). ``start``
+    may be traced — the runner computes each chunk's keys inside the jitted
+    chunk call (unjitted, the eager vmap costs ~120 ms/chunk over a remote
+    device tunnel)."""
+    rounds = jnp.int32(start) + jnp.arange(count, dtype=jnp.int32)
     folded = jax.vmap(lambda r: jax.random.fold_in(base_key, r))(rounds)
     if folded.dtype == jnp.uint32:
         return folded

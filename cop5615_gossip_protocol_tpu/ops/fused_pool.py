@@ -1,0 +1,574 @@
+"""Fused multi-round Pallas engine for the implicit full topology (pool mode).
+
+The flagship benchmark — 1M-node push-sum on `full` (BASELINE.json) — runs
+offset-pool delivery (ops/sampling.pool_offsets). Its XLA round is
+HBM-streaming: the threefry draw, the masked dynamic rolls of
+ops/delivery.deliver_pool, and the absorb each traverse the full [n] state
+through HBM (~600 us/round at 1M nodes on v5e, far under the chip's
+bandwidth roofline). This engine runs a whole chunk of K rounds in ONE
+`pallas_call` with all state VMEM-resident, replacing HBM traffic with
+VMEM-tile work (~225 us/round measured at 1M on v5e):
+
+- state (s, w, term, conv — or gossip count/active/conv) lives in VMEM
+  scratch planes across grid steps; HBM is touched twice per launch (DMA in
+  at round 0, DMA out at the last grid step);
+- per-node random pool choices are *packed*: one threefry word per 8 nodes,
+  4 bits each (ops/sampling.pool_choice_packed documents the scheme and the
+  XLA mirror that keeps both engines stream-compatible);
+- delivery reuses the pool formulation — the inbox is pool_size masked
+  circular rolls of the halved sends — but the roll is executed as a tiled
+  gather: sends/choices are stored into *doubled* [2*rows, 128] planes
+  (plane repeated twice along rows) so a roll by any displacement becomes a
+  static-size tile load at a dynamic row offset plus a dynamic lane rotate;
+  the mod-n wraparound over the padded tail is a second such gather blended
+  in below flat index d (`deliver_pool` on a padded 2-D layout, exact);
+- convergence is checked every round in-kernel; once the target count is
+  reached the remaining grid steps are no-ops and the executed-round count
+  returns in SMEM metadata.
+
+Trajectories match the chunked XLA pool path bit-for-bit for integer state
+(gossip) and up to compiler float reassociation for push-sum — the same
+contract as ops/fused.py vs the stencil path (tests/test_fused_pool.py in
+interpret mode; tests_tpu/ on hardware).
+
+Reference mapping: this kernel executes SURVEY.md §3.2/§3.3's hot loop for
+the `full` wiring (program.fs:191-225) — neighbor sampling (program.fs:91),
+message delivery (program.fs:93, 142-143), and the ParentActor convergence
+count (program.fs:47-60) — as one resident-state TPU program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..config import SimConfig
+from .fused import threefry_bits_2d
+from .sampling import (
+    POOL_CHOICE_BITS,
+    POOL_PACK,
+    POOL_TILE_ROWS,
+    pool_offsets,
+    pool_rows,
+)
+from .topology import Topology
+
+LANES = 128
+TILE = POOL_TILE_ROWS  # rows per in-kernel tile; layouts are tile multiples
+# VMEM plane budget: push-sum needs 4 state planes + 3 doubled send planes
+# = 40 bytes/node; 2**21 nodes ~ 84 MB, inside the v5e core's ~128 MB VMEM.
+MAX_POOL_NODES = 2**21
+
+
+@dataclasses.dataclass(frozen=True)
+class PoolLayout:
+    n: int
+    n_pad: int
+    rows: int
+    tiles: int
+
+
+def build_pool_layout(n: int) -> PoolLayout:
+    rows = pool_rows(n)  # tile multiple; fixes the packed-choice geometry too
+    return PoolLayout(n=n, n_pad=rows * LANES, rows=rows, tiles=rows // TILE)
+
+
+def pool_fused_support(topo: Topology, cfg: SimConfig) -> Optional[str]:
+    """None if the fused pool engine can run this config, else the reason."""
+    if not topo.implicit:
+        return "pool delivery (and its fused engine) is full-topology only"
+    if cfg.dtype != "float32":
+        return "fused pool engine supports float32 only"
+    if not jax.config.jax_threefry_partitionable:
+        return (
+            "requires jax_threefry_partitionable=True (the in-kernel "
+            "threefry replicates the partitionable stream only)"
+        )
+    if cfg.fault_rate > 0:
+        return "fault injection not supported in the fused pool kernel"
+    if cfg.n_devices is not None and cfg.n_devices > 1:
+        return "fused pool engine is single-device"
+    if cfg.pool_size > 1 << POOL_CHOICE_BITS:
+        return (
+            f"pool_size {cfg.pool_size} exceeds the packed-choice limit "
+            f"{1 << POOL_CHOICE_BITS}"
+        )
+    if topo.n > MAX_POOL_NODES:
+        return f"population {topo.n} exceeds VMEM-resident limit {MAX_POOL_NODES}"
+    return None
+
+
+def round_offsets(
+    base_key: jax.Array, start, count: int, pool_size: int, n: int
+) -> jax.Array:
+    """int32 [count, pool_size] per-round displacement pools for absolute
+    rounds start..start+count — exactly ops/sampling.pool_offsets applied to
+    each round's fold_in key, so the kernel consumes the same pools as the
+    chunked XLA path. ``start`` may be traced (see fused.round_keys)."""
+    rounds = jnp.int32(start) + jnp.arange(count, dtype=jnp.int32)
+
+    def one(r):
+        return pool_offsets(jax.random.fold_in(base_key, r), pool_size, n)
+
+    return jax.vmap(one)(rounds)
+
+
+# ---------------------------------------------------------------------------
+# In-kernel helpers.
+# ---------------------------------------------------------------------------
+
+
+def _lane_roll(x, r, interpret: bool):
+    """Dynamic circular roll along the 128-lane axis."""
+    if interpret:  # pltpu.roll has no interpret-mode lowering
+        return jnp.roll(x, r, axis=1)
+    return pltpu.roll(x, r, 1)
+
+
+def _iota2(shape, axis):
+    return jax.lax.broadcasted_iota(jnp.int32, shape, axis)
+
+
+def _choice_tile(k1, k2, t, pool_size: int):
+    """[TILE, 128] packed pool choices for tile t — the kernel-side mirror of
+    ops/sampling.pool_choice_packed: one threefry word per POOL_PACK rows,
+    4 bits per row."""
+    words = threefry_bits_2d(
+        k1, k2, TILE // POOL_PACK, LANES, row0=t * (TILE // POOL_PACK)
+    )
+    expanded = jnp.repeat(words, POOL_PACK, axis=0)
+    shift = (
+        jnp.uint32(POOL_CHOICE_BITS)
+        * (_iota2((TILE, LANES), 0) % POOL_PACK).astype(jnp.uint32)
+    )
+    return ((expanded >> shift) & jnp.uint32(pool_size - 1)).astype(jnp.int32)
+
+
+def _make_gather(layout: PoolLayout, interpret: bool):
+    """Tiled circular roll readers over doubled planes.
+
+    ``gather(choice_plane, value_planes, e, t, slot)`` returns, for each
+    (ref, zero) in ``value_planes``, rows [t*TILE, (t+1)*TILE) of the flat
+    forward roll by ``e`` (0 <= e < n_pad) of that plane — out[j] =
+    plane[j - e (mod n_pad)] — masked at the source to positions whose
+    choice equals ``slot`` (masking commutes with the rotation since choice
+    and value tiles move identically). ``gather_plain(plane, e, t)`` is the
+    unmasked form.
+    """
+    R2 = jnp.int32(layout.rows)
+    lane = _iota2((TILE, LANES), 1)
+
+    def gather(choice_plane, value_planes, e, t, slot):
+        q = e // LANES
+        r = e % LANES
+        sa = lax.rem(t * TILE - q + R2, R2)
+        sb = lax.rem(sa - 1 + R2, R2)
+        ca = choice_plane[pl.ds(sa, TILE), :]
+        cb = choice_plane[pl.ds(sb, TILE), :]
+        ma = ca == slot
+        mb = cb == slot
+        outs = []
+        for plane, zero in value_planes:
+            pa = jnp.where(ma, plane[pl.ds(sa, TILE), :], zero)
+            pb = jnp.where(mb, plane[pl.ds(sb, TILE), :], zero)
+            outs.append(
+                jnp.where(
+                    lane >= r,
+                    _lane_roll(pa, r, interpret),
+                    _lane_roll(pb, r, interpret),
+                )
+            )
+        return outs
+
+    def gather_plain(plane, e, t):
+        q = e // LANES
+        r = e % LANES
+        sa = lax.rem(t * TILE - q + R2, R2)
+        sb = lax.rem(sa - 1 + R2, R2)
+        a = plane[pl.ds(sa, TILE), :]
+        b = plane[pl.ds(sb, TILE), :]
+        return jnp.where(
+            lane >= r,
+            _lane_roll(a, r, interpret),
+            _lane_roll(b, r, interpret),
+        )
+
+    return gather, gather_plain
+
+
+def _copy_in(pairs, sems):
+    cps = [
+        pltpu.make_async_copy(src, dst, sems.at[i])
+        for i, (src, dst) in enumerate(pairs)
+    ]
+    for cp in cps:
+        cp.start()
+    for cp in cps:
+        cp.wait()
+
+
+# ---------------------------------------------------------------------------
+# Kernels. Grid = (K rounds,); planes in VMEM scratch across steps.
+# ---------------------------------------------------------------------------
+
+
+def make_pushsum_pool_chunk(
+    topo: Topology, cfg: SimConfig, *, interpret: bool = False
+):
+    """Returns (chunk_fn, layout): ``chunk_fn(state4, keys, offs, start,
+    cap)`` runs up to K = keys.shape[0] synchronous pool push-sum rounds in
+    one kernel launch. ``state4`` is (s, w, term, conv_i32) in the padded
+    [rows, 128] layout; ``keys`` uint32 [K, 2] per-round fold_in keys;
+    ``offs`` int32 [K, pool_size] per-round displacement pools (round_offsets);
+    ``start`` the absolute round of keys[0]; ``cap`` the max_rounds bound.
+    Returns (state4', rounds_executed)."""
+    layout = build_pool_layout(topo.n)
+    R, T = layout.rows, layout.tiles
+    N = layout.n
+    Z = layout.n_pad - N
+    P = cfg.pool_size
+    delta = np.float32(cfg.resolved_delta)
+    term_rounds = np.int32(cfg.term_rounds)
+    target = np.int32(cfg.resolved_target_count(topo.n, topo.target_count))
+
+    def kernel(
+        start_ref, keys_ref, offs_ref, s0, w0, t0, c0,
+        s_o, w_o, t_o, c_o, meta_o,
+        s_v, w_v, t_v, c_v, ds_v, dw_v, dc_v, flags, sems,
+    ):
+        k = pl.program_id(0)
+        K = pl.num_programs(0)
+        gather, _ = _make_gather(layout, interpret)
+        row_l = _iota2((TILE, LANES), 0)
+        lane = _iota2((TILE, LANES), 1)
+
+        @pl.when(k == 0)
+        def _init():
+            _copy_in([(s0, s_v), (w0, w_v), (t0, t_v), (c0, c_v)], sems)
+            # done seeds from the incoming state so a launch that starts
+            # already-converged (resume, post-convergence chunk) runs zero
+            # rounds, matching the chunked runner.
+            flags[0] = jnp.where(jnp.sum(c_v[:], dtype=jnp.int32) >= target, 1, 0)
+            flags[1] = 0
+
+        active = (flags[0] == 0) & (start_ref[0] + k < start_ref[1])
+
+        @pl.when(active)
+        def _round():
+            kk = k % 8
+            k1 = keys_ref[kk, 0]
+            k2 = keys_ref[kk, 1]
+
+            def p1(t, _):
+                r0 = t * TILE
+                choice = _choice_tile(k1, k2, t, P)
+                padm = (r0 + row_l) * LANES + lane >= N
+                ss = jnp.where(padm, 0.0, s_v[pl.ds(r0, TILE), :] * 0.5)
+                ws = jnp.where(padm, 0.0, w_v[pl.ds(r0, TILE), :] * 0.5)
+                ds_v[pl.ds(r0, TILE), :] = ss
+                ds_v[pl.ds(R + r0, TILE), :] = ss
+                dw_v[pl.ds(r0, TILE), :] = ws
+                dw_v[pl.ds(R + r0, TILE), :] = ws
+                dc_v[pl.ds(r0, TILE), :] = choice
+                dc_v[pl.ds(R + r0, TILE), :] = choice
+                return 0
+
+            lax.fori_loop(0, T, p1, 0)
+
+            def p2(t, acc):
+                r0 = t * TILE
+                jflat = (r0 + row_l) * LANES + lane
+                padm = jflat >= N
+                inbox_s = jnp.zeros((TILE, LANES), jnp.float32)
+                inbox_w = jnp.zeros((TILE, LANES), jnp.float32)
+                planes = ((ds_v, jnp.float32(0)), (dw_v, jnp.float32(0)))
+                for slot in range(P):
+                    d = offs_ref[kk, slot]
+                    s1, w1 = gather(dc_v, planes, d, t, slot)
+                    s2, w2 = gather(dc_v, planes, d + Z, t, slot)
+                    take_main = jflat >= d
+                    inbox_s = inbox_s + jnp.where(take_main, s1, s2)
+                    inbox_w = inbox_w + jnp.where(take_main, w1, w2)
+                inbox_s = jnp.where(padm, 0.0, inbox_s)
+                inbox_w = jnp.where(padm, 0.0, inbox_w)
+                # Absorb — mirrors models/pushsum.absorb (program.fs:119-143):
+                # s_keep = s - s_send, term advances only on receipt.
+                s_t = s_v[pl.ds(r0, TILE), :]
+                w_t = w_v[pl.ds(r0, TILE), :]
+                s_new = (s_t - ds_v[pl.ds(r0, TILE), :]) + inbox_s
+                w_new = (w_t - dw_v[pl.ds(r0, TILE), :]) + inbox_w
+                received = inbox_w > 0
+                stable = jnp.abs(s_new / w_new - s_t / w_t) <= delta
+                term = t_v[pl.ds(r0, TILE), :]
+                term_new = jnp.where(
+                    received, jnp.where(stable, term + 1, jnp.int32(0)), term
+                )
+                conv_new = jnp.where(
+                    padm,
+                    jnp.int32(0),
+                    jnp.where(
+                        (c_v[pl.ds(r0, TILE), :] != 0)
+                        | (term_new >= term_rounds),
+                        jnp.int32(1),
+                        jnp.int32(0),
+                    ),
+                )
+                s_v[pl.ds(r0, TILE), :] = s_new
+                w_v[pl.ds(r0, TILE), :] = w_new
+                t_v[pl.ds(r0, TILE), :] = term_new
+                c_v[pl.ds(r0, TILE), :] = conv_new
+                return acc + jnp.sum(conv_new, dtype=jnp.int32)
+
+            total = lax.fori_loop(0, T, p2, jnp.int32(0))
+            flags[1] = flags[1] + 1
+            flags[0] = jnp.where(total >= target, 1, 0)
+
+        @pl.when(k == K - 1)
+        def _emit():
+            _copy_in([(s_v, s_o), (w_v, w_o), (t_v, t_o), (c_v, c_o)], sems)
+            meta_o[0] = flags[1]
+
+    def chunk_fn(state4, keys, offs, start, cap):
+        s, w, t, c = state4
+        # Clamp the round cap to rounds with REAL keys/offsets: the SMEM
+        # streams are padded to 8-round blocks with zeros, and a padded grid
+        # step must never execute (same guard as ops/fused.py chunk_fn).
+        cap = jnp.minimum(
+            jnp.int32(cap), jnp.int32(start) + jnp.int32(keys.shape[0])
+        )
+        if keys.shape[0] % 8:
+            pad = 8 - keys.shape[0] % 8
+            keys = jnp.concatenate([keys, jnp.zeros((pad, 2), keys.dtype)])
+            offs = jnp.concatenate([offs, jnp.ones((pad, P), offs.dtype)])
+        K = keys.shape[0]
+        f32 = jax.ShapeDtypeStruct((R, LANES), jnp.float32)
+        i32 = jax.ShapeDtypeStruct((R, LANES), jnp.int32)
+        outs = pl.pallas_call(
+            kernel,
+            grid=(K,),
+            out_shape=(f32, f32, i32, i32, jax.ShapeDtypeStruct((2,), jnp.int32)),
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),  # start/cap
+                pl.BlockSpec((8, 2), lambda k: (k // 8, 0), memory_space=pltpu.SMEM),
+                pl.BlockSpec((8, P), lambda k: (k // 8, 0), memory_space=pltpu.SMEM),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=(
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((R, LANES), jnp.float32),
+                pltpu.VMEM((R, LANES), jnp.float32),
+                pltpu.VMEM((R, LANES), jnp.int32),
+                pltpu.VMEM((R, LANES), jnp.int32),
+                pltpu.VMEM((2 * R, LANES), jnp.float32),
+                pltpu.VMEM((2 * R, LANES), jnp.float32),
+                pltpu.VMEM((2 * R, LANES), jnp.int32),
+                pltpu.SMEM((2,), jnp.int32),
+                pltpu.SemaphoreType.DMA((4,)),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=120 * 1024 * 1024
+            ),
+            interpret=interpret,
+        )(
+            jnp.stack([jnp.int32(start), jnp.int32(cap)]),
+            keys,
+            offs,
+            s, w, t, c,
+        )
+        s2, w2, t2, c2, meta = outs
+        return (s2, w2, t2, c2), meta[0]
+
+    return chunk_fn, layout
+
+
+def make_gossip_pool_chunk(
+    topo: Topology, cfg: SimConfig, *, interpret: bool = False
+):
+    """Gossip analog of make_pushsum_pool_chunk. ``state3`` is (count,
+    active_i32, conv_i32). Converged-target suppression (the reference's
+    shared dictionary probe, program.fs:92) reads last round's converged
+    plane at the sampled target — a backward mod-n roll, i.e. a forward roll
+    by n - d through the same doubled-plane gather."""
+    layout = build_pool_layout(topo.n)
+    R, T = layout.rows, layout.tiles
+    N = layout.n
+    Z = layout.n_pad - N
+    P = cfg.pool_size
+    rumor_target = np.int32(cfg.resolved_rumor_target)
+    suppress = cfg.resolved_suppress
+    target = np.int32(cfg.resolved_target_count(topo.n, topo.target_count))
+
+    def kernel(*refs):
+        if suppress:
+            (start_ref, keys_ref, offs_ref, n0, a0, c0,
+             n_o, a_o, c_o, meta_o,
+             n_v, a_v, c_v, dch_v, dcv_v, flags, sems) = refs
+        else:
+            (start_ref, keys_ref, offs_ref, n0, a0, c0,
+             n_o, a_o, c_o, meta_o,
+             n_v, a_v, c_v, dch_v, flags, sems) = refs
+            dcv_v = None
+        k = pl.program_id(0)
+        K = pl.num_programs(0)
+        gather, gather_plain = _make_gather(layout, interpret)
+        row_l = _iota2((TILE, LANES), 0)
+        lane = _iota2((TILE, LANES), 1)
+
+        @pl.when(k == 0)
+        def _init():
+            _copy_in([(n0, n_v), (a0, a_v), (c0, c_v)], sems)
+            flags[0] = jnp.where(jnp.sum(c_v[:], dtype=jnp.int32) >= target, 1, 0)
+            flags[1] = 0
+
+        active_chunk = (flags[0] == 0) & (start_ref[0] + k < start_ref[1])
+
+        @pl.when(active_chunk)
+        def _round():
+            kk = k % 8
+            k1 = keys_ref[kk, 0]
+            k2 = keys_ref[kk, 1]
+
+            if suppress:
+
+                def p0(t, _):
+                    r0 = t * TILE
+                    conv = c_v[pl.ds(r0, TILE), :]
+                    dcv_v[pl.ds(r0, TILE), :] = conv
+                    dcv_v[pl.ds(R + r0, TILE), :] = conv
+                    return 0
+
+                lax.fori_loop(0, T, p0, 0)
+
+            def p1(t, _):
+                r0 = t * TILE
+                choice = _choice_tile(k1, k2, t, P)
+                jflat = (r0 + row_l) * LANES + lane
+                padm = jflat >= N
+                sending = (a_v[pl.ds(r0, TILE), :] != 0) & ~padm
+                if suppress:
+                    # conv[target] = conv[(i + d_choice) mod n]: per slot a
+                    # forward roll by n - d, selected at the destination.
+                    cot = jnp.zeros((TILE, LANES), jnp.int32)
+                    for slot in range(P):
+                        d = offs_ref[kk, slot]
+                        e = N - d
+                        g1 = gather_plain(dcv_v, e, t)
+                        g2 = gather_plain(dcv_v, e + Z, t)
+                        g = jnp.where(jflat >= e, g1, g2)
+                        cot = jnp.where(choice == slot, g, cot)
+                    sending = sending & (cot == 0)
+                # Fold the send gate into the choice plane: slot -1 delivers
+                # nothing, so the inbox gather needs no separate value plane.
+                marked = jnp.where(sending, choice, jnp.int32(-1))
+                dch_v[pl.ds(r0, TILE), :] = marked
+                dch_v[pl.ds(R + r0, TILE), :] = marked
+                return 0
+
+            lax.fori_loop(0, T, p1, 0)
+
+            def p2(t, acc):
+                r0 = t * TILE
+                jflat = (r0 + row_l) * LANES + lane
+                padm = jflat >= N
+                inbox = jnp.zeros((TILE, LANES), jnp.int32)
+                for slot in range(P):
+                    d = offs_ref[kk, slot]
+                    g1 = gather_plain(dch_v, d, t)
+                    g2 = gather_plain(dch_v, d + Z, t)
+                    g = jnp.where(jflat >= d, g1, g2)
+                    inbox = inbox + jnp.where(g == slot, jnp.int32(1), jnp.int32(0))
+                inbox = jnp.where(padm, jnp.int32(0), inbox)
+                # Absorb — mirrors models/gossip.absorb (program.fs:97-105).
+                count_new = n_v[pl.ds(r0, TILE), :] + inbox
+                active_new = jnp.where(
+                    (a_v[pl.ds(r0, TILE), :] != 0) | (inbox > 0),
+                    jnp.int32(1),
+                    jnp.int32(0),
+                )
+                conv_new = jnp.where(
+                    count_new >= rumor_target, jnp.int32(1), jnp.int32(0)
+                )
+                n_v[pl.ds(r0, TILE), :] = count_new
+                a_v[pl.ds(r0, TILE), :] = active_new
+                c_v[pl.ds(r0, TILE), :] = conv_new
+                return acc + jnp.sum(conv_new, dtype=jnp.int32)
+
+            total = lax.fori_loop(0, T, p2, jnp.int32(0))
+            flags[1] = flags[1] + 1
+            flags[0] = jnp.where(total >= target, 1, 0)
+
+        @pl.when(k == K - 1)
+        def _emit():
+            _copy_in([(n_v, n_o), (a_v, a_o), (c_v, c_o)], sems)
+            meta_o[0] = flags[1]
+
+    def chunk_fn(state3, keys, offs, start, cap):
+        cnt, act, cv = state3
+        cap = jnp.minimum(
+            jnp.int32(cap), jnp.int32(start) + jnp.int32(keys.shape[0])
+        )
+        if keys.shape[0] % 8:
+            pad = 8 - keys.shape[0] % 8
+            keys = jnp.concatenate([keys, jnp.zeros((pad, 2), keys.dtype)])
+            offs = jnp.concatenate([offs, jnp.ones((pad, P), offs.dtype)])
+        K = keys.shape[0]
+        i32 = jax.ShapeDtypeStruct((R, LANES), jnp.int32)
+        scratch = [
+            pltpu.VMEM((R, LANES), jnp.int32),
+            pltpu.VMEM((R, LANES), jnp.int32),
+            pltpu.VMEM((R, LANES), jnp.int32),
+            pltpu.VMEM((2 * R, LANES), jnp.int32),
+        ]
+        if suppress:
+            scratch.append(pltpu.VMEM((2 * R, LANES), jnp.int32))
+        scratch += [pltpu.SMEM((2,), jnp.int32), pltpu.SemaphoreType.DMA((3,))]
+        outs = pl.pallas_call(
+            kernel,
+            grid=(K,),
+            out_shape=(i32, i32, i32, jax.ShapeDtypeStruct((2,), jnp.int32)),
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec((8, 2), lambda k: (k // 8, 0), memory_space=pltpu.SMEM),
+                pl.BlockSpec((8, P), lambda k: (k // 8, 0), memory_space=pltpu.SMEM),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=(
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+            ),
+            scratch_shapes=scratch,
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=120 * 1024 * 1024
+            ),
+            interpret=interpret,
+        )(
+            jnp.stack([jnp.int32(start), jnp.int32(cap)]),
+            keys,
+            offs,
+            cnt, act, cv,
+        )
+        n2, a2, c2, meta = outs
+        return (n2, a2, c2), meta[0]
+
+    return chunk_fn, layout

@@ -111,6 +111,77 @@ def pool_choice(bits: jax.Array, pool_size: int) -> jax.Array:
     return (bits & jnp.uint32(pool_size - 1)).astype(jnp.int32)
 
 
+# --- packed pool choice ----------------------------------------------------
+#
+# A pool choice needs at most POOL_CHOICE_BITS of entropy, yet drawing one
+# u32 word per node makes the threefry draw the single most expensive op of
+# the 1M-node pool round (~170 us of a ~600 us round on v5e). Entropy
+# economy is a TPU-first concern: generate only the bits the round consumes.
+# The packed scheme draws one u32 word per POOL_PACK nodes and slices 4 bits
+# per node, cutting the RNG cost 8x. The geometry is fixed by the fused pool
+# kernel's 2-D layout (ops/fused_pool.py): rows of 128 lanes, grouped in 8
+# consecutive rows per word row, row count padded to a tile multiple — and
+# the XLA path reproduces the identical mapping so fused and chunked pool
+# engines stay stream-compatible.
+
+POOL_CHOICE_BITS = 4  # supports pool_size in {2, 4, 8, 16}
+POOL_PACK = 32 // POOL_CHOICE_BITS  # nodes per random word
+POOL_TILE_ROWS = 512  # fused-kernel tile height; fixes the padded row count
+_POOL_LANES = 128
+
+
+def pool_rows(n: int) -> int:
+    """Padded row count of the pool layout: the [rows, 128] grid covering n
+    nodes, rounded to a whole number of fused-kernel tiles."""
+    rows_min = (n + _POOL_LANES - 1) // _POOL_LANES
+    return ((rows_min + POOL_TILE_ROWS - 1) // POOL_TILE_ROWS) * POOL_TILE_ROWS
+
+
+def pool_words(round_k: jax.Array, n: int) -> jax.Array:
+    """uint32 [pool_rows(n) // POOL_PACK, 128] — the round's packed choice
+    words, drawn straight off the round key (disjoint from the _POOL_TAG and
+    send_gate streams, which fold in their own tags)."""
+    return jax.random.bits(
+        round_k, (pool_rows(n) // POOL_PACK, _POOL_LANES), jnp.uint32
+    )
+
+
+def pool_choice_packed(
+    round_k: jax.Array, n: int, pool_size: int, out_len: int | None = None
+) -> jax.Array:
+    """int32 [out_len or n] pool slots, 4 bits per node out of packed words.
+
+    Node i sits at (row, lane) = (i // 128, i % 128) of the 2-D layout and
+    reads word[row // POOL_PACK, lane] >> (4 * (row % POOL_PACK)). Exactly
+    uniform for power-of-two pool_size (no modulo bias). Entries past n (when
+    out_len > n) exist only so sharded callers can slice a device-aligned
+    vector; in-layout entries are real draws, anything past the layout is
+    zero-filled — callers must mask ids >= n out of sending either way.
+
+    pool_size > 16 exceeds the 4-bit budget; those (rare, perf-nonsensical)
+    widths fall back to one full word per node — a different but equally
+    valid stream (pool_size already selects the trajectory), ineligible for
+    the fused pool engine (ops/fused_pool.pool_fused_support).
+    """
+    out_len = n if out_len is None else out_len
+    if pool_size > 1 << POOL_CHOICE_BITS:
+        choice = pool_choice(uniform_bits(round_k, out_len), pool_size)
+        return choice
+    rows = pool_rows(n)
+    words = pool_words(round_k, n)
+    expanded = jnp.repeat(words, POOL_PACK, axis=0)
+    shift = (
+        POOL_CHOICE_BITS * (jnp.arange(rows, dtype=jnp.uint32) % POOL_PACK)
+    )[:, None]
+    choice = ((expanded >> shift) & jnp.uint32(pool_size - 1)).astype(jnp.int32)
+    flat = choice.reshape(-1)
+    if out_len <= flat.shape[0]:
+        return flat[:out_len]
+    return jnp.concatenate(
+        [flat, jnp.zeros((out_len - flat.shape[0],), jnp.int32)]
+    )
+
+
 def targets_pool(choice: jax.Array, offsets: jax.Array, node_ids: jax.Array, n: int) -> jax.Array:
     """Partner indices implied by (choice, offsets) — used by the sharded
     runner (which delivers by scatter) and by equivalence tests; the

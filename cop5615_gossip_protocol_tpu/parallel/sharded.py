@@ -120,28 +120,35 @@ def run_sharded(
 
     def targets_and_gate(round_idx, *targs):
         kr = sampling.round_key(key, round_idx)
-        # Full-length draw on every device, then slice: keeps the stream
+        # Full-length draws on every device, then slice: keeps the stream
         # identical to the single-device runner and independent of n_dev.
-        bits_full = sampling.uniform_bits(kr, n_pad)
         dev = lax.axis_index(NODE_AXIS)
         start = dev * n_loc
-        bits = lax.dynamic_slice(bits_full, (start,), (n_loc,))
         gids = start + jnp.arange(n_loc, dtype=jnp.int32)
         if topo.implicit:
             (valid_loc,) = targs
             if cfg.delivery == "pool":
                 # Offset-pool sampling (ops/sampling.pool_offsets) with
                 # scatter delivery: every device derives the same per-round
-                # pool from the replicated round key, so targets match the
-                # single-device pool path; the roll fast path stays
-                # single-device (cross-shard rolls land with the halo work).
+                # pool from the replicated round key, and the same packed
+                # choice words (sampling.pool_choice_packed — one word per
+                # 8 nodes), so targets match the single-device pool path;
+                # the roll fast path stays single-device (cross-shard rolls
+                # land with the halo work).
                 offs = sampling.pool_offsets(kr, cfg.pool_size, n)
-                choice = sampling.pool_choice(bits, cfg.pool_size)
+                choice_full = sampling.pool_choice_packed(
+                    kr, n, cfg.pool_size, out_len=n_pad
+                )
+                choice = lax.dynamic_slice(choice_full, (start,), (n_loc,))
                 targets = sampling.targets_pool(choice, offs, gids, n)
             else:
+                bits_full = sampling.uniform_bits(kr, n_pad)
+                bits = lax.dynamic_slice(bits_full, (start,), (n_loc,))
                 targets = sampling.targets_full(bits, gids, n)
             send_ok = valid_loc
         else:
+            bits_full = sampling.uniform_bits(kr, n_pad)
+            bits = lax.dynamic_slice(bits_full, (start,), (n_loc,))
             neighbors_loc, degree_loc, valid_loc = targs
             targets = sampling.targets_explicit(bits, neighbors_loc, degree_loc)
             send_ok = (degree_loc > 0) & valid_loc

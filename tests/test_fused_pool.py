@@ -1,0 +1,185 @@
+"""Fused pool engine (ops/fused_pool.py), run in interpret mode on CPU.
+
+Oracles mirror tests/test_fused.py's contract for the stencil engine:
+
+- the packed choice scheme (sampling.pool_choice_packed) must equal a NumPy
+  re-derivation from jax.random.bits — the stream both engines share;
+- full runs must match the chunked XLA pool runner: gossip bitwise (integer
+  state), push-sum on rounds/estimates (float32 both paths, same op order);
+  the n=1000 cases exercise the mod-n wraparound blend over a 64k-lane
+  padded tail, n=65536 the zero-pad case;
+- resume from a chunk-boundary snapshot follows the original trajectory;
+- mass is conserved through the doubled-plane delivery;
+- eligibility gating fails loudly.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cop5615_gossip_protocol_tpu import SimConfig, build_topology
+from cop5615_gossip_protocol_tpu.models.runner import run
+from cop5615_gossip_protocol_tpu.ops import fused_pool, sampling
+
+
+def _cfg(n, algorithm="gossip", engine="fused", **kw):
+    kw.setdefault("max_rounds", 60000)
+    kw.setdefault("chunk_rounds", 32)
+    return SimConfig(n=n, topology="full", algorithm=algorithm,
+                     delivery="pool", engine=engine, **kw)
+
+
+@pytest.mark.parametrize("n", [1000, 65536, 70000])
+def test_pool_choice_packed_matches_manual(n):
+    kr = sampling.round_key(jax.random.PRNGKey(9), 17)
+    K = 4
+    got = np.asarray(sampling.pool_choice_packed(kr, n, K))
+    rows = sampling.pool_rows(n)
+    words = np.asarray(
+        jax.random.bits(kr, (rows // sampling.POOL_PACK, 128), jnp.uint32)
+    )
+    idx = np.arange(n)
+    row, lane = idx // 128, idx % 128
+    want = (
+        words[row // sampling.POOL_PACK, lane]
+        >> (sampling.POOL_CHOICE_BITS * (row % sampling.POOL_PACK))
+    ) & (K - 1)
+    assert (got == want).all()
+
+
+def test_pool_choice_packed_wide_fallback():
+    # pool_size > 16 exceeds the 4-bit packing; the fallback draws full
+    # words (a valid stream of its own) and the fused engine refuses it.
+    kr = sampling.round_key(jax.random.PRNGKey(0), 0)
+    choice = np.asarray(sampling.pool_choice_packed(kr, 500, 32))
+    assert choice.shape == (500,) and (choice < 32).all() and (choice >= 0).all()
+    assert np.unique(choice).size > 16
+    topo = build_topology("full", 500)
+    assert "packed-choice" in fused_pool.pool_fused_support(
+        topo, _cfg(500, pool_size=32)
+    )
+
+
+@pytest.mark.parametrize("n", [1000, 65536])
+def test_fused_pool_gossip_matches_chunked_bitwise(n):
+    results = {}
+    for engine in ["chunked", "fused"]:
+        r = run(build_topology("full", n), _cfg(n, engine=engine))
+        results[engine] = r
+    a, b = results["chunked"], results["fused"]
+    assert a.converged and b.converged
+    assert a.rounds == b.rounds
+    assert a.converged_count == b.converged_count
+
+
+def test_fused_pool_gossip_two_tiles():
+    # rows = 1024 -> two in-kernel tiles; cross-tile gathers exercised.
+    n = 70000
+    a = run(build_topology("full", n), _cfg(n, engine="chunked"))
+    b = run(build_topology("full", n), _cfg(n, engine="fused"))
+    assert a.converged and b.converged
+    assert a.rounds == b.rounds and a.converged_count == b.converged_count
+
+
+@pytest.mark.parametrize("pool_size", [2, 4, 16])
+def test_fused_pool_pushsum_matches_chunked(pool_size):
+    n = 1000
+    results = {}
+    for engine in ["chunked", "fused"]:
+        r = run(
+            build_topology("full", n),
+            _cfg(n, algorithm="push-sum", engine=engine, pool_size=pool_size,
+                 chunk_rounds=64),
+        )
+        results[engine] = r
+    a, b = results["chunked"], results["fused"]
+    assert a.converged and b.converged
+    # Same f32 op order both paths => rounds agree exactly at this scale.
+    assert a.rounds == b.rounds
+    assert abs(a.estimate_mae - b.estimate_mae) < 1e-3
+
+
+def test_fused_pool_gossip_suppression_reference_mode():
+    # Reference semantics on full: Q1 population n+1, Q2 11th receipt, C13
+    # leader self-count, converged-target suppression via the doubled conv
+    # plane (the dictionary probe, program.fs:92).
+    n = 512
+    results = {}
+    for engine in ["chunked", "fused"]:
+        cfg = SimConfig(n=n, topology="full", algorithm="gossip",
+                        semantics="reference", delivery="pool", engine=engine,
+                        max_rounds=20000, chunk_rounds=32)
+        results[engine] = run(
+            build_topology("full", n, semantics="reference"), cfg
+        )
+    a, b = results["chunked"], results["fused"]
+    assert a.rounds == b.rounds
+    assert a.converged_count == b.converged_count
+    assert b.converged
+
+
+def test_fused_pool_mass_conservation():
+    n = 1000
+    seen = []
+    run(build_topology("full", n),
+        _cfg(n, algorithm="push-sum", chunk_rounds=16),
+        on_chunk=lambda r, st: seen.append(
+            (float(jnp.sum(st.s)), float(jnp.sum(st.w)))
+        ))
+    true_s = n * (n - 1) / 2.0
+    for s_tot, w_tot in seen:
+        assert abs(s_tot - true_s) / true_s < 1e-4
+        assert abs(w_tot - n) / n < 1e-5
+
+
+def test_fused_pool_resume_midway():
+    n = 1000
+    cfg = _cfg(n, chunk_rounds=8)
+    topo = build_topology("full", n)
+    snaps = []
+    full = run(topo, cfg, on_chunk=lambda r, s: snaps.append((r, s)))
+    assert len(snaps) >= 2
+    r0, s0 = snaps[0]
+    resumed = run(topo, cfg, start_state=jax.tree.map(jnp.asarray, s0),
+                  start_round=r0)
+    assert resumed.rounds == full.rounds
+    assert resumed.converged_count == full.converged_count
+
+
+@pytest.mark.parametrize("chunk_rounds", [5, 100])
+def test_fused_pool_chunk_rounds_not_multiple_of_8(chunk_rounds):
+    # SMEM key/offset blocks pad to 8-round multiples with zeros; padded
+    # grid steps must never execute (same regression class as the stencil
+    # engine's zero-key bug, tests/test_fused.py).
+    n = 1000
+    a = run(build_topology("full", n), _cfg(n, engine="chunked"))
+    b = run(build_topology("full", n),
+            _cfg(n, engine="fused", chunk_rounds=chunk_rounds))
+    assert a.rounds == b.rounds
+    assert a.converged_count == b.converged_count
+
+
+def test_pool_fused_support_gating():
+    topo = build_topology("full", 1000)
+    # float64
+    assert "float32" in fused_pool.pool_fused_support(
+        topo, _cfg(1000, dtype="float64", algorithm="push-sum")
+    )
+    # fault injection
+    assert "fault" in fused_pool.pool_fused_support(
+        topo, _cfg(1000, fault_rate=0.1)
+    )
+    # population cap
+    big = build_topology("full", fused_pool.MAX_POOL_NODES + 1)
+    assert "exceeds" in fused_pool.pool_fused_support(
+        big, _cfg(fused_pool.MAX_POOL_NODES + 1)
+    )
+    # explicit topology
+    line = build_topology("line", 100)
+    cfg_line = SimConfig(n=100, topology="full", delivery="pool")
+    assert "full-topology" in fused_pool.pool_fused_support(line, cfg_line)
+    # explicit engine request must fail loudly, not fall back
+    with pytest.raises(ValueError, match="fused.*unavailable|unavailable"):
+        run(topo, _cfg(1000, fault_rate=0.1, engine="fused"))

@@ -112,9 +112,12 @@ def test_auto_engine_selects_compiled_fused(monkeypatch):
     seen = {}
     real = runner_mod._run_fused
 
-    def spy(topo, cfg, key, on_chunk, start_state, start_round, interpret):
+    def spy(topo, cfg, key, on_chunk, start_state, start_round, interpret,
+            pool=False):
         seen["interpret"] = interpret
-        return real(topo, cfg, key, on_chunk, start_state, start_round, interpret)
+        seen["pool"] = pool
+        return real(topo, cfg, key, on_chunk, start_state, start_round,
+                    interpret, pool=pool)
 
     monkeypatch.setattr(runner_mod, "_run_fused", spy)
     n = 1024
@@ -122,4 +125,4 @@ def test_auto_engine_selects_compiled_fused(monkeypatch):
                     max_rounds=20000, chunk_rounds=64)
     res = run(build_topology("grid2d", n), cfg)
     assert res.converged
-    assert seen == {"interpret": False}
+    assert seen == {"interpret": False, "pool": False}

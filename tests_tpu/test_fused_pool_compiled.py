@@ -1,0 +1,139 @@
+"""Compiled (interpret=False) fused pool engine on a real TPU chip.
+
+tests/test_fused_pool.py exercises ops/fused_pool.py in interpret mode on
+CPU only; `_lane_roll` has an explicit interpret fork, so the hardware
+`pltpu.roll` lane rotates, the dynamic-row-offset tile loads over the
+doubled planes, and the real DMA/SMEM lowering are untouched by that suite.
+This suite is the hardware evidence — the compiled kernel must reproduce the
+chunked XLA pool path's trajectories on the chip, including at the flagship
+1M-node scale (the engine `bench.py` measures via engine='auto').
+
+Oracles mirror tests_tpu/test_fused_compiled.py:
+- gossip: integer state, bit-identical — rounds, converged count, AND the
+  final state arrays at the last chunk boundary, elementwise;
+- push-sum: same f32 op order both paths → rounds agree exactly, estimates
+  to ~1e-3;
+- resume from a chunk-boundary snapshot lands on the full run's trajectory;
+- engine='auto' on TPU must route an eligible pool config through the
+  compiled pool engine (the bench.py route).
+
+Run on a chip: python -m pytest tests_tpu -q
+Latest recorded run: tests_tpu/RUNLOG.md
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cop5615_gossip_protocol_tpu import SimConfig, build_topology
+from cop5615_gossip_protocol_tpu.models.runner import run
+
+
+def _cfg(n, algorithm="gossip", engine="fused", **kw):
+    kw.setdefault("max_rounds", 100_000)
+    kw.setdefault("chunk_rounds", 64)
+    return SimConfig(n=n, topology="full", algorithm=algorithm,
+                     delivery="pool", engine=engine, **kw)
+
+
+def _run_with_final_state(topo, cfg):
+    snaps = []
+    res = run(topo, cfg, on_chunk=lambda r, s: snaps.append((r, s)))
+    assert snaps, "on_chunk must fire at least once"
+    return res, snaps[-1][1]
+
+
+def _assert_states_bitwise(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb) > 0
+    for av, bv in zip(la, lb):
+        assert (np.asarray(av) == np.asarray(bv)).all()
+
+
+@pytest.mark.parametrize(
+    "n",
+    [
+        1000,     # 64k-lane padded tail: wraparound blend on hardware rolls
+        65536,    # zero padding
+        200_000,  # four in-kernel tiles, cross-tile gathers
+    ],
+)
+def test_compiled_pool_gossip_matches_chunked_bitwise(n):
+    results = {}
+    for engine in ["chunked", "fused"]:
+        results[engine] = _run_with_final_state(
+            build_topology("full", n), _cfg(n, engine=engine)
+        )
+    (ra, sa), (rb, sb) = results["chunked"], results["fused"]
+    assert ra.converged and rb.converged
+    assert ra.rounds == rb.rounds
+    assert ra.converged_count == rb.converged_count
+    _assert_states_bitwise(sa, sb)
+
+
+@pytest.mark.parametrize("n", [1000, 1_000_000])
+def test_compiled_pool_pushsum_matches_chunked(n):
+    results = {}
+    for engine in ["chunked", "fused"]:
+        results[engine] = run(
+            build_topology("full", n),
+            _cfg(n, algorithm="push-sum", engine=engine, chunk_rounds=256),
+        )
+    a, b = results["chunked"], results["fused"]
+    assert a.converged and b.converged
+    assert a.rounds == b.rounds
+    assert abs(a.estimate_mae - b.estimate_mae) < 1e-3
+
+
+def test_compiled_pool_gossip_suppression_reference_mode():
+    n = 2048
+    results = {}
+    for engine in ["chunked", "fused"]:
+        cfg = SimConfig(n=n, topology="full", algorithm="gossip",
+                        semantics="reference", delivery="pool", engine=engine,
+                        max_rounds=100_000, chunk_rounds=64)
+        results[engine] = run(
+            build_topology("full", n, semantics="reference"), cfg
+        )
+    a, b = results["chunked"], results["fused"]
+    assert a.rounds == b.rounds
+    assert a.converged_count == b.converged_count
+
+
+def test_compiled_pool_resume_midway():
+    n = 100_000
+    cfg = _cfg(n, algorithm="push-sum", chunk_rounds=32)
+    topo = build_topology("full", n)
+    snaps = []
+    full = run(topo, cfg, on_chunk=lambda r, s: snaps.append((r, s)))
+    assert len(snaps) >= 2
+    r0, s0 = snaps[0]
+    resumed = run(topo, cfg, start_state=jax.tree.map(jnp.asarray, s0),
+                  start_round=r0)
+    assert resumed.rounds == full.rounds
+    assert resumed.converged_count == full.converged_count
+
+
+def test_auto_engine_selects_compiled_pool(monkeypatch):
+    # The bench.py route: engine='auto' + delivery='pool' on TPU must hit
+    # the compiled pool engine.
+    from cop5615_gossip_protocol_tpu.models import runner as runner_mod
+
+    seen = {}
+    real = runner_mod._run_fused
+
+    def spy(topo, cfg, key, on_chunk, start_state, start_round, interpret,
+            pool=False):
+        seen["interpret"] = interpret
+        seen["pool"] = pool
+        return real(topo, cfg, key, on_chunk, start_state, start_round,
+                    interpret, pool=pool)
+
+    monkeypatch.setattr(runner_mod, "_run_fused", spy)
+    n = 10_000
+    res = run(build_topology("full", n),
+              _cfg(n, algorithm="push-sum", engine="auto"))
+    assert res.converged
+    assert seen == {"interpret": False, "pool": True}
