@@ -168,7 +168,11 @@ def main(argv: Optional[list[str]] = None) -> int:
     if args.resume:
         import dataclasses
 
-        start_state, start_round, saved_cfg = ckpt.load(args.resume)
+        try:
+            start_state, start_round, saved_cfg = ckpt.load(args.resume)
+        except ValueError as e:  # e.g. random-stream version mismatch
+            print(f"Invalid: {e}", file=sys.stderr)
+            return 2
         # Resume is only bitwise-faithful if every stream-relevant knob
         # matches the original run; loop-control knobs may differ.
         loop_knobs = {"max_rounds": cfg.max_rounds, "chunk_rounds": cfg.chunk_rounds,

@@ -151,10 +151,12 @@ class SimConfig:
     delivery: str = "auto"
 
     # Offset-pool width for delivery="pool". Power of two so the per-node
-    # slot choice is exact uniform low bits (no modulo bias). 4 measures
-    # fastest at 1M nodes on v5e (fewer rolls) with no convergence penalty
-    # (tests/test_pool.py; bench.py sweep r2: K=4 -> 0.54s, K=8 -> 1.18s,
-    # K=16 -> 1.81s wall, all mae ~0.028).
+    # slot choice is exact uniform bits (no modulo bias). 4 measures fastest
+    # at 1M nodes on v5e (fewer rolls) with no convergence penalty
+    # (tests/test_pool.py; chunked-path sweep r2: K=4 -> 0.54s, K=8 -> 1.18s,
+    # K=16 -> 1.81s wall, all mae ~0.028; the fused pool engine
+    # (ops/fused_pool.py) takes the 1M wall to ~0.16s at K=4 and supports
+    # K <= 16, the packed-choice 4-bit budget).
     pool_size: int = 4
 
     # Sharding: number of mesh devices for the node dimension; None/1 → single device.

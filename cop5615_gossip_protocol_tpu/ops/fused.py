@@ -249,6 +249,34 @@ def _sample_disp(bits, disp_ref, deg):
     return d
 
 
+def clamp_cap_and_pad(start, cap, keys, extras=()):
+    """Shared per-chunk SMEM stream prep for every fused engine.
+
+    Clamps the round cap to the rounds that have REAL keys, THEN pads the
+    per-round SMEM streams to 8-round blocks. Order matters: without the
+    clamp, a chunk_rounds not divisible by 8 would execute its padded grid
+    steps with key (0,0) — identical random bits at the same positions every
+    chunk, silently diverging from the chunked engine
+    (tests/test_fused.py::test_chunk_rounds_not_multiple_of_8).
+
+    ``extras`` is a tuple of (array, fill) pairs padded alongside the keys
+    (the pool engine's per-round offsets). Returns (cap, keys, *extras).
+    """
+    cap = jnp.minimum(jnp.int32(cap), jnp.int32(start) + jnp.int32(keys.shape[0]))
+    if keys.shape[0] % 8:
+        pad = 8 - keys.shape[0] % 8
+        keys = jnp.concatenate([keys, jnp.zeros((pad, 2), keys.dtype)])
+        padded = tuple(
+            jnp.concatenate(
+                [a, jnp.full((pad,) + a.shape[1:], fill, a.dtype)]
+            )
+            for a, fill in extras
+        )
+    else:
+        padded = tuple(a for a, _ in extras)
+    return (cap, keys) + padded
+
+
 # ---------------------------------------------------------------------------
 # Kernels. Grid = (K rounds,); state in VMEM scratch across steps.
 # ---------------------------------------------------------------------------
@@ -347,16 +375,7 @@ def make_pushsum_chunk(
 
     def chunk_fn(state4, keys, start, cap):
         s, w, t, c = state4
-        # Clamp the round cap to the rounds that have REAL keys. The SMEM key
-        # stream below is padded to 8-round blocks with zeros; without the
-        # clamp a chunk_rounds not divisible by 8 would execute its padded
-        # grid steps with key (0,0) — identical random bits at the same
-        # positions every chunk, silently diverging from the chunked engine
-        # (tests/test_fused.py::test_chunk_rounds_not_multiple_of_8).
-        cap = jnp.minimum(jnp.int32(cap), jnp.int32(start) + jnp.int32(keys.shape[0]))
-        if keys.shape[0] % 8:  # SMEM key blocks are 8 rounds wide
-            pad = 8 - keys.shape[0] % 8
-            keys = jnp.concatenate([keys, jnp.zeros((pad, 2), keys.dtype)])
+        cap, keys = clamp_cap_and_pad(start, cap, keys)
         K = keys.shape[0]
         grid = (K,)
         f32 = jax.ShapeDtypeStruct((R, LANES), jnp.float32)
@@ -364,7 +383,7 @@ def make_pushsum_chunk(
         outs = pl.pallas_call(
             kernel,
             grid=grid,
-            out_shape=(f32, f32, i32, i32, jax.ShapeDtypeStruct((2,), jnp.int32)),
+            out_shape=(f32, f32, i32, i32, jax.ShapeDtypeStruct((1,), jnp.int32)),
             in_specs=[
                 pl.BlockSpec(memory_space=pltpu.SMEM),  # start/cap
                 pl.BlockSpec((8, 2), lambda k: (k // 8, 0), memory_space=pltpu.SMEM),
@@ -481,17 +500,12 @@ def make_gossip_chunk(topo: Topology, cfg: SimConfig, *, interpret: bool = False
 
     def chunk_fn(state3, keys, start, cap):
         cnt, act, cv = state3
-        # Same padded-key guard as make_pushsum_chunk's chunk_fn: zero-key
-        # padding rounds must never execute.
-        cap = jnp.minimum(jnp.int32(cap), jnp.int32(start) + jnp.int32(keys.shape[0]))
-        if keys.shape[0] % 8:
-            pad = 8 - keys.shape[0] % 8
-            keys = jnp.concatenate([keys, jnp.zeros((pad, 2), keys.dtype)])
+        cap, keys = clamp_cap_and_pad(start, cap, keys)
         i32 = jax.ShapeDtypeStruct((R, LANES), jnp.int32)
         outs = pl.pallas_call(
             kernel,
             grid=(keys.shape[0],),
-            out_shape=(i32, i32, i32, jax.ShapeDtypeStruct((2,), jnp.int32)),
+            out_shape=(i32, i32, i32, jax.ShapeDtypeStruct((1,), jnp.int32)),
             in_specs=[
                 pl.BlockSpec(memory_space=pltpu.SMEM),
                 pl.BlockSpec((8, 2), lambda k: (k // 8, 0), memory_space=pltpu.SMEM),

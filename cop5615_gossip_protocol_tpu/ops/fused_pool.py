@@ -51,7 +51,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..config import SimConfig
-from .fused import threefry_bits_2d
+from .fused import clamp_cap_and_pad, threefry_bits_2d
 from .sampling import (
     POOL_CHOICE_BITS,
     POOL_PACK,
@@ -338,23 +338,14 @@ def make_pushsum_pool_chunk(
 
     def chunk_fn(state4, keys, offs, start, cap):
         s, w, t, c = state4
-        # Clamp the round cap to rounds with REAL keys/offsets: the SMEM
-        # streams are padded to 8-round blocks with zeros, and a padded grid
-        # step must never execute (same guard as ops/fused.py chunk_fn).
-        cap = jnp.minimum(
-            jnp.int32(cap), jnp.int32(start) + jnp.int32(keys.shape[0])
-        )
-        if keys.shape[0] % 8:
-            pad = 8 - keys.shape[0] % 8
-            keys = jnp.concatenate([keys, jnp.zeros((pad, 2), keys.dtype)])
-            offs = jnp.concatenate([offs, jnp.ones((pad, P), offs.dtype)])
+        cap, keys, offs = clamp_cap_and_pad(start, cap, keys, ((offs, 1),))
         K = keys.shape[0]
         f32 = jax.ShapeDtypeStruct((R, LANES), jnp.float32)
         i32 = jax.ShapeDtypeStruct((R, LANES), jnp.int32)
         outs = pl.pallas_call(
             kernel,
             grid=(K,),
-            out_shape=(f32, f32, i32, i32, jax.ShapeDtypeStruct((2,), jnp.int32)),
+            out_shape=(f32, f32, i32, i32, jax.ShapeDtypeStruct((1,), jnp.int32)),
             in_specs=[
                 pl.BlockSpec(memory_space=pltpu.SMEM),  # start/cap
                 pl.BlockSpec((8, 2), lambda k: (k // 8, 0), memory_space=pltpu.SMEM),
@@ -521,13 +512,7 @@ def make_gossip_pool_chunk(
 
     def chunk_fn(state3, keys, offs, start, cap):
         cnt, act, cv = state3
-        cap = jnp.minimum(
-            jnp.int32(cap), jnp.int32(start) + jnp.int32(keys.shape[0])
-        )
-        if keys.shape[0] % 8:
-            pad = 8 - keys.shape[0] % 8
-            keys = jnp.concatenate([keys, jnp.zeros((pad, 2), keys.dtype)])
-            offs = jnp.concatenate([offs, jnp.ones((pad, P), offs.dtype)])
+        cap, keys, offs = clamp_cap_and_pad(start, cap, keys, ((offs, 1),))
         K = keys.shape[0]
         i32 = jax.ShapeDtypeStruct((R, LANES), jnp.int32)
         scratch = [
@@ -542,7 +527,7 @@ def make_gossip_pool_chunk(
         outs = pl.pallas_call(
             kernel,
             grid=(K,),
-            out_shape=(i32, i32, i32, jax.ShapeDtypeStruct((2,), jnp.int32)),
+            out_shape=(i32, i32, i32, jax.ShapeDtypeStruct((1,), jnp.int32)),
             in_specs=[
                 pl.BlockSpec(memory_space=pltpu.SMEM),
                 pl.BlockSpec((8, 2), lambda k: (k // 8, 0), memory_space=pltpu.SMEM),

@@ -23,6 +23,14 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+# Version of the random-stream derivation scheme. A checkpoint resumed under
+# a different stream version would silently follow a different trajectory
+# than the run that wrote it — utils/checkpoint.py embeds this and load()
+# rejects mismatches. History:
+#   1 — per-node u32 draw for pool choices (rounds 1-2)
+#   2 — packed 4-bit pool choices, one word per 8 nodes (pool_choice_packed)
+STREAM_VERSION = 2
+
 
 def round_key(base_key: jax.Array, round_idx: jax.Array | int) -> jax.Array:
     """Key for one synchronous round — fold_in by round index so chunking and

@@ -21,6 +21,7 @@ import numpy as np
 from ..config import SimConfig
 from ..models.gossip import GossipState
 from ..models.pushsum import PushSumState
+from ..ops.sampling import POOL_CHOICE_BITS, STREAM_VERSION
 
 
 def _normalize(path: str | Path) -> Path:
@@ -38,7 +39,9 @@ def save(path: str | Path, state, rounds: int, cfg: SimConfig) -> None:
     path = _normalize(path)
     path.parent.mkdir(parents=True, exist_ok=True)
     arrays = {f: np.asarray(getattr(state, f)) for f in state._fields}
-    np.savez_compressed(path, __rounds__=rounds, **arrays)
+    np.savez_compressed(
+        path, __rounds__=rounds, __stream__=STREAM_VERSION, **arrays
+    )
     sidecar = path.with_suffix(path.suffix + ".json")
     sidecar.write_text(json.dumps(dataclasses.asdict(cfg), indent=2))
 
@@ -49,8 +52,30 @@ def load(path: str | Path):
     path = _normalize(path)
     with np.load(path) as z:
         rounds = int(z["__rounds__"])
-        fields = {k: z[k] for k in z.files if k != "__rounds__"}
+        # Pre-versioning checkpoints (stream 1) carry no marker.
+        stream = int(z["__stream__"]) if "__stream__" in z.files else 1
+        fields = {
+            k: z[k] for k in z.files if k not in ("__rounds__", "__stream__")
+        }
     cfg = SimConfig(**json.loads(path.with_suffix(path.suffix + ".json").read_text()))
+    # The v1 -> v2 stream change altered only the *packed* pool-choice
+    # derivation (sampling.STREAM_VERSION history), so only checkpoints
+    # whose config consumes that stream are unresumable: scatter/stencil
+    # runs replay bitwise-identically under either version, and so do
+    # pool_size > 16 runs (pool_choice_packed's wide fallback IS the v1
+    # derivation).
+    if (
+        stream != STREAM_VERSION
+        and cfg.delivery == "pool"
+        and cfg.pool_size <= 1 << POOL_CHOICE_BITS
+    ):
+        raise ValueError(
+            f"checkpoint {path} was written under random-stream version "
+            f"{stream}, this build derives version {STREAM_VERSION} for its "
+            "pool-choice draws — resuming would silently follow a different "
+            "trajectory than the run that wrote it; restart the run (or "
+            "check out the matching framework version)"
+        )
     cls = PushSumState if "s" in fields else GossipState
     state = cls(**{f: jnp.asarray(fields[f]) for f in cls._fields})
     return state, rounds, cfg

@@ -106,3 +106,54 @@ def test_cli_reference_walk_cannot_be_sharded(capsys):
                "--dtype", "float64", "--devices", "8"])
     assert rc == 2
     assert "cannot be sharded" in capsys.readouterr().err
+
+
+def test_checkpoint_rejects_mismatched_stream_version(tmp_path):
+    # A pool-delivery checkpoint written under a different random-stream
+    # derivation (the pre-packed-choice scheme) must be refused, not silently
+    # resumed onto a different trajectory. Non-pool checkpoints are
+    # unaffected by the v1->v2 change and must keep loading.
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cop5615_gossip_protocol_tpu import SimConfig
+    from cop5615_gossip_protocol_tpu.models.pushsum import PushSumState
+    from cop5615_gossip_protocol_tpu.utils import checkpoint as ckpt
+
+    st = PushSumState(
+        s=jnp.arange(16, dtype=jnp.float32), w=jnp.ones((16,), jnp.float32),
+        term=jnp.zeros((16,), jnp.int32), conv=jnp.zeros((16,), bool),
+    )
+    cfg_pool = SimConfig(n=16, topology="full", algorithm="push-sum",
+                         delivery="pool")
+    p = tmp_path / "ck.npz"
+    ckpt.save(p, st, 32, cfg_pool)
+    # Round-trips at the current version.
+    _, rounds, _ = ckpt.load(p)
+    assert rounds == 32
+
+    def rewrite_stream(version):
+        with np.load(p) as z:
+            data = {k: z[k] for k in z.files}
+        if version is None:
+            del data["__stream__"]
+        else:
+            data["__stream__"] = np.int64(version)
+        np.savez_compressed(p, **data)
+
+    rewrite_stream(1)
+    with pytest.raises(ValueError, match="stream version"):
+        ckpt.load(p)
+
+    # Pre-versioning checkpoints (no marker at all) are treated as stream 1.
+    rewrite_stream(None)
+    with pytest.raises(ValueError, match="stream version"):
+        ckpt.load(p)
+
+    # A scatter-delivery run never consumed the pool-choice stream: a
+    # version-1 checkpoint of it replays bitwise-identically and must load.
+    cfg_scatter = SimConfig(n=16, topology="full", algorithm="push-sum")
+    ckpt.save(p, st, 32, cfg_scatter)
+    rewrite_stream(1)
+    _, rounds, _ = ckpt.load(p)
+    assert rounds == 32
