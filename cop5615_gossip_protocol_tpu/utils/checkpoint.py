@@ -52,8 +52,10 @@ def load(path: str | Path):
     path = _normalize(path)
     with np.load(path) as z:
         rounds = int(z["__rounds__"])
-        # Pre-versioning checkpoints (stream 1) carry no marker.
-        stream = int(z["__stream__"]) if "__stream__" in z.files else 1
+        # Pre-marker checkpoints are of unknown stream version; treat as 1
+        # (the conservative reading — rejection beats a silently divergent
+        # resume).
+        stream = int(z["__stream__"]) if "__stream__" in z.files else None
         fields = {
             k: z[k] for k in z.files if k not in ("__rounds__", "__stream__")
         }
@@ -69,12 +71,16 @@ def load(path: str | Path):
         and cfg.delivery == "pool"
         and cfg.pool_size <= 1 << POOL_CHOICE_BITS
     ):
+        written = (
+            f"under random-stream version {stream}" if stream is not None
+            else "before stream versioning (version unknown)"
+        )
         raise ValueError(
-            f"checkpoint {path} was written under random-stream version "
-            f"{stream}, this build derives version {STREAM_VERSION} for its "
-            "pool-choice draws — resuming would silently follow a different "
-            "trajectory than the run that wrote it; restart the run (or "
-            "check out the matching framework version)"
+            f"checkpoint {path} was written {written}; this build derives "
+            f"version {STREAM_VERSION} for its pool-choice draws — resuming "
+            "could silently follow a different trajectory than the run that "
+            "wrote it; restart the run (or check out the matching framework "
+            "version)"
         )
     cls = PushSumState if "s" in fields else GossipState
     state = cls(**{f: jnp.asarray(fields[f]) for f in cls._fields})
