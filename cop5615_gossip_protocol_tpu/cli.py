@@ -81,7 +81,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="force a JAX platform (cpu useful for dev boxes)")
     p.add_argument("--x64", action="store_true", help="enable float64 support")
     p.add_argument("--distributed", action="store_true",
-                   help="call jax.distributed.initialize for multi-host meshes")
+                   help="call jax.distributed.initialize for multi-host meshes "
+                   "(auto-detected cluster env, e.g. TPU pods)")
+    p.add_argument("--coordinator", type=str, default=None, metavar="HOST:PORT",
+                   help="explicit jax.distributed coordinator address (implies "
+                   "--distributed; use with --num-processes/--process-id for "
+                   "clusters without auto-detection, incl. multi-process CPU)")
+    p.add_argument("--num-processes", type=int, default=None,
+                   help="total process count for --coordinator")
+    p.add_argument("--process-id", type=int, default=None,
+                   help="this process's rank for --coordinator")
     p.add_argument("--profile", type=str, default=None, metavar="DIR",
                    help="capture a jax.profiler trace of the run into DIR "
                    "(viewable in TensorBoard/Perfetto; round phases are "
@@ -105,16 +114,40 @@ def main(argv: Optional[list[str]] = None) -> int:
 
     if args.platform != "auto":
         jax.config.update("jax_platforms", args.platform)
+    if args.num_processes and args.devices and args.devices % args.num_processes:
+        print(
+            f"Invalid: --devices {args.devices} (global mesh size) must be "
+            f"divisible by --num-processes {args.num_processes}",
+            file=sys.stderr,
+        )
+        return 2
+    if args.coordinator is not None and (
+        args.num_processes is None or args.process_id is None
+    ):
+        print(
+            "Invalid: --coordinator requires --num-processes and "
+            "--process-id (there is no auto-detection to fill them in)",
+            file=sys.stderr,
+        )
+        return 2
     if args.platform == "cpu" and args.devices and args.devices > 1:
         # Virtual CPU devices so sharded runs work on a dev box — the
-        # fake-backend story the reference lacks (SURVEY.md §4).
-        jax.config.update("jax_num_cpu_devices", args.devices)
+        # fake-backend story the reference lacks (SURVEY.md §4). --devices is
+        # the GLOBAL mesh size; each process hosts its share.
+        local = args.devices // (args.num_processes or 1)
+        jax.config.update("jax_num_cpu_devices", max(local, 1))
     if args.x64:
         jax.config.update("jax_enable_x64", True)
-    if args.distributed:
+    if args.distributed or args.coordinator is not None:
         from .parallel.mesh import initialize_distributed
 
-        initialize_distributed()
+        initialize_distributed(
+            args.coordinator, args.num_processes, args.process_id
+        )
+    if jax.process_count() > 1 and jax.process_index() != 0:
+        # One record per run, not per process: non-lead processes still
+        # execute every collective but stay silent on stdout.
+        args.quiet = True
 
     try:
         algorithm = normalize_algorithm(args.algorithm)
@@ -149,7 +182,8 @@ def main(argv: Optional[list[str]] = None) -> int:
     from .utils import checkpoint as ckpt
     from .utils import metrics
 
-    print(metrics.banner(cfg))
+    if jax.process_index() == 0:
+        print(metrics.banner(cfg))
 
     t0 = time.perf_counter()
     topo = build_topology(kind, args.numNodes, seed=args.seed, semantics=args.semantics)
@@ -162,6 +196,27 @@ def main(argv: Optional[list[str]] = None) -> int:
         def on_chunk(rounds, state):  # noqa: F811
             counter["chunks"] += 1
             if counter["chunks"] % args.checkpoint_every == 0:
+                if jax.process_count() > 1:
+                    # Process-spanning state is not host-addressable; gather
+                    # the full arrays (a collective — every process must
+                    # participate), then only the lead process writes.
+                    from jax.experimental import multihost_utils
+
+                    state = type(state)(
+                        *multihost_utils.process_allgather(
+                            tuple(state), tiled=True
+                        )
+                    )
+                    if jax.process_index() != 0:
+                        return
+                # Strip the sharded runner's device padding: a checkpoint
+                # holds exactly n entries so it can be resumed under any
+                # device count (including single-device).
+                import numpy as np
+
+                state = type(state)(
+                    *(np.asarray(x)[: topo.n] for x in state)
+                )
                 ckpt.save(args.checkpoint, state, rounds, cfg)
 
     start_state, start_round = None, 0
@@ -203,11 +258,14 @@ def main(argv: Optional[list[str]] = None) -> int:
         return 2
     result.build_s = build_s
 
-    print(metrics.reference_format(result))
+    if jax.process_index() == 0:
+        print(metrics.reference_format(result))
     record = metrics.run_record(cfg, topo, result)
     if not args.quiet:
         print(json.dumps(record))
-    if args.jsonl:
+    if args.jsonl and jax.process_index() == 0:
+        # One record per run: on a shared filesystem every process appending
+        # would interleave N duplicates.
         metrics.append_jsonl(args.jsonl, record)
     return 0 if result.converged else 1
 

@@ -53,6 +53,10 @@ def initialize_distributed(
             num_processes=num_processes,
             process_id=process_id,
         )
-    except RuntimeError:
-        # Already initialized — idempotent bring-up for notebook/CLI reuse.
-        pass
+    except RuntimeError as e:
+        # Idempotent bring-up for notebook/CLI reuse — but ONLY for the
+        # already-initialized case. A connect failure must propagate: if it
+        # were swallowed, every process would proceed as a lone process 0
+        # and silently run its own full simulation.
+        if "already initialized" not in str(e).lower():
+            raise

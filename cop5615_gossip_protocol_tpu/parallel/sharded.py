@@ -29,6 +29,18 @@ Population is padded to a device multiple; padded slots are invalid (never
 send, never targeted, never counted). When n_devices divides n, trajectories
 are bit-identical to the single-device runner (exact for gossip's integer
 counts; push-sum reductions differ only in float summation order).
+
+The same program spans OS processes: after parallel/mesh.initialize_distributed
+(CLI: --coordinator/--num-processes/--process-id) the mesh covers all
+processes' devices, host->device transfers go through
+`jax.make_array_from_callback` (the shardings are no longer fully
+addressable), and the collectives cross the process boundary.
+tests/test_multiprocess.py runs two real processes over gloo CPU
+collectives: gossip trajectories stay bit-identical to the single-process
+mesh (the random stream is process-count-invariant); push-sum round counts
+may shift (cross-process reductions reassociate float sums, and the
+3-stable-rounds termination test amplifies ulp differences) while
+convergence quality is unchanged.
 """
 
 from __future__ import annotations
@@ -104,7 +116,18 @@ def run_sharded(
         )
 
     def dev_put(host_array, sharding=shard):
-        return jax.device_put(jnp.asarray(host_array), sharding)
+        """Host -> global device array. When the mesh spans processes
+        (jax.distributed multi-host: parallel/mesh.initialize_distributed)
+        the sharding is not fully addressable and `jax.device_put` cannot
+        build the global array; every process instead materializes its own
+        addressable shards from the (deterministically rebuilt) host array.
+        """
+        host_array = np.asarray(host_array)
+        if sharding.is_fully_addressable:
+            return jax.device_put(jnp.asarray(host_array), sharding)
+        return jax.make_array_from_callback(
+            host_array.shape, sharding, lambda idx: host_array[idx]
+        )
 
     valid = dev_put(np.arange(n_pad) < n)
     if topo.implicit:
@@ -284,15 +307,18 @@ def run_sharded(
         )
     )
 
+    def rep_put(x):
+        return dev_put(x, repl)
+
     carry = (
         state0,
-        jax.device_put(jnp.int32(start_round), repl),
-        jax.device_put(jnp.bool_(False), repl),
+        rep_put(np.int32(start_round)),
+        rep_put(np.bool_(False)),
     )
 
     t0 = time.perf_counter()
     carry = jax.block_until_ready(
-        chunk_sharded(carry, jax.device_put(jnp.int32(start_round), repl), *topo_args)
+        chunk_sharded(carry, rep_put(np.int32(start_round)), *topo_args)
     )
     compile_s = time.perf_counter() - t0
 
@@ -301,7 +327,7 @@ def run_sharded(
     while True:
         round_end = min(rounds + cfg.chunk_rounds, cfg.max_rounds)
         carry = chunk_sharded(
-            carry, jax.device_put(jnp.int32(round_end), repl), *topo_args
+            carry, rep_put(np.int32(round_end)), *topo_args
         )
         state, rnd, done = carry
         rounds = int(rnd)  # host sync at the chunk boundary
@@ -327,12 +353,14 @@ def run_sharded(
         run_s=run_s,
     )
     if cfg.algorithm == "push-sum":
-        s_host = np.asarray(state.s)[:n]
-        w_host = np.asarray(state.w)[:n]
-        conv_host = np.asarray(state.conv)[:n]
-        ratio = np.divide(s_host, w_host, out=np.zeros_like(s_host), where=w_host != 0)
+        # jnp reductions, not host numpy: when the mesh spans processes the
+        # state arrays are not host-addressable, but every process can run
+        # the same global reduction (replicated scalar out). Padded slots
+        # never converge, so gating on `conv` also excludes them.
         true_mean = (n - 1) / 2.0
-        err = np.where(conv_host, np.abs(ratio - true_mean), 0.0)
+        w_safe = jnp.where(state.w != 0, state.w, 1)
+        ratio = jnp.where(state.w != 0, state.s / w_safe, 0.0)
+        err = jnp.where(state.conv, jnp.abs(ratio - true_mean), 0.0)
         result.true_mean = true_mean
-        result.estimate_mae = float(err.sum() / max(converged_count, 1))
+        result.estimate_mae = float(jnp.sum(err)) / max(converged_count, 1)
     return result

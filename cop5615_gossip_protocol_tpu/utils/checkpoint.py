@@ -52,9 +52,9 @@ def load(path: str | Path):
     path = _normalize(path)
     with np.load(path) as z:
         rounds = int(z["__rounds__"])
-        # Pre-marker checkpoints are of unknown stream version; treat as 1
-        # (the conservative reading — rejection beats a silently divergent
-        # resume).
+        # Pre-marker checkpoints are of unknown stream version; for configs
+        # that consume a changed stream they are rejected below (rejection
+        # beats a silently divergent resume).
         stream = int(z["__stream__"]) if "__stream__" in z.files else None
         fields = {
             k: z[k] for k in z.files if k not in ("__rounds__", "__stream__")

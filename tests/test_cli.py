@@ -157,3 +157,42 @@ def test_checkpoint_rejects_mismatched_stream_version(tmp_path):
     rewrite_stream(1)
     _, rounds, _ = ckpt.load(p)
     assert rounds == 32
+
+
+def test_cli_checkpoint_resume_across_device_counts(tmp_path, capsys):
+    # Checkpoints hold exactly n entries (the sharded runner's device padding
+    # is stripped on save), so a run checkpointed under one mesh size resumes
+    # under another — or single-device. Gossip integer state + device-count-
+    # invariant stream => identical total rounds everywhere. n=1001 makes the
+    # 8-device padding (1008) visible if it ever leaks into the file.
+    args = ["1001", "full", "gossip", "--chunk-rounds", "16"]
+    rc = main(args + ["--devices", "8"])
+    full_rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0
+
+    ck = tmp_path / "ck.npz"
+    rc = main(args + ["--devices", "8", "--max-rounds", "16",
+                      "--checkpoint", str(ck)])
+    capsys.readouterr()
+    assert rc == 1 and ck.exists()
+
+    import numpy as np
+    with np.load(ck) as z:
+        assert z["count"].shape == (1001,)  # padding stripped
+
+    for extra in (["--devices", "4"], []):  # different mesh, single device
+        rc = main(args + extra + ["--resume", str(ck)])
+        rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert rc == 0, extra
+        assert rec["rounds"] == full_rec["rounds"], extra
+        assert rec["converged_count"] == full_rec["converged_count"], extra
+
+
+def test_cli_coordinator_flag_validation(capsys):
+    rc = main(["64", "full", "gossip", "--coordinator", "127.0.0.1:1"])
+    assert rc == 2
+    assert "--num-processes" in capsys.readouterr().err
+    rc = main(["64", "full", "gossip", "--devices", "8", "--coordinator",
+               "127.0.0.1:1", "--num-processes", "3", "--process-id", "0"])
+    assert rc == 2
+    assert "divisible" in capsys.readouterr().err
