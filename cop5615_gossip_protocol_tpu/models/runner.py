@@ -314,31 +314,39 @@ def _run_fused(
     start_state,
     start_round: int,
     interpret: bool,
-    pool: bool = False,
+    variant: str = "stencil",
 ) -> RunResult:
     """Chunk loop over a Pallas multi-round engine: one kernel launch per
     cfg.chunk_rounds rounds, state resident in VMEM for the whole chunk.
-    ``pool=False`` drives the stencil engine (ops/fused.py, explicit
-    offset-structured topologies); ``pool=True`` the implicit-full pool
-    engine (ops/fused_pool.py), whose chunks additionally consume the
-    per-round displacement pools."""
+    ``variant`` picks the kernel family: "stencil" — the whole-array engine
+    (ops/fused.py, offset-structured topologies to ~128k aligned nodes);
+    "stencil2" — its tiled big-population extension (ops/fused_stencil.py);
+    "pool" — the implicit-full pool engine (ops/fused_pool.py), whose
+    chunks additionally consume the per-round displacement pools."""
     from ..ops import fused
-    from ..ops import fused_pool
 
     target = cfg.resolved_target_count(topo.n, topo.target_count)
-    if pool:
+
+    def extra_args(start, count):
+        return ()
+
+    if variant == "pool":
+        from ..ops import fused_pool
+
         make_pushsum = fused_pool.make_pushsum_pool_chunk
         make_gossip = fused_pool.make_gossip_pool_chunk
 
-        def extra_args(start, count):
+        def extra_args(start, count):  # noqa: F811
             return (fused_pool.round_offsets(key, start, count, cfg.pool_size, topo.n),)
 
+    elif variant == "stencil2":
+        from ..ops import fused_stencil
+
+        make_pushsum = fused_stencil.make_pushsum_stencil2_chunk
+        make_gossip = fused_stencil.make_gossip_stencil2_chunk
     else:
         make_pushsum = fused.make_pushsum_chunk
         make_gossip = fused.make_gossip_chunk
-
-        def extra_args(start, count):
-            return ()
 
     if cfg.algorithm == "push-sum":
         chunk_fn, layout = make_pushsum(topo, cfg, interpret=interpret)
@@ -486,19 +494,29 @@ def run(
         # delivery on the implicit full topology (ops/fused_pool.py — the
         # flagship benchmark path, ~2.7x the chunked pool round on v5e),
         # the stencil engine otherwise (ops/fused.py).
-        pool = cfg.delivery == "pool"
-        if pool:
+        if cfg.delivery == "pool":
             from ..ops import fused_pool
 
+            variant = "pool"
             reason = fused_pool.pool_fused_support(topo, cfg)
             auto_ok = reason is None
         else:
             from ..ops import fused
 
-            reason = fused.fused_support(topo, cfg)
+            # The proven whole-array engine keeps its domain; the tiled
+            # stencil2 engine takes over where v1 refuses (population past
+            # 128k, wrap topologies at unaligned n).
+            reason_v1 = fused.fused_support(topo, cfg)
+            if reason_v1 is None:
+                variant, reason = "stencil", None
+            else:
+                from ..ops import fused_stencil
+
+                variant = "stencil2"
+                reason = fused_stencil.stencil2_support(topo, cfg)
             auto_ok = reason is None and cfg.delivery == "auto"
         if cfg.engine == "fused":
-            if not pool and cfg.delivery == "scatter":
+            if variant != "pool" and cfg.delivery == "scatter":
                 raise ValueError(
                     "engine='fused' delivers via the stencil formulation "
                     "only; delivery='scatter' would be silently ignored — "
@@ -509,14 +527,14 @@ def run(
             # Explicit fused runs everywhere: interpreted off-TPU (tests).
             return _run_fused(
                 topo, cfg, key, on_chunk, start_state, start_round,
-                interpret=jax.default_backend() != "tpu", pool=pool,
+                interpret=jax.default_backend() != "tpu", variant=variant,
             )
         # auto: compiled engines on TPU only — interpret mode would make CPU
         # runs slower, and the chunked XLA path is already fast there.
         if auto_ok and jax.default_backend() == "tpu":
             return _run_fused(
                 topo, cfg, key, on_chunk, start_state, start_round,
-                interpret=False, pool=pool,
+                interpret=False, variant=variant,
             )
 
     round_fn, state0, topo_args = make_round_fn(topo, cfg, key)

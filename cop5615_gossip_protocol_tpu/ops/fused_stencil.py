@@ -1,0 +1,521 @@
+"""Fused multi-round Pallas engine for offset-structured topologies, tiled.
+
+ops/fused.py's stencil engine keeps the whole population as single vector
+values, which caps it at ~128k nodes (register pressure) and, for wraparound
+topologies (ring/torus), at populations divisible by 128 (its padded-space
+rolls would misdeliver otherwise). This engine lifts both limits by reusing
+the pool engine's tiled architecture (ops/fused_pool.py): state and the
+per-round send/displacement planes live in VMEM scratch; a roll by any
+displacement class is a static-offset tile load from a *doubled* plane plus
+a lane rotate, with the mod-n wraparound blended exactly — so a 42^3 torus
+(74,088 nodes) or a 1M-node 100^3 torus runs fused where the v1 engine
+refuses.
+
+Differences from the pool engine:
+- sampling is per-neighbor (program.fs:91): full-width threefry words modulo
+  the node's degree, then a branchless select over the topology's
+  displacement columns (mirrors ops/sampling.targets_explicit bit-for-bit);
+- the per-round "choice" plane holds each node's sampled mod-n displacement
+  (sentinel -1 for non-senders), and delivery masks on equality with each
+  static displacement class, accumulated in ops/topology.stencil_offsets
+  order — the chunked deliver_stencil's order, so gossip trajectories stay
+  bit-identical;
+- the displacement columns and degree plane are DMA'd to VMEM once per
+  launch (they are round-invariant).
+
+Engine selection (models/runner.py): the v1 whole-array engine keeps its
+proven domain (n <= 131,072, wrap-aligned); this engine takes over beyond
+it, up to the VMEM budget in `stencil2_support`.
+
+Reference mapping: same hot loop as ops/fused.py — ChildActor handlers
+(program.fs:89-105, 110-143) + ParentActor count (program.fs:47-60) as one
+resident-state TPU program.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..config import SimConfig
+from .fused import threefry_bits_2d
+from .fused_pool import (
+    LANES,
+    TILE,
+    PoolLayout,
+    _copy_in,
+    _iota2,
+    _make_gather,
+    build_pool_layout,
+)
+from .topology import Topology, stencil_offsets
+
+# VMEM plane budget (bytes/node): 4 state + 2x2 doubled sends + 2 doubled
+# displacement plane + max_deg displacement columns + 1 degree, x4 bytes,
+# plus ~15 MB tile working set against the v5e core's ~128 MB.
+_VMEM_BUDGET = 100 * 1024 * 1024
+
+
+def _plane_bytes(n_pad: int, max_deg: int, algorithm: str, suppress: bool) -> int:
+    """Resident VMEM planes in bytes, per algorithm (4-byte words/node):
+    push-sum — 4 state + 2x2 doubled sends + 2 doubled displacement;
+    gossip — 3 state + 2 doubled marked-displacement (+2 doubled conv when
+    suppressing); both — max_deg displacement columns + 1 degree."""
+    if algorithm == "push-sum":
+        per_node = 4 + 4 + 2
+    else:
+        per_node = 3 + 2 + (2 if suppress else 0)
+    return n_pad * 4 * (per_node + max_deg + 1)
+
+
+def stencil2_support(topo: Topology, cfg: SimConfig) -> Optional[str]:
+    """None if the tiled stencil engine can run this config, else why not."""
+    if topo.implicit:
+        return "implicit (full) topology has no displacement structure"
+    offsets = stencil_offsets(topo)
+    if offsets is None:
+        return f"topology {topo.kind!r} has no small displacement set"
+    if cfg.dtype != "float32":
+        return "fused engine supports float32 only"
+    if not jax.config.jax_threefry_partitionable:
+        return (
+            "requires jax_threefry_partitionable=True (the in-kernel "
+            "threefry replicates the partitionable stream only)"
+        )
+    if cfg.fault_rate > 0:
+        return "fault injection not supported in the fused kernel"
+    if cfg.n_devices is not None and cfg.n_devices > 1:
+        return "fused engine is single-device"
+    layout = build_pool_layout(topo.n)
+    suppress = cfg.algorithm == "gossip" and cfg.resolved_suppress
+    if _plane_bytes(layout.n_pad, topo.max_deg, cfg.algorithm, suppress) > _VMEM_BUDGET:
+        return (
+            f"population {topo.n} (max_deg {topo.max_deg}) exceeds the "
+            "VMEM-resident plane budget"
+        )
+    return None
+
+
+def _make_blends(layout: PoolLayout, interpret: bool):
+    """Mod-n roll readers: blend the padded-space roll by e (flat j >= e)
+    with its wraparound variant (roll by e + Z) below e — exact for any
+    population, which is what lets this engine serve wrap topologies at
+    n % 128 != 0."""
+    gather, gather_plain = _make_gather(layout, interpret)
+    Z = layout.n_pad - layout.n
+
+    def gather_blend(choice_plane, value_planes, e, t, slot, jflat):
+        a = gather(choice_plane, value_planes, e, t, slot)
+        b = gather(choice_plane, value_planes, e + Z, t, slot)
+        take = jflat >= e
+        return tuple(jnp.where(take, x, y) for x, y in zip(a, b))
+
+    def gather_plain_blend(plane, e, t, jflat):
+        return jnp.where(
+            jflat >= e,
+            gather_plain(plane, e, t),
+            gather_plain(plane, e + Z, t),
+        )
+
+    return gather_blend, gather_plain_blend
+
+
+def _build_disp_planes(topo: Topology, layout: PoolLayout):
+    """[max_deg, rows, 128] int32 mod-n displacement per neighbor slot
+    (sentinel 0 on dead slots — masked by degree before use) and the
+    [rows, 128] degree plane."""
+    n, n_pad = topo.n, layout.n_pad
+    ids = np.arange(n, dtype=np.int64)[:, None]
+    disp = (topo.neighbors.astype(np.int64) - ids) % n
+    cols = np.arange(topo.max_deg)[None, :]
+    disp = np.where(cols < topo.degree[:, None], disp, 0)
+    disp_cols = np.zeros((topo.max_deg, n_pad), dtype=np.int32)
+    disp_cols[:, :n] = disp.T
+    degree = np.zeros((n_pad,), dtype=np.int32)
+    degree[:n] = topo.degree
+    return (
+        disp_cols.reshape(topo.max_deg, layout.rows, LANES),
+        degree.reshape(layout.rows, LANES),
+    )
+
+
+def _sample_disp_tile(k1, k2, t, disp_refs, deg_tile):
+    """Per-node sampled mod-n displacement for tile t — bit-compatible with
+    ops/sampling.targets_explicit (full-width words % degree, branchless
+    column select)."""
+    bits = threefry_bits_2d(k1, k2, TILE, LANES, row0=t * TILE)
+    deg_safe = jnp.maximum(deg_tile, 1).astype(jnp.uint32)
+    slot = (bits % deg_safe).astype(jnp.int32)
+    d = disp_refs[0]
+    for j in range(1, len(disp_refs)):
+        d = jnp.where(slot == j, disp_refs[j], d)
+    return d
+
+
+def make_pushsum_stencil2_chunk(
+    topo: Topology, cfg: SimConfig, *, interpret: bool = False
+):
+    """Returns (chunk_fn, layout): ``chunk_fn(state4, keys, start, cap)`` —
+    same contract as ops/fused.make_pushsum_chunk, implemented with the
+    tiled doubled-plane delivery so it scales to ~1M nodes and any n."""
+    layout = build_pool_layout(topo.n)
+    R, T = layout.rows, layout.tiles
+    N = layout.n
+    offsets = [int(d) for d in stencil_offsets(topo)]
+    delta = np.float32(cfg.resolved_delta)
+    term_rounds = np.int32(cfg.term_rounds)
+    target = np.int32(cfg.resolved_target_count(topo.n, topo.target_count))
+    disp_np, deg_np = _build_disp_planes(topo, layout)
+    max_deg = topo.max_deg
+
+    def kernel(
+        start_ref, keys_ref, disp_h, deg_h, s0, w0, t0, c0,
+        s_o, w_o, t_o, c_o, meta_o,
+        s_v, w_v, t_v, c_v, ds_v, dw_v, dd_v, disp_v, deg_v, flags, sems,
+    ):
+        k = pl.program_id(0)
+        K = pl.num_programs(0)
+        gather_blend, _ = _make_blends(layout, interpret)
+        row_l = _iota2((TILE, LANES), 0)
+        lane = _iota2((TILE, LANES), 1)
+
+        @pl.when(k == 0)
+        def _init():
+            _copy_in(
+                [(s0, s_v), (w0, w_v), (t0, t_v), (c0, c_v),
+                 (disp_h, disp_v), (deg_h, deg_v)],
+                sems,
+            )
+            flags[0] = jnp.where(
+                jnp.sum(c_v[:], dtype=jnp.int32) >= target, 1, 0
+            )
+            flags[1] = 0
+
+        active = (flags[0] == 0) & (start_ref[0] + k < start_ref[1])
+
+        @pl.when(active)
+        def _round():
+            kk = k % 8
+            k1 = keys_ref[kk, 0]
+            k2 = keys_ref[kk, 1]
+
+            def p1(t, _):
+                r0 = t * TILE
+                deg = deg_v[pl.ds(r0, TILE), :]
+                disp_refs = [
+                    disp_v[j, pl.ds(r0, TILE), :] for j in range(max_deg)
+                ]
+                d = _sample_disp_tile(k1, k2, t, disp_refs, deg)
+                padm = (r0 + row_l) * LANES + lane >= N
+                send_ok = (deg > 0) & ~padm
+                ss = jnp.where(send_ok, s_v[pl.ds(r0, TILE), :] * 0.5, 0.0)
+                ws = jnp.where(send_ok, w_v[pl.ds(r0, TILE), :] * 0.5, 0.0)
+                marked = jnp.where(send_ok, d, jnp.int32(-1))
+                ds_v[pl.ds(r0, TILE), :] = ss
+                ds_v[pl.ds(R + r0, TILE), :] = ss
+                dw_v[pl.ds(r0, TILE), :] = ws
+                dw_v[pl.ds(R + r0, TILE), :] = ws
+                dd_v[pl.ds(r0, TILE), :] = marked
+                dd_v[pl.ds(R + r0, TILE), :] = marked
+                return 0
+
+            lax.fori_loop(0, T, p1, 0)
+
+            def p2(t, acc):
+                r0 = t * TILE
+                jflat = (r0 + row_l) * LANES + lane
+                padm = jflat >= N
+                inbox_s = jnp.zeros((TILE, LANES), jnp.float32)
+                inbox_w = jnp.zeros((TILE, LANES), jnp.float32)
+                planes = ((ds_v, jnp.float32(0)), (dw_v, jnp.float32(0)))
+                for d_c in offsets:  # static classes, deliver_stencil order
+                    s1, w1 = gather_blend(dd_v, planes, d_c, t, d_c, jflat)
+                    inbox_s = inbox_s + s1
+                    inbox_w = inbox_w + w1
+                inbox_s = jnp.where(padm, 0.0, inbox_s)
+                inbox_w = jnp.where(padm, 0.0, inbox_w)
+                # Absorb — mirrors models/pushsum.absorb (program.fs:119-143).
+                s_t = s_v[pl.ds(r0, TILE), :]
+                w_t = w_v[pl.ds(r0, TILE), :]
+                s_new = (s_t - ds_v[pl.ds(r0, TILE), :]) + inbox_s
+                w_new = (w_t - dw_v[pl.ds(r0, TILE), :]) + inbox_w
+                received = inbox_w > 0
+                stable = jnp.abs(s_new / w_new - s_t / w_t) <= delta
+                term = t_v[pl.ds(r0, TILE), :]
+                term_new = jnp.where(
+                    received, jnp.where(stable, term + 1, jnp.int32(0)), term
+                )
+                conv_new = jnp.where(
+                    padm,
+                    jnp.int32(0),
+                    jnp.where(
+                        (c_v[pl.ds(r0, TILE), :] != 0)
+                        | (term_new >= term_rounds),
+                        jnp.int32(1),
+                        jnp.int32(0),
+                    ),
+                )
+                s_v[pl.ds(r0, TILE), :] = s_new
+                w_v[pl.ds(r0, TILE), :] = w_new
+                t_v[pl.ds(r0, TILE), :] = term_new
+                c_v[pl.ds(r0, TILE), :] = conv_new
+                return acc + jnp.sum(conv_new, dtype=jnp.int32)
+
+            total = lax.fori_loop(0, T, p2, jnp.int32(0))
+            flags[1] = flags[1] + 1
+            flags[0] = jnp.where(total >= target, 1, 0)
+
+        @pl.when(k == K - 1)
+        def _emit():
+            _copy_in([(s_v, s_o), (w_v, w_o), (t_v, t_o), (c_v, c_o)], sems)
+            meta_o[0] = flags[1]
+
+    disp_dev = jnp.asarray(disp_np)
+    deg_dev = jnp.asarray(deg_np)
+
+    def chunk_fn(state4, keys, start, cap):
+        from .fused import clamp_cap_and_pad
+
+        s, w, t, c = state4
+        cap, keys = clamp_cap_and_pad(start, cap, keys)
+        K = keys.shape[0]
+        f32 = jax.ShapeDtypeStruct((R, LANES), jnp.float32)
+        i32 = jax.ShapeDtypeStruct((R, LANES), jnp.int32)
+        outs = pl.pallas_call(
+            kernel,
+            grid=(K,),
+            out_shape=(f32, f32, i32, i32, jax.ShapeDtypeStruct((1,), jnp.int32)),
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec((8, 2), lambda k: (k // 8, 0), memory_space=pltpu.SMEM),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=(
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((R, LANES), jnp.float32),
+                pltpu.VMEM((R, LANES), jnp.float32),
+                pltpu.VMEM((R, LANES), jnp.int32),
+                pltpu.VMEM((R, LANES), jnp.int32),
+                pltpu.VMEM((2 * R, LANES), jnp.float32),
+                pltpu.VMEM((2 * R, LANES), jnp.float32),
+                pltpu.VMEM((2 * R, LANES), jnp.int32),
+                pltpu.VMEM((max_deg, R, LANES), jnp.int32),
+                pltpu.VMEM((R, LANES), jnp.int32),
+                pltpu.SMEM((2,), jnp.int32),
+                pltpu.SemaphoreType.DMA((6,)),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=124 * 1024 * 1024
+            ),
+            interpret=interpret,
+        )(
+            jnp.stack([jnp.int32(start), jnp.int32(cap)]),
+            keys,
+            disp_dev,
+            deg_dev,
+            s, w, t, c,
+        )
+        s2, w2, t2, c2, meta = outs
+        return (s2, w2, t2, c2), meta[0]
+
+    return chunk_fn, layout
+
+
+def make_gossip_stencil2_chunk(
+    topo: Topology, cfg: SimConfig, *, interpret: bool = False
+):
+    """Gossip analog. Suppression (the reference's dictionary probe,
+    program.fs:92) reads last round's conv plane at each node's sampled
+    target — a backward roll per displacement class through the doubled
+    conv plane, selected at the destination by the sampled class."""
+    layout = build_pool_layout(topo.n)
+    R, T = layout.rows, layout.tiles
+    N = layout.n
+    offsets = [int(d) for d in stencil_offsets(topo)]
+    rumor_target = np.int32(cfg.resolved_rumor_target)
+    suppress = cfg.resolved_suppress
+    target = np.int32(cfg.resolved_target_count(topo.n, topo.target_count))
+    disp_np, deg_np = _build_disp_planes(topo, layout)
+    max_deg = topo.max_deg
+
+    def kernel(*refs):
+        if suppress:
+            (start_ref, keys_ref, disp_h, deg_h, n0, a0, c0,
+             n_o, a_o, c_o, meta_o,
+             n_v, a_v, c_v, dd_v, dcv_v, disp_v, deg_v, flags, sems) = refs
+        else:
+            (start_ref, keys_ref, disp_h, deg_h, n0, a0, c0,
+             n_o, a_o, c_o, meta_o,
+             n_v, a_v, c_v, dd_v, disp_v, deg_v, flags, sems) = refs
+            dcv_v = None
+        k = pl.program_id(0)
+        K = pl.num_programs(0)
+        _, gather_plain_blend = _make_blends(layout, interpret)
+        row_l = _iota2((TILE, LANES), 0)
+        lane = _iota2((TILE, LANES), 1)
+
+        @pl.when(k == 0)
+        def _init():
+            _copy_in(
+                [(n0, n_v), (a0, a_v), (c0, c_v),
+                 (disp_h, disp_v), (deg_h, deg_v)],
+                sems,
+            )
+            flags[0] = jnp.where(
+                jnp.sum(c_v[:], dtype=jnp.int32) >= target, 1, 0
+            )
+            flags[1] = 0
+
+        active_chunk = (flags[0] == 0) & (start_ref[0] + k < start_ref[1])
+
+        @pl.when(active_chunk)
+        def _round():
+            kk = k % 8
+            k1 = keys_ref[kk, 0]
+            k2 = keys_ref[kk, 1]
+
+            if suppress:
+
+                def p0(t, _):
+                    r0 = t * TILE
+                    conv = c_v[pl.ds(r0, TILE), :]
+                    dcv_v[pl.ds(r0, TILE), :] = conv
+                    dcv_v[pl.ds(R + r0, TILE), :] = conv
+                    return 0
+
+                lax.fori_loop(0, T, p0, 0)
+
+            def p1(t, _):
+                r0 = t * TILE
+                deg = deg_v[pl.ds(r0, TILE), :]
+                disp_refs = [
+                    disp_v[j, pl.ds(r0, TILE), :] for j in range(max_deg)
+                ]
+                d = _sample_disp_tile(k1, k2, t, disp_refs, deg)
+                jflat = (r0 + row_l) * LANES + lane
+                padm = jflat >= N
+                sending = (a_v[pl.ds(r0, TILE), :] != 0) & (deg > 0) & ~padm
+                if suppress:
+                    cot = jnp.zeros((TILE, LANES), jnp.int32)
+                    for d_c in offsets:
+                        g = gather_plain_blend(dcv_v, N - d_c, t, jflat)
+                        cot = jnp.where(d == d_c, g, cot)
+                    sending = sending & (cot == 0)
+                marked = jnp.where(sending, d, jnp.int32(-1))
+                dd_v[pl.ds(r0, TILE), :] = marked
+                dd_v[pl.ds(R + r0, TILE), :] = marked
+                return 0
+
+            lax.fori_loop(0, T, p1, 0)
+
+            def p2(t, acc):
+                r0 = t * TILE
+                jflat = (r0 + row_l) * LANES + lane
+                padm = jflat >= N
+                inbox = jnp.zeros((TILE, LANES), jnp.int32)
+                for d_c in offsets:
+                    g = gather_plain_blend(dd_v, d_c, t, jflat)
+                    inbox = inbox + jnp.where(
+                        g == d_c, jnp.int32(1), jnp.int32(0)
+                    )
+                inbox = jnp.where(padm, jnp.int32(0), inbox)
+                # Absorb — mirrors models/gossip.absorb (program.fs:97-105).
+                count_new = n_v[pl.ds(r0, TILE), :] + inbox
+                active_new = jnp.where(
+                    (a_v[pl.ds(r0, TILE), :] != 0) | (inbox > 0),
+                    jnp.int32(1),
+                    jnp.int32(0),
+                )
+                conv_new = jnp.where(
+                    count_new >= rumor_target, jnp.int32(1), jnp.int32(0)
+                )
+                n_v[pl.ds(r0, TILE), :] = count_new
+                a_v[pl.ds(r0, TILE), :] = active_new
+                c_v[pl.ds(r0, TILE), :] = conv_new
+                return acc + jnp.sum(conv_new, dtype=jnp.int32)
+
+            total = lax.fori_loop(0, T, p2, jnp.int32(0))
+            flags[1] = flags[1] + 1
+            flags[0] = jnp.where(total >= target, 1, 0)
+
+        @pl.when(k == K - 1)
+        def _emit():
+            _copy_in([(n_v, n_o), (a_v, a_o), (c_v, c_o)], sems)
+            meta_o[0] = flags[1]
+
+    disp_dev = jnp.asarray(disp_np)
+    deg_dev = jnp.asarray(deg_np)
+
+    def chunk_fn(state3, keys, start, cap):
+        from .fused import clamp_cap_and_pad
+
+        cnt, act, cv = state3
+        cap, keys = clamp_cap_and_pad(start, cap, keys)
+        i32 = jax.ShapeDtypeStruct((R, LANES), jnp.int32)
+        scratch = [
+            pltpu.VMEM((R, LANES), jnp.int32),
+            pltpu.VMEM((R, LANES), jnp.int32),
+            pltpu.VMEM((R, LANES), jnp.int32),
+            pltpu.VMEM((2 * R, LANES), jnp.int32),
+        ]
+        if suppress:
+            scratch.append(pltpu.VMEM((2 * R, LANES), jnp.int32))
+        scratch += [
+            pltpu.VMEM((max_deg, R, LANES), jnp.int32),
+            pltpu.VMEM((R, LANES), jnp.int32),
+            pltpu.SMEM((2,), jnp.int32),
+            pltpu.SemaphoreType.DMA((5,)),
+        ]
+        outs = pl.pallas_call(
+            kernel,
+            grid=(keys.shape[0],),
+            out_shape=(i32, i32, i32, jax.ShapeDtypeStruct((1,), jnp.int32)),
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec((8, 2), lambda k: (k // 8, 0), memory_space=pltpu.SMEM),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=(
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+            ),
+            scratch_shapes=scratch,
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=124 * 1024 * 1024
+            ),
+            interpret=interpret,
+        )(
+            jnp.stack([jnp.int32(start), jnp.int32(cap)]),
+            keys,
+            disp_dev,
+            deg_dev,
+            cnt, act, cv,
+        )
+        n2, a2, c2, meta = outs
+        return (n2, a2, c2), meta[0]
+
+    return chunk_fn, layout

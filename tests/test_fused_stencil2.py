@@ -1,0 +1,131 @@
+"""Tiled stencil engine (ops/fused_stencil.py), interpret mode on CPU.
+
+The engine exists for populations the v1 whole-array engine refuses —
+n > 131,072 and wraparound topologies at n % 128 != 0 — so every config
+here is chosen to be v1-ineligible, making engine='fused' route through
+stencil2. Oracles mirror tests/test_fused.py: gossip bitwise vs the
+chunked XLA stencil path, push-sum on rounds/estimates, resume, gating.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from cop5615_gossip_protocol_tpu import SimConfig, build_topology
+from cop5615_gossip_protocol_tpu.models.runner import run
+from cop5615_gossip_protocol_tpu.ops import fused, fused_stencil
+
+
+def _cfg(n, kind, algorithm="gossip", engine="fused", **kw):
+    kw.setdefault("max_rounds", 200_000)
+    kw.setdefault("chunk_rounds", 32)
+    return SimConfig(n=n, topology=kind, algorithm=algorithm,
+                     engine=engine, **kw)
+
+
+def test_v1_refuses_these_configs():
+    # Guard the premise: every config below is v1-ineligible, so
+    # engine='fused' exercises stencil2.
+    topo = build_topology("torus3d", 1000)  # pop 729, wrap + unaligned
+    assert fused.fused_support(topo, _cfg(1000, "torus3d")) is not None
+    assert fused_stencil.stencil2_support(topo, _cfg(1000, "torus3d")) is None
+
+
+@pytest.mark.parametrize("kind,n", [("torus3d", 1000), ("ring", 300)])
+def test_stencil2_gossip_matches_chunked_bitwise(kind, n):
+    # Wraparound displacements at n % 128 != 0 — the exact case the v1
+    # engine's padded-space rolls cannot express; the tiled engine's mod-n
+    # blend must reproduce the chunked trajectory bit-for-bit.
+    results = {}
+    for engine in ["chunked", "fused"]:
+        r = run(build_topology(kind, n), _cfg(n, kind, engine=engine))
+        results[engine] = r
+    a, b = results["chunked"], results["fused"]
+    assert a.converged and b.converged
+    assert a.rounds == b.rounds
+    assert a.converged_count == b.converged_count
+
+
+def test_stencil2_gossip_suppression():
+    n = 1000  # torus pop 729
+    results = {}
+    for engine in ["chunked", "fused"]:
+        r = run(build_topology("torus3d", n),
+                _cfg(n, "torus3d", engine=engine, suppress_converged=True))
+        results[engine] = r
+    a, b = results["chunked"], results["fused"]
+    assert a.rounds == b.rounds
+    assert a.converged_count == b.converged_count
+
+
+def test_stencil2_pushsum_matches_chunked():
+    n = 1000  # torus pop 729
+    results = {}
+    for engine in ["chunked", "fused"]:
+        r = run(build_topology("torus3d", n),
+                _cfg(n, "torus3d", algorithm="push-sum", engine=engine,
+                     chunk_rounds=256))
+        results[engine] = r
+    a, b = results["chunked"], results["fused"]
+    assert a.converged and b.converged
+    assert a.rounds == b.rounds
+    assert abs(a.estimate_mae - b.estimate_mae) < 1e-3
+
+
+def test_stencil2_resume_midway():
+    n = 1000
+    cfg = _cfg(n, "torus3d", chunk_rounds=8)
+    topo = build_topology("torus3d", n)
+    snaps = []
+    full = run(topo, cfg, on_chunk=lambda r, s: snaps.append((r, s)))
+    assert len(snaps) >= 2
+    r0, s0 = snaps[0]
+    resumed = run(topo, cfg, start_state=jax.tree.map(jnp.asarray, s0),
+                  start_round=r0)
+    assert resumed.rounds == full.rounds
+    assert resumed.converged_count == full.converged_count
+
+
+@pytest.mark.parametrize("chunk_rounds", [5, 100])
+def test_stencil2_chunk_rounds_not_multiple_of_8(chunk_rounds):
+    n = 1000
+    a = run(build_topology("torus3d", n), _cfg(n, "torus3d", engine="chunked"))
+    b = run(build_topology("torus3d", n),
+            _cfg(n, "torus3d", chunk_rounds=chunk_rounds))
+    assert a.rounds == b.rounds
+    assert a.converged_count == b.converged_count
+
+
+def test_stencil2_support_gating():
+    # imp3d has random long-range edges — no displacement structure.
+    topo = build_topology("imp3d", 1000)
+    assert "displacement" in fused_stencil.stencil2_support(
+        topo, _cfg(1000, "imp3d")
+    )
+    # Budget: a torus past the VMEM plane budget is refused with the reason.
+    big = build_topology("torus3d", 8_000_000)
+    assert "budget" in fused_stencil.stencil2_support(
+        big, _cfg(8_000_000, "torus3d")
+    )
+    with pytest.raises(ValueError, match="unavailable"):
+        run(big, _cfg(8_000_000, "torus3d"))
+
+
+def test_v1_still_preferred_where_eligible(monkeypatch):
+    # Small aligned configs keep the proven v1 engine.
+    from cop5615_gossip_protocol_tpu.models import runner as runner_mod
+
+    seen = {}
+    real = runner_mod._run_fused
+
+    def spy(topo, cfg, key, on_chunk, start_state, start_round, interpret,
+            variant="stencil"):
+        seen["variant"] = variant
+        return real(topo, cfg, key, on_chunk, start_state, start_round,
+                    interpret, variant=variant)
+
+    monkeypatch.setattr(runner_mod, "_run_fused", spy)
+    r = run(build_topology("grid2d", 144),
+            _cfg(144, "grid2d", max_rounds=4000))
+    assert r.converged
+    assert seen == {"variant": "stencil"}

@@ -113,11 +113,11 @@ def test_auto_engine_selects_compiled_fused(monkeypatch):
     real = runner_mod._run_fused
 
     def spy(topo, cfg, key, on_chunk, start_state, start_round, interpret,
-            pool=False):
+            variant="stencil"):
         seen["interpret"] = interpret
-        seen["pool"] = pool
+        seen["variant"] = variant
         return real(topo, cfg, key, on_chunk, start_state, start_round,
-                    interpret, pool=pool)
+                    interpret, variant=variant)
 
     monkeypatch.setattr(runner_mod, "_run_fused", spy)
     n = 1024
@@ -125,4 +125,4 @@ def test_auto_engine_selects_compiled_fused(monkeypatch):
                     max_rounds=20000, chunk_rounds=64)
     res = run(build_topology("grid2d", n), cfg)
     assert res.converged
-    assert seen == {"interpret": False, "pool": False}
+    assert seen == {"interpret": False, "variant": "stencil"}

@@ -125,15 +125,15 @@ def test_auto_engine_selects_compiled_pool(monkeypatch):
     real = runner_mod._run_fused
 
     def spy(topo, cfg, key, on_chunk, start_state, start_round, interpret,
-            pool=False):
+            variant="stencil"):
         seen["interpret"] = interpret
-        seen["pool"] = pool
+        seen["variant"] = variant
         return real(topo, cfg, key, on_chunk, start_state, start_round,
-                    interpret, pool=pool)
+                    interpret, variant=variant)
 
     monkeypatch.setattr(runner_mod, "_run_fused", spy)
     n = 10_000
     res = run(build_topology("full", n),
               _cfg(n, algorithm="push-sum", engine="auto"))
     assert res.converged
-    assert seen == {"interpret": False, "pool": True}
+    assert seen == {"interpret": False, "variant": "pool"}

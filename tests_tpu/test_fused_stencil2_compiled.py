@@ -1,0 +1,85 @@
+"""Compiled (interpret=False) tiled stencil engine on a real TPU chip.
+
+Every config is v1-ineligible (population past 131,072, or wraparound at
+n % 128 != 0), so engine='fused' exercises ops/fused_stencil.py's compiled
+path: static-displacement tile gathers over doubled VMEM planes, the mod-n
+wraparound blend, and the per-neighbor sampling select — none of which the
+interpret-mode CPU suite's `jnp.roll` forks touch.
+
+Run on a chip: python -m pytest tests_tpu -q
+Latest recorded run: tests_tpu/RUNLOG.md
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cop5615_gossip_protocol_tpu import SimConfig, build_topology
+from cop5615_gossip_protocol_tpu.models.runner import run
+
+
+def _run_with_final_state(topo, cfg):
+    snaps = []
+    res = run(topo, cfg, on_chunk=lambda r, s: snaps.append((r, s)))
+    assert snaps
+    return res, snaps[-1][1]
+
+
+def _assert_states_bitwise(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb) > 0
+    for av, bv in zip(la, lb):
+        assert (np.asarray(av) == np.asarray(bv)).all()
+
+
+@pytest.mark.parametrize(
+    "n",
+    [
+        1000,       # pop 729: wrap + unaligned — v1's hard-refused case
+        262_144,    # 64^3: aligned but past v1's 128k cap
+        1_000_000,  # 100^3: the BASELINE.md torus scale class
+    ],
+)
+def test_compiled_stencil2_gossip_matches_chunked_bitwise(n):
+    results = {}
+    for engine in ["chunked", "fused"]:
+        cfg = SimConfig(n=n, topology="torus3d", algorithm="gossip",
+                        engine=engine, max_rounds=20000, chunk_rounds=64)
+        results[engine] = _run_with_final_state(
+            build_topology("torus3d", n), cfg
+        )
+    (ra, sa), (rb, sb) = results["chunked"], results["fused"]
+    assert ra.converged and rb.converged
+    assert ra.rounds == rb.rounds
+    assert ra.converged_count == rb.converged_count
+    _assert_states_bitwise(sa, sb)
+
+
+def test_compiled_stencil2_pushsum_matches_chunked():
+    n = 262_144  # 64^3
+    results = {}
+    for engine in ["chunked", "fused"]:
+        cfg = SimConfig(n=n, topology="torus3d", algorithm="push-sum",
+                        engine=engine, max_rounds=200_000, chunk_rounds=1024)
+        results[engine] = run(build_topology("torus3d", n), cfg)
+    a, b = results["chunked"], results["fused"]
+    assert a.converged and b.converged
+    assert a.rounds == b.rounds
+    assert abs(a.estimate_mae - b.estimate_mae) < 1e-3
+
+
+def test_compiled_stencil2_resume_midway():
+    n = 262_144
+    cfg = SimConfig(n=n, topology="torus3d", algorithm="gossip",
+                    engine="fused", max_rounds=20000, chunk_rounds=32)
+    topo = build_topology("torus3d", n)
+    snaps = []
+    full = run(topo, cfg, on_chunk=lambda r, s: snaps.append((r, s)))
+    assert len(snaps) >= 2
+    r0, s0 = snaps[0]
+    resumed = run(topo, cfg, start_state=jax.tree.map(jnp.asarray, s0),
+                  start_round=r0)
+    assert resumed.rounds == full.rounds
+    assert resumed.converged_count == full.converged_count
