@@ -215,6 +215,62 @@ def _copy_in(pairs, sems):
         cp.wait()
 
 
+def absorb_pushsum_tile(r0, padm, inbox_s, inbox_w,
+                        s_v, w_v, t_v, c_v, ds_v, dw_v,
+                        delta, term_rounds):
+    """One tile of models/pushsum.absorb (program.fs:119-143) against VMEM
+    state planes: s_keep = s - s_send (sends read back from the first copy
+    of the doubled planes), term advances only on receipt, conv latches,
+    pad lanes never converge. Owns the pad masking of the inboxes — callers
+    pass them raw. Writes the tile back; returns its converged count.
+    Shared by the pool and tiled-stencil engines."""
+    inbox_s = jnp.where(padm, 0.0, inbox_s)
+    inbox_w = jnp.where(padm, 0.0, inbox_w)
+    s_t = s_v[pl.ds(r0, TILE), :]
+    w_t = w_v[pl.ds(r0, TILE), :]
+    s_new = (s_t - ds_v[pl.ds(r0, TILE), :]) + inbox_s
+    w_new = (w_t - dw_v[pl.ds(r0, TILE), :]) + inbox_w
+    received = inbox_w > 0
+    stable = jnp.abs(s_new / w_new - s_t / w_t) <= delta
+    term = t_v[pl.ds(r0, TILE), :]
+    term_new = jnp.where(
+        received, jnp.where(stable, term + 1, jnp.int32(0)), term
+    )
+    conv_new = jnp.where(
+        padm,
+        jnp.int32(0),
+        jnp.where(
+            (c_v[pl.ds(r0, TILE), :] != 0) | (term_new >= term_rounds),
+            jnp.int32(1),
+            jnp.int32(0),
+        ),
+    )
+    s_v[pl.ds(r0, TILE), :] = s_new
+    w_v[pl.ds(r0, TILE), :] = w_new
+    t_v[pl.ds(r0, TILE), :] = term_new
+    c_v[pl.ds(r0, TILE), :] = conv_new
+    return jnp.sum(conv_new, dtype=jnp.int32)
+
+
+def absorb_gossip_tile(r0, padm, inbox, n_v, a_v, c_v, rumor_target):
+    """One tile of models/gossip.absorb (program.fs:97-105) against VMEM
+    state planes. Owns the pad masking of the inbox — callers pass it raw.
+    Writes the tile back; returns its converged count. Shared by the pool
+    and tiled-stencil engines."""
+    inbox = jnp.where(padm, jnp.int32(0), inbox)
+    count_new = n_v[pl.ds(r0, TILE), :] + inbox
+    active_new = jnp.where(
+        (a_v[pl.ds(r0, TILE), :] != 0) | (inbox > 0),
+        jnp.int32(1),
+        jnp.int32(0),
+    )
+    conv_new = jnp.where(count_new >= rumor_target, jnp.int32(1), jnp.int32(0))
+    n_v[pl.ds(r0, TILE), :] = count_new
+    a_v[pl.ds(r0, TILE), :] = active_new
+    c_v[pl.ds(r0, TILE), :] = conv_new
+    return jnp.sum(conv_new, dtype=jnp.int32)
+
+
 # ---------------------------------------------------------------------------
 # Kernels. Grid = (K rounds,); planes in VMEM scratch across steps.
 # ---------------------------------------------------------------------------
@@ -297,35 +353,10 @@ def make_pushsum_pool_chunk(
                     take_main = jflat >= d
                     inbox_s = inbox_s + jnp.where(take_main, s1, s2)
                     inbox_w = inbox_w + jnp.where(take_main, w1, w2)
-                inbox_s = jnp.where(padm, 0.0, inbox_s)
-                inbox_w = jnp.where(padm, 0.0, inbox_w)
-                # Absorb — mirrors models/pushsum.absorb (program.fs:119-143):
-                # s_keep = s - s_send, term advances only on receipt.
-                s_t = s_v[pl.ds(r0, TILE), :]
-                w_t = w_v[pl.ds(r0, TILE), :]
-                s_new = (s_t - ds_v[pl.ds(r0, TILE), :]) + inbox_s
-                w_new = (w_t - dw_v[pl.ds(r0, TILE), :]) + inbox_w
-                received = inbox_w > 0
-                stable = jnp.abs(s_new / w_new - s_t / w_t) <= delta
-                term = t_v[pl.ds(r0, TILE), :]
-                term_new = jnp.where(
-                    received, jnp.where(stable, term + 1, jnp.int32(0)), term
+                return acc + absorb_pushsum_tile(
+                    r0, padm, inbox_s, inbox_w,
+                    s_v, w_v, t_v, c_v, ds_v, dw_v, delta, term_rounds,
                 )
-                conv_new = jnp.where(
-                    padm,
-                    jnp.int32(0),
-                    jnp.where(
-                        (c_v[pl.ds(r0, TILE), :] != 0)
-                        | (term_new >= term_rounds),
-                        jnp.int32(1),
-                        jnp.int32(0),
-                    ),
-                )
-                s_v[pl.ds(r0, TILE), :] = s_new
-                w_v[pl.ds(r0, TILE), :] = w_new
-                t_v[pl.ds(r0, TILE), :] = term_new
-                c_v[pl.ds(r0, TILE), :] = conv_new
-                return acc + jnp.sum(conv_new, dtype=jnp.int32)
 
             total = lax.fori_loop(0, T, p2, jnp.int32(0))
             flags[1] = flags[1] + 1
@@ -485,21 +516,9 @@ def make_gossip_pool_chunk(
                     g2 = gather_plain(dch_v, d + Z, t)
                     g = jnp.where(jflat >= d, g1, g2)
                     inbox = inbox + jnp.where(g == slot, jnp.int32(1), jnp.int32(0))
-                inbox = jnp.where(padm, jnp.int32(0), inbox)
-                # Absorb — mirrors models/gossip.absorb (program.fs:97-105).
-                count_new = n_v[pl.ds(r0, TILE), :] + inbox
-                active_new = jnp.where(
-                    (a_v[pl.ds(r0, TILE), :] != 0) | (inbox > 0),
-                    jnp.int32(1),
-                    jnp.int32(0),
+                return acc + absorb_gossip_tile(
+                    r0, padm, inbox, n_v, a_v, c_v, rumor_target
                 )
-                conv_new = jnp.where(
-                    count_new >= rumor_target, jnp.int32(1), jnp.int32(0)
-                )
-                n_v[pl.ds(r0, TILE), :] = count_new
-                a_v[pl.ds(r0, TILE), :] = active_new
-                c_v[pl.ds(r0, TILE), :] = conv_new
-                return acc + jnp.sum(conv_new, dtype=jnp.int32)
 
             total = lax.fori_loop(0, T, p2, jnp.int32(0))
             flags[1] = flags[1] + 1

@@ -53,6 +53,8 @@ from .fused_pool import (
     _copy_in,
     _iota2,
     _make_gather,
+    absorb_gossip_tile,
+    absorb_pushsum_tile,
     build_pool_layout,
 )
 from .topology import Topology, stencil_offsets
@@ -239,34 +241,10 @@ def make_pushsum_stencil2_chunk(
                     s1, w1 = gather_blend(dd_v, planes, d_c, t, d_c, jflat)
                     inbox_s = inbox_s + s1
                     inbox_w = inbox_w + w1
-                inbox_s = jnp.where(padm, 0.0, inbox_s)
-                inbox_w = jnp.where(padm, 0.0, inbox_w)
-                # Absorb — mirrors models/pushsum.absorb (program.fs:119-143).
-                s_t = s_v[pl.ds(r0, TILE), :]
-                w_t = w_v[pl.ds(r0, TILE), :]
-                s_new = (s_t - ds_v[pl.ds(r0, TILE), :]) + inbox_s
-                w_new = (w_t - dw_v[pl.ds(r0, TILE), :]) + inbox_w
-                received = inbox_w > 0
-                stable = jnp.abs(s_new / w_new - s_t / w_t) <= delta
-                term = t_v[pl.ds(r0, TILE), :]
-                term_new = jnp.where(
-                    received, jnp.where(stable, term + 1, jnp.int32(0)), term
+                return acc + absorb_pushsum_tile(
+                    r0, padm, inbox_s, inbox_w,
+                    s_v, w_v, t_v, c_v, ds_v, dw_v, delta, term_rounds,
                 )
-                conv_new = jnp.where(
-                    padm,
-                    jnp.int32(0),
-                    jnp.where(
-                        (c_v[pl.ds(r0, TILE), :] != 0)
-                        | (term_new >= term_rounds),
-                        jnp.int32(1),
-                        jnp.int32(0),
-                    ),
-                )
-                s_v[pl.ds(r0, TILE), :] = s_new
-                w_v[pl.ds(r0, TILE), :] = w_new
-                t_v[pl.ds(r0, TILE), :] = term_new
-                c_v[pl.ds(r0, TILE), :] = conv_new
-                return acc + jnp.sum(conv_new, dtype=jnp.int32)
 
             total = lax.fori_loop(0, T, p2, jnp.int32(0))
             flags[1] = flags[1] + 1
@@ -436,21 +414,9 @@ def make_gossip_stencil2_chunk(
                     inbox = inbox + jnp.where(
                         g == d_c, jnp.int32(1), jnp.int32(0)
                     )
-                inbox = jnp.where(padm, jnp.int32(0), inbox)
-                # Absorb — mirrors models/gossip.absorb (program.fs:97-105).
-                count_new = n_v[pl.ds(r0, TILE), :] + inbox
-                active_new = jnp.where(
-                    (a_v[pl.ds(r0, TILE), :] != 0) | (inbox > 0),
-                    jnp.int32(1),
-                    jnp.int32(0),
+                return acc + absorb_gossip_tile(
+                    r0, padm, inbox, n_v, a_v, c_v, rumor_target
                 )
-                conv_new = jnp.where(
-                    count_new >= rumor_target, jnp.int32(1), jnp.int32(0)
-                )
-                n_v[pl.ds(r0, TILE), :] = count_new
-                a_v[pl.ds(r0, TILE), :] = active_new
-                c_v[pl.ds(r0, TILE), :] = conv_new
-                return acc + jnp.sum(conv_new, dtype=jnp.int32)
 
             total = lax.fori_loop(0, T, p2, jnp.int32(0))
             flags[1] = flags[1] + 1
