@@ -30,7 +30,9 @@ Eligibility (`fused_support`): explicit offset-structured topology whose
 displacements either never wrap the index space (line/ref2d/grid2d/grid3d)
 or whose population is a multiple of 128 (ring/torus3d then roll cleanly in
 the padded 2-D layout), float32, no fault injection, single device, and
-state small enough to sit in VMEM (~16 MB/core).
+population within MAX_FUSED_NODES (the VMEM-residency budget spelled out at
+its definition — beyond it, and for unaligned wrap populations, the tiled
+engine in ops/fused_stencil.py takes over).
 
 Reference mapping: this kernel is the whole of SURVEY.md §3.2/§3.3's hot
 loop — the ChildActor message handlers (program.fs:89-105, 110-143), the

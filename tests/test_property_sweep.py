@@ -1,0 +1,63 @@
+"""Property sweep (SURVEY.md §4): every topology x algorithm converges at
+random small populations and seeds, in both semantics modes.
+
+The reference's entire validation story was eight manual timed runs
+(report.pdf p.2-3); this sweep is the systematic version: for each of the 9
+topology builders and both protocols, three (n, seed) draws must converge
+with every live node accounted for, push-sum estimates near the true mean,
+and the run result internally consistent. Catches regressions that
+per-feature tests anchored to fixed seeds can miss (e.g. a topology builder
+edge case at an awkward population).
+"""
+
+import numpy as np
+import pytest
+
+from cop5615_gossip_protocol_tpu import SimConfig, build_topology, run
+from cop5615_gossip_protocol_tpu.config import TOPOLOGIES
+
+_RNG = np.random.RandomState(20260730)
+_CASES = [
+    (kind, algo, int(_RNG.randint(20, 400)), int(_RNG.randint(0, 1 << 16)))
+    for kind in TOPOLOGIES
+    for algo in ("gossip", "push-sum")
+    for _ in range(3)
+]
+
+
+@pytest.mark.parametrize("kind,algo,n,seed", _CASES)
+def test_converges_everywhere(kind, algo, n, seed):
+    topo = build_topology(kind, n, seed=seed)
+    cfg = SimConfig(n=n, topology=kind, algorithm=algo, seed=seed,
+                    max_rounds=200_000, chunk_rounds=512)
+    r = run(topo, cfg)
+    assert r.converged, (kind, algo, n, seed, r.rounds)
+    assert r.converged_count >= r.target_count
+    assert 0 < r.rounds <= 200_000
+    assert r.population == topo.n
+    if algo == "push-sum":
+        # Converged estimates sit near the true mean (pop-1)/2 on graphs
+        # that mix; 1-D graphs (line, and ref2d/ring which are line-wired)
+        # stabilize locally with O(tens-of-units) error — the same
+        # criterion and failure mode as the reference's delta test, so only
+        # a sanity bound applies there.
+        if kind in ("line", "ref2d", "ring"):
+            assert r.estimate_mae < topo.n, (kind, n, seed)
+        else:
+            assert r.estimate_mae < max(0.05 * topo.n, 5.0), (kind, n, seed)
+
+
+def test_reference_semantics_sweep():
+    # The quirk-faithful mode across the reference's own CLI surface
+    # (line/full/2D/Imp3D), one small draw each.
+    for spelling in ("line", "full", "2D", "Imp3D"):
+        from cop5615_gossip_protocol_tpu.config import normalize_topology
+
+        kind = normalize_topology(spelling, "reference")
+        n = int(_RNG.randint(20, 120))
+        topo = build_topology(kind, n, semantics="reference")
+        cfg = SimConfig(n=n, topology=kind, algorithm="gossip",
+                        semantics="reference", max_rounds=200_000)
+        r = run(topo, cfg)
+        assert r.converged, (spelling, n)
+        assert r.target_count <= r.population  # Q1: N of N+1
