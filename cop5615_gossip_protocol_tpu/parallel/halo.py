@@ -125,6 +125,75 @@ def halo_roll(x_loc, s: int, axis: str, n_dev: int):
     return jnp.concatenate([x_loc[..., m:], recv], axis=-1)
 
 
+def global_roll_dynamic(x_loc, r, axis: str, n_dev: int):
+    """Global circular roll by a *traced* amount ``r`` of a node-sharded
+    [..., n_loc] array: out[t] = x[(t - r) mod n], n = n_dev * n_loc.
+
+    ``halo_roll`` needs static offsets narrower than a shard; the offset-pool
+    path (ops/sampling.pool_offsets) draws its displacements per round
+    *inside* the jit'd loop, uniform over the whole ring — dynamic and
+    arbitrarily wide. A dynamic shift cannot pick a ppermute permutation at
+    trace time, so the roll decomposes as r = q * n_loc + s with
+
+      1. shard rotation by q: ceil(log2 n_dev) ppermute stages, stage b
+         rotating by 2^b and kept iff bit b of q is set (every device
+         computes the same replicated q, so the selects agree);
+      2. one more static ppermute by 1 for the neighbor shard the stitch
+         needs (out lane j < s reads from the *previous* source shard);
+      3. two local rolls by s and a lane select to stitch.
+
+    Per-device payload is O(n_loc * log n_dev) and memory O(n_loc) — never a
+    full-length vector. Cost is independent of r; r = 0 is the identity.
+    """
+    n_loc = x_loc.shape[-1]
+    if n_dev == 1:
+        return jnp.roll(x_loc, r, axis=-1)
+    r = jnp.asarray(r)
+    q = r // n_loc  # source shard rotation, in [0, n_dev)
+    s = r - q * n_loc  # intra-shard shift, in [0, n_loc)
+    a = x_loc  # after rotation: device d holds the shard of device (d - q)
+    for b in range((n_dev - 1).bit_length()):
+        step = 1 << b
+        rotated = lax.ppermute(a, axis, _ring_perm(n_dev, +step))
+        a = jnp.where(((q >> b) & 1) == 1, rotated, a)
+    bshard = lax.ppermute(a, axis, _ring_perm(n_dev, +1))  # shard of (d-q-1)
+    # out[j] = a[j - s] for j >= s, bshard[j - s + n_loc] for j < s; both are
+    # lane j of the corresponding local roll by s.
+    a_roll = jnp.roll(a, s, axis=-1)
+    b_roll = jnp.roll(bshard, s, axis=-1)
+    lane = jnp.arange(n_loc)
+    return jnp.where(lane >= s, a_roll, b_roll)
+
+
+def deliver_pool_sharded(channels_loc, choice_loc, offsets, axis: str, n_dev: int):
+    """Sharded offset-pool delivery (ops/delivery.deliver_pool under
+    shard_map): K masked *dynamic* global rolls instead of a scatter into a
+    full-length vector + psum_scatter. ``channels_loc`` is [C, n_loc] — the
+    stacked message channels ride the same ppermutes. Accumulation follows
+    the same static pool-slot order as the single-device path, so sharded
+    pool trajectories are bit-identical to single-device ones (pinned by
+    tests/test_halo.py)."""
+    inbox = jnp.zeros_like(channels_loc)
+    zero = jnp.zeros((), channels_loc.dtype)
+    for k in range(offsets.shape[0]):
+        masked = jnp.where(choice_loc == k, channels_loc, zero)
+        inbox = inbox + global_roll_dynamic(masked, offsets[k], axis, n_dev)
+    return inbox
+
+
+def pool_lookup_sharded(vec_loc, choice_loc, offsets, axis: str, n_dev: int):
+    """Sharded analog of ops/delivery.pool_lookup — gossip's converged-target
+    suppression read without the all_gather of the full conv vector: the
+    value a sender in pool slot k needs sits one *backward* dynamic roll
+    away. Returns out[i] = vec[(i + o_choice[i]) mod n]."""
+    n = n_dev * vec_loc.shape[-1]
+    out = vec_loc
+    for k in range(offsets.shape[0]):
+        rolled = global_roll_dynamic(vec_loc, (n - offsets[k]) % n, axis, n_dev)
+        out = jnp.where(choice_loc == k, rolled, out)
+    return out
+
+
 def deliver_halo(values_loc, disp_loc, plan: HaloPlan, axis: str):
     """Sharded stencil delivery: inbox shard from |offsets| masked halo
     rolls. ``values_loc`` is [..., n_loc] — push-sum stacks its s and w

@@ -7,13 +7,16 @@ per-node state vectors; one synchronous round is:
 
 1. every device draws the round's full-length random words (bit-identical
    with the single-device runner — see ops/sampling.py) and slices its shard;
-2. local nodes pick global partner indices; delivery is then either
+2. local nodes pick global partner indices; delivery is then
    **halo exchange** (offset-structured topologies: per displacement class,
    a local shift plus one `ppermute` of the boundary slice — O(n_loc + halo)
-   per device, parallel/halo.py) or **scatter + psum_scatter** (irregular
-   topologies: scatter into a full-length contribution vector, then one
-   reduce-scatter over the "nodes" axis hands each device its summed inbox
-   shard);
+   per device, parallel/halo.py), **pool rolls** (implicit full with
+   offset-pool sampling at mesh-divisible populations: K dynamic global
+   rolls of log2(n_dev) ppermute stages each, O(n_loc) per device —
+   parallel/halo.global_roll_dynamic), or **scatter + psum_scatter**
+   (irregular topologies and the non-divisible fallback: scatter into a
+   full-length contribution vector, then one reduce-scatter over the
+   "nodes" axis hands each device its summed inbox shard);
 3. local absorb/update, then a scalar `psum` of converged counts serves as
    the global termination predicate (the ParentActor's count-and-exit,
    program.fs:47-60, as a reduction).
@@ -106,6 +109,15 @@ def run_sharded(
     plan = None
     if cfg.delivery in ("auto", "stencil") and not topo.implicit:
         plan = halo_mod.plan_halo(topo, n_dev)
+    # Offset-pool delivery on the implicit full topology (the flagship
+    # benchmark path): when the population divides the mesh exactly, the
+    # K per-round displacement rolls run as dynamic global rolls —
+    # log2(n_dev) ppermute stages each, O(n_loc) per-device memory
+    # (parallel/halo.global_roll_dynamic) — instead of scattering into a
+    # full-length vector and psum_scattering it. Non-divisible populations
+    # fall back to the scatter path: pad slots inside the ring would
+    # corrupt the roll.
+    pool_roll = topo.implicit and cfg.delivery == "pool" and n_pad == n
     if cfg.delivery == "stencil" and plan is None:
         raise ValueError(
             "delivery='stencil' under sharding requires an offset-structured "
@@ -151,23 +163,16 @@ def run_sharded(
         if topo.implicit:
             (valid_loc,) = targs
             if cfg.delivery == "pool":
-                # Offset-pool sampling (ops/sampling.pool_offsets) with
-                # scatter delivery: every device derives the same per-round
-                # pool from the replicated round key, and the same packed
-                # choice words (sampling.pool_choice_packed — one word per
-                # 8 nodes), so targets match the single-device pool path;
-                # the roll fast path stays single-device (cross-shard rolls
-                # land with the halo work).
-                offs = sampling.pool_offsets(kr, cfg.pool_size, n)
-                choice_full = sampling.pool_choice_packed(
-                    kr, n, cfg.pool_size, out_len=n_pad
-                )
-                choice = lax.dynamic_slice(choice_full, (start,), (n_loc,))
+                # Scatter fallback for pool sampling at non-divisible
+                # populations: same (choice, offsets, send_ok) stream as the
+                # pool-roll path — pool_parts is the single source of that
+                # stream — materialized into explicit targets.
+                choice, offs, send_ok = pool_parts(round_idx, valid_loc)
                 targets = sampling.targets_pool(choice, offs, gids, n)
-            else:
-                bits_full = sampling.uniform_bits(kr, n_pad)
-                bits = lax.dynamic_slice(bits_full, (start,), (n_loc,))
-                targets = sampling.targets_full(bits, gids, n)
+                return targets, send_ok, valid_loc, gids
+            bits_full = sampling.uniform_bits(kr, n_pad)
+            bits = lax.dynamic_slice(bits_full, (start,), (n_loc,))
+            targets = sampling.targets_full(bits, gids, n)
             send_ok = valid_loc
         else:
             bits_full = sampling.uniform_bits(kr, n_pad)
@@ -179,6 +184,27 @@ def run_sharded(
         if gate_full is not True:
             send_ok = send_ok & lax.dynamic_slice(gate_full, (start,), (n_loc,))
         return targets, send_ok, valid_loc, gids
+
+    def pool_parts(round_idx, valid_loc):
+        """(choice, offsets, send_ok) shards — the single source of the pool
+        sampling stream for BOTH sharded pool paths (roll delivery and the
+        non-divisible scatter fallback), matching the single-device pool
+        runner (models/runner.py _make_pool_round_fn): shared per-round
+        offsets off the replicated round key, packed choice words sliced
+        per shard."""
+        kr = sampling.round_key(key, round_idx)
+        dev = lax.axis_index(NODE_AXIS)
+        start = dev * n_loc
+        offs = sampling.pool_offsets(kr, cfg.pool_size, n)
+        choice_full = sampling.pool_choice_packed(
+            kr, n, cfg.pool_size, out_len=n_pad
+        )
+        choice = lax.dynamic_slice(choice_full, (start,), (n_loc,))
+        send_ok = valid_loc
+        gate_full = sampling.send_gate(kr, n_pad, cfg.fault_rate)
+        if gate_full is not True:
+            send_ok = send_ok & lax.dynamic_slice(gate_full, (start,), (n_loc,))
+        return choice, offs, send_ok
 
     if plan is not None:
 
@@ -214,24 +240,42 @@ def run_sharded(
         delta = cfg.resolved_delta
         term_rounds = cfg.term_rounds
 
-        def round_fn(state, round_idx, *targs):
-            targets, send_ok, _, gids = targets_and_gate(round_idx, *targs)
-            s_send, w_send, s_keep, w_keep = pushsum_mod.halve_and_send(
-                state.s, state.w, send_ok
-            )
-            if plan is not None:
-                # Stack s/w so both channels ride one ppermute per offset
-                # class (halves the per-round collective count).
-                inbox = deliver_sharded(
-                    jnp.stack([s_send, w_send]), targets, gids
+        if pool_roll:
+
+            def round_fn(state, round_idx, *targs):
+                (valid_loc,) = targs
+                choice, offs, send_ok = pool_parts(round_idx, valid_loc)
+                s_send, w_send, s_keep, w_keep = pushsum_mod.halve_and_send(
+                    state.s, state.w, send_ok
                 )
-                inbox_s, inbox_w = inbox[0], inbox[1]
-            else:
-                inbox_s = deliver_sharded(s_send, targets, gids)
-                inbox_w = deliver_sharded(w_send, targets, gids)
-            return pushsum_mod.absorb(
-                state, s_keep, w_keep, inbox_s, inbox_w, delta, term_rounds
-            )
+                # s and w stacked: both channels ride each roll's ppermutes.
+                inbox = halo_mod.deliver_pool_sharded(
+                    jnp.stack([s_send, w_send]), choice, offs, NODE_AXIS, n_dev
+                )
+                return pushsum_mod.absorb(
+                    state, s_keep, w_keep, inbox[0], inbox[1], delta, term_rounds
+                )
+
+        else:
+
+            def round_fn(state, round_idx, *targs):
+                targets, send_ok, _, gids = targets_and_gate(round_idx, *targs)
+                s_send, w_send, s_keep, w_keep = pushsum_mod.halve_and_send(
+                    state.s, state.w, send_ok
+                )
+                if plan is not None:
+                    # Stack s/w so both channels ride one ppermute per offset
+                    # class (halves the per-round collective count).
+                    inbox = deliver_sharded(
+                        jnp.stack([s_send, w_send]), targets, gids
+                    )
+                    inbox_s, inbox_w = inbox[0], inbox[1]
+                else:
+                    inbox_s = deliver_sharded(s_send, targets, gids)
+                    inbox_w = deliver_sharded(w_send, targets, gids)
+                return pushsum_mod.absorb(
+                    state, s_keep, w_keep, inbox_s, inbox_w, delta, term_rounds
+                )
 
         s0 = np.arange(n_pad, dtype=dtype)
         s0[n:] = 0.0  # padded slots carry no sum mass...
@@ -257,17 +301,41 @@ def run_sharded(
             count=dev_put(count0), active=dev_put(active0), conv=dev_put(np.zeros(n_pad, bool))
         )
 
-        def round_fn(state, round_idx, *targs):
-            targets, send_ok, _, gids = targets_and_gate(round_idx, *targs)
-            if suppress:
-                conv_of_target = conv_of_target_sharded(state.conv, targets, gids)
-            else:
-                conv_of_target = False
-            vals = gossip_mod.send_values(
-                state, targets, send_ok, suppress, conv_of_target
-            )
-            inbox = deliver_sharded(vals, targets, gids)
-            return gossip_mod.absorb(state, inbox, rumor_target)
+        if pool_roll:
+
+            def round_fn(state, round_idx, *targs):
+                (valid_loc,) = targs
+                choice, offs, send_ok = pool_parts(round_idx, valid_loc)
+                conv_of_target = (
+                    halo_mod.pool_lookup_sharded(
+                        state.conv, choice, offs, NODE_AXIS, n_dev
+                    )
+                    if suppress
+                    else False
+                )
+                vals = gossip_mod.send_values(
+                    state, None, send_ok, suppress, conv_of_target
+                )
+                inbox = halo_mod.deliver_pool_sharded(
+                    vals[None], choice, offs, NODE_AXIS, n_dev
+                )[0]
+                return gossip_mod.absorb(state, inbox, rumor_target)
+
+        else:
+
+            def round_fn(state, round_idx, *targs):
+                targets, send_ok, _, gids = targets_and_gate(round_idx, *targs)
+                if suppress:
+                    conv_of_target = conv_of_target_sharded(
+                        state.conv, targets, gids
+                    )
+                else:
+                    conv_of_target = False
+                vals = gossip_mod.send_values(
+                    state, targets, send_ok, suppress, conv_of_target
+                )
+                inbox = deliver_sharded(vals, targets, gids)
+                return gossip_mod.absorb(state, inbox, rumor_target)
 
     if start_state is not None:
         fills = {"s": 0.0, "w": 1.0, "term": cfg.initial_term_round,

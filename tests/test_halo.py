@@ -88,6 +88,77 @@ def test_halo_roll_single_device():
     )
 
 
+# --- global_roll_dynamic (pool-roll delivery) -----------------------------
+
+
+@pytest.mark.parametrize("r", [0, 1, 63, 64, 65, 200, 511])
+def test_global_roll_dynamic_matches_roll(r):
+    # Traced roll amount: r enters as a replicated scalar argument, so one
+    # compiled program serves every per-round pool offset.
+    n = 512
+    mesh = make_mesh(8)
+    x = np.arange(2 * n, dtype=np.float32).reshape(2, n)  # stacked channels
+
+    def f(x_loc, r):
+        return halo.global_roll_dynamic(x_loc, r, NODE_AXIS, 8)
+
+    rolled = jax.jit(
+        jax.shard_map(
+            f, mesh=mesh, in_specs=(P(None, NODE_AXIS), P()),
+            out_specs=P(None, NODE_AXIS),
+        )
+    )(x, jnp.int32(r))
+    np.testing.assert_array_equal(np.asarray(rolled), np.roll(x, r, axis=1))
+
+
+def test_global_roll_dynamic_single_device():
+    x = jnp.arange(16.0)
+    np.testing.assert_array_equal(
+        np.asarray(halo.global_roll_dynamic(x, jnp.int32(5), NODE_AXIS, 1)),
+        np.roll(np.arange(16.0), 5),
+    )
+
+
+def test_pool_roll_pushsum_bitwise_matches_single_device():
+    # Same masked values, same static pool-slot accumulation order → the
+    # sharded pool-roll float trajectory is bitwise the single-device one.
+    n = 1024
+    cfg = SimConfig(n=n, topology="full", algorithm="push-sum",
+                    delivery="pool", pool_size=4, max_rounds=50_000)
+    topo = build_topology("full", n)
+
+    final = {}
+
+    def grab(tag):
+        def on_chunk(rounds, state):
+            final[tag] = state
+        return on_chunk
+
+    r1 = run(topo, cfg, on_chunk=grab("single"))
+    r8 = run_sharded(topo, cfg, mesh=make_mesh(8), on_chunk=grab("sharded"))
+    assert r8.rounds == r1.rounds
+    np.testing.assert_array_equal(
+        np.asarray(final["single"].s), np.asarray(final["sharded"].s)[:n]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(final["single"].w), np.asarray(final["sharded"].w)[:n]
+    )
+
+
+def test_pool_roll_gossip_suppression_bitwise():
+    # Suppression on the pool-roll path reads conv through backward dynamic
+    # rolls (pool_lookup_sharded), not an all_gather; trajectories must match
+    # the single-device pool_lookup path exactly.
+    n = 1024
+    cfg = SimConfig(n=n, topology="full", algorithm="gossip",
+                    delivery="pool", suppress_converged=True, seed=3)
+    topo = build_topology("full", n)
+    r1 = run(topo, cfg)
+    r8 = run_sharded(topo, cfg, mesh=make_mesh(8))
+    assert r8.rounds == r1.rounds
+    assert r8.converged_count == r1.converged_count
+
+
 # --- end-to-end bit-identity ---------------------------------------------
 
 

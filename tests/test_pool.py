@@ -117,14 +117,30 @@ def test_pool_gossip_reference_suppression():
 
 
 def test_pool_sharded_matches_single_device():
-    # The sharded fallback samples identical targets (same round key -> same
-    # pool) and delivers by scatter; gossip integer trajectories must agree
-    # exactly with the single-device roll path.
+    # Mesh-divisible population: the sharded run delivers by dynamic global
+    # rolls (parallel/halo.global_roll_dynamic — same masked-roll order as
+    # the single-device path); gossip integer trajectories must agree
+    # exactly. The deeper bitwise pins live in tests/test_halo.py.
     n = 1024  # divisible by 8 devices: identical RNG slicing
     base = dict(n=n, topology="full", algorithm="gossip",
                 delivery="pool", max_rounds=5000)
     r1 = run(build_topology("full", n), SimConfig(**base))
     r8 = run(build_topology("full", n), SimConfig(n_devices=8, **base))
+    assert r1.rounds == r8.rounds
+    assert r1.converged_count == r8.converged_count
+
+
+def test_pool_sharded_nondivisible_falls_back_to_scatter():
+    # n % n_devices != 0: pad slots inside the ring would corrupt a global
+    # roll, so the sharded pool path falls back to scatter + psum_scatter
+    # over targets_pool — same sampled targets, so gossip trajectories still
+    # match the single-device roll path exactly.
+    n = 1001
+    base = dict(n=n, topology="full", algorithm="gossip",
+                delivery="pool", max_rounds=5000)
+    r1 = run(build_topology("full", n), SimConfig(**base))
+    r8 = run(build_topology("full", n), SimConfig(n_devices=8, **base))
+    assert r8.converged
     assert r1.rounds == r8.rounds
     assert r1.converged_count == r8.converged_count
 
