@@ -73,6 +73,60 @@ def _table(rows: list[MatchedRow]) -> list[str]:
     return out
 
 
+def _analysis(all_rows: dict, grid_n) -> list[str]:
+    """Qualitative analysis of the measured grid — the counterpart of the
+    reference report's own analysis section (report.pdf p.3-5), but keyed to
+    *rounds to converge*, the quantity that survives the semantic recast
+    (wall-clock at small N is dispatch-floor-bound, see the reading note
+    above). Ranks are computed from the rows just measured, not hard-coded."""
+    if not all_rows:
+        return []
+    n_top = max(grid_n)
+    out = ["## Analysis (at the grid's largest point)", ""]
+    for algo in ("gossip", "push-sum"):
+        ranked = sorted(
+            (rows[-1].tpu_rounds, topo)
+            for (a, topo), rows in all_rows.items()
+            if a == algo and rows
+        )
+        order = " < ".join(f"{t} ({r:,})" for r, t in ranked)
+        out.append(f"- **{algo} rounds at N={n_top:,}:** {order}.")
+    out += [
+        "",
+        "The ordering mirrors graph structure, and matches the trends in the "
+        "reference's own tables (report.pdf p.4-5) once '2D' is read for what "
+        "it is wired as:",
+        "",
+        "- **full** converges fastest: every node can reach every other, so "
+        "rumor spread and mass mixing are O(log N) rounds (expander behavior).",
+        "- **Imp3D** tracks full closely — the one uniformly random extra "
+        "neighbor per node (program.fs:308-310) makes the lattice a "
+        "small-world graph; this is the reference report's own observation "
+        "that Imp3D is its second-fastest topology.",
+        "- **line is slowest** — information must diffuse through an O(N) "
+        "diameter. The reference's '2D' column tracks (even exceeds) its "
+        "line column because its 2D *is* a line (quirk Q6, "
+        "program.fs:242-248 — neighbors are wired {i-1, i+1}, the grid size "
+        "is never used); the TPU column here measures the honest 4-neighbor "
+        "grid instead (O(sqrt N) diameter — between line and Imp3D, exactly "
+        "where a true 2D grid belongs), while the Q6 wiring itself is "
+        "reproduced and pinned separately (ref2d, tests/test_topology.py). "
+        "On slow-mixing graphs push-sum's local-stability criterion "
+        "(|Δ(s/w)| <= δ for 3 consecutive receipt rounds) can latch long "
+        "before global mass equilibrium — the same early-latch failure mode "
+        "the reference has (its nodes also only compare their own "
+        "consecutive ratios, program.fs:119-137).",
+        "- **Wall-clock vs rounds decouple on TPU**: a round costs the same "
+        "regardless of how many nodes are informed (dense batched kernel), "
+        "so TPU wall scales with rounds x per-round cost, while the Akka "
+        "wall scales with messages x per-message cost — which is why the "
+        "speedup column grows with N everywhere, crossing 1x once the "
+        "dispatch floor is amortized.",
+        "",
+    ]
+    return out
+
+
 def generate(out_path: str, seed: int, grid_n, scale_n, platform_note: str) -> None:
     lines = [
         "# BENCH_TABLES — old vs new on the reference's own grid",
@@ -111,6 +165,7 @@ def generate(out_path: str, seed: int, grid_n, scale_n, platform_note: str) -> N
         "",
     ]
     t_start = time.perf_counter()
+    all_rows: dict[tuple[str, str], list[MatchedRow]] = {}
     for algo in ("gossip", "push-sum"):
         lines.append(f"## {algo}")
         lines.append("")
@@ -123,11 +178,14 @@ def generate(out_path: str, seed: int, grid_n, scale_n, platform_note: str) -> N
                     f"({rows[-1].tpu_rounds} rounds), refsim {rows[-1].refsim_ms:.2f} ms",
                     flush=True,
                 )
+            all_rows[(algo, topo)] = rows
             lines.append(f"### {topo}")
             lines.append("")
             lines.extend(_table(rows))
             lines.append("")
         lines.append("")
+
+    lines.extend(_analysis(all_rows, grid_n))
 
     if scale_n:
         lines.append("## Beyond the reference's ceiling (full topology, push-sum)")
