@@ -52,7 +52,7 @@ from .fused_pool import (
     PoolLayout,
     _copy_in,
     _iota2,
-    _make_gather,
+    _make_gather_modn,
     absorb_gossip_tile,
     absorb_pushsum_tile,
     build_pool_layout,
@@ -103,30 +103,6 @@ def stencil2_support(topo: Topology, cfg: SimConfig) -> Optional[str]:
             "VMEM-resident plane budget"
         )
     return None
-
-
-def _make_blends(layout: PoolLayout, interpret: bool):
-    """Mod-n roll readers: blend the padded-space roll by e (flat j >= e)
-    with its wraparound variant (roll by e + Z) below e — exact for any
-    population, which is what lets this engine serve wrap topologies at
-    n % 128 != 0."""
-    gather, gather_plain = _make_gather(layout, interpret)
-    Z = layout.n_pad - layout.n
-
-    def gather_blend(choice_plane, value_planes, e, t, slot, jflat):
-        a = gather(choice_plane, value_planes, e, t, slot)
-        b = gather(choice_plane, value_planes, e + Z, t, slot)
-        take = jflat >= e
-        return tuple(jnp.where(take, x, y) for x, y in zip(a, b))
-
-    def gather_plain_blend(plane, e, t, jflat):
-        return jnp.where(
-            jflat >= e,
-            gather_plain(plane, e, t),
-            gather_plain(plane, e + Z, t),
-        )
-
-    return gather_blend, gather_plain_blend
 
 
 def _build_disp_planes(topo: Topology, layout: PoolLayout):
@@ -184,7 +160,11 @@ def make_pushsum_stencil2_chunk(
     ):
         k = pl.program_id(0)
         K = pl.num_programs(0)
-        gather_blend, _ = _make_blends(layout, interpret)
+        # Mod-n roll readers (fused_pool._make_gather_modn): padded-space
+        # roll blended with its wraparound variant below flat index e — exact
+        # for any population, which is what lets this engine serve wrap
+        # topologies at n % 128 != 0.
+        gather_blend, _ = _make_gather_modn(layout, interpret)
         row_l = _iota2((TILE, LANES), 0)
         lane = _iota2((TILE, LANES), 1)
 
@@ -346,7 +326,7 @@ def make_gossip_stencil2_chunk(
             dcv_v = None
         k = pl.program_id(0)
         K = pl.num_programs(0)
-        _, gather_plain_blend = _make_blends(layout, interpret)
+        _, gather_plain_blend = _make_gather_modn(layout, interpret)
         row_l = _iota2((TILE, LANES), 0)
         lane = _iota2((TILE, LANES), 1)
 
