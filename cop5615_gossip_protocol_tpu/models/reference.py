@@ -55,14 +55,17 @@ class WalkCarry(NamedTuple):
 def make_walk(topo: Topology, cfg: SimConfig, base_key: jax.Array, leader: jax.Array):
     """Build (step_fn, carry0, topo_args) for the single-walk push-sum.
 
-    step_fn(carry, *topo_args) -> carry advances one message hop. carry0 is
-    the post-kickoff state: leader already halved, halves in flight toward a
-    random neighbor of the leader.
+    step_fn(carry, key_data, *topo_args) -> carry advances one message hop
+    (``key_data`` is the raw base key from ops/sampling.key_split, passed as
+    a runtime argument — baked key constants cost ~100 ms per dispatch on
+    the axon platform). carry0 is the post-kickoff state: leader already
+    halved, halves in flight toward a random neighbor of the leader.
     """
     dtype = jnp.dtype(cfg.dtype)
     n = topo.n
     delta = jnp.asarray(cfg.resolved_delta, dtype)
     term_rounds = cfg.term_rounds
+    _, key_impl = sampling.key_split(base_key)
 
     if topo.implicit:
         topo_args = ()
@@ -104,9 +107,9 @@ def make_walk(topo: Topology, cfg: SimConfig, base_key: jax.Array, leader: jax.A
         dead=~first_ok,
     )
 
-    def step_fn(c: WalkCarry, *targs) -> WalkCarry:
+    def step_fn(c: WalkCarry, key_data, *targs) -> WalkCarry:
         cur = c.cur
-        key = jax.random.fold_in(base_key, c.steps)
+        key = jax.random.fold_in(sampling.key_join(key_data, key_impl), c.steps)
         s_c = c.s[cur]
         w_c = c.w[cur]
         newsum = s_c + c.msg_s
@@ -156,21 +159,22 @@ def run_walk(topo: Topology, cfg: SimConfig, base_key: jax.Array, leader: jax.Ar
     import time
 
     step_fn, carry0, topo_args = make_walk(topo, cfg, base_key, leader)
+    key_data, _ = sampling.key_split(base_key)
     max_steps = cfg.max_rounds
 
-    def whole(c: WalkCarry, *targs):
+    def whole(c: WalkCarry, key_data, *targs):
         def cond(c):
             return (~c.dead) & (c.steps < max_steps) & (jnp.sum(c.conv) < target)
 
         def body(c):
-            return step_fn(c, *targs)
+            return step_fn(c, key_data, *targs)
 
         return lax.while_loop(cond, body, c)
 
     t0 = time.perf_counter()
-    compiled = jax.jit(whole).lower(carry0, *topo_args).compile()
+    compiled = jax.jit(whole).lower(carry0, key_data, *topo_args).compile()
     compile_s = time.perf_counter() - t0
     t1 = time.perf_counter()
-    final = jax.block_until_ready(compiled(carry0, *topo_args))
+    final = jax.block_until_ready(compiled(carry0, key_data, *topo_args))
     run_s = time.perf_counter() - t1
     return final, compile_s, run_s

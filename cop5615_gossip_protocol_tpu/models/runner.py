@@ -116,11 +116,14 @@ def resolve_deliver_fn(topo: Topology, cfg: SimConfig):
 def make_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array):
     """Build (round_fn, state0, topo_args).
 
-    ``round_fn(state, round_idx, *topo_args) -> state`` is one synchronous
-    protocol round, pure and jittable — the unit `__graft_entry__.entry`
-    compile-checks. ``topo_args`` carries the neighbor tensors as explicit
-    arguments so multi-hundred-MB adjacency is never baked into the
-    executable as a constant.
+    ``round_fn(state, round_idx, key_data, *topo_args) -> state`` is one
+    synchronous protocol round, pure and jittable — the unit
+    `__graft_entry__.entry` compile-checks. ``topo_args`` carries the
+    neighbor tensors, and ``key_data`` the raw PRNG key
+    (ops/sampling.key_split), as explicit arguments: arrays closed over by a
+    jitted round would be baked into the executable as constants, which the
+    axon remote-TPU platform re-ships on EVERY dispatch (~100 ms/launch,
+    measured — it dominated all small-N walls).
     """
     dtype = _check_dtype(cfg)
     n = topo.n
@@ -132,6 +135,8 @@ def make_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array):
             )
         return _make_pool_round_fn(topo, cfg, base_key, dtype)
 
+    _, key_impl = sampling.key_split(base_key)
+
     if topo.implicit:
         topo_args = ()
     else:
@@ -139,11 +144,11 @@ def make_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array):
 
     deliver_fn = resolve_deliver_fn(topo, cfg)
 
-    def targets_and_gate(round_idx, *targs):
+    def targets_and_gate(round_idx, key_data, *targs):
         # ids generated inside the trace (lax.iota) — never a baked constant.
         with jax.named_scope("sample"):
             ids = jnp.arange(n, dtype=jnp.int32)
-            kr = sampling.round_key(base_key, round_idx)
+            kr = sampling.round_key(sampling.key_join(key_data, key_impl), round_idx)
             bits = sampling.uniform_bits(kr, n)
             if topo.implicit:
                 targets = sampling.targets_full(bits, ids, n)
@@ -162,8 +167,8 @@ def make_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array):
         delta = cfg.resolved_delta
         term_rounds = cfg.term_rounds
 
-        def round_fn(state, round_idx, *targs):
-            targets, send_ok = targets_and_gate(round_idx, *targs)
+        def round_fn(state, round_idx, key_data, *targs):
+            targets, send_ok = targets_and_gate(round_idx, key_data, *targs)
             return pushsum_mod.round_from_targets(
                 state, targets, send_ok, n, delta, term_rounds, deliver_fn
             )
@@ -176,8 +181,8 @@ def make_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array):
         rumor_target = cfg.resolved_rumor_target
         suppress = cfg.resolved_suppress
 
-        def round_fn(state, round_idx, *targs):
-            targets, send_ok = targets_and_gate(round_idx, *targs)
+        def round_fn(state, round_idx, key_data, *targs):
+            targets, send_ok = targets_and_gate(round_idx, key_data, *targs)
             return gossip_mod.round_from_targets(
                 state, targets, send_ok, n, rumor_target, suppress, deliver_fn
             )
@@ -194,10 +199,11 @@ def _make_pool_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array, dty
     on v5e; bench.py)."""
     n = topo.n
     K = cfg.pool_size
+    _, key_impl = sampling.key_split(base_key)
 
-    def pool_parts(round_idx):
+    def pool_parts(round_idx, key_data):
         with jax.named_scope("sample"):
-            kr = sampling.round_key(base_key, round_idx)
+            kr = sampling.round_key(sampling.key_join(key_data, key_impl), round_idx)
             offs = sampling.pool_offsets(kr, K, n)
             # Packed draw: one threefry word per 8 nodes instead of one per
             # node — a choice consumes 4 bits, not 32 (sampling.py). Stream-
@@ -212,8 +218,8 @@ def _make_pool_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array, dty
         delta = cfg.resolved_delta
         term_rounds = cfg.term_rounds
 
-        def round_fn(state, round_idx):
-            choice, offs, send_ok = pool_parts(round_idx)
+        def round_fn(state, round_idx, key_data):
+            choice, offs, send_ok = pool_parts(round_idx, key_data)
             with jax.named_scope("pushsum_halve"):
                 s_send, w_send, s_keep, w_keep = pushsum_mod.halve_and_send(
                     state.s, state.w, send_ok
@@ -235,8 +241,8 @@ def _make_pool_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array, dty
         rumor_target = cfg.resolved_rumor_target
         suppress = cfg.resolved_suppress
 
-        def round_fn(state, round_idx):
-            choice, offs, send_ok = pool_parts(round_idx)
+        def round_fn(state, round_idx, key_data):
+            choice, offs, send_ok = pool_parts(round_idx, key_data)
             with jax.named_scope("gossip_send"):
                 conv_of_target = (
                     delivery_mod.pool_lookup(state.conv, choice, offs)
@@ -394,18 +400,30 @@ def _run_fused(
 
     def chunk_call(state_dev, start, cap):
         # Keys/offsets are derived INSIDE the jit: per-chunk eager fold_in
-        # vmaps cost ~120 ms/chunk over a remote-device tunnel, dwarfing the
-        # ~30 ms kernel launch they feed.
+        # vmaps cost ~120 ms/chunk over the remote tunnel. The base key is
+        # deliberately CLOSED OVER (a baked constant): this loop is
+        # single-device/single-key, and passing even a uint32[2] runtime
+        # argument instead costs a consistent ~30 ms per dispatch on the
+        # axon tunnel (measured on the 1M-node flagship chunk, ~140 ms
+        # baked vs ~170 ms as argument).
         keys = fused.round_keys(key, start, K)
         return chunk_fn(state_dev, keys, *extra_args(start, K), start, cap)
 
     chunk_j = jax.jit(chunk_call)
 
     t0 = time.perf_counter()
-    warm = jax.block_until_ready(
-        chunk_j(state_dev, jnp.int32(start_round), jnp.int32(start_round))
+    # Warmup executes ONE real round and discards the result (state_dev is
+    # untouched; round keys are absolute, so the main loop recomputes the
+    # same round 0 identically). A zero-round warmup (cap == start) would
+    # leave the kernel's active path unexercised, and the axon tunnel defers
+    # a ~1 s one-time cost to the first execution that reaches it — which
+    # would land inside the timed run loop instead of here.
+    warm = chunk_j(
+        state_dev, jnp.int32(start_round),
+        jnp.int32(min(start_round + 1, cfg.max_rounds)),
     )
-    del warm  # cap == start: executes zero rounds, state untouched
+    int(warm[1])  # sync via data-dependent output (block_until_ready can
+    del warm      # return early over the tunnel)
     compile_s = time.perf_counter() - t0
 
     rounds = start_round
@@ -538,17 +556,18 @@ def run(
             )
 
     round_fn, state0, topo_args = make_round_fn(topo, cfg, key)
+    key_data, _ = sampling.key_split(key)
     if start_state is not None:
         state0 = jax.tree.map(jnp.asarray, start_state)
 
-    def chunk(carry, round_end, *targs):
+    def chunk(carry, round_end, key_data, *targs):
         def cond(c):
             _, rnd, done = c
             return jnp.logical_and(~done, rnd < round_end)
 
         def body(c):
             state, rnd, _ = c
-            state = round_fn(state, rnd, *targs)
+            state = round_fn(state, rnd, key_data, *targs)
             done = jnp.sum(state.conv) >= target
             return (state, rnd + 1, done)
 
@@ -558,14 +577,24 @@ def run(
     carry = (state0, jnp.int32(start_round), jnp.bool_(False))
 
     t0 = time.perf_counter()
-    carry = jax.block_until_ready(chunk_j(carry, jnp.int32(start_round), *topo_args))
+    # Warmup runs ONE real round (kept: the carry advances, the main loop
+    # continues from it on the same absolute-round key stream). With a
+    # zero-round warmup the while body never executes, and the axon tunnel
+    # defers a one-time cost to the first execution that reaches it — which
+    # would land inside the timed loop. Clamped so max_rounds still bounds
+    # the trajectory.
+    carry = chunk_j(
+        carry, jnp.int32(min(start_round + 1, cfg.max_rounds)),
+        key_data, *topo_args,
+    )
+    int(carry[1])  # data-dependent sync; block_until_ready can return early
     compile_s = time.perf_counter() - t0
 
     rounds = start_round
     t1 = time.perf_counter()
     while True:
         round_end = min(rounds + cfg.chunk_rounds, cfg.max_rounds)
-        carry = chunk_j(carry, jnp.int32(round_end), *topo_args)
+        carry = chunk_j(carry, jnp.int32(round_end), key_data, *topo_args)
         state, rnd, done = carry
         rounds = int(rnd)  # forces a host sync at the chunk boundary
         if on_chunk is not None:

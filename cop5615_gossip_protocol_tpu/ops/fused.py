@@ -372,6 +372,10 @@ def make_pushsum_chunk(
             c_o[:] = c_v[:]
             meta_o[0] = flags[1]
 
+    # Closed over (baked as executable constants) DELIBERATELY: measured
+    # end-to-end on the axon tunnel, passing these planes as runtime
+    # arguments lands chunk dispatch on a ~10x slower path (big-array
+    # arguments re-ship per call), while constants ride the fast path.
     disp_cols = jnp.asarray(layout.disp_cols)
     degree2d = jnp.asarray(layout.degree2d)
 

@@ -235,6 +235,8 @@ def make_pushsum_stencil2_chunk(
             _copy_in([(s_v, s_o), (w_v, w_o), (t_v, t_o), (c_v, c_o)], sems)
             meta_o[0] = flags[1]
 
+    # Closed over (baked constants) deliberately — see ops/fused.py: big
+    # arrays as runtime arguments land dispatch on a ~10x slower tunnel path.
     disp_dev = jnp.asarray(disp_np)
     deg_dev = jnp.asarray(deg_np)
 

@@ -99,6 +99,11 @@ def run_sharded(
     n_pad = ((n + n_dev - 1) // n_dev) * n_dev
     n_loc = n_pad // n_dev
     target = cfg.resolved_target_count(n, topo.target_count)
+    # The base key crosses the jit/shard_map boundary as a replicated runtime
+    # ARGUMENT (raw data + static impl, ops/sampling.key_split): closed over,
+    # it would bake into the executable as a constant, which the axon
+    # platform re-ships on every chunk dispatch (~100 ms/launch).
+    key_data_host, key_impl = sampling.key_split(key)
 
     shard = NamedSharding(mesh, P(NODE_AXIS))
     repl = NamedSharding(mesh, P())
@@ -153,8 +158,8 @@ def run_sharded(
 
     # --- local round bodies (operate on [n_loc] shards) -------------------
 
-    def targets_and_gate(round_idx, *targs):
-        kr = sampling.round_key(key, round_idx)
+    def targets_and_gate(round_idx, key_data, *targs):
+        kr = sampling.round_key(sampling.key_join(key_data, key_impl), round_idx)
         # Full-length draws on every device, then slice: keeps the stream
         # identical to the single-device runner and independent of n_dev.
         dev = lax.axis_index(NODE_AXIS)
@@ -167,7 +172,7 @@ def run_sharded(
                 # populations: same (choice, offsets, send_ok) stream as the
                 # pool-roll path — pool_parts is the single source of that
                 # stream — materialized into explicit targets.
-                choice, offs, send_ok = pool_parts(round_idx, valid_loc)
+                choice, offs, send_ok = pool_parts(round_idx, key_data, valid_loc)
                 targets = sampling.targets_pool(choice, offs, gids, n)
                 return targets, send_ok, valid_loc, gids
             bits_full = sampling.uniform_bits(kr, n_pad)
@@ -185,14 +190,14 @@ def run_sharded(
             send_ok = send_ok & lax.dynamic_slice(gate_full, (start,), (n_loc,))
         return targets, send_ok, valid_loc, gids
 
-    def pool_parts(round_idx, valid_loc):
+    def pool_parts(round_idx, key_data, valid_loc):
         """(choice, offsets, send_ok) shards — the single source of the pool
         sampling stream for BOTH sharded pool paths (roll delivery and the
         non-divisible scatter fallback), matching the single-device pool
         runner (models/runner.py _make_pool_round_fn): shared per-round
         offsets off the replicated round key, packed choice words sliced
         per shard."""
-        kr = sampling.round_key(key, round_idx)
+        kr = sampling.round_key(sampling.key_join(key_data, key_impl), round_idx)
         dev = lax.axis_index(NODE_AXIS)
         start = dev * n_loc
         offs = sampling.pool_offsets(kr, cfg.pool_size, n)
@@ -242,9 +247,9 @@ def run_sharded(
 
         if pool_roll:
 
-            def round_fn(state, round_idx, *targs):
+            def round_fn(state, round_idx, key_data, *targs):
                 (valid_loc,) = targs
-                choice, offs, send_ok = pool_parts(round_idx, valid_loc)
+                choice, offs, send_ok = pool_parts(round_idx, key_data, valid_loc)
                 s_send, w_send, s_keep, w_keep = pushsum_mod.halve_and_send(
                     state.s, state.w, send_ok
                 )
@@ -258,8 +263,10 @@ def run_sharded(
 
         else:
 
-            def round_fn(state, round_idx, *targs):
-                targets, send_ok, _, gids = targets_and_gate(round_idx, *targs)
+            def round_fn(state, round_idx, key_data, *targs):
+                targets, send_ok, _, gids = targets_and_gate(
+                    round_idx, key_data, *targs
+                )
                 s_send, w_send, s_keep, w_keep = pushsum_mod.halve_and_send(
                     state.s, state.w, send_ok
                 )
@@ -303,9 +310,9 @@ def run_sharded(
 
         if pool_roll:
 
-            def round_fn(state, round_idx, *targs):
+            def round_fn(state, round_idx, key_data, *targs):
                 (valid_loc,) = targs
-                choice, offs, send_ok = pool_parts(round_idx, valid_loc)
+                choice, offs, send_ok = pool_parts(round_idx, key_data, valid_loc)
                 conv_of_target = (
                     halo_mod.pool_lookup_sharded(
                         state.conv, choice, offs, NODE_AXIS, n_dev
@@ -323,8 +330,10 @@ def run_sharded(
 
         else:
 
-            def round_fn(state, round_idx, *targs):
-                targets, send_ok, _, gids = targets_and_gate(round_idx, *targs)
+            def round_fn(state, round_idx, key_data, *targs):
+                targets, send_ok, _, gids = targets_and_gate(
+                    round_idx, key_data, *targs
+                )
                 if suppress:
                     conv_of_target = conv_of_target_sharded(
                         state.conv, targets, gids
@@ -347,14 +356,14 @@ def run_sharded(
 
     # --- chunked while_loop under shard_map -------------------------------
 
-    def chunk_local(carry, round_end, *targs):
+    def chunk_local(carry, round_end, key_data, *targs):
         def cond(c):
             _, rnd, done = c
             return jnp.logical_and(~done, rnd < round_end)
 
         def body(c):
             state, rnd, _ = c
-            state = round_fn(state, rnd, *targs)
+            state = round_fn(state, rnd, key_data, *targs)
             conv_count = lax.psum(jnp.sum(state.conv), NODE_AXIS)
             return (state, rnd + 1, conv_count >= target)
 
@@ -369,7 +378,7 @@ def run_sharded(
         jax.shard_map(
             chunk_local,
             mesh=mesh,
-            in_specs=(carry_specs, P()) + topo_specs,
+            in_specs=(carry_specs, P(), P()) + topo_specs,
             out_specs=carry_specs,
             check_vma=False,
         )
@@ -384,10 +393,18 @@ def run_sharded(
         rep_put(np.bool_(False)),
     )
 
+    kd_dev = rep_put(np.asarray(key_data_host))
+
     t0 = time.perf_counter()
-    carry = jax.block_until_ready(
-        chunk_sharded(carry, rep_put(np.int32(start_round)), *topo_args)
+    # Warmup runs ONE real round (kept — the carry advances on the same
+    # absolute-round key stream). A zero-round warmup would leave the while
+    # body unexecuted and the axon tunnel defers a one-time cost to the
+    # first execution that reaches it, which would land in the timed loop.
+    carry = chunk_sharded(
+        carry, rep_put(np.int32(min(start_round + 1, cfg.max_rounds))),
+        kd_dev, *topo_args,
     )
+    int(carry[1])  # data-dependent sync; block_until_ready can return early
     compile_s = time.perf_counter() - t0
 
     rounds = start_round
@@ -395,7 +412,7 @@ def run_sharded(
     while True:
         round_end = min(rounds + cfg.chunk_rounds, cfg.max_rounds)
         carry = chunk_sharded(
-            carry, rep_put(np.int32(round_end)), *topo_args
+            carry, rep_put(np.int32(round_end)), kd_dev, *topo_args
         )
         state, rnd, done = carry
         rounds = int(rnd)  # host sync at the chunk boundary

@@ -10,6 +10,7 @@ import pytest
 from cop5615_gossip_protocol_tpu import SimConfig, build_topology, run
 from cop5615_gossip_protocol_tpu.models import pushsum as P
 from cop5615_gossip_protocol_tpu.models.runner import make_round_fn
+from cop5615_gossip_protocol_tpu.ops import sampling
 
 
 def np_round(s, w, term, conv, targets, send_ok, delta, term_rounds):
@@ -56,10 +57,11 @@ def test_mass_conservation(kind):
     cfg = SimConfig(n=64, topology=kind, algorithm="push-sum", dtype="float64")
     key = jax.random.PRNGKey(0)
     round_fn, state, targs = make_round_fn(topo, cfg, key)
+    key_data, _ = sampling.key_split(key)
     total_s0 = float(jnp.sum(state.s))
     total_w0 = float(jnp.sum(state.w))
     for rnd in range(50):
-        state = round_fn(state, jnp.int32(rnd), *targs)
+        state = round_fn(state, jnp.int32(rnd), key_data, *targs)
         assert float(jnp.sum(state.s)) == pytest.approx(total_s0, rel=1e-12)
         assert float(jnp.sum(state.w)) == pytest.approx(total_w0, rel=1e-12)
 

@@ -8,6 +8,7 @@ import pytest
 from cop5615_gossip_protocol_tpu import SimConfig, build_topology, run
 from cop5615_gossip_protocol_tpu.models import reference as R
 from cop5615_gossip_protocol_tpu.models.runner import draw_leader
+from cop5615_gossip_protocol_tpu.ops import sampling
 
 
 def _cfg(n, kind, **kw):
@@ -24,12 +25,13 @@ def test_walk_mass_conservation():
     key = jax.random.PRNGKey(0)
     leader = draw_leader(key, topo, cfg)
     step_fn, carry, targs = R.make_walk(topo, cfg, key, leader)
+    kd, _ = sampling.key_split(key)
     total0 = float(jnp.sum(carry.s) + carry.msg_s)
     w_total0 = float(jnp.sum(carry.w) + carry.msg_w)
     assert total0 == pytest.approx(topo.n * (topo.n - 1) / 2)
     assert w_total0 == pytest.approx(topo.n)
     for _ in range(200):
-        carry = step_fn(carry, *targs)
+        carry = step_fn(carry, kd, *targs)
         assert float(jnp.sum(carry.s) + carry.msg_s) == pytest.approx(total0, rel=1e-12)
         assert float(jnp.sum(carry.w) + carry.msg_w) == pytest.approx(w_total0, rel=1e-12)
 
@@ -42,8 +44,9 @@ def test_walk_one_message_in_flight():
     key = jax.random.PRNGKey(1)
     leader = draw_leader(key, topo, cfg)
     step_fn, carry, targs = R.make_walk(topo, cfg, key, leader)
+    kd, _ = sampling.key_split(key)
     for _ in range(100):
-        nxt = step_fn(carry, *targs)
+        nxt = step_fn(carry, kd, *targs)
         changed = int(jnp.sum((nxt.s != carry.s) | (nxt.w != carry.w)))
         assert changed <= 1
         assert int(nxt.steps) == int(carry.steps) + 1
@@ -69,8 +72,9 @@ def test_walk_converged_relay_freezes_state():
     key = jax.random.PRNGKey(2)
     leader = draw_leader(key, topo, cfg)
     step_fn, carry, targs = R.make_walk(topo, cfg, key, leader)
+    kd, _ = sampling.key_split(key)
     carry = carry._replace(conv=carry.conv.at[int(carry.cur)].set(True))
-    nxt = step_fn(carry, *targs)
+    nxt = step_fn(carry, kd, *targs)
     cur = int(carry.cur)
     assert float(nxt.s[cur]) == float(carry.s[cur])
     assert float(nxt.msg_s) == float(carry.msg_s)  # relayed unchanged
@@ -90,8 +94,9 @@ def test_walk_dies_on_orphan_q8():
     cfg = _cfg(3, "line")
     key = jax.random.PRNGKey(0)
     step_fn, carry, targs = R.make_walk(topo, cfg, key, jnp.int32(0))
+    kd, _ = sampling.key_split(key)
     carry = carry._replace(cur=jnp.int32(2))  # force the walk onto the orphan
-    nxt = step_fn(carry, *targs)
+    nxt = step_fn(carry, kd, *targs)
     assert bool(nxt.dead)
 
 
