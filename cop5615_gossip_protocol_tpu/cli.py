@@ -42,6 +42,15 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("algorithm", help="gossip | push-sum")
     p.add_argument(
+        "--backend",
+        choices=["jax", "refsim", "akka"],
+        default="jax",
+        help="jax: the TPU-native batched engine (default); refsim: the "
+        "native C++ discrete-event model of the reference's Akka actor "
+        "semantics (native/refsim.cpp — the runnable stand-in for "
+        "`dotnet run`, no .NET in this image); akka is an alias for refsim",
+    )
+    p.add_argument(
         "--semantics",
         choices=["batched", "reference"],
         default="batched",
@@ -107,8 +116,107 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _main_refsim(args) -> int:
+    """--backend refsim|akka: run the native discrete-event reference
+    simulator instead of the JAX engine. No JAX involvement at all — the
+    north-star `--backend {akka|jax}` switch on the parity triple
+    (BASELINE.json), with the C++ DES standing in for the Akka runtime."""
+    from . import native
+
+    # Flags that configure the JAX engine have no meaning in the native DES
+    # (its constants ARE the reference's hard-coded ones) — fail loudly
+    # rather than silently ignoring an explicit request.
+    inapplicable = {
+        "--semantics reference": args.semantics != "batched",
+        "--dtype": args.dtype is not None,
+        "--delta": args.delta is not None,
+        "--rumor-threshold": args.rumor_threshold != 10,
+        "--term-rounds": args.term_rounds != 3,
+        "--max-rounds": args.max_rounds != 1_000_000,
+        "--chunk-rounds": args.chunk_rounds != 4096,
+        "--target-frac": args.target_frac is not None,
+        "--suppress": args.suppress != "auto",
+        "--fault-rate": args.fault_rate != 0.0,
+        "--delivery": args.delivery != "auto",
+        "--pool-size": args.pool_size != 4,
+        "--engine": args.engine != "auto",
+        "--devices": args.devices is not None,
+        "--platform": args.platform != "auto",
+        "--x64": args.x64,
+        "--distributed/--coordinator": args.distributed or args.coordinator,
+        "--num-processes/--process-id": args.num_processes is not None
+        or args.process_id is not None,
+        "--profile": args.profile is not None,
+        "--checkpoint": args.checkpoint is not None
+        or args.checkpoint_every != 1,
+        "--resume": args.resume is not None,
+    }
+    bad = [flag for flag, set_ in inapplicable.items() if set_]
+    if bad:
+        print(
+            f"Invalid: {', '.join(bad)} does not apply to --backend "
+            f"{args.backend} (the native simulator runs the reference's "
+            "exact semantics and hard-coded constants)",
+            file=sys.stderr,
+        )
+        return 2
+    try:
+        algorithm = normalize_algorithm(args.algorithm)
+        # The native engine models the reference, so reference topology
+        # normalization applies ("2D" -> the line-wired ref2d, quirk Q6).
+        kind = normalize_topology(args.topology, "reference")
+    except ValueError as e:
+        print(f"Invalid: {e}", file=sys.stderr)
+        return 2
+    if kind not in native.NATIVE_TOPOLOGIES:
+        print(
+            f"Invalid: topology {args.topology!r} is not one the reference "
+            f"implements; --backend {args.backend} supports "
+            f"{sorted(native.NATIVE_TOPOLOGIES)}",
+            file=sys.stderr,
+        )
+        return 2
+    print(
+        f"Starting {algorithm} on {kind} "
+        f"(native reference semantics, seed={args.seed})"
+    )
+    try:
+        r = native.refsim_run(args.numNodes, kind, algorithm, seed=args.seed)
+    except ValueError as e:
+        print(f"Invalid: {e}", file=sys.stderr)
+        return 2
+    # Byte-compatible with the reference's output (program.fs:51-52).
+    print("-" * 59)
+    print(f"Convergence Time: {r.wall_ms:f} ms")
+    record = {
+        "backend": args.backend,
+        "config": {
+            "n": args.numNodes, "topology": kind, "algorithm": algorithm,
+            "seed": args.seed,
+        },
+        "population": r.population,
+        "target_count": r.target,
+        "converged_count": r.converged,
+        "converged": r.ok and r.converged >= r.target,
+        "events": r.events,
+        "max_queue": r.max_queue,
+        "leader": r.leader,
+        "wall_ms": r.wall_ms,
+    }
+    if not args.quiet:
+        print(json.dumps(record))
+    if args.jsonl:
+        from .utils import metrics
+
+        metrics.append_jsonl(args.jsonl, record)
+    return 0 if record["converged"] else 1
+
+
 def main(argv: Optional[list[str]] = None) -> int:
     args = build_parser().parse_args(argv)
+
+    if args.backend in ("refsim", "akka"):
+        return _main_refsim(args)
 
     import jax  # deferred so --platform can take effect before backend init
 

@@ -53,7 +53,8 @@ class WalkCarry(NamedTuple):
 
 
 def make_walk(topo: Topology, cfg: SimConfig, base_key: jax.Array, leader: jax.Array):
-    """Build (step_fn, carry0, topo_args) for the single-walk push-sum.
+    """Build (step_fn, carry0, key_data, topo_args) for the single-walk
+    push-sum.
 
     step_fn(carry, key_data, *topo_args) -> carry advances one message hop
     (``key_data`` is the raw base key from ops/sampling.key_split, passed as
@@ -65,7 +66,7 @@ def make_walk(topo: Topology, cfg: SimConfig, base_key: jax.Array, leader: jax.A
     n = topo.n
     delta = jnp.asarray(cfg.resolved_delta, dtype)
     term_rounds = cfg.term_rounds
-    _, key_impl = sampling.key_split(base_key)
+    key_data, key_impl = sampling.key_split(base_key)
 
     if topo.implicit:
         topo_args = ()
@@ -146,7 +147,7 @@ def make_walk(topo: Topology, cfg: SimConfig, base_key: jax.Array, leader: jax.A
             dead=c.dead | ~ok,
         )
 
-    return step_fn, carry0, topo_args
+    return step_fn, carry0, key_data, topo_args
 
 
 def run_walk(topo: Topology, cfg: SimConfig, base_key: jax.Array, leader: jax.Array, target: int):
@@ -158,8 +159,7 @@ def run_walk(topo: Topology, cfg: SimConfig, base_key: jax.Array, leader: jax.Ar
     """
     import time
 
-    step_fn, carry0, topo_args = make_walk(topo, cfg, base_key, leader)
-    key_data, _ = sampling.key_split(base_key)
+    step_fn, carry0, key_data, topo_args = make_walk(topo, cfg, base_key, leader)
     max_steps = cfg.max_rounds
 
     def whole(c: WalkCarry, key_data, *targs):

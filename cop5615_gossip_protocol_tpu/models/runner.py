@@ -114,16 +114,19 @@ def resolve_deliver_fn(topo: Topology, cfg: SimConfig):
 
 
 def make_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array):
-    """Build (round_fn, state0, topo_args).
+    """Build (round_fn, state0, key_data, topo_args).
 
     ``round_fn(state, round_idx, key_data, *topo_args) -> state`` is one
     synchronous protocol round, pure and jittable — the unit
     `__graft_entry__.entry` compile-checks. ``topo_args`` carries the
-    neighbor tensors, and ``key_data`` the raw PRNG key
+    neighbor tensors, and ``key_data`` the raw form of ``base_key``
     (ops/sampling.key_split), as explicit arguments: arrays closed over by a
     jitted round would be baked into the executable as constants, which the
     axon remote-TPU platform re-ships on EVERY dispatch (~100 ms/launch,
-    measured — it dominated all small-N walls).
+    measured — it dominated all small-N walls). ``key_data`` is returned
+    alongside so callers feed back the exact data matching the impl the
+    round function captured — re-splitting a different key would silently
+    mix streams.
     """
     dtype = _check_dtype(cfg)
     n = topo.n
@@ -135,7 +138,7 @@ def make_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array):
             )
         return _make_pool_round_fn(topo, cfg, base_key, dtype)
 
-    _, key_impl = sampling.key_split(base_key)
+    key_data, key_impl = sampling.key_split(base_key)
 
     if topo.implicit:
         topo_args = ()
@@ -187,7 +190,7 @@ def make_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array):
                 state, targets, send_ok, n, rumor_target, suppress, deliver_fn
             )
 
-    return round_fn, state0, topo_args
+    return round_fn, state0, key_data, topo_args
 
 
 def _make_pool_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array, dtype):
@@ -199,7 +202,7 @@ def _make_pool_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array, dty
     on v5e; bench.py)."""
     n = topo.n
     K = cfg.pool_size
-    _, key_impl = sampling.key_split(base_key)
+    key_data, key_impl = sampling.key_split(base_key)
 
     def pool_parts(round_idx, key_data):
         with jax.named_scope("sample"):
@@ -257,7 +260,7 @@ def _make_pool_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array, dty
             with jax.named_scope("gossip_absorb"):
                 return gossip_mod.absorb(state, inbox, rumor_target)
 
-    return round_fn, state0, ()
+    return round_fn, state0, key_data, ()
 
 
 def _run_reference_walk(topo: Topology, cfg: SimConfig, key, target: int) -> RunResult:
@@ -555,8 +558,7 @@ def run(
                 interpret=False, variant=variant,
             )
 
-    round_fn, state0, topo_args = make_round_fn(topo, cfg, key)
-    key_data, _ = sampling.key_split(key)
+    round_fn, state0, key_data, topo_args = make_round_fn(topo, cfg, key)
     if start_state is not None:
         state0 = jax.tree.map(jnp.asarray, start_state)
 

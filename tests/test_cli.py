@@ -196,3 +196,50 @@ def test_cli_coordinator_flag_validation(capsys):
                "127.0.0.1:1", "--num-processes", "3", "--process-id", "0"])
     assert rc == 2
     assert "divisible" in capsys.readouterr().err
+
+
+def test_cli_backend_refsim(capsys):
+    # The north-star `--backend {akka|jax}` switch (BASELINE.json): the
+    # native DES stands in for the Akka runtime on the same parity triple.
+    rc = main(["100", "2D", "gossip", "--backend", "refsim"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "-----------------------------------------------------------" in out
+    assert "Convergence Time: " in out
+    rec = json.loads(out.strip().splitlines()[-1])
+    assert rec["backend"] == "refsim"
+    assert rec["config"]["topology"] == "ref2d"  # Q6 applies: "2D" is a line
+    assert rec["population"] == rec["target_count"] + 1  # Q1
+    assert rec["converged"] is True
+    assert rec["events"] > 0
+
+
+def test_cli_backend_akka_alias_and_seed(capsys):
+    rc1 = main(["50", "full", "push-sum", "--backend", "akka", "--seed", "7"])
+    rec1 = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    rc2 = main(["50", "full", "push-sum", "--backend", "refsim", "--seed", "7"])
+    rec2 = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc1 == rc2 == 0
+    # Same DES, same seed — identical event trajectory either spelling.
+    assert rec1["events"] == rec2["events"]
+    assert rec1["leader"] == rec2["leader"]
+    assert rec1["max_queue"] == rec2["max_queue"] == 1  # single-walk push-sum
+
+
+def test_cli_backend_refsim_rejects_framework_topologies(capsys):
+    rc = main(["100", "torus3d", "gossip", "--backend", "refsim"])
+    err = capsys.readouterr().err
+    assert rc == 2
+    assert "not one the reference implements" in err
+
+
+def test_cli_backend_refsim_rejects_jax_only_flags(capsys):
+    rc = main(["100", "full", "gossip", "--backend", "refsim", "--devices", "4"])
+    err = capsys.readouterr().err
+    assert rc == 2
+    assert "--devices" in err and "does not apply" in err
+    rc = main(["100", "full", "gossip", "--backend", "akka",
+               "--engine", "fused"])
+    err = capsys.readouterr().err
+    assert rc == 2
+    assert "--engine" in err

@@ -10,7 +10,6 @@ import pytest
 from cop5615_gossip_protocol_tpu import SimConfig, build_topology, run
 from cop5615_gossip_protocol_tpu.models import pushsum as P
 from cop5615_gossip_protocol_tpu.models.runner import make_round_fn
-from cop5615_gossip_protocol_tpu.ops import sampling
 
 
 def np_round(s, w, term, conv, targets, send_ok, delta, term_rounds):
@@ -56,8 +55,7 @@ def test_mass_conservation(kind):
     topo = build_topology(kind, 64, seed=0)
     cfg = SimConfig(n=64, topology=kind, algorithm="push-sum", dtype="float64")
     key = jax.random.PRNGKey(0)
-    round_fn, state, targs = make_round_fn(topo, cfg, key)
-    key_data, _ = sampling.key_split(key)
+    round_fn, state, key_data, targs = make_round_fn(topo, cfg, key)
     total_s0 = float(jnp.sum(state.s))
     total_w0 = float(jnp.sum(state.w))
     for rnd in range(50):

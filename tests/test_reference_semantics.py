@@ -8,7 +8,6 @@ import pytest
 from cop5615_gossip_protocol_tpu import SimConfig, build_topology, run
 from cop5615_gossip_protocol_tpu.models import reference as R
 from cop5615_gossip_protocol_tpu.models.runner import draw_leader
-from cop5615_gossip_protocol_tpu.ops import sampling
 
 
 def _cfg(n, kind, **kw):
@@ -24,8 +23,7 @@ def test_walk_mass_conservation():
     topo = build_topology("full", 20, semantics="reference")
     key = jax.random.PRNGKey(0)
     leader = draw_leader(key, topo, cfg)
-    step_fn, carry, targs = R.make_walk(topo, cfg, key, leader)
-    kd, _ = sampling.key_split(key)
+    step_fn, carry, kd, targs = R.make_walk(topo, cfg, key, leader)
     total0 = float(jnp.sum(carry.s) + carry.msg_s)
     w_total0 = float(jnp.sum(carry.w) + carry.msg_w)
     assert total0 == pytest.approx(topo.n * (topo.n - 1) / 2)
@@ -43,8 +41,7 @@ def test_walk_one_message_in_flight():
     topo = build_topology("full", 20, semantics="reference")
     key = jax.random.PRNGKey(1)
     leader = draw_leader(key, topo, cfg)
-    step_fn, carry, targs = R.make_walk(topo, cfg, key, leader)
-    kd, _ = sampling.key_split(key)
+    step_fn, carry, kd, targs = R.make_walk(topo, cfg, key, leader)
     for _ in range(100):
         nxt = step_fn(carry, kd, *targs)
         changed = int(jnp.sum((nxt.s != carry.s) | (nxt.w != carry.w)))
@@ -71,8 +68,7 @@ def test_walk_converged_relay_freezes_state():
     topo = build_topology("full", 10, semantics="reference")
     key = jax.random.PRNGKey(2)
     leader = draw_leader(key, topo, cfg)
-    step_fn, carry, targs = R.make_walk(topo, cfg, key, leader)
-    kd, _ = sampling.key_split(key)
+    step_fn, carry, kd, targs = R.make_walk(topo, cfg, key, leader)
     carry = carry._replace(conv=carry.conv.at[int(carry.cur)].set(True))
     nxt = step_fn(carry, kd, *targs)
     cur = int(carry.cur)
@@ -93,8 +89,7 @@ def test_walk_dies_on_orphan_q8():
     topo = Topology("line", 3, 3, 3, 1, neighbors, degree)
     cfg = _cfg(3, "line")
     key = jax.random.PRNGKey(0)
-    step_fn, carry, targs = R.make_walk(topo, cfg, key, jnp.int32(0))
-    kd, _ = sampling.key_split(key)
+    step_fn, carry, kd, targs = R.make_walk(topo, cfg, key, jnp.int32(0))
     carry = carry._replace(cur=jnp.int32(2))  # force the walk onto the orphan
     nxt = step_fn(carry, kd, *targs)
     assert bool(nxt.dead)
