@@ -559,8 +559,14 @@ def run(
             )
 
     round_fn, state0, key_data, topo_args = make_round_fn(topo, cfg, key)
+    done0 = False
     if start_state is not None:
         state0 = jax.tree.map(jnp.asarray, start_state)
+        # Seed the loop predicate from the resumed state: a checkpoint taken
+        # at/after convergence must execute ZERO further rounds, matching the
+        # fused kernels (which seed their done flag from the incoming conv
+        # plane) — otherwise the resumed trajectory gains a phantom round.
+        done0 = bool(jnp.sum(state0.conv) >= target)
 
     def chunk(carry, round_end, key_data, *targs):
         def cond(c):
@@ -576,7 +582,7 @@ def run(
         return lax.while_loop(cond, body, carry)
 
     chunk_j = jax.jit(chunk)
-    carry = (state0, jnp.int32(start_round), jnp.bool_(False))
+    carry = (state0, jnp.int32(start_round), jnp.bool_(done0))
 
     t0 = time.perf_counter()
     # Warmup runs ONE real round (kept: the carry advances, the main loop

@@ -346,6 +346,7 @@ def run_sharded(
                 inbox = deliver_sharded(vals, targets, gids)
                 return gossip_mod.absorb(state, inbox, rumor_target)
 
+    done0 = False
     if start_state is not None:
         fills = {"s": 0.0, "w": 1.0, "term": cfg.initial_term_round,
                  "conv": False, "count": 0, "active": False}
@@ -353,6 +354,10 @@ def run_sharded(
             f: dev_put(_pad_to(np.asarray(getattr(start_state, f)), n_pad, fills[f]))
             for f in state0._fields
         })
+        # Seed the loop predicate from the resumed state — a checkpoint taken
+        # at/after convergence must execute zero further rounds (matches the
+        # single-device runner and the fused kernels' conv-plane seeding).
+        done0 = bool(np.asarray(start_state.conv).sum() >= target)
 
     # --- chunked while_loop under shard_map -------------------------------
 
@@ -390,7 +395,7 @@ def run_sharded(
     carry = (
         state0,
         rep_put(np.int32(start_round)),
-        rep_put(np.bool_(False)),
+        rep_put(np.bool_(done0)),
     )
 
     kd_dev = rep_put(np.asarray(key_data_host))

@@ -243,3 +243,43 @@ def test_cli_backend_refsim_rejects_jax_only_flags(capsys):
     err = capsys.readouterr().err
     assert rc == 2
     assert "--engine" in err
+
+
+def test_resume_from_converged_state_runs_zero_rounds(tmp_path):
+    # A checkpoint taken at (or after) convergence must resume to an
+    # immediate no-op on every engine: the loop predicate seeds from the
+    # resumed conv vector, matching the fused kernels' conv-plane seeding.
+    from cop5615_gossip_protocol_tpu import SimConfig, build_topology, run
+    from cop5615_gossip_protocol_tpu.parallel.mesh import make_mesh
+    from cop5615_gossip_protocol_tpu.parallel.sharded import run_sharded
+
+    cfg = SimConfig(n=256, topology="grid2d", algorithm="gossip")
+    topo = build_topology("grid2d", 256)
+    full = run(topo, cfg)
+    assert full.converged
+
+    final_state = {}
+
+    def grab(rounds, st):
+        final_state["st"], final_state["rounds"] = st, rounds
+
+    run(topo, cfg, on_chunk=grab)
+    resumed = run(
+        topo, cfg,
+        start_state=final_state["st"], start_round=final_state["rounds"],
+    )
+    assert resumed.converged
+    assert resumed.rounds == final_state["rounds"]  # zero extra rounds
+
+    mesh = make_mesh(4)
+    import numpy as np
+
+    unpadded = type(final_state["st"])(
+        *(np.asarray(x)[: topo.n] for x in final_state["st"])
+    )
+    resumed_sh = run_sharded(
+        topo, cfg, mesh=mesh,
+        start_state=unpadded, start_round=final_state["rounds"],
+    )
+    assert resumed_sh.converged
+    assert resumed_sh.rounds == final_state["rounds"]

@@ -124,3 +124,23 @@ def test_determinism_and_seed_sensitivity():
     leaders = {int(draw_leader(k, topo, cfg7)) for k in (k7, k8)}
     assert leaders  # draw is valid under both seeds
     assert all(0 <= ld < 128 for ld in leaders)
+
+
+def test_typed_and_legacy_keys_share_a_trajectory():
+    # ops/sampling.key_split passes the default threefry key through as raw
+    # uint32 data; a new-style typed key (jax.random.key), the classic
+    # PRNGKey, and the raw data itself must all drive the identical
+    # trajectory — a silent stream split here would break resume.
+    from cop5615_gossip_protocol_tpu import SimConfig, build_topology, run
+
+    cfg = SimConfig(n=144, topology="grid2d", algorithm="gossip")
+    topo = build_topology("grid2d", 144)
+    r_prng = run(topo, cfg, key=jax.random.PRNGKey(5))
+    r_typed = run(topo, cfg, key=jax.random.key(5))
+    r_raw = run(topo, cfg, key=jax.random.key_data(jax.random.PRNGKey(5)))
+    assert r_prng.rounds == r_typed.rounds == r_raw.rounds
+    assert (
+        r_prng.converged_count
+        == r_typed.converged_count
+        == r_raw.converged_count
+    )
