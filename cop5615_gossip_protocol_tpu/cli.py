@@ -116,40 +116,46 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def _main_refsim(args) -> int:
+def _main_refsim(args, parser) -> int:
     """--backend refsim|akka: run the native discrete-event reference
-    simulator instead of the JAX engine. No JAX involvement at all — the
-    north-star `--backend {akka|jax}` switch on the parity triple
-    (BASELINE.json), with the C++ DES standing in for the Akka runtime."""
+    simulator instead of the JAX engine (no JAX backend is ever
+    initialized) — the north-star `--backend {akka|jax}` switch on the
+    parity triple (BASELINE.json), with the C++ DES standing in for the
+    Akka runtime."""
     from . import native
+    from .utils import metrics
 
     # Flags that configure the JAX engine have no meaning in the native DES
     # (its constants ARE the reference's hard-coded ones) — fail loudly
-    # rather than silently ignoring an explicit request.
+    # rather than silently ignoring an explicit request. Compared against
+    # the parser's own defaults so the guard cannot rot if one changes.
+    def changed(dest):
+        return getattr(args, dest) != parser.get_default(dest)
+
     inapplicable = {
-        "--semantics reference": args.semantics != "batched",
-        "--dtype": args.dtype is not None,
-        "--delta": args.delta is not None,
-        "--rumor-threshold": args.rumor_threshold != 10,
-        "--term-rounds": args.term_rounds != 3,
-        "--max-rounds": args.max_rounds != 1_000_000,
-        "--chunk-rounds": args.chunk_rounds != 4096,
-        "--target-frac": args.target_frac is not None,
-        "--suppress": args.suppress != "auto",
-        "--fault-rate": args.fault_rate != 0.0,
-        "--delivery": args.delivery != "auto",
-        "--pool-size": args.pool_size != 4,
-        "--engine": args.engine != "auto",
-        "--devices": args.devices is not None,
-        "--platform": args.platform != "auto",
-        "--x64": args.x64,
-        "--distributed/--coordinator": args.distributed or args.coordinator,
-        "--num-processes/--process-id": args.num_processes is not None
-        or args.process_id is not None,
-        "--profile": args.profile is not None,
-        "--checkpoint": args.checkpoint is not None
-        or args.checkpoint_every != 1,
-        "--resume": args.resume is not None,
+        "--semantics reference": changed("semantics"),
+        "--dtype": changed("dtype"),
+        "--delta": changed("delta"),
+        "--rumor-threshold": changed("rumor_threshold"),
+        "--term-rounds": changed("term_rounds"),
+        "--max-rounds": changed("max_rounds"),
+        "--chunk-rounds": changed("chunk_rounds"),
+        "--target-frac": changed("target_frac"),
+        "--suppress": changed("suppress"),
+        "--fault-rate": changed("fault_rate"),
+        "--delivery": changed("delivery"),
+        "--pool-size": changed("pool_size"),
+        "--engine": changed("engine"),
+        "--devices": changed("devices"),
+        "--platform": changed("platform"),
+        "--x64": changed("x64"),
+        "--distributed/--coordinator": changed("distributed")
+        or changed("coordinator"),
+        "--num-processes/--process-id": changed("num_processes")
+        or changed("process_id"),
+        "--profile": changed("profile"),
+        "--checkpoint": changed("checkpoint") or changed("checkpoint_every"),
+        "--resume": changed("resume"),
     }
     bad = [flag for flag, set_ in inapplicable.items() if set_]
     if bad:
@@ -185,9 +191,7 @@ def _main_refsim(args) -> int:
     except ValueError as e:
         print(f"Invalid: {e}", file=sys.stderr)
         return 2
-    # Byte-compatible with the reference's output (program.fs:51-52).
-    print("-" * 59)
-    print(f"Convergence Time: {r.wall_ms:f} ms")
+    print(metrics.convergence_line(r.wall_ms))
     record = {
         "backend": args.backend,
         "config": {
@@ -206,17 +210,16 @@ def _main_refsim(args) -> int:
     if not args.quiet:
         print(json.dumps(record))
     if args.jsonl:
-        from .utils import metrics
-
         metrics.append_jsonl(args.jsonl, record)
     return 0 if record["converged"] else 1
 
 
 def main(argv: Optional[list[str]] = None) -> int:
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
 
     if args.backend in ("refsim", "akka"):
-        return _main_refsim(args)
+        return _main_refsim(args, parser)
 
     import jax  # deferred so --platform can take effect before backend init
 

@@ -14,11 +14,12 @@ from __future__ import annotations
 import dataclasses
 import json
 from pathlib import Path
-from typing import Optional
+from typing import TYPE_CHECKING, Optional
 
-from ..config import SimConfig
-from ..models.runner import RunResult
-from ..ops.topology import Topology
+if TYPE_CHECKING:  # type-only: keeps this module importable without JAX
+    from ..config import SimConfig
+    from ..models.runner import RunResult
+    from ..ops.topology import Topology
 
 
 def banner(cfg: SimConfig) -> str:
@@ -30,16 +31,22 @@ def banner(cfg: SimConfig) -> str:
     )
 
 
-def reference_format(result: RunResult) -> str:
-    """The reference's convergence print, byte-compatible: dashed rule then
-    'Convergence Time: %f ms' (program.fs:50-52). Timed quantity is the
-    steady-state run wall-clock — the reference's Stopwatch also excludes
-    topology build (started at program.fs:175), and we additionally exclude
-    XLA compile (reported separately in the JSON record)."""
+def convergence_line(wall_ms: float) -> str:
+    """The reference's convergence print, byte-compatible: 59-dash rule then
+    'Convergence Time: %f ms' (program.fs:50-52). Single source of the
+    format for every backend (the C++ refsim CLI mirrors it in refsim.cpp)."""
     return (
         "-----------------------------------------------------------\n"
-        f"Convergence Time: {result.wall_ms:.6f} ms"
+        f"Convergence Time: {wall_ms:.6f} ms"
     )
+
+
+def reference_format(result: RunResult) -> str:
+    """convergence_line on a RunResult. Timed quantity is the steady-state
+    run wall-clock — the reference's Stopwatch also excludes topology build
+    (started at program.fs:175), and we additionally exclude XLA compile
+    (reported separately in the JSON record)."""
+    return convergence_line(result.wall_ms)
 
 
 def run_record(
