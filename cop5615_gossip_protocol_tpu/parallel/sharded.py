@@ -49,7 +49,6 @@ convergence quality is unchanged.
 from __future__ import annotations
 
 import time
-from functools import partial
 from typing import Callable, Optional
 
 import jax
@@ -104,6 +103,18 @@ def run_sharded(
     # it would bake into the executable as a constant, which the axon
     # platform re-ships on every chunk dispatch (~100 ms/launch).
     key_data_host, key_impl = sampling.key_split(key)
+    if n_pad != n and not jax.config.jax_threefry_partitionable:
+        # The stream contract (ops/sampling.py: every device draws the same
+        # full-length words and slices its shard) holds at padded lengths
+        # only under the position-wise partitionable threefry — legacy
+        # threefry bits depend on the total draw length, so a padded
+        # full-length draw would silently diverge from the single-device
+        # stream. Same guard the fused engines apply.
+        raise ValueError(
+            f"sharded runs at a population ({n}) not divisible by the mesh "
+            f"({n_dev} devices) require jax_threefry_partitionable=True; "
+            "enable it or pick a divisible population"
+        )
 
     shard = NamedSharding(mesh, P(NODE_AXIS))
     repl = NamedSharding(mesh, P())
@@ -141,7 +152,11 @@ def run_sharded(
         """
         host_array = np.asarray(host_array)
         if sharding.is_fully_addressable:
-            return jax.device_put(jnp.asarray(host_array), sharding)
+            # Shard straight from host memory: wrapping in jnp.asarray first
+            # would commit the whole array to the default device before
+            # resharding — a transient full-size single-device HBM spike at
+            # the 16M-node scale (~450 MB of neighbor tables).
+            return jax.device_put(host_array, sharding)
         return jax.make_array_from_callback(
             host_array.shape, sharding, lambda idx: host_array[idx]
         )
@@ -231,10 +246,14 @@ def run_sharded(
         def deliver_sharded(values, targets, gids):
             """Scatter into a full-length contribution vector, then
             reduce-scatter so each device receives its own summed inbox
-            shard."""
-            contrib = jnp.zeros((n_pad,), values.dtype).at[targets].add(values)
+            shard. ``values`` may be [..., n_loc]: stacked channels share
+            one scatter pass and one collective (as the halo and pool
+            delivery paths already do)."""
+            contrib = jnp.zeros(values.shape[:-1] + (n_pad,), values.dtype)
+            contrib = contrib.at[..., targets].add(values)
             return lax.psum_scatter(
-                contrib, NODE_AXIS, scatter_dimension=0, tiled=True
+                contrib, NODE_AXIS, scatter_dimension=contrib.ndim - 1,
+                tiled=True,
             )
 
         def conv_of_target_sharded(conv_loc, targets, gids):
@@ -270,16 +289,13 @@ def run_sharded(
                 s_send, w_send, s_keep, w_keep = pushsum_mod.halve_and_send(
                     state.s, state.w, send_ok
                 )
-                if plan is not None:
-                    # Stack s/w so both channels ride one ppermute per offset
-                    # class (halves the per-round collective count).
-                    inbox = deliver_sharded(
-                        jnp.stack([s_send, w_send]), targets, gids
-                    )
-                    inbox_s, inbox_w = inbox[0], inbox[1]
-                else:
-                    inbox_s = deliver_sharded(s_send, targets, gids)
-                    inbox_w = deliver_sharded(w_send, targets, gids)
+                # Stack s/w so both channels share the delivery's
+                # collectives (one ppermute set per offset class on the halo
+                # path; one scatter + reduce-scatter on the fallback).
+                inbox = deliver_sharded(
+                    jnp.stack([s_send, w_send]), targets, gids
+                )
+                inbox_s, inbox_w = inbox[0], inbox[1]
                 return pushsum_mod.absorb(
                     state, s_keep, w_keep, inbox_s, inbox_w, delta, term_rounds
                 )
