@@ -93,7 +93,7 @@ def main(argv=None) -> int:
         )
         return 1
 
-    rounds_per_sec = result.rounds / result.run_s if result.run_s > 0 else 0.0
+    rounds_per_sec = result.to_record()["rounds_per_sec"] or 0.0
     akka_extrapolated_s = AKKA_MS_PER_NODE * args.n / 1e3
     vs_baseline = akka_extrapolated_s / result.run_s if result.run_s > 0 else 0.0
     out = {

@@ -313,12 +313,18 @@ def _northstar_section(seed: int) -> list[str]:
             n=n, topology=kind, algorithm=algo, seed=seed, delivery=delivery,
             max_rounds=cap or 1_000_000,
         )
-        topo = build_topology(kind, n, seed=seed)
-        res = run(topo, cfg)
+        try:
+            topo = build_topology(kind, n, seed=seed)
+            res = run(topo, cfg)
+        except Exception as e:  # noqa: BLE001 — a failed row must not void
+            # the many minutes of grid/scale measurements above it.
+            out.append(f"| {n:,} {kind} {algo} | — | ERROR: {e} | — | — | — |")
+            print(f"[suite] northstar {kind}/{algo} FAILED: {e}", flush=True)
+            continue
         status = "converged" if res.converged else (
             f"bounded sample ({cap:,} rounds)" if cap else "DID NOT CONVERGE"
         )
-        rps = res.rounds / res.run_s if res.run_s > 0 else 0.0
+        rps = res.to_record()["rounds_per_sec"] or 0.0
         out.append(
             f"| {n:,} {kind} {algo} | {topo.n:,} | {status} "
             f"| {_fmt(res.wall_ms)} | {res.rounds:,} | {rps:,.0f} |"

@@ -132,8 +132,10 @@ def _main_refsim(args, parser) -> int:
     def changed(dest):
         return getattr(args, dest) != parser.get_default(dest)
 
+    # --semantics is deliberately absent: the native DES IS reference
+    # semantics, so asking for it is redundant-but-correct, and "batched"
+    # is indistinguishable from the default.
     inapplicable = {
-        "--semantics reference": changed("semantics"),
         "--dtype": changed("dtype"),
         "--delta": changed("delta"),
         "--rumor-threshold": changed("rumor_threshold"),
@@ -191,7 +193,18 @@ def _main_refsim(args, parser) -> int:
     except ValueError as e:
         print(f"Invalid: {e}", file=sys.stderr)
         return 2
-    print(metrics.convergence_line(r.wall_ms))
+    converged = r.ok and r.converged >= r.target
+    if converged:
+        print(metrics.convergence_line(r.wall_ms))
+    else:
+        # Mirror the standalone C++ CLI (refsim.cpp): no convergence time
+        # ever happened, so none is printed — the reference's only
+        # non-convergence behavior was hanging forever (program.fs:334).
+        print(
+            f"did not converge: {r.converged}/{r.target} nodes after "
+            f"{r.events} events",
+            file=sys.stderr,
+        )
     record = {
         "backend": args.backend,
         "config": {
@@ -201,7 +214,7 @@ def _main_refsim(args, parser) -> int:
         "population": r.population,
         "target_count": r.target,
         "converged_count": r.converged,
-        "converged": r.ok and r.converged >= r.target,
+        "converged": converged,
         "events": r.events,
         "max_queue": r.max_queue,
         "leader": r.leader,

@@ -160,9 +160,8 @@ def run_walk(topo: Topology, cfg: SimConfig, base_key: jax.Array, leader: jax.Ar
     import time
 
     step_fn, carry0, key_data, topo_args = make_walk(topo, cfg, base_key, leader)
-    max_steps = cfg.max_rounds
 
-    def whole(c: WalkCarry, key_data, *targs):
+    def whole(c: WalkCarry, key_data, max_steps, *targs):
         def cond(c):
             return (~c.dead) & (c.steps < max_steps) & (jnp.sum(c.conv) < target)
 
@@ -171,10 +170,22 @@ def run_walk(topo: Topology, cfg: SimConfig, base_key: jax.Array, leader: jax.Ar
 
         return lax.while_loop(cond, body, c)
 
+    whole_j = jax.jit(whole)
     t0 = time.perf_counter()
-    compiled = jax.jit(whole).lower(carry0, key_data, *topo_args).compile()
+    # Warmup executes ONE hop and discards it (max_steps is a traced bound,
+    # so the same executable serves both calls; the timed run recomputes the
+    # hop from carry0 on the same absolute-step key stream). Without it the
+    # axon tunnel's deferred first-execution cost would land in run_s —
+    # the same accounting rule as the batched engines' warmups.
+    warm = whole_j(
+        carry0, key_data,
+        jnp.int32(min(int(carry0.steps) + 1, cfg.max_rounds)), *topo_args,
+    )
+    int(warm.steps)  # data-dependent sync; block_until_ready can lie here
+    del warm
     compile_s = time.perf_counter() - t0
     t1 = time.perf_counter()
-    final = jax.block_until_ready(compiled(carry0, key_data, *topo_args))
+    final = whole_j(carry0, key_data, jnp.int32(cfg.max_rounds), *topo_args)
+    int(final.steps)  # force completion before stopping the clock
     run_s = time.perf_counter() - t1
     return final, compile_s, run_s

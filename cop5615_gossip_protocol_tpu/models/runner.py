@@ -585,17 +585,19 @@ def run(
     carry = (state0, jnp.int32(start_round), jnp.bool_(done0))
 
     t0 = time.perf_counter()
-    # Warmup runs ONE real round (kept: the carry advances, the main loop
-    # continues from it on the same absolute-round key stream). With a
-    # zero-round warmup the while body never executes, and the axon tunnel
-    # defers a one-time cost to the first execution that reaches it — which
-    # would land inside the timed loop. Clamped so max_rounds still bounds
-    # the trajectory.
-    carry = chunk_j(
+    # Warmup runs ONE real round and DISCARDS the result — the timed loop
+    # recomputes round 0 from the original carry on the same absolute-round
+    # key stream, so run_s covers every round that `rounds` counts (same
+    # accounting rule as _run_fused). A zero-round warmup would leave the
+    # while body unexecuted, and the axon tunnel defers a one-time cost to
+    # the first execution that reaches it — which would land inside the
+    # timed loop. Clamped so max_rounds still bounds the trajectory.
+    warm = chunk_j(
         carry, jnp.int32(min(start_round + 1, cfg.max_rounds)),
         key_data, *topo_args,
     )
-    int(carry[1])  # data-dependent sync; block_until_ready can return early
+    int(warm[1])  # data-dependent sync; block_until_ready can return early
+    del warm
     compile_s = time.perf_counter() - t0
 
     rounds = start_round

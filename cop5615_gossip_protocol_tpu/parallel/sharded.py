@@ -417,15 +417,18 @@ def run_sharded(
     kd_dev = rep_put(np.asarray(key_data_host))
 
     t0 = time.perf_counter()
-    # Warmup runs ONE real round (kept — the carry advances on the same
-    # absolute-round key stream). A zero-round warmup would leave the while
-    # body unexecuted and the axon tunnel defers a one-time cost to the
-    # first execution that reaches it, which would land in the timed loop.
-    carry = chunk_sharded(
+    # Warmup runs ONE real round and DISCARDS the result — the timed loop
+    # recomputes round 0 from the original carry (absolute-round keys make
+    # both exact), so run_s covers every round that `rounds` counts. A
+    # zero-round warmup would leave the while body unexecuted and the axon
+    # tunnel defers a one-time cost to the first execution that reaches it,
+    # which would land in the timed loop.
+    warm = chunk_sharded(
         carry, rep_put(np.int32(min(start_round + 1, cfg.max_rounds))),
         kd_dev, *topo_args,
     )
-    int(carry[1])  # data-dependent sync; block_until_ready can return early
+    int(warm[1])  # data-dependent sync; block_until_ready can return early
+    del warm
     compile_s = time.perf_counter() - t0
 
     rounds = start_round

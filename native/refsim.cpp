@@ -347,10 +347,12 @@ int main(int argc, char** argv) {
   }
   int n = std::atoi(argv[1]);
   std::string topo(argv[2]);
+  std::string algo(argv[3]);
   for (auto& c : topo) c = static_cast<char>(std::tolower(c));
+  for (auto& c : algo) c = static_cast<char>(std::tolower(c));
   uint64_t seed = argc > 4 ? std::strtoull(argv[4], nullptr, 10) : 0;
   RefSimResult r;
-  int rc = refsim_run(n, topo.c_str(), argv[3], seed, 0, &r);
+  int rc = refsim_run(n, topo.c_str(), algo.c_str(), seed, 0, &r);
   if (rc != 0) {
     std::fprintf(stderr, "refsim: invalid arguments (rc=%d)\n", rc);
     return rc;
