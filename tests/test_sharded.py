@@ -129,3 +129,38 @@ def test_sharded_resume_continues_stream(tmp_path):
                           start_state=state, start_round=rounds)
     assert resumed.converged
     assert resumed.rounds == full.rounds
+
+
+def test_nondivisible_population_requires_partitionable_threefry():
+    # The padded full-length draw equals the single-device stream only under
+    # the position-wise partitionable threefry; with the flag off the runner
+    # must refuse a non-divisible population rather than silently diverge.
+    # Subprocess: the flag must be set before any trace caches exist.
+    import subprocess
+    import sys
+
+    code = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+jax.config.update("jax_threefry_partitionable", False)
+import sys
+sys.path.insert(0, {root!r})
+from cop5615_gossip_protocol_tpu import SimConfig, build_topology
+from cop5615_gossip_protocol_tpu.parallel.sharded import run_sharded
+try:
+    run_sharded(build_topology("full", 1001),
+                SimConfig(n=1001, topology="full", algorithm="gossip",
+                          max_rounds=4, n_devices=8))
+except ValueError as e:
+    assert "jax_threefry_partitionable" in str(e), e
+    print("GUARDED")
+    raise SystemExit(0)
+raise SystemExit("no error raised")
+""".format(root=str(__import__("pathlib").Path(__file__).resolve().parents[1]))
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=300,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "GUARDED" in out.stdout
