@@ -106,6 +106,14 @@ def build_parser() -> argparse.ArgumentParser:
                    "named_scope-tagged: sample / deliver / absorb)")
     p.add_argument("--jsonl", type=str, default=None,
                    help="append the structured run record to this JSONL file")
+    p.add_argument("--trace-convergence", type=str, default=None,
+                   metavar="FILE",
+                   help="append per-chunk convergence counters (rounds, "
+                   "converged/newly-converged counts, active count or "
+                   "estimate error) as JSONL — the SURVEY §5 per-round "
+                   "counters, at chunk granularity since every sample costs "
+                   "a device->host sync; lower --chunk-rounds for finer "
+                   "resolution")
     p.add_argument("--checkpoint", type=str, default=None,
                    help="write round-state checkpoints to this .npz path")
     p.add_argument("--checkpoint-every", type=int, default=1,
@@ -158,6 +166,7 @@ def _main_refsim(args, parser) -> int:
         "--profile": changed("profile"),
         "--checkpoint": changed("checkpoint") or changed("checkpoint_every"),
         "--resume": changed("resume"),
+        "--trace-convergence": changed("trace_convergence"),
     }
     bad = [flag for flag, set_ in inapplicable.items() if set_]
     if bad:
@@ -313,11 +322,41 @@ def main(argv: Optional[list[str]] = None) -> int:
     topo = build_topology(kind, args.numNodes, seed=args.seed, semantics=args.semantics)
     build_s = time.perf_counter() - t0
 
-    on_chunk = None
+    hooks = []
+    if args.trace_convergence:
+        prev = {"conv": 0}
+
+        def trace_hook(rounds, state):
+            # jnp reductions, not host numpy: when the mesh spans processes
+            # the arrays are not host-addressable, but every process can run
+            # the same replicated-scalar reduction. Padded slots never
+            # converge / never activate, so no explicit slicing is needed.
+            import jax.numpy as jnp
+
+            conv = int(jnp.sum(state.conv))
+            rec = {
+                "rounds": rounds,
+                "converged_count": conv,
+                "newly_converged": conv - prev["conv"],
+            }
+            prev["conv"] = conv
+            if hasattr(state, "s"):  # push-sum: converged-estimate error
+                w_safe = jnp.where(state.w != 0, state.w, 1)
+                ratio = jnp.where(state.w != 0, state.s / w_safe, 0.0)
+                err = jnp.where(
+                    state.conv, jnp.abs(ratio - (topo.n - 1) / 2.0), 0.0
+                )
+                rec["estimate_mae"] = float(jnp.sum(err)) / max(conv, 1)
+            else:  # gossip: how many nodes have heard the rumor
+                rec["active_count"] = int(jnp.sum(state.active))
+            if jax.process_index() == 0:
+                metrics.append_jsonl(args.trace_convergence, rec)
+
+        hooks.append(trace_hook)
     if args.checkpoint:
         counter = {"chunks": 0}
 
-        def on_chunk(rounds, state):  # noqa: F811
+        def checkpoint_hook(rounds, state):
             counter["chunks"] += 1
             if counter["chunks"] % args.checkpoint_every == 0:
                 if jax.process_count() > 1:
@@ -342,6 +381,17 @@ def main(argv: Optional[list[str]] = None) -> int:
                     *(np.asarray(x)[: topo.n] for x in state)
                 )
                 ckpt.save(args.checkpoint, state, rounds, cfg)
+
+        hooks.append(checkpoint_hook)
+
+    if not hooks:
+        on_chunk = None
+    elif len(hooks) == 1:
+        on_chunk = hooks[0]
+    else:
+        def on_chunk(rounds, state):
+            for h in hooks:
+                h(rounds, state)
 
     start_state, start_round = None, 0
     if args.resume:

@@ -283,3 +283,39 @@ def test_resume_from_converged_state_runs_zero_rounds(tmp_path):
     )
     assert resumed_sh.converged
     assert resumed_sh.rounds == final_state["rounds"]
+
+
+def test_cli_trace_convergence(tmp_path, capsys):
+    # SURVEY §5 metrics plan: per-round counters behind a flag, sampled at
+    # chunk boundaries (each sample is a device->host sync).
+    tr = tmp_path / "trace.jsonl"
+    rc = main(["256", "grid2d", "gossip", "--quiet", "--chunk-rounds", "32",
+               "--trace-convergence", str(tr)])
+    capsys.readouterr()
+    assert rc == 0
+    recs = [json.loads(x) for x in tr.read_text().splitlines()]
+    assert len(recs) >= 2  # multiple chunks sampled
+    convs = [r["converged_count"] for r in recs]
+    assert convs == sorted(convs)  # monotone
+    assert convs[-1] == 256
+    assert sum(r["newly_converged"] for r in recs) == 256
+    actives = [r["active_count"] for r in recs]
+    assert actives == sorted(actives)  # rumor spread is monotone too
+
+    tr2 = tmp_path / "trace2.jsonl"
+    rc = main(["256", "grid2d", "push-sum", "--quiet", "--chunk-rounds", "512",
+               "--trace-convergence", str(tr2), "--dtype", "float64"])
+    capsys.readouterr()
+    assert rc == 0
+    recs = [json.loads(x) for x in tr2.read_text().splitlines()]
+    assert recs[-1]["converged_count"] == 256
+    assert recs[-1]["estimate_mae"] < 1.0
+
+    # Composes with checkpointing (both hooks fire at the same boundaries).
+    tr3 = tmp_path / "trace3.jsonl"
+    ck = tmp_path / "ck.npz"
+    rc = main(["256", "grid2d", "gossip", "--quiet", "--chunk-rounds", "32",
+               "--trace-convergence", str(tr3), "--checkpoint", str(ck)])
+    capsys.readouterr()
+    assert rc == 0
+    assert ck.exists() and tr3.read_text().strip()
