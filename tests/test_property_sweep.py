@@ -61,3 +61,55 @@ def test_reference_semantics_sweep():
         r = run(topo, cfg)
         assert r.converged, (spelling, n)
         assert r.target_count <= r.population  # Q1: N of N+1
+
+
+_ENGINE_CASES = [
+    # Random (topology, algorithm, n, seed, chunk_rounds, suppress) draws —
+    # fused (interpret) vs chunked differential, beyond the fixed anchors in
+    # test_fused*.py. Pool cases cover the implicit full topology.
+    (str(_RNG.choice(["line", "ring", "grid2d", "torus3d", "ref2d"])),
+     str(_RNG.choice(["gossip", "push-sum"])),
+     int(_RNG.randint(30, 700)),
+     int(_RNG.randint(0, 1 << 16)),
+     int(_RNG.randint(3, 40)),
+     bool(_RNG.randint(0, 2)))
+    for _ in range(8)
+] + [
+    ("full", str(_RNG.choice(["gossip", "push-sum"])),
+     int(_RNG.randint(30, 700)), int(_RNG.randint(0, 1 << 16)),
+     int(_RNG.randint(3, 40)), bool(_RNG.randint(0, 2)))
+    for _ in range(4)
+]
+
+
+@pytest.mark.parametrize("kind,algo,n,seed,chunk,supp", _ENGINE_CASES)
+def test_fused_matches_chunked_random_configs(kind, algo, n, seed, chunk, supp):
+    # Differential fuzz: on every eligible random config, the fused Pallas
+    # engine (interpret mode off-TPU) must reproduce the chunked XLA
+    # engine's result — bitwise for gossip's integer state (rounds and
+    # converged counts equal), rounds-exact with matching estimate quality
+    # for push-sum. Ineligible draws assert the loud refusal instead.
+    from cop5615_gossip_protocol_tpu.ops import fused, fused_pool, fused_stencil
+
+    delivery = "pool" if kind == "full" else "auto"
+    base = dict(n=n, topology=kind, algorithm=algo, seed=seed,
+                chunk_rounds=chunk, max_rounds=100_000, delivery=delivery,
+                suppress_converged=supp if algo == "gossip" else None)
+    topo = build_topology(kind, n, seed=seed)
+    cfg_f = SimConfig(**base, engine="fused")
+    if kind == "full":
+        reason = fused_pool.pool_fused_support(topo, cfg_f)
+    else:
+        reason = fused.fused_support(topo, cfg_f) and \
+            fused_stencil.stencil2_support(topo, cfg_f)
+    if reason is not None:
+        with pytest.raises(ValueError, match="engine='fused' unavailable"):
+            run(topo, cfg_f)
+        return
+    r_f = run(topo, cfg_f)
+    r_c = run(topo, SimConfig(**base, engine="chunked"))
+    assert r_f.rounds == r_c.rounds, (kind, algo, n, seed, chunk, supp)
+    assert r_f.converged_count == r_c.converged_count
+    assert r_f.converged == r_c.converged
+    if algo == "push-sum":
+        assert r_f.estimate_mae == pytest.approx(r_c.estimate_mae, abs=1e-3)
