@@ -14,6 +14,18 @@ round's* converged vector — same protocol role as the reference's dictionary
 probe, without the data race. The reference's hot loop burns CPU proportional
 to informed-nodes × dispatcher-rate regardless of progress (SURVEY.md §3.2);
 here a round is one fused scatter-add over all nodes.
+
+Suppression is applied on the RECEIVER side: instead of each sender reading
+conv[target] (a remote gather — ~10 ms at 1M nodes on v5e, or per-offset
+backward rolls / an all_gather in the sharded and fused engines), the
+receiver zeroes its own inbox when it is converged. Both forms consult the
+same vintage of the converged vector (the state at round start — exactly the
+registry the reference's sender probes at program.fs:92), so the resulting
+inbox is IDENTICAL element-wise: at a non-converged receiver no sender was
+suppressed, at a converged receiver every sender was — either way the inbox
+the absorb sees is the same array. The trajectory is bit-identical while the
+remote read disappears entirely (and with it the sharded path's only
+suppression collective and the fused engines' doubled conv planes).
 """
 
 from __future__ import annotations
@@ -45,20 +57,20 @@ def init_state(pop: int, leader: jnp.ndarray, leader_counts_receipt: bool) -> Go
     return GossipState(count=count, active=active, conv=jnp.zeros((pop,), bool))
 
 
-def send_values(state: GossipState, targets, send_ok, suppress: bool, conv_of_target):
-    """int32 delivery values (1 per landed message) for this round.
+def send_values(state: GossipState, send_ok):
+    """int32 delivery values (1 per sent message) for this round. Converged
+    targets are suppressed receiver-side in `absorb` (see module docstring),
+    so the send side needs no knowledge of its target's state."""
+    return (state.active & send_ok).astype(jnp.int32)
 
-    ``conv_of_target`` is conv[targets] — on a single device a plain gather;
-    the sharded runner all_gathers conv first. With suppress False it is
-    ignored (honest batched mode default).
-    """
-    sending = state.active & send_ok
+
+def absorb(state: GossipState, inbox, rumor_target: int, suppress: bool = False) -> GossipState:
+    """Receipt-count update. ``suppress`` applies the reference's
+    converged-target suppression (program.fs:92) receiver-side: a converged
+    node drops its whole inbox — element-wise identical to every sender
+    having consulted the same (round-start) converged vector and not sent."""
     if suppress:
-        sending = sending & ~conv_of_target
-    return sending.astype(jnp.int32)
-
-
-def absorb(state: GossipState, inbox, rumor_target: int) -> GossipState:
+        inbox = jnp.where(state.conv, jnp.zeros((), inbox.dtype), inbox)
     count_new = state.count + inbox
     active_new = state.active | (inbox > 0)
     conv_new = count_new >= rumor_target
@@ -74,9 +86,8 @@ def round_from_targets(
     # named_scope tags flow into profiler traces (cli --profile) so per-round
     # cost splits into send / deliver / absorb (SURVEY.md §5 tracing plan).
     with jax.named_scope("gossip_send"):
-        conv_of_target = state.conv[targets] if suppress else False
-        vals = send_values(state, targets, send_ok, suppress, conv_of_target)
+        vals = send_values(state, send_ok)
     with jax.named_scope("gossip_deliver"):
         inbox = deliver_fn(vals, targets)
     with jax.named_scope("gossip_absorb"):
-        return absorb(state, inbox, rumor_target)
+        return absorb(state, inbox, rumor_target, suppress)

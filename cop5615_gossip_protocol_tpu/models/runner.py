@@ -247,18 +247,13 @@ def _make_pool_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array, dty
         def round_fn(state, round_idx, key_data):
             choice, offs, send_ok = pool_parts(round_idx, key_data)
             with jax.named_scope("gossip_send"):
-                conv_of_target = (
-                    delivery_mod.pool_lookup(state.conv, choice, offs)
-                    if suppress
-                    else False
-                )
-                vals = gossip_mod.send_values(
-                    state, None, send_ok, suppress, conv_of_target
-                )
+                vals = gossip_mod.send_values(state, send_ok)
             with jax.named_scope("gossip_deliver"):
                 inbox = delivery_mod.deliver_pool(vals[None], choice, offs)[0]
             with jax.named_scope("gossip_absorb"):
-                return gossip_mod.absorb(state, inbox, rumor_target)
+                # Suppression is receiver-side (models/gossip.absorb): no
+                # pool_lookup backward rolls needed.
+                return gossip_mod.absorb(state, inbox, rumor_target, suppress)
 
     return round_fn, state0, key_data, ()
 

@@ -76,14 +76,3 @@ def deliver_pool(channels, choice, offsets):
         inbox = inbox + jnp.roll(masked, offsets[k], axis=1)
     return inbox
 
-
-def pool_lookup(vec, choice, offsets):
-    """Per-sender read of ``vec`` at the sampled target — gossip's
-    converged-target suppression (the reference's registry probe,
-    program.fs:92) without a 1M-lane gather: for pool slot k the target sits
-    at displacement o_k, so the remote read is a *backward* roll per slot.
-    Returns out[i] = vec[(i + o_choice[i]) mod n]."""
-    out = jnp.zeros_like(vec)
-    for k in range(offsets.shape[0]):
-        out = jnp.where(choice == k, jnp.roll(vec, -offsets[k]), out)
-    return out

@@ -431,15 +431,15 @@ def make_pushsum_chunk(
 def make_gossip_chunk(topo: Topology, cfg: SimConfig, *, interpret: bool = False):
     """Gossip analog of make_pushsum_chunk. ``state3`` is (count, active_i32,
     conv_i32). Converged-target suppression (the reference's shared
-    dictionary probe, program.fs:92) reads last round's converged vector at
-    the sampled target via a backward roll per offset."""
+    dictionary probe, program.fs:92) is receiver-side: a converged node
+    zeroes its inbox before absorbing — element-wise identical to suppressing
+    at the senders against the same round-start conv plane (models/gossip.py
+    docstring has the argument), with no backward rolls at all."""
     layout = build_layout(topo)
     R = layout.rows
     rumor_target = np.int32(cfg.resolved_rumor_target)
     suppress = cfg.resolved_suppress
     target = np.int32(cfg.resolved_target_count(topo.n, topo.target_count))
-    n_pad = layout.n_pad
-
     def kernel(
         start_ref, keys_ref, disp_ref, deg_ref, n0, a0, c0,
         n_o, a_o, c_o, meta_o,
@@ -465,17 +465,6 @@ def make_gossip_chunk(topo: Topology, cfg: SimConfig, *, interpret: bool = False
             deg = deg_ref[:]
             disp = _sample_disp(bits, disp_ref, deg)
             sending = (a_v[:] != 0) & (deg > 0)
-            if suppress:
-                conv = c_v[:]
-                conv_of_target = jnp.zeros_like(conv)
-                for d_mod, shift in layout.shifts:
-                    back = (n_pad - shift) % n_pad
-                    conv_of_target = jnp.where(
-                        disp == d_mod,
-                        _flat_roll(conv, back, interpret),
-                        conv_of_target,
-                    )
-                sending = sending & (conv_of_target == 0)
             vals = sending.astype(jnp.int32)
             inbox = jnp.zeros_like(vals)
             for d_mod, shift in layout.shifts:
@@ -483,6 +472,11 @@ def make_gossip_chunk(topo: Topology, cfg: SimConfig, *, interpret: bool = False
                 inbox = inbox + _flat_roll(
                     jnp.where(m, vals, jnp.int32(0)), shift, interpret
                 )
+            if suppress:
+                # Receiver-side suppression against the round-start conv
+                # plane (c_v not yet updated) — identical inbox to the
+                # sender-side probe, zero rolls.
+                inbox = jnp.where(c_v[:] != 0, jnp.int32(0), inbox)
             count_new = n_v[:] + inbox
             active_new = jnp.where(
                 (a_v[:] != 0) | (inbox > 0), jnp.int32(1), jnp.int32(0)

@@ -306,12 +306,18 @@ def absorb_pushsum_tile(r0, padm, inbox_s, inbox_w,
     return jnp.sum(conv_new, dtype=jnp.int32)
 
 
-def absorb_gossip_tile(r0, padm, inbox, n_v, a_v, c_v, rumor_target):
+def absorb_gossip_tile(r0, padm, inbox, n_v, a_v, c_v, rumor_target,
+                       suppress: bool = False):
     """One tile of models/gossip.absorb (program.fs:97-105) against VMEM
     state planes. Owns the pad masking of the inbox — callers pass it raw.
+    ``suppress`` applies converged-target suppression receiver-side against
+    the round-start conv tile (c_v not yet updated) — element-wise identical
+    to the sender-side registry probe (models/gossip.py docstring).
     Writes the tile back; returns its converged count. Shared by the pool
     and tiled-stencil engines."""
     inbox = jnp.where(padm, jnp.int32(0), inbox)
+    if suppress:
+        inbox = jnp.where(c_v[pl.ds(r0, TILE), :] != 0, jnp.int32(0), inbox)
     count_new = n_v[pl.ds(r0, TILE), :] + inbox
     active_new = jnp.where(
         (a_v[pl.ds(r0, TILE), :] != 0) | (inbox > 0),
@@ -476,9 +482,9 @@ def make_gossip_pool_chunk(
 ):
     """Gossip analog of make_pushsum_pool_chunk. ``state3`` is (count,
     active_i32, conv_i32). Converged-target suppression (the reference's
-    shared dictionary probe, program.fs:92) reads last round's converged
-    plane at the sampled target — a backward mod-n roll, i.e. a forward roll
-    by n - d through the same doubled-plane gather."""
+    shared dictionary probe, program.fs:92) is receiver-side in
+    absorb_gossip_tile — identical trajectories to the sender-side probe
+    with no backward rolls and no doubled conv plane."""
     layout = build_pool_layout(topo.n)
     R, T = layout.rows, layout.tiles
     N = layout.n
@@ -488,15 +494,9 @@ def make_gossip_pool_chunk(
     target = np.int32(cfg.resolved_target_count(topo.n, topo.target_count))
 
     def kernel(*refs):
-        if suppress:
-            (start_ref, keys_ref, offs_ref, n0, a0, c0,
-             n_o, a_o, c_o, meta_o,
-             n_v, a_v, c_v, dch_v, dcv_v, flags, sems) = refs
-        else:
-            (start_ref, keys_ref, offs_ref, n0, a0, c0,
-             n_o, a_o, c_o, meta_o,
-             n_v, a_v, c_v, dch_v, flags, sems) = refs
-            dcv_v = None
+        (start_ref, keys_ref, offs_ref, n0, a0, c0,
+         n_o, a_o, c_o, meta_o,
+         n_v, a_v, c_v, dch_v, flags, sems) = refs
         k = pl.program_id(0)
         K = pl.num_programs(0)
         _, gather_plain_modn = _make_gather_modn(layout, interpret)
@@ -517,32 +517,12 @@ def make_gossip_pool_chunk(
             k1 = keys_ref[kk, 0]
             k2 = keys_ref[kk, 1]
 
-            if suppress:
-
-                def p0(t, _):
-                    r0 = t * TILE
-                    conv = c_v[pl.ds(r0, TILE), :]
-                    dcv_v[pl.ds(r0, TILE), :] = conv
-                    dcv_v[pl.ds(R + r0, TILE), :] = conv
-                    return 0
-
-                lax.fori_loop(0, T, p0, 0)
-
             def p1(t, _):
                 r0 = t * TILE
                 choice = _choice_tile(k1, k2, t, P)
                 jflat = (r0 + row_l) * LANES + lane
                 padm = jflat >= N
                 sending = (a_v[pl.ds(r0, TILE), :] != 0) & ~padm
-                if suppress:
-                    # conv[target] = conv[(i + d_choice) mod n]: per slot a
-                    # forward roll by n - d, selected at the destination.
-                    cot = jnp.zeros((TILE, LANES), jnp.int32)
-                    for slot in range(P):
-                        d = offs_ref[kk, slot]
-                        g = gather_plain_modn(dcv_v, N - d, t, jflat)
-                        cot = jnp.where(choice == slot, g, cot)
-                    sending = sending & (cot == 0)
                 # Fold the send gate into the choice plane: slot -1 delivers
                 # nothing, so the inbox gather needs no separate value plane.
                 marked = jnp.where(sending, choice, jnp.int32(-1))
@@ -562,7 +542,7 @@ def make_gossip_pool_chunk(
                     g = gather_plain_modn(dch_v, d, t, jflat)
                     inbox = inbox + jnp.where(g == slot, jnp.int32(1), jnp.int32(0))
                 return acc + absorb_gossip_tile(
-                    r0, padm, inbox, n_v, a_v, c_v, rumor_target
+                    r0, padm, inbox, n_v, a_v, c_v, rumor_target, suppress
                 )
 
             total = lax.fori_loop(0, T, p2, jnp.int32(0))
@@ -585,8 +565,6 @@ def make_gossip_pool_chunk(
             pltpu.VMEM((R, LANES), jnp.int32),
             pltpu.VMEM((2 * R, LANES), jnp.int32),
         ]
-        if suppress:
-            scratch.append(pltpu.VMEM((2 * R, LANES), jnp.int32))
         scratch += [pltpu.SMEM((2,), jnp.int32), pltpu.SemaphoreType.DMA((3,))]
         outs = pl.pallas_call(
             kernel,

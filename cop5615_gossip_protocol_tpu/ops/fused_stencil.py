@@ -65,15 +65,15 @@ from .topology import Topology, stencil_offsets
 _VMEM_BUDGET = 100 * 1024 * 1024
 
 
-def _plane_bytes(n_pad: int, max_deg: int, algorithm: str, suppress: bool) -> int:
+def _plane_bytes(n_pad: int, max_deg: int, algorithm: str) -> int:
     """Resident VMEM planes in bytes, per algorithm (4-byte words/node):
     push-sum — 4 state + 2x2 doubled sends + 2 doubled displacement;
-    gossip — 3 state + 2 doubled marked-displacement (+2 doubled conv when
-    suppressing); both — max_deg displacement columns + 1 degree."""
+    gossip — 3 state + 2 doubled marked-displacement; both — max_deg
+    displacement columns + 1 degree."""
     if algorithm == "push-sum":
         per_node = 4 + 4 + 2
     else:
-        per_node = 3 + 2 + (2 if suppress else 0)
+        per_node = 3 + 2  # suppression is receiver-side — no conv plane
     return n_pad * 4 * (per_node + max_deg + 1)
 
 
@@ -96,8 +96,7 @@ def stencil2_support(topo: Topology, cfg: SimConfig) -> Optional[str]:
     if cfg.n_devices is not None and cfg.n_devices > 1:
         return "fused engine is single-device"
     layout = build_pool_layout(topo.n)
-    suppress = cfg.algorithm == "gossip" and cfg.resolved_suppress
-    if _plane_bytes(layout.n_pad, topo.max_deg, cfg.algorithm, suppress) > _VMEM_BUDGET:
+    if _plane_bytes(layout.n_pad, topo.max_deg, cfg.algorithm) > _VMEM_BUDGET:
         return (
             f"population {topo.n} (max_deg {topo.max_deg}) exceeds the "
             "VMEM-resident plane budget"
@@ -303,9 +302,9 @@ def make_gossip_stencil2_chunk(
     topo: Topology, cfg: SimConfig, *, interpret: bool = False
 ):
     """Gossip analog. Suppression (the reference's dictionary probe,
-    program.fs:92) reads last round's conv plane at each node's sampled
-    target — a backward roll per displacement class through the doubled
-    conv plane, selected at the destination by the sampled class."""
+    program.fs:92) is receiver-side in absorb_gossip_tile — identical
+    trajectories to the sender-side probe (models/gossip.py docstring) with
+    no backward rolls and no doubled conv plane."""
     layout = build_pool_layout(topo.n)
     R, T = layout.rows, layout.tiles
     N = layout.n
@@ -317,15 +316,9 @@ def make_gossip_stencil2_chunk(
     max_deg = topo.max_deg
 
     def kernel(*refs):
-        if suppress:
-            (start_ref, keys_ref, disp_h, deg_h, n0, a0, c0,
-             n_o, a_o, c_o, meta_o,
-             n_v, a_v, c_v, dd_v, dcv_v, disp_v, deg_v, flags, sems) = refs
-        else:
-            (start_ref, keys_ref, disp_h, deg_h, n0, a0, c0,
-             n_o, a_o, c_o, meta_o,
-             n_v, a_v, c_v, dd_v, disp_v, deg_v, flags, sems) = refs
-            dcv_v = None
+        (start_ref, keys_ref, disp_h, deg_h, n0, a0, c0,
+         n_o, a_o, c_o, meta_o,
+         n_v, a_v, c_v, dd_v, disp_v, deg_v, flags, sems) = refs
         k = pl.program_id(0)
         K = pl.num_programs(0)
         _, gather_plain_blend = _make_gather_modn(layout, interpret)
@@ -352,17 +345,6 @@ def make_gossip_stencil2_chunk(
             k1 = keys_ref[kk, 0]
             k2 = keys_ref[kk, 1]
 
-            if suppress:
-
-                def p0(t, _):
-                    r0 = t * TILE
-                    conv = c_v[pl.ds(r0, TILE), :]
-                    dcv_v[pl.ds(r0, TILE), :] = conv
-                    dcv_v[pl.ds(R + r0, TILE), :] = conv
-                    return 0
-
-                lax.fori_loop(0, T, p0, 0)
-
             def p1(t, _):
                 r0 = t * TILE
                 deg = deg_v[pl.ds(r0, TILE), :]
@@ -373,12 +355,6 @@ def make_gossip_stencil2_chunk(
                 jflat = (r0 + row_l) * LANES + lane
                 padm = jflat >= N
                 sending = (a_v[pl.ds(r0, TILE), :] != 0) & (deg > 0) & ~padm
-                if suppress:
-                    cot = jnp.zeros((TILE, LANES), jnp.int32)
-                    for d_c in offsets:
-                        g = gather_plain_blend(dcv_v, N - d_c, t, jflat)
-                        cot = jnp.where(d == d_c, g, cot)
-                    sending = sending & (cot == 0)
                 marked = jnp.where(sending, d, jnp.int32(-1))
                 dd_v[pl.ds(r0, TILE), :] = marked
                 dd_v[pl.ds(R + r0, TILE), :] = marked
@@ -397,7 +373,7 @@ def make_gossip_stencil2_chunk(
                         g == d_c, jnp.int32(1), jnp.int32(0)
                     )
                 return acc + absorb_gossip_tile(
-                    r0, padm, inbox, n_v, a_v, c_v, rumor_target
+                    r0, padm, inbox, n_v, a_v, c_v, rumor_target, suppress
                 )
 
             total = lax.fori_loop(0, T, p2, jnp.int32(0))
@@ -424,8 +400,6 @@ def make_gossip_stencil2_chunk(
             pltpu.VMEM((R, LANES), jnp.int32),
             pltpu.VMEM((2 * R, LANES), jnp.int32),
         ]
-        if suppress:
-            scratch.append(pltpu.VMEM((2 * R, LANES), jnp.int32))
         scratch += [
             pltpu.VMEM((max_deg, R, LANES), jnp.int32),
             pltpu.VMEM((R, LANES), jnp.int32),

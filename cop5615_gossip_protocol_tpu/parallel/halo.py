@@ -181,19 +181,6 @@ def deliver_pool_sharded(channels_loc, choice_loc, offsets, axis: str, n_dev: in
     return inbox
 
 
-def pool_lookup_sharded(vec_loc, choice_loc, offsets, axis: str, n_dev: int):
-    """Sharded analog of ops/delivery.pool_lookup — gossip's converged-target
-    suppression read without the all_gather of the full conv vector: the
-    value a sender in pool slot k needs sits one *backward* dynamic roll
-    away. Returns out[i] = vec[(i + o_choice[i]) mod n]."""
-    n = n_dev * vec_loc.shape[-1]
-    out = vec_loc
-    for k in range(offsets.shape[0]):
-        rolled = global_roll_dynamic(vec_loc, (n - offsets[k]) % n, axis, n_dev)
-        out = jnp.where(choice_loc == k, rolled, out)
-    return out
-
-
 def deliver_halo(values_loc, disp_loc, plan: HaloPlan, axis: str):
     """Sharded stencil delivery: inbox shard from |offsets| masked halo
     rolls. ``values_loc`` is [..., n_loc] — push-sum stacks its s and w
@@ -210,19 +197,3 @@ def deliver_halo(values_loc, disp_loc, plan: HaloPlan, axis: str):
         inbox = inbox + halo_roll(masked, int(s), axis, plan.n_dev)
     return inbox
 
-
-def lookup_halo(vec_loc, disp_loc, plan: HaloPlan, axis: str):
-    """Per-sender read of a node-sharded vector at the sampled target —
-    gossip's converged-target suppression (program.fs:92) without the
-    all_gather of the full conv vector: the value a sender at displacement
-    class d needs sits one *backward* roll away.
-
-    Returns out[i] = vec[(i + s_i) mod n] where s_i is the sender's sampled
-    displacement; lanes whose displacement is not in the plan (no real edge)
-    return vec_loc unchanged — callers mask by send validity.
-    """
-    out = vec_loc
-    for d, s in zip(plan.offsets_mod, plan.offsets_signed):
-        rolled = halo_roll(vec_loc, -int(s), axis, plan.n_dev)
-        out = jnp.where(disp_loc == d, rolled, out)
-    return out

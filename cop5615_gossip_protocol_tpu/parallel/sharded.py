@@ -24,9 +24,11 @@ per-node state vectors; one synchronous round is:
 The whole round loop — collectives included — lives inside one jit'd
 `lax.while_loop`, so a chunk of thousands of rounds runs with zero host
 round-trips. Gossip's converged-target suppression (the shared dictionary
-probe, program.fs:92) needs remote reads: one backward halo roll per offset
-class on the halo path, an `all_gather` of the one-bool-per-node converged
-vector otherwise — only when suppression is enabled.
+probe, program.fs:92) is applied receiver-side (models/gossip.absorb): a
+converged node drops its own inbox, consulting the same round-start conv
+vintage a sender-side probe would — identical trajectories with zero
+suppression collectives (previously a backward halo roll per offset class
+or an all_gather of the converged vector).
 
 Population is padded to a device multiple; padded slots are invalid (never
 send, never targeted, never counted). Equivalence with the single-device
@@ -250,10 +252,6 @@ def run_sharded(
             disp = jnp.remainder(targets - gids, n)
             return halo_mod.deliver_halo(values, disp, plan, NODE_AXIS)
 
-        def conv_of_target_sharded(conv_loc, targets, gids):
-            disp = jnp.remainder(targets - gids, n)
-            return halo_mod.lookup_halo(conv_loc, disp, plan, NODE_AXIS)
-
     else:
 
         def deliver_sharded(values, targets, gids):
@@ -268,10 +266,6 @@ def run_sharded(
                 contrib, NODE_AXIS, scatter_dimension=contrib.ndim - 1,
                 tiled=True,
             )
-
-        def conv_of_target_sharded(conv_loc, targets, gids):
-            conv_full = lax.all_gather(conv_loc, NODE_AXIS, tiled=True)
-            return conv_full[targets]
 
     if cfg.algorithm == "push-sum":
         delta = cfg.resolved_delta
@@ -342,20 +336,12 @@ def run_sharded(
             def round_fn(state, round_idx, key_data, *targs):
                 (valid_loc,) = targs
                 choice, offs, send_ok = pool_parts(round_idx, key_data, valid_loc)
-                conv_of_target = (
-                    halo_mod.pool_lookup_sharded(
-                        state.conv, choice, offs, NODE_AXIS, n_dev
-                    )
-                    if suppress
-                    else False
-                )
-                vals = gossip_mod.send_values(
-                    state, None, send_ok, suppress, conv_of_target
-                )
+                vals = gossip_mod.send_values(state, send_ok)
                 inbox = halo_mod.deliver_pool_sharded(
                     vals[None], choice, offs, NODE_AXIS, n_dev
                 )[0]
-                return gossip_mod.absorb(state, inbox, rumor_target)
+                # Receiver-side suppression: purely local, no collective.
+                return gossip_mod.absorb(state, inbox, rumor_target, suppress)
 
         else:
 
@@ -363,17 +349,9 @@ def run_sharded(
                 targets, send_ok, _, gids = targets_and_gate(
                     round_idx, key_data, *targs
                 )
-                if suppress:
-                    conv_of_target = conv_of_target_sharded(
-                        state.conv, targets, gids
-                    )
-                else:
-                    conv_of_target = False
-                vals = gossip_mod.send_values(
-                    state, targets, send_ok, suppress, conv_of_target
-                )
+                vals = gossip_mod.send_values(state, send_ok)
                 inbox = deliver_sharded(vals, targets, gids)
-                return gossip_mod.absorb(state, inbox, rumor_target)
+                return gossip_mod.absorb(state, inbox, rumor_target, suppress)
 
     done0 = False
     if start_state is not None:

@@ -146,9 +146,9 @@ def test_pool_roll_pushsum_bitwise_matches_single_device():
 
 
 def test_pool_roll_gossip_suppression_bitwise():
-    # Suppression on the pool-roll path reads conv through backward dynamic
-    # rolls (pool_lookup_sharded), not an all_gather; trajectories must match
-    # the single-device pool_lookup path exactly.
+    # Suppression is receiver-side (models/gossip.absorb) — purely local on
+    # every path; sharded pool-roll trajectories must still match the
+    # single-device pool path exactly.
     n = 1024
     cfg = SimConfig(n=n, topology="full", algorithm="gossip",
                     delivery="pool", suppress_converged=True, seed=3)
@@ -201,8 +201,8 @@ def test_pushsum_halo_matches_single_device_bitwise():
 
 
 def test_sharded_suppression_halo_path_bitwise():
-    # Reference-semantics gossip on a halo topology: the converged-target
-    # probe goes through lookup_halo (backward rolls), not all_gather.
+    # Reference-semantics gossip on a halo topology: suppression is enabled
+    # (the registry probe semantics) and applied receiver-side on both paths.
     n = 511  # population 512 after the Q1 extra actor → divides 8 devices
     cfg = SimConfig(n=n, topology="line", algorithm="gossip",
                     semantics="reference", seed=2)

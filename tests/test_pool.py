@@ -5,7 +5,8 @@ Oracles:
 
 - delivery equivalence: the masked-roll inbox must equal a scatter-add over
   the implied targets (exact for int channels, float-order tolerance for f32);
-- pool_lookup must equal the plain gather vec[targets];
+- receiver-side suppression must equal sender-side suppression exactly
+  (models/gossip.py docstring argument, pinned per-round here);
 - mass conservation per round;
 - convergence quality: pool sampling must converge in a comparable number of
   rounds to iid scatter sampling (the pool's correlated draws still form an
@@ -59,15 +60,38 @@ def test_deliver_pool_matches_scatter(n, K):
     np.testing.assert_allclose(np.asarray(inbox[1]), np.asarray(want_f), rtol=1e-6)
 
 
-def test_pool_lookup_matches_gather():
+def test_receiver_side_suppression_matches_sender_side():
+    # The equivalence the whole codebase rides on (models/gossip.py): zeroing
+    # a converged receiver's inbox == every sender probing the same
+    # round-start conv vector and not sending. Pinned per-round on random
+    # states: both forms must produce the same next state, element-wise.
+    from cop5615_gossip_protocol_tpu.models import gossip as gossip_mod
+
     n, K = 300, 8
-    choice, offs = _pool_parts(2, 9, n, K)
-    ids = jnp.arange(n, dtype=jnp.int32)
-    targets = sampling.targets_pool(choice, offs, ids, n)
-    vec = jax.random.bernoulli(jax.random.PRNGKey(3), 0.3, (n,))
-    got = delivery.pool_lookup(vec, choice, offs)
-    want = vec[targets]
-    assert (np.asarray(got) == np.asarray(want)).all()
+    rumor_target = 5
+    for seed in range(5):
+        k0, k1, k2 = jax.random.split(jax.random.PRNGKey(seed), 3)
+        choice, offs = _pool_parts(seed, 9, n, K)
+        ids = jnp.arange(n, dtype=jnp.int32)
+        targets = sampling.targets_pool(choice, offs, ids, n)
+        count = jax.random.randint(k0, (n,), 0, rumor_target + 2)
+        conv = count >= rumor_target
+        active = conv | jax.random.bernoulli(k1, 0.5, (n,))
+        state = gossip_mod.GossipState(count=count, active=active, conv=conv)
+        send_ok = jax.random.bernoulli(k2, 0.9, (n,))
+        # sender-side reference implementation
+        vals_sup = (active & send_ok & ~conv[targets]).astype(jnp.int32)
+        want = gossip_mod.absorb(
+            state, delivery.deliver(vals_sup, targets, n), rumor_target
+        )
+        # receiver-side (the shipped path)
+        vals = gossip_mod.send_values(state, send_ok)
+        got = gossip_mod.absorb(
+            state, delivery.deliver(vals, targets, n), rumor_target,
+            suppress=True,
+        )
+        for f in state._fields:
+            assert (np.asarray(getattr(got, f)) == np.asarray(getattr(want, f))).all(), f
 
 
 def test_pool_mass_conservation():
@@ -108,7 +132,7 @@ def test_pool_gossip_converges():
 
 def test_pool_gossip_reference_suppression():
     # Reference semantics on full: Q1 population n+1, Q2 11th receipt,
-    # suppression via pool_lookup backward rolls instead of a gather.
+    # suppression applied receiver-side (models/gossip.absorb).
     n = 512
     cfg = SimConfig(n=n, topology="full", algorithm="gossip",
                     semantics="reference", delivery="pool", max_rounds=8000)
