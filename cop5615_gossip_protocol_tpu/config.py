@@ -196,10 +196,13 @@ class SimConfig:
                 f"unknown delivery {self.delivery!r}; "
                 "expected auto|scatter|stencil|pool"
             )
-        if self.delivery == "pool" and self.topology != "full":
+        if self.delivery == "pool" and self.topology not in (
+            "full", "imp2d", "imp3d"
+        ):
             raise ValueError(
-                "delivery='pool' applies only to the implicit full topology "
-                "(explicit topologies sample from their adjacency rows); "
+                "delivery='pool' applies to the implicit full topology "
+                "(offset-pool sampling) and to imp2d/imp3d (pooled "
+                "long-range edges over the lattice stencil); "
                 f"got topology={self.topology!r}"
             )
         if not (2 <= self.pool_size <= 1024) or self.pool_size & (self.pool_size - 1):

@@ -29,7 +29,7 @@ from jax import lax
 from ..config import SimConfig
 from ..ops import delivery as delivery_mod
 from ..ops import sampling
-from ..ops.topology import Topology, stencil_offsets
+from ..ops.topology import Topology, imp_split, stencil_offsets
 from . import gossip as gossip_mod
 from . import pushsum as pushsum_mod
 
@@ -132,11 +132,28 @@ def make_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array):
     n = topo.n
 
     if cfg.delivery == "pool":
-        if not topo.implicit:
-            raise ValueError(
-                "delivery='pool' applies only to the implicit full topology"
-            )
-        return _make_pool_round_fn(topo, cfg, base_key, dtype)
+        if topo.implicit:
+            return _make_pool_round_fn(topo, cfg, base_key, dtype)
+        if topo.kind in ("imp2d", "imp3d"):
+            if cfg.reference:
+                raise ValueError(
+                    "delivery='pool' on imp topologies re-draws the random "
+                    "long-range edge per round and cannot reproduce the "
+                    "reference's static extra edge (Q9, program.fs:308-310); "
+                    "use batched semantics or delivery='scatter'"
+                )
+            split = imp_split(topo)
+            if split is None:
+                raise ValueError(
+                    f"imp pooled delivery unavailable for this {topo.kind!r} "
+                    "instance (lattice slots are not offset-structured)"
+                )
+            return _make_imp_pool_round_fn(topo, cfg, base_key, dtype, split)
+        raise ValueError(
+            "delivery='pool' applies to the implicit full topology and the "
+            f"imp2d/imp3d random-extra-edge topologies; {topo.kind!r} has "
+            "neither an implicit nor a lattice+extra structure"
+        )
 
     key_data, key_impl = sampling.key_split(base_key)
 
@@ -256,6 +273,105 @@ def _make_pool_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array, dty
                 return gossip_mod.absorb(state, inbox, rumor_target, suppress)
 
     return round_fn, state0, key_data, ()
+
+
+def imp_pool_parts(topo: Topology, cfg: SimConfig, round_k, disp_cols, degree):
+    """The imp pooled round's sampling, shared (exactly) with its tests.
+
+    Slot selection draws the SAME uniform words the static-graph path does
+    (ops/sampling.uniform_bits off the round key, slot = word % degree), so
+    WHICH neighbor slot each node samples is identical across delivery
+    modes; only the long-range slot's target changes — from the build-time
+    static edge to one of the round's K shared pool displacements
+    (marginally still uniform over j != i). Returns
+    (d_sampled, is_extra, choice, offs, send_ok)."""
+    n = topo.n
+    bits = sampling.uniform_bits(round_k, n)
+    # The same slot selection as the static path, byte for byte — only the
+    # "neighbor" rows here hold displacements, with -1 sentineling the extra
+    # slot (ops/topology.imp_split), so a sampled -1 IS the extra draw.
+    d = sampling.targets_explicit(bits, disp_cols, degree)
+    is_extra = (d == -1) & (degree > 0)
+    offs = sampling.pool_offsets(round_k, cfg.pool_size, n)
+    choice = sampling.pool_choice_packed(
+        sampling.imp_choice_key(round_k), n, cfg.pool_size
+    )
+    send_ok = degree > 0
+    gate = sampling.send_gate(round_k, n, cfg.fault_rate)
+    if gate is not True:
+        send_ok = send_ok & gate
+    return d, is_extra, choice, offs, send_ok
+
+
+def _make_imp_pool_round_fn(
+    topo: Topology, cfg: SimConfig, base_key: jax.Array, dtype, split
+):
+    """Pooled-rewiring round for imp2d/imp3d: lattice edges deliver as
+    static stencil rolls, the random long-range slot as K shared per-round
+    pool displacements (ops/delivery.deliver_imp_pool) — the whole round is
+    rolls and elementwise work, no scatter.
+
+    Semantics: the reference's Imp3D fixes one uniformly random extra
+    neighbor per node at build time (program.fs:308-310); this mode re-draws
+    it per round from the pool, keeping the same per-node sampling marginals
+    (slot uniform over degree; long-range target uniform over j != i up to
+    the documented modulo bias) while making the joint per-round — the same
+    TPU-first recast the implicit full topology ships as pool sampling
+    (ops/sampling.pool_offsets). Convergence equivalence vs the static-iid
+    graph is pinned statistically (tests/test_imp_pool.py); per-round cost
+    drops from scatter-bound (~12 ns/edge element on v5e — hardware floor
+    for random access) to stencil-class."""
+    n = topo.n
+    key_data, key_impl = sampling.key_split(base_key)
+    topo_args = (jnp.asarray(split.disp_cols), jnp.asarray(split.degree))
+    lattice_offsets = tuple(int(q) for q in split.lattice_offsets)
+
+    def parts(round_idx, key_data, disp_cols, degree):
+        with jax.named_scope("sample"):
+            kr = sampling.round_key(
+                sampling.key_join(key_data, key_impl), round_idx
+            )
+            return imp_pool_parts(topo, cfg, kr, disp_cols, degree)
+
+    if cfg.algorithm == "push-sum":
+        state0 = pushsum_mod.init_state(n, dtype, cfg.initial_term_round)
+        delta = cfg.resolved_delta
+        term_rounds = cfg.term_rounds
+
+        def round_fn(state, round_idx, key_data, *targs):
+            d, is_extra, choice, offs, send_ok = parts(round_idx, key_data, *targs)
+            with jax.named_scope("pushsum_halve"):
+                s_send, w_send, s_keep, w_keep = pushsum_mod.halve_and_send(
+                    state.s, state.w, send_ok
+                )
+            with jax.named_scope("pushsum_deliver"):
+                inbox = delivery_mod.deliver_imp_pool(
+                    jnp.stack([s_send, w_send]), d, is_extra, choice,
+                    lattice_offsets, offs,
+                )
+            with jax.named_scope("pushsum_absorb"):
+                return pushsum_mod.absorb(
+                    state, s_keep, w_keep, inbox[0], inbox[1], delta, term_rounds
+                )
+
+    else:
+        leader = draw_leader(base_key, topo, cfg)
+        state0 = gossip_mod.init_state(n, leader, leader_counts_receipt=False)
+        rumor_target = cfg.resolved_rumor_target
+        suppress = cfg.resolved_suppress
+
+        def round_fn(state, round_idx, key_data, *targs):
+            d, is_extra, choice, offs, send_ok = parts(round_idx, key_data, *targs)
+            with jax.named_scope("gossip_send"):
+                vals = gossip_mod.send_values(state, send_ok)
+            with jax.named_scope("gossip_deliver"):
+                inbox = delivery_mod.deliver_imp_pool(
+                    vals[None], d, is_extra, choice, lattice_offsets, offs
+                )[0]
+            with jax.named_scope("gossip_absorb"):
+                return gossip_mod.absorb(state, inbox, rumor_target, suppress)
+
+    return round_fn, state0, key_data, topo_args
 
 
 def _run_reference_walk(topo: Topology, cfg: SimConfig, key, target: int) -> RunResult:

@@ -86,7 +86,10 @@ def build_pool_layout(n: int) -> PoolLayout:
 def pool_fused_support(topo: Topology, cfg: SimConfig) -> Optional[str]:
     """None if the fused pool engine can run this config, else the reason."""
     if not topo.implicit:
-        return "pool delivery (and its fused engine) is full-topology only"
+        return (
+            "the fused pool engine serves the implicit full topology only; "
+            f"pooled delivery on {topo.kind!r} runs the chunked engine"
+        )
     if cfg.dtype != "float32":
         return "fused pool engine supports float32 only"
     if not jax.config.jax_threefry_partitionable:

@@ -122,6 +122,19 @@ def targets_full(bits: jax.Array, node_ids: jax.Array, n: int) -> jax.Array:
 # own stream starts fresh).
 _POOL_TAG = 0x0FF5
 
+# fold_in tag for the imp-pool CHOICE stream. The imp pooled round draws the
+# neighbor-slot words straight off the round key (uniform_bits — the same
+# stream the static-graph path samples slots from, so WHICH slot each node
+# draws is identical across delivery modes) and must therefore move the pool
+# choice onto a tagged subkey: pool_choice_packed words also start at
+# counter 0, and sharing the untagged key would correlate slot and choice.
+IMP_CHOICE_TAG = 0x1A77
+
+
+def imp_choice_key(round_k: jax.Array) -> jax.Array:
+    """Subkey for the imp-pool packed choice draw (see IMP_CHOICE_TAG)."""
+    return jax.random.fold_in(round_k, IMP_CHOICE_TAG)
+
 
 def pool_offsets(round_k: jax.Array, pool_size: int, n: int) -> jax.Array:
     """[pool_size] int32 offsets, each uniform on [1, n-1] — the round's

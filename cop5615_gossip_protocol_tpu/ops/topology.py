@@ -103,6 +103,55 @@ def stencil_offsets(topo: Topology, max_offsets: int = 16) -> Optional[np.ndarra
     return diffs.astype(np.int32)
 
 
+@dataclasses.dataclass(frozen=True)
+class ImpSplit:
+    """Lattice/extra decomposition of an imp2d/imp3d adjacency for pooled
+    delivery (ops/delivery.deliver_imp_pool).
+
+    The imp builders append each node's single random long-range edge as the
+    LAST live slot of its row, after the lattice edges (build_imp2d /
+    build_imp3d; mirrors program.fs:308-310 where the random extra is added
+    after the six grid neighbors). The lattice slots alone have a small
+    displacement set — the random extras are what defeats
+    ``stencil_offsets``. This split carries:
+
+    - ``lattice_offsets``: sorted modular displacement classes over the
+      non-extra slots only ({±1, ±side} for imp2d, {±1, ±g, ±g²} for imp3d,
+      boundary-truncated rows included — a boundary row simply has fewer
+      live slots);
+    - ``disp_cols``: [n, max_deg] int32 per-slot modular displacement, with
+      sentinel -1 on the extra slot and on dead slots (so a sampled extra
+      can never alias a lattice class);
+    - ``degree``: the row degrees (the extra slot is index degree-1).
+    """
+
+    lattice_offsets: np.ndarray  # [L] int32, sorted unique, no 0
+    disp_cols: np.ndarray  # [n, max_deg] int32, -1 on extra/dead slots
+    degree: np.ndarray  # [n] int32
+
+
+def imp_split(topo: Topology, max_offsets: int = 16) -> Optional[ImpSplit]:
+    """Build the lattice/extra split, or None when the topology is not an
+    imp kind or its non-extra slots are not offset-structured."""
+    if topo.kind not in ("imp2d", "imp3d") or topo.implicit or topo.n < 2:
+        return None
+    n = topo.n
+    cols = np.arange(topo.max_deg)[None, :]
+    deg = topo.degree[:, None]
+    lattice_live = cols < deg - 1  # all live slots except the last (extra)
+    ids = np.arange(n, dtype=np.int64)[:, None]
+    disp = (topo.neighbors.astype(np.int64) - ids) % n
+    offs = np.unique(disp[lattice_live])
+    if offs.size == 0 or offs.size > max_offsets or (offs == 0).any():
+        return None
+    disp_cols = np.where(lattice_live, disp, -1).astype(np.int32)
+    return ImpSplit(
+        lattice_offsets=offs.astype(np.int32),
+        disp_cols=disp_cols,
+        degree=topo.degree.copy(),
+    )
+
+
 def _pack(rows: list[list[int]], kind: str, n_requested: int, target: int) -> Topology:
     n = len(rows)
     max_deg = max((len(r) for r in rows), default=0)

@@ -109,6 +109,13 @@ def run_sharded(
     if key is None:
         key = jax.random.PRNGKey(cfg.seed)
 
+    if cfg.delivery == "pool" and not topo.implicit:
+        raise ValueError(
+            "imp pooled delivery is single-device for now (lattice halo "
+            "rolls x dynamic pool rolls under shard_map land with the "
+            "fused-sharded composition); drop n_devices or use "
+            "delivery='auto'"
+        )
     n = topo.n
     n_pad = ((n + n_dev - 1) // n_dev) * n_dev
     n_loc = n_pad // n_dev

@@ -1,0 +1,164 @@
+"""Pooled extra-edge delivery for imp2d/imp3d (ops/delivery.deliver_imp_pool,
+models/runner._make_imp_pool_round_fn).
+
+The imp topologies are a lattice plus one random long-range edge per node
+(program.fs:308-310). Pooled mode re-draws the long-range target per round
+from K shared displacements, turning the round into rolls only. Oracles:
+
+- imp_split correctness: lattice offsets match the grid displacement set;
+  the extra slot is the last live slot of every row;
+- delivery equivalence: the class-roll inbox must equal a scatter-add over
+  the materialized targets (exact for int channels, float-order tolerance
+  for f32 — the same contract as deliver_stencil/deliver_pool);
+- mass conservation per round;
+- convergence equivalence: pooled imp must converge in a comparable number
+  of rounds to the static-iid graph under scatter delivery, with the same
+  estimate quality (the same statistical contract test_pool.py pins for the
+  implicit full topology's pool recast);
+- config gating: reference semantics and non-imp topologies reject pool.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cop5615_gossip_protocol_tpu import SimConfig, build_topology
+from cop5615_gossip_protocol_tpu.models.runner import imp_pool_parts, run
+from cop5615_gossip_protocol_tpu.ops import delivery, sampling
+from cop5615_gossip_protocol_tpu.ops.topology import imp_split
+
+
+def _parts(kind, n, seed, rnd, K=4):
+    topo = build_topology(kind, n, seed=seed)
+    split = imp_split(topo)
+    assert split is not None
+    cfg = SimConfig(n=n, topology=kind, algorithm="push-sum",
+                    delivery="pool", pool_size=K, seed=seed)
+    kr = sampling.round_key(jax.random.PRNGKey(seed), rnd)
+    d, is_extra, choice, offs, send_ok = imp_pool_parts(
+        topo, cfg, kr, jnp.asarray(split.disp_cols), jnp.asarray(split.degree)
+    )
+    return topo, split, d, is_extra, choice, offs, send_ok
+
+
+@pytest.mark.parametrize("kind,n", [("imp2d", 400), ("imp3d", 729)])
+def test_imp_split_structure(kind, n):
+    topo = build_topology(kind, n, seed=3)
+    split = imp_split(topo)
+    assert split is not None
+    # Extra slot is the last live slot; its displacement is sentineled -1.
+    for i in range(topo.n):
+        deg = int(topo.degree[i])
+        assert deg >= 1
+        assert split.disp_cols[i, deg - 1] == -1
+        for k in range(deg - 1):
+            assert split.disp_cols[i, k] in split.lattice_offsets
+    # imp3d lattice classes are the 3D grid set {±1, ±g, ±g²} mod n.
+    if kind == "imp3d":
+        g = round(topo.n ** (1 / 3))
+        want = sorted({d % topo.n for d in
+                       (1, -1, g, -g, g * g, -g * g)})
+        assert sorted(int(x) for x in split.lattice_offsets) == want
+
+
+@pytest.mark.parametrize("kind,n", [("imp2d", 300), ("imp3d", 512)])
+def test_imp_pool_delivery_matches_scatter(kind, n):
+    # Materialize each node's implied target and scatter-deliver; the roll
+    # path must agree (int exact, float to summation order).
+    for seed, rnd in [(0, 0), (1, 7), (2, 123)]:
+        topo, split, d, is_extra, choice, offs, send_ok = _parts(kind, n, seed, rnd)
+        n = topo.n
+        ids = jnp.arange(n, dtype=jnp.int32)
+        lattice_t = jnp.remainder(ids + d, n)
+        pool_t = jnp.remainder(ids + offs[choice], n)
+        targets = jnp.where(is_extra, pool_t, lattice_t)
+        vals_i = jnp.where(send_ok, 1, 0).astype(jnp.int32)
+        vals_f = jnp.where(send_ok, jnp.arange(n, dtype=jnp.float32) * 0.5, 0.0)
+        inbox = delivery.deliver_imp_pool(
+            jnp.stack([vals_i.astype(jnp.float32), vals_f]),
+            d, is_extra, choice,
+            tuple(int(q) for q in split.lattice_offsets), offs,
+        )
+        want_i = delivery.deliver(vals_i, targets, n)
+        want_f = delivery.deliver(vals_f, targets, n)
+        assert (np.asarray(inbox[0]).astype(np.int64) == np.asarray(want_i)).all()
+        np.testing.assert_allclose(
+            np.asarray(inbox[1]), np.asarray(want_f), rtol=1e-6, atol=1e-4
+        )
+
+
+def test_imp_pool_mass_conservation():
+    topo, split, d, is_extra, choice, offs, send_ok = _parts("imp3d", 729, 5, 2)
+    n = topo.n
+    s = jnp.arange(n, dtype=jnp.float32)
+    w = jnp.ones((n,), jnp.float32)
+    s_send = jnp.where(send_ok, s * 0.5, 0.0)
+    w_send = jnp.where(send_ok, w * 0.5, 0.0)
+    inbox = delivery.deliver_imp_pool(
+        jnp.stack([s_send, w_send]), d, is_extra, choice,
+        tuple(int(q) for q in split.lattice_offsets), offs,
+    )
+    s_new = (s - s_send) + inbox[0]
+    w_new = (w - w_send) + inbox[1]
+    np.testing.assert_allclose(float(jnp.sum(s_new)), float(jnp.sum(s)), rtol=1e-6)
+    np.testing.assert_allclose(float(jnp.sum(w_new)), float(jnp.sum(w)), rtol=1e-6)
+
+
+@pytest.mark.parametrize("kind,n", [("imp2d", 1024), ("imp3d", 1728)])
+def test_imp_pool_pushsum_convergence_comparable_to_static(kind, n):
+    # The semantic contract: per-round rewiring from the pool must not
+    # degrade convergence vs the build-time static extra edge under scatter
+    # delivery. (Fresh randomness per round mixes at least as well; the
+    # bound is generous because round counts are seed-noisy at this size.)
+    base = dict(n=n, topology=kind, algorithm="push-sum", max_rounds=20000)
+    r_static = run(build_topology(kind, n, seed=11),
+                   SimConfig(delivery="scatter", **base))
+    r_pool = run(build_topology(kind, n, seed=11),
+                 SimConfig(delivery="pool", pool_size=4, **base))
+    assert r_static.converged and r_pool.converged
+    assert r_pool.rounds <= int(r_static.rounds * 1.6) + 5
+    assert r_pool.estimate_mae < 1e-2
+    assert r_pool.converged_count == r_pool.population
+
+
+def test_imp_pool_gossip_converges_with_suppression():
+    n = 1331
+    cfg = SimConfig(n=n, topology="imp3d", algorithm="gossip",
+                    delivery="pool", suppress_converged=True, max_rounds=20000)
+    r = run(build_topology("imp3d", n), cfg)
+    assert r.converged and r.converged_count == r.population
+
+
+def test_imp_pool_determinism():
+    n = 512
+    cfg = SimConfig(n=n, topology="imp3d", algorithm="push-sum",
+                    delivery="pool", seed=9, max_rounds=20000)
+    r1 = run(build_topology("imp3d", n, seed=9), cfg)
+    r2 = run(build_topology("imp3d", n, seed=9), cfg)
+    assert r1.converged
+    assert r1.rounds == r2.rounds
+    assert r1.estimate_mae == r2.estimate_mae
+
+
+def test_imp_pool_rejects_reference_semantics():
+    cfg = SimConfig(n=400, topology="imp3d", algorithm="gossip",
+                    semantics="reference", delivery="pool")
+    with pytest.raises(ValueError, match="static extra edge"):
+        run(build_topology("imp3d", 400, semantics="reference"), cfg)
+
+
+def test_pool_rejects_non_imp_explicit_topology():
+    with pytest.raises(ValueError, match="imp2d/imp3d"):
+        SimConfig(n=400, topology="line", algorithm="gossip", delivery="pool")
+
+
+def test_imp_pool_sharded_rejected_for_now():
+    from cop5615_gossip_protocol_tpu.parallel.mesh import make_mesh
+    from cop5615_gossip_protocol_tpu.parallel.sharded import run_sharded
+
+    cfg = SimConfig(n=512, topology="imp3d", algorithm="push-sum",
+                    delivery="pool", n_devices=2)
+    with pytest.raises(ValueError, match="single-device"):
+        run_sharded(build_topology("imp3d", 512), cfg, mesh=make_mesh(2))
