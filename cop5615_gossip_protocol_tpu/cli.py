@@ -75,9 +75,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--delivery", choices=["auto", "scatter", "stencil", "pool"],
                    default="auto",
                    help="message delivery: stencil (shift-based, offset-structured "
-                   "topologies) vs scatter-add vs pool (offset-pool sampling on "
-                   "the full topology — per-round shared displacement pool, "
-                   "delivery as masked rolls); auto picks stencil where legal")
+                   "topologies) vs scatter-add vs pool (per-round shared "
+                   "displacement pool, delivery as masked rolls — on the full "
+                   "topology as offset-pool sampling, on imp2d/imp3d as pooled "
+                   "long-range edges over the lattice stencil); auto picks "
+                   "stencil where legal")
     p.add_argument("--pool-size", type=int, default=4,
                    help="displacement-pool width for --delivery pool (power of two)")
     p.add_argument("--engine", choices=["auto", "chunked", "fused"], default="auto",

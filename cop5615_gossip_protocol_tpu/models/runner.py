@@ -459,6 +459,18 @@ def _run_fused(
         def extra_args(start, count):  # noqa: F811
             return (fused_pool.round_offsets(key, start, count, cfg.pool_size, topo.n),)
 
+    elif variant == "imp":
+        from ..ops import fused_imp, fused_pool
+
+        make_pushsum = fused_imp.make_pushsum_imp_chunk
+        make_gossip = fused_imp.make_gossip_imp_chunk
+
+        def extra_args(start, count):  # noqa: F811
+            return (
+                fused_pool.round_offsets(key, start, count, cfg.pool_size, topo.n),
+                fused_imp.choice_round_keys(key, start, count),
+            )
+
     elif variant == "stencil2":
         from ..ops import fused_stencil
 
@@ -627,10 +639,16 @@ def run(
         # flagship benchmark path, ~2.7x the chunked pool round on v5e),
         # the stencil engine otherwise (ops/fused.py).
         if cfg.delivery == "pool":
-            from ..ops import fused_pool
+            if topo.implicit:
+                from ..ops import fused_pool
 
-            variant = "pool"
-            reason = fused_pool.pool_fused_support(topo, cfg)
+                variant = "pool"
+                reason = fused_pool.pool_fused_support(topo, cfg)
+            else:
+                from ..ops import fused_imp
+
+                variant = "imp"
+                reason = fused_imp.imp_fused_support(topo, cfg)
             auto_ok = reason is None
         else:
             from ..ops import fused

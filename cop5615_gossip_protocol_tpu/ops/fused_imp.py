@@ -1,0 +1,496 @@
+"""Fused multi-round Pallas engine for imp2d/imp3d under pooled long-range
+sampling — "stencil + K pooled classes".
+
+The chunked XLA imp-pool round (models/runner._make_imp_pool_round_fn) is
+rolls-only but still streams the full state through HBM per roll pass
+(~2.3 ms/round at 1M-node imp3d on v5e). This engine runs a whole chunk of
+K rounds in one `pallas_call` with the tiled doubled-plane architecture of
+ops/fused_pool.py / ops/fused_stencil.py, delivering along
+
+    L static lattice classes  +  P dynamic pool classes per round
+
+where the class machinery is the pool engine's masked mod-n tile gather
+(_make_gather_modn) keyed on CLASS IDS, not displacement values: a pool
+offset that collides with a lattice displacement (or another pool slot)
+must not double-deliver, and ids are collision-free by construction —
+lattice classes are 0..L-1, pool classes L..L+P-1, -1 marks non-senders.
+
+Stream compatibility with the chunked imp-pool path, bit for bit:
+- slot selection: threefry_bits_2d replicates uniform_bits' per-position
+  words; slot = word % degree (ops/sampling.targets_explicit's derivation);
+- pool choice: _choice_tile under the IMP_CHOICE_TAG-folded round key
+  replicates ops/sampling.pool_choice_packed on the same packed geometry;
+- pool offsets: round_offsets replicates ops/sampling.pool_offsets.
+Trajectories match the chunked path exactly for integer state (gossip) and
+up to compiler float reassociation for push-sum — the contract
+tests/test_fused_imp.py pins in interpret mode and tests_tpu/ on hardware.
+
+Reference mapping: the Imp3D hot loop (program.fs:267-330 wiring;
+program.fs:89-105/110-143 handlers) under the pooled re-draw of the random
+extra neighbor (program.fs:308-310) documented at
+models/runner._make_imp_pool_round_fn.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..config import SimConfig
+from .fused import clamp_cap_and_pad, threefry_bits_2d
+from .fused_pool import (
+    LANES,
+    TILE,
+    PoolLayout,
+    _choice_tile,
+    _copy_in,
+    _iota2,
+    _make_gather_modn,
+    absorb_gossip_tile,
+    absorb_pushsum_tile,
+    build_pool_layout,
+    round_offsets,
+)
+from .sampling import IMP_CHOICE_TAG, POOL_CHOICE_BITS
+from .topology import Topology, imp_split
+
+# Same resident-plane budget rationale as ops/fused_stencil._VMEM_BUDGET.
+_VMEM_BUDGET = 100 * 1024 * 1024
+
+
+def _plane_bytes(n_pad: int, max_deg: int, algorithm: str) -> int:
+    """Resident VMEM bytes (4-byte words/node): push-sum — 4 state + 2x2
+    doubled sends + 2 doubled class plane; gossip — 3 state + 2 doubled
+    class plane; both — max_deg class columns + 1 degree."""
+    per_node = (4 + 4 + 2) if algorithm == "push-sum" else (3 + 2)
+    return n_pad * 4 * (per_node + max_deg + 1)
+
+
+def imp_fused_support(topo: Topology, cfg: SimConfig) -> Optional[str]:
+    """None if the fused imp-pool engine can run this config, else why not."""
+    if topo.kind not in ("imp2d", "imp3d"):
+        return f"topology {topo.kind!r} is not an imp (lattice+extra) kind"
+    if cfg.reference:
+        return (
+            "pooled long-range sampling cannot reproduce the reference's "
+            "static extra edge (Q9); reference semantics use scatter"
+        )
+    if imp_split(topo) is None:
+        return "lattice slots are not offset-structured for this instance"
+    if cfg.dtype != "float32":
+        return "fused engine supports float32 only"
+    if not jax.config.jax_threefry_partitionable:
+        return (
+            "requires jax_threefry_partitionable=True (the in-kernel "
+            "threefry replicates the partitionable stream only)"
+        )
+    if cfg.fault_rate > 0:
+        return "fault injection not supported in the fused kernel"
+    if cfg.n_devices is not None and cfg.n_devices > 1:
+        return "fused engine is single-device"
+    if cfg.pool_size > 1 << POOL_CHOICE_BITS:
+        return (
+            f"pool_size {cfg.pool_size} exceeds the packed-choice limit "
+            f"{1 << POOL_CHOICE_BITS}"
+        )
+    layout = build_pool_layout(topo.n)
+    if _plane_bytes(layout.n_pad, topo.max_deg, cfg.algorithm) > _VMEM_BUDGET:
+        return (
+            f"population {topo.n} (max_deg {topo.max_deg}) exceeds the "
+            "VMEM-resident plane budget"
+        )
+    return None
+
+
+def choice_round_keys(base_key: jax.Array, start, count: int) -> jax.Array:
+    """uint32 [count, 2] keys for the per-round pool-CHOICE stream:
+    fold_in(round_key, IMP_CHOICE_TAG) for absolute rounds start.. —
+    exactly ops/sampling.imp_choice_key applied per round, so the in-kernel
+    packed choice words match the chunked path's."""
+    rounds = jnp.int32(start) + jnp.arange(count, dtype=jnp.int32)
+
+    def one(r):
+        k = jax.random.fold_in(base_key, r)
+        k = jax.random.fold_in(k, IMP_CHOICE_TAG)
+        return k if k.dtype == jnp.uint32 else jax.random.key_data(k)
+
+    return jax.vmap(one)(rounds)
+
+
+def _build_class_planes(topo: Topology, layout: PoolLayout):
+    """([max_deg, rows, 128] int32 class-id per neighbor slot, [rows, 128]
+    degree). Lattice slots carry their lattice-offset index 0..L-1; the
+    extra slot (last live slot of each row) and dead slots carry sentinel L
+    (dead slots are never sampled — slot < degree); pad nodes have degree 0.
+    Also returns the sorted lattice offsets."""
+    split = imp_split(topo)
+    assert split is not None
+    n, n_pad = topo.n, layout.n_pad
+    offs = split.lattice_offsets
+    L = offs.shape[0]
+    # disp -> class index; disp_cols sentinels extra/dead slots with -1,
+    # which maps to class L (the extra sentinel) here.
+    cls = np.full((n, topo.max_deg), L, dtype=np.int32)
+    for q, d in enumerate(offs):
+        cls[split.disp_cols == d] = q
+    cls_cols = np.full((topo.max_deg, n_pad), L, dtype=np.int32)
+    cls_cols[:, :n] = cls.T
+    degree = np.zeros((n_pad,), dtype=np.int32)
+    degree[:n] = split.degree
+    return (
+        cls_cols.reshape(topo.max_deg, layout.rows, LANES),
+        degree.reshape(layout.rows, LANES),
+        [int(d) for d in offs],
+    )
+
+
+def _sample_class_tile(k1, k2, ck1, ck2, t, cls_refs, deg_tile, L: int, P: int):
+    """[TILE, 128] sampled class id per node: slot = word % degree over the
+    untagged round stream (bit-compatible with the chunked path's
+    targets_explicit on the -1-sentineled disp columns), lattice slots map
+    to their class, the extra slot to L + packed pool choice (tagged
+    stream)."""
+    bits = threefry_bits_2d(k1, k2, TILE, LANES, row0=t * TILE)
+    deg_safe = jnp.maximum(deg_tile, 1).astype(jnp.uint32)
+    slot = (bits % deg_safe).astype(jnp.int32)
+    cls = cls_refs[0]
+    for j in range(1, len(cls_refs)):
+        cls = jnp.where(slot == j, cls_refs[j], cls)
+    choice = _choice_tile(ck1, ck2, t, P)
+    return jnp.where(cls == L, L + choice, cls)
+
+
+def make_pushsum_imp_chunk(
+    topo: Topology, cfg: SimConfig, *, interpret: bool = False
+):
+    """Returns (chunk_fn, layout): ``chunk_fn(state4, keys, offs, ckeys,
+    start, cap)`` — the stencil2 contract plus the per-round displacement
+    pools ``offs`` (round_offsets) and choice keys ``ckeys``
+    (choice_round_keys)."""
+    layout = build_pool_layout(topo.n)
+    R, T = layout.rows, layout.tiles
+    N = layout.n
+    P = cfg.pool_size
+    delta = np.float32(cfg.resolved_delta)
+    term_rounds = np.int32(cfg.term_rounds)
+    target = np.int32(cfg.resolved_target_count(topo.n, topo.target_count))
+    cls_np, deg_np, lattice = _build_class_planes(topo, layout)
+    L = len(lattice)
+    max_deg = topo.max_deg
+
+    def kernel(
+        start_ref, keys_ref, ckeys_ref, offs_ref, cls_h, deg_h, s0, w0, t0, c0,
+        s_o, w_o, t_o, c_o, meta_o,
+        s_v, w_v, t_v, c_v, ds_v, dw_v, dm_v, cls_v, deg_v, flags, sems,
+    ):
+        k = pl.program_id(0)
+        K = pl.num_programs(0)
+        gather_blend, _ = _make_gather_modn(layout, interpret)
+        row_l = _iota2((TILE, LANES), 0)
+        lane = _iota2((TILE, LANES), 1)
+
+        @pl.when(k == 0)
+        def _init():
+            _copy_in(
+                [(s0, s_v), (w0, w_v), (t0, t_v), (c0, c_v),
+                 (cls_h, cls_v), (deg_h, deg_v)],
+                sems,
+            )
+            flags[0] = jnp.where(
+                jnp.sum(c_v[:], dtype=jnp.int32) >= target, 1, 0
+            )
+            flags[1] = 0
+
+        active = (flags[0] == 0) & (start_ref[0] + k < start_ref[1])
+
+        @pl.when(active)
+        def _round():
+            kk = k % 8
+            k1 = keys_ref[kk, 0]
+            k2 = keys_ref[kk, 1]
+            ck1 = ckeys_ref[kk, 0]
+            ck2 = ckeys_ref[kk, 1]
+
+            def p1(t, _):
+                r0 = t * TILE
+                deg = deg_v[pl.ds(r0, TILE), :]
+                cls_refs = [
+                    cls_v[j, pl.ds(r0, TILE), :] for j in range(max_deg)
+                ]
+                cls = _sample_class_tile(
+                    k1, k2, ck1, ck2, t, cls_refs, deg, L, P
+                )
+                padm = (r0 + row_l) * LANES + lane >= N
+                send_ok = (deg > 0) & ~padm
+                ss = jnp.where(send_ok, s_v[pl.ds(r0, TILE), :] * 0.5, 0.0)
+                ws = jnp.where(send_ok, w_v[pl.ds(r0, TILE), :] * 0.5, 0.0)
+                marked = jnp.where(send_ok, cls, jnp.int32(-1))
+                ds_v[pl.ds(r0, TILE), :] = ss
+                ds_v[pl.ds(R + r0, TILE), :] = ss
+                dw_v[pl.ds(r0, TILE), :] = ws
+                dw_v[pl.ds(R + r0, TILE), :] = ws
+                dm_v[pl.ds(r0, TILE), :] = marked
+                dm_v[pl.ds(R + r0, TILE), :] = marked
+                return 0
+
+            lax.fori_loop(0, T, p1, 0)
+
+            def p2(t, acc):
+                r0 = t * TILE
+                jflat = (r0 + row_l) * LANES + lane
+                padm = jflat >= N
+                inbox_s = jnp.zeros((TILE, LANES), jnp.float32)
+                inbox_w = jnp.zeros((TILE, LANES), jnp.float32)
+                planes = ((ds_v, jnp.float32(0)), (dw_v, jnp.float32(0)))
+                # Static lattice classes first, then the round's pool
+                # classes — the chunked deliver_imp_pool's exact order.
+                for q, d_c in enumerate(lattice):
+                    s1, w1 = gather_blend(dm_v, planes, d_c, t, q, jflat)
+                    inbox_s = inbox_s + s1
+                    inbox_w = inbox_w + w1
+                for slot in range(P):
+                    d = offs_ref[kk, slot]
+                    s1, w1 = gather_blend(dm_v, planes, d, t, L + slot, jflat)
+                    inbox_s = inbox_s + s1
+                    inbox_w = inbox_w + w1
+                return acc + absorb_pushsum_tile(
+                    r0, padm, inbox_s, inbox_w,
+                    s_v, w_v, t_v, c_v, ds_v, dw_v, delta, term_rounds,
+                )
+
+            total = lax.fori_loop(0, T, p2, jnp.int32(0))
+            flags[1] = flags[1] + 1
+            flags[0] = jnp.where(total >= target, 1, 0)
+
+        @pl.when(k == K - 1)
+        def _emit():
+            _copy_in([(s_v, s_o), (w_v, w_o), (t_v, t_o), (c_v, c_o)], sems)
+            meta_o[0] = flags[1]
+
+    # Baked constants deliberately — see ops/fused.py dispatch-cost note.
+    cls_dev = jnp.asarray(cls_np)
+    deg_dev = jnp.asarray(deg_np)
+
+    def chunk_fn(state4, keys, offs, ckeys, start, cap):
+        s, w, t, c = state4
+        cap, keys, offs, ckeys = clamp_cap_and_pad(
+            start, cap, keys, ((offs, 1), (ckeys, 0))
+        )
+        K = keys.shape[0]
+        f32 = jax.ShapeDtypeStruct((R, LANES), jnp.float32)
+        i32 = jax.ShapeDtypeStruct((R, LANES), jnp.int32)
+        outs = pl.pallas_call(
+            kernel,
+            grid=(K,),
+            out_shape=(f32, f32, i32, i32, jax.ShapeDtypeStruct((1,), jnp.int32)),
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec((8, 2), lambda k: (k // 8, 0), memory_space=pltpu.SMEM),
+                pl.BlockSpec((8, 2), lambda k: (k // 8, 0), memory_space=pltpu.SMEM),
+                pl.BlockSpec((8, P), lambda k: (k // 8, 0), memory_space=pltpu.SMEM),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=(
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((R, LANES), jnp.float32),
+                pltpu.VMEM((R, LANES), jnp.float32),
+                pltpu.VMEM((R, LANES), jnp.int32),
+                pltpu.VMEM((R, LANES), jnp.int32),
+                pltpu.VMEM((2 * R, LANES), jnp.float32),
+                pltpu.VMEM((2 * R, LANES), jnp.float32),
+                pltpu.VMEM((2 * R, LANES), jnp.int32),
+                pltpu.VMEM((max_deg, R, LANES), jnp.int32),
+                pltpu.VMEM((R, LANES), jnp.int32),
+                pltpu.SMEM((2,), jnp.int32),
+                pltpu.SemaphoreType.DMA((6,)),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=124 * 1024 * 1024
+            ),
+            interpret=interpret,
+        )(
+            jnp.stack([jnp.int32(start), jnp.int32(cap)]),
+            keys,
+            ckeys,
+            offs,
+            cls_dev,
+            deg_dev,
+            s, w, t, c,
+        )
+        s2, w2, t2, c2, meta = outs
+        return (s2, w2, t2, c2), meta[0]
+
+    return chunk_fn, layout
+
+
+def make_gossip_imp_chunk(
+    topo: Topology, cfg: SimConfig, *, interpret: bool = False
+):
+    """Gossip analog: the marked plane alone carries the sampled class (a
+    send is one unit), delivery counts class-id matches per shift, and
+    suppression is receiver-side in absorb_gossip_tile."""
+    layout = build_pool_layout(topo.n)
+    R, T = layout.rows, layout.tiles
+    N = layout.n
+    P = cfg.pool_size
+    rumor_target = np.int32(cfg.resolved_rumor_target)
+    suppress = cfg.resolved_suppress
+    target = np.int32(cfg.resolved_target_count(topo.n, topo.target_count))
+    cls_np, deg_np, lattice = _build_class_planes(topo, layout)
+    L = len(lattice)
+    max_deg = topo.max_deg
+
+    def kernel(
+        start_ref, keys_ref, ckeys_ref, offs_ref, cls_h, deg_h, n0, a0, c0,
+        n_o, a_o, c_o, meta_o,
+        n_v, a_v, c_v, dm_v, cls_v, deg_v, flags, sems,
+    ):
+        k = pl.program_id(0)
+        K = pl.num_programs(0)
+        _, gather_plain_blend = _make_gather_modn(layout, interpret)
+        row_l = _iota2((TILE, LANES), 0)
+        lane = _iota2((TILE, LANES), 1)
+
+        @pl.when(k == 0)
+        def _init():
+            _copy_in(
+                [(n0, n_v), (a0, a_v), (c0, c_v),
+                 (cls_h, cls_v), (deg_h, deg_v)],
+                sems,
+            )
+            flags[0] = jnp.where(
+                jnp.sum(c_v[:], dtype=jnp.int32) >= target, 1, 0
+            )
+            flags[1] = 0
+
+        active_chunk = (flags[0] == 0) & (start_ref[0] + k < start_ref[1])
+
+        @pl.when(active_chunk)
+        def _round():
+            kk = k % 8
+            k1 = keys_ref[kk, 0]
+            k2 = keys_ref[kk, 1]
+            ck1 = ckeys_ref[kk, 0]
+            ck2 = ckeys_ref[kk, 1]
+
+            def p1(t, _):
+                r0 = t * TILE
+                deg = deg_v[pl.ds(r0, TILE), :]
+                cls_refs = [
+                    cls_v[j, pl.ds(r0, TILE), :] for j in range(max_deg)
+                ]
+                cls = _sample_class_tile(
+                    k1, k2, ck1, ck2, t, cls_refs, deg, L, P
+                )
+                jflat = (r0 + row_l) * LANES + lane
+                padm = jflat >= N
+                sending = (a_v[pl.ds(r0, TILE), :] != 0) & (deg > 0) & ~padm
+                marked = jnp.where(sending, cls, jnp.int32(-1))
+                dm_v[pl.ds(r0, TILE), :] = marked
+                dm_v[pl.ds(R + r0, TILE), :] = marked
+                return 0
+
+            lax.fori_loop(0, T, p1, 0)
+
+            def p2(t, acc):
+                r0 = t * TILE
+                jflat = (r0 + row_l) * LANES + lane
+                padm = jflat >= N
+                inbox = jnp.zeros((TILE, LANES), jnp.int32)
+                for q, d_c in enumerate(lattice):
+                    g = gather_plain_blend(dm_v, d_c, t, jflat)
+                    inbox = inbox + jnp.where(g == q, jnp.int32(1), jnp.int32(0))
+                for slot in range(P):
+                    d = offs_ref[kk, slot]
+                    g = gather_plain_blend(dm_v, d, t, jflat)
+                    inbox = inbox + jnp.where(
+                        g == L + slot, jnp.int32(1), jnp.int32(0)
+                    )
+                return acc + absorb_gossip_tile(
+                    r0, padm, inbox, n_v, a_v, c_v, rumor_target, suppress
+                )
+
+            total = lax.fori_loop(0, T, p2, jnp.int32(0))
+            flags[1] = flags[1] + 1
+            flags[0] = jnp.where(total >= target, 1, 0)
+
+        @pl.when(k == K - 1)
+        def _emit():
+            _copy_in([(n_v, n_o), (a_v, a_o), (c_v, c_o)], sems)
+            meta_o[0] = flags[1]
+
+    cls_dev = jnp.asarray(cls_np)
+    deg_dev = jnp.asarray(deg_np)
+
+    def chunk_fn(state3, keys, offs, ckeys, start, cap):
+        cnt, act, cv = state3
+        cap, keys, offs, ckeys = clamp_cap_and_pad(
+            start, cap, keys, ((offs, 1), (ckeys, 0))
+        )
+        i32 = jax.ShapeDtypeStruct((R, LANES), jnp.int32)
+        outs = pl.pallas_call(
+            kernel,
+            grid=(keys.shape[0],),
+            out_shape=(i32, i32, i32, jax.ShapeDtypeStruct((1,), jnp.int32)),
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec((8, 2), lambda k: (k // 8, 0), memory_space=pltpu.SMEM),
+                pl.BlockSpec((8, 2), lambda k: (k // 8, 0), memory_space=pltpu.SMEM),
+                pl.BlockSpec((8, P), lambda k: (k // 8, 0), memory_space=pltpu.SMEM),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=(
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((R, LANES), jnp.int32),
+                pltpu.VMEM((R, LANES), jnp.int32),
+                pltpu.VMEM((R, LANES), jnp.int32),
+                pltpu.VMEM((2 * R, LANES), jnp.int32),
+                pltpu.VMEM((max_deg, R, LANES), jnp.int32),
+                pltpu.VMEM((R, LANES), jnp.int32),
+                pltpu.SMEM((2,), jnp.int32),
+                pltpu.SemaphoreType.DMA((5,)),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=124 * 1024 * 1024
+            ),
+            interpret=interpret,
+        )(
+            jnp.stack([jnp.int32(start), jnp.int32(cap)]),
+            keys,
+            ckeys,
+            offs,
+            cls_dev,
+            deg_dev,
+            cnt, act, cv,
+        )
+        n2, a2, c2, meta = outs
+        return (n2, a2, c2), meta[0]
+
+    return chunk_fn, layout

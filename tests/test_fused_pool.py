@@ -179,7 +179,7 @@ def test_pool_fused_support_gating():
     # explicit topology
     line = build_topology("line", 100)
     cfg_line = SimConfig(n=100, topology="full", delivery="pool")
-    assert "full-topology" in fused_pool.pool_fused_support(line, cfg_line)
+    assert "full topology only" in fused_pool.pool_fused_support(line, cfg_line)
     # explicit engine request must fail loudly, not fall back
     with pytest.raises(ValueError, match="fused.*unavailable|unavailable"):
         run(topo, _cfg(1000, fault_rate=0.1, engine="fused"))
