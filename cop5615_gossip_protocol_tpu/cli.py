@@ -325,8 +325,9 @@ def main(argv: Optional[list[str]] = None) -> int:
     build_s = time.perf_counter() - t0
 
     hooks = []
+    trace_prev = {"conv": 0}
     if args.trace_convergence:
-        prev = {"conv": 0}
+        prev = trace_prev
 
         def trace_hook(rounds, state):
             # jnp reductions, not host numpy: when the mesh spans processes
@@ -415,6 +416,12 @@ def main(argv: Optional[list[str]] = None) -> int:
                 file=sys.stderr,
             )
             return 2
+        # Seed the trace baseline from the resumed state: nodes that
+        # converged before the checkpoint are not "newly converged" in the
+        # resumed run's first trace record.
+        import numpy as np
+
+        trace_prev["conv"] = int(np.asarray(start_state.conv).sum())
 
     # SURVEY.md §5 tracing plan: the trace spans compile + run, and the
     # in-kernel named_scope tags split per-round cost into sample / deliver /

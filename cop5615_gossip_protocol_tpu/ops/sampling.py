@@ -58,10 +58,22 @@ def key_split(key: jax.Array):
     if jnp.issubdtype(key.dtype, jax.dtypes.prng_key):
         impl = jax.random.key_impl(key)
         data = jax.random.key_data(key)
-        if str(impl) == "threefry2x32":
+        if str(impl) == "threefry2x32" and _legacy_keys_usable():
             return data, None
         return data, impl
     return key, None
+
+
+def _legacy_keys_usable() -> bool:
+    """Whether jax.random accepts raw uint32 arrays as legacy threefry keys.
+
+    The fast path above hands raw key data to jax.random, which rides the
+    ``jax_legacy_prng_key`` deprecation flag; if a future JAX flips it to
+    'error', silently continuing would crash at trace time far from here.
+    Detected (not assumed) so the fallback — rebuilding a typed key via
+    wrap_key_data in `key_join`, correct but on a slower dispatch path — is
+    automatic, mirroring the jax_threefry_partitionable guards elsewhere."""
+    return getattr(jax.config, "jax_legacy_prng_key", "allow") != "error"
 
 
 def key_join(key_data: jax.Array, impl) -> jax.Array:

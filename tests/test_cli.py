@@ -81,6 +81,34 @@ def test_cli_checkpoint_resume_is_stream_exact(tmp_path, capsys):
     assert res_rec["estimate_mae"] == pytest.approx(full_rec["estimate_mae"], rel=1e-9)
 
 
+def test_cli_trace_resume_seeds_newly_converged(tmp_path, capsys):
+    # ADVICE r2: resuming with --trace-convergence must seed the baseline
+    # from the checkpoint - nodes converged before the checkpoint are not
+    # "newly converged" in the resumed run's first trace record.
+    args = ["400", "line", "gossip", "--chunk-rounds", "64"]
+    rc = main(args)
+    full_rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0
+    # Stop at a point where some nodes have already converged.
+    half = (full_rec["rounds"] // 2 // 64) * 64
+    ck = tmp_path / "state.npz"
+    rc = main(args + ["--max-rounds", str(half), "--checkpoint", str(ck)])
+    capsys.readouterr()
+    assert ck.exists()
+    import numpy as np
+    pre_conv = int(np.load(ck)["conv"].sum())
+    assert pre_conv > 0, "pick a config where some nodes converge by half"
+    tr = tmp_path / "trace.jsonl"
+    rc = main(args + ["--resume", str(ck), "--trace-convergence", str(tr)])
+    capsys.readouterr()
+    assert rc == 0
+    recs = [json.loads(x) for x in tr.read_text().splitlines()]
+    # Each record's newly_converged must be the true per-chunk increment:
+    # the first one counts from the checkpoint's converged set, not from 0.
+    assert recs[0]["newly_converged"] == recs[0]["converged_count"] - pre_conv
+    assert sum(r["newly_converged"] for r in recs) == recs[-1]["converged_count"] - pre_conv
+
+
 def test_cli_sharded_devices_flag(capsys):
     rc = main(["256", "full", "gossip", "--devices", "8", "--quiet"])
     assert rc == 0
