@@ -437,12 +437,16 @@ def _run_fused(
     variant: str = "stencil",
 ) -> RunResult:
     """Chunk loop over a Pallas multi-round engine: one kernel launch per
-    cfg.chunk_rounds rounds, state resident in VMEM for the whole chunk.
-    ``variant`` picks the kernel family: "stencil" — the whole-array engine
-    (ops/fused.py, offset-structured topologies to ~128k aligned nodes);
-    "stencil2" — its tiled big-population extension (ops/fused_stencil.py);
-    "pool" — the implicit-full pool engine (ops/fused_pool.py), whose
-    chunks additionally consume the per-round displacement pools."""
+    cfg.chunk_rounds rounds. ``variant`` picks the kernel family:
+    "stencil" — the whole-array VMEM engine (ops/fused.py, offset-structured
+    topologies to ~128k aligned nodes); "stencil2" — its tiled VMEM-resident
+    big-population extension (ops/fused_stencil.py); "pool" — the
+    implicit-full VMEM pool engine (ops/fused_pool.py) whose chunks
+    additionally consume the per-round displacement pools; "pool2" — the
+    HBM-streaming pool tier past the VMEM cap (ops/fused_pool2.py, state in
+    ping/pong HBM planes, streamed through VMEM per tile); "imp" — the
+    imp2d/imp3d pooled-long-range engine (ops/fused_imp.py), which also
+    consumes per-round choice keys."""
     from ..ops import fused
 
     target = cfg.resolved_target_count(topo.n, topo.target_count)
@@ -450,11 +454,17 @@ def _run_fused(
     def extra_args(start, count):
         return ()
 
-    if variant == "pool":
+    if variant in ("pool", "pool2"):
         from ..ops import fused_pool
 
-        make_pushsum = fused_pool.make_pushsum_pool_chunk
-        make_gossip = fused_pool.make_gossip_pool_chunk
+        if variant == "pool":
+            make_pushsum = fused_pool.make_pushsum_pool_chunk
+            make_gossip = fused_pool.make_gossip_pool_chunk
+        else:
+            from ..ops import fused_pool2
+
+            make_pushsum = fused_pool2.make_pushsum_pool2_chunk
+            make_gossip = fused_pool2.make_gossip_pool2_chunk
 
         def extra_args(start, count):  # noqa: F811
             return (fused_pool.round_offsets(key, start, count, cfg.pool_size, topo.n),)
@@ -642,8 +652,18 @@ def run(
             if topo.implicit:
                 from ..ops import fused_pool
 
-                variant = "pool"
-                reason = fused_pool.pool_fused_support(topo, cfg)
+                # VMEM-resident engine up to its cap; the HBM-streaming
+                # tier (ops/fused_pool2.py) past it — per-node round cost
+                # stays in the fused class instead of cliffing onto the
+                # chunked XLA path (VERDICT r2 #2).
+                if topo.n <= fused_pool.MAX_POOL_NODES:
+                    variant = "pool"
+                    reason = fused_pool.pool_fused_support(topo, cfg)
+                else:
+                    from ..ops import fused_pool2
+
+                    variant = "pool2"
+                    reason = fused_pool2.pool2_support(topo, cfg)
             else:
                 from ..ops import fused_imp
 

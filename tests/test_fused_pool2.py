@@ -1,0 +1,123 @@
+"""HBM-streaming pool engine (ops/fused_pool2.py), interpret mode on CPU.
+
+The engine serves the implicit full topology past the VMEM-resident
+engine's 2^21-node cap; tests force it at small populations by shrinking
+ops/fused_pool.MAX_POOL_NODES (the runner reads it at dispatch time).
+Oracles mirror tests/test_fused_pool.py: gossip bitwise vs the chunked XLA
+pool path — on both the Z=0 (aligned population, single-window) and Z>0
+(mod-n blend) code paths — push-sum on rounds/estimates, resume, gating.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from cop5615_gossip_protocol_tpu import SimConfig, build_topology
+from cop5615_gossip_protocol_tpu.models.runner import run
+from cop5615_gossip_protocol_tpu.ops import fused_pool, fused_pool2
+
+
+def _cfg(n, algorithm="gossip", engine="fused", **kw):
+    kw.setdefault("max_rounds", 5000)
+    kw.setdefault("chunk_rounds", 16)
+    return SimConfig(n=n, topology="full", algorithm=algorithm,
+                     delivery="pool", engine=engine, **kw)
+
+
+@pytest.fixture
+def force_pool2(monkeypatch):
+    # Shrink the VMEM engine's domain so dispatch routes to pool2.
+    monkeypatch.setattr(fused_pool, "MAX_POOL_NODES", 1000)
+
+
+@pytest.mark.parametrize("n", [20000,   # Z > 0: mod-n blend path
+                               65536])  # Z = 0: single-window path
+def test_pool2_gossip_matches_chunked_bitwise(n, force_pool2):
+    results = {}
+    for engine in ["chunked", "fused"]:
+        r = run(build_topology("full", n), _cfg(n, engine=engine))
+        results[engine] = r
+    a, b = results["chunked"], results["fused"]
+    assert a.converged and b.converged
+    assert a.rounds == b.rounds
+    assert a.converged_count == b.converged_count
+
+
+def test_pool2_gossip_suppression_bitwise(force_pool2):
+    n = 20000
+    results = {}
+    for engine in ["chunked", "fused"]:
+        r = run(build_topology("full", n),
+                _cfg(n, engine=engine, suppress_converged=True))
+        results[engine] = r
+    a, b = results["chunked"], results["fused"]
+    assert a.rounds == b.rounds
+    assert a.converged_count == b.converged_count
+
+
+def test_pool2_pushsum_matches_chunked(force_pool2):
+    n = 20000
+    results = {}
+    for engine in ["chunked", "fused"]:
+        r = run(build_topology("full", n),
+                _cfg(n, algorithm="push-sum", engine=engine, chunk_rounds=64))
+        results[engine] = r
+    a, b = results["chunked"], results["fused"]
+    assert a.converged and b.converged
+    assert a.rounds == b.rounds
+    assert abs(a.estimate_mae - b.estimate_mae) < 1e-3
+
+
+def test_pool2_resume_midway(force_pool2):
+    n = 20000
+    cfg = _cfg(n, chunk_rounds=8)
+    topo = build_topology("full", n)
+    snaps = []
+    full = run(topo, cfg, on_chunk=lambda r, s: snaps.append((r, s)))
+    assert len(snaps) >= 2
+    r0, s0 = snaps[0]
+    resumed = run(topo, cfg, start_state=jax.tree.map(jnp.asarray, s0),
+                  start_round=r0)
+    assert resumed.rounds == full.rounds
+    assert resumed.converged_count == full.converged_count
+    # A checkpoint taken at/after convergence must execute ZERO rounds.
+    r_last, s_last = snaps[-1]
+    again = run(topo, cfg, start_state=jax.tree.map(jnp.asarray, s_last),
+                start_round=r_last)
+    assert again.rounds == r_last
+
+
+def test_pool2_chunk_rounds_not_multiple_of_8(force_pool2):
+    n = 20000
+    a = run(build_topology("full", n), _cfg(n, engine="chunked"))
+    b = run(build_topology("full", n), _cfg(n, chunk_rounds=5))
+    assert a.rounds == b.rounds
+    assert a.converged_count == b.converged_count
+
+
+def test_pool2_support_gating():
+    cfg = _cfg(70000)
+    topo = build_topology("full", 70000)
+    assert fused_pool2.pool2_support(topo, cfg) is None
+    line = build_topology("line", 100)
+    assert "full topology" in fused_pool2.pool2_support(line, cfg)
+    over = build_topology("full", fused_pool2.MAX_POOL2_NODES + 1)
+    assert "HBM-plane budget" in fused_pool2.pool2_support(over, cfg)
+
+
+def test_dispatch_routes_pool2_past_vmem_cap(monkeypatch, force_pool2):
+    from cop5615_gossip_protocol_tpu.models import runner as runner_mod
+
+    seen = {}
+    real = runner_mod._run_fused
+
+    def spy(topo, cfg, key, on_chunk, start_state, start_round, interpret,
+            variant="stencil"):
+        seen["variant"] = variant
+        return real(topo, cfg, key, on_chunk, start_state, start_round,
+                    interpret, variant=variant)
+
+    monkeypatch.setattr(runner_mod, "_run_fused", spy)
+    r = run(build_topology("full", 20000), _cfg(20000))
+    assert r.converged
+    assert seen == {"variant": "pool2"}
