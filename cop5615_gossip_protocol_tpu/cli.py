@@ -65,6 +65,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="push-sum stability threshold (default per dtype; reference: 1e-10)")
     p.add_argument("--rumor-threshold", type=int, default=10)
     p.add_argument("--term-rounds", type=int, default=3)
+    p.add_argument("--termination", choices=["local", "global"], default="local",
+                   help="push-sum stop rule: local = the reference's per-node "
+                   "consecutive-stability latch (program.fs:119-137); global "
+                   "= stop when every node's per-round relative ratio change "
+                   "is <= delta (the honest global-residual criterion)")
     p.add_argument("--max-rounds", type=int, default=1_000_000)
     p.add_argument("--chunk-rounds", type=int, default=4096)
     p.add_argument("--target-frac", type=float, default=None)
@@ -150,6 +155,7 @@ def _main_refsim(args, parser) -> int:
         "--delta": changed("delta"),
         "--rumor-threshold": changed("rumor_threshold"),
         "--term-rounds": changed("term_rounds"),
+        "--termination": changed("termination"),
         "--max-rounds": changed("max_rounds"),
         "--chunk-rounds": changed("chunk_rounds"),
         "--target-frac": changed("target_frac"),
@@ -298,6 +304,7 @@ def main(argv: Optional[list[str]] = None) -> int:
             delta=args.delta,
             rumor_threshold=args.rumor_threshold,
             term_rounds=args.term_rounds,
+            termination=args.termination,
             max_rounds=args.max_rounds,
             chunk_rounds=args.chunk_rounds,
             target_frac=args.target_frac,

@@ -162,6 +162,17 @@ class SimConfig:
     # Sharding: number of mesh devices for the node dimension; None/1 → single device.
     n_devices: int | None = None
 
+    # Push-sum termination criterion. "local" is the reference's own
+    # (program.fs:119-137): each node latches converged after term_rounds
+    # consecutive sub-delta receipt rounds — local stability, which on
+    # slow-mixing graphs latches early/late relative to true equilibrium and
+    # at torus scale spends tens of thousands of rounds on stragglers.
+    # "global" stops when max over nodes of the per-round ratio change
+    # |Δ(s/w)| is <= delta — the honest global-residual rule (the same
+    # quantity --trace-convergence reports per chunk); every node is then
+    # declared converged at once.
+    termination: str = "local"
+
     def __post_init__(self) -> None:
         if self.n <= 0:
             raise ValueError(f"n must be positive, got {self.n}")
@@ -212,6 +223,22 @@ class SimConfig:
         if self.engine not in ("auto", "chunked", "fused"):
             raise ValueError(
                 f"unknown engine {self.engine!r}; expected auto|chunked|fused"
+            )
+        if self.termination not in ("local", "global"):
+            raise ValueError(
+                f"unknown termination {self.termination!r}; expected local|global"
+            )
+        if self.termination == "global" and self.algorithm != "push-sum":
+            raise ValueError(
+                "termination='global' is a push-sum residual criterion "
+                "(max |Δ(s/w)| <= delta); gossip terminates on receipt "
+                "counts only"
+            )
+        if self.termination == "global" and self.semantics == "reference":
+            raise ValueError(
+                "termination='global' replaces the reference's local "
+                "stability rule (program.fs:119-137) and contradicts "
+                "reference semantics; use batched semantics"
             )
 
     # -- resolved policy ---------------------------------------------------

@@ -64,7 +64,8 @@ def halve_and_send(s, w, send_ok):
     return s_send, w_send, s - s_send, w - w_send
 
 
-def absorb(state: PushSumState, s_keep, w_keep, inbox_s, inbox_w, delta, term_rounds):
+def absorb(state: PushSumState, s_keep, w_keep, inbox_s, inbox_w, delta,
+           term_rounds, global_termination: bool = False):
     """Absorb one round of deliveries and advance the termination counters.
 
     Mirrors the ComputePushSum handler (program.fs:119-143): ratio change is
@@ -72,6 +73,17 @@ def absorb(state: PushSumState, s_keep, w_keep, inbox_s, inbox_w, delta, term_ro
     increments it (program.fs:130-133); reaching term_rounds latches
     convergence (program.fs:135-137). The receipt gate stands in for the
     reference's "no message, no handler" semantics.
+
+    ``global_termination`` replaces the per-node latch with the global
+    residual rule (SimConfig.termination): conv becomes all-or-nothing —
+    every node converged iff EVERY node's per-round ratio change satisfies
+    |Δ(s/w)| <= delta * max(|s/w|, 1) this round. The residual is RELATIVE
+    (unlike the reference's absolute test): at equilibrium each absorb still
+    re-rounds the mixed masses, so max-over-nodes |Δ| floors at a few ulps
+    of the ratio scale (~(n-1)/2) — an absolute delta below that would
+    never fire at float32. Non-receiving nodes have Δ = 0 and never block.
+    Under node sharding each shard's all() composes with the runner's
+    sum(conv) >= n predicate into the global all() exactly.
     """
     s_new = s_keep + inbox_s
     w_new = w_keep + inbox_w
@@ -79,6 +91,13 @@ def absorb(state: PushSumState, s_keep, w_keep, inbox_s, inbox_w, delta, term_ro
     ratio_old = state.s / state.w
     ratio_new = s_new / w_new
     stable = jnp.abs(ratio_new - ratio_old) <= jnp.asarray(delta, state.s.dtype)
+    if global_termination:
+        tol = jnp.asarray(delta, state.s.dtype) * jnp.maximum(
+            jnp.abs(ratio_old), jnp.asarray(1, state.s.dtype)
+        )
+        stable_g = jnp.abs(ratio_new - ratio_old) <= tol
+        conv_new = jnp.broadcast_to(jnp.all(stable_g), state.conv.shape)
+        return PushSumState(s=s_new, w=w_new, term=state.term, conv=conv_new)
     term_new = jnp.where(
         received, jnp.where(stable, state.term + 1, 0), state.term
     )
@@ -88,7 +107,7 @@ def absorb(state: PushSumState, s_keep, w_keep, inbox_s, inbox_w, delta, term_ro
 
 def round_from_targets(
     state: PushSumState, targets, send_ok, pop: int, delta, term_rounds,
-    deliver_fn=None,
+    deliver_fn=None, global_termination: bool = False,
 ) -> PushSumState:
     """One full synchronous round on a single device (sharded delivery lives
     in parallel/sharded.py, built from the same halve_and_send/absorb).
@@ -106,4 +125,5 @@ def round_from_targets(
         inbox_s = deliver_fn(s_send, targets)
         inbox_w = deliver_fn(w_send, targets)
     with jax.named_scope("pushsum_absorb"):
-        return absorb(state, s_keep, w_keep, inbox_s, inbox_w, delta, term_rounds)
+        return absorb(state, s_keep, w_keep, inbox_s, inbox_w, delta,
+                      term_rounds, global_termination)

@@ -190,7 +190,8 @@ def make_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array):
         def round_fn(state, round_idx, key_data, *targs):
             targets, send_ok = targets_and_gate(round_idx, key_data, *targs)
             return pushsum_mod.round_from_targets(
-                state, targets, send_ok, n, delta, term_rounds, deliver_fn
+                state, targets, send_ok, n, delta, term_rounds, deliver_fn,
+                cfg.termination == "global",
             )
 
     else:
@@ -250,7 +251,8 @@ def _make_pool_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array, dty
                 )
             with jax.named_scope("pushsum_absorb"):
                 return pushsum_mod.absorb(
-                    state, s_keep, w_keep, inbox[0], inbox[1], delta, term_rounds
+                    state, s_keep, w_keep, inbox[0], inbox[1], delta,
+                    term_rounds, cfg.termination == "global",
                 )
 
     else:
@@ -351,7 +353,8 @@ def _make_imp_pool_round_fn(
                 )
             with jax.named_scope("pushsum_absorb"):
                 return pushsum_mod.absorb(
-                    state, s_keep, w_keep, inbox[0], inbox[1], delta, term_rounds
+                    state, s_keep, w_keep, inbox[0], inbox[1], delta,
+                    term_rounds, cfg.termination == "global",
                 )
 
     else:
@@ -607,11 +610,15 @@ def run(
                 "n_devices or use batched semantics"
             )
         if cfg.engine == "fused":
-            raise ValueError(
-                "engine='fused' is single-device (the Pallas multi-round "
-                "kernel keeps the whole population in one core's VMEM); "
-                "sharded runs use the chunked collective engine — drop the "
-                "engine override or n_devices"
+            # Fused x sharded composition: per-shard multi-round Pallas
+            # chunks under shard_map, halo ppermutes + psum at chunk
+            # boundaries (parallel/fused_sharded.py). Raises with the
+            # reason when the topology/layout has no exact plan.
+            from ..parallel.fused_sharded import run_fused_sharded
+
+            return run_fused_sharded(
+                topo, cfg, key=key, on_chunk=on_chunk,
+                start_state=start_state, start_round=start_round,
             )
         # delivery='stencil' is legal under sharding: the halo-exchange plan
         # (parallel/halo.py) implements it as local shifts + boundary
@@ -643,7 +650,13 @@ def run(
         # round (one send per informed node per round) already models.
         return _run_reference_walk(topo, cfg, key, target)
 
-    if cfg.engine != "chunked":
+    if cfg.termination == "global" and cfg.engine == "fused":
+        raise ValueError(
+            "termination='global' runs on the chunked engine (the fused "
+            "kernels implement the reference's local latch); drop the "
+            "engine override"
+        )
+    if cfg.engine != "chunked" and cfg.termination != "global":
         # Two Pallas engines share one dispatch: the pool engine for pool
         # delivery on the implicit full topology (ops/fused_pool.py — the
         # flagship benchmark path, ~2.7x the chunked pool round on v5e),

@@ -131,6 +131,30 @@ def _threefry_rounds(x0, x1, rots):
     return x0, x1
 
 
+def threefry2x32_hash(k1, k2, i):
+    """Threefry-2x32 of counter array ``i`` (uint32) under key (k1, k2),
+    xor-folded — the partitionable-stream hash of a 32-bit draw at counter
+    position i (high counter word 0). The single key-schedule home for
+    every in-kernel bits generator; callers differ only in how they build
+    the counter array."""
+    ks0 = k1
+    ks1 = k2
+    ks2 = k1 ^ k2 ^ jnp.uint32(0x1BD11BDA)
+    x0 = jnp.zeros(i.shape, jnp.uint32) + ks0  # counts1 (high bits) = 0
+    x1 = i + ks1
+    x0, x1 = _threefry_rounds(x0, x1, _ROT_A)
+    x0, x1 = x0 + ks1, x1 + ks2 + jnp.uint32(1)
+    x0, x1 = _threefry_rounds(x0, x1, _ROT_B)
+    x0, x1 = x0 + ks2, x1 + ks0 + jnp.uint32(2)
+    x0, x1 = _threefry_rounds(x0, x1, _ROT_A)
+    x0, x1 = x0 + ks0, x1 + ks1 + jnp.uint32(3)
+    x0, x1 = _threefry_rounds(x0, x1, _ROT_B)
+    x0, x1 = x0 + ks1, x1 + ks2 + jnp.uint32(4)
+    x0, x1 = _threefry_rounds(x0, x1, _ROT_A)
+    x0, x1 = x0 + ks2, x1 + ks0 + jnp.uint32(5)
+    return x0 ^ x1
+
+
 def threefry_bits_2d(k1, k2, rows: int, cols: int, row0=0):
     """uint32 [rows, cols] == rows [row0, row0+rows) of
     jax.random.bits(key, ((row0+rows)*cols,), uint32) reshaped — the default
@@ -145,22 +169,7 @@ def threefry_bits_2d(k1, k2, rows: int, cols: int, row0=0):
          + jnp.asarray(row0, jnp.uint32)) * jnp.uint32(cols)
         + jax.lax.broadcasted_iota(jnp.uint32, (rows, cols), 1)
     )
-    ks0 = k1
-    ks1 = k2
-    ks2 = k1 ^ k2 ^ jnp.uint32(0x1BD11BDA)
-    x0 = jnp.zeros((rows, cols), jnp.uint32) + ks0  # counts1 (high bits) = 0
-    x1 = i + ks1
-    x0, x1 = _threefry_rounds(x0, x1, _ROT_A)
-    x0, x1 = x0 + ks1, x1 + ks2 + jnp.uint32(1)
-    x0, x1 = _threefry_rounds(x0, x1, _ROT_B)
-    x0, x1 = x0 + ks2, x1 + ks0 + jnp.uint32(2)
-    x0, x1 = _threefry_rounds(x0, x1, _ROT_A)
-    x0, x1 = x0 + ks0, x1 + ks1 + jnp.uint32(3)
-    x0, x1 = _threefry_rounds(x0, x1, _ROT_B)
-    x0, x1 = x0 + ks1, x1 + ks2 + jnp.uint32(4)
-    x0, x1 = _threefry_rounds(x0, x1, _ROT_A)
-    x0, x1 = x0 + ks2, x1 + ks0 + jnp.uint32(5)
-    return x0 ^ x1
+    return threefry2x32_hash(k1, k2, i)
 
 
 # ---------------------------------------------------------------------------

@@ -291,7 +291,8 @@ def run_sharded(
                     jnp.stack([s_send, w_send]), choice, offs, NODE_AXIS, n_dev
                 )
                 return pushsum_mod.absorb(
-                    state, s_keep, w_keep, inbox[0], inbox[1], delta, term_rounds
+                    state, s_keep, w_keep, inbox[0], inbox[1], delta, term_rounds,
+                    cfg.termination == "global",
                 )
 
         else:
@@ -311,7 +312,8 @@ def run_sharded(
                 )
                 inbox_s, inbox_w = inbox[0], inbox[1]
                 return pushsum_mod.absorb(
-                    state, s_keep, w_keep, inbox_s, inbox_w, delta, term_rounds
+                    state, s_keep, w_keep, inbox_s, inbox_w, delta, term_rounds,
+                    cfg.termination == "global",
                 )
 
         s0 = np.arange(n_pad, dtype=dtype)

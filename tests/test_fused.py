@@ -174,12 +174,18 @@ def test_fused_rejects_scatter_delivery_and_reference_pushsum():
                       semantics="reference", engine="fused")
     with pytest.raises(ValueError, match="single-walk"):
         run(topo_r, cfg_r)
-    # fused is single-device: an explicit fused request under sharding must
-    # raise, not silently run the chunked collective engine.
+    # fused under sharding routes to the fused x sharded composition
+    # (parallel/fused_sharded.py); a layout with no exact per-device plan
+    # must raise with the reason, not silently run the chunked engine.
     cfg_s = SimConfig(n=64, topology="line", algorithm="gossip",
                       engine="fused", n_devices=8)
-    with pytest.raises(ValueError, match="single-device"):
+    with pytest.raises(ValueError, match="unavailable"):
         run(topo, cfg_s)
+    # ...and scatter delivery stays a loud rejection under sharding too.
+    cfg_ss = SimConfig(n=125000, topology="torus3d", algorithm="gossip",
+                       engine="fused", delivery="scatter", n_devices=2)
+    with pytest.raises(ValueError, match="scatter"):
+        run(build_topology("torus3d", 125000), cfg_ss)
 
 
 def test_fused_resume_rejects_non_float32():

@@ -1,0 +1,126 @@
+"""Fused x sharded composition (parallel/fused_sharded.py), interpret mode
+on the 8-virtual-CPU-device mesh.
+
+Contracts:
+- chunk_rounds=1 degenerates to exact per-round convergence detection and
+  gossip trajectories are BITWISE identical to the single-device engines;
+- at larger fused chunks (CR), convergence is detected at the first
+  super-step boundary at/after the true round, never before;
+- push-sum follows the single-device trajectory to float tolerance over a
+  fixed round budget and conserves mass;
+- the plan shrinks CR until halo and VMEM constraints fit, and refuses
+  configurations with no exact plan (implicit topologies, indivisible
+  layouts) with the reason.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cop5615_gossip_protocol_tpu import SimConfig, build_topology
+from cop5615_gossip_protocol_tpu.models.runner import run
+from cop5615_gossip_protocol_tpu.parallel.fused_sharded import (
+    plan_fused_sharded,
+    run_fused_sharded,
+)
+from cop5615_gossip_protocol_tpu.parallel.mesh import make_mesh
+
+# torus g=50: padded layout 1024 rows -> two 512-row shards.
+N = 125000
+
+
+def _grab(final, tag):
+    def f(rounds, state):
+        final[tag] = state
+    return f
+
+
+def test_gossip_cr1_bitwise_vs_single_device():
+    topo = build_topology("torus3d", N)
+    final = {}
+    r1 = run(topo, SimConfig(n=N, topology="torus3d", algorithm="gossip",
+                             engine="chunked", max_rounds=3000),
+             on_chunk=_grab(final, "c"))
+    r2 = run(topo, SimConfig(n=N, topology="torus3d", algorithm="gossip",
+                             engine="fused", n_devices=2, chunk_rounds=1,
+                             max_rounds=3000),
+             on_chunk=_grab(final, "f"))
+    assert r1.rounds == r2.rounds
+    assert r1.converged_count == r2.converged_count
+    for f in ("count", "active", "conv"):
+        a = np.asarray(getattr(final["c"], f))
+        b = np.asarray(getattr(final["f"], f))[:N]
+        assert (a == b).all(), f
+
+
+def test_gossip_cr_adaptive_converges_at_boundary():
+    topo = build_topology("torus3d", N)
+    r1 = run(topo, SimConfig(n=N, topology="torus3d", algorithm="gossip",
+                             engine="chunked", max_rounds=3000))
+    r3 = run(topo, SimConfig(n=N, topology="torus3d", algorithm="gossip",
+                             engine="fused", n_devices=2, chunk_rounds=8,
+                             max_rounds=3000))
+    cfg = SimConfig(n=N, topology="torus3d", algorithm="gossip",
+                    engine="fused", n_devices=2, chunk_rounds=8)
+    plan = plan_fused_sharded(build_topology("torus3d", N), cfg, 2)
+    assert not isinstance(plan, str)
+    cr = plan[2]
+    assert r3.converged
+    # First super-step boundary at/after the true convergence round.
+    assert r1.rounds <= r3.rounds <= r1.rounds + cr
+
+
+def test_pushsum_fixed_rounds_trajectory_and_mass():
+    topo = build_topology("torus3d", N)
+    final = {}
+    rp1 = run(topo, SimConfig(n=N, topology="torus3d", algorithm="push-sum",
+                              engine="chunked", max_rounds=64, chunk_rounds=64),
+              on_chunk=_grab(final, "c"))
+    rp2 = run(topo, SimConfig(n=N, topology="torus3d", algorithm="push-sum",
+                              engine="fused", n_devices=2, chunk_rounds=8,
+                              max_rounds=64), on_chunk=_grab(final, "f"))
+    assert rp1.rounds == rp2.rounds == 64
+    a, b = final["c"], final["f"]
+    np.testing.assert_allclose(np.asarray(a.s), np.asarray(b.s)[:N],
+                               rtol=2e-5, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(a.w), np.asarray(b.w)[:N],
+                               rtol=2e-5, atol=1e-6)
+    sm = float(np.asarray(b.s, np.float64)[:N].sum())
+    true = N * (N - 1) / 2
+    assert abs(sm - true) / true < 1e-5
+    wm = float(np.asarray(b.w, np.float64)[:N].sum())
+    assert abs(wm - N) / N < 1e-5
+
+
+def test_plan_gating():
+    cfg = SimConfig(n=N, topology="torus3d", algorithm="gossip",
+                    engine="fused", n_devices=2)
+    # implicit topology
+    assert "displacement" in plan_fused_sharded(
+        build_topology("full", 1024), cfg, 2
+    )
+    # layout indivisible into whole tiles per device
+    assert "tiles per device" in plan_fused_sharded(
+        build_topology("torus3d", N), cfg, 3
+    )
+    # runner surfaces the reason
+    bad = SimConfig(n=1024, topology="full", algorithm="gossip",
+                    engine="fused", n_devices=2)
+    with pytest.raises(ValueError, match="unavailable"):
+        run(build_topology("full", 1024), bad)
+
+
+def test_ring_eight_devices_counts_match():
+    # Full 8-device mesh (shards of 512 rows need n >= 8*65536); bounded
+    # rounds — the oracle is count equality with the single-device path.
+    n = 8 * 65536
+    topo = build_topology("ring", n)
+    r1 = run(topo, SimConfig(n=n, topology="ring", algorithm="gossip",
+                             engine="chunked", max_rounds=60))
+    r8 = run(topo, SimConfig(n=n, topology="ring", algorithm="gossip",
+                             engine="fused", n_devices=8, chunk_rounds=1,
+                             max_rounds=60))
+    assert r1.rounds == r8.rounds
+    assert r1.converged_count == r8.converged_count

@@ -1,0 +1,49 @@
+"""Compiled (interpret=False) fused x sharded engine on the real chip.
+
+Hardware has ONE chip, so this exercises the composition's compiled kernel
+on a 1-device mesh: the halo-extended per-shard Pallas chunk, the two-shift
+mod-n blend, global-position threefry, and the shard_map/while_loop
+orchestration — against the single-device engines. Multi-device execution
+of the same program is validated on the virtual CPU mesh
+(tests/test_fused_sharded.py, __graft_entry__.dryrun_multichip).
+
+Run on a chip: python -m pytest tests_tpu -q
+Latest recorded run: tests_tpu/RUNLOG.md
+"""
+
+from cop5615_gossip_protocol_tpu import SimConfig, build_topology
+from cop5615_gossip_protocol_tpu.models.runner import run
+from cop5615_gossip_protocol_tpu.parallel.fused_sharded import run_fused_sharded
+from cop5615_gossip_protocol_tpu.parallel.mesh import make_mesh
+
+
+def test_compiled_fused_sharded_gossip_matches_single_device():
+    n = 1_000_000
+    topo = build_topology("torus3d", n)
+    r1 = run(topo, SimConfig(n=n, topology="torus3d", algorithm="gossip",
+                             engine="chunked", max_rounds=3000))
+    r2 = run_fused_sharded(
+        topo,
+        SimConfig(n=n, topology="torus3d", algorithm="gossip",
+                  engine="fused", chunk_rounds=1, max_rounds=3000),
+        mesh=make_mesh(1),
+    )
+    assert r2.converged
+    assert r1.rounds == r2.rounds
+    assert r1.converged_count == r2.converged_count
+
+
+def test_compiled_fused_sharded_pushsum_throughput_class():
+    # The VERDICT r3 bar: single-shard throughput in the single-device
+    # fused engine's class at 1M via the new code path (halo recompute at
+    # CR=2 costs ~25-35%; the chunked XLA round costs ~3x).
+    n = 1_000_000
+    topo = build_topology("torus3d", n)
+    cfg = SimConfig(n=n, topology="torus3d", algorithm="push-sum",
+                    engine="fused", chunk_rounds=512, max_rounds=2000)
+    r_shard = run_fused_sharded(topo, cfg, mesh=make_mesh(1))
+    r_single = run(topo, cfg)
+    assert r_shard.rounds == 2000 and r_single.rounds == 2000
+    per_shard = r_shard.run_s / r_shard.rounds
+    per_single = r_single.run_s / r_single.rounds
+    assert per_shard < per_single * 1.6, (per_shard, per_single)
