@@ -1,0 +1,142 @@
+"""Roofline accounting per engine (VERDICT r3 #4).
+
+For each delivery/engine tier at a representative population this module
+measures the per-round cost on the real chip (differential fixed-round
+timing — benchmarks/compare.engine_us_per_round — so launch plumbing and
+compile cancel exactly) and sets it against a documented LOWER-BOUND model
+of the algorithmic HBM bytes each round must move. Implied bandwidth over
+the v5e's 819 GB/s HBM roofline classifies each tier:
+
+- **HBM-streaming** tiers (chunked XLA paths, the pool2 engine) are judged
+  by % of roofline; anything far under it is explained (XLA materializes
+  intermediates the model's fused lower bound does not);
+- **VMEM-resident** tiers (the fused engines) move ~zero HBM bytes per
+  round by design — their per-round cost is VPU-op-bound, and the table
+  reports the implied VMEM-traffic bandwidth instead (v5e VMEM feeds the
+  VPU at multiple TB/s, so these rows sit far above the HBM roofline —
+  that is the point of the engines);
+- **addressing-bound** tiers (sort-based scatter on static irregular
+  edges) are bounded by the chip's per-element dynamic-address cost —
+  measured at ~8-12 ns/element across every formulation tried (XLA
+  gather/scatter, sorted static-index scatter, inverse-table gathers,
+  Pallas per-edge loops; see the r3 microbenchmark series) — not by
+  bandwidth; the model reports that floor instead.
+
+Byte models (per node per round, f32=4B planes; lower bounds assume
+perfect producer-consumer fusion — one read per consumed plane, one write
+per produced plane):
+
+- chunked stencil push-sum, C displacement classes: state r/w (s,w,term,
+  conv) 32 B + C masked-roll passes reading both send channels, 8C B.
+- chunked pool push-sum, K slots: 32 B state + 8K B roll reads + ~1 B
+  packed choice words.
+- pool2 push-sum, K slots: p1 reads s,w (8) and writes ds,dw,choice (12);
+  p2 reads K windows of 3 planes (12K), own state (16), writes state (16)
+  — 52 + 12K B (the module docstring's accounting).
+- VMEM-resident engines: HBM ~0; VMEM traffic estimated as the kernel's
+  plane passes (reported for context, not judged against the HBM roof).
+
+Usage: python benchmarks/roofline.py  (requires the TPU; ~2-3 min)
+Emits the markdown section BENCH_TABLES.md embeds.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+HBM_ROOF_GBS = 819.0  # v5e chip HBM bandwidth
+
+# (label, kind, algorithm, n, cfg overrides, bound class,
+#  model bytes/node/round or None, justification)
+POINTS = (
+    ("chunked scatter", "imp3d", "push-sum", 1_000_000,
+     dict(delivery="scatter", engine="chunked"), "addressing-bound",
+     None,
+     "sort-based scatter over n random static edges; the chip's "
+     "~8-12 ns/element dynamic-address floor (measured across every "
+     "gather/scatter formulation) x 2 channels bounds the round, not HBM"),
+    ("chunked stencil", "torus3d", "push-sum", 1_000_000,
+     dict(delivery="stencil", engine="chunked"), "HBM-streaming",
+     32 + 8 * 12,
+     "12 displacement classes; XLA materializes each masked roll as its "
+     "own HBM pass instead of fusing into one sweep"),
+    ("chunked pool", "full", "push-sum", 1_048_576,
+     dict(delivery="pool", engine="chunked", pool_size=4), "HBM-streaming",
+     32 + 8 * 4 + 1,
+     "K=4 masked dynamic rolls; same XLA materialization overhead"),
+    ("fused stencil2", "torus3d", "push-sum", 1_000_000,
+     dict(delivery="stencil", engine="fused"), "VMEM-resident",
+     None, "state resident across the whole chunk; VPU-op-bound"),
+    ("fused pool", "full", "push-sum", 1_048_576,
+     dict(delivery="pool", engine="fused", pool_size=2), "VMEM-resident",
+     None, "state resident across the whole chunk; VPU-op-bound"),
+    ("fused imp", "imp3d", "push-sum", 1_000_000,
+     dict(delivery="pool", engine="fused", pool_size=4), "VMEM-resident",
+     None, "lattice + pooled long-range classes, state resident"),
+    ("pool2 (HBM stream)", "full", "push-sum", 16_777_216,
+     dict(delivery="pool", engine="fused", pool_size=2), "HBM-streaming",
+     52 + 12 * 2,
+     "ping/pong planes + per-slot roll windows; DMA-issue overhead and "
+     "the p1/p2 split account for the rest"),
+)
+
+
+def section() -> list[str]:
+    from benchmarks.compare import engine_us_per_round
+
+    out = [
+        "## Roofline accounting per engine (push-sum, measured on-chip)",
+        "",
+        "Per-round cost via differential fixed-round timing (launch floor "
+        "and compile cancel), set against a lower-bound model of the "
+        "algorithmic HBM bytes per round. Implied GB/s over the v5e's "
+        f"{HBM_ROOF_GBS:.0f} GB/s HBM roofline classifies each tier; "
+        "VMEM-resident engines move ~zero HBM bytes per round by design "
+        "and are VPU-op-bound (their implied 'bandwidth' would be VMEM "
+        "traffic, far above the HBM roof — that is the point); the "
+        "sort-based scatter tier is bounded by the chip's measured "
+        "~8-12 ns/element dynamic-address floor, not bandwidth.",
+        "",
+        "| engine tier | config | µs/round | model B/node/round | "
+        "implied GB/s | % HBM roof | bound class |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    notes = []
+    for label, kind, _algo, n, overrides, klass, model_b, why in POINTS:
+        r1, r2 = (64, 320) if n > 4_000_000 else (256, 1280)
+        us = engine_us_per_round(kind, "push-sum", n, r1=r1, r2=r2,
+                                 **overrides)
+        if model_b is not None:
+            gbs = n * model_b / (us * 1e-6) / 1e9
+            pct = f"{100 * gbs / HBM_ROOF_GBS:.0f}%"
+            gbs_s = f"{gbs:,.0f}"
+            model_s = str(model_b)
+        else:
+            gbs_s, pct, model_s = "—", "—", "—"
+        out.append(
+            f"| {label} | {kind} {n:,} | {us:,.1f} | {model_s} "
+            f"| {gbs_s} | {pct} | {klass} |"
+        )
+        notes.append(f"- **{label}**: {why}.")
+        print(f"[roofline] {label}: {us:.1f} us/round", flush=True)
+    out.append("")
+    out.extend(notes)
+    out.append("")
+    return out
+
+
+def main() -> int:
+    import jax
+
+    if jax.default_backend() != "tpu":
+        print("roofline accounting needs the real chip", file=sys.stderr)
+        return 2
+    print("\n".join(section()))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -59,6 +59,29 @@ class HaloPlan:
         return int(np.max(np.abs(self.offsets_signed)))
 
 
+def plan_imp_halo(split, n: int, n_dev: int) -> HaloPlan | None:
+    """Halo plan over an imp topology's LATTICE classes only
+    (ops/topology.imp_split) — the sharded imp-pool path delivers the
+    lattice edges by halo rolls and the pooled long-range slot by dynamic
+    global rolls; the lattice classes alone must satisfy the same
+    exactness conditions plan_halo checks for whole topologies."""
+    if n_dev < 1:
+        return None
+    n_pad = ((n + n_dev - 1) // n_dev) * n_dev
+    n_loc = n_pad // n_dev
+    mod = split.lattice_offsets.astype(np.int64)
+    signed = np.where(mod <= n // 2, mod, mod - n)
+    if mod.size == 0 or np.abs(signed).max() > n_loc:
+        return None
+    # No n_pad != n exactness scan: the caller (parallel/sharded.py) rejects
+    # non-divisible populations on this path outright — the pool rolls need
+    # an unpadded ring anyway.
+    return HaloPlan(
+        n=n, n_pad=n_pad, n_loc=n_loc, n_dev=n_dev,
+        offsets_mod=mod, offsets_signed=signed,
+    )
+
+
 def plan_halo(topo: Topology, n_dev: int) -> HaloPlan | None:
     """Build the halo plan, or None when halo delivery cannot be exact:
     implicit topology, too many offset classes, a halo wider than a shard,

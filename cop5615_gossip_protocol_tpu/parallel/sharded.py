@@ -77,7 +77,7 @@ from ..models import gossip as gossip_mod
 from ..models import pushsum as pushsum_mod
 from ..models.runner import RunResult, _check_dtype, draw_leader
 from ..ops import sampling
-from ..ops.topology import Topology
+from ..ops.topology import Topology, imp_split
 from . import halo as halo_mod
 from .mesh import NODE_AXIS, make_mesh
 
@@ -109,13 +109,6 @@ def run_sharded(
     if key is None:
         key = jax.random.PRNGKey(cfg.seed)
 
-    if cfg.delivery == "pool" and not topo.implicit:
-        raise ValueError(
-            "imp pooled delivery is single-device for now (lattice halo "
-            "rolls x dynamic pool rolls under shard_map land with the "
-            "fused-sharded composition); drop n_devices or use "
-            "delivery='auto'"
-        )
     n = topo.n
     n_pad = ((n + n_dev - 1) // n_dev) * n_dev
     n_loc = n_pad // n_dev
@@ -156,6 +149,37 @@ def run_sharded(
     # fall back to the scatter path: pad slots inside the ring would
     # corrupt the roll.
     pool_roll = topo.implicit and cfg.delivery == "pool" and n_pad == n
+    # Sharded imp-pool: lattice classes deliver by halo rolls, the pooled
+    # long-range slot by K dynamic global rolls — both existing sharded
+    # primitives; accumulation order (sorted lattice classes, then pool
+    # slots) matches the single-device deliver_imp_pool exactly.
+    imp_plan = imp_split_t = None
+    if cfg.delivery == "pool" and not topo.implicit:
+        if cfg.reference:
+            raise ValueError(
+                "delivery='pool' on imp topologies cannot reproduce the "
+                "reference's static extra edge (Q9); use batched semantics"
+            )
+        split = imp_split(topo)
+        imp_plan = None if split is None else halo_mod.plan_imp_halo(
+            split, n, n_dev
+        )
+        if imp_plan is None:
+            raise ValueError(
+                f"sharded imp pooled delivery needs an exact lattice halo "
+                f"plan for {topo.kind!r} at n={n} on {n_dev} devices "
+                "(lattice halo must fit a shard); use fewer devices or "
+                "delivery='scatter'"
+            )
+        if n_pad != n:
+            # The pool rolls require an unpadded ring (same constraint as
+            # the full-topology pool-roll path).
+            raise ValueError(
+                f"sharded imp pooled delivery requires the population "
+                f"({n}) to divide the mesh ({n_dev} devices); pad slots "
+                "inside the ring would corrupt the dynamic pool rolls"
+            )
+        imp_split_t = split
     if cfg.delivery == "stencil" and plan is None:
         raise ValueError(
             "delivery='stencil' under sharding requires an offset-structured "
@@ -184,7 +208,10 @@ def run_sharded(
         )
 
     valid = dev_put(np.arange(n_pad) < n)
-    if topo.implicit:
+    if topo.implicit or imp_plan is not None:
+        # The imp-pool path ships its own displacement/degree planes below;
+        # transferring the full neighbor table too would be the exact
+        # transient-HBM spike dev_put exists to avoid.
         topo_args = (valid,)
         topo_specs = (P(NODE_AXIS),)
     else:
@@ -274,11 +301,73 @@ def run_sharded(
                 tiled=True,
             )
 
+    def imp_parts(round_idx, key_data, disp_loc, deg_loc, valid_loc):
+        """Sharded mirror of models/runner.imp_pool_parts: full-length
+        draws sliced per shard (stream identical to single-device)."""
+        kr = sampling.round_key(sampling.key_join(key_data, key_impl), round_idx)
+        dev = lax.axis_index(NODE_AXIS)
+        start = dev * n_loc
+        bits_full = sampling.uniform_bits(kr, n_pad)
+        bits = lax.dynamic_slice(bits_full, (start,), (n_loc,))
+        d = sampling.targets_explicit(bits, disp_loc, deg_loc)
+        is_extra = (d == -1) & (deg_loc > 0)
+        offs = sampling.pool_offsets(kr, cfg.pool_size, n)
+        choice_full = sampling.pool_choice_packed(
+            sampling.imp_choice_key(kr), n, cfg.pool_size, out_len=n_pad
+        )
+        choice = lax.dynamic_slice(choice_full, (start,), (n_loc,))
+        send_ok = (deg_loc > 0) & valid_loc
+        gate_full = sampling.send_gate(kr, n_pad, cfg.fault_rate)
+        if gate_full is not True:
+            send_ok = send_ok & lax.dynamic_slice(gate_full, (start,), (n_loc,))
+        return d, is_extra, choice, offs, send_ok
+
+    def deliver_imp_sharded(channels, d, is_extra, choice, offs):
+        zero = jnp.zeros((), channels.dtype)
+        lat = jnp.where(is_extra[None, :], zero, channels)
+        inbox = halo_mod.deliver_halo(lat, d, imp_plan, NODE_AXIS)
+        choice_eff = jnp.where(is_extra, choice, jnp.int32(-1))
+        ext = jnp.where(is_extra[None, :], channels, zero)
+        # Pool rolls accumulate INTO the lattice inbox (not into a separate
+        # accumulator later added on): the single-device deliver_imp_pool is
+        # one left-fold over lattice-then-pool classes, and a different
+        # association tree shifts f32 sums by an ulp — enough to drift the
+        # term counter's round counts (the r2 reassociation lesson).
+        for k in range(offs.shape[0]):
+            masked = jnp.where(choice_eff == k, ext, zero)
+            inbox = inbox + halo_mod.global_roll_dynamic(
+                masked, offs[k], NODE_AXIS, n_dev
+            )
+        return inbox
+
+    if imp_plan is not None:
+        disp_dev = dev_put(_pad_to(imp_split_t.disp_cols, n_pad, -1))
+        deg_dev = dev_put(_pad_to(imp_split_t.degree, n_pad))
+        topo_args = (disp_dev, deg_dev, valid)
+        topo_specs = (P(NODE_AXIS), P(NODE_AXIS), P(NODE_AXIS))
+
     if cfg.algorithm == "push-sum":
         delta = cfg.resolved_delta
         term_rounds = cfg.term_rounds
 
-        if pool_roll:
+        if imp_plan is not None:
+
+            def round_fn(state, round_idx, key_data, *targs):
+                d, is_extra, choice, offs, send_ok = imp_parts(
+                    round_idx, key_data, *targs
+                )
+                s_send, w_send, s_keep, w_keep = pushsum_mod.halve_and_send(
+                    state.s, state.w, send_ok
+                )
+                inbox = deliver_imp_sharded(
+                    jnp.stack([s_send, w_send]), d, is_extra, choice, offs
+                )
+                return pushsum_mod.absorb(
+                    state, s_keep, w_keep, inbox[0], inbox[1], delta,
+                    term_rounds, cfg.termination == "global",
+                )
+
+        elif pool_roll:
 
             def round_fn(state, round_idx, key_data, *targs):
                 (valid_loc,) = targs
@@ -340,7 +429,19 @@ def run_sharded(
             count=dev_put(count0), active=dev_put(active0), conv=dev_put(np.zeros(n_pad, bool))
         )
 
-        if pool_roll:
+        if imp_plan is not None:
+
+            def round_fn(state, round_idx, key_data, *targs):
+                d, is_extra, choice, offs, send_ok = imp_parts(
+                    round_idx, key_data, *targs
+                )
+                vals = gossip_mod.send_values(state, send_ok)
+                inbox = deliver_imp_sharded(
+                    vals[None].astype(jnp.int32), d, is_extra, choice, offs
+                )[0]
+                return gossip_mod.absorb(state, inbox, rumor_target, suppress)
+
+        elif pool_roll:
 
             def round_fn(state, round_idx, key_data, *targs):
                 (valid_loc,) = targs

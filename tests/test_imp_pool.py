@@ -154,11 +154,45 @@ def test_pool_rejects_non_imp_explicit_topology():
         SimConfig(n=400, topology="line", algorithm="gossip", delivery="pool")
 
 
-def test_imp_pool_sharded_rejected_for_now():
+def test_imp_pool_sharded_gossip_bitwise():
+    # Sharded imp-pool: lattice halo rolls + dynamic pool rolls. Gossip
+    # trajectories must match single-device exactly at any device count.
     from cop5615_gossip_protocol_tpu.parallel.mesh import make_mesh
     from cop5615_gossip_protocol_tpu.parallel.sharded import run_sharded
 
-    cfg = SimConfig(n=512, topology="imp3d", algorithm="push-sum",
+    n = 1728  # 12^3, divides 8 devices
+    topo = build_topology("imp3d", n, seed=3)
+    cfg = SimConfig(n=n, topology="imp3d", algorithm="gossip",
+                    delivery="pool", suppress_converged=True, seed=3,
+                    max_rounds=20000)
+    r1 = run(topo, cfg)
+    r8 = run_sharded(topo, cfg, mesh=make_mesh(8))
+    assert r1.converged and r8.converged
+    assert r8.rounds == r1.rounds
+    assert r8.converged_count == r1.converged_count
+
+
+def test_imp_pool_sharded_pushsum_matches():
+    from cop5615_gossip_protocol_tpu.parallel.mesh import make_mesh
+    from cop5615_gossip_protocol_tpu.parallel.sharded import run_sharded
+
+    n = 1024  # 32^2 imp2d, divides 8
+    topo = build_topology("imp2d", n, seed=5)
+    cfg = SimConfig(n=n, topology="imp2d", algorithm="push-sum",
+                    delivery="pool", seed=5, max_rounds=20000)
+    r1 = run(topo, cfg)
+    r8 = run_sharded(topo, cfg, mesh=make_mesh(8))
+    assert r1.converged and r8.converged
+    # Same per-class accumulation order -> round counts align.
+    assert r8.rounds == r1.rounds
+    assert abs(r8.estimate_mae - r1.estimate_mae) < 1e-3
+
+
+def test_imp_pool_sharded_rejects_indivisible():
+    from cop5615_gossip_protocol_tpu.parallel.mesh import make_mesh
+    from cop5615_gossip_protocol_tpu.parallel.sharded import run_sharded
+
+    cfg = SimConfig(n=729, topology="imp3d", algorithm="push-sum",
                     delivery="pool", n_devices=2)
-    with pytest.raises(ValueError, match="single-device"):
-        run_sharded(build_topology("imp3d", 512), cfg, mesh=make_mesh(2))
+    with pytest.raises(ValueError, match="divide the mesh"):
+        run_sharded(build_topology("imp3d", 729), cfg, mesh=make_mesh(2))
