@@ -20,7 +20,9 @@ uniform over j != i; convergence quality vs iid scatter sampling is pinned by
 tests/test_pool.py (rounds within a few percent, same estimate error). Pass
 --delivery scatter to measure the exact-iid path instead.
 
-On TPU the run auto-selects the fused pool engine (ops/fused_pool.py).
+On TPU the run auto-selects the fused pool engine (ops/fused_pool.py,
+VMEM-resident, to 2^21 nodes; past that the HBM-streaming tier
+ops/fused_pool2.py — `--n 16777216` converges ~1.9-2.7 s on one v5e chip).
 pool_size defaults to 2 here: on the fused engine's tiled gathers the
 per-slot cost dominates, and K=2 measured fastest at 1M on v5e
 (K=2 -> 0.122 s, K=4 -> 0.156 s, K=8 -> 0.264 s; rounds 951/966/1216,
