@@ -489,6 +489,11 @@ def _run_fused(
 
         make_pushsum = fused_stencil.make_pushsum_stencil2_chunk
         make_gossip = fused_stencil.make_gossip_stencil2_chunk
+    elif variant == "stencil_hbm":
+        from ..ops import fused_stencil_hbm
+
+        make_pushsum = fused_stencil_hbm.make_pushsum_stencil_hbm_chunk
+        make_gossip = fused_stencil_hbm.make_gossip_stencil_hbm_chunk
     else:
         make_pushsum = fused.make_pushsum_chunk
         make_gossip = fused.make_gossip_chunk
@@ -688,7 +693,10 @@ def run(
 
             # The proven whole-array engine keeps its domain; the tiled
             # stencil2 engine takes over where v1 refuses (population past
-            # 128k, wrap topologies at unaligned n).
+            # 128k, wrap topologies at unaligned n); past stencil2's VMEM
+            # budget the HBM-streaming tier serves constant-degree wrap
+            # lattices (torus3d/ring) so the grid-scale rows never cliff
+            # onto the chunked path.
             reason_v1 = fused.fused_support(topo, cfg)
             if reason_v1 is None:
                 variant, reason = "stencil", None
@@ -697,6 +705,12 @@ def run(
 
                 variant = "stencil2"
                 reason = fused_stencil.stencil2_support(topo, cfg)
+                if reason is not None:
+                    from ..ops import fused_stencil_hbm
+
+                    hbm_reason = fused_stencil_hbm.stencil_hbm_support(topo, cfg)
+                    if hbm_reason is None:
+                        variant, reason = "stencil_hbm", None
             auto_ok = reason is None and cfg.delivery == "auto"
         if cfg.engine == "fused":
             if variant != "pool" and cfg.delivery == "scatter":

@@ -1,0 +1,674 @@
+"""HBM-streaming fused stencil engine — wrap lattices past VMEM residency.
+
+ops/fused_stencil.py (the tiled VMEM engine) caps at ~1.2M nodes; beyond
+it the torus rows of BENCH_TABLES' grid-scale table used to fall back to
+the chunked XLA path (~10 ms/round at 16.8M). This engine reuses the
+HBM-streaming architecture of ops/fused_pool2.py — ping/pong state planes,
+PT-row processing tiles, mirrored-margin roll windows DMA'd at 8-aligned
+starts, mod-n blend statically elided at aligned populations — with the
+pool machinery swapped for stencil classes:
+
+- serves CONSTANT-DEGREE wrap lattices (torus3d, ring) only: their
+  per-slot displacements are pure arithmetic in the node's lattice
+  coordinates (e.g. the torus x-1 column is n-1 interior, g-1 on the x=0
+  face), so the kernel derives each tile's displacement columns from its
+  global indices in-register — no [max_deg, R, 128] neighbor planes in
+  HBM, which would otherwise dominate the streamed bytes (28 B/node of
+  structure against ~40 B of state);
+- sampling is slot = word % degree over the same threefry stream as every
+  other engine, then a branchless select over the computed columns —
+  bit-compatible with ops/sampling.targets_explicit on the builder's
+  column order (build_torus3d: x-1, x+1, y-1, y+1, z-1, z+1);
+- delivery masks the marked plane on the sampled DISPLACEMENT value per
+  static class (ops/fused_stencil's scheme) through pool2's window
+  readers, one (or two, when the pad blend is live) windows per class.
+
+HBM traffic per node per round: gossip ~36 B (p1: read active 4, write
+marked 4; p2: C marked windows 4C at C=12 -> 48... dominated by windows),
+push-sum ~180 B — still an order under the chunked path's materialized
+passes. Trajectories match the chunked stencil path bit-for-bit for
+integer state and up to compiler reassociation for push-sum — the same
+contract as every fused engine, pinned by tests/test_fused_stencil_hbm.py
+in interpret mode and tests_tpu/ on hardware.
+
+Reference mapping: the same lattice hot loop as ops/fused_stencil.py
+(program.fs:89-105, 110-143 over the Imp3D-family lattices,
+program.fs:295-306), at populations past 16M on one chip.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..config import SimConfig
+from .fused import clamp_cap_and_pad, threefry_bits_2d
+from .fused_pool import LANES, _lane_roll, build_pool_layout
+from .fused_pool2 import _copy_wait, _pick_pt
+from .topology import Topology, stencil_offsets
+
+MAX_STENCIL_HBM_NODES = 2**27
+
+
+def stencil_hbm_support(topo: Topology, cfg: SimConfig) -> Optional[str]:
+    """None if the HBM-streaming stencil engine can run this config."""
+    if topo.kind not in ("torus3d", "ring"):
+        return (
+            f"topology {topo.kind!r} is not a constant-degree wrap lattice "
+            "(torus3d/ring) with arithmetic displacement columns"
+        )
+    if cfg.dtype != "float32":
+        return "fused engine supports float32 only"
+    if not jax.config.jax_threefry_partitionable:
+        return (
+            "requires jax_threefry_partitionable=True (the in-kernel "
+            "threefry replicates the partitionable stream only)"
+        )
+    if cfg.fault_rate > 0:
+        return "fault injection not supported in the fused kernel"
+    if cfg.n_devices is not None and cfg.n_devices > 1:
+        return "fused engine is single-device"
+    if topo.n > MAX_STENCIL_HBM_NODES:
+        return (
+            f"population {topo.n} exceeds the HBM-plane budget "
+            f"({MAX_STENCIL_HBM_NODES} nodes)"
+        )
+    return None
+
+
+def _lattice_params(topo: Topology):
+    """(g, column displacement builder) for the supported lattices. The
+    builder maps a [PT, 128] global node-index tile to the list of per-slot
+    mod-n displacement columns, in the topology builder's column order."""
+    n = topo.n
+    if topo.kind == "ring":
+        def cols(idx):
+            one = jnp.full(idx.shape, 1, jnp.int32)
+            return [jnp.full(idx.shape, n - 1, jnp.int32), one]
+        return 2, cols
+    g = round(n ** (1 / 3))
+    assert g * g * g == n, "torus3d populations are perfect cubes"
+    g2 = g * g
+
+    def cols(idx):
+        x = idx % g
+        y = (idx // g) % g
+        z = idx // g2
+        i32 = jnp.int32
+        return [
+            jnp.where(x > 0, i32(n - 1), i32(g - 1)),
+            jnp.where(x < g - 1, i32(1), i32(n - (g - 1))),
+            jnp.where(y > 0, i32(n - g), i32(g * (g - 1))),
+            jnp.where(y < g - 1, i32(g), i32(n - g * (g - 1))),
+            jnp.where(z > 0, i32(n - g2), i32(g2 * (g - 1))),
+            jnp.where(z < g - 1, i32(g2), i32(n - g2 * (g - 1))),
+        ]
+    return 6, cols
+
+
+def _window_vals(wv_ref, wm_ref, off, pt, rlane, d_c, lane, interpret):
+    """Value window masked where the marked displacement equals class d_c,
+    lane-rotated — pool2's _window_contrib with displacement-keyed masks."""
+    va = wv_ref[pl.ds(off + 1, pt), :]
+    vb = wv_ref[pl.ds(off, pt), :]
+    ma = wm_ref[pl.ds(off + 1, pt), :]
+    mb = wm_ref[pl.ds(off, pt), :]
+    pa = jnp.where(ma == d_c, va, 0.0)
+    pb = jnp.where(mb == d_c, vb, 0.0)
+    return jnp.where(
+        lane >= rlane,
+        _lane_roll(pa, rlane, interpret),
+        _lane_roll(pb, rlane, interpret),
+    )
+
+
+def _window_marked(wm_ref, off, pt, rlane, lane, interpret):
+    return jnp.where(
+        lane >= rlane,
+        _lane_roll(wm_ref[pl.ds(off + 1, pt), :], rlane, interpret),
+        _lane_roll(wm_ref[pl.ds(off, pt), :], rlane, interpret),
+    )
+
+
+def make_pushsum_stencil_hbm_chunk(
+    topo: Topology, cfg: SimConfig, *, interpret: bool = False
+):
+    """ops/fused_stencil.make_pushsum_stencil2_chunk's contract —
+    ``chunk_fn(state4, keys, start, cap)`` — HBM-streamed."""
+    layout = build_pool_layout(topo.n)
+    R = layout.rows
+    N = layout.n
+    Z = layout.n_pad - layout.n
+    PT = _pick_pt(R)
+    T = R // PT
+    M = PT + 16
+    deg, col_builder = _lattice_params(topo)
+    offsets = [int(d) for d in stencil_offsets(topo)]
+    delta = np.float32(cfg.resolved_delta)
+    term_rounds = np.int32(cfg.term_rounds)
+    target = np.int32(cfg.resolved_target_count(topo.n, topo.target_count))
+
+    def kernel(
+        start_ref, keys_ref, s_in, w_in, t_in, c_in,
+        sA, wA, tA, cA, sB, wB, tB, cB, ds_p, dw_p, dm_p, meta_o,
+        scr_s, scr_w, scr_t, scr_c, scr_ds, scr_dw, scr_dm,
+        win_s, win_w, win_m, win_s2, win_w2, win_m2, flags, sems,
+    ):
+        k = pl.program_id(0)
+        K = pl.num_programs(0)
+        sem_d = sems.at[0]
+        row_l = lax.broadcasted_iota(jnp.int32, (PT, LANES), 0)
+        lane = lax.broadcasted_iota(jnp.int32, (PT, LANES), 1)
+
+        @pl.when(k == 0)
+        def _init():
+            total = jnp.int32(0)
+            for t in range(T):
+                r0 = t * PT
+                _copy_wait(s_in.at[pl.ds(r0, PT), :], scr_s, sem_d)
+                _copy_wait(w_in.at[pl.ds(r0, PT), :], scr_w, sem_d)
+                _copy_wait(t_in.at[pl.ds(r0, PT), :], scr_t, sem_d)
+                _copy_wait(c_in.at[pl.ds(r0, PT), :], scr_c, sem_d)
+                _copy_wait(scr_s, sA.at[pl.ds(r0, PT), :], sem_d)
+                _copy_wait(scr_w, wA.at[pl.ds(r0, PT), :], sem_d)
+                _copy_wait(scr_t, tA.at[pl.ds(r0, PT), :], sem_d)
+                _copy_wait(scr_c, cA.at[pl.ds(r0, PT), :], sem_d)
+                total = total + jnp.sum(scr_c[:], dtype=jnp.int32)
+            flags[0] = jnp.where(total >= target, 1, 0)
+            flags[1] = 0
+
+        active = (flags[0] == 0) & (start_ref[1] + k < start_ref[2])
+
+        def round_body(cur, nxt):
+            (s_c, w_c, t_c, c_c) = cur
+            (s_n, w_n, t_n, c_n) = nxt
+            kk = k % 8
+            k1 = keys_ref[kk, 0]
+            k2 = keys_ref[kk, 1]
+
+            def p1(t, _):
+                r0 = t * PT
+                _copy_wait(s_c.at[pl.ds(r0, PT), :], scr_s, sem_d)
+                _copy_wait(w_c.at[pl.ds(r0, PT), :], scr_w, sem_d)
+                jflat = (r0 + row_l) * LANES + lane
+                padm = jflat >= N
+                bits = threefry_bits_2d(k1, k2, PT, LANES, row0=r0)
+                slot = (bits % jnp.uint32(deg)).astype(jnp.int32)
+                cols = col_builder(jflat)
+                d = cols[0]
+                for j in range(1, deg):
+                    d = jnp.where(slot == j, cols[j], d)
+                send_ok = ~padm
+                scr_ds[:] = jnp.where(send_ok, scr_s[:] * 0.5, 0.0)
+                scr_dw[:] = jnp.where(send_ok, scr_w[:] * 0.5, 0.0)
+                scr_dm[:] = jnp.where(send_ok, d, jnp.int32(-1))
+                _copy_wait(scr_ds, ds_p.at[pl.ds(r0, PT), :], sem_d)
+                _copy_wait(scr_dw, dw_p.at[pl.ds(r0, PT), :], sem_d)
+                _copy_wait(scr_dm, dm_p.at[pl.ds(r0, PT), :], sem_d)
+
+                @pl.when(t == 0)
+                def _mirror0():
+                    _copy_wait(scr_ds, ds_p.at[pl.ds(R, PT), :], sem_d)
+                    _copy_wait(scr_dw, dw_p.at[pl.ds(R, PT), :], sem_d)
+                    _copy_wait(scr_dm, dm_p.at[pl.ds(R, PT), :], sem_d)
+
+                @pl.when(t == 1)
+                def _mirror1():
+                    _copy_wait(
+                        scr_ds.at[pl.ds(0, 16), :], ds_p.at[pl.ds(R + PT, 16), :], sem_d
+                    )
+                    _copy_wait(
+                        scr_dw.at[pl.ds(0, 16), :], dw_p.at[pl.ds(R + PT, 16), :], sem_d
+                    )
+                    _copy_wait(
+                        scr_dm.at[pl.ds(0, 16), :], dm_p.at[pl.ds(R + PT, 16), :], sem_d
+                    )
+
+                return 0
+
+            lax.fori_loop(0, T, p1, 0, unroll=False)
+
+            def p2(t, acc):
+                r0 = t * PT
+                _copy_wait(s_c.at[pl.ds(r0, PT), :], scr_s, sem_d)
+                _copy_wait(w_c.at[pl.ds(r0, PT), :], scr_w, sem_d)
+                _copy_wait(t_c.at[pl.ds(r0, PT), :], scr_t, sem_d)
+                _copy_wait(c_c.at[pl.ds(r0, PT), :], scr_c, sem_d)
+                jflat = (r0 + row_l) * LANES + lane
+                padm = jflat >= N
+                inbox_s = jnp.zeros((PT, LANES), jnp.float32)
+                inbox_w = jnp.zeros((PT, LANES), jnp.float32)
+
+                def fetch(e, ws_ref, ww_ref, wm_ref, sem_base):
+                    # Start the class's three (or six, with the blend's
+                    # second variant) window copies together and wait once:
+                    # serialized start/wait pairs leave each ~1 MB
+                    # transfer's latency exposed (the gossip kernel's
+                    # measured lesson below).
+                    q = e // LANES
+                    ws_raw = lax.rem(
+                        r0 - q - jnp.int32(1) + jnp.int32(2 * R), jnp.int32(R)
+                    )
+                    ws8 = (ws_raw // 8) * 8  # aligned DMA start
+                    cps = [
+                        pltpu.make_async_copy(
+                            ds_p.at[pl.ds(ws8, PT + 16), :], ws_ref,
+                            sems.at[sem_base],
+                        ),
+                        pltpu.make_async_copy(
+                            dw_p.at[pl.ds(ws8, PT + 16), :], ww_ref,
+                            sems.at[sem_base + 1],
+                        ),
+                        pltpu.make_async_copy(
+                            dm_p.at[pl.ds(ws8, PT + 16), :], wm_ref,
+                            sems.at[sem_base + 2],
+                        ),
+                    ]
+                    for cp in cps:
+                        cp.start()
+                    return (e % LANES, ws_raw - ws8), cps
+
+                for d_c in offsets:
+                    if Z == 0:
+                        (rl, off), cps = fetch(
+                            jnp.int32(d_c), win_s, win_w, win_m, 0
+                        )
+                        for cp in cps:
+                            cp.wait()
+                        cs = _window_vals(
+                            win_s, win_m, off, PT, rl, d_c, lane, interpret
+                        )
+                        cw = _window_vals(
+                            win_w, win_m, off, PT, rl, d_c, lane, interpret
+                        )
+                    else:
+                        (rl, off), cps = fetch(
+                            jnp.int32(d_c), win_s, win_w, win_m, 0
+                        )
+                        (rl2, off2), cps2 = fetch(
+                            jnp.int32(d_c + Z), win_s2, win_w2, win_m2, 3
+                        )
+                        for cp in cps + cps2:
+                            cp.wait()
+                        take = jflat >= d_c
+                        cs = jnp.where(
+                            take,
+                            _window_vals(
+                                win_s, win_m, off, PT, rl, d_c, lane, interpret
+                            ),
+                            _window_vals(
+                                win_s2, win_m2, off2, PT, rl2, d_c, lane, interpret
+                            ),
+                        )
+                        cw = jnp.where(
+                            take,
+                            _window_vals(
+                                win_w, win_m, off, PT, rl, d_c, lane, interpret
+                            ),
+                            _window_vals(
+                                win_w2, win_m2, off2, PT, rl2, d_c, lane, interpret
+                            ),
+                        )
+                    inbox_s = inbox_s + cs
+                    inbox_w = inbox_w + cw
+                inbox_s = jnp.where(padm, 0.0, inbox_s)
+                inbox_w = jnp.where(padm, 0.0, inbox_w)
+                s_t = scr_s[:]
+                w_t = scr_w[:]
+                s_send = jnp.where(padm, 0.0, s_t * 0.5)
+                w_send = jnp.where(padm, 0.0, w_t * 0.5)
+                s_new = (s_t - s_send) + inbox_s
+                w_new = (w_t - w_send) + inbox_w
+                received = inbox_w > 0
+                stable = jnp.abs(s_new / w_new - s_t / w_t) <= delta
+                term_new = jnp.where(
+                    received,
+                    jnp.where(stable, scr_t[:] + 1, jnp.int32(0)),
+                    scr_t[:],
+                )
+                conv_new = jnp.where(
+                    padm,
+                    jnp.int32(0),
+                    jnp.where(
+                        (scr_c[:] != 0) | (term_new >= term_rounds),
+                        jnp.int32(1),
+                        jnp.int32(0),
+                    ),
+                )
+                scr_s[:] = s_new
+                scr_w[:] = w_new
+                scr_t[:] = term_new
+                scr_c[:] = conv_new
+                _copy_wait(scr_s, s_n.at[pl.ds(r0, PT), :], sem_d)
+                _copy_wait(scr_w, w_n.at[pl.ds(r0, PT), :], sem_d)
+                _copy_wait(scr_t, t_n.at[pl.ds(r0, PT), :], sem_d)
+                _copy_wait(scr_c, c_n.at[pl.ds(r0, PT), :], sem_d)
+                return acc + jnp.sum(conv_new, dtype=jnp.int32)
+
+            total = lax.fori_loop(0, T, p2, jnp.int32(0), unroll=False)
+            flags[1] = flags[1] + 1
+            flags[0] = jnp.where(total >= target, 1, 0)
+
+        A = (sA, wA, tA, cA)
+        B = (sB, wB, tB, cB)
+        par = flags[1] % 2  # snapshot before the mutating branches
+
+        @pl.when(active & (par == 0))
+        def _round_even():
+            round_body(A, B)
+
+        @pl.when(active & (par == 1))
+        def _round_odd():
+            round_body(B, A)
+
+        @pl.when(k == K - 1)
+        def _emit():
+            meta_o[0] = flags[1]
+            meta_o[1] = flags[1] % 2
+
+    def chunk_fn(state4, keys, start, cap):
+        s, w, t, c = state4
+        cap, keys = clamp_cap_and_pad(start, cap, keys)
+        K = keys.shape[0]
+        f32 = jax.ShapeDtypeStruct((R, LANES), jnp.float32)
+        i32 = jax.ShapeDtypeStruct((R, LANES), jnp.int32)
+        f32m = jax.ShapeDtypeStruct((R + M, LANES), jnp.float32)
+        i32m = jax.ShapeDtypeStruct((R + M, LANES), jnp.int32)
+        outs = pl.pallas_call(
+            kernel,
+            grid=(K,),
+            out_shape=(
+                f32, f32, i32, i32,
+                f32, f32, i32, i32,
+                f32m, f32m, i32m,
+                jax.ShapeDtypeStruct((2,), jnp.int32),
+            ),
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec((8, 2), lambda k: (k // 8, 0), memory_space=pltpu.SMEM),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=tuple(
+                [pl.BlockSpec(memory_space=pl.ANY)] * 11
+                + [pl.BlockSpec(memory_space=pltpu.SMEM)]
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((PT, LANES), jnp.float32),
+                pltpu.VMEM((PT, LANES), jnp.float32),
+                pltpu.VMEM((PT, LANES), jnp.int32),
+                pltpu.VMEM((PT, LANES), jnp.int32),
+                pltpu.VMEM((PT, LANES), jnp.float32),
+                pltpu.VMEM((PT, LANES), jnp.float32),
+                pltpu.VMEM((PT, LANES), jnp.int32),
+                pltpu.VMEM((PT + 16, LANES), jnp.float32),
+                pltpu.VMEM((PT + 16, LANES), jnp.float32),
+                pltpu.VMEM((PT + 16, LANES), jnp.int32),
+                pltpu.VMEM((PT + 16, LANES), jnp.float32),
+                pltpu.VMEM((PT + 16, LANES), jnp.float32),
+                pltpu.VMEM((PT + 16, LANES), jnp.int32),
+                pltpu.SMEM((2,), jnp.int32),
+                pltpu.SemaphoreType.DMA((6,)),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=96 * 1024 * 1024
+            ),
+            interpret=interpret,
+        )(
+            jnp.stack([jnp.int32(0), jnp.int32(start), jnp.int32(cap)]),
+            keys,
+            s, w, t, c,
+        )
+        meta = outs[11]
+        parity = meta[1]
+
+        def sel(a, b):
+            return jnp.where(parity == 0, a, b)
+
+        state_out = tuple(sel(outs[i], outs[4 + i]) for i in range(4))
+        return state_out, meta[0]
+
+    return chunk_fn, layout
+
+
+def make_gossip_stencil_hbm_chunk(
+    topo: Topology, cfg: SimConfig, *, interpret: bool = False
+):
+    """Gossip analog: one marked-displacement plane; receiver-side
+    suppression on the streamed conv tile."""
+    layout = build_pool_layout(topo.n)
+    R = layout.rows
+    N = layout.n
+    Z = layout.n_pad - layout.n
+    PT = _pick_pt(R)
+    T = R // PT
+    M = PT + 16
+    deg, col_builder = _lattice_params(topo)
+    offsets = [int(d) for d in stencil_offsets(topo)]
+    rumor_target = np.int32(cfg.resolved_rumor_target)
+    suppress = cfg.resolved_suppress
+    target = np.int32(cfg.resolved_target_count(topo.n, topo.target_count))
+
+    def kernel(
+        start_ref, keys_ref, n_in, a_in, c_in,
+        nA, aA, cA, nB, aB, cB, dm_p, meta_o,
+        scr_n, scr_a, scr_c, scr_m, win_all, flags, sems, wsems,
+    ):
+        k = pl.program_id(0)
+        K = pl.num_programs(0)
+        sem_d = sems.at[0]
+        row_l = lax.broadcasted_iota(jnp.int32, (PT, LANES), 0)
+        lane = lax.broadcasted_iota(jnp.int32, (PT, LANES), 1)
+
+        @pl.when(k == 0)
+        def _init():
+            total = jnp.int32(0)
+            for t in range(T):
+                r0 = t * PT
+                _copy_wait(n_in.at[pl.ds(r0, PT), :], scr_n, sem_d)
+                _copy_wait(a_in.at[pl.ds(r0, PT), :], scr_a, sem_d)
+                _copy_wait(c_in.at[pl.ds(r0, PT), :], scr_c, sem_d)
+                _copy_wait(scr_n, nA.at[pl.ds(r0, PT), :], sem_d)
+                _copy_wait(scr_a, aA.at[pl.ds(r0, PT), :], sem_d)
+                _copy_wait(scr_c, cA.at[pl.ds(r0, PT), :], sem_d)
+                total = total + jnp.sum(scr_c[:], dtype=jnp.int32)
+            flags[0] = jnp.where(total >= target, 1, 0)
+            flags[1] = 0
+
+        active = (flags[0] == 0) & (start_ref[1] + k < start_ref[2])
+
+        def round_body(cur, nxt):
+            (n_c, a_c, c_c) = cur
+            (n_n, a_n, c_n) = nxt
+            kk = k % 8
+            k1 = keys_ref[kk, 0]
+            k2 = keys_ref[kk, 1]
+
+            def p1(t, _):
+                r0 = t * PT
+                _copy_wait(a_c.at[pl.ds(r0, PT), :], scr_a, sem_d)
+                jflat = (r0 + row_l) * LANES + lane
+                padm = jflat >= N
+                bits = threefry_bits_2d(k1, k2, PT, LANES, row0=r0)
+                slot = (bits % jnp.uint32(deg)).astype(jnp.int32)
+                cols = col_builder(jflat)
+                d = cols[0]
+                for j in range(1, deg):
+                    d = jnp.where(slot == j, cols[j], d)
+                sending = (scr_a[:] != 0) & ~padm
+                scr_m[:] = jnp.where(sending, d, jnp.int32(-1))
+                _copy_wait(scr_m, dm_p.at[pl.ds(r0, PT), :], sem_d)
+
+                @pl.when(t == 0)
+                def _mirror0():
+                    _copy_wait(scr_m, dm_p.at[pl.ds(R, PT), :], sem_d)
+
+                @pl.when(t == 1)
+                def _mirror1():
+                    _copy_wait(
+                        scr_m.at[pl.ds(0, 16), :], dm_p.at[pl.ds(R + PT, 16), :], sem_d
+                    )
+
+                return 0
+
+            lax.fori_loop(0, T, p1, 0, unroll=False)
+
+            def p2(t, acc):
+                r0 = t * PT
+                _copy_wait(n_c.at[pl.ds(r0, PT), :], scr_n, sem_d)
+                _copy_wait(a_c.at[pl.ds(r0, PT), :], scr_a, sem_d)
+                _copy_wait(c_c.at[pl.ds(r0, PT), :], scr_c, sem_d)
+                jflat = (r0 + row_l) * LANES + lane
+                padm = jflat >= N
+                inbox = jnp.zeros((PT, LANES), jnp.int32)
+
+                # Start EVERY class window's DMA before waiting on any:
+                # serialized start/wait pairs leave each ~1 MB transfer's
+                # latency exposed and made this p2 DMA-latency-bound
+                # (measured ~4 ms/round at 16.8M vs ~0.7 ms of traffic).
+                def win_params(e):
+                    q = e // LANES
+                    ws_raw = lax.rem(
+                        r0 - q - jnp.int32(1) + jnp.int32(2 * R), jnp.int32(R)
+                    )
+                    ws8 = (ws_raw // 8) * 8
+                    return ws8, e % LANES, ws_raw - ws8
+
+                plans = []
+                cps = []
+                for ci, d_c in enumerate(offsets):
+                    es = (jnp.int32(d_c),) if Z == 0 else (
+                        jnp.int32(d_c), jnp.int32(d_c + Z)
+                    )
+                    for vi, e in enumerate(es):
+                        ws8, rl, off = win_params(e)
+                        slot = ci * len(es) + vi
+                        cp = pltpu.make_async_copy(
+                            dm_p.at[pl.ds(ws8, PT + 16), :],
+                            win_all.at[slot], wsems.at[slot],
+                        )
+                        cp.start()
+                        cps.append(cp)
+                        plans.append((rl, off))
+                for cp in cps:
+                    cp.wait()
+
+                for ci, d_c in enumerate(offsets):
+                    stride = 1 if Z == 0 else 2
+                    rl, off = plans[ci * stride]
+                    ga = _window_marked(
+                        win_all.at[ci * stride], off, PT, rl, lane, interpret
+                    )
+                    if Z == 0:
+                        g = ga
+                    else:
+                        rl2, off2 = plans[ci * stride + 1]
+                        g = jnp.where(
+                            jflat >= d_c,
+                            ga,
+                            _window_marked(
+                                win_all.at[ci * stride + 1], off2, PT, rl2,
+                                lane, interpret,
+                            ),
+                        )
+                    inbox = inbox + jnp.where(g == d_c, jnp.int32(1), jnp.int32(0))
+                inbox = jnp.where(padm, jnp.int32(0), inbox)
+                if suppress:
+                    inbox = jnp.where(scr_c[:] != 0, jnp.int32(0), inbox)
+                count_new = scr_n[:] + inbox
+                active_new = jnp.where(
+                    (scr_a[:] != 0) | (inbox > 0), jnp.int32(1), jnp.int32(0)
+                )
+                conv_new = jnp.where(
+                    count_new >= rumor_target, jnp.int32(1), jnp.int32(0)
+                )
+                scr_n[:] = count_new
+                scr_a[:] = active_new
+                scr_c[:] = conv_new
+                _copy_wait(scr_n, n_n.at[pl.ds(r0, PT), :], sem_d)
+                _copy_wait(scr_a, a_n.at[pl.ds(r0, PT), :], sem_d)
+                _copy_wait(scr_c, c_n.at[pl.ds(r0, PT), :], sem_d)
+                return acc + jnp.sum(conv_new, dtype=jnp.int32)
+
+            total = lax.fori_loop(0, T, p2, jnp.int32(0), unroll=False)
+            flags[1] = flags[1] + 1
+            flags[0] = jnp.where(total >= target, 1, 0)
+
+        A = (nA, aA, cA)
+        B = (nB, aB, cB)
+        par = flags[1] % 2
+
+        @pl.when(active & (par == 0))
+        def _round_even():
+            round_body(A, B)
+
+        @pl.when(active & (par == 1))
+        def _round_odd():
+            round_body(B, A)
+
+        @pl.when(k == K - 1)
+        def _emit():
+            meta_o[0] = flags[1]
+            meta_o[1] = flags[1] % 2
+
+    def chunk_fn(state3, keys, start, cap):
+        cnt, act, cv = state3
+        cap, keys = clamp_cap_and_pad(start, cap, keys)
+        i32 = jax.ShapeDtypeStruct((R, LANES), jnp.int32)
+        i32m = jax.ShapeDtypeStruct((R + M, LANES), jnp.int32)
+        outs = pl.pallas_call(
+            kernel,
+            grid=(keys.shape[0],),
+            out_shape=(
+                i32, i32, i32, i32, i32, i32, i32m,
+                jax.ShapeDtypeStruct((2,), jnp.int32),
+            ),
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec((8, 2), lambda k: (k // 8, 0), memory_space=pltpu.SMEM),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=tuple(
+                [pl.BlockSpec(memory_space=pl.ANY)] * 7
+                + [pl.BlockSpec(memory_space=pltpu.SMEM)]
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((PT, LANES), jnp.int32),
+                pltpu.VMEM((PT, LANES), jnp.int32),
+                pltpu.VMEM((PT, LANES), jnp.int32),
+                pltpu.VMEM((PT, LANES), jnp.int32),
+                pltpu.VMEM((len(offsets) * (1 if Z == 0 else 2), PT + 16, LANES), jnp.int32),
+                pltpu.SMEM((2,), jnp.int32),
+                pltpu.SemaphoreType.DMA((1,)),
+                pltpu.SemaphoreType.DMA((len(offsets) * (1 if Z == 0 else 2),)),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=96 * 1024 * 1024
+            ),
+            interpret=interpret,
+        )(
+            jnp.stack([jnp.int32(0), jnp.int32(start), jnp.int32(cap)]),
+            keys,
+            cnt, act, cv,
+        )
+        meta = outs[7]
+        parity = meta[1]
+
+        def sel(a, b):
+            return jnp.where(parity == 0, a, b)
+
+        state_out = tuple(sel(outs[i], outs[3 + i]) for i in range(3))
+        return state_out, meta[0]
+
+    return chunk_fn, layout

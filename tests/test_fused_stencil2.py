@@ -102,13 +102,21 @@ def test_stencil2_support_gating():
     assert "displacement" in fused_stencil.stencil2_support(
         topo, _cfg(1000, "imp3d")
     )
-    # Budget: a torus past the VMEM plane budget is refused with the reason.
+    # Budget: a torus past the VMEM plane budget is refused with the reason
+    # — and the HBM-streaming stencil tier picks it up instead of the old
+    # hard failure (ops/fused_stencil_hbm.py).
+    from cop5615_gossip_protocol_tpu.ops import fused_stencil_hbm
+
     big = build_topology("torus3d", 8_000_000)
     assert "budget" in fused_stencil.stencil2_support(
         big, _cfg(8_000_000, "torus3d")
     )
+    assert fused_stencil_hbm.stencil_hbm_support(
+        big, _cfg(8_000_000, "torus3d")
+    ) is None
+    # A config no fused tier serves (fault injection) still fails loudly.
     with pytest.raises(ValueError, match="unavailable"):
-        run(big, _cfg(8_000_000, "torus3d"))
+        run(big, _cfg(8_000_000, "torus3d", fault_rate=0.1))
 
 
 def test_v1_still_preferred_where_eligible(monkeypatch):
