@@ -81,6 +81,11 @@ POINTS = (
      52 + 12 * 2,
      "ping/pong planes + per-slot roll windows; DMA-issue overhead and "
      "the p1/p2 split account for the rest"),
+    ("stencil hbm", "torus3d", "push-sum", 16_777_216,
+     dict(delivery="stencil", engine="fused"), "HBM-streaming",
+     40 + 12 * 12,
+     "12 displacement classes x 3-plane windows dominate; the arithmetic "
+     "in-kernel columns keep the neighbor structure out of HBM entirely"),
 )
 
 
