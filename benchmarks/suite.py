@@ -316,7 +316,8 @@ NORTHSTAR_CONFIGS = (
     # in the grid-scale table's imp3d static/scatter rows)
     (100_000, "imp2d", "push-sum", "pool", None),
     (1_000_000, "full", "gossip", "pool", None),
-    (10_000_000, "torus3d", "push-sum", "stencil", 2_000),
+    (10_000_000, "torus3d", "push-sum", "auto", 2_000),  # auto routes the
+    # fused stencil tiers; an explicit delivery pin would keep auto_ok off
 )
 
 
