@@ -711,7 +711,11 @@ def run(
                     hbm_reason = fused_stencil_hbm.stencil_hbm_support(topo, cfg)
                     if hbm_reason is None:
                         variant, reason = "stencil_hbm", None
-            auto_ok = reason is None and cfg.delivery == "auto"
+            # Explicit delivery='stencil' is the same formulation the fused
+            # stencil engines execute — it participates in auto-fusing just
+            # like explicit delivery='pool' does on the pool branch (only
+            # 'scatter' pins the XLA path).
+            auto_ok = reason is None and cfg.delivery in ("auto", "stencil")
         if cfg.engine == "fused":
             if variant != "pool" and cfg.delivery == "scatter":
                 raise ValueError(
