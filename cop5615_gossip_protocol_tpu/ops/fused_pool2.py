@@ -129,6 +129,20 @@ def _copy_wait(src, dst, sem):
     cp.wait()
 
 
+def _copy_all(pairs, sems):
+    """Start every copy, then wait on all — overlapped transfers instead
+    of serialized start/wait pairs, whose exposed ~1 MB latencies made the
+    streamed phases DMA-latency-bound (the stencil-hbm lesson)."""
+    cps = [
+        pltpu.make_async_copy(s, d, sems.at[i])
+        for i, (s, d) in enumerate(pairs)
+    ]
+    for c in cps:
+        c.start()
+    for c in cps:
+        c.wait()
+
+
 def _window_contrib(wv_ref, wc_ref, off, pt, rlane, slot, lane, interpret):
     """Contribution of one roll window to the inbox tile. The window buffer
     was DMA'd from the 8-aligned row ws8; ``off`` is the sub-8 remainder, so
@@ -219,16 +233,20 @@ def make_pushsum_pool2_chunk(
 
             def p1(t, _):
                 r0 = t * PT
-                _copy_wait(s_c.at[pl.ds(r0, PT), :], scr_s, sem_d)
-                _copy_wait(w_c.at[pl.ds(r0, PT), :], scr_w, sem_d)
+                _copy_all([
+                    (s_c.at[pl.ds(r0, PT), :], scr_s),
+                    (w_c.at[pl.ds(r0, PT), :], scr_w),
+                ], sems)
                 choice = _choice_tile_pt(k1, k2, r0, PT, P)
                 padm = (r0 + row_l) * LANES + lane >= N
                 scr_ds[:] = jnp.where(padm, 0.0, scr_s[:] * 0.5)
                 scr_dw[:] = jnp.where(padm, 0.0, scr_w[:] * 0.5)
                 scr_dc[:] = choice
-                _copy_wait(scr_ds, ds_p.at[pl.ds(r0, PT), :], sem_d)
-                _copy_wait(scr_dw, dw_p.at[pl.ds(r0, PT), :], sem_d)
-                _copy_wait(scr_dc, dc_p.at[pl.ds(r0, PT), :], sem_d)
+                _copy_all([
+                    (scr_ds, ds_p.at[pl.ds(r0, PT), :]),
+                    (scr_dw, dw_p.at[pl.ds(r0, PT), :]),
+                    (scr_dc, dc_p.at[pl.ds(r0, PT), :]),
+                ], sems)
 
                 @pl.when(t == 0)
                 def _mirror0():
@@ -254,10 +272,12 @@ def make_pushsum_pool2_chunk(
 
             def p2(t, acc):
                 r0 = t * PT
-                _copy_wait(s_c.at[pl.ds(r0, PT), :], scr_s, sem_d)
-                _copy_wait(w_c.at[pl.ds(r0, PT), :], scr_w, sem_d)
-                _copy_wait(t_c.at[pl.ds(r0, PT), :], scr_t, sem_d)
-                _copy_wait(c_c.at[pl.ds(r0, PT), :], scr_c, sem_d)
+                _copy_all([
+                    (s_c.at[pl.ds(r0, PT), :], scr_s),
+                    (w_c.at[pl.ds(r0, PT), :], scr_w),
+                    (t_c.at[pl.ds(r0, PT), :], scr_t),
+                    (c_c.at[pl.ds(r0, PT), :], scr_c),
+                ], sems)
                 jflat = (r0 + row_l) * LANES + lane
                 padm = jflat >= N
                 inbox_s = jnp.zeros((PT, LANES), jnp.float32)
@@ -274,9 +294,11 @@ def make_pushsum_pool2_chunk(
                             r0 - q - jnp.int32(1) + jnp.int32(2 * R), jnp.int32(R)
                         )
                         ws8 = (ws_raw // 8) * 8
-                        _copy_wait(ds_p.at[pl.ds(ws8, PT + 16), :], ws_ref, sem_d)
-                        _copy_wait(dw_p.at[pl.ds(ws8, PT + 16), :], ww_ref, sem_d)
-                        _copy_wait(dc_p.at[pl.ds(ws8, PT + 16), :], wc_ref, sem_d)
+                        _copy_all([
+                            (ds_p.at[pl.ds(ws8, PT + 16), :], ws_ref),
+                            (dw_p.at[pl.ds(ws8, PT + 16), :], ww_ref),
+                            (dc_p.at[pl.ds(ws8, PT + 16), :], wc_ref),
+                        ], sems)
                         return e % LANES, ws_raw - ws8
 
                     if Z == 0:
@@ -342,10 +364,12 @@ def make_pushsum_pool2_chunk(
                 scr_w[:] = w_new
                 scr_t[:] = term_new
                 scr_c[:] = conv_new
-                _copy_wait(scr_s, s_n.at[pl.ds(r0, PT), :], sem_d)
-                _copy_wait(scr_w, w_n.at[pl.ds(r0, PT), :], sem_d)
-                _copy_wait(scr_t, t_n.at[pl.ds(r0, PT), :], sem_d)
-                _copy_wait(scr_c, c_n.at[pl.ds(r0, PT), :], sem_d)
+                _copy_all([
+                    (scr_s, s_n.at[pl.ds(r0, PT), :]),
+                    (scr_w, w_n.at[pl.ds(r0, PT), :]),
+                    (scr_t, t_n.at[pl.ds(r0, PT), :]),
+                    (scr_c, c_n.at[pl.ds(r0, PT), :]),
+                ], sems)
                 return acc + jnp.sum(conv_new, dtype=jnp.int32)
 
             total = lax.fori_loop(0, T, p2, jnp.int32(0), unroll=False)
@@ -417,7 +441,7 @@ def make_pushsum_pool2_chunk(
                 pltpu.VMEM((PT + 16, LANES), jnp.float32),
                 pltpu.VMEM((PT + 16, LANES), jnp.int32),
                 pltpu.SMEM((2,), jnp.int32),
-                pltpu.SemaphoreType.DMA((1,)),
+                pltpu.SemaphoreType.DMA((4,)),
             ],
             compiler_params=pltpu.CompilerParams(
                 vmem_limit_bytes=96 * 1024 * 1024
@@ -520,9 +544,11 @@ def make_gossip_pool2_chunk(
 
             def p2(t, acc):
                 r0 = t * PT
-                _copy_wait(n_c.at[pl.ds(r0, PT), :], scr_n, sem_d)
-                _copy_wait(a_c.at[pl.ds(r0, PT), :], scr_a, sem_d)
-                _copy_wait(c_c.at[pl.ds(r0, PT), :], scr_c, sem_d)
+                _copy_all([
+                    (n_c.at[pl.ds(r0, PT), :], scr_n),
+                    (a_c.at[pl.ds(r0, PT), :], scr_a),
+                    (c_c.at[pl.ds(r0, PT), :], scr_c),
+                ], sems)
                 jflat = (r0 + row_l) * LANES + lane
                 padm = jflat >= N
                 inbox = jnp.zeros((PT, LANES), jnp.int32)
@@ -563,9 +589,11 @@ def make_gossip_pool2_chunk(
                 scr_n[:] = count_new
                 scr_a[:] = active_new
                 scr_c[:] = conv_new
-                _copy_wait(scr_n, n_n.at[pl.ds(r0, PT), :], sem_d)
-                _copy_wait(scr_a, a_n.at[pl.ds(r0, PT), :], sem_d)
-                _copy_wait(scr_c, c_n.at[pl.ds(r0, PT), :], sem_d)
+                _copy_all([
+                    (scr_n, n_n.at[pl.ds(r0, PT), :]),
+                    (scr_a, a_n.at[pl.ds(r0, PT), :]),
+                    (scr_c, c_n.at[pl.ds(r0, PT), :]),
+                ], sems)
                 return acc + jnp.sum(conv_new, dtype=jnp.int32)
 
             total = lax.fori_loop(0, T, p2, jnp.int32(0), unroll=False)
@@ -621,7 +649,7 @@ def make_gossip_pool2_chunk(
                 pltpu.VMEM((PT + 16, LANES), jnp.int32),
                 pltpu.VMEM((PT + 16, LANES), jnp.int32),
                 pltpu.SMEM((2,), jnp.int32),
-                pltpu.SemaphoreType.DMA((1,)),
+                pltpu.SemaphoreType.DMA((4,)),
             ],
             compiler_params=pltpu.CompilerParams(
                 vmem_limit_bytes=96 * 1024 * 1024
