@@ -124,3 +124,17 @@ def test_ring_eight_devices_counts_match():
                              max_rounds=60))
     assert r1.rounds == r8.rounds
     assert r1.converged_count == r8.converged_count
+
+
+def test_gossip_grid2d_cr1_bitwise():
+    # Non-wrap lattice: the engine's blend handles boundary-truncated
+    # displacement classes too, not just wrap topologies.
+    n = 131044  # 362^2 -> 1024-row layout -> two 512-row shards
+    topo = build_topology("grid2d", n)
+    r1 = run(topo, SimConfig(n=n, topology="grid2d", algorithm="gossip",
+                             engine="chunked", max_rounds=5000))
+    r2 = run(topo, SimConfig(n=n, topology="grid2d", algorithm="gossip",
+                             engine="fused", n_devices=2, chunk_rounds=1,
+                             max_rounds=5000))
+    assert r1.rounds == r2.rounds
+    assert r1.converged_count == r2.converged_count
