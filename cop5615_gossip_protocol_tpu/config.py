@@ -227,7 +227,7 @@ class SimConfig:
         if (
             self.dtype == "bfloat16"
             and self.algorithm == "push-sum"
-            and self.topology in ("line", "ring", "2d", "ref2d")
+            and self.topology in ("line", "ring", "ref2d")
         ):
             # Measured (tests/test_bfloat16.py preamble): on 1-D chains the
             # bf16 ratio latches stable after ~O(n) rounds while mixing

@@ -65,7 +65,7 @@ def halve_and_send(s, w, send_ok):
 
 
 def absorb(state: PushSumState, s_keep, w_keep, inbox_s, inbox_w, delta,
-           term_rounds, global_termination: bool = False):
+           term_rounds, global_termination: bool = False, valid=None):
     """Absorb one round of deliveries and advance the termination counters.
 
     Mirrors the ComputePushSum handler (program.fs:119-143): ratio change is
@@ -84,6 +84,15 @@ def absorb(state: PushSumState, s_keep, w_keep, inbox_s, inbox_w, delta,
     never fire at float32. Non-receiving nodes have Δ = 0 and never block.
     Under node sharding each shard's all() composes with the runner's
     sum(conv) >= n predicate into the global all() exactly.
+
+    ``valid`` (optional [n] bool) masks padded slots out of the global
+    latch: pad lanes have Δ = 0 so they never *block* the all(), but the
+    broadcast must not mark them converged — that would inflate
+    converged_count by the pad count (and in degenerate meshes with
+    n_pad - n_loc >= n could fire the psum predicate with a shard still
+    unstable) and break the estimate_mae gate, which relies on pad slots
+    never converging. Single-device callers have no padding and leave it
+    None.
     """
     s_new = s_keep + inbox_s
     w_new = w_keep + inbox_w
@@ -97,6 +106,8 @@ def absorb(state: PushSumState, s_keep, w_keep, inbox_s, inbox_w, delta,
         )
         stable_g = jnp.abs(ratio_new - ratio_old) <= tol
         conv_new = jnp.broadcast_to(jnp.all(stable_g), state.conv.shape)
+        if valid is not None:
+            conv_new = conv_new & valid
         return PushSumState(s=s_new, w=w_new, term=state.term, conv=conv_new)
     term_new = jnp.where(
         received, jnp.where(stable, state.term + 1, 0), state.term

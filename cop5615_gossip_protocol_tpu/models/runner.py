@@ -607,6 +607,16 @@ def run(
     """
     if key is None:
         key = jax.random.PRNGKey(cfg.seed)
+    if cfg.termination == "global" and cfg.engine == "fused":
+        # Hoisted ABOVE the sharded dispatch (ADVICE r3): fused_sharded
+        # implements the reference's local latch only — without this a
+        # sharded fused run with termination='global' would silently
+        # execute the wrong criterion while the single-device path raised.
+        raise ValueError(
+            "termination='global' runs on the chunked engine (the fused "
+            "kernels implement the reference's local latch); drop the "
+            "engine override"
+        )
     if cfg.n_devices is not None and cfg.n_devices > 1:
         if cfg.reference and cfg.algorithm == "push-sum":
             raise ValueError(
@@ -655,12 +665,6 @@ def run(
         # round (one send per informed node per round) already models.
         return _run_reference_walk(topo, cfg, key, target)
 
-    if cfg.termination == "global" and cfg.engine == "fused":
-        raise ValueError(
-            "termination='global' runs on the chunked engine (the fused "
-            "kernels implement the reference's local latch); drop the "
-            "engine override"
-        )
     if cfg.engine != "chunked" and cfg.termination != "global":
         # Two Pallas engines share one dispatch: the pool engine for pool
         # delivery on the implicit full topology (ops/fused_pool.py — the

@@ -65,7 +65,7 @@ def deliver_imp_pool(channels, d_sampled, is_extra, choice,
     L static + K dynamic masked circular shifts — no scatter, no gather:
 
         inbox = sum over lattice classes q of
-                    roll(channels * [d_sampled == off_q and not extra], off_q)
+                    roll(channels * [d_sampled == off_q], off_q)
               + sum over pool slots k of
                     roll(channels * [extra and choice == k], pool_offs[k])
 
@@ -73,17 +73,18 @@ def deliver_imp_pool(channels, d_sampled, is_extra, choice,
     per-node sampled modular displacement (-1 on the extra slot, so it can
     never alias a lattice class); ``is_extra`` whether the node sampled its
     long-range slot; ``choice`` its pool slot. Each sent value lands in
-    exactly one shift: lattice masks exclude extra senders, pool masks
-    require them. Accumulation order is static (lattice classes in sorted
+    exactly one shift: extra senders carry d_sampled = -1, which never
+    aliases a lattice class, so the class masks exclude them by
+    construction; pool masks require them. Accumulation order is static
+    (lattice classes in sorted
     order, then pool slots), so results are deterministic given the seed;
     equivalence with a scatter-add over the materialized targets is pinned
     by tests/test_imp_pool.py.
     """
     inbox = jnp.zeros_like(channels)
     zero = jnp.zeros((), channels.dtype)
-    not_extra = ~is_extra
     for q in lattice_offsets:
-        m = (d_sampled == q) & not_extra
+        m = d_sampled == q
         inbox = inbox + jnp.roll(jnp.where(m[None, :], channels, zero), int(q), axis=1)
     for k in range(pool_offs.shape[0]):
         m = is_extra & (choice == k)

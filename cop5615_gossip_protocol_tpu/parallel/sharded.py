@@ -353,8 +353,9 @@ def run_sharded(
         if imp_plan is not None:
 
             def round_fn(state, round_idx, key_data, *targs):
+                disp_loc, deg_loc, valid_loc = targs
                 d, is_extra, choice, offs, send_ok = imp_parts(
-                    round_idx, key_data, *targs
+                    round_idx, key_data, disp_loc, deg_loc, valid_loc
                 )
                 s_send, w_send, s_keep, w_keep = pushsum_mod.halve_and_send(
                     state.s, state.w, send_ok
@@ -365,6 +366,7 @@ def run_sharded(
                 return pushsum_mod.absorb(
                     state, s_keep, w_keep, inbox[0], inbox[1], delta,
                     term_rounds, cfg.termination == "global",
+                    valid=valid_loc,
                 )
 
         elif pool_roll:
@@ -381,13 +383,13 @@ def run_sharded(
                 )
                 return pushsum_mod.absorb(
                     state, s_keep, w_keep, inbox[0], inbox[1], delta, term_rounds,
-                    cfg.termination == "global",
+                    cfg.termination == "global", valid=valid_loc,
                 )
 
         else:
 
             def round_fn(state, round_idx, key_data, *targs):
-                targets, send_ok, _, gids = targets_and_gate(
+                targets, send_ok, valid_loc, gids = targets_and_gate(
                     round_idx, key_data, *targs
                 )
                 s_send, w_send, s_keep, w_keep = pushsum_mod.halve_and_send(
@@ -402,7 +404,7 @@ def run_sharded(
                 inbox_s, inbox_w = inbox[0], inbox[1]
                 return pushsum_mod.absorb(
                     state, s_keep, w_keep, inbox_s, inbox_w, delta, term_rounds,
-                    cfg.termination == "global",
+                    cfg.termination == "global", valid=valid_loc,
                 )
 
         s0 = np.arange(n_pad, dtype=dtype)

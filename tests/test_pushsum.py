@@ -198,3 +198,21 @@ def test_global_termination_sharded_composes():
     # Halo delivery preserves accumulation order; the global residual flag
     # composes across shards into the same stop round.
     assert r8.rounds == r1.rounds
+
+
+def test_global_termination_sharded_pad_exact_count():
+    # ADVICE r3: with n not a device multiple, the global-latch broadcast
+    # must not mark pad slots converged — converged_count is exactly n (not
+    # n_pad) and the estimate gate sees only real nodes.
+    from cop5615_gossip_protocol_tpu import SimConfig, build_topology
+    from cop5615_gossip_protocol_tpu.parallel.mesh import make_mesh
+    from cop5615_gossip_protocol_tpu.parallel.sharded import run_sharded
+
+    n = 1001  # n_pad = 1008 on 8 devices: 7 pad lanes
+    topo = build_topology("full", n)
+    cfg = SimConfig(n=n, topology="full", algorithm="push-sum",
+                    termination="global", max_rounds=200000)
+    r8 = run_sharded(topo, cfg, mesh=make_mesh(8))
+    assert r8.converged
+    assert r8.converged_count == n
+    assert r8.estimate_mae / ((n - 1) / 2) < 1e-4
