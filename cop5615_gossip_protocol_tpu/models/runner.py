@@ -607,16 +607,6 @@ def run(
     """
     if key is None:
         key = jax.random.PRNGKey(cfg.seed)
-    if cfg.termination == "global" and cfg.engine == "fused":
-        # Hoisted ABOVE the sharded dispatch (ADVICE r3): fused_sharded
-        # implements the reference's local latch only — without this a
-        # sharded fused run with termination='global' would silently
-        # execute the wrong criterion while the single-device path raised.
-        raise ValueError(
-            "termination='global' runs on the chunked engine (the fused "
-            "kernels implement the reference's local latch); drop the "
-            "engine override"
-        )
     if cfg.n_devices is not None and cfg.n_devices > 1:
         if cfg.reference and cfg.algorithm == "push-sum":
             raise ValueError(
@@ -625,6 +615,17 @@ def run(
                 "n_devices or use batched semantics"
             )
         if cfg.engine == "fused":
+            if cfg.termination == "global":
+                # Raised HERE, before the dispatch (ADVICE r3): without it
+                # a sharded fused push-sum run with termination='global'
+                # would silently execute the reference's local latch. The
+                # single-device fused engines implement the global
+                # criterion in-kernel (VERDICT r3 #5).
+                raise ValueError(
+                    "termination='global' is not supported by the fused x "
+                    "sharded composition; drop the engine override (the "
+                    "chunked sharded path runs it) or run single-device"
+                )
             # Fused x sharded composition: per-shard multi-round Pallas
             # chunks under shard_map, halo ppermutes + psum at chunk
             # boundaries (parallel/fused_sharded.py). Raises with the
@@ -665,11 +666,14 @@ def run(
         # round (one send per informed node per round) already models.
         return _run_reference_walk(topo, cfg, key, target)
 
-    if cfg.engine != "chunked" and cfg.termination != "global":
+    if cfg.engine != "chunked":
         # Two Pallas engines share one dispatch: the pool engine for pool
         # delivery on the implicit full topology (ops/fused_pool.py — the
         # flagship benchmark path, ~2.7x the chunked pool round on v5e),
-        # the stencil engine otherwise (ops/fused.py).
+        # the stencil engine otherwise (ops/fused.py). termination='global'
+        # rides the same dispatch: every push-sum kernel implements the
+        # global-residual criterion in-kernel (VERDICT r3 #5); gossip can
+        # never reach here with it (SimConfig rejects the combination).
         if cfg.delivery == "pool":
             if topo.implicit:
                 from ..ops import fused_pool

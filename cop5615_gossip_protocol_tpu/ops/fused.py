@@ -307,6 +307,7 @@ def make_pushsum_chunk(
     delta = np.float32(cfg.resolved_delta)
     term_rounds = np.int32(cfg.term_rounds)
     target = np.int32(cfg.resolved_target_count(topo.n, topo.target_count))
+    global_term = cfg.termination == "global"
 
     def kernel(
         start_ref, keys_ref, disp_ref, deg_ref, s0, w0, t0, c0,
@@ -355,23 +356,53 @@ def make_pushsum_chunk(
             # Absorb — mirrors models/pushsum.absorb (program.fs:119-143).
             s_new = (s - s_send) + inbox_s
             w_new = (w - w_send) + inbox_w
-            received = inbox_w > 0
-            stable = jnp.abs(s_new / w_new - s / w) <= delta
-            term = t_v[:]
-            term_new = jnp.where(
-                received, jnp.where(stable, term + 1, jnp.int32(0)), term
-            )
-            conv_new = jnp.where(
-                (c_v[:] != 0) | (term_new >= term_rounds),
-                jnp.int32(1),
-                jnp.int32(0),
-            )
-            s_v[:] = s_new
-            w_v[:] = w_new
-            t_v[:] = term_new
-            c_v[:] = conv_new
-            flags[1] = flags[1] + 1
-            flags[0] = jnp.where(jnp.sum(conv_new) >= target, 1, 0)
+            if global_term:
+                # Global-residual criterion (models/pushsum.absorb with
+                # global_termination=True): relative tolerance, conv
+                # all-or-nothing, term untouched. Pad lanes (w=1, inbox 0)
+                # have Δ = 0 and never block; the conv plane masks them so
+                # converged_count stays exactly n.
+                ratio_old = s / w
+                tol = delta * jnp.maximum(jnp.abs(ratio_old), jnp.float32(1))
+                unstable = jnp.abs(s_new / w_new - ratio_old) > tol
+                all_ok = jnp.sum(unstable.astype(jnp.int32)) == 0
+                if layout.n_pad != layout.n:
+                    pos = (
+                        jax.lax.broadcasted_iota(jnp.int32, (R, LANES), 0)
+                        * LANES
+                        + jax.lax.broadcasted_iota(jnp.int32, (R, LANES), 1)
+                    )
+                    conv_new = jnp.where(
+                        all_ok & (pos < layout.n), jnp.int32(1), jnp.int32(0)
+                    )
+                else:
+                    conv_new = jnp.broadcast_to(
+                        jnp.where(all_ok, jnp.int32(1), jnp.int32(0)),
+                        (R, LANES),
+                    )
+                s_v[:] = s_new
+                w_v[:] = w_new
+                c_v[:] = conv_new
+                flags[1] = flags[1] + 1
+                flags[0] = jnp.where(all_ok, 1, 0)
+            else:
+                received = inbox_w > 0
+                stable = jnp.abs(s_new / w_new - s / w) <= delta
+                term = t_v[:]
+                term_new = jnp.where(
+                    received, jnp.where(stable, term + 1, jnp.int32(0)), term
+                )
+                conv_new = jnp.where(
+                    (c_v[:] != 0) | (term_new >= term_rounds),
+                    jnp.int32(1),
+                    jnp.int32(0),
+                )
+                s_v[:] = s_new
+                w_v[:] = w_new
+                t_v[:] = term_new
+                c_v[:] = conv_new
+                flags[1] = flags[1] + 1
+                flags[0] = jnp.where(jnp.sum(conv_new) >= target, 1, 0)
 
         @pl.when(k == K - 1)
         def _emit():

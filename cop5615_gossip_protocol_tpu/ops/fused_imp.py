@@ -56,6 +56,7 @@ from .fused_pool import (
     absorb_gossip_tile,
     absorb_pushsum_tile,
     build_pool_layout,
+    latch_conv_global,
     round_offsets,
 )
 from .sampling import IMP_CHOICE_TAG, POOL_CHOICE_BITS
@@ -181,6 +182,7 @@ def make_pushsum_imp_chunk(
     delta = np.float32(cfg.resolved_delta)
     term_rounds = np.int32(cfg.term_rounds)
     target = np.int32(cfg.resolved_target_count(topo.n, topo.target_count))
+    global_term = cfg.termination == "global"
     cls_np, deg_np, lattice = _build_class_planes(topo, layout)
     L = len(lattice)
     max_deg = topo.max_deg
@@ -263,11 +265,21 @@ def make_pushsum_imp_chunk(
                 return acc + absorb_pushsum_tile(
                     r0, padm, inbox_s, inbox_w,
                     s_v, w_v, t_v, c_v, ds_v, dw_v, delta, term_rounds,
+                    global_term=global_term,
                 )
 
             total = lax.fori_loop(0, T, p2, jnp.int32(0))
             flags[1] = flags[1] + 1
-            flags[0] = jnp.where(total >= target, 1, 0)
+            if global_term:
+                # total counts UNSTABLE lanes (absorb_pushsum_tile's
+                # global branch); zero fires the all-or-nothing latch.
+                @pl.when(total == 0)
+                def _latch():
+                    latch_conv_global(c_v, N)
+
+                flags[0] = jnp.where(total == 0, 1, 0)
+            else:
+                flags[0] = jnp.where(total >= target, 1, 0)
 
         @pl.when(k == K - 1)
         def _emit():

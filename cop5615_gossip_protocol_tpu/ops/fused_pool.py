@@ -274,19 +274,35 @@ def _copy_in(pairs, sems):
 
 def absorb_pushsum_tile(r0, padm, inbox_s, inbox_w,
                         s_v, w_v, t_v, c_v, ds_v, dw_v,
-                        delta, term_rounds):
+                        delta, term_rounds, global_term: bool = False):
     """One tile of models/pushsum.absorb (program.fs:119-143) against VMEM
     state planes: s_keep = s - s_send (sends read back from the first copy
     of the doubled planes), term advances only on receipt, conv latches,
     pad lanes never converge. Owns the pad masking of the inboxes — callers
     pass them raw. Writes the tile back; returns its converged count.
-    Shared by the pool and tiled-stencil engines."""
+    Shared by the pool and tiled-stencil engines.
+
+    ``global_term`` (static) switches to the global-residual criterion
+    (models/pushsum.absorb with global_termination=True): term and conv are
+    left untouched — conv becomes all-or-nothing and only the round whose
+    verdict fires writes it (latch_conv_global) — and the return value is
+    the tile's count of UNSTABLE valid lanes (relative tolerance
+    delta * max(|ratio|, 1)); the caller stops when the round's total is
+    zero. Non-receiving lanes have Δ = 0 and never block, exactly as in
+    the chunked oracle."""
     inbox_s = jnp.where(padm, 0.0, inbox_s)
     inbox_w = jnp.where(padm, 0.0, inbox_w)
     s_t = s_v[pl.ds(r0, TILE), :]
     w_t = w_v[pl.ds(r0, TILE), :]
     s_new = (s_t - ds_v[pl.ds(r0, TILE), :]) + inbox_s
     w_new = (w_t - dw_v[pl.ds(r0, TILE), :]) + inbox_w
+    if global_term:
+        ratio_old = s_t / w_t
+        tol = delta * jnp.maximum(jnp.abs(ratio_old), jnp.float32(1))
+        unstable = (jnp.abs(s_new / w_new - ratio_old) > tol) & ~padm
+        s_v[pl.ds(r0, TILE), :] = s_new
+        w_v[pl.ds(r0, TILE), :] = w_new
+        return jnp.sum(unstable.astype(jnp.int32), dtype=jnp.int32)
     received = inbox_w > 0
     stable = jnp.abs(s_new / w_new - s_t / w_t) <= delta
     term = t_v[pl.ds(r0, TILE), :]
@@ -307,6 +323,19 @@ def absorb_pushsum_tile(r0, padm, inbox_s, inbox_w,
     t_v[pl.ds(r0, TILE), :] = term_new
     c_v[pl.ds(r0, TILE), :] = conv_new
     return jnp.sum(conv_new, dtype=jnp.int32)
+
+
+def latch_conv_global(c_v, n: int):
+    """Write the all-or-nothing global-termination conv plane: 1 on valid
+    lanes, 0 on padding. Called at most once per run — only by the round
+    whose max-relative-residual verdict fired (the chunked oracle's
+    broadcast-all() conv with the pad mask of ADVICE r3 applied)."""
+    R = c_v.shape[0]
+    pos = (
+        lax.broadcasted_iota(jnp.int32, (R, LANES), 0) * LANES
+        + lax.broadcasted_iota(jnp.int32, (R, LANES), 1)
+    )
+    c_v[:] = jnp.where(pos < n, jnp.int32(1), jnp.int32(0))
 
 
 def absorb_gossip_tile(r0, padm, inbox, n_v, a_v, c_v, rumor_target,
@@ -356,6 +385,7 @@ def make_pushsum_pool_chunk(
     delta = np.float32(cfg.resolved_delta)
     term_rounds = np.int32(cfg.term_rounds)
     target = np.int32(cfg.resolved_target_count(topo.n, topo.target_count))
+    global_term = cfg.termination == "global"
 
     def kernel(
         start_ref, keys_ref, offs_ref, s0, w0, t0, c0,
@@ -416,11 +446,21 @@ def make_pushsum_pool_chunk(
                 return acc + absorb_pushsum_tile(
                     r0, padm, inbox_s, inbox_w,
                     s_v, w_v, t_v, c_v, ds_v, dw_v, delta, term_rounds,
+                    global_term=global_term,
                 )
 
             total = lax.fori_loop(0, T, p2, jnp.int32(0))
             flags[1] = flags[1] + 1
-            flags[0] = jnp.where(total >= target, 1, 0)
+            if global_term:
+                # total counts UNSTABLE lanes: zero means every node's
+                # relative residual cleared delta this round.
+                @pl.when(total == 0)
+                def _latch():
+                    latch_conv_global(c_v, N)
+
+                flags[0] = jnp.where(total == 0, 1, 0)
+            else:
+                flags[0] = jnp.where(total >= target, 1, 0)
 
         @pl.when(k == K - 1)
         def _emit():

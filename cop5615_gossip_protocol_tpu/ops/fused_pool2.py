@@ -129,6 +129,22 @@ def _copy_wait(src, dst, sem):
     cp.wait()
 
 
+def latch_conv_global_streamed(c_n, scr_c, sem_d, T, PT, N, row_l, lane):
+    """HBM-streamed analog of fused_pool.latch_conv_global: write the
+    all-or-nothing global-termination conv plane (1 on valid lanes) tile
+    by tile into the parity plane holding the final state. Runs at most
+    once per run — only the round whose residual verdict fired. Shared by
+    the pool2 and stencil_hbm engines."""
+    def lt(t, _):
+        r0 = t * PT
+        padm = (r0 + row_l) * LANES + lane >= N
+        scr_c[:] = jnp.where(padm, jnp.int32(0), jnp.int32(1))
+        _copy_wait(scr_c, c_n.at[pl.ds(r0, PT), :], sem_d)
+        return 0
+
+    lax.fori_loop(0, T, lt, 0, unroll=False)
+
+
 def _copy_all(pairs, sems):
     """Start every copy, then wait on all — overlapped transfers instead
     of serialized start/wait pairs, whose exposed ~1 MB latencies made the
@@ -189,6 +205,7 @@ def make_pushsum_pool2_chunk(
     delta = np.float32(cfg.resolved_delta)
     term_rounds = np.int32(cfg.term_rounds)
     target = np.int32(cfg.resolved_target_count(topo.n, topo.target_count))
+    global_term = cfg.termination == "global"
 
     def kernel(
         start_ref, keys_ref, offs_ref, s_in, w_in, t_in, c_in,
@@ -344,22 +361,41 @@ def make_pushsum_pool2_chunk(
                 w_send = jnp.where(padm, 0.0, w_t * 0.5)
                 s_new = (s_t - s_send) + inbox_s
                 w_new = (w_t - w_send) + inbox_w
-                received = inbox_w > 0
-                stable = jnp.abs(s_new / w_new - s_t / w_t) <= delta
-                term_new = jnp.where(
-                    received,
-                    jnp.where(stable, scr_t[:] + 1, jnp.int32(0)),
-                    scr_t[:],
-                )
-                conv_new = jnp.where(
-                    padm,
-                    jnp.int32(0),
-                    jnp.where(
-                        (scr_c[:] != 0) | (term_new >= term_rounds),
-                        jnp.int32(1),
+                if global_term:
+                    # Global-residual criterion: relative tolerance, term
+                    # and conv streamed through unchanged (conv is written
+                    # once, by the latch below, when the verdict fires);
+                    # the accumulator counts UNSTABLE valid lanes.
+                    ratio_old = s_t / w_t
+                    tol = delta * jnp.maximum(
+                        jnp.abs(ratio_old), jnp.float32(1)
+                    )
+                    unstable = (
+                        jnp.abs(s_new / w_new - ratio_old) > tol
+                    ) & ~padm
+                    term_new = scr_t[:]
+                    conv_new = scr_c[:]
+                    tile_metric = jnp.sum(
+                        unstable.astype(jnp.int32), dtype=jnp.int32
+                    )
+                else:
+                    received = inbox_w > 0
+                    stable = jnp.abs(s_new / w_new - s_t / w_t) <= delta
+                    term_new = jnp.where(
+                        received,
+                        jnp.where(stable, scr_t[:] + 1, jnp.int32(0)),
+                        scr_t[:],
+                    )
+                    conv_new = jnp.where(
+                        padm,
                         jnp.int32(0),
-                    ),
-                )
+                        jnp.where(
+                            (scr_c[:] != 0) | (term_new >= term_rounds),
+                            jnp.int32(1),
+                            jnp.int32(0),
+                        ),
+                    )
+                    tile_metric = jnp.sum(conv_new, dtype=jnp.int32)
                 scr_s[:] = s_new
                 scr_w[:] = w_new
                 scr_t[:] = term_new
@@ -370,11 +406,24 @@ def make_pushsum_pool2_chunk(
                     (scr_t, t_n.at[pl.ds(r0, PT), :]),
                     (scr_c, c_n.at[pl.ds(r0, PT), :]),
                 ], sems)
-                return acc + jnp.sum(conv_new, dtype=jnp.int32)
+                return acc + tile_metric
 
             total = lax.fori_loop(0, T, p2, jnp.int32(0), unroll=False)
             flags[1] = flags[1] + 1
-            flags[0] = jnp.where(total >= target, 1, 0)
+            if global_term:
+                # Zero unstable lanes: every node cleared the relative
+                # residual this round. Latch the all-or-nothing conv plane
+                # into the parity that now holds the final state (runs at
+                # most once per run).
+                @pl.when(total == 0)
+                def _latch():
+                    latch_conv_global_streamed(
+                        c_n, scr_c, sem_d, T, PT, N, row_l, lane
+                    )
+
+                flags[0] = jnp.where(total == 0, 1, 0)
+            else:
+                flags[0] = jnp.where(total >= target, 1, 0)
 
         A = (sA, wA, tA, cA)
         B = (sB, wB, tB, cB)

@@ -56,6 +56,7 @@ from .fused_pool import (
     absorb_gossip_tile,
     absorb_pushsum_tile,
     build_pool_layout,
+    latch_conv_global,
 )
 from .topology import Topology, stencil_offsets
 
@@ -149,6 +150,7 @@ def make_pushsum_stencil2_chunk(
     delta = np.float32(cfg.resolved_delta)
     term_rounds = np.int32(cfg.term_rounds)
     target = np.int32(cfg.resolved_target_count(topo.n, topo.target_count))
+    global_term = cfg.termination == "global"
     disp_np, deg_np = _build_disp_planes(topo, layout)
     max_deg = topo.max_deg
 
@@ -223,11 +225,21 @@ def make_pushsum_stencil2_chunk(
                 return acc + absorb_pushsum_tile(
                     r0, padm, inbox_s, inbox_w,
                     s_v, w_v, t_v, c_v, ds_v, dw_v, delta, term_rounds,
+                    global_term=global_term,
                 )
 
             total = lax.fori_loop(0, T, p2, jnp.int32(0))
             flags[1] = flags[1] + 1
-            flags[0] = jnp.where(total >= target, 1, 0)
+            if global_term:
+                # total counts UNSTABLE lanes (absorb_pushsum_tile's
+                # global branch); zero fires the all-or-nothing latch.
+                @pl.when(total == 0)
+                def _latch():
+                    latch_conv_global(c_v, N)
+
+                flags[0] = jnp.where(total == 0, 1, 0)
+            else:
+                flags[0] = jnp.where(total >= target, 1, 0)
 
         @pl.when(k == K - 1)
         def _emit():

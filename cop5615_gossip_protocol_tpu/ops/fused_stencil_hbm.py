@@ -51,7 +51,7 @@ from jax.experimental.pallas import tpu as pltpu
 from ..config import SimConfig
 from .fused import clamp_cap_and_pad, threefry_bits_2d
 from .fused_pool import LANES, _lane_roll, build_pool_layout
-from .fused_pool2 import _copy_wait, _pick_pt
+from .fused_pool2 import _copy_wait, _pick_pt, latch_conv_global_streamed
 from .topology import Topology, stencil_offsets
 
 MAX_STENCIL_HBM_NODES = 2**27
@@ -154,6 +154,7 @@ def make_pushsum_stencil_hbm_chunk(
     delta = np.float32(cfg.resolved_delta)
     term_rounds = np.int32(cfg.term_rounds)
     target = np.int32(cfg.resolved_target_count(topo.n, topo.target_count))
+    global_term = cfg.termination == "global"
 
     def kernel(
         start_ref, keys_ref, s_in, w_in, t_in, c_in,
@@ -326,22 +327,41 @@ def make_pushsum_stencil_hbm_chunk(
                 w_send = jnp.where(padm, 0.0, w_t * 0.5)
                 s_new = (s_t - s_send) + inbox_s
                 w_new = (w_t - w_send) + inbox_w
-                received = inbox_w > 0
-                stable = jnp.abs(s_new / w_new - s_t / w_t) <= delta
-                term_new = jnp.where(
-                    received,
-                    jnp.where(stable, scr_t[:] + 1, jnp.int32(0)),
-                    scr_t[:],
-                )
-                conv_new = jnp.where(
-                    padm,
-                    jnp.int32(0),
-                    jnp.where(
-                        (scr_c[:] != 0) | (term_new >= term_rounds),
-                        jnp.int32(1),
+                if global_term:
+                    # Global-residual criterion: relative tolerance, term
+                    # and conv streamed through unchanged (conv written by
+                    # the latch below when the verdict fires); accumulator
+                    # counts UNSTABLE valid lanes.
+                    ratio_old = s_t / w_t
+                    tol = delta * jnp.maximum(
+                        jnp.abs(ratio_old), jnp.float32(1)
+                    )
+                    unstable = (
+                        jnp.abs(s_new / w_new - ratio_old) > tol
+                    ) & ~padm
+                    term_new = scr_t[:]
+                    conv_new = scr_c[:]
+                    tile_metric = jnp.sum(
+                        unstable.astype(jnp.int32), dtype=jnp.int32
+                    )
+                else:
+                    received = inbox_w > 0
+                    stable = jnp.abs(s_new / w_new - s_t / w_t) <= delta
+                    term_new = jnp.where(
+                        received,
+                        jnp.where(stable, scr_t[:] + 1, jnp.int32(0)),
+                        scr_t[:],
+                    )
+                    conv_new = jnp.where(
+                        padm,
                         jnp.int32(0),
-                    ),
-                )
+                        jnp.where(
+                            (scr_c[:] != 0) | (term_new >= term_rounds),
+                            jnp.int32(1),
+                            jnp.int32(0),
+                        ),
+                    )
+                    tile_metric = jnp.sum(conv_new, dtype=jnp.int32)
                 scr_s[:] = s_new
                 scr_w[:] = w_new
                 scr_t[:] = term_new
@@ -350,11 +370,22 @@ def make_pushsum_stencil_hbm_chunk(
                 _copy_wait(scr_w, w_n.at[pl.ds(r0, PT), :], sem_d)
                 _copy_wait(scr_t, t_n.at[pl.ds(r0, PT), :], sem_d)
                 _copy_wait(scr_c, c_n.at[pl.ds(r0, PT), :], sem_d)
-                return acc + jnp.sum(conv_new, dtype=jnp.int32)
+                return acc + tile_metric
 
             total = lax.fori_loop(0, T, p2, jnp.int32(0), unroll=False)
             flags[1] = flags[1] + 1
-            flags[0] = jnp.where(total >= target, 1, 0)
+            if global_term:
+                # Zero unstable lanes — latch the all-or-nothing conv
+                # plane into the final-state parity (at most once per run).
+                @pl.when(total == 0)
+                def _latch():
+                    latch_conv_global_streamed(
+                        c_n, scr_c, sem_d, T, PT, N, row_l, lane
+                    )
+
+                flags[0] = jnp.where(total == 0, 1, 0)
+            else:
+                flags[0] = jnp.where(total >= target, 1, 0)
 
         A = (sA, wA, tA, cA)
         B = (sB, wB, tB, cB)

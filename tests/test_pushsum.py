@@ -176,9 +176,12 @@ def test_global_termination_gating():
     with pytest.raises(ValueError, match="reference"):
         SimConfig(n=64, topology="line", algorithm="push-sum",
                   semantics="reference", termination="global")
+    # Single-device fused + global is supported in-kernel since VERDICT r3
+    # #5 (tests/test_fused_global.py); the sharded composition still
+    # raises loudly (ADVICE r3 medium).
     cfg = SimConfig(n=512, topology="torus3d", algorithm="push-sum",
-                    termination="global", engine="fused")
-    with pytest.raises(ValueError, match="chunked"):
+                    termination="global", engine="fused", n_devices=2)
+    with pytest.raises(ValueError, match="fused x sharded"):
         run(build_topology("torus3d", 512), cfg)
 
 
