@@ -83,8 +83,10 @@ def build_pool_layout(n: int) -> PoolLayout:
     return PoolLayout(n=n, n_pad=rows * LANES, rows=rows, tiles=rows // TILE)
 
 
-def pool_fused_support(topo: Topology, cfg: SimConfig) -> Optional[str]:
-    """None if the fused pool engine can run this config, else the reason."""
+def pool_common_support(topo: Topology, cfg: SimConfig) -> Optional[str]:
+    """Gates shared by every consumer of the VMEM pool-kernel machinery
+    (the single-device engine and the sharded composition's plan) — ONE
+    home so the limits cannot drift between them."""
     if not topo.implicit:
         return (
             "the fused pool engine serves the implicit full topology only; "
@@ -99,15 +101,26 @@ def pool_fused_support(topo: Topology, cfg: SimConfig) -> Optional[str]:
         )
     if cfg.fault_rate > 0:
         return "fault injection not supported in the fused pool kernel"
-    if cfg.n_devices is not None and cfg.n_devices > 1:
-        return "fused pool engine is single-device"
     if cfg.pool_size > 1 << POOL_CHOICE_BITS:
         return (
             f"pool_size {cfg.pool_size} exceeds the packed-choice limit "
             f"{1 << POOL_CHOICE_BITS}"
         )
     if topo.n > MAX_POOL_NODES:
-        return f"population {topo.n} exceeds VMEM-resident limit {MAX_POOL_NODES}"
+        return (
+            f"population {topo.n} exceeds the VMEM-resident doubled-plane "
+            f"budget ({MAX_POOL_NODES} nodes)"
+        )
+    return None
+
+
+def pool_fused_support(topo: Topology, cfg: SimConfig) -> Optional[str]:
+    """None if the fused pool engine can run this config, else the reason."""
+    reason = pool_common_support(topo, cfg)
+    if reason is not None:
+        return reason
+    if cfg.n_devices is not None and cfg.n_devices > 1:
+        return "fused pool engine is single-device"
     return None
 
 

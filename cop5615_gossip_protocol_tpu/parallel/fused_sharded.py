@@ -95,7 +95,11 @@ def threefry_bits_rows(k1, k2, global_rows, cols: int):
 def plan_fused_sharded(topo: Topology, cfg: SimConfig, n_dev: int):
     """(H_rows, rows_loc, CR, layout) or a string reason why not."""
     if topo.implicit:
-        return "implicit (full) topology has no displacement structure"
+        return (
+            "implicit (full) topology has no displacement structure for "
+            "the halo composition; use delivery='pool' (the fused pool x "
+            "sharded composition, parallel/fused_pool_sharded.py)"
+        )
     offsets = stencil_offsets(topo)
     if offsets is None:
         return f"topology {topo.kind!r} has no small displacement set"

@@ -1,0 +1,173 @@
+"""Fused pool x sharded composition (parallel/fused_pool_sharded.py).
+
+The implicit-full flagship across devices: local halve, one all_gather of
+the send planes per round, single-device pool-kernel delivery+absorb per
+shard. The design claim is BITWISE equality with the single-device fused
+pool engine at every device count (same tile arithmetic on the same
+operands) — which transitively matches the chunked collective pool path
+(tests/test_halo.py pins that leg). Pinned here: gossip int state, push-sum
+float state to the last bit, global termination, resume, plan gating.
+
+Geometry note: the pool layout's 512-row tiles mean the smallest sharded
+populations are 131072 (2 devices) / 262144 (4 devices); rounds are bounded
+where convergence would cost interpret-mode minutes.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cop5615_gossip_protocol_tpu import SimConfig, build_topology
+from cop5615_gossip_protocol_tpu.models.runner import run
+from cop5615_gossip_protocol_tpu.parallel.fused_pool_sharded import (
+    plan_fused_pool_sharded,
+    run_fused_pool_sharded,
+)
+from cop5615_gossip_protocol_tpu.parallel.mesh import make_mesh
+
+
+def _cfg(n, algorithm="gossip", **kw):
+    kw.setdefault("delivery", "pool")
+    kw.setdefault("engine", "fused")
+    kw.setdefault("max_rounds", 200)
+    return SimConfig(n=n, topology="full", algorithm=algorithm, **kw)
+
+
+def test_gossip_bitwise_vs_single_device():
+    n = 131072
+    topo = build_topology("full", n)
+    final = {}
+    r1 = run(topo, _cfg(n), on_chunk=lambda r, s: final.__setitem__("a", s))
+    r2 = run_fused_pool_sharded(
+        topo, _cfg(n, n_devices=2), mesh=make_mesh(2),
+        on_chunk=lambda r, s: final.__setitem__("b", s),
+    )
+    assert r1.converged and r2.converged
+    assert r1.rounds == r2.rounds
+    assert r1.converged_count == r2.converged_count
+    a, b = final["a"], final["b"]
+    assert (np.asarray(a.count) == np.asarray(b.count)).all()
+    assert (np.asarray(a.active) == np.asarray(b.active)).all()
+
+
+def test_gossip_bitwise_vs_chunked_collective():
+    # VERDICT r3 #1's oracle: the chunked collective pool path
+    # (parallel/halo.deliver_pool_sharded) on the same mesh.
+    n = 131072
+    topo = build_topology("full", n)
+    r_f = run_fused_pool_sharded(topo, _cfg(n, n_devices=2), mesh=make_mesh(2))
+    cfg_c = _cfg(n, n_devices=2, engine="chunked")
+    r_c = run(topo, cfg_c)
+    assert r_f.rounds == r_c.rounds
+    assert r_f.converged_count == r_c.converged_count
+
+
+def test_gossip_padded_population():
+    # n_pad > n: the mod-n blend + valid masks must keep pad lanes inert.
+    n = 250000  # rows -> 2048, n_pad = 262144
+    topo = build_topology("full", n)
+    final = {}
+    r1 = run(topo, _cfg(n), on_chunk=lambda r, s: final.__setitem__("a", s))
+    r2 = run_fused_pool_sharded(
+        topo, _cfg(n, n_devices=4), mesh=make_mesh(4),
+        on_chunk=lambda r, s: final.__setitem__("b", s),
+    )
+    assert r1.rounds == r2.rounds
+    assert r1.converged_count == r2.converged_count == n
+    assert (np.asarray(final["a"].count) == np.asarray(final["b"].count)).all()
+
+
+def test_pushsum_state_bitwise():
+    n = 131072
+    topo = build_topology("full", n)
+    final = {}
+    kw = dict(max_rounds=60, chunk_rounds=60)
+    run(topo, _cfg(n, "push-sum", **kw),
+        on_chunk=lambda r, s: final.__setitem__("a", s))
+    run_fused_pool_sharded(
+        topo, _cfg(n, "push-sum", n_devices=2, **kw), mesh=make_mesh(2),
+        on_chunk=lambda r, s: final.__setitem__("b", s),
+    )
+    a, b = final["a"], final["b"]
+    # Same float ops in the same order on every tile: bitwise, not just close.
+    assert (np.asarray(a.s) == np.asarray(b.s)).all()
+    assert (np.asarray(a.w) == np.asarray(b.w)).all()
+    assert (np.asarray(a.term) == np.asarray(b.term)).all()
+    sm = float(np.asarray(b.s, np.float64).sum())
+    true = n * (n - 1) / 2
+    assert abs(sm - true) / true < 1e-6  # mass conserved
+
+
+def test_pushsum_global_termination():
+    n = 131072
+    topo = build_topology("full", n)
+    kw = dict(termination="global", max_rounds=5000)
+    r1 = run(topo, _cfg(n, "push-sum", **kw))
+    r2 = run_fused_pool_sharded(
+        topo, _cfg(n, "push-sum", n_devices=2, **kw), mesh=make_mesh(2)
+    )
+    assert r1.converged and r2.converged
+    assert r1.rounds == r2.rounds
+    assert r2.converged_count == n
+
+
+def test_resume_midway():
+    n = 131072
+    topo = build_topology("full", n)
+    cfg = _cfg(n, "push-sum", n_devices=2, max_rounds=60, chunk_rounds=20)
+    snaps = []
+    mesh = make_mesh(2)
+    run_fused_pool_sharded(
+        topo, cfg, mesh=mesh, on_chunk=lambda r, s: snaps.append((r, s))
+    )
+    assert len(snaps) >= 2
+    r0, s0 = snaps[0]
+    final = snaps[-1][1]
+    resumed = {}
+    run_fused_pool_sharded(
+        topo, cfg, mesh=mesh,
+        start_state=jax.tree.map(jnp.asarray, s0), start_round=r0,
+        on_chunk=lambda r, s: resumed.__setitem__("s", s),
+    )
+    assert (np.asarray(resumed["s"].s) == np.asarray(final.s)).all()
+    assert (np.asarray(resumed["s"].w) == np.asarray(final.w)).all()
+
+
+def test_runner_dispatch_routes_pool_composition(monkeypatch):
+    from cop5615_gossip_protocol_tpu.parallel import fused_pool_sharded as fps
+
+    called = {}
+    orig = fps.run_fused_pool_sharded
+
+    def spy(*a, **kw):
+        called["yes"] = True
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(fps, "run_fused_pool_sharded", spy)
+    n = 131072
+    r = run(build_topology("full", n),
+            _cfg(n, n_devices=2, max_rounds=60))
+    assert called.get("yes")
+    assert r.rounds > 0
+
+
+def test_plan_gating():
+    cfg = _cfg(131072, n_devices=2)
+    assert not isinstance(
+        plan_fused_pool_sharded(build_topology("full", 131072), cfg, 2), str
+    )
+    assert "implicit full" in plan_fused_pool_sharded(
+        build_topology("torus3d", 4096), cfg, 2
+    )
+    assert "delivery='pool'" in plan_fused_pool_sharded(
+        build_topology("full", 131072), _cfg(131072, delivery="auto"), 2
+    )
+    assert "divide" in plan_fused_pool_sharded(
+        build_topology("full", 131072), cfg, 3
+    )
+    big = 1 << 22
+    assert "budget" in plan_fused_pool_sharded(
+        build_topology("full", big), _cfg(big), 2
+    )

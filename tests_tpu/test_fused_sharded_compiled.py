@@ -34,9 +34,11 @@ def test_compiled_fused_sharded_gossip_matches_single_device():
 
 
 def test_compiled_fused_sharded_pushsum_throughput_class():
-    # The VERDICT r3 bar: single-shard throughput in the single-device
-    # fused engine's class at 1M via the new code path (halo recompute at
-    # CR=2 costs ~25-35%; the chunked XLA round costs ~3x).
+    # Measured envelope (RUNLOG r4): 1-device-mesh composition wall is
+    # 1.13x the single-device engine at CR=512 (1082 vs 958 ms / 2000
+    # rounds, stable across reps) — the halo-recompute overhead. Bound at
+    # 1.3x: measured + noise headroom, tight enough that a regression to
+    # the old 1.6x class fails.
     n = 1_000_000
     topo = build_topology("torus3d", n)
     cfg = SimConfig(n=n, topology="torus3d", algorithm="push-sum",
@@ -46,4 +48,4 @@ def test_compiled_fused_sharded_pushsum_throughput_class():
     assert r_shard.rounds == 2000 and r_single.rounds == 2000
     per_shard = r_shard.run_s / r_shard.rounds
     per_single = r_single.run_s / r_single.rounds
-    assert per_shard < per_single * 1.6, (per_shard, per_single)
+    assert per_shard < per_single * 1.3, (per_shard, per_single)
