@@ -98,6 +98,35 @@ def main(argv=None) -> int:
     rounds_per_sec = result.to_record()["rounds_per_sec"] or 0.0
     akka_extrapolated_s = AKKA_MS_PER_NODE * args.n / 1e3
     vs_baseline = akka_extrapolated_s / result.run_s if result.run_s > 0 else 0.0
+
+    # Floor-cancelled engine metrics (VERDICT r3 #6): the legacy `value` is
+    # a single-launch wall whose ~110-140 ms per-dispatch tunnel floor
+    # wobbles +-25% round over round at this round count; the differential
+    # pass (same compiled chunk at two round budgets, min-of-3 each)
+    # cancels the floor and reports what the ENGINE costs per round. TPU
+    # only — off-TPU there is no tunnel floor and the wide round budget
+    # would dominate the run.
+    engine_us = engine_rps = None
+    if jax.default_backend() == "tpu":
+        from benchmarks.compare import ENGINE_US_NOISE, engine_us_per_round
+
+        overrides = {"delivery": args.delivery, "dtype": args.dtype,
+                     "pool_size": args.pool_size}
+        if args.delta is not None:
+            overrides["delta"] = args.delta
+        engine_us = engine_us_per_round(
+            args.topology, args.algorithm, args.n, seed=args.seed,
+            **overrides,
+        )
+        if engine_us > ENGINE_US_NOISE:
+            engine_rps = round(1e6 / engine_us, 1)
+            engine_us = round(engine_us, 3)
+        else:
+            # Below the dispatch-jitter noise bound (possibly negative):
+            # that is a statement about the bound, not a cost — emit null
+            # rather than a misleading number.
+            engine_us = None
+
     out = {
         "metric": f"pushsum_rounds_per_sec_{args.topology}_n{args.n}"
         if args.algorithm == "push-sum"
@@ -105,6 +134,11 @@ def main(argv=None) -> int:
         "value": round(rounds_per_sec, 3),
         "unit": "rounds/sec",
         "vs_baseline": round(vs_baseline, 2),
+        # Floor-cancelled engine metrics — what the engine costs per round
+        # with the per-dispatch tunnel floor differenced out (null off-TPU
+        # or when the differential sits below the noise bound):
+        "engine_us_per_round": engine_us,
+        "engine_rounds_per_sec": engine_rps,
         # context (judge-readable, not part of the contract):
         "rounds": result.rounds,
         "wall_s": round(result.run_s, 6),

@@ -115,7 +115,17 @@ def engine_us_per_round(
             assert res.rounds == cap, (res.rounds, cap)
             best = res.run_s if best is None else min(best, res.run_s)
         walls.append(best)
-    return max((walls[1] - walls[0]) / (r2 - r1) * 1e6, 0.0)
+    # Raw differential, deliberately UNclamped (VERDICT r3 Weak #4): at
+    # small N the true per-round cost can sit below the dispatch jitter and
+    # the difference may come out <= 0 — that is a statement about the
+    # noise bound, not "free", and callers must render it as below-noise
+    # (ENGINE_US_NOISE) rather than 0.00.
+    return (walls[1] - walls[0]) / (r2 - r1) * 1e6
+
+
+# Differentials below this are indistinguishable from dispatch jitter at
+# the default round spreads; render as "<0.5" instead of a number.
+ENGINE_US_NOISE = 0.5
 
 
 def matched_run(

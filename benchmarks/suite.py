@@ -63,6 +63,18 @@ def _fmt(x, nd=2, none="—"):
     return none if x is None else f"{x:,.{nd}f}"
 
 
+def _fmt_us(x):
+    """Engine-µs/round cell: differentials under the dispatch-jitter noise
+    bound print as a bound, not a fake 0.00 (VERDICT r3 Weak #4)."""
+    from benchmarks.compare import ENGINE_US_NOISE
+
+    if x is None:
+        return "—"
+    if x < ENGINE_US_NOISE:
+        return f"<{ENGINE_US_NOISE}"
+    return f"{x:,.2f}"
+
+
 def _table(rows: list[MatchedRow]) -> list[str]:
     out = [
         "| #Nodes | Akka report (ms) | refsim native (ms) | gossip-tpu (ms) "
@@ -74,7 +86,7 @@ def _table(rows: list[MatchedRow]) -> list[str]:
         out.append(
             f"| {r.n:,} | {_fmt(r.akka_report_ms)} | {_fmt(r.refsim_ms)} "
             f"| {_fmt(r.tpu_ms)} | {r.tpu_rounds:,} "
-            f"| {_fmt(r.tpu_us_per_round)} "
+            f"| {_fmt_us(r.tpu_us_per_round)} "
             f"| {_fmt(sp, 1)}{'' if sp is None else 'x'} |"
         )
     return out

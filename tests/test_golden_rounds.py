@@ -1,0 +1,69 @@
+"""Absolute golden round counts (SURVEY §4; VERDICT r3 #7).
+
+Every other equivalence test in this suite pins engines against EACH OTHER
+— all of them share one sampling stream (ops/sampling.py + the in-kernel
+threefry twins), so a semantic drift there would move every engine in
+lockstep and no relative test would notice. This file is the absolute
+oracle: rounds-to-converge and converged counts for fixed
+(topology, algorithm, n, delivery, seed), generated ONCE on the chunked
+CPU path (float32, default deltas) and checked in as
+tests/golden_rounds.json.
+
+If this test fails after an intentional sampling/semantics change,
+regenerate the table with the snippet in the JSON's sibling docstring
+below and say so in the commit message — silently regenerating defeats
+the oracle.
+
+Regeneration:
+    python - <<'EOF'
+    import json, jax
+    jax.config.update('jax_platforms', 'cpu')
+    from cop5615_gossip_protocol_tpu import SimConfig, build_topology
+    from cop5615_gossip_protocol_tpu.models.runner import run
+    rows = json.load(open('tests/golden_rounds.json'))
+    for row in rows:
+        cfg = SimConfig(n=row['n'], topology=row['topology'],
+                        algorithm=row['algorithm'], delivery=row['delivery'],
+                        seed=row['seed'], engine='chunked', max_rounds=200000)
+        r = run(build_topology(row['topology'], row['n'], seed=row['seed']), cfg)
+        row.update(rounds=r.rounds, converged_count=r.converged_count,
+                   converged=r.converged)
+    json.dump(rows, open('tests/golden_rounds.json', 'w'), indent=1)
+    EOF
+"""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from cop5615_gossip_protocol_tpu import SimConfig, build_topology
+from cop5615_gossip_protocol_tpu.models.runner import run
+
+GOLDEN = json.loads(
+    (Path(__file__).parent / "golden_rounds.json").read_text()
+)
+
+
+@pytest.mark.parametrize(
+    "row", GOLDEN,
+    ids=[
+        f"{r['topology']}-{r['algorithm']}-{r['n']}-{r['delivery']}-s{r['seed']}"
+        for r in GOLDEN
+    ],
+)
+def test_golden_rounds(row):
+    cfg = SimConfig(
+        n=row["n"], topology=row["topology"], algorithm=row["algorithm"],
+        delivery=row["delivery"], seed=row["seed"], engine="chunked",
+        max_rounds=200000,
+    )
+    topo = build_topology(row["topology"], row["n"], seed=row["seed"])
+    r = run(topo, cfg)
+    assert r.rounds == row["rounds"], (
+        f"absolute round count drifted: {r.rounds} != golden "
+        f"{row['rounds']} — the shared sampling stream or round semantics "
+        "changed (see module docstring before regenerating)"
+    )
+    assert r.converged_count == row["converged_count"]
+    assert r.converged == row["converged"]
