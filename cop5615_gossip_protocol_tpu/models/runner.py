@@ -472,11 +472,17 @@ def _run_fused(
         def extra_args(start, count):  # noqa: F811
             return (fused_pool.round_offsets(key, start, count, cfg.pool_size, topo.n),)
 
-    elif variant == "imp":
+    elif variant in ("imp", "imp_hbm"):
         from ..ops import fused_imp, fused_pool
 
-        make_pushsum = fused_imp.make_pushsum_imp_chunk
-        make_gossip = fused_imp.make_gossip_imp_chunk
+        if variant == "imp":
+            make_pushsum = fused_imp.make_pushsum_imp_chunk
+            make_gossip = fused_imp.make_gossip_imp_chunk
+        else:
+            from ..ops import fused_imp_hbm
+
+            make_pushsum = fused_imp_hbm.make_pushsum_imp_hbm_chunk
+            make_gossip = fused_imp_hbm.make_gossip_imp_hbm_chunk
 
         def extra_args(start, count):  # noqa: F811
             return (
@@ -709,8 +715,18 @@ def run(
             else:
                 from ..ops import fused_imp
 
+                # VMEM imp engine up to its plane budget; the HBM-streaming
+                # tier (ops/fused_imp_hbm.py) past it — imp2d/imp3d no
+                # longer cliff onto the chunked path at scale (VERDICT r3
+                # #2a).
                 variant = "imp"
                 reason = fused_imp.imp_fused_support(topo, cfg)
+                if reason is not None:
+                    from ..ops import fused_imp_hbm
+
+                    hbm_reason = fused_imp_hbm.imp_hbm_support(topo, cfg)
+                    if hbm_reason is None:
+                        variant, reason = "imp_hbm", None
             auto_ok = reason is None
         else:
             from ..ops import fused
@@ -718,9 +734,10 @@ def run(
             # The proven whole-array engine keeps its domain; the tiled
             # stencil2 engine takes over where v1 refuses (population past
             # 128k, wrap topologies at unaligned n); past stencil2's VMEM
-            # budget the HBM-streaming tier serves constant-degree wrap
-            # lattices (torus3d/ring) so the grid-scale rows never cliff
-            # onto the chunked path.
+            # budget the HBM-streaming tier serves every arithmetic
+            # lattice kind (torus3d/ring wrap columns; grid2d/grid3d/
+            # line/ref2d boundary masks) so the grid-scale rows never
+            # cliff onto the chunked path.
             reason_v1 = fused.fused_support(topo, cfg)
             if reason_v1 is None:
                 variant, reason = "stencil", None

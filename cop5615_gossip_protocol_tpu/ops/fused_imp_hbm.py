@@ -1,46 +1,38 @@
-"""HBM-streaming fused stencil engine — lattices past VMEM residency.
+"""HBM-streaming fused imp engine — imp2d/imp3d past VMEM residency.
 
-ops/fused_stencil.py (the tiled VMEM engine) caps at ~1.2M nodes; beyond
-it the lattice rows of BENCH_TABLES' grid-scale table used to fall back to
-the chunked XLA path (~10 ms/round at 16.8M). This engine reuses the
-HBM-streaming architecture of ops/fused_pool2.py — ping/pong state planes,
-PT-row processing tiles, mirrored-margin roll windows DMA'd at 8-aligned
-starts — with the pool machinery swapped for stencil classes:
+ops/fused_imp.py (the VMEM tiled engine) caps at the resident-plane budget
+(~1-2M nodes with the class columns); past it imp2d/imp3d — the
+reference's marquee topology (program.fs:267-313; report.pdf p.3 caps it
+at 2,000 nodes) — used to cliff back onto the chunked XLA path
+(VERDICT r3 #2a). This engine composes the two proven pieces:
 
-- serves lattices whose structure is pure ARITHMETIC in the node index:
-  wrap kinds (torus3d, ring — e.g. the torus x-1 column is n-1 interior,
-  g-1 on the x=0 face) and, since r4 (VERDICT r3 #2b), non-wrap kinds
-  (grid2d, grid3d, line, ref2d — boundary-face live masks instead of
-  wrap columns). The kernel derives each tile's direction pairs from its
-  global indices in-register — no [max_deg, R, 128] neighbor planes in
-  HBM, which would otherwise dominate the streamed bytes (28 B/node of
-  structure against ~40 B of state);
-- sampling is slot = word % degree over the same threefry stream as every
-  other engine, then a running-index select over the LIVE computed
-  columns — bit-compatible with ops/sampling.targets_explicit on the
-  builder's column order (x-1, x+1, y-1, y+1[, z-1, z+1]);
-- delivery masks the marked plane on the sampled DISPLACEMENT value per
-  static class (ops/fused_stencil's scheme) through pool2's window
-  readers: wrap classes read one mod-n window (two when the pad blend is
-  live); non-wrap classes always read ONE window at the SIGNED
-  padded-space shift — no edge of a non-wrap lattice crosses the global
-  [0, n) boundary, so the blend is statically dead at any padding.
+- ops/fused_stencil_hbm.py's streaming architecture: ping/pong HBM state
+  planes, PT-row tiles, mirrored-margin windows DMA'd at 8-aligned
+  starts, and ARITHMETIC lattice structure — the imp kinds' honest-mode
+  lattice is the full grid2d/grid3d lattice (ops/topology.build_imp2d /
+  build_imp3d append the one long-range edge per node AFTER the lattice
+  columns), so boundary-mask direction pairs replace neighbor planes and
+  the marked class plane is the only per-round structure in HBM;
+- ops/fused_imp.py's class-id scheme: the marked plane holds the sampled
+  CLASS (lattice class q in sorted-offset order, L + pool choice for the
+  long-range slot, -1 for non-senders), sampling slot = untagged word %
+  degree with the packed pool choice on the tagged stream — the chunked
+  deliver_imp_pool stream, bit for bit.
 
-HBM traffic per node per round: gossip ~36 B (p1: read active 4, write
-marked 4; p2: C marked windows 4C at C=12 -> 48... dominated by windows),
-push-sum ~180 B — still an order under the chunked path's materialized
-passes. Trajectories match the chunked stencil path bit-for-bit for
-integer state and up to compiler reassociation for push-sum — the same
-contract as every fused engine, pinned by tests/test_fused_stencil_hbm.py
-in interpret mode and tests_tpu/ on hardware.
+Delivery per round per tile: L lattice windows at SIGNED padded-space
+shifts (non-wrap lattice edges never cross the global boundary — one
+window per class at any padding), then P pool windows at the round's
+traced mod-n displacements with the d/d+Z blend when the population is
+padded (pool rolls DO wrap the global ring). Accumulation order matches
+the chunked path: lattice classes sorted, then pool slots.
 
-Reference mapping: the same lattice hot loop as ops/fused_stencil.py
-(program.fs:89-105, 110-143 over the Imp3D-family lattices,
-program.fs:295-306), at populations past 16M on one chip.
+Reference-semantics mode is rejected for the same reason as the VMEM imp
+engine: pooled sampling cannot reproduce the static extra edge (Q9).
 """
 
 from __future__ import annotations
 
+import math
 from typing import Optional
 
 import numpy as np
@@ -53,23 +45,34 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ..config import SimConfig
 from .fused import clamp_cap_and_pad, threefry_bits_2d
-from .fused_pool import LANES, _lane_roll, build_pool_layout
-from .fused_pool2 import _copy_wait, _pick_pt, latch_conv_global_streamed
-from .topology import Topology, stencil_offsets
+from .fused_pool import LANES, build_pool_layout
+from .fused_pool2 import (
+    _choice_tile_pt,
+    _copy_wait,
+    _pick_pt,
+    latch_conv_global_streamed,
+)
+from .fused_stencil_hbm import (
+    MAX_STENCIL_HBM_NODES,
+    _signed_pad_shift,
+    _window_marked,
+    _window_vals,
+)
+from .sampling import POOL_CHOICE_BITS
+from .topology import Topology, imp_split
 
-MAX_STENCIL_HBM_NODES = 2**27
 
-
-_HBM_KINDS = ("torus3d", "ring", "grid2d", "grid3d", "line", "ref2d")
-
-
-def stencil_hbm_support(topo: Topology, cfg: SimConfig) -> Optional[str]:
-    """None if the HBM-streaming stencil engine can run this config."""
-    if topo.kind not in _HBM_KINDS:
+def imp_hbm_support(topo: Topology, cfg: SimConfig) -> Optional[str]:
+    """None if the HBM-streaming imp engine can run this config."""
+    if topo.kind not in ("imp2d", "imp3d"):
+        return f"topology {topo.kind!r} is not an imp (lattice+extra) kind"
+    if cfg.reference:
         return (
-            f"topology {topo.kind!r} has no arithmetic displacement "
-            f"columns (served kinds: {', '.join(_HBM_KINDS)})"
+            "pooled long-range sampling cannot reproduce the reference's "
+            "static extra edge (Q9); reference semantics use scatter"
         )
+    if imp_split(topo) is None:
+        return "lattice slots are not offset-structured for this instance"
     if cfg.dtype != "float32":
         return "fused engine supports float32 only"
     if not jax.config.jax_threefry_partitionable:
@@ -81,6 +84,11 @@ def stencil_hbm_support(topo: Topology, cfg: SimConfig) -> Optional[str]:
         return "fault injection not supported in the fused kernel"
     if cfg.n_devices is not None and cfg.n_devices > 1:
         return "fused engine is single-device"
+    if cfg.pool_size > 1 << POOL_CHOICE_BITS:
+        return (
+            f"pool_size {cfg.pool_size} exceeds the packed-choice limit "
+            f"{1 << POOL_CHOICE_BITS}"
+        )
     if topo.n > MAX_STENCIL_HBM_NODES:
         return (
             f"population {topo.n} exceeds the HBM-plane budget "
@@ -89,157 +97,71 @@ def stencil_hbm_support(topo: Topology, cfg: SimConfig) -> Optional[str]:
     return None
 
 
-def _lattice_params(topo: Topology):
-    """(dirs builder, wrap) for the supported lattices.
+def _imp_dirs(topo: Topology):
+    """(lattice direction list, sorted lattice offsets, L).
 
-    ``dirs(idx)`` maps a [PT, 128] global node-index tile to the list of
-    (live mask, mod-n displacement column) pairs IN THE TOPOLOGY BUILDER'S
-    column order — the foundation of bit-compatibility with
-    ops/sampling.targets_explicit (the j-th LIVE pair is the builder's
-    j-th neighbor column). Wrap lattices (torus3d/ring) have all
-    directions live everywhere; non-wrap lattices (grid2d/grid3d and the
-    chain kinds) mask boundary faces instead — VERDICT r3 #2's "boundary
-    masks instead of the wrap blend".
-
-    A reference-mode non-wrap topology appends one UNWIRED node past the
-    lattice (Q1, ops/topology.build_grid2d); its live masks are forced
-    empty by the ``idx < n_lat`` conjunct (degree 0 -> never sends, never
-    addressed).
-    """
+    Directions are (live_fn, mod-n displacement) in the topology BUILDER'S
+    column order (ops/topology._grid2d_rows / _grid3d_rows: x-1, x+1,
+    y-1, y+1[, z-1, z+1]); ``live_fn(idx)`` is the boundary mask. The
+    honest imp lattice is the full grid, so no truncation masks apply.
+    The class id of each direction is its index in the SORTED offset list
+    — precomputed statically by the caller via the returned offsets."""
     n = topo.n
-    # The reference-mode extra node is always the last index, degree 0.
-    n_lat = n - 1 if (
-        topo.degree is not None and n > 0 and int(topo.degree[-1]) == 0
-    ) else n
-    i32 = jnp.int32
-
-    if topo.kind == "ring":
-        def dirs(idx):
-            t = jnp.full(idx.shape, True)
-            return [
-                (t, jnp.full(idx.shape, n - 1, i32)),
-                (t, jnp.full(idx.shape, 1, i32)),
-            ]
-        return dirs, True
-
-    if topo.kind in ("line", "ref2d"):
-        # Chain wiring {i-1, i+1} over the whole population (ref2d is the
-        # reference's "2D", Q6 — line wiring over the squared population).
-        def dirs(idx):
-            return [
-                (idx > 0, jnp.full(idx.shape, n - 1, i32)),
-                (idx < n_lat - 1, jnp.full(idx.shape, 1, i32)),
-            ]
-        return dirs, False
-
-    if topo.kind == "grid2d":
-        s = round(n_lat ** 0.5)
-        assert s * s == n_lat, "grid2d lattices are perfect squares"
-
-        def dirs(idx):
-            in_lat = idx < n_lat
-            x = idx % s
-            y = idx // s
-            return [
-                (in_lat & (x > 0), jnp.full(idx.shape, n - 1, i32)),
-                (in_lat & (x < s - 1), jnp.full(idx.shape, 1, i32)),
-                (in_lat & (y > 0), jnp.full(idx.shape, n - s, i32)),
-                (in_lat & (y < s - 1), jnp.full(idx.shape, s, i32)),
-            ]
-        return dirs, False
-
-    g = round(n_lat ** (1 / 3))
-    assert g * g * g == n_lat, "3-D lattices are perfect cubes"
-    g2 = g * g
-
-    if topo.kind == "grid3d":
-        def dirs(idx):
-            in_lat = idx < n_lat
-            x = idx % g
-            y = (idx // g) % g
-            z = idx // g2
-            return [
-                (in_lat & (x > 0), jnp.full(idx.shape, n - 1, i32)),
-                (in_lat & (x < g - 1), jnp.full(idx.shape, 1, i32)),
-                (in_lat & (y > 0), jnp.full(idx.shape, n - g, i32)),
-                (in_lat & (y < g - 1), jnp.full(idx.shape, g, i32)),
-                (in_lat & (z > 0), jnp.full(idx.shape, n - g2, i32)),
-                (in_lat & (z < g - 1), jnp.full(idx.shape, g2, i32)),
-            ]
-        return dirs, False
-
-    def dirs(idx):  # torus3d
-        t = jnp.full(idx.shape, True)
-        x = idx % g
-        y = (idx // g) % g
-        z = idx // g2
-        return [
-            (t, jnp.where(x > 0, i32(n - 1), i32(g - 1))),
-            (t, jnp.where(x < g - 1, i32(1), i32(n - (g - 1)))),
-            (t, jnp.where(y > 0, i32(n - g), i32(g * (g - 1)))),
-            (t, jnp.where(y < g - 1, i32(g), i32(n - g * (g - 1)))),
-            (t, jnp.where(z > 0, i32(n - g2), i32(g2 * (g - 1)))),
-            (t, jnp.where(z < g - 1, i32(g2), i32(n - g2 * (g - 1)))),
+    split = imp_split(topo)
+    assert split is not None
+    offs = [int(d) for d in split.lattice_offsets]
+    if topo.kind == "imp2d":
+        s = round(math.sqrt(n))
+        assert s * s == n, "honest imp2d lattices are perfect squares"
+        dirs = [
+            (lambda idx: idx % s > 0, n - 1),
+            (lambda idx, s=s: idx % s < s - 1, 1),
+            (lambda idx, s=s: idx // s > 0, n - s),
+            (lambda idx, s=s: idx // s < s - 1, s),
         ]
-    return dirs, True
+    else:
+        g = round(n ** (1 / 3))
+        assert g * g * g == n, "honest imp3d lattices are perfect cubes"
+        g2 = g * g
+        dirs = [
+            (lambda idx, g=g: idx % g > 0, n - 1),
+            (lambda idx, g=g: idx % g < g - 1, 1),
+            (lambda idx, g=g: (idx // g) % g > 0, n - g),
+            (lambda idx, g=g: (idx // g) % g < g - 1, g),
+            (lambda idx, g2=g2: idx // g2 > 0, n - g2),
+            (lambda idx, g2=g2, g=g: idx // g2 < g - 1, g2),
+        ]
+    assert sorted(d for _, d in dirs) == offs
+    return dirs, offs, len(offs)
 
 
-def _sample_disp_dirs(bits, pairs):
-    """Per-node sampled mod-n displacement + degree from the direction
-    pairs — bit-compatible with ops/sampling.targets_explicit: slot =
-    full-width word % degree, then the slot-th LIVE column in builder
-    order (a running-index select). Returns (d, deg)."""
-    deg = pairs[0][0].astype(jnp.int32)
-    for live, _ in pairs[1:]:
+def _sample_class_imp(bits, choice, jflat, padm, dirs, cls_of, L: int):
+    """Sampled class id + send gate for one tile: slot = untagged word %
+    degree over [lattice dirs..., extra]; lattice slots map to their
+    sorted-offset class, the extra (always-live last) slot to L + packed
+    pool choice. Bit-compatible with the chunked imp_parts
+    (targets_explicit over -1-sentineled columns + tagged choice)."""
+    lives = [fn(jflat) for fn, _ in dirs]
+    deg = (~padm).astype(jnp.int32)  # the extra slot (real nodes only)
+    for live in lives:
         deg = deg + live.astype(jnp.int32)
     deg_safe = jnp.maximum(deg, 1).astype(jnp.uint32)
     slot = (bits % deg_safe).astype(jnp.int32)
-    d = jnp.zeros(bits.shape, jnp.int32)
+    cls = jnp.full(bits.shape, L, jnp.int32)  # default: the extra slot
     cum = jnp.zeros(bits.shape, jnp.int32)
-    for live, disp in pairs:
-        d = jnp.where(live & (slot == cum), disp, d)
+    for live, (_, d) in zip(lives, dirs):
+        cls = jnp.where(live & (slot == cum), jnp.int32(cls_of[d]), cls)
         cum = cum + live.astype(jnp.int32)
-    return d, deg
+    cls = jnp.where(cls == L, L + choice, cls)
+    send_ok = (deg > 0) & ~padm
+    return cls, send_ok
 
 
-def _signed_pad_shift(d_mod: int, n: int, n_pad: int) -> int:
-    """Padded-space roll amount for a non-wrap class: the SIGNED
-    displacement (no edge of a non-wrap lattice crosses the global [0, n)
-    boundary, so the mod-n blend is statically dead and a signed roll over
-    the padded ring is exact)."""
-    signed = d_mod if d_mod <= n // 2 else d_mod - n
-    return signed % n_pad
-
-
-def _window_vals(wv_ref, wm_ref, off, pt, rlane, d_c, lane, interpret):
-    """Value window masked where the marked displacement equals class d_c,
-    lane-rotated — pool2's _window_contrib with displacement-keyed masks."""
-    va = wv_ref[pl.ds(off + 1, pt), :]
-    vb = wv_ref[pl.ds(off, pt), :]
-    ma = wm_ref[pl.ds(off + 1, pt), :]
-    mb = wm_ref[pl.ds(off, pt), :]
-    pa = jnp.where(ma == d_c, va, 0.0)
-    pb = jnp.where(mb == d_c, vb, 0.0)
-    return jnp.where(
-        lane >= rlane,
-        _lane_roll(pa, rlane, interpret),
-        _lane_roll(pb, rlane, interpret),
-    )
-
-
-def _window_marked(wm_ref, off, pt, rlane, lane, interpret):
-    return jnp.where(
-        lane >= rlane,
-        _lane_roll(wm_ref[pl.ds(off + 1, pt), :], rlane, interpret),
-        _lane_roll(wm_ref[pl.ds(off, pt), :], rlane, interpret),
-    )
-
-
-def make_pushsum_stencil_hbm_chunk(
+def make_pushsum_imp_hbm_chunk(
     topo: Topology, cfg: SimConfig, *, interpret: bool = False
 ):
-    """ops/fused_stencil.make_pushsum_stencil2_chunk's contract —
-    ``chunk_fn(state4, keys, start, cap)`` — HBM-streamed."""
+    """ops/fused_imp.make_pushsum_imp_chunk's contract —
+    ``chunk_fn(state4, keys, offs, ckeys, start, cap)`` — HBM-streamed."""
     layout = build_pool_layout(topo.n)
     R = layout.rows
     N = layout.n
@@ -247,24 +169,17 @@ def make_pushsum_stencil_hbm_chunk(
     PT = _pick_pt(R)
     T = R // PT
     M = PT + 16
-    dirs_builder, wrap = _lattice_params(topo)
-    offsets = [int(d) for d in stencil_offsets(topo)]
-    # Window shift per class: mod-n displacement on wrap lattices (blended
-    # with the d+Z variant at padded populations), signed padded-space roll
-    # on non-wrap lattices (no edge crosses the global boundary, so one
-    # window per class is exact at ANY padding).
-    blend = wrap and Z != 0
-    shifts = {
-        d: (d if wrap else _signed_pad_shift(d, N, layout.n_pad))
-        for d in offsets
-    }
+    dirs, lat_offs, L = _imp_dirs(topo)
+    cls_of = {d: q for q, d in enumerate(lat_offs)}
+    lat_shifts = [_signed_pad_shift(d, N, layout.n_pad) for d in lat_offs]
+    P = cfg.pool_size
     delta = np.float32(cfg.resolved_delta)
     term_rounds = np.int32(cfg.term_rounds)
     target = np.int32(cfg.resolved_target_count(topo.n, topo.target_count))
     global_term = cfg.termination == "global"
 
     def kernel(
-        start_ref, keys_ref, s_in, w_in, t_in, c_in,
+        start_ref, keys_ref, offs_ref, ckeys_ref, s_in, w_in, t_in, c_in,
         sA, wA, tA, cA, sB, wB, tB, cB, ds_p, dw_p, dm_p, meta_o,
         scr_s, scr_w, scr_t, scr_c, scr_ds, scr_dw, scr_dm,
         win_s, win_w, win_m, win_s2, win_w2, win_m2, flags, sems,
@@ -300,6 +215,8 @@ def make_pushsum_stencil_hbm_chunk(
             kk = k % 8
             k1 = keys_ref[kk, 0]
             k2 = keys_ref[kk, 1]
+            ck1 = ckeys_ref[kk, 0]
+            ck2 = ckeys_ref[kk, 1]
 
             def p1(t, _):
                 r0 = t * PT
@@ -308,11 +225,13 @@ def make_pushsum_stencil_hbm_chunk(
                 jflat = (r0 + row_l) * LANES + lane
                 padm = jflat >= N
                 bits = threefry_bits_2d(k1, k2, PT, LANES, row0=r0)
-                d, deg_t = _sample_disp_dirs(bits, dirs_builder(jflat))
-                send_ok = (deg_t > 0) & ~padm
+                choice = _choice_tile_pt(ck1, ck2, r0, PT, P)
+                cls, send_ok = _sample_class_imp(
+                    bits, choice, jflat, padm, dirs, cls_of, L
+                )
                 scr_ds[:] = jnp.where(send_ok, scr_s[:] * 0.5, 0.0)
                 scr_dw[:] = jnp.where(send_ok, scr_w[:] * 0.5, 0.0)
-                scr_dm[:] = jnp.where(send_ok, d, jnp.int32(-1))
+                scr_dm[:] = jnp.where(send_ok, cls, jnp.int32(-1))
                 _copy_wait(scr_ds, ds_p.at[pl.ds(r0, PT), :], sem_d)
                 _copy_wait(scr_dw, dw_p.at[pl.ds(r0, PT), :], sem_d)
                 _copy_wait(scr_dm, dm_p.at[pl.ds(r0, PT), :], sem_d)
@@ -326,13 +245,16 @@ def make_pushsum_stencil_hbm_chunk(
                 @pl.when(t == 1)
                 def _mirror1():
                     _copy_wait(
-                        scr_ds.at[pl.ds(0, 16), :], ds_p.at[pl.ds(R + PT, 16), :], sem_d
+                        scr_ds.at[pl.ds(0, 16), :],
+                        ds_p.at[pl.ds(R + PT, 16), :], sem_d,
                     )
                     _copy_wait(
-                        scr_dw.at[pl.ds(0, 16), :], dw_p.at[pl.ds(R + PT, 16), :], sem_d
+                        scr_dw.at[pl.ds(0, 16), :],
+                        dw_p.at[pl.ds(R + PT, 16), :], sem_d,
                     )
                     _copy_wait(
-                        scr_dm.at[pl.ds(0, 16), :], dm_p.at[pl.ds(R + PT, 16), :], sem_d
+                        scr_dm.at[pl.ds(0, 16), :],
+                        dm_p.at[pl.ds(R + PT, 16), :], sem_d,
                     )
 
                 return 0
@@ -351,16 +273,12 @@ def make_pushsum_stencil_hbm_chunk(
                 inbox_w = jnp.zeros((PT, LANES), jnp.float32)
 
                 def fetch(e, ws_ref, ww_ref, wm_ref, sem_base):
-                    # Start the class's three (or six, with the blend's
-                    # second variant) window copies together and wait once:
-                    # serialized start/wait pairs leave each ~1 MB
-                    # transfer's latency exposed (the gossip kernel's
-                    # measured lesson below).
                     q = e // LANES
                     ws_raw = lax.rem(
-                        r0 - q - jnp.int32(1) + jnp.int32(2 * R), jnp.int32(R)
+                        r0 - q - jnp.int32(1) + jnp.int32(2 * R),
+                        jnp.int32(R),
                     )
-                    ws8 = (ws_raw // 8) * 8  # aligned DMA start
+                    ws8 = (ws_raw // 8) * 8
                     cps = [
                         pltpu.make_async_copy(
                             ds_p.at[pl.ds(ws8, PT + 16), :], ws_ref,
@@ -379,49 +297,53 @@ def make_pushsum_stencil_hbm_chunk(
                         cp.start()
                     return (e % LANES, ws_raw - ws8), cps
 
-                for d_c in offsets:
-                    if not blend:
-                        (rl, off), cps = fetch(
-                            jnp.int32(shifts[d_c]), win_s, win_w, win_m, 0
-                        )
-                        for cp in cps:
-                            cp.wait()
-                        cs = _window_vals(
-                            win_s, win_m, off, PT, rl, d_c, lane, interpret
-                        )
-                        cw = _window_vals(
-                            win_w, win_m, off, PT, rl, d_c, lane, interpret
-                        )
+                def one_window(e, mask_id):
+                    (rl, off), cps = fetch(e, win_s, win_w, win_m, 1)
+                    for cp in cps:
+                        cp.wait()
+                    cs = _window_vals(
+                        win_s, win_m, off, PT, rl, mask_id, lane, interpret
+                    )
+                    cw = _window_vals(
+                        win_w, win_m, off, PT, rl, mask_id, lane, interpret
+                    )
+                    return cs, cw
+
+                # Lattice classes, sorted order, signed single windows.
+                for q, sh in enumerate(lat_shifts):
+                    cs, cw = one_window(jnp.int32(sh), q)
+                    inbox_s = inbox_s + cs
+                    inbox_w = inbox_w + cw
+                # Pool slots: mod-n traced displacements (blend at Z > 0).
+                for slot in range(P):
+                    e = offs_ref[kk, slot]
+                    if Z == 0:
+                        cs, cw = one_window(e, L + slot)
                     else:
-                        (rl, off), cps = fetch(
-                            jnp.int32(d_c), win_s, win_w, win_m, 0
-                        )
+                        (rl, off), cps = fetch(e, win_s, win_w, win_m, 1)
                         (rl2, off2), cps2 = fetch(
-                            jnp.int32(d_c + Z), win_s2, win_w2, win_m2, 3
+                            e + jnp.int32(Z), win_s2, win_w2, win_m2, 4
                         )
                         for cp in cps + cps2:
                             cp.wait()
-                        take = jflat >= d_c
+                        take = jflat >= e
                         cs = jnp.where(
                             take,
-                            _window_vals(
-                                win_s, win_m, off, PT, rl, d_c, lane, interpret
-                            ),
-                            _window_vals(
-                                win_s2, win_m2, off2, PT, rl2, d_c, lane, interpret
-                            ),
+                            _window_vals(win_s, win_m, off, PT, rl,
+                                         L + slot, lane, interpret),
+                            _window_vals(win_s2, win_m2, off2, PT, rl2,
+                                         L + slot, lane, interpret),
                         )
                         cw = jnp.where(
                             take,
-                            _window_vals(
-                                win_w, win_m, off, PT, rl, d_c, lane, interpret
-                            ),
-                            _window_vals(
-                                win_w2, win_m2, off2, PT, rl2, d_c, lane, interpret
-                            ),
+                            _window_vals(win_w, win_m, off, PT, rl,
+                                         L + slot, lane, interpret),
+                            _window_vals(win_w2, win_m2, off2, PT, rl2,
+                                         L + slot, lane, interpret),
                         )
                     inbox_s = inbox_s + cs
                     inbox_w = inbox_w + cw
+
                 inbox_s = jnp.where(padm, 0.0, inbox_s)
                 inbox_w = jnp.where(padm, 0.0, inbox_w)
                 s_t = scr_s[:]
@@ -431,10 +353,6 @@ def make_pushsum_stencil_hbm_chunk(
                 s_new = (s_t - s_send) + inbox_s
                 w_new = (w_t - w_send) + inbox_w
                 if global_term:
-                    # Global-residual criterion: relative tolerance, term
-                    # and conv streamed through unchanged (conv written by
-                    # the latch below when the verdict fires); accumulator
-                    # counts UNSTABLE valid lanes.
                     ratio_old = s_t / w_t
                     tol = delta * jnp.maximum(
                         jnp.abs(ratio_old), jnp.float32(1)
@@ -478,8 +396,6 @@ def make_pushsum_stencil_hbm_chunk(
             total = lax.fori_loop(0, T, p2, jnp.int32(0), unroll=False)
             flags[1] = flags[1] + 1
             if global_term:
-                # Zero unstable lanes — latch the all-or-nothing conv
-                # plane into the final-state parity (at most once per run).
                 @pl.when(total == 0)
                 def _latch():
                     latch_conv_global_streamed(
@@ -507,9 +423,11 @@ def make_pushsum_stencil_hbm_chunk(
             meta_o[0] = flags[1]
             meta_o[1] = flags[1] % 2
 
-    def chunk_fn(state4, keys, start, cap):
+    def chunk_fn(state4, keys, offs, ckeys, start, cap):
         s, w, t, c = state4
-        cap, keys = clamp_cap_and_pad(start, cap, keys)
+        cap, keys, offs, ckeys = clamp_cap_and_pad(
+            start, cap, keys, ((offs, 1), (ckeys, 0))
+        )
         K = keys.shape[0]
         f32 = jax.ShapeDtypeStruct((R, LANES), jnp.float32)
         i32 = jax.ShapeDtypeStruct((R, LANES), jnp.int32)
@@ -526,6 +444,8 @@ def make_pushsum_stencil_hbm_chunk(
             ),
             in_specs=[
                 pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec((8, 2), lambda k: (k // 8, 0), memory_space=pltpu.SMEM),
+                pl.BlockSpec((8, P), lambda k: (k // 8, 0), memory_space=pltpu.SMEM),
                 pl.BlockSpec((8, 2), lambda k: (k // 8, 0), memory_space=pltpu.SMEM),
                 pl.BlockSpec(memory_space=pl.ANY),
                 pl.BlockSpec(memory_space=pl.ANY),
@@ -551,7 +471,7 @@ def make_pushsum_stencil_hbm_chunk(
                 pltpu.VMEM((PT + 16, LANES), jnp.float32),
                 pltpu.VMEM((PT + 16, LANES), jnp.int32),
                 pltpu.SMEM((2,), jnp.int32),
-                pltpu.SemaphoreType.DMA((6,)),
+                pltpu.SemaphoreType.DMA((7,)),
             ],
             compiler_params=pltpu.CompilerParams(
                 vmem_limit_bytes=96 * 1024 * 1024
@@ -559,7 +479,7 @@ def make_pushsum_stencil_hbm_chunk(
             interpret=interpret,
         )(
             jnp.stack([jnp.int32(0), jnp.int32(start), jnp.int32(cap)]),
-            keys,
+            keys, offs, ckeys,
             s, w, t, c,
         )
         meta = outs[11]
@@ -574,11 +494,11 @@ def make_pushsum_stencil_hbm_chunk(
     return chunk_fn, layout
 
 
-def make_gossip_stencil_hbm_chunk(
+def make_gossip_imp_hbm_chunk(
     topo: Topology, cfg: SimConfig, *, interpret: bool = False
 ):
-    """Gossip analog: one marked-displacement plane; receiver-side
-    suppression on the streamed conv tile."""
+    """Gossip analog: one marked-class plane; receiver-side suppression on
+    the streamed conv tile; windows prefetched per tile before any wait."""
     layout = build_pool_layout(topo.n)
     R = layout.rows
     N = layout.n
@@ -586,19 +506,18 @@ def make_gossip_stencil_hbm_chunk(
     PT = _pick_pt(R)
     T = R // PT
     M = PT + 16
-    dirs_builder, wrap = _lattice_params(topo)
-    offsets = [int(d) for d in stencil_offsets(topo)]
-    blend = wrap and Z != 0  # see make_pushsum_stencil_hbm_chunk
-    shifts = {
-        d: (d if wrap else _signed_pad_shift(d, N, layout.n_pad))
-        for d in offsets
-    }
+    dirs, lat_offs, L = _imp_dirs(topo)
+    cls_of = {d: q for q, d in enumerate(lat_offs)}
+    lat_shifts = [_signed_pad_shift(d, N, layout.n_pad) for d in lat_offs]
+    P = cfg.pool_size
+    # Window slots: L lattice (single) + P pool (doubled when blended).
+    n_win = L + P * (1 if Z == 0 else 2)
     rumor_target = np.int32(cfg.resolved_rumor_target)
     suppress = cfg.resolved_suppress
     target = np.int32(cfg.resolved_target_count(topo.n, topo.target_count))
 
     def kernel(
-        start_ref, keys_ref, n_in, a_in, c_in,
+        start_ref, keys_ref, offs_ref, ckeys_ref, n_in, a_in, c_in,
         nA, aA, cA, nB, aB, cB, dm_p, meta_o,
         scr_n, scr_a, scr_c, scr_m, win_all, flags, sems, wsems,
     ):
@@ -631,6 +550,8 @@ def make_gossip_stencil_hbm_chunk(
             kk = k % 8
             k1 = keys_ref[kk, 0]
             k2 = keys_ref[kk, 1]
+            ck1 = ckeys_ref[kk, 0]
+            ck2 = ckeys_ref[kk, 1]
 
             def p1(t, _):
                 r0 = t * PT
@@ -638,9 +559,12 @@ def make_gossip_stencil_hbm_chunk(
                 jflat = (r0 + row_l) * LANES + lane
                 padm = jflat >= N
                 bits = threefry_bits_2d(k1, k2, PT, LANES, row0=r0)
-                d, deg_t = _sample_disp_dirs(bits, dirs_builder(jflat))
-                sending = (scr_a[:] != 0) & (deg_t > 0) & ~padm
-                scr_m[:] = jnp.where(sending, d, jnp.int32(-1))
+                choice = _choice_tile_pt(ck1, ck2, r0, PT, P)
+                cls, send_ok = _sample_class_imp(
+                    bits, choice, jflat, padm, dirs, cls_of, L
+                )
+                sending = (scr_a[:] != 0) & send_ok
+                scr_m[:] = jnp.where(sending, cls, jnp.int32(-1))
                 _copy_wait(scr_m, dm_p.at[pl.ds(r0, PT), :], sem_d)
 
                 @pl.when(t == 0)
@@ -650,7 +574,8 @@ def make_gossip_stencil_hbm_chunk(
                 @pl.when(t == 1)
                 def _mirror1():
                     _copy_wait(
-                        scr_m.at[pl.ds(0, 16), :], dm_p.at[pl.ds(R + PT, 16), :], sem_d
+                        scr_m.at[pl.ds(0, 16), :],
+                        dm_p.at[pl.ds(R + PT, 16), :], sem_d,
                     )
 
                 return 0
@@ -666,56 +591,68 @@ def make_gossip_stencil_hbm_chunk(
                 padm = jflat >= N
                 inbox = jnp.zeros((PT, LANES), jnp.int32)
 
-                # Start EVERY class window's DMA before waiting on any:
-                # serialized start/wait pairs leave each ~1 MB transfer's
-                # latency exposed and made this p2 DMA-latency-bound
-                # (measured ~4 ms/round at 16.8M vs ~0.7 ms of traffic).
                 def win_params(e):
                     q = e // LANES
                     ws_raw = lax.rem(
-                        r0 - q - jnp.int32(1) + jnp.int32(2 * R), jnp.int32(R)
+                        r0 - q - jnp.int32(1) + jnp.int32(2 * R),
+                        jnp.int32(R),
                     )
                     ws8 = (ws_raw // 8) * 8
                     return ws8, e % LANES, ws_raw - ws8
 
+                # Start EVERY window's DMA before waiting on any (the
+                # stencil_hbm gossip lesson: serialized start/wait pairs
+                # leave each ~1 MB transfer's latency exposed).
+                es = [jnp.int32(sh) for sh in lat_shifts]
+                for slot in range(P):
+                    e = offs_ref[kk, slot]
+                    es.append(e)
+                    if Z != 0:
+                        es.append(e + jnp.int32(Z))
                 plans = []
                 cps = []
-                for ci, d_c in enumerate(offsets):
-                    es = (jnp.int32(shifts[d_c]),) if not blend else (
-                        jnp.int32(d_c), jnp.int32(d_c + Z)
+                for wi, e in enumerate(es):
+                    ws8, rl, off = win_params(e)
+                    cp = pltpu.make_async_copy(
+                        dm_p.at[pl.ds(ws8, PT + 16), :],
+                        win_all.at[wi], wsems.at[wi],
                     )
-                    for vi, e in enumerate(es):
-                        ws8, rl, off = win_params(e)
-                        slot = ci * len(es) + vi
-                        cp = pltpu.make_async_copy(
-                            dm_p.at[pl.ds(ws8, PT + 16), :],
-                            win_all.at[slot], wsems.at[slot],
-                        )
-                        cp.start()
-                        cps.append(cp)
-                        plans.append((rl, off))
+                    cp.start()
+                    cps.append(cp)
+                    plans.append((rl, off))
                 for cp in cps:
                     cp.wait()
 
-                for ci, d_c in enumerate(offsets):
-                    stride = 1 if not blend else 2
-                    rl, off = plans[ci * stride]
-                    ga = _window_marked(
-                        win_all.at[ci * stride], off, PT, rl, lane, interpret
+                for q in range(L):
+                    rl, off = plans[q]
+                    g = _window_marked(
+                        win_all.at[q], off, PT, rl, lane, interpret
                     )
-                    if not blend:
+                    inbox = inbox + jnp.where(
+                        g == q, jnp.int32(1), jnp.int32(0)
+                    )
+                stride = 1 if Z == 0 else 2
+                for slot in range(P):
+                    wi = L + slot * stride
+                    rl, off = plans[wi]
+                    ga = _window_marked(
+                        win_all.at[wi], off, PT, rl, lane, interpret
+                    )
+                    if Z == 0:
                         g = ga
                     else:
-                        rl2, off2 = plans[ci * stride + 1]
+                        rl2, off2 = plans[wi + 1]
                         g = jnp.where(
-                            jflat >= d_c,
+                            jflat >= offs_ref[kk, slot],
                             ga,
                             _window_marked(
-                                win_all.at[ci * stride + 1], off2, PT, rl2,
-                                lane, interpret,
+                                win_all.at[wi + 1], off2, PT, rl2, lane,
+                                interpret,
                             ),
                         )
-                    inbox = inbox + jnp.where(g == d_c, jnp.int32(1), jnp.int32(0))
+                    inbox = inbox + jnp.where(
+                        g == L + slot, jnp.int32(1), jnp.int32(0)
+                    )
                 inbox = jnp.where(padm, jnp.int32(0), inbox)
                 if suppress:
                     inbox = jnp.where(scr_c[:] != 0, jnp.int32(0), inbox)
@@ -755,9 +692,11 @@ def make_gossip_stencil_hbm_chunk(
             meta_o[0] = flags[1]
             meta_o[1] = flags[1] % 2
 
-    def chunk_fn(state3, keys, start, cap):
+    def chunk_fn(state3, keys, offs, ckeys, start, cap):
         cnt, act, cv = state3
-        cap, keys = clamp_cap_and_pad(start, cap, keys)
+        cap, keys, offs, ckeys = clamp_cap_and_pad(
+            start, cap, keys, ((offs, 1), (ckeys, 0))
+        )
         i32 = jax.ShapeDtypeStruct((R, LANES), jnp.int32)
         i32m = jax.ShapeDtypeStruct((R + M, LANES), jnp.int32)
         outs = pl.pallas_call(
@@ -769,6 +708,8 @@ def make_gossip_stencil_hbm_chunk(
             ),
             in_specs=[
                 pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec((8, 2), lambda k: (k // 8, 0), memory_space=pltpu.SMEM),
+                pl.BlockSpec((8, P), lambda k: (k // 8, 0), memory_space=pltpu.SMEM),
                 pl.BlockSpec((8, 2), lambda k: (k // 8, 0), memory_space=pltpu.SMEM),
                 pl.BlockSpec(memory_space=pl.ANY),
                 pl.BlockSpec(memory_space=pl.ANY),
@@ -783,10 +724,10 @@ def make_gossip_stencil_hbm_chunk(
                 pltpu.VMEM((PT, LANES), jnp.int32),
                 pltpu.VMEM((PT, LANES), jnp.int32),
                 pltpu.VMEM((PT, LANES), jnp.int32),
-                pltpu.VMEM((len(offsets) * (1 if not blend else 2), PT + 16, LANES), jnp.int32),
+                pltpu.VMEM((n_win, PT + 16, LANES), jnp.int32),
                 pltpu.SMEM((2,), jnp.int32),
                 pltpu.SemaphoreType.DMA((1,)),
-                pltpu.SemaphoreType.DMA((len(offsets) * (1 if not blend else 2),)),
+                pltpu.SemaphoreType.DMA((n_win,)),
             ],
             compiler_params=pltpu.CompilerParams(
                 vmem_limit_bytes=96 * 1024 * 1024
@@ -794,7 +735,7 @@ def make_gossip_stencil_hbm_chunk(
             interpret=interpret,
         )(
             jnp.stack([jnp.int32(0), jnp.int32(start), jnp.int32(cap)]),
-            keys,
+            keys, offs, ckeys,
             cnt, act, cv,
         )
         meta = outs[7]

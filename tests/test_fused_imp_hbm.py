@@ -1,0 +1,170 @@
+"""HBM-streaming imp engine (ops/fused_imp_hbm.py), interpret mode.
+
+Serves imp2d/imp3d under pooled long-range sampling past the VMEM imp
+engine's plane budget; tests force it at small populations by shrinking
+that budget. Oracles: the chunked imp-pool path (round/count equality for
+gossip, trajectory state for push-sum), suppression, resume, global
+termination, gating.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cop5615_gossip_protocol_tpu import SimConfig, build_topology
+from cop5615_gossip_protocol_tpu.models.runner import run
+from cop5615_gossip_protocol_tpu.ops import fused_imp, fused_imp_hbm
+
+
+@pytest.fixture
+def force_hbm(monkeypatch):
+    monkeypatch.setattr(fused_imp, "_VMEM_BUDGET", 1000)
+
+
+def _cfg(n, kind="imp3d", algorithm="gossip", engine="fused", **kw):
+    kw.setdefault("delivery", "pool")
+    kw.setdefault("max_rounds", 20000)
+    kw.setdefault("chunk_rounds", 16)
+    return SimConfig(n=n, topology=kind, algorithm=algorithm,
+                     engine=engine, **kw)
+
+
+@pytest.mark.parametrize("kind,n", [("imp3d", 27_000), ("imp2d", 26_896)])
+def test_imp_dirs_match_builder(kind, n):
+    # The lattice direction predicates/displacements duplicate the
+    # arithmetic in fused_stencil_hbm._lattice_params in scalar form; this
+    # pins BOTH against the builder's adjacency so a change to one that
+    # misses the other fails loudly (lattice columns come first, the
+    # long-range extra edge is the builder's last column).
+    topo = build_topology(kind, n)
+    n = topo.n
+    dirs, offs, L = fused_imp_hbm._imp_dirs(topo)
+    idx = np.arange(n, dtype=np.int64)
+    got = np.full((n, topo.max_deg - 1), -1, dtype=np.int64)
+    live_count = np.zeros(n, dtype=np.int64)
+    for fn, d in dirs:
+        live = np.asarray(fn(idx))
+        rows = np.nonzero(live)[0]
+        got[rows, live_count[rows]] = d
+        live_count += live
+    assert (live_count == topo.degree - 1).all()  # + the extra edge
+    want = np.where(
+        np.arange(topo.max_deg - 1)[None, :] < (topo.degree - 1)[:, None],
+        (topo.neighbors[:, :-1].astype(np.int64) - idx[:, None]) % n,
+        -1,
+    )
+    assert (got == want).all(), kind
+    assert sorted(d for _, d in dirs) == offs and L == len(offs)
+
+
+@pytest.mark.parametrize("kind,n", [("imp3d", 125000),   # 50^3, Z > 0
+                                    ("imp2d", 65536)])   # 256^2, Z = 0
+def test_imp_hbm_gossip_matches_chunked(kind, n, force_hbm):
+    results = {}
+    for engine in ["chunked", "fused"]:
+        r = run(build_topology(kind, n),
+                _cfg(n, kind, engine=engine, max_rounds=300))
+        results[engine] = r
+    a, b = results["chunked"], results["fused"]
+    assert a.converged and b.converged
+    assert a.rounds == b.rounds
+    assert a.converged_count == b.converged_count
+
+
+def test_imp_hbm_gossip_suppression(force_hbm):
+    n = 125000
+    results = {}
+    for engine in ["chunked", "fused"]:
+        r = run(build_topology("imp3d", n),
+                _cfg(n, engine=engine, suppress_converged=True,
+                     max_rounds=300))
+        results[engine] = r
+    assert results["chunked"].rounds == results["fused"].rounds
+    assert results["chunked"].converged_count == results["fused"].converged_count
+
+
+def test_imp_hbm_pushsum_matches_chunked_fixed_rounds(force_hbm):
+    n = 125000
+    final = {}
+
+    def grab(tag):
+        def f(rounds, state):
+            final[tag] = state
+        return f
+
+    for engine in ["chunked", "fused"]:
+        r = run(build_topology("imp3d", n),
+                _cfg(n, algorithm="push-sum", engine=engine,
+                     max_rounds=64, chunk_rounds=64),
+                on_chunk=grab(engine))
+        assert r.rounds == 64
+    a, b = final["chunked"], final["fused"]
+    np.testing.assert_allclose(np.asarray(a.s), np.asarray(b.s)[:n],
+                               rtol=2e-5, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(a.w), np.asarray(b.w)[:n],
+                               rtol=2e-5, atol=1e-6)
+    sm = float(np.asarray(b.s, np.float64)[:n].sum())
+    true = n * (n - 1) / 2
+    assert abs(sm - true) / true < 1e-5  # mass conserved
+
+
+def test_imp_hbm_pushsum_global_termination(force_hbm):
+    n = 125000
+    topo = build_topology("imp3d", n)
+    rs = {}
+    for engine in ["chunked", "fused"]:
+        rs[engine] = run(topo, _cfg(n, algorithm="push-sum", engine=engine,
+                                    termination="global", max_rounds=5000))
+    assert rs["fused"].converged
+    assert rs["chunked"].rounds == rs["fused"].rounds
+    assert rs["fused"].converged_count == n
+
+
+def test_imp_hbm_resume_midway(force_hbm):
+    n = 125000
+    cfg = _cfg(n, chunk_rounds=16, max_rounds=300)
+    topo = build_topology("imp3d", n)
+    snaps = []
+    full = run(topo, cfg, on_chunk=lambda r, s: snaps.append((r, s)))
+    assert len(snaps) >= 2
+    r0, s0 = snaps[0]
+    resumed = run(topo, cfg, start_state=jax.tree.map(jnp.asarray, s0),
+                  start_round=r0)
+    assert resumed.rounds == full.rounds
+    assert resumed.converged_count == full.converged_count
+
+
+def test_imp_hbm_support_gating():
+    cfg = _cfg(125000)
+    assert fused_imp_hbm.imp_hbm_support(
+        build_topology("imp3d", 125000), cfg
+    ) is None
+    assert "imp" in fused_imp_hbm.imp_hbm_support(
+        build_topology("torus3d", 4096), cfg
+    )
+    assert "single-device" in fused_imp_hbm.imp_hbm_support(
+        build_topology("imp3d", 125000), _cfg(125000, n_devices=4)
+    )
+    assert "static extra edge" in fused_imp_hbm.imp_hbm_support(
+        build_topology("imp3d", 1000, semantics="reference"),
+        _cfg(1000, semantics="reference"),
+    )
+
+
+def test_dispatch_routes_imp_hbm_past_vmem_budget(monkeypatch, force_hbm):
+    from cop5615_gossip_protocol_tpu.models import runner as runner_mod
+
+    seen = {}
+    real = runner_mod._run_fused
+
+    def spy(*a, **kw):
+        seen["variant"] = kw.get("variant")
+        return real(*a, **kw)
+
+    monkeypatch.setattr(runner_mod, "_run_fused", spy)
+    n = 125000
+    r = run(build_topology("imp3d", n), _cfg(n, max_rounds=100))
+    assert seen["variant"] == "imp_hbm"
+    assert r.rounds > 0
