@@ -75,12 +75,12 @@ def test_two_process_sharded_matches_single_process(tmp_path):
     assert "Convergence Time" not in logs[1]
 
 
-def _run_pair(tmp_path, port, cli_args, expect_rc={0}):
+def _run_pair(tmp_path, port, cli_args, expect_rc={0}, timeout=300):
     outs = [tmp_path / f"rec{pid}.jsonl" for pid in range(2)]
     procs = [_spawn(pid, port, cli_args, outs[pid]) for pid in range(2)]
     logs = []
     for pr in procs:
-        out_bytes, _ = pr.communicate(timeout=300)
+        out_bytes, _ = pr.communicate(timeout=timeout)
         logs.append(out_bytes.decode(errors="replace"))
     assert all(pr.returncode in expect_rc for pr in procs), logs
     return json.loads(outs[0].read_text().splitlines()[-1])
@@ -138,6 +138,55 @@ def test_two_process_checkpoint_resume(tmp_path):
     )
     assert resumed["rounds"] == full["rounds"]
     assert resumed["converged_count"] == full["converged_count"]
+
+
+def test_two_process_fused_sharded_lattice(tmp_path):
+    # VERDICT r3 #8: the fused x sharded composition under REAL two-OS-
+    # process collectives. At chunk_rounds=1 the per-shard Pallas chunks
+    # (interpret mode on CPU) + halo ppermutes must reproduce the
+    # single-process 8-virtual-device run exactly — gossip state is
+    # integer, so rounds and counts match bit-for-bit. Population: the
+    # smallest torus whose layout splits into whole 512-row tiles on 8
+    # devices (128^3 -> 16384 rows).
+    n = 128**3
+    args = [str(n), "torus3d", "gossip", "--engine", "fused",
+            "--chunk-rounds", "1", "--max-rounds", "8"]
+    ref = run(
+        build_topology("torus3d", n),
+        SimConfig(n=n, topology="torus3d", algorithm="gossip",
+                  engine="fused", chunk_rounds=1, max_rounds=8,
+                  n_devices=8),
+    )
+    rec0 = _run_pair(
+        tmp_path, 21000 + (os.getpid() + 462) % 9000, args,
+        expect_rc={0, 1},  # capped before convergence
+        timeout=600,
+    )
+    assert rec0["rounds"] == ref.rounds
+    assert rec0["converged_count"] == ref.converged_count
+
+
+def test_two_process_fused_pool_sharded(tmp_path):
+    # The implicit-full pool composition across processes: one all_gather
+    # of the send planes per round now crosses the process boundary.
+    # Gossip ints: the two-process run must match the single-process mesh
+    # (itself bitwise the single-device fused pool engine) exactly.
+    n = 2**20
+    args = [str(n), "full", "gossip", "--delivery", "pool",
+            "--engine", "fused", "--max-rounds", "12"]
+    ref = run(
+        build_topology("full", n),
+        SimConfig(n=n, topology="full", algorithm="gossip",
+                  delivery="pool", engine="fused", max_rounds=12,
+                  n_devices=8),
+    )
+    rec0 = _run_pair(
+        tmp_path, 21000 + (os.getpid() + 539) % 9000, args,
+        expect_rc={0, 1},
+        timeout=600,
+    )
+    assert rec0["rounds"] == ref.rounds
+    assert rec0["converged_count"] == ref.converged_count
 
 
 def test_two_process_pool_pushsum(tmp_path):
