@@ -147,7 +147,9 @@ def test_two_process_fused_sharded_lattice(tmp_path):
     # single-process 8-virtual-device run exactly — gossip state is
     # integer, so rounds and counts match bit-for-bit. Population: the
     # smallest torus whose layout splits into whole 512-row tiles on 8
-    # devices (128^3 -> 16384 rows).
+    # devices (128^3 -> 16384 rows) — large for interpret mode, but the
+    # run is capped at 8 rounds (measured: both fused two-process tests
+    # together finish in ~60 s).
     n = 128**3
     args = [str(n), "torus3d", "gossip", "--engine", "fused",
             "--chunk-rounds", "1", "--max-rounds", "8"]
