@@ -101,6 +101,11 @@ def engine_us_per_round(
         # Small populations: sub-us rounds need a wider budget spread to
         # rise above the tunnel's per-dispatch jitter (+-ms).
         r1, r2 = 1024, 16_384
+    elif n > 64_000_000 and r1 == 512 and r2 == 2560:
+        # 2^27-class rounds cost ~15 ms each; the default spread would run
+        # for minutes while the differential is already thousands of x the
+        # jitter at these costs.
+        r1, r2 = 64, 320
     topo = build_topology(kind, n, seed=seed, semantics="batched")
     walls = []
     for cap in (r1, r2):

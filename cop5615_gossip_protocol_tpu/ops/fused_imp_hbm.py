@@ -50,6 +50,7 @@ from .fused_pool2 import (
     _choice_tile_pt,
     _copy_wait,
     _pick_pt,
+    _win_plan,
     latch_conv_global_streamed,
 )
 from .fused_stencil_hbm import (
@@ -273,12 +274,7 @@ def make_pushsum_imp_hbm_chunk(
                 inbox_w = jnp.zeros((PT, LANES), jnp.float32)
 
                 def fetch(e, ws_ref, ww_ref, wm_ref, sem_base):
-                    q = e // LANES
-                    ws_raw = lax.rem(
-                        r0 - q - jnp.int32(1) + jnp.int32(2 * R),
-                        jnp.int32(R),
-                    )
-                    ws8 = (ws_raw // 8) * 8
+                    ws8, rl_e, off_e = _win_plan(r0, e, R)
                     cps = [
                         pltpu.make_async_copy(
                             ds_p.at[pl.ds(ws8, PT + 16), :], ws_ref,
@@ -295,7 +291,7 @@ def make_pushsum_imp_hbm_chunk(
                     ]
                     for cp in cps:
                         cp.start()
-                    return (e % LANES, ws_raw - ws8), cps
+                    return (rl_e, off_e), cps
 
                 def one_window(e, mask_id):
                     (rl, off), cps = fetch(e, win_s, win_w, win_m, 1)
@@ -591,15 +587,6 @@ def make_gossip_imp_hbm_chunk(
                 padm = jflat >= N
                 inbox = jnp.zeros((PT, LANES), jnp.int32)
 
-                def win_params(e):
-                    q = e // LANES
-                    ws_raw = lax.rem(
-                        r0 - q - jnp.int32(1) + jnp.int32(2 * R),
-                        jnp.int32(R),
-                    )
-                    ws8 = (ws_raw // 8) * 8
-                    return ws8, e % LANES, ws_raw - ws8
-
                 # Start EVERY window's DMA before waiting on any (the
                 # stencil_hbm gossip lesson: serialized start/wait pairs
                 # leave each ~1 MB transfer's latency exposed).
@@ -612,7 +599,7 @@ def make_gossip_imp_hbm_chunk(
                 plans = []
                 cps = []
                 for wi, e in enumerate(es):
-                    ws8, rl, off = win_params(e)
+                    ws8, rl, off = _win_plan(r0, e, R)
                     cp = pltpu.make_async_copy(
                         dm_p.at[pl.ds(ws8, PT + 16), :],
                         win_all.at[wi], wsems.at[wi],

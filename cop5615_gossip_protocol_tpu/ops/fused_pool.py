@@ -65,6 +65,11 @@ from .topology import Topology
 
 LANES = 128
 TILE = POOL_TILE_ROWS  # rows per in-kernel tile; layouts are tile multiples
+# term+conv packed plane (the streaming engines and the sharded pool
+# composition): term (monotone-reset counter, < 2^30 — bounded by the round
+# count) in the low 30 bits, the latched conv flag in bit 30.
+TC_TERM_MASK = np.int32((1 << 30) - 1)
+TC_CONV_BIT = np.int32(1 << 30)
 # VMEM plane budget: push-sum needs 4 state planes + 3 doubled send planes
 # = 40 bytes/node; 2**21 nodes ~ 84 MB, inside the v5e core's ~128 MB VMEM.
 MAX_POOL_NODES = 2**21

@@ -5,35 +5,39 @@ at MAX_POOL_NODES = 2^21; beyond that the runner used to fall back to the
 chunked XLA path and per-round cost cliffed (BENCH_TABLES r2: full gossip
 0.23 ms/round at 2M -> 4.9 ms/round at 16.8M). This engine runs the same
 pool rounds with state resident in HBM, streamed through VMEM in processing
-tiles of PT rows:
+tiles of PT rows.
+
+r4 redesign (VERDICT r3 #3 — from 59% of the HBM roofline): the round is
+ONE tile sweep with no send planes at all.
 
 - state lives in two HBM plane sets (ping/pong, allocated as kernel
-  outputs); round j reads parity j%2 and writes the other — the in-place
-  hazard of a one-pass sweep (a tile's update destroying pre-round values a
-  later tile still needs) never exists;
-- each round is two tile sweeps: p1 reads (s, w) tiles, derives the packed
-  pool choices in-register (the same tagged threefry stream as the VMEM
-  engine and the chunked path), and writes halved sends + the choice/marked
-  plane to HBM scratch; p2 DMAs, per pool slot, the (PT+1)-row source
-  window of each scratch plane that a circular roll by the slot's
-  displacement needs, applies the sublane/lane decomposition of the roll
-  in-register, absorbs, and writes the next-parity state tiles;
-- the mod-n wraparound blend reads a second window at displacement d + Z
-  (Z = pad size) and selects below flat index d — statically ELIDED when
-  Z == 0, which every power-of-two population has (the bench scale points
-  2^20..2^24 all take the single-window path);
-- circular row indexing is solved with a mirrored margin instead of split
-  DMAs: scratch planes carry PT+16 extra rows holding a copy of rows
-  [0, PT+16), so any roll window starting in [0, R) is one contiguous DMA —
-  issued at an 8-row-ALIGNED start (unaligned dynamic sublane offsets fault
-  the DMA engine; the sub-8-row remainder becomes a dynamic VMEM slice);
-- convergence is checked every round in-kernel (conv counts accumulated
-  across p2 tiles); once reached the remaining grid steps are no-ops.
+  outputs) WITH mirrored margins; round j reads parity j%2 and writes the
+  other, so the current parity is immutable all round — which is exactly
+  what lets delivery read it directly:
+- per pool slot, the roll window is DMA'd from the RAW current-parity
+  state planes (8-row-ALIGNED starts; the sub-8 remainder is a dynamic
+  VMEM slice). The halve moves to the inbox: x0.5 is an exact
+  power-of-two scaling that commutes with every IEEE rounding in the
+  masked-window sum, so summing raw values and halving the total is
+  bitwise the old pre-halved-send delivery (the fused_pool_sharded
+  lemma);
+- the packed pool choice is REGENERATED inside the window consumer at the
+  window's (mirror-wrapped) global rows — threefry is position-wise, so
+  the plane never exists in memory; pad lanes fold in as choice -1
+  (deliver nothing), replacing the old send masking;
+- push-sum term+conv ride ONE packed plane (ops/fused_pool.TC_CONV_BIT);
+  gossip stores only (count, active) — conv is count >= rumor_threshold
+  by monotonicity and is derived, never stored;
+- the mod-n wraparound blend (Z > 0) fetches the second window only on
+  the single tile per slot that straddles the displacement's flat index
+  (the stencil engine's straddle predication);
+- convergence is checked every round in-kernel; once reached the
+  remaining grid steps are no-ops.
 
-HBM traffic per round per node: push-sum ~76 B (p1: read 8 write 12; p2:
-read P*12 + own 16, write 16 at pool_size 2) — ~1.3 GB at 16.8M nodes,
-~1.6 ms/round at the v5e's 819 GB/s roofline; gossip ~40 B, ~0.8 ms/round.
-Per-node cost stays in the VMEM engine's class instead of cliffing.
+HBM traffic per round per node at pool_size 2: push-sum ~44 B (own tiles
+12 r + 12 w, windows 2 slots x 2 planes x ~8.25) vs ~76 B before; gossip
+~20 B vs ~40. ~0.74 GB at 16.8M nodes, ~0.9 ms/round at the v5e's
+819 GB/s roofline.
 
 Trajectories match the chunked XLA pool path bit-for-bit for integer state
 (gossip) and up to compiler float reassociation for push-sum — the same
@@ -58,8 +62,15 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..config import SimConfig
-from .fused import clamp_cap_and_pad, threefry_bits_2d
-from .fused_pool import LANES, MAX_POOL_NODES, _lane_roll, build_pool_layout
+from .fused import clamp_cap_and_pad, threefry2x32_hash, threefry_bits_2d
+from .fused_pool import (
+    LANES,
+    MAX_POOL_NODES,
+    TC_CONV_BIT,
+    TC_TERM_MASK,
+    _lane_roll,
+    build_pool_layout,
+)
 from .sampling import POOL_CHOICE_BITS, POOL_PACK
 from .topology import Topology
 
@@ -69,8 +80,8 @@ from .topology import Topology
 # 256 exists to give the small interpret-mode test populations T >= 2 tiles.
 _PT_CANDIDATES = (2048, 1024, 512, 256)
 
-# HBM residency: 8 state planes (ping+pong) + scratch send planes. The v5e
-# chip has 16 GB; cap the engine where planes would exceed ~6 GB.
+# HBM residency: 6 state planes (ping+pong). The v5e chip has 16 GB; cap
+# the engine where planes would exceed ~6 GB.
 MAX_POOL2_NODES = 2**27
 
 
@@ -79,6 +90,16 @@ def _pick_pt(rows: int) -> int:
         if rows % pt == 0 and rows // pt >= 2:
             return pt
     raise ValueError(f"no processing tile divides {rows} rows")
+
+
+def _pick_pt_even(rows: int) -> int:
+    """Largest candidate giving an EVEN tile count (the double-buffered
+    pair loop needs one); pt=256 always qualifies (rows is a multiple of
+    512, so rows//256 is even)."""
+    for pt in _PT_CANDIDATES:
+        if rows % pt == 0 and rows // pt >= 2 and (rows // pt) % 2 == 0:
+            return pt
+    raise ValueError(f"no even tile split divides {rows} rows")
 
 
 def pool2_support(topo: Topology, cfg: SimConfig) -> Optional[str]:
@@ -123,26 +144,44 @@ def _choice_tile_pt(k1, k2, r0, pt: int, pool_size: int):
     return ((expanded >> shift) & jnp.uint32(pool_size - 1)).astype(jnp.int32)
 
 
+def _choice_window(k1, k2, ws8, rows: int, R: int, N: int, pool_size: int):
+    """[rows, 128] packed pool choices for MIRRORED-plane window rows
+    [ws8, ws8+rows), ws8 8-ALIGNED: rows >= R are the mirror of rows-R, so
+    the word-row counters wrap at R // POOL_PACK (threefry is
+    position-wise; the stream is bitwise _choice_tile_pt's — one hash per
+    packed word, expanded 8x, exactly like the tile generator). Pad lanes
+    (global flat >= N) fold in as -1: they match no slot, which replaces
+    the old send-plane pad masking. Callers park the result in a VMEM
+    scratch so the sub-8 window slices can be taken as REF slices (Mosaic
+    cannot dynamic-slice register arrays)."""
+    rows_w = rows // POOL_PACK
+    Rw = R // POOL_PACK
+    wrow = ws8 // POOL_PACK + lax.broadcasted_iota(
+        jnp.int32, (rows_w, LANES), 0
+    )
+    wrow = jnp.where(wrow >= Rw, wrow - Rw, wrow)
+    wlane = lax.broadcasted_iota(jnp.int32, (rows_w, LANES), 1)
+    i = wrow.astype(jnp.uint32) * jnp.uint32(LANES) + wlane.astype(jnp.uint32)
+    words = threefry2x32_hash(k1, k2, i)
+    expanded = jnp.repeat(words, POOL_PACK, axis=0)
+    row_i = ws8 + lax.broadcasted_iota(jnp.int32, (rows, LANES), 0)
+    lane = lax.broadcasted_iota(jnp.int32, (rows, LANES), 1)
+    # ws8 and R are both multiples of POOL_PACK, so the in-word row index
+    # survives the mirror wrap unchanged.
+    shift = (
+        jnp.uint32(POOL_CHOICE_BITS)
+        * (row_i % POOL_PACK).astype(jnp.uint32)
+    )
+    ch = ((expanded >> shift) & jnp.uint32(pool_size - 1)).astype(jnp.int32)
+    wrapped = jnp.where(row_i >= R, row_i - R, row_i)
+    jf = wrapped * LANES + lane
+    return jnp.where(jf >= N, jnp.int32(-1), ch)
+
+
 def _copy_wait(src, dst, sem):
     cp = pltpu.make_async_copy(src, dst, sem)
     cp.start()
     cp.wait()
-
-
-def latch_conv_global_streamed(c_n, scr_c, sem_d, T, PT, N, row_l, lane):
-    """HBM-streamed analog of fused_pool.latch_conv_global: write the
-    all-or-nothing global-termination conv plane (1 on valid lanes) tile
-    by tile into the parity plane holding the final state. Runs at most
-    once per run — only the round whose residual verdict fired. Shared by
-    the pool2 and stencil_hbm engines."""
-    def lt(t, _):
-        r0 = t * PT
-        padm = (r0 + row_l) * LANES + lane >= N
-        scr_c[:] = jnp.where(padm, jnp.int32(0), jnp.int32(1))
-        _copy_wait(scr_c, c_n.at[pl.ds(r0, PT), :], sem_d)
-        return 0
-
-    lax.fori_loop(0, T, lt, 0, unroll=False)
 
 
 def _copy_all(pairs, sems):
@@ -159,18 +198,91 @@ def _copy_all(pairs, sems):
         c.wait()
 
 
-def _window_contrib(wv_ref, wc_ref, off, pt, rlane, slot, lane, interpret):
-    """Contribution of one roll window to the inbox tile. The window buffer
-    was DMA'd from the 8-aligned row ws8; ``off`` is the sub-8 remainder, so
-    the roll's 'a' rows sit at [off+1, off+1+pt) and 'b' rows at
-    [off, off+pt) — dynamic VMEM slices. Source-side masking on the class
-    window, then the lane rotation blend (ops/fused_pool._make_gather)."""
-    va = wv_ref[pl.ds(off + 1, pt), :]
-    vb = wv_ref[pl.ds(off, pt), :]
-    ca = wc_ref[pl.ds(off + 1, pt), :]
-    cb = wc_ref[pl.ds(off, pt), :]
-    pa = jnp.where(ca == slot, va, 0.0)
-    pb = jnp.where(cb == slot, vb, 0.0)
+def _win_plan(r0, e, R: int):
+    """(ws8, rl, off) window plan for a circular roll by ``e`` read at tile
+    row r0: ws8 is the 8-ALIGNED DMA start row (unaligned dynamic sublane
+    offsets crash the TPU DMA engine — measured), rl the lane rotation,
+    off the sub-8 row remainder consumed as a dynamic VMEM slice. The ONE
+    home for this formula — the streaming engines and both blend variants
+    use it."""
+    q = e // LANES
+    ws_raw = lax.rem(r0 - q - jnp.int32(1) + jnp.int32(2 * R), jnp.int32(R))
+    ws8 = (ws_raw // 8) * 8
+    return ws8, e % LANES, ws_raw - ws8
+
+
+def _slot_plan(r0, d, Z: int, R: int, PT: int):
+    """(straddle, ws8, rl, off) for a pool slot's traced displacement:
+    the mod-n wrap blend reduced to the ONE variant this tile actually
+    uses (below/straddle/above three-way split); the wrap window itself
+    is fetched predicated on ``straddle`` by the caller. The single home
+    for the subtlest predicate of the zero-send-plane design."""
+    if Z == 0:
+        ws8, rl, off = _win_plan(r0, d, R)
+        return None, ws8, rl, off
+    lo = r0 * LANES
+    hi = lo + PT * LANES
+    straddle = (lo < d) & (hi > d)
+    e1 = jnp.where(straddle, d, jnp.where(lo >= d, d, d + jnp.int32(Z)))
+    ws8, rl, off = _win_plan(r0, e1, R)
+    return straddle, ws8, rl, off
+
+
+def _write_tile_and_mirrors(pairs, t, R: int, PT: int, sems):
+    """Next-parity tile write + the margin mirrors the NEXT round's
+    windows read (rows [R, R+M) copy rows [0, M)). Shared by both pool2
+    kernels — one home for the mirror layout."""
+    r0 = t * PT
+    _copy_all([(src, pln.at[pl.ds(r0, PT), :]) for src, pln in pairs], sems)
+
+    @pl.when(t == 0)
+    def _mirror0():
+        _copy_all(
+            [(src, pln.at[pl.ds(R, PT), :]) for src, pln in pairs], sems
+        )
+
+    @pl.when(t == 1)
+    def _mirror1():
+        _copy_all(
+            [
+                (src.at[pl.ds(0, 16), :], pln.at[pl.ds(R + PT, 16), :])
+                for src, pln in pairs
+            ],
+            sems,
+        )
+
+
+def latch_conv_global_streamed(c_n, scr_c, sem_d, T, PT, N, row_l, lane):
+    """HBM-streamed analog of fused_pool.latch_conv_global: write the
+    all-or-nothing global-termination conv plane (1 on valid lanes) tile
+    by tile into the parity plane holding the final state. Runs at most
+    once per run — only the round whose residual verdict fired. Used by
+    the stencil and imp streaming engines (the pool engine's packed tc
+    plane has its own bit-OR latch)."""
+    def lt(t, _):
+        r0 = t * PT
+        padm = (r0 + row_l) * LANES + lane >= N
+        scr_c[:] = jnp.where(padm, jnp.int32(0), jnp.int32(1))
+        _copy_wait(scr_c, c_n.at[pl.ds(r0, PT), :], sem_d)
+        return 0
+
+    lax.fori_loop(0, T, lt, 0, unroll=False)
+
+
+def _masked_window_roll(win_ref, ch_ref, slot, off, pt, rlane, lane,
+                        interpret, zero):
+    """Rolled window contribution: the two sub-8 row slices of the window
+    REF and the parked choice-window scratch REF (dynamic ref slices —
+    Mosaic cannot dynamic-slice register arrays), source-masked on the
+    slot, then the lane-rotation blend."""
+    pa = jnp.where(
+        ch_ref[pl.ds(off + 1, pt), :] == slot,
+        win_ref[pl.ds(off + 1, pt), :], zero,
+    )
+    pb = jnp.where(
+        ch_ref[pl.ds(off, pt), :] == slot,
+        win_ref[pl.ds(off, pt), :], zero,
+    )
     return jnp.where(
         lane >= rlane,
         _lane_roll(pa, rlane, interpret),
@@ -178,13 +290,22 @@ def _window_contrib(wv_ref, wc_ref, off, pt, rlane, slot, lane, interpret):
     )
 
 
-def _window_marked(wm_ref, off, pt, rlane, lane, interpret):
-    """Rolled marked-class window (gossip): destination sees each sender's
-    class id; -1 (non-sender) rides along and matches nothing."""
+def _counted_window_roll(act_ref, ch_ref, slot, off, pt, rlane, lane,
+                         interpret):
+    """Gossip variant: counts 1 per source whose choice matches AND whose
+    active flag (read from the raw window ref slices) is set."""
+    pa = (
+        (ch_ref[pl.ds(off + 1, pt), :] == slot)
+        & (act_ref[pl.ds(off + 1, pt), :] != 0)
+    ).astype(jnp.int32)
+    pb = (
+        (ch_ref[pl.ds(off, pt), :] == slot)
+        & (act_ref[pl.ds(off, pt), :] != 0)
+    ).astype(jnp.int32)
     return jnp.where(
         lane >= rlane,
-        _lane_roll(wm_ref[pl.ds(off + 1, pt), :], rlane, interpret),
-        _lane_roll(wm_ref[pl.ds(off, pt), :], rlane, interpret),
+        _lane_roll(pa, rlane, interpret),
+        _lane_roll(pb, rlane, interpret),
     )
 
 
@@ -193,14 +314,14 @@ def make_pushsum_pool2_chunk(
 ):
     """Returns (chunk_fn, layout): the ops/fused_pool.make_pushsum_pool_chunk
     contract — ``chunk_fn(state4, keys, offs, start, cap)`` — with state in
-    [rows, 128] layout and HBM-streamed execution."""
+    [rows, 128] layout and HBM-streamed zero-send-plane execution."""
     layout = build_pool_layout(topo.n)
     R = layout.rows
     N = layout.n
-    Z = layout.n_pad - layout.n  # 0 exactly when n is a multiple of 65536*...
-    PT = _pick_pt(R)
+    Z = layout.n_pad - layout.n
+    PT = _pick_pt_even(R)
     T = R // PT
-    M = PT + 16  # mirrored margin rows on the scratch planes
+    M = PT + 16  # mirrored margin rows on the parity planes
     P = cfg.pool_size
     delta = np.float32(cfg.resolved_delta)
     term_rounds = np.int32(cfg.term_rounds)
@@ -208,10 +329,10 @@ def make_pushsum_pool2_chunk(
     global_term = cfg.termination == "global"
 
     def kernel(
-        start_ref, keys_ref, offs_ref, s_in, w_in, t_in, c_in,
-        sA, wA, tA, cA, sB, wB, tB, cB, ds_p, dw_p, dc_p, meta_o,
-        scr_s, scr_w, scr_t, scr_c, scr_ds, scr_dw, scr_dc,
-        win_s, win_w, win_c, win_s2, win_w2, win_c2, flags, sems,
+        start_ref, keys_ref, offs_ref, s_in, w_in, tc_in,
+        sA, wA, tcA, sB, wB, tcB, meta_o,
+        scr_s, scr_w, scr_tc, scr_ch, scr_ch2,
+        win_s, win_w, win_s2, win_w2, flags, sems, own_sems,
     ):
         k = pl.program_id(0)
         K = pl.num_programs(0)
@@ -219,153 +340,146 @@ def make_pushsum_pool2_chunk(
         row_l = lax.broadcasted_iota(jnp.int32, (PT, LANES), 0)
         lane = lax.broadcasted_iota(jnp.int32, (PT, LANES), 1)
 
+        def write_tile_and_mirrors(t, pairs):
+            _write_tile_and_mirrors(pairs, t, R, PT, own_sems)
+
         @pl.when(k == 0)
         def _init():
-            # Seed parity-0 (A) from the input state and count its converged
-            # plane tile by tile — a resumed-at-convergence launch must
-            # execute zero rounds (the chunked runner's contract).
             total = jnp.int32(0)
             for t in range(T):
                 r0 = t * PT
-                _copy_wait(s_in.at[pl.ds(r0, PT), :], scr_s, sem_d)
-                _copy_wait(w_in.at[pl.ds(r0, PT), :], scr_w, sem_d)
-                _copy_wait(t_in.at[pl.ds(r0, PT), :], scr_t, sem_d)
-                _copy_wait(c_in.at[pl.ds(r0, PT), :], scr_c, sem_d)
-                _copy_wait(scr_s, sA.at[pl.ds(r0, PT), :], sem_d)
-                _copy_wait(scr_w, wA.at[pl.ds(r0, PT), :], sem_d)
-                _copy_wait(scr_t, tA.at[pl.ds(r0, PT), :], sem_d)
-                _copy_wait(scr_c, cA.at[pl.ds(r0, PT), :], sem_d)
-                total = total + jnp.sum(scr_c[:], dtype=jnp.int32)
+                _copy_all([
+                    (s_in.at[pl.ds(r0, PT), :], scr_s),
+                    (w_in.at[pl.ds(r0, PT), :], scr_w),
+                    (tc_in.at[pl.ds(r0, PT), :], scr_tc),
+                ], own_sems)
+                write_tile_and_mirrors(
+                    t, [(scr_s, sA), (scr_w, wA), (scr_tc, tcA)]
+                )
+                total = total + jnp.sum(
+                    ((scr_tc[:] & TC_CONV_BIT) != 0).astype(jnp.int32),
+                    dtype=jnp.int32,
+                )
             flags[0] = jnp.where(total >= target, 1, 0)
-            flags[1] = 0  # rounds executed; parity = flags[1] % 2
+            flags[1] = 0
 
         active = (flags[0] == 0) & (start_ref[0] + k < start_ref[1])
 
         def round_body(cur, nxt):
-            (s_c, w_c, t_c, c_c) = cur
-            (s_n, w_n, t_n, c_n) = nxt
+            (s_c, w_c, tc_c) = cur
+            (s_n, w_n, tc_n) = nxt
             kk = k % 8
             k1 = keys_ref[kk, 0]
             k2 = keys_ref[kk, 1]
 
-            def p1(t, _):
+            def win_plans(t):
+                """Per-slot window plans for tile t — a pure function of
+                (t, round offsets), so wait-side descriptor recreation is
+                exact."""
                 r0 = t * PT
-                _copy_all([
-                    (s_c.at[pl.ds(r0, PT), :], scr_s),
-                    (w_c.at[pl.ds(r0, PT), :], scr_w),
-                ], sems)
-                choice = _choice_tile_pt(k1, k2, r0, PT, P)
-                padm = (r0 + row_l) * LANES + lane >= N
-                scr_ds[:] = jnp.where(padm, 0.0, scr_s[:] * 0.5)
-                scr_dw[:] = jnp.where(padm, 0.0, scr_w[:] * 0.5)
-                scr_dc[:] = choice
-                _copy_all([
-                    (scr_ds, ds_p.at[pl.ds(r0, PT), :]),
-                    (scr_dw, dw_p.at[pl.ds(r0, PT), :]),
-                    (scr_dc, dc_p.at[pl.ds(r0, PT), :]),
-                ], sems)
-
-                @pl.when(t == 0)
-                def _mirror0():
-                    _copy_wait(scr_ds, ds_p.at[pl.ds(R, PT), :], sem_d)
-                    _copy_wait(scr_dw, dw_p.at[pl.ds(R, PT), :], sem_d)
-                    _copy_wait(scr_dc, dc_p.at[pl.ds(R, PT), :], sem_d)
-
-                @pl.when(t == 1)
-                def _mirror1():
-                    _copy_wait(
-                        scr_ds.at[pl.ds(0, 16), :], ds_p.at[pl.ds(R + PT, 16), :]
-                    , sem_d)
-                    _copy_wait(
-                        scr_dw.at[pl.ds(0, 16), :], dw_p.at[pl.ds(R + PT, 16), :]
-                    , sem_d)
-                    _copy_wait(
-                        scr_dc.at[pl.ds(0, 16), :], dc_p.at[pl.ds(R + PT, 16), :]
-                    , sem_d)
-
-                return 0
-
-            lax.fori_loop(0, T, p1, 0, unroll=False)
-
-            def p2(t, acc):
-                r0 = t * PT
-                _copy_all([
-                    (s_c.at[pl.ds(r0, PT), :], scr_s),
-                    (w_c.at[pl.ds(r0, PT), :], scr_w),
-                    (t_c.at[pl.ds(r0, PT), :], scr_t),
-                    (c_c.at[pl.ds(r0, PT), :], scr_c),
-                ], sems)
-                jflat = (r0 + row_l) * LANES + lane
-                padm = jflat >= N
-                inbox_s = jnp.zeros((PT, LANES), jnp.float32)
-                inbox_w = jnp.zeros((PT, LANES), jnp.float32)
+                plans = []
                 for slot in range(P):
                     d = offs_ref[kk, slot]
+                    straddle, ws8, rl, off = _slot_plan(r0, d, Z, R, PT)
+                    plans.append((d, straddle, ws8, rl, off))
+                return plans
 
-                    def fetch(e, ws_ref, ww_ref, wc_ref):
-                        # 8-aligned window start: unaligned dynamic sublane
-                        # DMA offsets fault the DMA engine; the remainder
-                        # becomes a dynamic VMEM slice in _window_contrib.
-                        q = e // LANES
-                        ws_raw = lax.rem(
-                            r0 - q - jnp.int32(1) + jnp.int32(2 * R), jnp.int32(R)
-                        )
-                        ws8 = (ws_raw // 8) * 8
-                        _copy_all([
-                            (ds_p.at[pl.ds(ws8, PT + 16), :], ws_ref),
-                            (dw_p.at[pl.ds(ws8, PT + 16), :], ww_ref),
-                            (dc_p.at[pl.ds(ws8, PT + 16), :], wc_ref),
-                        ], sems)
-                        return e % LANES, ws_raw - ws8
+            def win_volley(t, b):
+                """Copy descriptors for tile t's slot windows into the
+                STATIC buffer set b (double-buffered: set b prefetches
+                under set 1-b's compute). Recreated identically at wait
+                time — the standard start-now-wait-later shape."""
+                plans = win_plans(t)
+                pairs = []
+                for slot, (_, _, ws8, _, _) in enumerate(plans):
+                    pairs.append(
+                        (s_c.at[pl.ds(ws8, M), :], win_s.at[b, slot])
+                    )
+                    pairs.append(
+                        (w_c.at[pl.ds(ws8, M), :], win_w.at[b, slot])
+                    )
+                base = b * 2 * P
+                return plans, [
+                    pltpu.make_async_copy(src, dst, sems.at[base + i])
+                    for i, (src, dst) in enumerate(pairs)
+                ]
 
-                    if Z == 0:
-                        rl, off = fetch(d, win_s, win_w, win_c)
-                        cs = _window_contrib(
-                            win_s, win_c, off, PT, rl, slot, lane, interpret
+            def compute_tile(t, b, acc):
+                """One tile's round with windows already resident in
+                buffer set b; own-state tiles are fetched synchronously
+                here (3 small copies against 2P windows — the windows are
+                what double-buffering must hide)."""
+                r0 = t * PT
+                jflat = (r0 + row_l) * LANES + lane
+                padm = jflat >= N
+                plans = win_plans(t)  # copies already resident in set b
+                _copy_all([
+                    (s_c.at[pl.ds(r0, PT), :], scr_s),
+                    (w_c.at[pl.ds(r0, PT), :], scr_w),
+                    (tc_c.at[pl.ds(r0, PT), :], scr_tc),
+                ], own_sems)
+                raw_s = jnp.zeros((PT, LANES), jnp.float32)
+                raw_w = jnp.zeros((PT, LANES), jnp.float32)
+                for slot in range(P):
+                    d, straddle, ws8, rl, off = plans[slot]
+                    scr_ch[:] = _choice_window(k1, k2, ws8, M, R, N, P)
+                    cs = _masked_window_roll(
+                        win_s.at[b, slot], scr_ch, slot, off, PT, rl,
+                        lane, interpret, 0.0,
+                    )
+                    cw = _masked_window_roll(
+                        win_w.at[b, slot], scr_ch, slot, off, PT, rl,
+                        lane, interpret, 0.0,
+                    )
+                    if Z != 0:
+                        # Wrap variant only on the straddle tile (at most
+                        # one per slot per round) — start+wait inside the
+                        # predicate; stale win_*2 reads are masked out.
+                        ws8_2, rl2, off2 = _win_plan(
+                            r0, d + jnp.int32(Z), R
                         )
-                        cw = _window_contrib(
-                            win_w, win_c, off, PT, rl, slot, lane, interpret
-                        )
-                    else:
-                        rl, off = fetch(d, win_s, win_w, win_c)
-                        rl2, off2 = fetch(d + Z, win_s2, win_w2, win_c2)
-                        take = jflat >= d
+
+                        @pl.when(straddle)
+                        def _fetch_wrap():
+                            # The hash regen rides the predicate too:
+                            # stale scr_ch2 is masked by use2 exactly like
+                            # the stale window buffers.
+                            _copy_all([
+                                (s_c.at[pl.ds(ws8_2, M), :], win_s2),
+                                (w_c.at[pl.ds(ws8_2, M), :], win_w2),
+                            ], own_sems)
+                            scr_ch2[:] = _choice_window(
+                                k1, k2, ws8_2, M, R, N, P
+                            )
+                        use2 = straddle & (jflat < d)
                         cs = jnp.where(
-                            take,
-                            _window_contrib(
-                                win_s, win_c, off, PT, rl, slot, lane, interpret
-                            ),
-                            _window_contrib(
-                                win_s2, win_c2, off2, PT, rl2, slot, lane, interpret
-                            ),
+                            use2,
+                            _masked_window_roll(win_s2, scr_ch2, slot,
+                                                off2, PT, rl2, lane,
+                                                interpret, 0.0),
+                            cs,
                         )
                         cw = jnp.where(
-                            take,
-                            _window_contrib(
-                                win_w, win_c, off, PT, rl, slot, lane, interpret
-                            ),
-                            _window_contrib(
-                                win_w2, win_c2, off2, PT, rl2, slot, lane, interpret
-                            ),
+                            use2,
+                            _masked_window_roll(win_w2, scr_ch2, slot,
+                                                off2, PT, rl2, lane,
+                                                interpret, 0.0),
+                            cw,
                         )
-                    inbox_s = inbox_s + cs
-                    inbox_w = inbox_w + cw
-                # Absorb (models/pushsum.absorb; program.fs:119-143) on the
-                # streamed tile: sends recomputed from state (halves), so no
-                # send-plane readback is needed.
-                inbox_s = jnp.where(padm, 0.0, inbox_s)
-                inbox_w = jnp.where(padm, 0.0, inbox_w)
+                    raw_s = raw_s + cs
+                    raw_w = raw_w + cw
+                # Halve AFTER the masked sums — bitwise the pre-halved-send
+                # delivery (power-of-two scaling commutes with rounding).
+                half = jnp.float32(0.5)
+                inbox_s = jnp.where(padm, 0.0, raw_s * half)
+                inbox_w = jnp.where(padm, 0.0, raw_w * half)
                 s_t = scr_s[:]
                 w_t = scr_w[:]
-                s_send = jnp.where(padm, 0.0, s_t * 0.5)
-                w_send = jnp.where(padm, 0.0, w_t * 0.5)
+                s_send = jnp.where(padm, 0.0, s_t * half)
+                w_send = jnp.where(padm, 0.0, w_t * half)
                 s_new = (s_t - s_send) + inbox_s
                 w_new = (w_t - w_send) + inbox_w
                 if global_term:
-                    # Global-residual criterion: relative tolerance, term
-                    # and conv streamed through unchanged (conv is written
-                    # once, by the latch below, when the verdict fires);
-                    # the accumulator counts UNSTABLE valid lanes.
                     ratio_old = s_t / w_t
                     tol = delta * jnp.maximum(
                         jnp.abs(ratio_old), jnp.float32(1)
@@ -373,60 +487,92 @@ def make_pushsum_pool2_chunk(
                     unstable = (
                         jnp.abs(s_new / w_new - ratio_old) > tol
                     ) & ~padm
-                    term_new = scr_t[:]
-                    conv_new = scr_c[:]
+                    tc_new = scr_tc[:]
                     tile_metric = jnp.sum(
                         unstable.astype(jnp.int32), dtype=jnp.int32
                     )
                 else:
                     received = inbox_w > 0
                     stable = jnp.abs(s_new / w_new - s_t / w_t) <= delta
+                    term = scr_tc[:] & TC_TERM_MASK
+                    conv_old = (scr_tc[:] & TC_CONV_BIT) != 0
                     term_new = jnp.where(
                         received,
-                        jnp.where(stable, scr_t[:] + 1, jnp.int32(0)),
-                        scr_t[:],
+                        jnp.where(stable, term + 1, jnp.int32(0)),
+                        term,
                     )
-                    conv_new = jnp.where(
-                        padm,
-                        jnp.int32(0),
-                        jnp.where(
-                            (scr_c[:] != 0) | (term_new >= term_rounds),
-                            jnp.int32(1),
-                            jnp.int32(0),
-                        ),
+                    conv_new = (
+                        conv_old | (term_new >= term_rounds)
+                    ) & ~padm
+                    tc_new = jnp.where(
+                        conv_new, term_new | TC_CONV_BIT, term_new
                     )
-                    tile_metric = jnp.sum(conv_new, dtype=jnp.int32)
+                    tile_metric = jnp.sum(
+                        conv_new.astype(jnp.int32), dtype=jnp.int32
+                    )
                 scr_s[:] = s_new
                 scr_w[:] = w_new
-                scr_t[:] = term_new
-                scr_c[:] = conv_new
-                _copy_all([
-                    (scr_s, s_n.at[pl.ds(r0, PT), :]),
-                    (scr_w, w_n.at[pl.ds(r0, PT), :]),
-                    (scr_t, t_n.at[pl.ds(r0, PT), :]),
-                    (scr_c, c_n.at[pl.ds(r0, PT), :]),
-                ], sems)
+                scr_tc[:] = tc_new
+                write_tile_and_mirrors(
+                    t, [(scr_s, s_n), (scr_w, w_n), (scr_tc, tc_n)]
+                )
                 return acc + tile_metric
 
-            total = lax.fori_loop(0, T, p2, jnp.int32(0), unroll=False)
+            # Pair loop over (even, odd) tiles with STATIC window buffer
+            # parity: set b's windows prefetch UNDER set 1-b's compute, so
+            # the 2P-window volley latency — what bounded the single-volley
+            # design — hides behind real work. T is even by _pick_pt_even.
+            for cp in win_volley(0, 0)[1]:
+                cp.start()
+
+            def pair(u, acc):
+                t0 = 2 * u
+                t1 = t0 + 1
+                for cp in win_volley(t0, 0)[1]:
+                    cp.wait()
+                for cp in win_volley(t1, 1)[1]:
+                    cp.start()
+                acc = compute_tile(t0, 0, acc)
+                for cp in win_volley(t1, 1)[1]:
+                    cp.wait()
+
+                @pl.when(u + 1 < T // 2)
+                def _prefetch():
+                    for cp in win_volley(t0 + 2, 0)[1]:
+                        cp.start()
+
+                acc = compute_tile(t1, 1, acc)
+                return acc
+
+            total = lax.fori_loop(0, T // 2, pair, jnp.int32(0), unroll=False)
             flags[1] = flags[1] + 1
             if global_term:
-                # Zero unstable lanes: every node cleared the relative
-                # residual this round. Latch the all-or-nothing conv plane
-                # into the parity that now holds the final state (runs at
-                # most once per run).
+                # Zero unstable lanes — OR the conv bit into the packed
+                # plane of the final-state parity (at most once per run).
                 @pl.when(total == 0)
                 def _latch():
-                    latch_conv_global_streamed(
-                        c_n, scr_c, sem_d, T, PT, N, row_l, lane
-                    )
+                    def lt(t, _):
+                        r0 = t * PT
+                        padm = (r0 + row_l) * LANES + lane >= N
+                        _copy_wait(
+                            tc_n.at[pl.ds(r0, PT), :], scr_tc, sem_d
+                        )
+                        scr_tc[:] = jnp.where(
+                            padm, scr_tc[:], scr_tc[:] | TC_CONV_BIT
+                        )
+                        _copy_wait(
+                            scr_tc, tc_n.at[pl.ds(r0, PT), :], sem_d
+                        )
+                        return 0
+
+                    lax.fori_loop(0, T, lt, 0, unroll=False)
 
                 flags[0] = jnp.where(total == 0, 1, 0)
             else:
                 flags[0] = jnp.where(total >= target, 1, 0)
 
-        A = (sA, wA, tA, cA)
-        B = (sB, wB, tB, cB)
+        A = (sA, wA, tcA)
+        B = (sB, wB, tcB)
         # Snapshot the parity BEFORE the branches: round_body increments
         # flags[1], and a predicate reading flags[1] after the first branch
         # ran would fire the second branch in the same grid step.
@@ -447,19 +593,17 @@ def make_pushsum_pool2_chunk(
 
     def chunk_fn(state4, keys, offs, start, cap):
         s, w, t, c = state4
+        tc = jnp.where(c != 0, t | TC_CONV_BIT, t)
         cap, keys, offs = clamp_cap_and_pad(start, cap, keys, ((offs, 1),))
         K = keys.shape[0]
-        f32 = jax.ShapeDtypeStruct((R, LANES), jnp.float32)
-        i32 = jax.ShapeDtypeStruct((R, LANES), jnp.int32)
         f32m = jax.ShapeDtypeStruct((R + M, LANES), jnp.float32)
         i32m = jax.ShapeDtypeStruct((R + M, LANES), jnp.int32)
         outs = pl.pallas_call(
             kernel,
             grid=(K,),
             out_shape=(
-                f32, f32, i32, i32,  # parity A
-                f32, f32, i32, i32,  # parity B
-                f32m, f32m, i32m,    # send/choice scratch planes
+                f32m, f32m, i32m,  # parity A
+                f32m, f32m, i32m,  # parity B
                 jax.ShapeDtypeStruct((2,), jnp.int32),
             ),
             in_specs=[
@@ -469,28 +613,24 @@ def make_pushsum_pool2_chunk(
                 pl.BlockSpec(memory_space=pl.ANY),
                 pl.BlockSpec(memory_space=pl.ANY),
                 pl.BlockSpec(memory_space=pl.ANY),
-                pl.BlockSpec(memory_space=pl.ANY),
             ],
             out_specs=tuple(
-                [pl.BlockSpec(memory_space=pl.ANY)] * 11
+                [pl.BlockSpec(memory_space=pl.ANY)] * 6
                 + [pl.BlockSpec(memory_space=pltpu.SMEM)]
             ),
             scratch_shapes=[
                 pltpu.VMEM((PT, LANES), jnp.float32),
                 pltpu.VMEM((PT, LANES), jnp.float32),
                 pltpu.VMEM((PT, LANES), jnp.int32),
-                pltpu.VMEM((PT, LANES), jnp.int32),
-                pltpu.VMEM((PT, LANES), jnp.float32),
-                pltpu.VMEM((PT, LANES), jnp.float32),
-                pltpu.VMEM((PT, LANES), jnp.int32),
-                pltpu.VMEM((PT + 16, LANES), jnp.float32),
-                pltpu.VMEM((PT + 16, LANES), jnp.float32),
-                pltpu.VMEM((PT + 16, LANES), jnp.int32),
-                pltpu.VMEM((PT + 16, LANES), jnp.float32),
-                pltpu.VMEM((PT + 16, LANES), jnp.float32),
-                pltpu.VMEM((PT + 16, LANES), jnp.int32),
+                pltpu.VMEM((M, LANES), jnp.int32),
+                pltpu.VMEM((M, LANES), jnp.int32),
+                pltpu.VMEM((2, P, M, LANES), jnp.float32),
+                pltpu.VMEM((2, P, M, LANES), jnp.float32),
+                pltpu.VMEM((M, LANES), jnp.float32),
+                pltpu.VMEM((M, LANES), jnp.float32),
                 pltpu.SMEM((2,), jnp.int32),
-                pltpu.SemaphoreType.DMA((4,)),
+                pltpu.SemaphoreType.DMA((4 * P,)),
+                pltpu.SemaphoreType.DMA((3,)),
             ],
             compiler_params=pltpu.CompilerParams(
                 vmem_limit_bytes=96 * 1024 * 1024
@@ -500,9 +640,9 @@ def make_pushsum_pool2_chunk(
             jnp.stack([jnp.int32(start), jnp.int32(cap)]),
             keys,
             offs,
-            s, w, t, c,
+            s, w, tc,
         )
-        meta = outs[11]
+        meta = outs[6]
         parity = meta[1]
 
         def sel(a, b):
@@ -510,8 +650,12 @@ def make_pushsum_pool2_chunk(
 
         # A zero-round launch needs no fallback: _init seeds parity A from
         # the input state at k == 0, so sel() returns the input unchanged.
-        state_out = tuple(sel(outs[i], outs[4 + i]) for i in range(4))
-        return state_out, meta[0]
+        s2 = sel(outs[0], outs[3])[:R]
+        w2 = sel(outs[1], outs[4])[:R]
+        tc2 = sel(outs[2], outs[5])[:R]
+        t2 = tc2 & TC_TERM_MASK
+        c2 = ((tc2 & TC_CONV_BIT) != 0).astype(jnp.int32)
+        return (s2, w2, t2, c2), meta[0]
 
     return chunk_fn, layout
 
@@ -519,8 +663,11 @@ def make_pushsum_pool2_chunk(
 def make_gossip_pool2_chunk(
     topo: Topology, cfg: SimConfig, *, interpret: bool = False
 ):
-    """Gossip analog: one marked plane (class id or -1) carries the sends;
-    suppression is receiver-side on the streamed conv tile."""
+    """Gossip analog, two planes only: (count, active). conv is
+    count >= rumor_threshold BY MONOTONICITY (count never decreases and the
+    latch compares the same bound — models/gossip.absorb), so it is derived
+    at read points and never stored; delivery windows read the RAW active
+    plane and regenerate the choice mask in the consumer."""
     layout = build_pool_layout(topo.n)
     R = layout.rows
     N = layout.n
@@ -534,9 +681,9 @@ def make_gossip_pool2_chunk(
     target = np.int32(cfg.resolved_target_count(topo.n, topo.target_count))
 
     def kernel(
-        start_ref, keys_ref, offs_ref, n_in, a_in, c_in,
-        nA, aA, cA, nB, aB, cB, dm_p, meta_o,
-        scr_n, scr_a, scr_c, scr_m, win_m, win_m2, flags, sems,
+        start_ref, keys_ref, offs_ref, n_in, a_in,
+        nA, aA, nB, aB, meta_o,
+        scr_n, scr_a, scr_ch, scr_ch2, win_a, win_a2, flags, sems,
     ):
         k = pl.program_id(0)
         K = pl.num_programs(0)
@@ -544,113 +691,107 @@ def make_gossip_pool2_chunk(
         row_l = lax.broadcasted_iota(jnp.int32, (PT, LANES), 0)
         lane = lax.broadcasted_iota(jnp.int32, (PT, LANES), 1)
 
+        def write_tile_and_mirrors(t, pairs):
+            _write_tile_and_mirrors(pairs, t, R, PT, sems)
+
         @pl.when(k == 0)
         def _init():
             total = jnp.int32(0)
             for t in range(T):
                 r0 = t * PT
-                _copy_wait(n_in.at[pl.ds(r0, PT), :], scr_n, sem_d)
-                _copy_wait(a_in.at[pl.ds(r0, PT), :], scr_a, sem_d)
-                _copy_wait(c_in.at[pl.ds(r0, PT), :], scr_c, sem_d)
-                _copy_wait(scr_n, nA.at[pl.ds(r0, PT), :], sem_d)
-                _copy_wait(scr_a, aA.at[pl.ds(r0, PT), :], sem_d)
-                _copy_wait(scr_c, cA.at[pl.ds(r0, PT), :], sem_d)
-                total = total + jnp.sum(scr_c[:], dtype=jnp.int32)
+                _copy_all([
+                    (n_in.at[pl.ds(r0, PT), :], scr_n),
+                    (a_in.at[pl.ds(r0, PT), :], scr_a),
+                ], sems)
+                write_tile_and_mirrors(t, [(scr_n, nA), (scr_a, aA)])
+                total = total + jnp.sum(
+                    (scr_n[:] >= rumor_target).astype(jnp.int32),
+                    dtype=jnp.int32,
+                )
             flags[0] = jnp.where(total >= target, 1, 0)
             flags[1] = 0
 
         active = (flags[0] == 0) & (start_ref[0] + k < start_ref[1])
 
         def round_body(cur, nxt):
-            (n_c, a_c, c_c) = cur
-            (n_n, a_n, c_n) = nxt
+            (n_c, a_c) = cur
+            (n_n, a_n) = nxt
             kk = k % 8
             k1 = keys_ref[kk, 0]
             k2 = keys_ref[kk, 1]
 
-            def p1(t, _):
+            def tile(t, acc):
                 r0 = t * PT
-                _copy_wait(a_c.at[pl.ds(r0, PT), :], scr_a, sem_d)
-                choice = _choice_tile_pt(k1, k2, r0, PT, P)
-                padm = (r0 + row_l) * LANES + lane >= N
-                sending = (scr_a[:] != 0) & ~padm
-                scr_m[:] = jnp.where(sending, choice, jnp.int32(-1))
-                _copy_wait(scr_m, dm_p.at[pl.ds(r0, PT), :], sem_d)
-
-                @pl.when(t == 0)
-                def _mirror0():
-                    _copy_wait(scr_m, dm_p.at[pl.ds(R, PT), :], sem_d)
-
-                @pl.when(t == 1)
-                def _mirror1():
-                    _copy_wait(
-                        scr_m.at[pl.ds(0, 16), :], dm_p.at[pl.ds(R + PT, 16), :]
-                    , sem_d)
-
-                return 0
-
-            lax.fori_loop(0, T, p1, 0, unroll=False)
-
-            def p2(t, acc):
-                r0 = t * PT
-                _copy_all([
-                    (n_c.at[pl.ds(r0, PT), :], scr_n),
-                    (a_c.at[pl.ds(r0, PT), :], scr_a),
-                    (c_c.at[pl.ds(r0, PT), :], scr_c),
-                ], sems)
                 jflat = (r0 + row_l) * LANES + lane
                 padm = jflat >= N
-                inbox = jnp.zeros((PT, LANES), jnp.int32)
+                # One DMA volley per tile (see the push-sum kernel).
+                plans = []
+                pairs = [
+                    (n_c.at[pl.ds(r0, PT), :], scr_n),
+                    (a_c.at[pl.ds(r0, PT), :], scr_a),
+                ]
                 for slot in range(P):
                     d = offs_ref[kk, slot]
-
-                    def fetch(e, wm_ref):
-                        q = e // LANES
-                        ws_raw = lax.rem(
-                            r0 - q - jnp.int32(1) + jnp.int32(2 * R), jnp.int32(R)
+                    straddle, ws8, rl, off = _slot_plan(r0, d, Z, R, PT)
+                    plans.append((d, straddle, ws8, rl, off))
+                    pairs.append((a_c.at[pl.ds(ws8, M), :], win_a.at[slot]))
+                _copy_all(pairs, sems)
+                inbox = jnp.zeros((PT, LANES), jnp.int32)
+                for slot in range(P):
+                    d, straddle, ws8, rl, off = plans[slot]
+                    scr_ch[:] = _choice_window(k1, k2, ws8, M, R, N, P)
+                    g = _counted_window_roll(
+                        win_a.at[slot], scr_ch, slot, off, PT, rl, lane,
+                        interpret,
+                    )
+                    if Z != 0:
+                        ws8_2, rl2, off2 = _win_plan(
+                            r0, d + jnp.int32(Z), R
                         )
-                        ws8 = (ws_raw // 8) * 8  # aligned DMA start
-                        _copy_wait(dm_p.at[pl.ds(ws8, PT + 16), :], wm_ref, sem_d)
-                        return e % LANES, ws_raw - ws8
 
-                    if Z == 0:
-                        rl, off = fetch(d, win_m)
-                        g = _window_marked(win_m, off, PT, rl, lane, interpret)
-                    else:
-                        rl, off = fetch(d, win_m)
-                        rl2, off2 = fetch(d + Z, win_m2)
+                        @pl.when(straddle)
+                        def _fetch_wrap():
+                            _copy_wait(
+                                a_c.at[pl.ds(ws8_2, M), :], win_a2, sem_d
+                            )
+                            scr_ch2[:] = _choice_window(
+                                k1, k2, ws8_2, M, R, N, P
+                            )
+                        use2 = straddle & (jflat < d)
                         g = jnp.where(
-                            jflat >= d,
-                            _window_marked(win_m, off, PT, rl, lane, interpret),
-                            _window_marked(win_m2, off2, PT, rl2, lane, interpret),
+                            use2,
+                            _counted_window_roll(
+                                win_a2, scr_ch2, slot, off2, PT, rl2,
+                                lane, interpret,
+                            ),
+                            g,
                         )
-                    inbox = inbox + jnp.where(g == slot, jnp.int32(1), jnp.int32(0))
+                    inbox = inbox + g
                 inbox = jnp.where(padm, jnp.int32(0), inbox)
                 if suppress:
-                    inbox = jnp.where(scr_c[:] != 0, jnp.int32(0), inbox)
+                    # Receiver-side suppression vs the round-start conv
+                    # (= round-start count latch, derived).
+                    inbox = jnp.where(
+                        scr_n[:] >= rumor_target, jnp.int32(0), inbox
+                    )
                 count_new = scr_n[:] + inbox
                 active_new = jnp.where(
                     (scr_a[:] != 0) | (inbox > 0), jnp.int32(1), jnp.int32(0)
                 )
-                conv_new = jnp.where(
-                    count_new >= rumor_target, jnp.int32(1), jnp.int32(0)
-                )
+                conv_new = (count_new >= rumor_target) & ~padm
                 scr_n[:] = count_new
                 scr_a[:] = active_new
-                scr_c[:] = conv_new
-                _copy_all([
-                    (scr_n, n_n.at[pl.ds(r0, PT), :]),
-                    (scr_a, a_n.at[pl.ds(r0, PT), :]),
-                    (scr_c, c_n.at[pl.ds(r0, PT), :]),
-                ], sems)
-                return acc + jnp.sum(conv_new, dtype=jnp.int32)
+                write_tile_and_mirrors(t, [(scr_n, n_n), (scr_a, a_n)])
+                return acc + jnp.sum(
+                    conv_new.astype(jnp.int32), dtype=jnp.int32
+                )
 
-            total = lax.fori_loop(0, T, p2, jnp.int32(0), unroll=False)
+            total = lax.fori_loop(0, T, tile, jnp.int32(0), unroll=False)
             flags[1] = flags[1] + 1
             flags[0] = jnp.where(total >= target, 1, 0)
 
-        A = (nA, aA, cA)
-        B = (nB, aB, cB)
+        A = (nA, aA)
+        B = (nB, aB)
         par = flags[1] % 2  # snapshot before the mutating branches
 
         @pl.when(active & (par == 0))
@@ -667,15 +808,14 @@ def make_gossip_pool2_chunk(
             meta_o[1] = flags[1] % 2
 
     def chunk_fn(state3, keys, offs, start, cap):
-        cnt, act, cv = state3
+        cnt, act, _cv = state3
         cap, keys, offs = clamp_cap_and_pad(start, cap, keys, ((offs, 1),))
-        i32 = jax.ShapeDtypeStruct((R, LANES), jnp.int32)
         i32m = jax.ShapeDtypeStruct((R + M, LANES), jnp.int32)
         outs = pl.pallas_call(
             kernel,
             grid=(keys.shape[0],),
             out_shape=(
-                i32, i32, i32, i32, i32, i32, i32m,
+                i32m, i32m, i32m, i32m,
                 jax.ShapeDtypeStruct((2,), jnp.int32),
             ),
             in_specs=[
@@ -684,21 +824,20 @@ def make_gossip_pool2_chunk(
                 pl.BlockSpec((8, P), lambda k: (k // 8, 0), memory_space=pltpu.SMEM),
                 pl.BlockSpec(memory_space=pl.ANY),
                 pl.BlockSpec(memory_space=pl.ANY),
-                pl.BlockSpec(memory_space=pl.ANY),
             ],
             out_specs=tuple(
-                [pl.BlockSpec(memory_space=pl.ANY)] * 7
+                [pl.BlockSpec(memory_space=pl.ANY)] * 4
                 + [pl.BlockSpec(memory_space=pltpu.SMEM)]
             ),
             scratch_shapes=[
                 pltpu.VMEM((PT, LANES), jnp.int32),
                 pltpu.VMEM((PT, LANES), jnp.int32),
-                pltpu.VMEM((PT, LANES), jnp.int32),
-                pltpu.VMEM((PT, LANES), jnp.int32),
-                pltpu.VMEM((PT + 16, LANES), jnp.int32),
-                pltpu.VMEM((PT + 16, LANES), jnp.int32),
+                pltpu.VMEM((M, LANES), jnp.int32),
+                pltpu.VMEM((M, LANES), jnp.int32),
+                pltpu.VMEM((P, M, LANES), jnp.int32),
+                pltpu.VMEM((M, LANES), jnp.int32),
                 pltpu.SMEM((2,), jnp.int32),
-                pltpu.SemaphoreType.DMA((4,)),
+                pltpu.SemaphoreType.DMA((2 + P,)),
             ],
             compiler_params=pltpu.CompilerParams(
                 vmem_limit_bytes=96 * 1024 * 1024
@@ -708,16 +847,20 @@ def make_gossip_pool2_chunk(
             jnp.stack([jnp.int32(start), jnp.int32(cap)]),
             keys,
             offs,
-            cnt, act, cv,
+            cnt, act,
         )
-        meta = outs[7]
+        meta = outs[4]
         parity = meta[1]
 
         def sel(a, b):
             return jnp.where(parity == 0, a, b)
 
-        # Zero-round launches return parity A, seeded from the input at init.
-        state_out = tuple(sel(outs[i], outs[3 + i]) for i in range(3))
-        return state_out, meta[0]
+        # Zero-round launches return parity A, seeded from the input at
+        # init. conv is derived — count is monotone and the latch compares
+        # the same bound every round.
+        n2 = sel(outs[0], outs[2])[:R]
+        a2 = sel(outs[1], outs[3])[:R]
+        c2 = (n2 >= rumor_target).astype(jnp.int32)
+        return (n2, a2, c2), meta[0]
 
     return chunk_fn, layout

@@ -54,7 +54,12 @@ from jax.experimental.pallas import tpu as pltpu
 from ..config import SimConfig
 from .fused import clamp_cap_and_pad, threefry_bits_2d
 from .fused_pool import LANES, _lane_roll, build_pool_layout
-from .fused_pool2 import _copy_wait, _pick_pt, latch_conv_global_streamed
+from .fused_pool2 import (
+    _copy_wait,
+    _pick_pt,
+    _win_plan,
+    latch_conv_global_streamed,
+)
 from .topology import Topology, stencil_offsets
 
 MAX_STENCIL_HBM_NODES = 2**27
@@ -225,18 +230,6 @@ def _window_vals(wv_ref, wm_ref, off, pt, rlane, d_c, lane, interpret):
         _lane_roll(pa, rlane, interpret),
         _lane_roll(pb, rlane, interpret),
     )
-
-
-def _win_plan(r0, e, R: int):
-    """(ws8, rl, off) window plan for a circular roll by ``e`` read at tile
-    row r0: ws8 is the 8-ALIGNED DMA start row (unaligned dynamic sublane
-    offsets crash the TPU DMA engine — measured), rl the lane rotation,
-    off the sub-8 row remainder consumed as a dynamic VMEM slice. The ONE
-    home for this formula — both kernels and both blend variants use it."""
-    q = e // LANES
-    ws_raw = lax.rem(r0 - q - jnp.int32(1) + jnp.int32(2 * R), jnp.int32(R))
-    ws8 = (ws_raw // 8) * 8
-    return ws8, e % LANES, ws_raw - ws8
 
 
 def _window_marked(wm_ref, off, pt, rlane, lane, interpret):

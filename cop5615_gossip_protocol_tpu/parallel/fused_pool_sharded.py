@@ -56,6 +56,8 @@ from jax.experimental.pallas import tpu as pltpu
 from ..config import SimConfig
 from ..ops.fused_pool import (
     LANES,
+    TC_CONV_BIT as _TC_CONV_BIT,
+    TC_TERM_MASK as _TC_TERM_MASK,
     TILE,
     _choice_tile,
     _copy_in,
@@ -66,11 +68,6 @@ from ..ops.fused_pool import (
     pool_common_support,
 )
 from ..ops.topology import Topology
-
-# term+conv packed plane: term (monotone-reset counter, < 2^30 — bounded by
-# the round count) in the low 30 bits, the latched conv flag in bit 30.
-_TC_TERM_MASK = np.int32((1 << 30) - 1)
-_TC_CONV_BIT = np.int32(1 << 30)
 
 
 def plan_fused_pool_sharded(topo: Topology, cfg: SimConfig, n_dev: int):
