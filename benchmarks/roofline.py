@@ -48,42 +48,58 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
 HBM_ROOF_GBS = 819.0  # v5e chip HBM bandwidth
+# v5e VPU 32-bit elementwise issue roof: 8 sublanes x 128 lanes x 4 ALUs
+# x ~940 MHz ~ 3.85 T ops/s. The ops models below count vector ops per
+# node per round (threefry words amortized over their packing) — a +-30%
+# estimate whose job is classifying rows as issue-bound vs
+# latency/slice-bound, not precision.
+VPU_ROOF_OPS = 3.85e12
 
 # (label, kind, algorithm, n, cfg overrides, bound class,
-#  model bytes/node/round or None, justification)
+#  model bytes/node/round or None, model VPU ops/node/round or None,
+#  justification)
 POINTS = (
     ("chunked scatter", "imp3d", "push-sum", 1_000_000,
      dict(delivery="scatter", engine="chunked"), "addressing-bound",
-     None,
+     None, None,
      "sort-based scatter over n random static edges; the chip's "
      "~8-12 ns/element dynamic-address floor (measured across every "
      "gather/scatter formulation) x 2 channels bounds the round, not HBM"),
     ("chunked stencil", "torus3d", "push-sum", 1_000_000,
      dict(delivery="stencil", engine="chunked"), "HBM-streaming",
-     32 + 8 * 12,
+     32 + 8 * 12, None,
      "12 displacement classes; XLA materializes each masked roll as its "
      "own HBM pass instead of fusing into one sweep"),
     ("chunked pool", "full", "push-sum", 1_048_576,
      dict(delivery="pool", engine="chunked", pool_size=4), "HBM-streaming",
-     32 + 8 * 4 + 1,
+     32 + 8 * 4 + 1, None,
      "K=4 masked dynamic rolls; same XLA materialization overhead"),
     ("fused stencil2", "torus3d", "push-sum", 1_000_000,
      dict(delivery="stencil", engine="fused"), "VMEM-resident",
-     None, "state resident across the whole chunk; VPU-op-bound"),
+     None, 390,
+     "state resident across the whole chunk; ops model: full-width "
+     "sampling word ~100 + 12-column select ~25 + 12 classes x ~20 "
+     "(2-plane masked tile gathers + lane roll) + absorb ~25"),
     ("fused pool", "full", "push-sum", 1_048_576,
      dict(delivery="pool", engine="fused", pool_size=2), "VMEM-resident",
-     None, "state resident across the whole chunk; VPU-op-bound"),
+     None, 86,
+     "state resident across the whole chunk; ops model: packed choice "
+     "~13 + sends ~8 + 2 slots x ~20 gather + absorb ~25"),
     ("fused imp", "imp3d", "push-sum", 1_000_000,
      dict(delivery="pool", engine="fused", pool_size=4), "VMEM-resident",
-     None, "lattice + pooled long-range classes, state resident"),
+     None, 360,
+     "lattice + pooled long-range classes, state resident; ops model: "
+     "word ~100 + choice ~13 + class select ~20 + 10 classes x ~20 + "
+     "absorb ~25"),
     ("pool2 (HBM stream)", "full", "push-sum", 16_777_216,
      dict(delivery="pool", engine="fused", pool_size=2), "HBM-streaming",
-     52 + 12 * 2,
-     "ping/pong planes + per-slot roll windows; DMA-issue overhead and "
-     "the p1/p2 split account for the rest"),
+     44, None,
+     "r4 zero-send-plane design: raw-window reads + in-consumer choice "
+     "regen + packed term/conv; the remaining gap to the roof is the "
+     "synchronous per-tile write volley (RUNLOG r4)"),
     ("stencil hbm", "torus3d", "push-sum", 16_777_216,
      dict(delivery="stencil", engine="fused"), "HBM-streaming",
-     40 + 12 * 12,
+     40 + 12 * 12, None,
      "12 displacement classes x 3-plane windows dominate; the arithmetic "
      "in-kernel columns keep the neighbor structure out of HBM entirely"),
 )
@@ -103,16 +119,25 @@ def section() -> list[str]:
         "and are VPU-op-bound (their implied 'bandwidth' would be VMEM "
         "traffic, far above the HBM roof — that is the point); the "
         "sort-based scatter tier is bounded by the chip's measured "
-        "~8-12 ns/element dynamic-address floor, not bandwidth.",
+        "~8-12 ns/element dynamic-address floor, not bandwidth. "
+        "VMEM-resident rows carry a vector-ops model instead "
+        f"(% of the ~{VPU_ROOF_OPS/1e12:.1f} T ops/s 32-bit issue roof; "
+        "VERDICT r3 #9): rows well under ~50% are not issue-bound either "
+        "— their tiled gathers are dynamic-slice/roll sequences whose "
+        "dependency chains and sub-tile moves cap issue, the same class "
+        "of floor the r3 microbenchmarks measured for every "
+        "dynamic-addressing formulation.",
         "",
         "| engine tier | config | µs/round | model B/node/round | "
-        "implied GB/s | % HBM roof | bound class |",
-        "|---|---|---|---|---|---|---|",
+        "implied GB/s | % HBM roof | model ops/node/round | % VPU issue "
+        "| bound class |",
+        "|---|---|---|---|---|---|---|---|---|",
     ]
     from benchmarks.compare import ENGINE_US_NOISE
 
     notes = []
-    for label, kind, _algo, n, overrides, klass, model_b, why in POINTS:
+    for label, kind, _algo, n, overrides, klass, model_b, model_ops, why \
+            in POINTS:
         r1, r2 = (64, 320) if n > 4_000_000 else (256, 1280)
         us = engine_us_per_round(kind, "push-sum", n, r1=r1, r2=r2,
                                  **overrides)
@@ -127,10 +152,16 @@ def section() -> list[str]:
         else:
             gbs_s, pct = "—", "—"
             model_s = str(model_b) if model_b is not None else "—"
+        if model_ops is not None and not below_noise:
+            vpu = n * model_ops / (us * 1e-6)
+            vpu_s = f"{100 * vpu / VPU_ROOF_OPS:.0f}%"
+            ops_s = f"~{model_ops}"
+        else:
+            vpu_s, ops_s = "—", "—"
         us_s = f"<{ENGINE_US_NOISE}" if below_noise else f"{us:,.1f}"
         out.append(
             f"| {label} | {kind} {n:,} | {us_s} | {model_s} "
-            f"| {gbs_s} | {pct} | {klass} |"
+            f"| {gbs_s} | {pct} | {ops_s} | {vpu_s} | {klass} |"
         )
         notes.append(f"- **{label}**: {why}.")
         print(f"[roofline] {label}: {us:.1f} us/round", flush=True)
