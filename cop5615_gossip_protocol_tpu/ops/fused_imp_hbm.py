@@ -47,9 +47,9 @@ from ..config import SimConfig
 from .fused import clamp_cap_and_pad, threefry_bits_2d
 from .fused_pool import LANES, build_pool_layout
 from .fused_pool2 import (
+    _PT_CANDIDATES,
     _choice_tile_pt,
     _copy_wait,
-    _pick_pt,
     _win_plan,
     latch_conv_global_streamed,
 )
@@ -136,6 +136,65 @@ def _imp_dirs(topo: Topology):
     return dirs, offs, len(offs)
 
 
+_WIN_VMEM_BUDGET = 64 * 2**20
+
+
+def _pick_pt_win(rows: int, planes: int) -> int:
+    """Largest processing tile whose batched window volley (``planes``
+    resident (PT+16, LANES) 4-byte planes) fits the VMEM budget — the
+    start-all-then-wait shape (ADVICE r4 #2) is worth a smaller tile."""
+    for pt in _PT_CANDIDATES:
+        if rows % pt == 0 and rows // pt >= 2:
+            if planes * (pt + 16) * LANES * 4 <= _WIN_VMEM_BUDGET:
+                return pt
+    raise ValueError(
+        f"no processing tile fits {planes} batched window planes of "
+        f"{rows} rows in the {_WIN_VMEM_BUDGET >> 20} MiB VMEM budget "
+        "(unreachable while imp_hbm_support caps pool_size at "
+        f"{1 << POOL_CHOICE_BITS})"
+    )
+
+
+def _volley_targets(lat_shifts, offs_ref, kk, P: int, Z: int):
+    """Window displacement list in the order both consume loops index:
+    lattice classes (sorted-offset order, signed padded-space shifts),
+    then per-pool-slot traced offsets — doubled with the d+Z variant at
+    padded populations (the blend pair rides adjacent indices). Indexes
+    ``offs_ref`` one scalar at a time (SMEM loads are scalar-only)."""
+    es = [jnp.int32(sh) for sh in lat_shifts]
+    for slot in range(P):
+        e = offs_ref[kk, slot]
+        es.append(e)
+        if Z != 0:
+            es.append(e + jnp.int32(Z))
+    return es
+
+
+def _volley_windows(r0, es, planes, wsems, R: int, PT: int):
+    """Start EVERY window's DMA for every plane before waiting on any
+    (the stencil_hbm gossip lesson: serialized start/wait pairs leave
+    each ~1 MB transfer's latency exposed, len(es) x len(planes) times
+    per tile). ``planes`` is [(src HBM plane, (n_win, PT+16, LANES)
+    stacked VMEM dst)]; semaphores are flat, one per in-flight copy.
+    Returns the per-window (rotate-lane, offset) plans."""
+    np_ = len(planes)
+    plans = []
+    cps = []
+    for wi, e in enumerate(es):
+        ws8, rl, off = _win_plan(r0, e, R)
+        for pi, (src, dst) in enumerate(planes):
+            cp = pltpu.make_async_copy(
+                src.at[pl.ds(ws8, PT + 16), :],
+                dst.at[wi], wsems.at[np_ * wi + pi],
+            )
+            cp.start()
+            cps.append(cp)
+        plans.append((rl, off))
+    for cp in cps:
+        cp.wait()
+    return plans
+
+
 def _sample_class_imp(bits, choice, jflat, padm, dirs, cls_of, L: int):
     """Sampled class id + send gate for one tile: slot = untagged word %
     degree over [lattice dirs..., extra]; lattice slots map to their
@@ -167,13 +226,14 @@ def make_pushsum_imp_hbm_chunk(
     R = layout.rows
     N = layout.n
     Z = layout.n_pad - layout.n
-    PT = _pick_pt(R)
-    T = R // PT
-    M = PT + 16
     dirs, lat_offs, L = _imp_dirs(topo)
     cls_of = {d: q for q, d in enumerate(lat_offs)}
     lat_shifts = [_signed_pad_shift(d, N, layout.n_pad) for d in lat_offs]
     P = cfg.pool_size
+    n_win = L + P * (1 if Z == 0 else 2)
+    PT = _pick_pt_win(R, 3 * n_win)
+    T = R // PT
+    M = PT + 16
     delta = np.float32(cfg.resolved_delta)
     term_rounds = np.int32(cfg.term_rounds)
     target = np.int32(cfg.resolved_target_count(topo.n, topo.target_count))
@@ -183,7 +243,7 @@ def make_pushsum_imp_hbm_chunk(
         start_ref, keys_ref, offs_ref, ckeys_ref, s_in, w_in, t_in, c_in,
         sA, wA, tA, cA, sB, wB, tB, cB, ds_p, dw_p, dm_p, meta_o,
         scr_s, scr_w, scr_t, scr_c, scr_ds, scr_dw, scr_dm,
-        win_s, win_w, win_m, win_s2, win_w2, win_m2, flags, sems,
+        win_vs, win_vw, win_vm, flags, sems, wsems,
     ):
         k = pl.program_id(0)
         K = pl.num_programs(0)
@@ -273,70 +333,44 @@ def make_pushsum_imp_hbm_chunk(
                 inbox_s = jnp.zeros((PT, LANES), jnp.float32)
                 inbox_w = jnp.zeros((PT, LANES), jnp.float32)
 
-                def fetch(e, ws_ref, ww_ref, wm_ref, sem_base):
-                    ws8, rl_e, off_e = _win_plan(r0, e, R)
-                    cps = [
-                        pltpu.make_async_copy(
-                            ds_p.at[pl.ds(ws8, PT + 16), :], ws_ref,
-                            sems.at[sem_base],
-                        ),
-                        pltpu.make_async_copy(
-                            dw_p.at[pl.ds(ws8, PT + 16), :], ww_ref,
-                            sems.at[sem_base + 1],
-                        ),
-                        pltpu.make_async_copy(
-                            dm_p.at[pl.ds(ws8, PT + 16), :], wm_ref,
-                            sems.at[sem_base + 2],
-                        ),
-                    ]
-                    for cp in cps:
-                        cp.start()
-                    return (rl_e, off_e), cps
+                # Batched three-plane volley (ADVICE r4 #2 — the gossip
+                # sibling's shape, now shared via _volley_windows).
+                es = _volley_targets(lat_shifts, offs_ref, kk, P, Z)
+                plans = _volley_windows(
+                    r0, es,
+                    ((ds_p, win_vs), (dw_p, win_vw), (dm_p, win_vm)),
+                    wsems, R, PT,
+                )
 
-                def one_window(e, mask_id):
-                    (rl, off), cps = fetch(e, win_s, win_w, win_m, 1)
-                    for cp in cps:
-                        cp.wait()
+                def consume(wi, mask_id):
+                    rl, off = plans[wi]
                     cs = _window_vals(
-                        win_s, win_m, off, PT, rl, mask_id, lane, interpret
+                        win_vs.at[wi], win_vm.at[wi], off, PT, rl,
+                        mask_id, lane, interpret,
                     )
                     cw = _window_vals(
-                        win_w, win_m, off, PT, rl, mask_id, lane, interpret
+                        win_vw.at[wi], win_vm.at[wi], off, PT, rl,
+                        mask_id, lane, interpret,
                     )
                     return cs, cw
 
                 # Lattice classes, sorted order, signed single windows.
-                for q, sh in enumerate(lat_shifts):
-                    cs, cw = one_window(jnp.int32(sh), q)
+                for q in range(L):
+                    cs, cw = consume(q, q)
                     inbox_s = inbox_s + cs
                     inbox_w = inbox_w + cw
                 # Pool slots: mod-n traced displacements (blend at Z > 0).
+                stride = 1 if Z == 0 else 2
                 for slot in range(P):
-                    e = offs_ref[kk, slot]
+                    wi = L + slot * stride
                     if Z == 0:
-                        cs, cw = one_window(e, L + slot)
+                        cs, cw = consume(wi, L + slot)
                     else:
-                        (rl, off), cps = fetch(e, win_s, win_w, win_m, 1)
-                        (rl2, off2), cps2 = fetch(
-                            e + jnp.int32(Z), win_s2, win_w2, win_m2, 4
-                        )
-                        for cp in cps + cps2:
-                            cp.wait()
-                        take = jflat >= e
-                        cs = jnp.where(
-                            take,
-                            _window_vals(win_s, win_m, off, PT, rl,
-                                         L + slot, lane, interpret),
-                            _window_vals(win_s2, win_m2, off2, PT, rl2,
-                                         L + slot, lane, interpret),
-                        )
-                        cw = jnp.where(
-                            take,
-                            _window_vals(win_w, win_m, off, PT, rl,
-                                         L + slot, lane, interpret),
-                            _window_vals(win_w2, win_m2, off2, PT, rl2,
-                                         L + slot, lane, interpret),
-                        )
+                        cs_a, cw_a = consume(wi, L + slot)
+                        cs_b, cw_b = consume(wi + 1, L + slot)
+                        take = jflat >= offs_ref[kk, slot]
+                        cs = jnp.where(take, cs_a, cs_b)
+                        cw = jnp.where(take, cw_a, cw_b)
                     inbox_s = inbox_s + cs
                     inbox_w = inbox_w + cw
 
@@ -460,14 +494,12 @@ def make_pushsum_imp_hbm_chunk(
                 pltpu.VMEM((PT, LANES), jnp.float32),
                 pltpu.VMEM((PT, LANES), jnp.float32),
                 pltpu.VMEM((PT, LANES), jnp.int32),
-                pltpu.VMEM((PT + 16, LANES), jnp.float32),
-                pltpu.VMEM((PT + 16, LANES), jnp.float32),
-                pltpu.VMEM((PT + 16, LANES), jnp.int32),
-                pltpu.VMEM((PT + 16, LANES), jnp.float32),
-                pltpu.VMEM((PT + 16, LANES), jnp.float32),
-                pltpu.VMEM((PT + 16, LANES), jnp.int32),
+                pltpu.VMEM((n_win, PT + 16, LANES), jnp.float32),
+                pltpu.VMEM((n_win, PT + 16, LANES), jnp.float32),
+                pltpu.VMEM((n_win, PT + 16, LANES), jnp.int32),
                 pltpu.SMEM((2,), jnp.int32),
-                pltpu.SemaphoreType.DMA((7,)),
+                pltpu.SemaphoreType.DMA((1,)),
+                pltpu.SemaphoreType.DMA((3 * n_win,)),
             ],
             compiler_params=pltpu.CompilerParams(
                 vmem_limit_bytes=96 * 1024 * 1024
@@ -499,15 +531,15 @@ def make_gossip_imp_hbm_chunk(
     R = layout.rows
     N = layout.n
     Z = layout.n_pad - layout.n
-    PT = _pick_pt(R)
-    T = R // PT
-    M = PT + 16
     dirs, lat_offs, L = _imp_dirs(topo)
     cls_of = {d: q for q, d in enumerate(lat_offs)}
     lat_shifts = [_signed_pad_shift(d, N, layout.n_pad) for d in lat_offs]
     P = cfg.pool_size
     # Window slots: L lattice (single) + P pool (doubled when blended).
     n_win = L + P * (1 if Z == 0 else 2)
+    PT = _pick_pt_win(R, n_win)
+    T = R // PT
+    M = PT + 16
     rumor_target = np.int32(cfg.resolved_rumor_target)
     suppress = cfg.resolved_suppress
     target = np.int32(cfg.resolved_target_count(topo.n, topo.target_count))
@@ -587,28 +619,11 @@ def make_gossip_imp_hbm_chunk(
                 padm = jflat >= N
                 inbox = jnp.zeros((PT, LANES), jnp.int32)
 
-                # Start EVERY window's DMA before waiting on any (the
-                # stencil_hbm gossip lesson: serialized start/wait pairs
-                # leave each ~1 MB transfer's latency exposed).
-                es = [jnp.int32(sh) for sh in lat_shifts]
-                for slot in range(P):
-                    e = offs_ref[kk, slot]
-                    es.append(e)
-                    if Z != 0:
-                        es.append(e + jnp.int32(Z))
-                plans = []
-                cps = []
-                for wi, e in enumerate(es):
-                    ws8, rl, off = _win_plan(r0, e, R)
-                    cp = pltpu.make_async_copy(
-                        dm_p.at[pl.ds(ws8, PT + 16), :],
-                        win_all.at[wi], wsems.at[wi],
-                    )
-                    cp.start()
-                    cps.append(cp)
-                    plans.append((rl, off))
-                for cp in cps:
-                    cp.wait()
+                # Batched marked-plane volley (shared _volley_windows).
+                es = _volley_targets(lat_shifts, offs_ref, kk, P, Z)
+                plans = _volley_windows(
+                    r0, es, ((dm_p, win_all),), wsems, R, PT
+                )
 
                 for q in range(L):
                     rl, off = plans[q]

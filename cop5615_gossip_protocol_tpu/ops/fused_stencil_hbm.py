@@ -131,9 +131,10 @@ def _lattice_params(topo: Topology):
         # Chain wiring {i-1, i+1} over the whole population (ref2d is the
         # reference's "2D", Q6 — line wiring over the squared population).
         def dirs(idx):
+            in_lat = idx < n_lat
             return [
-                (idx > 0, jnp.full(idx.shape, n - 1, i32)),
-                (idx < n_lat - 1, jnp.full(idx.shape, 1, i32)),
+                (in_lat & (idx > 0), jnp.full(idx.shape, n - 1, i32)),
+                (in_lat & (idx < n_lat - 1), jnp.full(idx.shape, 1, i32)),
             ]
         return dirs, False
 
