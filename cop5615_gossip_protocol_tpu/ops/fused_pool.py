@@ -292,7 +292,8 @@ def _copy_in(pairs, sems):
 
 def absorb_pushsum_tile(r0, padm, inbox_s, inbox_w,
                         s_v, w_v, t_v, c_v, ds_v, dw_v,
-                        delta, term_rounds, global_term: bool = False):
+                        delta, term_rounds, global_term: bool = False,
+                        count_mask=None):
     """One tile of models/pushsum.absorb (program.fs:119-143) against VMEM
     state planes: s_keep = s - s_send (sends read back from the first copy
     of the doubled planes), term advances only on receipt, conv latches,
@@ -307,7 +308,12 @@ def absorb_pushsum_tile(r0, padm, inbox_s, inbox_w,
     the tile's count of UNSTABLE valid lanes (relative tolerance
     delta * max(|ratio|, 1)); the caller stops when the round's total is
     zero. Non-receiving lanes have Δ = 0 and never block, exactly as in
-    the chunked oracle."""
+    the chunked oracle.
+
+    ``count_mask`` (optional [TILE, 128] bool) further restricts the
+    RETURNED global-mode metric — not the state update — to a subregion:
+    the sharded compositions count only their middle (non-halo) rows, whose
+    redundant halo copies are counted by the row's home shard."""
     inbox_s = jnp.where(padm, 0.0, inbox_s)
     inbox_w = jnp.where(padm, 0.0, inbox_w)
     s_t = s_v[pl.ds(r0, TILE), :]
@@ -318,6 +324,8 @@ def absorb_pushsum_tile(r0, padm, inbox_s, inbox_w,
         ratio_old = s_t / w_t
         tol = delta * jnp.maximum(jnp.abs(ratio_old), jnp.float32(1))
         unstable = (jnp.abs(s_new / w_new - ratio_old) > tol) & ~padm
+        if count_mask is not None:
+            unstable = unstable & count_mask
         s_v[pl.ds(r0, TILE), :] = s_new
         w_v[pl.ds(r0, TILE), :] = w_new
         return jnp.sum(unstable.astype(jnp.int32), dtype=jnp.int32)

@@ -1,0 +1,1036 @@
+"""HBM-streaming stencil x sharded: lattice scale PAST VMEM, across chips.
+
+parallel/fused_sharded.py composes the VMEM-resident fused engines with node
+sharding, which caps the PER-SHARD population at the VMEM plane budget
+(~2^21 pool / ~1.2M stencil slots). One chip alone streams 2^27 nodes
+through HBM (ops/fused_stencil_hbm.py) — so sharding used to SHRINK the
+reachable population instead of multiplying it (VERDICT r4 missing #1).
+This module runs the HBM-streaming stencil engine inside the same
+halo-amortized shard_map skeleton:
+
+- each device holds its shard of the global [R_glob, 128] padded node
+  layout plus an H-row halo per side, ALL IN HBM (that is the point);
+- one super-step = one ppermute pair per plane (halo exchange), then ONE
+  per-shard `pallas_call` that streams PT-row processing tiles through VMEM
+  for CR whole rounds — ping/pong parity planes, mirrored-margin delivery
+  windows, in-consumer threefry at GLOBAL positions: the single-device
+  streamed architecture of ops/fused_stencil_hbm.py re-indexed so that
+  extended row r is global row (row0 + r) mod R_glob;
+- halo regions are recomputed redundantly and stay valid for exactly CR
+  rounds: delivery is exact in slot space (out[j] reads in[j - e]), so
+  contamination from the buffer edges advances at most w slots per round
+  (w = the largest in-buffer window shift) and H >= ceil(CR*w/128) + 1
+  rows keeps the middle shard exact — the parallel/fused_sharded.py
+  invariant, unchanged by streaming;
+- convergence composes at super-step boundaries: local termination psums
+  the last round's middle-region converged count (CR-granular, exact at
+  chunk_rounds=1); termination='global' psums the kernel's PER-ROUND
+  middle unstable-lane counts and, when an interior round's global count
+  hits zero, REruns the chunk capped at that round — the stop round and
+  final state are exactly the sharded chunked global path's
+  (parallel/sharded.py + models/pushsum.absorb global_termination).
+
+Delivery windows ride the extended ring: per class d, the in-buffer
+circular roll pair (e1 for receivers at global flat >= d, e2 below — the
+fused_sharded blend); non-wrap lattices need only the signed single window
+(boundary live-masks already kill every would-be wrapping sender, the
+ops/fused_stencil_hbm._signed_pad_shift argument), and wrap lattices at
+Z = 0 have e1 == e2. When the blend is live (wrap, Z > 0), a tile fetches
+ONE window at the variant it actually uses; only tiles whose global slot
+interval contains a blend crossing (at most ~2 per class per device) fetch
+the second, predicated — the streamed engines' straddle-tile scheme with
+the tile->global map made runtime (row0-dependent).
+
+The aggregate population ceiling is therefore n_dev * (single-chip HBM
+budget): 8 x 2^27 = 2^30 nodes on the BASELINE.json v4-8 shape — sharding
+now multiplies the ceiling. Trajectories match the chunked sharded path
+bit-for-bit for integer state (gossip) and up to compiler reassociation
+for push-sum (tests/test_fused_hbm_sharded.py; tests_tpu/ on hardware).
+
+Reference mapping: C15's recast of the reference's whole runtime — the
+lattice hot loop (program.fs:89-105, 110-143) over Imp3D-family wirings
+(program.fs:295-306), actor-per-node on one machine's threads capped at
+~2000 nodes (program.fs:23, report.pdf p.3 §4) — at a billion nodes
+across a mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..config import SimConfig
+from ..ops.fused import clamp_cap_and_pad, threefry2x32_hash
+from ..ops.fused_pool import LANES, build_pool_layout
+from ..ops.fused_pool2 import _copy_all, _win_plan
+from ..ops.fused_stencil_hbm import (
+    _HBM_KINDS,
+    _lattice_params,
+    _sample_disp_dirs,
+    _window_marked,
+    _window_vals,
+)
+from ..ops.topology import Topology, stencil_offsets
+from .fused_sharded import _signed_pad
+
+_PT_CANDIDATES = (2048, 1024, 512, 256)
+# Per-device HBM for the kernel's resident planes (state parities +
+# delivery). The v5e chip has 16 GB; leave room for the XLA-side extended
+# inputs and collective buffers.
+_HBM_PLANE_BUDGET = 12 * 2**30
+_VMEM_SCRATCH_BUDGET = 80 * 2**20
+
+
+def _halo_width_slots(topo: Topology, layout) -> int:
+    """Largest |in-buffer shift| any delivery window uses — the per-round
+    contamination advance from the extended buffer's edges."""
+    offsets = [int(d) for d in stencil_offsets(topo)]
+    _, wrap = _lattice_params(topo)
+    n_pad = layout.n_pad
+    N = layout.n
+    w = 0
+    for d in offsets:
+        if wrap:
+            w = max(w, abs(_signed_pad(-d, n_pad)), abs(_signed_pad(N - d, n_pad)))
+        else:
+            w = max(w, abs(d if d <= N // 2 else d - N))
+    return w
+
+
+def plan_stencil_hbm_sharded(topo: Topology, cfg: SimConfig, n_dev: int):
+    """(H, rows_loc, CR, PT, layout) or a string reason why not.
+
+    Mirrors plan_fused_sharded's gates; the budgets differ: state lives in
+    HBM, so the population check is the per-device HBM plane budget (the
+    single-chip tier's 2^27-class ceiling, times the mesh), and VMEM only
+    bounds the PT-row streaming scratch."""
+    if topo.implicit:
+        return (
+            "implicit (full) topology has no displacement structure for "
+            "the halo composition; use delivery='pool' (the fused pool x "
+            "sharded composition)"
+        )
+    if topo.kind not in _HBM_KINDS:
+        return (
+            f"topology {topo.kind!r} has no arithmetic displacement "
+            f"columns (served kinds: {', '.join(_HBM_KINDS)})"
+        )
+    offsets = stencil_offsets(topo)
+    if offsets is None:
+        return f"topology {topo.kind!r} has no small displacement set"
+    if cfg.dtype != "float32":
+        return "fused engine supports float32 only"
+    if not jax.config.jax_threefry_partitionable:
+        return "requires jax_threefry_partitionable=True"
+    if cfg.fault_rate > 0:
+        return "fault injection not supported in the fused kernel"
+    if cfg.delivery == "scatter":
+        return (
+            "the fused kernel delivers via the stencil formulation only; "
+            "delivery='scatter' would be silently ignored"
+        )
+    layout = build_pool_layout(topo.n)
+    R = layout.rows
+    if R % n_dev != 0:
+        return (
+            f"padded layout ({R} rows) must split evenly; {n_dev} devices "
+            "do not divide it"
+        )
+    rows_loc = R // n_dev
+    Z = layout.n_pad - layout.n
+    _, wrap = _lattice_params(topo)
+    blend = wrap and Z != 0
+    w = _halo_width_slots(topo, layout)
+    pushsum = cfg.algorithm == "push-sum"
+    hbm_planes = 11 if pushsum else 7  # 2 parities x state + delivery
+    win_per_class = (3 if pushsum else 1) * (2 if blend else 1)
+    n_win = len(offsets) * win_per_class
+    CR0 = max(1, min(int(cfg.chunk_rounds), 64))
+
+    def fit(cr):
+        h_min = -(-(cr * w) // LANES) + 1
+        cands = []
+        for pt in _PT_CANDIDATES:
+            r = (-rows_loc) % pt
+            if r % 2:
+                continue  # 2H cannot hit an odd residue mod an even PT
+            h = h_min + ((r // 2 - h_min) % (pt // 2))
+            rows_ext = rows_loc + 2 * h
+            if rows_ext // pt < 2 or h > rows_loc:
+                continue
+            vmem = (
+                (7 if pushsum else 4) * pt * LANES * 4
+                + n_win * (pt + 16) * LANES * 4
+            )
+            if vmem > _VMEM_SCRATCH_BUDGET:
+                continue
+            if hbm_planes * (rows_ext + pt + 16) * LANES * 4 > _HBM_PLANE_BUDGET:
+                continue
+            cands.append((rows_ext, pt, h))
+        if not cands:
+            return None
+        # Largest PT whose halo waste stays within ~12% of the leanest
+        # candidate: fewer, larger DMA volleys per round beat a few percent
+        # of redundant halo rows.
+        lean = min(c[0] for c in cands)
+        ok = [c for c in cands if c[0] <= lean + max(lean // 8, 1)]
+        return max(ok, key=lambda c: c[1])
+
+    CR = CR0
+    while CR > 1 and fit(CR) is None:
+        CR //= 2
+    b = fit(CR)
+    if b is None:
+        return (
+            f"no processing-tile split fits: per-round halo ({w} slots) at "
+            f"a {rows_loc}-row shard exceeds the shard, the VMEM streaming "
+            "scratch, or the per-device HBM plane budget even at "
+            "chunk_rounds=1; use the chunked collective engine"
+        )
+    _, PT, H = b
+    return (H, rows_loc, CR, PT, layout)
+
+
+def _class_windows(topo: Topology, layout, rows_ext: int):
+    """Per class d: (d, e1, e2) in-buffer forward roll amounts over the
+    extended ring (n_ext = rows_ext * 128). e1 serves receivers at global
+    flat >= d, e2 those below (the mod-n blend of fused_sharded). e2 is
+    None when one window is exact for every receiver: non-wrap lattices
+    (the signed shift — boundary masks kill every would-be wrapping
+    sender) and wrap lattices at Z = 0 (both variants coincide)."""
+    offsets = [int(d) for d in stencil_offsets(topo)]
+    _, wrap = _lattice_params(topo)
+    n_pad = layout.n_pad
+    N = layout.n
+    n_ext = rows_ext * LANES
+    out = []
+    for d in offsets:
+        if wrap:
+            e1 = (-_signed_pad(-d, n_pad)) % n_ext
+            e2 = (-_signed_pad(N - d, n_pad)) % n_ext
+            out.append((d, e1, None if e1 == e2 else e2))
+        else:
+            sd = d if d <= N // 2 else d - N
+            out.append((d, sd % n_ext, None))
+    return out
+
+
+def _tile_blend_plan(row0, r0, d: int, R_glob: int, n_pad: int, PT: int):
+    """Scalar blend facts for one (tile, class): the tile's global slot
+    interval is [lo, lo + PT*128) mod n_pad; the blend select
+    (take = gflat >= d) changes value only at crossings d and 0, so a tile
+    containing neither is UNIFORM and needs one window — the variant of its
+    first slot. Conservative at the lo == crossing edge (marks nonuniform,
+    costing one spare fetch, never correctness). Returns
+    (nonuniform, take_lo) traced booleans."""
+    lo = lax.rem(row0 + r0, jnp.int32(R_glob)) * jnp.int32(LANES)
+    PTL = jnp.int32(PT * LANES)
+    npj = jnp.int32(n_pad)
+    c_d = lax.rem(jnp.int32(d) - lo + 2 * npj, npj) < PTL
+    c_0 = lax.rem(npj - lo, npj) < PTL
+    return c_d | c_0, lo >= jnp.int32(d)
+
+
+def _start_class_volley(windows, r0, row0, pairs, wsems, stride: int,
+                        R_glob: int, n_pad: int, PT: int, M: int,
+                        rows_ext: int):
+    """Start every class's PRIMARY window DMA before waiting on any (the
+    stencil_hbm gossip lesson — serialized start/wait pairs leave each ~MB
+    transfer's latency exposed), at the blend variant this tile actually
+    uses; tiles containing a blend crossing (at most ~2 per class per
+    device) fetch the second variant predicated, start+wait inside the
+    pl.when. ``pairs`` is [(hbm_plane, window_stack), ...] — one pair for
+    the gossip marked plane, three (ds, dw, dm) for push-sum. Returns
+    (plans, wrap_plans, nonunis, cps); callers wait on ``cps`` and consume
+    through the (rl, off) plans. The ONE home for the composition's
+    subtlest predicate, shared by both kernels."""
+    n_pairs = len(pairs)
+    plans, cps, nonunis = [], [], []
+    for ci, (d_c, e1, e2) in enumerate(windows):
+        if e2 is None:
+            e_sel = jnp.int32(e1)
+            nonunis.append(None)
+        else:
+            nonuni, take_lo = _tile_blend_plan(
+                row0, r0, d_c, R_glob, n_pad, PT
+            )
+            nonunis.append(nonuni)
+            e_sel = jnp.where(
+                nonuni | take_lo, jnp.int32(e1), jnp.int32(e2)
+            )
+        ws8, rl, off = _win_plan(r0, e_sel, rows_ext)
+        slot = ci * stride
+        for si, (pln, wref) in enumerate(pairs):
+            cp = pltpu.make_async_copy(
+                pln.at[pl.ds(ws8, M), :], wref.at[slot],
+                wsems.at[slot * n_pairs + si],
+            )
+            cp.start()
+            cps.append(cp)
+        plans.append((rl, off))
+    wrap_plans = []
+    for ci, (d_c, e1, e2) in enumerate(windows):
+        if e2 is None:
+            wrap_plans.append(None)
+            continue
+        ws8_2, rl2, off2 = _win_plan(r0, jnp.int32(e2), rows_ext)
+        wrap_plans.append((rl2, off2))
+        slot2 = ci * stride + 1
+
+        @pl.when(nonunis[ci])
+        def _fetch_wrap(ws8_2=ws8_2, slot2=slot2):
+            cps2 = [
+                pltpu.make_async_copy(
+                    pln.at[pl.ds(ws8_2, M), :], wref.at[slot2],
+                    wsems.at[slot2 * n_pairs + si],
+                )
+                for si, (pln, wref) in enumerate(pairs)
+            ]
+            for cp2 in cps2:
+                cp2.start()
+            for cp2 in cps2:
+                cp2.wait()
+
+    return plans, wrap_plans, nonunis, cps
+
+
+def make_pushsum_stencil_hbm_shard_chunk(
+    topo: Topology, cfg: SimConfig, H: int, rows_loc: int, PT: int,
+    layout, *, interpret: bool = False
+):
+    """Per-device chunk kernel: ``chunk_fn(ext_state, keys, row0, start,
+    cap) -> (mid_state4, executed, u)`` runs up to K = keys.shape[0]
+    push-sum rounds on one device's halo-extended planes, HBM-streamed.
+    ``row0`` is the extended buffer's first GLOBAL row (pre-wrapped);
+    ``u[k]`` is round k's middle-region metric — unstable valid lanes
+    under termination='global', converged count otherwise; -1 on rounds
+    not executed."""
+    R_glob = layout.rows
+    N = layout.n
+    n_pad = layout.n_pad
+    Z = n_pad - N
+    rows_ext = rows_loc + 2 * H
+    T = rows_ext // PT
+    M = PT + 16
+    dirs_builder, wrap = _lattice_params(topo)
+    blend = wrap and Z != 0
+    windows = _class_windows(topo, layout, rows_ext)
+    C = len(windows)
+    stride = 2 if blend else 1
+    delta = np.float32(cfg.resolved_delta)
+    term_rounds = np.int32(cfg.term_rounds)
+    global_term = cfg.termination == "global"
+
+    def kernel(
+        scal_ref, keys_ref, s_in, w_in, t_in, c_in,
+        sA, wA, tA, cA, sB, wB, tB, cB, ds_p, dw_p, dm_p, meta_o, u_o,
+        scr_s, scr_w, scr_t, scr_c, scr_ds, scr_dw, scr_dm,
+        win_s, win_w, win_m, flags, sems, wsems,
+    ):
+        k = pl.program_id(0)
+        K = pl.num_programs(0)
+        row_l = lax.broadcasted_iota(jnp.int32, (PT, LANES), 0)
+        lane = lax.broadcasted_iota(jnp.int32, (PT, LANES), 1)
+        row0 = scal_ref[0]
+
+        def tile_globals(r0):
+            grow = lax.rem(row0 + r0 + row_l, jnp.int32(R_glob))
+            gflat = grow * LANES + lane
+            return grow, gflat
+
+        @pl.when(k == 0)
+        def _init():
+            def cp(t, _):
+                r0 = t * PT
+                _copy_all([
+                    (s_in.at[pl.ds(r0, PT), :], scr_s),
+                    (w_in.at[pl.ds(r0, PT), :], scr_w),
+                    (t_in.at[pl.ds(r0, PT), :], scr_t),
+                    (c_in.at[pl.ds(r0, PT), :], scr_c),
+                ], sems)
+                _copy_all([
+                    (scr_s, sA.at[pl.ds(r0, PT), :]),
+                    (scr_w, wA.at[pl.ds(r0, PT), :]),
+                    (scr_t, tA.at[pl.ds(r0, PT), :]),
+                    (scr_c, cA.at[pl.ds(r0, PT), :]),
+                ], sems)
+                return 0
+
+            lax.fori_loop(0, T, cp, 0, unroll=False)
+            flags[0] = 0  # rounds executed
+
+        u_o[k] = jnp.int32(-1)
+        active = scal_ref[1] + k < scal_ref[2]
+
+        def round_body(cur, nxt):
+            (s_c, w_c, t_c, c_c) = cur
+            (s_n, w_n, t_n, c_n) = nxt
+            kk = k % 8
+            k1 = keys_ref[kk, 0]
+            k2 = keys_ref[kk, 1]
+
+            def p1(t, _):
+                r0 = t * PT
+                _copy_all([
+                    (s_c.at[pl.ds(r0, PT), :], scr_s),
+                    (w_c.at[pl.ds(r0, PT), :], scr_w),
+                ], sems)
+                grow, gflat = tile_globals(r0)
+                padm = gflat >= N
+                bits = threefry2x32_hash(
+                    k1, k2,
+                    grow.astype(jnp.uint32) * jnp.uint32(LANES)
+                    + lane.astype(jnp.uint32),
+                )
+                d, deg_t = _sample_disp_dirs(bits, dirs_builder(gflat))
+                send_ok = (deg_t > 0) & ~padm
+                scr_ds[:] = jnp.where(send_ok, scr_s[:] * 0.5, 0.0)
+                scr_dw[:] = jnp.where(send_ok, scr_w[:] * 0.5, 0.0)
+                scr_dm[:] = jnp.where(send_ok, d, jnp.int32(-1))
+                _copy_all([
+                    (scr_ds, ds_p.at[pl.ds(r0, PT), :]),
+                    (scr_dw, dw_p.at[pl.ds(r0, PT), :]),
+                    (scr_dm, dm_p.at[pl.ds(r0, PT), :]),
+                ], sems)
+
+                @pl.when(t == 0)
+                def _mirror0():
+                    _copy_all([
+                        (scr_ds, ds_p.at[pl.ds(rows_ext, PT), :]),
+                        (scr_dw, dw_p.at[pl.ds(rows_ext, PT), :]),
+                        (scr_dm, dm_p.at[pl.ds(rows_ext, PT), :]),
+                    ], sems)
+
+                @pl.when(t == 1)
+                def _mirror1():
+                    _copy_all([
+                        (scr_ds.at[pl.ds(0, 16), :],
+                         ds_p.at[pl.ds(rows_ext + PT, 16), :]),
+                        (scr_dw.at[pl.ds(0, 16), :],
+                         dw_p.at[pl.ds(rows_ext + PT, 16), :]),
+                        (scr_dm.at[pl.ds(0, 16), :],
+                         dm_p.at[pl.ds(rows_ext + PT, 16), :]),
+                    ], sems)
+
+                return 0
+
+            lax.fori_loop(0, T, p1, 0, unroll=False)
+
+            def p2(t, acc):
+                r0 = t * PT
+                _copy_all([
+                    (s_c.at[pl.ds(r0, PT), :], scr_s),
+                    (w_c.at[pl.ds(r0, PT), :], scr_w),
+                    (t_c.at[pl.ds(r0, PT), :], scr_t),
+                    (c_c.at[pl.ds(r0, PT), :], scr_c),
+                ], sems)
+                _, gflat = tile_globals(r0)
+                padm = gflat >= N
+                mid = (row_l + r0 >= H) & (row_l + r0 < H + rows_loc)
+
+                plans, wrap_plans, nonunis, cps = _start_class_volley(
+                    windows, r0, row0,
+                    [(ds_p, win_s), (dw_p, win_w), (dm_p, win_m)],
+                    wsems, stride, R_glob, n_pad, PT, M, rows_ext,
+                )
+                for cp in cps:
+                    cp.wait()
+
+                inbox_s = jnp.zeros((PT, LANES), jnp.float32)
+                inbox_w = jnp.zeros((PT, LANES), jnp.float32)
+                for ci, (d_c, e1, e2) in enumerate(windows):
+                    rl, off = plans[ci]
+                    s1 = ci * stride
+                    cs = _window_vals(
+                        win_s.at[s1], win_m.at[s1], off, PT, rl, d_c,
+                        lane, interpret,
+                    )
+                    cw = _window_vals(
+                        win_w.at[s1], win_m.at[s1], off, PT, rl, d_c,
+                        lane, interpret,
+                    )
+                    if e2 is not None:
+                        rl2, off2 = wrap_plans[ci]
+                        s2 = s1 + 1
+                        use2 = nonunis[ci] & (gflat < d_c)
+                        cs = jnp.where(
+                            use2,
+                            _window_vals(win_s.at[s2], win_m.at[s2], off2,
+                                         PT, rl2, d_c, lane, interpret),
+                            cs,
+                        )
+                        cw = jnp.where(
+                            use2,
+                            _window_vals(win_w.at[s2], win_m.at[s2], off2,
+                                         PT, rl2, d_c, lane, interpret),
+                            cw,
+                        )
+                    inbox_s = inbox_s + cs
+                    inbox_w = inbox_w + cw
+                inbox_s = jnp.where(padm, 0.0, inbox_s)
+                inbox_w = jnp.where(padm, 0.0, inbox_w)
+                s_t = scr_s[:]
+                w_t = scr_w[:]
+                s_send = jnp.where(padm, 0.0, s_t * 0.5)
+                w_send = jnp.where(padm, 0.0, w_t * 0.5)
+                s_new = (s_t - s_send) + inbox_s
+                w_new = (w_t - w_send) + inbox_w
+                if global_term:
+                    # Global residual: term/conv stream through unchanged
+                    # (the XLA side latches conv after the psum'd verdict);
+                    # the metric counts MIDDLE unstable valid lanes.
+                    ratio_old = s_t / w_t
+                    tol = delta * jnp.maximum(
+                        jnp.abs(ratio_old), jnp.float32(1)
+                    )
+                    unstable = (
+                        jnp.abs(s_new / w_new - ratio_old) > tol
+                    ) & ~padm & mid
+                    term_new = scr_t[:]
+                    conv_new = scr_c[:]
+                    tile_metric = jnp.sum(
+                        unstable.astype(jnp.int32), dtype=jnp.int32
+                    )
+                else:
+                    received = inbox_w > 0
+                    stable = jnp.abs(s_new / w_new - s_t / w_t) <= delta
+                    term_new = jnp.where(
+                        received,
+                        jnp.where(stable, scr_t[:] + 1, jnp.int32(0)),
+                        scr_t[:],
+                    )
+                    conv_new = jnp.where(
+                        padm,
+                        jnp.int32(0),
+                        jnp.where(
+                            (scr_c[:] != 0) | (term_new >= term_rounds),
+                            jnp.int32(1),
+                            jnp.int32(0),
+                        ),
+                    )
+                    tile_metric = jnp.sum(
+                        jnp.where(mid, conv_new, jnp.int32(0)),
+                        dtype=jnp.int32,
+                    )
+                scr_s[:] = s_new
+                scr_w[:] = w_new
+                scr_t[:] = term_new
+                scr_c[:] = conv_new
+                _copy_all([
+                    (scr_s, s_n.at[pl.ds(r0, PT), :]),
+                    (scr_w, w_n.at[pl.ds(r0, PT), :]),
+                    (scr_t, t_n.at[pl.ds(r0, PT), :]),
+                    (scr_c, c_n.at[pl.ds(r0, PT), :]),
+                ], sems)
+                return acc + tile_metric
+
+            total = lax.fori_loop(0, T, p2, jnp.int32(0), unroll=False)
+            flags[0] = flags[0] + 1
+            u_o[k] = total
+
+        A = (sA, wA, tA, cA)
+        B = (sB, wB, tB, cB)
+        par = flags[0] % 2  # snapshot before the mutating branches
+
+        @pl.when(active & (par == 0))
+        def _round_even():
+            round_body(A, B)
+
+        @pl.when(active & (par == 1))
+        def _round_odd():
+            round_body(B, A)
+
+        @pl.when(k == K - 1)
+        def _emit():
+            meta_o[0] = flags[0]
+            meta_o[1] = flags[0] % 2
+
+    def chunk_fn(ext_state, keys, row0, start, cap):
+        s, w, t, c = ext_state
+        cap, keys = clamp_cap_and_pad(start, cap, keys)
+        K = keys.shape[0]
+        f32 = jax.ShapeDtypeStruct((rows_ext, LANES), jnp.float32)
+        i32 = jax.ShapeDtypeStruct((rows_ext, LANES), jnp.int32)
+        f32m = jax.ShapeDtypeStruct((rows_ext + M, LANES), jnp.float32)
+        i32m = jax.ShapeDtypeStruct((rows_ext + M, LANES), jnp.int32)
+        outs = pl.pallas_call(
+            kernel,
+            grid=(K,),
+            out_shape=(
+                f32, f32, i32, i32,
+                f32, f32, i32, i32,
+                f32m, f32m, i32m,
+                jax.ShapeDtypeStruct((2,), jnp.int32),
+                jax.ShapeDtypeStruct((K,), jnp.int32),
+            ),
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec((8, 2), lambda k: (k // 8, 0),
+                             memory_space=pltpu.SMEM),
+            ] + [pl.BlockSpec(memory_space=pl.ANY)] * 4,
+            out_specs=tuple(
+                [pl.BlockSpec(memory_space=pl.ANY)] * 11
+                + [pl.BlockSpec(memory_space=pltpu.SMEM)] * 2
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((PT, LANES), jnp.float32),
+                pltpu.VMEM((PT, LANES), jnp.float32),
+                pltpu.VMEM((PT, LANES), jnp.int32),
+                pltpu.VMEM((PT, LANES), jnp.int32),
+                pltpu.VMEM((PT, LANES), jnp.float32),
+                pltpu.VMEM((PT, LANES), jnp.float32),
+                pltpu.VMEM((PT, LANES), jnp.int32),
+                pltpu.VMEM((C * stride, M, LANES), jnp.float32),
+                pltpu.VMEM((C * stride, M, LANES), jnp.float32),
+                pltpu.VMEM((C * stride, M, LANES), jnp.int32),
+                pltpu.SMEM((1,), jnp.int32),
+                pltpu.SemaphoreType.DMA((4,)),
+                pltpu.SemaphoreType.DMA((C * stride * 3,)),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=96 * 1024 * 1024
+            ),
+            interpret=interpret,
+        )(
+            jnp.stack([jnp.int32(row0), jnp.int32(start), jnp.int32(cap)]),
+            keys,
+            s, w, t, c,
+        )
+        meta = outs[11]
+        parity = meta[1]
+
+        def sel(a, b):
+            return jnp.where(
+                parity == 0, a[H:H + rows_loc], b[H:H + rows_loc]
+            )
+
+        mid_state = tuple(sel(outs[i], outs[4 + i]) for i in range(4))
+        return mid_state, meta[0], outs[12]
+
+    return chunk_fn, rows_ext
+
+
+def make_gossip_stencil_hbm_shard_chunk(
+    topo: Topology, cfg: SimConfig, H: int, rows_loc: int, PT: int,
+    layout, *, interpret: bool = False
+):
+    """Gossip analog: one marked-displacement delivery plane; receiver-side
+    suppression on the streamed conv tile; ``u[k]`` is round k's
+    middle-region converged count (-1 when not executed)."""
+    R_glob = layout.rows
+    N = layout.n
+    n_pad = layout.n_pad
+    Z = n_pad - N
+    rows_ext = rows_loc + 2 * H
+    T = rows_ext // PT
+    M = PT + 16
+    dirs_builder, wrap = _lattice_params(topo)
+    blend = wrap and Z != 0
+    windows = _class_windows(topo, layout, rows_ext)
+    C = len(windows)
+    stride = 2 if blend else 1
+    rumor_target = np.int32(cfg.resolved_rumor_target)
+    suppress = cfg.resolved_suppress
+
+    def kernel(
+        scal_ref, keys_ref, n_in, a_in, c_in,
+        nA, aA, cA, nB, aB, cB, dm_p, meta_o, u_o,
+        scr_n, scr_a, scr_c, scr_m, win_m, flags, sems, wsems,
+    ):
+        k = pl.program_id(0)
+        K = pl.num_programs(0)
+        row_l = lax.broadcasted_iota(jnp.int32, (PT, LANES), 0)
+        lane = lax.broadcasted_iota(jnp.int32, (PT, LANES), 1)
+        row0 = scal_ref[0]
+
+        def tile_globals(r0):
+            grow = lax.rem(row0 + r0 + row_l, jnp.int32(R_glob))
+            gflat = grow * LANES + lane
+            return grow, gflat
+
+        @pl.when(k == 0)
+        def _init():
+            def cp(t, _):
+                r0 = t * PT
+                _copy_all([
+                    (n_in.at[pl.ds(r0, PT), :], scr_n),
+                    (a_in.at[pl.ds(r0, PT), :], scr_a),
+                    (c_in.at[pl.ds(r0, PT), :], scr_c),
+                ], sems)
+                _copy_all([
+                    (scr_n, nA.at[pl.ds(r0, PT), :]),
+                    (scr_a, aA.at[pl.ds(r0, PT), :]),
+                    (scr_c, cA.at[pl.ds(r0, PT), :]),
+                ], sems)
+                return 0
+
+            lax.fori_loop(0, T, cp, 0, unroll=False)
+            flags[0] = 0
+
+        u_o[k] = jnp.int32(-1)
+        active = scal_ref[1] + k < scal_ref[2]
+
+        def round_body(cur, nxt):
+            (n_c, a_c, c_c) = cur
+            (n_n, a_n, c_n) = nxt
+            kk = k % 8
+            k1 = keys_ref[kk, 0]
+            k2 = keys_ref[kk, 1]
+
+            def p1(t, _):
+                r0 = t * PT
+                _copy_all([(a_c.at[pl.ds(r0, PT), :], scr_a)], sems)
+                grow, gflat = tile_globals(r0)
+                padm = gflat >= N
+                bits = threefry2x32_hash(
+                    k1, k2,
+                    grow.astype(jnp.uint32) * jnp.uint32(LANES)
+                    + lane.astype(jnp.uint32),
+                )
+                d, deg_t = _sample_disp_dirs(bits, dirs_builder(gflat))
+                sending = (scr_a[:] != 0) & (deg_t > 0) & ~padm
+                scr_m[:] = jnp.where(sending, d, jnp.int32(-1))
+                _copy_all([(scr_m, dm_p.at[pl.ds(r0, PT), :])], sems)
+
+                @pl.when(t == 0)
+                def _mirror0():
+                    _copy_all(
+                        [(scr_m, dm_p.at[pl.ds(rows_ext, PT), :])], sems
+                    )
+
+                @pl.when(t == 1)
+                def _mirror1():
+                    _copy_all([
+                        (scr_m.at[pl.ds(0, 16), :],
+                         dm_p.at[pl.ds(rows_ext + PT, 16), :]),
+                    ], sems)
+
+                return 0
+
+            lax.fori_loop(0, T, p1, 0, unroll=False)
+
+            def p2(t, acc):
+                r0 = t * PT
+                _copy_all([
+                    (n_c.at[pl.ds(r0, PT), :], scr_n),
+                    (a_c.at[pl.ds(r0, PT), :], scr_a),
+                    (c_c.at[pl.ds(r0, PT), :], scr_c),
+                ], sems)
+                _, gflat = tile_globals(r0)
+                padm = gflat >= N
+                mid = (row_l + r0 >= H) & (row_l + r0 < H + rows_loc)
+
+                plans, wrap_plans, nonunis, cps = _start_class_volley(
+                    windows, r0, row0, [(dm_p, win_m)],
+                    wsems, stride, R_glob, n_pad, PT, M, rows_ext,
+                )
+                for cp in cps:
+                    cp.wait()
+
+                inbox = jnp.zeros((PT, LANES), jnp.int32)
+                for ci, (d_c, e1, e2) in enumerate(windows):
+                    rl, off = plans[ci]
+                    s1 = ci * stride
+                    g = _window_marked(
+                        win_m.at[s1], off, PT, rl, lane, interpret
+                    )
+                    if e2 is not None:
+                        rl2, off2 = wrap_plans[ci]
+                        g = jnp.where(
+                            nonunis[ci] & (gflat < d_c),
+                            _window_marked(win_m.at[s1 + 1], off2, PT, rl2,
+                                           lane, interpret),
+                            g,
+                        )
+                    inbox = inbox + jnp.where(
+                        g == d_c, jnp.int32(1), jnp.int32(0)
+                    )
+                inbox = jnp.where(padm, jnp.int32(0), inbox)
+                if suppress:
+                    inbox = jnp.where(scr_c[:] != 0, jnp.int32(0), inbox)
+                count_new = scr_n[:] + inbox
+                active_new = jnp.where(
+                    (scr_a[:] != 0) | (inbox > 0), jnp.int32(1), jnp.int32(0)
+                )
+                conv_new = jnp.where(
+                    (count_new >= rumor_target) & ~padm,
+                    jnp.int32(1), jnp.int32(0),
+                )
+                scr_n[:] = count_new
+                scr_a[:] = active_new
+                scr_c[:] = conv_new
+                _copy_all([
+                    (scr_n, n_n.at[pl.ds(r0, PT), :]),
+                    (scr_a, a_n.at[pl.ds(r0, PT), :]),
+                    (scr_c, c_n.at[pl.ds(r0, PT), :]),
+                ], sems)
+                return acc + jnp.sum(
+                    jnp.where(mid, conv_new, jnp.int32(0)), dtype=jnp.int32
+                )
+
+            total = lax.fori_loop(0, T, p2, jnp.int32(0), unroll=False)
+            flags[0] = flags[0] + 1
+            u_o[k] = total
+
+        A = (nA, aA, cA)
+        B = (nB, aB, cB)
+        par = flags[0] % 2
+
+        @pl.when(active & (par == 0))
+        def _round_even():
+            round_body(A, B)
+
+        @pl.when(active & (par == 1))
+        def _round_odd():
+            round_body(B, A)
+
+        @pl.when(k == K - 1)
+        def _emit():
+            meta_o[0] = flags[0]
+            meta_o[1] = flags[0] % 2
+
+    def chunk_fn(ext_state, keys, row0, start, cap):
+        cnt, act, cv = ext_state
+        cap, keys = clamp_cap_and_pad(start, cap, keys)
+        K = keys.shape[0]
+        i32 = jax.ShapeDtypeStruct((rows_ext, LANES), jnp.int32)
+        i32m = jax.ShapeDtypeStruct((rows_ext + M, LANES), jnp.int32)
+        outs = pl.pallas_call(
+            kernel,
+            grid=(K,),
+            out_shape=(
+                i32, i32, i32, i32, i32, i32, i32m,
+                jax.ShapeDtypeStruct((2,), jnp.int32),
+                jax.ShapeDtypeStruct((K,), jnp.int32),
+            ),
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec((8, 2), lambda k: (k // 8, 0),
+                             memory_space=pltpu.SMEM),
+            ] + [pl.BlockSpec(memory_space=pl.ANY)] * 3,
+            out_specs=tuple(
+                [pl.BlockSpec(memory_space=pl.ANY)] * 7
+                + [pl.BlockSpec(memory_space=pltpu.SMEM)] * 2
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((PT, LANES), jnp.int32),
+                pltpu.VMEM((PT, LANES), jnp.int32),
+                pltpu.VMEM((PT, LANES), jnp.int32),
+                pltpu.VMEM((PT, LANES), jnp.int32),
+                pltpu.VMEM((C * stride, M, LANES), jnp.int32),
+                pltpu.SMEM((1,), jnp.int32),
+                pltpu.SemaphoreType.DMA((3,)),
+                pltpu.SemaphoreType.DMA((C * stride,)),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=96 * 1024 * 1024
+            ),
+            interpret=interpret,
+        )(
+            jnp.stack([jnp.int32(row0), jnp.int32(start), jnp.int32(cap)]),
+            keys,
+            cnt, act, cv,
+        )
+        meta = outs[7]
+        parity = meta[1]
+
+        def sel(a, b):
+            return jnp.where(
+                parity == 0, a[H:H + rows_loc], b[H:H + rows_loc]
+            )
+
+        mid_state = tuple(sel(outs[i], outs[3 + i]) for i in range(3))
+        return mid_state, meta[0], outs[8]
+
+    return chunk_fn, rows_ext
+
+
+def run_stencil_hbm_sharded(
+    topo: Topology,
+    cfg: SimConfig,
+    mesh=None,
+    key=None,
+    on_chunk=None,
+    start_state=None,
+    start_round: int = 0,
+):
+    """Sharded HBM-streaming run — engine='fused', n_devices > 1, lattices
+    past the VMEM composition's per-shard budget.
+
+    Same contract as parallel/fused_sharded.run_fused_sharded for local
+    termination (detection at super-step granularity, exact at
+    chunk_rounds=1). termination='global' stops at the EXACT verdict round:
+    the kernel reports per-round middle unstable counts, the psum'd vector
+    names the first globally-stable round, and a capped rerun of the same
+    chunk (same keys — deterministic) lands the state there, matching the
+    chunked sharded global path's stop round and state."""
+    import time
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..models import gossip as gossip_mod
+    from ..models import pushsum as pushsum_mod
+    from ..models.runner import _check_dtype, _finalize_result, draw_leader
+    from ..ops import sampling
+    from ..ops.fused import round_keys
+    from .fused_sharded import global_verdict_step
+    from .mesh import NODE_AXIS, make_mesh
+
+    if mesh is None:
+        mesh = make_mesh(cfg.n_devices)
+    n_dev = mesh.devices.size
+    plan = plan_stencil_hbm_sharded(topo, cfg, n_dev)
+    if isinstance(plan, str):
+        raise ValueError(
+            f"engine='fused' with n_devices={n_dev} unavailable: {plan}"
+        )
+    H, rows_loc, CR, PT, layout = plan
+    _check_dtype(cfg)
+    if key is None:
+        key = jax.random.PRNGKey(cfg.seed)
+    interpret = jax.default_backend() != "tpu"
+    pushsum = cfg.algorithm == "push-sum"
+    global_term = cfg.termination == "global"
+    make = (
+        make_pushsum_stencil_hbm_shard_chunk
+        if pushsum
+        else make_gossip_stencil_hbm_shard_chunk
+    )
+    chunk_fn, rows_ext = make(
+        topo, cfg, H, rows_loc, PT, layout, interpret=interpret
+    )
+    R_glob = layout.rows
+    n = topo.n
+    target = cfg.resolved_target_count(n, topo.target_count)
+    key_data_host, key_impl = sampling.key_split(key)
+
+    shard_rows = NamedSharding(mesh, P(NODE_AXIS, None))
+    repl = NamedSharding(mesh, P())
+
+    plane_fields = (
+        [("s", np.float32, 0.0), ("w", np.float32, 1.0),
+         ("term", np.int32, cfg.initial_term_round), ("conv", np.int32, 0)]
+        if pushsum
+        else [("count", np.int32, 0), ("active", np.int32, 0),
+              ("conv", np.int32, 0)]
+    )
+
+    def to_planes(state):
+        outs = []
+        for f, dt, fill in plane_fields:
+            x = np.asarray(getattr(state, f)).astype(dt)
+            full = np.full(layout.n_pad, fill, dtype=dt)
+            full[: x.shape[0]] = x
+            outs.append(full.reshape(R_glob, LANES))
+        return tuple(outs)
+
+    if start_state is not None:
+        st0 = jax.tree.map(np.asarray, start_state)
+    elif pushsum:
+        st0 = pushsum_mod.init_state(n, jnp.float32, cfg.initial_term_round)
+    else:
+        st0 = gossip_mod.init_state(
+            n, draw_leader(key, topo, cfg),
+            leader_counts_receipt=cfg.reference and topo.kind == "full",
+        )
+    planes0 = tuple(jax.device_put(p, shard_rows) for p in to_planes(st0))
+    done0 = bool(np.asarray(st0.conv).sum() >= target)
+
+    perm_fwd = [(d, (d + 1) % n_dev) for d in range(n_dev)]
+    perm_bwd = [(d, (d - 1) % n_dev) for d in range(n_dev)]
+
+    def ext_rows(x):
+        left = lax.ppermute(x[-H:], NODE_AXIS, perm_fwd)
+        right = lax.ppermute(x[:H], NODE_AXIS, perm_bwd)
+        return jnp.concatenate([left, x, right], axis=0)
+
+    def chunk_local(carry, round_end, key_data):
+        def cond(c):
+            _, rnd, done = c
+            return jnp.logical_and(~done, rnd < round_end)
+
+        def body(c):
+            planes, rnd, _ = c
+            ext_state = tuple(ext_rows(p) for p in planes)
+            keys = round_keys(sampling.key_join(key_data, key_impl), rnd, CR)
+            dev = lax.axis_index(NODE_AXIS)
+            row0 = lax.rem(
+                dev.astype(jnp.int32) * rows_loc - H + 2 * R_glob,
+                jnp.int32(R_glob),
+            )
+            out, executed, u = chunk_fn(ext_state, keys, row0, rnd, round_end)
+            if pushsum and global_term:
+                def run_capped(cap):
+                    return chunk_fn(ext_state, keys, row0, rnd, cap)[0]
+
+                return global_verdict_step(
+                    run_capped, out, executed, u, rnd, rows_loc, n,
+                    NODE_AXIS,
+                )
+            conv_last = lax.dynamic_index_in_dim(
+                u, jnp.maximum(executed - 1, 0), keepdims=False
+            )
+            total = lax.psum(conv_last, NODE_AXIS)
+            return (out, rnd + executed, total >= target)
+
+        return lax.while_loop(cond, body, carry)
+
+    plane_specs = tuple(P(NODE_AXIS, None) for _ in planes0)
+    chunk_sharded = jax.jit(
+        jax.shard_map(
+            chunk_local,
+            mesh=mesh,
+            in_specs=((plane_specs, P(), P()), P(), P()),
+            out_specs=(plane_specs, P(), P()),
+            check_vma=False,
+        )
+    )
+
+    def rep_put(x):
+        return jax.device_put(x, repl)
+
+    kd_dev = rep_put(np.asarray(key_data_host))
+    carry = (planes0, rep_put(np.int32(start_round)), rep_put(np.bool_(done0)))
+
+    def to_canonical(planes):
+        flats = [p.reshape(-1)[:n] for p in planes]
+        if pushsum:
+            return pushsum_mod.PushSumState(
+                s=flats[0], w=flats[1], term=flats[2], conv=flats[3] != 0
+            )
+        return gossip_mod.GossipState(
+            count=flats[0], active=flats[1] != 0, conv=flats[2] != 0
+        )
+
+    t0 = time.perf_counter()
+    warm = chunk_sharded(
+        carry, rep_put(np.int32(min(start_round + CR, cfg.max_rounds))),
+        kd_dev,
+    )
+    int(warm[1])
+    del warm
+    compile_s = time.perf_counter() - t0
+
+    rounds = start_round
+    t1 = time.perf_counter()
+    while True:
+        round_end = min(rounds + CR * 8, cfg.max_rounds)
+        carry = chunk_sharded(carry, rep_put(np.int32(round_end)), kd_dev)
+        planes, rnd, done = carry
+        rounds = int(rnd)
+        if on_chunk is not None:
+            on_chunk(rounds, to_canonical(planes))
+        if bool(done) or rounds >= cfg.max_rounds:
+            break
+    run_s = time.perf_counter() - t1
+
+    return _finalize_result(
+        topo, cfg, to_canonical(carry[0]), rounds, target, compile_s, run_s
+    )

@@ -116,10 +116,8 @@ def test_global_auto_dispatch_uses_fused_on_tpu_only():
     assert r_auto.rounds == r_chunked.rounds
 
 
-def test_global_fused_sharded_raises_loudly():
-    # ADVICE r3 (medium): the fused x sharded composition implements the
-    # local latch only — global must raise, not silently run it.
-    cfg = SimConfig(n=4096, topology="torus3d", algorithm="push-sum",
-                    termination="global", engine="fused", n_devices=4)
-    with pytest.raises(ValueError, match="fused x sharded"):
-        run(build_topology("torus3d", 4096), cfg)
+# Sharded fused + termination='global' (VERDICT r4 #8) is covered where the
+# compositions live: tests/test_fused_sharded.py and
+# tests/test_fused_hbm_sharded.py run the psum'd per-round unstable vector +
+# capped-rerun exact stop against the chunked sharded global oracle, through
+# the runner dispatch; tests/test_pushsum.py pins the no-plan raise.

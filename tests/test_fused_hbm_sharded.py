@@ -1,0 +1,205 @@
+"""HBM-streaming stencil x sharded composition
+(parallel/fused_hbm_sharded.py), interpret mode on the 8-virtual-CPU-device
+mesh.
+
+Contracts (VERDICT r4 #1 + #8):
+- chunk_rounds=1 degenerates to exact per-round detection and gossip
+  trajectories are BITWISE the single-device engines' — wrap (torus3d,
+  Z > 0 blend), Z = 0 (ring), and non-wrap (grid2d signed windows);
+- at larger CR, convergence is detected at the first super-step boundary
+  at/after the true round, never before;
+- push-sum follows the single-device trajectory to float tolerance over a
+  fixed budget and conserves mass through the halo exchange;
+- termination='global' stops at the EXACT verdict round (the psum'd
+  per-round unstable vector + capped deterministic rerun), matching the
+  chunked sharded global path at any chunk_rounds;
+- the runner tiers the compositions like the single-device engines: VMEM
+  composition while the shard fits, HBM-streaming past it — sharding
+  multiplies the population ceiling instead of shrinking it.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cop5615_gossip_protocol_tpu import SimConfig, build_topology
+from cop5615_gossip_protocol_tpu.models.runner import run
+from cop5615_gossip_protocol_tpu.parallel import fused_sharded
+from cop5615_gossip_protocol_tpu.parallel.fused_hbm_sharded import (
+    plan_stencil_hbm_sharded,
+    run_stencil_hbm_sharded,
+)
+from cop5615_gossip_protocol_tpu.parallel.mesh import make_mesh
+
+# torus g=50: padded layout 1024 rows -> two 512-row shards; Z > 0 so the
+# runtime mod-n blend (nonuniform-tile second windows) is live.
+N = 125000
+
+
+def _grab(final, tag):
+    def f(rounds, state):
+        final[tag] = state
+    return f
+
+
+def _mesh2():
+    return make_mesh(2)
+
+
+def _hbm_run(topo, cfg, mesh, **kw):
+    return run_stencil_hbm_sharded(topo, cfg, mesh=mesh, **kw)
+
+
+def test_gossip_cr1_bitwise_vs_single_device():
+    topo = build_topology("torus3d", N)
+    final = {}
+    r1 = run(topo, SimConfig(n=N, topology="torus3d", algorithm="gossip",
+                             engine="chunked", max_rounds=3000),
+             on_chunk=_grab(final, "c"))
+    cfg = SimConfig(n=N, topology="torus3d", algorithm="gossip",
+                    engine="fused", n_devices=2, chunk_rounds=1,
+                    max_rounds=3000)
+    r2 = _hbm_run(topo, cfg, _mesh2(), on_chunk=_grab(final, "f"))
+    assert r1.rounds == r2.rounds
+    assert r1.converged_count == r2.converged_count
+    for f in ("count", "active", "conv"):
+        a = np.asarray(getattr(final["c"], f))
+        b = np.asarray(getattr(final["f"], f))[:N]
+        assert (a == b).all(), f
+
+
+def test_gossip_grid2d_nonwrap_bitwise():
+    # Non-wrap lattice: single signed windows, boundary live-masks.
+    n = 131044  # 362^2 -> 1024-row layout -> two 512-row shards
+    topo = build_topology("grid2d", n)
+    r1 = run(topo, SimConfig(n=n, topology="grid2d", algorithm="gossip",
+                             engine="chunked", max_rounds=5000))
+    cfg = SimConfig(n=n, topology="grid2d", algorithm="gossip",
+                    engine="fused", n_devices=2, chunk_rounds=1,
+                    max_rounds=5000)
+    r2 = _hbm_run(topo, cfg, _mesh2())
+    assert r1.rounds == r2.rounds
+    assert r1.converged_count == r2.converged_count
+
+
+def test_gossip_ring_z0_counts_match():
+    # Z = 0: both blend variants coincide -> single windows on a wrap kind.
+    n = 65536
+    topo = build_topology("ring", n)
+    r1 = run(topo, SimConfig(n=n, topology="ring", algorithm="gossip",
+                             engine="chunked", max_rounds=60))
+    cfg = SimConfig(n=n, topology="ring", algorithm="gossip",
+                    engine="fused", n_devices=2, chunk_rounds=1,
+                    max_rounds=60)
+    r2 = _hbm_run(topo, cfg, _mesh2())
+    assert r1.rounds == r2.rounds
+    assert r1.converged_count == r2.converged_count
+
+
+def test_gossip_cr_adaptive_converges_at_boundary():
+    topo = build_topology("torus3d", N)
+    r1 = run(topo, SimConfig(n=N, topology="torus3d", algorithm="gossip",
+                             engine="chunked", max_rounds=3000))
+    cfg = SimConfig(n=N, topology="torus3d", algorithm="gossip",
+                    engine="fused", n_devices=2, chunk_rounds=8,
+                    max_rounds=3000)
+    plan = plan_stencil_hbm_sharded(topo, cfg, 2)
+    assert not isinstance(plan, str)
+    cr = plan[2]
+    r3 = _hbm_run(topo, cfg, _mesh2())
+    assert r3.converged
+    assert r1.rounds <= r3.rounds <= r1.rounds + cr
+
+
+def test_pushsum_fixed_rounds_trajectory_and_mass():
+    topo = build_topology("torus3d", N)
+    final = {}
+    rp1 = run(topo, SimConfig(n=N, topology="torus3d", algorithm="push-sum",
+                              engine="chunked", max_rounds=64,
+                              chunk_rounds=64),
+              on_chunk=_grab(final, "c"))
+    cfg = SimConfig(n=N, topology="torus3d", algorithm="push-sum",
+                    engine="fused", n_devices=2, chunk_rounds=8,
+                    max_rounds=64)
+    rp2 = _hbm_run(topo, cfg, _mesh2(), on_chunk=_grab(final, "f"))
+    assert rp1.rounds == rp2.rounds == 64
+    a, b = final["c"], final["f"]
+    np.testing.assert_allclose(np.asarray(a.s), np.asarray(b.s)[:N],
+                               rtol=2e-5, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(a.w), np.asarray(b.w)[:N],
+                               rtol=2e-5, atol=1e-6)
+    sm = float(np.asarray(b.s, np.float64)[:N].sum())
+    true = N * (N - 1) / 2
+    assert abs(sm - true) / true < 1e-5
+    wm = float(np.asarray(b.w, np.float64)[:N].sum())
+    assert abs(wm - N) / N < 1e-5
+
+
+def test_pushsum_global_exact_vs_chunked_sharded():
+    # The global verdict composes across shards: psum'd per-round unstable
+    # vector + capped rerun -> the stop round is EXACT at CR > 1, matching
+    # the chunked sharded global path. A fat delta keeps the interpret-mode
+    # round count small; the guard asserts the verdict actually fired.
+    base = dict(n=N, topology="torus3d", algorithm="push-sum",
+                termination="global", delta=1e-1, n_devices=2,
+                max_rounds=2000)
+    topo = build_topology("torus3d", N)
+    a = run(topo, SimConfig(engine="chunked", chunk_rounds=64, **base))
+    assert a.converged and a.rounds > 1
+    cfg = SimConfig(engine="fused", chunk_rounds=8, **base)
+    b = _hbm_run(topo, cfg, _mesh2())
+    assert b.converged
+    assert a.rounds == b.rounds, (a.rounds, b.rounds)
+    assert b.converged_count == N
+
+
+def test_resume_midway():
+    topo = build_topology("torus3d", N)
+    cfg = SimConfig(n=N, topology="torus3d", algorithm="gossip",
+                    engine="fused", n_devices=2, chunk_rounds=4,
+                    max_rounds=3000)
+    mesh = _mesh2()
+    snaps = []
+    full = _hbm_run(topo, cfg, mesh,
+                    on_chunk=lambda r, s: snaps.append((r, s)))
+    assert len(snaps) >= 2
+    r0, s0 = snaps[0]
+    resumed = _hbm_run(topo, cfg, mesh,
+                       start_state=jax.tree.map(jnp.asarray, s0),
+                       start_round=r0)
+    assert resumed.rounds == full.rounds
+    assert resumed.converged_count == full.converged_count
+
+
+def test_plan_gating_and_runner_tiering(monkeypatch):
+    cfg = SimConfig(n=N, topology="torus3d", algorithm="gossip",
+                    engine="fused", n_devices=2, chunk_rounds=1,
+                    max_rounds=3000)
+    # implicit topology has no stencil structure
+    assert "displacement" in plan_stencil_hbm_sharded(
+        build_topology("full", 1024), cfg, 2
+    )
+    # imp kinds have no arithmetic columns
+    assert "arithmetic" in plan_stencil_hbm_sharded(
+        build_topology("imp3d", 27000), cfg, 2
+    )
+    # indivisible layout
+    assert "split evenly" in plan_stencil_hbm_sharded(
+        build_topology("torus3d", N), cfg, 3
+    )
+    # Runner tiering: with the VMEM composition's budget collapsed, the
+    # dispatch falls through to the HBM-streaming composition and the run
+    # still matches the chunked single-device oracle bitwise.
+    monkeypatch.setattr(fused_sharded, "_VMEM_BUDGET", 1000)
+    plan_v = fused_sharded.plan_fused_sharded(
+        build_topology("torus3d", N), cfg, 2
+    )
+    assert isinstance(plan_v, str)
+    r1 = run(build_topology("torus3d", N),
+             SimConfig(n=N, topology="torus3d", algorithm="gossip",
+                       engine="chunked", max_rounds=3000))
+    r2 = run(build_topology("torus3d", N), cfg)
+    assert r1.rounds == r2.rounds
+    assert r1.converged_count == r2.converged_count

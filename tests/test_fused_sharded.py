@@ -126,6 +126,26 @@ def test_ring_eight_devices_counts_match():
     assert r1.converged_count == r8.converged_count
 
 
+def test_pushsum_global_exact_vs_chunked_sharded():
+    # VERDICT r4 #8: termination='global' in the VMEM lattice composition —
+    # the psum'd per-round middle unstable vector names the verdict round
+    # and the capped deterministic rerun lands the state there, so the stop
+    # round is EXACT at CR > 1, matching the chunked sharded global path.
+    base = dict(n=N, topology="torus3d", algorithm="push-sum",
+                termination="global", delta=1e-1, n_devices=2,
+                max_rounds=2000)
+    topo = build_topology("torus3d", N)
+    a = run(topo, SimConfig(engine="chunked", chunk_rounds=64, **base))
+    assert a.converged and a.rounds > 1
+    # Through the runner dispatch (not run_fused_sharded directly): this
+    # also pins that engine='fused' + n_devices>1 + global ROUTES to the
+    # composition instead of the old loud raise.
+    b = run(topo, SimConfig(engine="fused", chunk_rounds=8, **base))
+    assert b.converged
+    assert a.rounds == b.rounds, (a.rounds, b.rounds)
+    assert b.converged_count == N
+
+
 def test_gossip_grid2d_cr1_bitwise():
     # Non-wrap lattice: the engine's blend handles boundary-truncated
     # displacement classes too, not just wrap topologies.

@@ -177,11 +177,13 @@ def test_global_termination_gating():
         SimConfig(n=64, topology="line", algorithm="push-sum",
                   semantics="reference", termination="global")
     # Single-device fused + global is supported in-kernel since VERDICT r3
-    # #5 (tests/test_fused_global.py); the sharded composition still
-    # raises loudly (ADVICE r3 medium).
+    # #5 (tests/test_fused_global.py); the sharded compositions run it too
+    # since VERDICT r4 #8 (tests/test_fused_sharded.py,
+    # tests/test_fused_hbm_sharded.py) — but a layout with no exact plan
+    # must still raise with BOTH tier reasons, not silently fall back.
     cfg = SimConfig(n=512, topology="torus3d", algorithm="push-sum",
-                    termination="global", engine="fused", n_devices=2)
-    with pytest.raises(ValueError, match="fused x sharded"):
+                    termination="global", engine="fused", n_devices=3)
+    with pytest.raises(ValueError, match="HBM-streaming composition"):
         run(build_topology("torus3d", 512), cfg)
 
 
