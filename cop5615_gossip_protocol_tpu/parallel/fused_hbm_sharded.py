@@ -88,20 +88,39 @@ _HBM_PLANE_BUDGET = 12 * 2**30
 _VMEM_SCRATCH_BUDGET = 80 * 2**20
 
 
-def _halo_width_slots(topo: Topology, layout) -> int:
-    """Largest |in-buffer shift| any delivery window uses — the per-round
-    contamination advance from the extended buffer's edges."""
+def _class_sigmas(topo: Topology, layout):
+    """Per class d: (d, sigma1, sigma2) signed in-buffer sender offsets —
+    the ONE home for the wrap/non-wrap case analysis that both the window
+    rolls (_class_windows) and the halo-sufficiency width
+    (_halo_width_slots) derive from, so the two can never drift. sigma1
+    serves receivers at global flat >= d, sigma2 those below (the
+    fused_sharded mod-n blend pair); sigma2 is None when one window is
+    exact for every receiver: non-wrap lattices (boundary live-masks kill
+    every would-be wrapping sender — the
+    ops/fused_stencil_hbm._signed_pad_shift argument) and wrap lattices at
+    Z = 0 (both variants coincide)."""
     offsets = [int(d) for d in stencil_offsets(topo)]
     _, wrap = _lattice_params(topo)
     n_pad = layout.n_pad
     N = layout.n
-    w = 0
+    out = []
     for d in offsets:
         if wrap:
-            w = max(w, abs(_signed_pad(-d, n_pad)), abs(_signed_pad(N - d, n_pad)))
+            s1 = _signed_pad(-d, n_pad)
+            s2 = _signed_pad(N - d, n_pad)
+            out.append((d, s1, None if s1 == s2 else s2))
         else:
-            w = max(w, abs(d if d <= N // 2 else d - N))
-    return w
+            out.append((d, -(d if d <= N // 2 else d - N), None))
+    return out
+
+
+def _halo_width_slots(topo: Topology, layout) -> int:
+    """Largest |in-buffer shift| any delivery window uses — the per-round
+    contamination advance from the extended buffer's edges."""
+    return max(
+        max(abs(s1), abs(s2 if s2 is not None else 0))
+        for _, s1, s2 in _class_sigmas(topo, layout)
+    )
 
 
 def plan_stencil_hbm_sharded(topo: Topology, cfg: SimConfig, n_dev: int):
@@ -200,26 +219,14 @@ def plan_stencil_hbm_sharded(topo: Topology, cfg: SimConfig, n_dev: int):
 
 def _class_windows(topo: Topology, layout, rows_ext: int):
     """Per class d: (d, e1, e2) in-buffer forward roll amounts over the
-    extended ring (n_ext = rows_ext * 128). e1 serves receivers at global
-    flat >= d, e2 those below (the mod-n blend of fused_sharded). e2 is
-    None when one window is exact for every receiver: non-wrap lattices
-    (the signed shift — boundary masks kill every would-be wrapping
-    sender) and wrap lattices at Z = 0 (both variants coincide)."""
-    offsets = [int(d) for d in stencil_offsets(topo)]
-    _, wrap = _lattice_params(topo)
-    n_pad = layout.n_pad
-    N = layout.n
+    extended ring (n_ext = rows_ext * 128) — a forward roll by e delivers
+    out[j] = in[j - e], so e = (-sigma) mod n_ext for each of
+    _class_sigmas' sender offsets. e2 is None whenever sigma2 is."""
     n_ext = rows_ext * LANES
-    out = []
-    for d in offsets:
-        if wrap:
-            e1 = (-_signed_pad(-d, n_pad)) % n_ext
-            e2 = (-_signed_pad(N - d, n_pad)) % n_ext
-            out.append((d, e1, None if e1 == e2 else e2))
-        else:
-            sd = d if d <= N // 2 else d - N
-            out.append((d, sd % n_ext, None))
-    return out
+    return [
+        (d, (-s1) % n_ext, None if s2 is None else (-s2) % n_ext)
+        for d, s1, s2 in _class_sigmas(topo, layout)
+    ]
 
 
 def _tile_blend_plan(row0, r0, d: int, R_glob: int, n_pad: int, PT: int):

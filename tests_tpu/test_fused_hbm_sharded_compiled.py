@@ -1,0 +1,65 @@
+"""Compiled (interpret=False) HBM-streaming x sharded composition on the
+real chip (parallel/fused_hbm_sharded.py, VERDICT r4 #1).
+
+Hardware has ONE chip, so this exercises the composition's compiled kernel
+on a 1-device mesh at a population past every VMEM budget (2^24 — the
+streamed tier's class): global-row threefry, the runtime straddle-predicated
+mod-n blend, per-shard streamed tile sweeps, and the shard_map/while_loop
+orchestration — against the single-device streamed engine. Multi-device
+execution of the same program is validated on the virtual CPU mesh
+(tests/test_fused_hbm_sharded.py, __graft_entry__.dryrun_multichip leg 8).
+
+Run on a chip: python -m pytest tests_tpu -q
+Latest recorded run: tests_tpu/RUNLOG.md
+"""
+
+import numpy as np
+
+from cop5615_gossip_protocol_tpu import SimConfig, build_topology
+from cop5615_gossip_protocol_tpu.models.runner import run
+from cop5615_gossip_protocol_tpu.parallel.fused_hbm_sharded import (
+    run_stencil_hbm_sharded,
+)
+from cop5615_gossip_protocol_tpu.parallel.mesh import make_mesh
+
+N = 2**24  # 256^3 torus — past stencil2's VMEM budget, streamed tier
+
+
+def test_compiled_hbm_sharded_gossip_bitwise_vs_single_device():
+    topo = build_topology("torus3d", N)
+    grab = {}
+    r1 = run(topo, SimConfig(n=N, topology="torus3d", algorithm="gossip",
+                             engine="fused", chunk_rounds=40, max_rounds=40),
+             on_chunk=lambda r, s: grab.update(a=s))
+    r2 = run_stencil_hbm_sharded(
+        topo,
+        SimConfig(n=N, topology="torus3d", algorithm="gossip",
+                  engine="fused", chunk_rounds=1, max_rounds=40),
+        mesh=make_mesh(1),
+        on_chunk=lambda r, s: grab.update(b=s),
+    )
+    assert r1.rounds == r2.rounds == 40
+    assert r1.converged_count == r2.converged_count
+    for f in ("count", "active", "conv"):
+        a = np.asarray(getattr(grab["a"], f))[:N]
+        b = np.asarray(getattr(grab["b"], f))[:N]
+        assert (a == b).all(), f
+
+
+def test_compiled_hbm_sharded_pushsum_throughput_class():
+    # Measured envelope (RUNLOG r5): 1-device-mesh composition wall is
+    # 1.23x the single-device streamed engine at CR=64 over 256 rounds
+    # (10.0 vs 8.1 ms/round at 2^24) — per-super-step halo assembly + the
+    # state in/out round-trip the single-device multi-round launch
+    # amortizes away. Bound at 1.35x: measured + noise headroom, inside
+    # the VERDICT r4 #1 "within ~1.3x" bar's intent and tight enough that
+    # a regression to a per-round-launch class (1.8x+) fails loudly.
+    topo = build_topology("torus3d", N)
+    cfg = SimConfig(n=N, topology="torus3d", algorithm="push-sum",
+                    engine="fused", chunk_rounds=64, max_rounds=256)
+    r_shard = run_stencil_hbm_sharded(topo, cfg, mesh=make_mesh(1))
+    r_single = run(topo, cfg)
+    assert r_shard.rounds == 256 and r_single.rounds == 256
+    per_shard = r_shard.run_s / r_shard.rounds
+    per_single = r_single.run_s / r_single.rounds
+    assert per_shard < per_single * 1.35, (per_shard, per_single)
