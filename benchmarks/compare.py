@@ -76,20 +76,45 @@ class MatchedRow:
         return rec
 
 
-def engine_us_per_round(
-    kind: str, algorithm: str, n: int, seed: int = 0,
-    r1: int = 512, r2: int = 2560, **overrides,
-) -> float:
-    """Per-round engine cost in microseconds, with the per-dispatch launch
-    floor differenced out (VERDICT r3 #8).
+def default_round_spread(n: int) -> tuple[int, int]:
+    """(r1, r2) fixed-round budgets for the differential timing at
+    population n — the ONE policy home (bench.py, roofline.py, and the
+    grid sweep all measure through it, so their numbers are comparable).
+
+    The r5 calibration (RUNLOG r5) showed the old narrow spreads were the
+    source of VERDICT r4 Weak #1's irreproducible headline: at 1M the
+    512->2560 differential signal (~100 ms) is the same order as the
+    remote-tunnel launch floor (~100-175 ms observed), so floor drift
+    between the two runs swung the quotient 28-64 us/round. These spreads
+    size the signal to >=~0.5 s — an order above the floor's wobble —
+    after which interleaved pairs agree within a few percent."""
+    if n <= 65_536:
+        return 1024, 131_072  # sub-us rounds: ~0.1 s signal minimum
+    if n <= 4_000_000:
+        return 512, 16_384  # ~50 us rounds -> ~0.8 s signal
+    if n <= 64_000_000:
+        return 64, 1024  # ~2-7 ms rounds -> >=2 s signal
+    return 64, 320  # 2^27-class ~15 ms rounds -> ~4 s signal
+
+
+def engine_us_stats(
+    kind: str, algorithm: str, n: int, seed: int = 0, pairs: int = 3,
+    r1: int | None = None, r2: int | None = None, **overrides,
+) -> dict:
+    """Per-round engine cost statistics with the per-dispatch launch floor
+    differenced out (VERDICT r3 #8, r4 #2).
 
     A to-convergence run at small N is one chunk dispatch whose wall is
-    ~110-140 ms of remote-tunnel launch plumbing regardless of rounds — it
+    ~100-175 ms of remote-tunnel launch plumbing regardless of rounds — it
     measures the tunnel, not the engine. Here the SAME compiled chunk runs
-    twice with convergence disabled (gossip: unreachable rumor threshold;
-    push-sum: unreachable term counter), executing exactly r1 and r2 rounds
-    in one dispatch each; (t2 - t1) / (r2 - r1) cancels the floor and the
-    compile exactly because both runs share one executable."""
+    with convergence disabled (gossip: unreachable rumor threshold;
+    push-sum: unreachable term counter) at two fixed round budgets;
+    (t2 - t1) / (r2 - r1) cancels the floor and the compile exactly
+    because both runs share one executable. ``pairs`` (r1, r2) runs are
+    INTERLEAVED in time so slow floor drift hits both budgets equally;
+    the returned dict carries the per-pair differentials plus their
+    median/min/max — callers quote the median and the spread, never a
+    single pair (the r4 lesson: a lone narrow-spread pair wobbled 1.8x)."""
     from cop5615_gossip_protocol_tpu import SimConfig, build_topology, run
 
     no_conv = (
@@ -97,35 +122,52 @@ def engine_us_per_round(
         if algorithm == "gossip"
         else {"term_rounds": 1_000_000}
     )
-    if n <= 65_536 and r1 == 512 and r2 == 2560:
-        # Small populations: sub-us rounds need a wider budget spread to
-        # rise above the tunnel's per-dispatch jitter (+-ms).
-        r1, r2 = 1024, 16_384
-    elif n > 64_000_000 and r1 == 512 and r2 == 2560:
-        # 2^27-class rounds cost ~15 ms each; the default spread would run
-        # for minutes while the differential is already thousands of x the
-        # jitter at these costs.
-        r1, r2 = 64, 320
+    d1, d2 = default_round_spread(n)
+    r1 = d1 if r1 is None else r1
+    r2 = d2 if r2 is None else r2
     topo = build_topology(kind, n, seed=seed, semantics="batched")
-    walls = []
-    for cap in (r1, r2):
+
+    def one(cap):
         cfg = SimConfig(
             n=n, topology=kind, algorithm=algorithm, semantics="batched",
             seed=seed, max_rounds=cap, chunk_rounds=max(r1, r2),
             **{**no_conv, **overrides},
         )
-        best = None
-        for _ in range(3):  # min-of-3: robust to dispatch jitter spikes
-            res = run(topo, cfg)
-            assert res.rounds == cap, (res.rounds, cap)
-            best = res.run_s if best is None else min(best, res.run_s)
-        walls.append(best)
-    # Raw differential, deliberately UNclamped (VERDICT r3 Weak #4): at
-    # small N the true per-round cost can sit below the dispatch jitter and
-    # the difference may come out <= 0 — that is a statement about the
-    # noise bound, not "free", and callers must render it as below-noise
-    # (ENGINE_US_NOISE) rather than 0.00.
-    return (walls[1] - walls[0]) / (r2 - r1) * 1e6
+        res = run(topo, cfg)
+        assert res.rounds == cap, (res.rounds, cap)
+        return res.run_s
+
+    per_pair = []
+    for _ in range(pairs):
+        w1 = one(r1)
+        w2 = one(r2)
+        # Raw differential, deliberately UNclamped (VERDICT r3 Weak #4):
+        # at small N the true per-round cost can sit below the dispatch
+        # jitter and a pair may come out <= 0 — that is a statement about
+        # the noise bound, not "free"; callers render it as below-noise
+        # (ENGINE_US_NOISE) rather than 0.00.
+        per_pair.append((w2 - w1) / (r2 - r1) * 1e6)
+    per_pair_sorted = sorted(per_pair)
+    median = per_pair_sorted[len(per_pair_sorted) // 2]
+    return {
+        "us_per_round": median,
+        "us_min": per_pair_sorted[0],
+        "us_max": per_pair_sorted[-1],
+        "pairs": per_pair,
+        "r1": r1,
+        "r2": r2,
+    }
+
+
+def engine_us_per_round(
+    kind: str, algorithm: str, n: int, seed: int = 0,
+    r1: int | None = None, r2: int | None = None, **overrides,
+) -> float:
+    """Median-of-3-pairs differential per-round engine cost in
+    microseconds — engine_us_stats' headline number."""
+    return engine_us_stats(
+        kind, algorithm, n, seed=seed, pairs=3, r1=r1, r2=r2, **overrides
+    )["us_per_round"]
 
 
 # Differentials below this are indistinguishable from dispatch jitter at
