@@ -418,76 +418,64 @@ def make_pushsum_pool2_chunk(
                     for i, (src, dst) in enumerate(pairs)
                 ]
 
-            def write_cps(t, b):
+            def _write_planes(b):
+                return [(out_s.at[b], s_n), (out_w.at[b], w_n),
+                        (out_tc.at[b], tc_n)]
+
+            def _main_cps(t, b):
                 """Deferred write-volley descriptors for tile t (next-parity
-                tile + the margin mirrors tiles 0/1 replicate) — a pure
-                function of (t, b) so the wait two tiles later recreates
-                them exactly. Sourced from the DEDICATED out buffers, so
-                the only hazard is tile t+2's absorb store into out[b] —
-                which waits on these first (wait_writes)."""
+                tile) — a pure function of (t, b) so the wait two tiles
+                later recreates them exactly. Sourced from the DEDICATED
+                out buffers, so the only hazard is tile t+2's absorb store
+                into out[b] — which waits on these first (wait_writes)."""
                 r0 = t * PT
                 base = b * 6
-                main = [
+                return [
                     pltpu.make_async_copy(
                         src, pln.at[pl.ds(r0, PT), :], wr_sems.at[base + i]
                     )
-                    for i, (src, pln) in enumerate(
-                        [(out_s.at[b], s_n), (out_w.at[b], w_n),
-                         (out_tc.at[b], tc_n)]
-                    )
+                    for i, (src, pln) in enumerate(_write_planes(b))
                 ]
-                m0 = [
-                    pltpu.make_async_copy(
-                        src, pln.at[pl.ds(R, PT), :], wr_sems.at[base + 3 + i]
-                    )
-                    for i, (src, pln) in enumerate(
-                        [(out_s.at[b], s_n), (out_w.at[b], w_n),
-                         (out_tc.at[b], tc_n)]
-                    )
-                ]
-                m1 = [
-                    pltpu.make_async_copy(
-                        src.at[pl.ds(0, 16), :],
-                        pln.at[pl.ds(R + PT, 16), :],
-                        wr_sems.at[base + 3 + i],
-                    )
-                    for i, (src, pln) in enumerate(
-                        [(out_s.at[b], s_n), (out_w.at[b], w_n),
-                         (out_tc.at[b], tc_n)]
-                    )
-                ]
-                return main, m0, m1
 
-            def start_writes(t, b):
-                main, m0, m1 = write_cps(t, b)
-                for cp in main:
-                    cp.start()
+            def _mirror_op(t, b, op):
+                """Margin-mirror copies (rows [R, R+M) replicate rows
+                [0, M) for the next round's windows) — descriptors built
+                INSIDE the t==0/t==1 predicates, and skipped outright for
+                concrete other tiles (the round-end drain), so a
+                statically-false pl.when creates no orphaned
+                descriptors."""
+                if isinstance(t, int) and t not in (0, 1):
+                    return
 
                 @pl.when(t == 0)
                 def _m0():
-                    for cp in m0:
-                        cp.start()
+                    for i, (src, pln) in enumerate(_write_planes(b)):
+                        cp = pltpu.make_async_copy(
+                            src, pln.at[pl.ds(R, PT), :],
+                            wr_sems.at[b * 6 + 3 + i],
+                        )
+                        getattr(cp, op)()
 
                 @pl.when(t == 1)
                 def _m1():
-                    for cp in m1:
-                        cp.start()
+                    for i, (src, pln) in enumerate(_write_planes(b)):
+                        cp = pltpu.make_async_copy(
+                            src.at[pl.ds(0, 16), :],
+                            pln.at[pl.ds(R + PT, 16), :],
+                            wr_sems.at[b * 6 + 3 + i],
+                        )
+                        getattr(cp, op)()
+
+            def start_writes(t, b):
+                for cp in _main_cps(t, b):
+                    cp.start()
+                _mirror_op(t, b, "start")
 
             def wait_writes(t, b):
                 """Wait tile t's write volley (started two tiles ago)."""
-                main, m0, m1 = write_cps(t, b)
-                for cp in main:
+                for cp in _main_cps(t, b):
                     cp.wait()
-
-                @pl.when(t == 0)
-                def _m0():
-                    for cp in m0:
-                        cp.wait()
-
-                @pl.when(t == 1)
-                def _m1():
-                    for cp in m1:
-                        cp.wait()
+                _mirror_op(t, b, "wait")
 
             def compute_tile(t, b, acc):
                 """One tile's round with windows AND own state already
@@ -771,7 +759,7 @@ def make_gossip_pool2_chunk(
     R = layout.rows
     N = layout.n
     Z = layout.n_pad - layout.n
-    PT = _pick_pt(R)
+    PT = _pick_pt_even(R)
     T = R // PT
     M = PT + 16
     P = cfg.pool_size
@@ -782,16 +770,14 @@ def make_gossip_pool2_chunk(
     def kernel(
         start_ref, keys_ref, offs_ref, n_in, a_in,
         nA, aA, nB, aB, meta_o,
-        scr_n, scr_a, scr_ch, scr_ch2, win_a, win_a2, flags, sems,
+        own_n, own_a, out_n, out_a, scr_ch, scr_ch2,
+        win_a, win_a2, flags, sems, wr_sems, str_sems,
     ):
         k = pl.program_id(0)
         K = pl.num_programs(0)
-        sem_d = sems.at[0]
+        sem_d = str_sems.at[0]
         row_l = lax.broadcasted_iota(jnp.int32, (PT, LANES), 0)
         lane = lax.broadcasted_iota(jnp.int32, (PT, LANES), 1)
-
-        def write_tile_and_mirrors(t, pairs):
-            _write_tile_and_mirrors(pairs, t, R, PT, sems)
 
         @pl.when(k == 0)
         def _init():
@@ -799,12 +785,15 @@ def make_gossip_pool2_chunk(
             for t in range(T):
                 r0 = t * PT
                 _copy_all([
-                    (n_in.at[pl.ds(r0, PT), :], scr_n),
-                    (a_in.at[pl.ds(r0, PT), :], scr_a),
-                ], sems)
-                write_tile_and_mirrors(t, [(scr_n, nA), (scr_a, aA)])
+                    (n_in.at[pl.ds(r0, PT), :], own_n.at[0]),
+                    (a_in.at[pl.ds(r0, PT), :], own_a.at[0]),
+                ], str_sems)
+                _write_tile_and_mirrors(
+                    [(own_n.at[0], nA), (own_a.at[0], aA)], t, R, PT,
+                    str_sems,
+                )
                 total = total + jnp.sum(
-                    (scr_n[:] >= rumor_target).astype(jnp.int32),
+                    (own_n[0] >= rumor_target).astype(jnp.int32),
                     dtype=jnp.int32,
                 )
             flags[0] = jnp.where(total >= target, 1, 0)
@@ -819,29 +808,94 @@ def make_gossip_pool2_chunk(
             k1 = keys_ref[kk, 0]
             k2 = keys_ref[kk, 1]
 
-            def tile(t, acc):
+            def win_plans(t):
                 r0 = t * PT
-                jflat = (r0 + row_l) * LANES + lane
-                padm = jflat >= N
-                # One DMA volley per tile (see the push-sum kernel).
                 plans = []
-                pairs = [
-                    (n_c.at[pl.ds(r0, PT), :], scr_n),
-                    (a_c.at[pl.ds(r0, PT), :], scr_a),
-                ]
                 for slot in range(P):
                     d = offs_ref[kk, slot]
                     straddle, ws8, rl, off = _slot_plan(r0, d, Z, R, PT)
                     plans.append((d, straddle, ws8, rl, off))
-                    pairs.append((a_c.at[pl.ds(ws8, M), :], win_a.at[slot]))
-                _copy_all(pairs, sems)
+                return plans
+
+            def fetch_volley(t, b):
+                """Windows + own tiles into buffer set b — the push-sum
+                kernel's double-buffered prefetch shape (VERDICT r4 #3)."""
+                plans = win_plans(t)
+                r0 = t * PT
+                pairs = []
+                for slot, (_, _, ws8, _, _) in enumerate(plans):
+                    pairs.append(
+                        (a_c.at[pl.ds(ws8, M), :], win_a.at[b, slot])
+                    )
+                pairs.append((n_c.at[pl.ds(r0, PT), :], own_n.at[b]))
+                pairs.append((a_c.at[pl.ds(r0, PT), :], own_a.at[b]))
+                base = b * (P + 2)
+                return plans, [
+                    pltpu.make_async_copy(src, dst, sems.at[base + i])
+                    for i, (src, dst) in enumerate(pairs)
+                ]
+
+            def _write_planes(b):
+                return [(out_n.at[b], n_n), (out_a.at[b], a_n)]
+
+            def _main_cps(t, b):
+                r0 = t * PT
+                base = b * 4
+                return [
+                    pltpu.make_async_copy(
+                        src, pln.at[pl.ds(r0, PT), :], wr_sems.at[base + i]
+                    )
+                    for i, (src, pln) in enumerate(_write_planes(b))
+                ]
+
+            def _mirror_op(t, b, op):
+                """See the push-sum kernel's _mirror_op — lazy descriptors
+                so the statically-false round-end drain predicates create
+                no orphans."""
+                if isinstance(t, int) and t not in (0, 1):
+                    return
+
+                @pl.when(t == 0)
+                def _m0():
+                    for i, (src, pln) in enumerate(_write_planes(b)):
+                        cp = pltpu.make_async_copy(
+                            src, pln.at[pl.ds(R, PT), :],
+                            wr_sems.at[b * 4 + 2 + i],
+                        )
+                        getattr(cp, op)()
+
+                @pl.when(t == 1)
+                def _m1():
+                    for i, (src, pln) in enumerate(_write_planes(b)):
+                        cp = pltpu.make_async_copy(
+                            src.at[pl.ds(0, 16), :],
+                            pln.at[pl.ds(R + PT, 16), :],
+                            wr_sems.at[b * 4 + 2 + i],
+                        )
+                        getattr(cp, op)()
+
+            def start_writes(t, b):
+                for cp in _main_cps(t, b):
+                    cp.start()
+                _mirror_op(t, b, "start")
+
+            def wait_writes(t, b):
+                for cp in _main_cps(t, b):
+                    cp.wait()
+                _mirror_op(t, b, "wait")
+
+            def compute_tile(t, b, acc):
+                r0 = t * PT
+                jflat = (r0 + row_l) * LANES + lane
+                padm = jflat >= N
+                plans = win_plans(t)  # copies already resident in set b
                 inbox = jnp.zeros((PT, LANES), jnp.int32)
                 for slot in range(P):
                     d, straddle, ws8, rl, off = plans[slot]
                     scr_ch[:] = _choice_window(k1, k2, ws8, M, R, N, P)
                     g = _counted_window_roll(
-                        win_a.at[slot], scr_ch, slot, off, PT, rl, lane,
-                        interpret,
+                        win_a.at[b, slot], scr_ch, slot, off, PT, rl,
+                        lane, interpret,
                     )
                     if Z != 0:
                         ws8_2, rl2, off2 = _win_plan(
@@ -871,21 +925,51 @@ def make_gossip_pool2_chunk(
                     # Receiver-side suppression vs the round-start conv
                     # (= round-start count latch, derived).
                     inbox = jnp.where(
-                        scr_n[:] >= rumor_target, jnp.int32(0), inbox
+                        own_n[b] >= rumor_target, jnp.int32(0), inbox
                     )
-                count_new = scr_n[:] + inbox
+                count_new = own_n[b] + inbox
                 active_new = jnp.where(
-                    (scr_a[:] != 0) | (inbox > 0), jnp.int32(1), jnp.int32(0)
+                    (own_a[b] != 0) | (inbox > 0), jnp.int32(1), jnp.int32(0)
                 )
                 conv_new = (count_new >= rumor_target) & ~padm
-                scr_n[:] = count_new
-                scr_a[:] = active_new
-                write_tile_and_mirrors(t, [(scr_n, n_n), (scr_a, a_n)])
+
+                @pl.when(t >= 2)
+                def _drain_prev():
+                    wait_writes(t - 2, b)
+
+                out_n[b] = count_new
+                out_a[b] = active_new
                 return acc + jnp.sum(
                     conv_new.astype(jnp.int32), dtype=jnp.int32
                 )
 
-            total = lax.fori_loop(0, T, tile, jnp.int32(0), unroll=False)
+            for cp in fetch_volley(0, 0)[1]:
+                cp.start()
+
+            def pair(u, acc):
+                t0 = 2 * u
+                t1 = t0 + 1
+                for cp in fetch_volley(t0, 0)[1]:
+                    cp.wait()
+                for cp in fetch_volley(t1, 1)[1]:
+                    cp.start()
+                acc = compute_tile(t0, 0, acc)
+                start_writes(t0, 0)
+                for cp in fetch_volley(t1, 1)[1]:
+                    cp.wait()
+
+                @pl.when(u + 1 < T // 2)
+                def _prefetch():
+                    for cp in fetch_volley(t0 + 2, 0)[1]:
+                        cp.start()
+
+                acc = compute_tile(t1, 1, acc)
+                start_writes(t1, 1)
+                return acc
+
+            total = lax.fori_loop(0, T // 2, pair, jnp.int32(0), unroll=False)
+            wait_writes(T - 2, 0)
+            wait_writes(T - 1, 1)
             flags[1] = flags[1] + 1
             flags[0] = jnp.where(total >= target, 1, 0)
 
@@ -929,14 +1013,18 @@ def make_gossip_pool2_chunk(
                 + [pl.BlockSpec(memory_space=pltpu.SMEM)]
             ),
             scratch_shapes=[
-                pltpu.VMEM((PT, LANES), jnp.int32),
-                pltpu.VMEM((PT, LANES), jnp.int32),
+                pltpu.VMEM((2, PT, LANES), jnp.int32),
+                pltpu.VMEM((2, PT, LANES), jnp.int32),
+                pltpu.VMEM((2, PT, LANES), jnp.int32),
+                pltpu.VMEM((2, PT, LANES), jnp.int32),
                 pltpu.VMEM((M, LANES), jnp.int32),
                 pltpu.VMEM((M, LANES), jnp.int32),
-                pltpu.VMEM((P, M, LANES), jnp.int32),
+                pltpu.VMEM((2, P, M, LANES), jnp.int32),
                 pltpu.VMEM((M, LANES), jnp.int32),
                 pltpu.SMEM((2,), jnp.int32),
-                pltpu.SemaphoreType.DMA((2 + P,)),
+                pltpu.SemaphoreType.DMA((2 * (P + 2),)),
+                pltpu.SemaphoreType.DMA((8,)),
+                pltpu.SemaphoreType.DMA((2,)),
             ],
             compiler_params=pltpu.CompilerParams(
                 vmem_limit_bytes=96 * 1024 * 1024
