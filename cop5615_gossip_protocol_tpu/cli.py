@@ -77,6 +77,31 @@ def build_parser() -> argparse.ArgumentParser:
                    help="suppress gossip sends to converged targets (auto: on in reference semantics)")
     p.add_argument("--fault-rate", type=float, default=0.0,
                    help="per-round probability a node fails to send (fault injection)")
+    p.add_argument("--crash-rate", type=float, default=0.0,
+                   help="crash-stop churn: per-round probability each node "
+                   "dies permanently (dead nodes neither send nor advance; "
+                   "push-sum mass parks on them, conserved)")
+    p.add_argument("--crash-schedule", type=str, default=None,
+                   metavar="ROUND:COUNT,...",
+                   help="deterministic crash-stop schedule: kill COUNT "
+                   "uniformly random nodes at each listed round "
+                   "(mutually exclusive with --crash-rate)")
+    p.add_argument("--dup-rate", type=float, default=0.0,
+                   help="per-round probability a sent message is delivered "
+                   "twice (at-least-once delivery; chunked engine, "
+                   "scatter/stencil delivery)")
+    p.add_argument("--delay-rounds", type=int, default=0,
+                   help="defer every round's deliveries through a ring of "
+                   "this depth (bounded message delay; chunked engine, "
+                   "scatter/stencil delivery)")
+    p.add_argument("--quorum", type=float, default=1.0,
+                   help="crash-model termination: fraction of LIVE nodes "
+                   "that must be converged to end the run (default 1.0)")
+    p.add_argument("--stall-chunks", type=int, default=0,
+                   help="watchdog: stop with outcome=stalled after this "
+                   "many consecutive chunks without converged-count "
+                   "progress (0 disables) — the reference's line-topology "
+                   "hang as a measured event")
     p.add_argument("--delivery", choices=["auto", "scatter", "stencil", "pool"],
                    default="auto",
                    help="message delivery: stencil (shift-based, offset-structured "
@@ -126,7 +151,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint-every", type=int, default=1,
                    help="checkpoint every K chunks (with --checkpoint)")
     p.add_argument("--resume", type=str, default=None,
-                   help="resume from a checkpoint .npz (single-device batched runs)")
+                   help="resume from a checkpoint .npz, or 'auto' to restart "
+                   "from the --checkpoint sidecar when it exists (fresh run "
+                   "otherwise) — a killed long run rerun with identical "
+                   "flags picks up from its last auto-checkpoint")
     p.add_argument("--quiet", action="store_true", help="suppress the JSON record on stdout")
     return p
 
@@ -161,6 +189,12 @@ def _main_refsim(args, parser) -> int:
         "--target-frac": changed("target_frac"),
         "--suppress": changed("suppress"),
         "--fault-rate": changed("fault_rate"),
+        "--crash-rate/--crash-schedule": changed("crash_rate")
+        or changed("crash_schedule"),
+        "--dup-rate": changed("dup_rate"),
+        "--delay-rounds": changed("delay_rounds"),
+        "--quorum": changed("quorum"),
+        "--stall-chunks": changed("stall_chunks"),
         "--delivery": changed("delivery"),
         "--pool-size": changed("pool_size"),
         "--engine": changed("engine"),
@@ -253,6 +287,13 @@ def main(argv: Optional[list[str]] = None) -> int:
 
     import jax  # deferred so --platform can take effect before backend init
 
+    from .utils.compat import ensure_partitionable_threefry
+
+    # The cross-engine stream contract requires the partitionable threefry
+    # (default on current JAX, off on older runtimes); opt in before any
+    # trace exists so every engine's support gate sees it (utils/compat.py).
+    ensure_partitionable_threefry()
+
     if args.platform != "auto":
         jax.config.update("jax_platforms", args.platform)
     if args.num_processes and args.devices and args.devices % args.num_processes:
@@ -275,8 +316,10 @@ def main(argv: Optional[list[str]] = None) -> int:
         # Virtual CPU devices so sharded runs work on a dev box — the
         # fake-backend story the reference lacks (SURVEY.md §4). --devices is
         # the GLOBAL mesh size; each process hosts its share.
+        from .utils import compat
+
         local = args.devices // (args.num_processes or 1)
-        jax.config.update("jax_num_cpu_devices", max(local, 1))
+        compat.set_host_device_count(max(local, 1))
     if args.x64:
         jax.config.update("jax_enable_x64", True)
     if args.distributed or args.coordinator is not None:
@@ -310,6 +353,12 @@ def main(argv: Optional[list[str]] = None) -> int:
             target_frac=args.target_frac,
             suppress_converged=None if args.suppress == "auto" else args.suppress == "on",
             fault_rate=args.fault_rate,
+            crash_rate=args.crash_rate,
+            crash_schedule=args.crash_schedule,
+            dup_rate=args.dup_rate,
+            delay_rounds=args.delay_rounds,
+            quorum=args.quorum,
+            stall_chunks=args.stall_chunks,
             delivery=args.delivery,
             pool_size=args.pool_size,
             engine=args.engine,
@@ -404,14 +453,44 @@ def main(argv: Optional[list[str]] = None) -> int:
                 h(rounds, state)
 
     start_state, start_round = None, 0
-    if args.resume:
-        import dataclasses
-
-        try:
-            start_state, start_round, saved_cfg = ckpt.load(args.resume)
-        except ValueError as e:  # e.g. random-stream version mismatch
-            print(f"Invalid: {e}", file=sys.stderr)
+    resume_path = args.resume
+    if resume_path == "auto":
+        # Crash-only-restarts workflow: rerun the identical command line and
+        # pick up from the periodic --checkpoint sidecar when one exists —
+        # first launch (no sidecar yet) starts fresh.
+        if not args.checkpoint:
+            print(
+                "Invalid: --resume auto needs --checkpoint PATH (the "
+                "sidecar it restarts from)",
+                file=sys.stderr,
+            )
             return 2
+        from pathlib import Path
+
+        resume_path = args.checkpoint if Path(args.checkpoint).exists() else None
+    if resume_path:
+        import dataclasses
+        import zipfile
+
+        # Beyond ValueError (stream-version mismatch, bad config), a kill
+        # can leave a truncated .npz or a missing sidecar: BadZipFile /
+        # OSError / KeyError. ckpt.save is atomic-rename so this is rare,
+        # but --resume auto exists precisely for killed runs — it falls
+        # back to a fresh start; an explicit path still fails loudly.
+        try:
+            start_state, start_round, saved_cfg = ckpt.load(resume_path)
+        except (ValueError, OSError, KeyError, zipfile.BadZipFile) as e:
+            if args.resume == "auto":
+                print(
+                    f"checkpoint {resume_path} unusable ({e}); "
+                    "starting fresh",
+                    file=sys.stderr,
+                )
+                resume_path = None
+            else:
+                print(f"Invalid: {e}", file=sys.stderr)
+                return 2
+    if resume_path:
         # Resume is only bitwise-faithful if every stream-relevant knob
         # matches the original run; loop-control knobs may differ.
         loop_knobs = {"max_rounds": cfg.max_rounds, "chunk_rounds": cfg.chunk_rounds,

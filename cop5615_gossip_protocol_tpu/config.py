@@ -129,9 +129,43 @@ class SimConfig:
     # round's converged vector). None → True in reference semantics.
     suppress_converged: bool | None = None
 
-    # Simulated fault injection: per round, each node fails to send with this
-    # probability (SURVEY.md §5 "Failure detection").
+    # --- failure model (ops/faults.py is the semantics home) -------------
+    # Per round, each node fails to send with this probability — the send
+    # drop gate (SURVEY.md §5 "Failure detection"; ops/sampling.send_gate).
     fault_rate: float = 0.0
+
+    # Crash-stop node death: with crash_rate p every node independently
+    # survives each round with probability 1-p (geometric death round);
+    # crash_schedule "round:count,..." kills exactly count uniformly random
+    # nodes at each listed round instead. Dead nodes neither send nor
+    # advance protocol state; push-sum mass parks on them (conserved).
+    crash_rate: float = 0.0
+    crash_schedule: str | None = None
+
+    # Per round, each sent message is additionally delivered twice with
+    # this probability — at-least-once delivery. For push-sum duplicated
+    # mass is CREATED (total mass inflates by the duplicate): that loss of
+    # conservation is the fault being modeled, not a bug. Chunked engine,
+    # scatter/stencil delivery only.
+    dup_rate: float = 0.0
+
+    # Bounded message delay: every round's delivered planes are deferred
+    # through a ring of this depth before being absorbed — in-flight mass
+    # lives in the ring (conservation holds over state + ring). Chunked
+    # engine, scatter/stencil delivery only.
+    delay_rounds: int = 0
+
+    # Fraction of LIVE nodes that must be converged to end a crash-model
+    # run: sum(conv & alive) >= quorum_need(sum(alive), quorum)
+    # (ops/faults.quorum_need). Only meaningful with a crash model — the
+    # legacy converged_count >= target predicate rules otherwise.
+    quorum: float = 1.0
+
+    # Stall watchdog: terminate with outcome="stalled" after this many
+    # consecutive chunks with no progress in the converged count (the
+    # reference's line-topology hang, program.fs:334, as a measured event).
+    # 0 disables.
+    stall_chunks: int = 0
 
     # Round engine: "chunked" = jit'd lax.while_loop dispatching one fused
     # XLA round program per round; "fused" = the Pallas multi-round kernel
@@ -196,6 +230,54 @@ class SimConfig:
             raise ValueError("rumor_threshold must be >= 1")
         if not (0.0 <= self.fault_rate < 1.0):
             raise ValueError("fault_rate must be in [0, 1)")
+        if not (0.0 <= self.crash_rate < 1.0):
+            raise ValueError("crash_rate must be in [0, 1)")
+        if not (0.0 <= self.dup_rate < 1.0):
+            raise ValueError("dup_rate must be in [0, 1)")
+        if self.crash_schedule is not None:
+            if self.crash_rate > 0:
+                raise ValueError(
+                    "crash_rate and crash_schedule are mutually exclusive "
+                    "(the schedule IS the death process)"
+                )
+            from .ops.faults import parse_crash_schedule
+
+            parse_crash_schedule(self.crash_schedule)  # fail at config time
+        if not (0 <= self.delay_rounds <= 64):
+            raise ValueError(
+                f"delay_rounds must be in [0, 64], got {self.delay_rounds} "
+                "(the ring buffer holds delay_rounds full delivery planes)"
+            )
+        if not (0.0 < self.quorum <= 1.0):
+            raise ValueError(f"quorum must be in (0, 1], got {self.quorum}")
+        if self.quorum != 1.0 and not self.crash_model:
+            raise ValueError(
+                "quorum < 1.0 is the crash-model termination rule "
+                "(sum(conv & alive) >= quorum over LIVE nodes) and is a "
+                "silent no-op without one; set crash_rate/crash_schedule, "
+                "or use target_frac to relax a fault-free target"
+            )
+        if self.stall_chunks < 0:
+            raise ValueError("stall_chunks must be >= 0")
+        if self.semantics == "reference" and (
+            self.crash_model or self.dup_rate > 0 or self.delay_rounds > 0
+        ):
+            raise ValueError(
+                "crash/dup/delay fault models contradict reference "
+                "semantics — the reference models zero faults "
+                "(program.fs has no failure path); use batched semantics"
+            )
+        if self.crash_model and self.termination == "global":
+            raise ValueError(
+                "termination='global' (every node's residual stable) is "
+                "undefined under a crash model — dead nodes park arriving "
+                "mass and never stabilize; use the local latch with quorum"
+            )
+        if self.crash_model and self.target_frac is not None:
+            raise ValueError(
+                "target_frac and the crash model's quorum rule are two "
+                "different termination targets; use quorum"
+            )
         if not (1 <= self.max_rounds <= 2**30):
             # The upper bound keeps round-indexed PRNG fold_in tags disjoint
             # from the leader-draw tag (models/runner.py _LEADER_TAG).
@@ -264,6 +346,22 @@ class SimConfig:
     @property
     def reference(self) -> bool:
         return self.semantics == "reference"
+
+    @property
+    def crash_model(self) -> bool:
+        """True when nodes can die (ops/faults.death_plane is non-None)."""
+        return self.crash_rate > 0.0 or self.crash_schedule is not None
+
+    @property
+    def faulted(self) -> bool:
+        """Any failure-model knob set — engines that support none of them
+        gate on this."""
+        return (
+            self.fault_rate > 0.0
+            or self.crash_model
+            or self.dup_rate > 0.0
+            or self.delay_rounds > 0
+        )
 
     @property
     def resolved_delta(self) -> float:

@@ -28,6 +28,7 @@ from jax import lax
 
 from ..config import SimConfig
 from ..ops import delivery as delivery_mod
+from ..ops import faults as faults_mod
 from ..ops import sampling
 from ..ops.topology import Topology, imp_split, stencil_offsets
 from . import gossip as gossip_mod
@@ -56,6 +57,11 @@ class RunResult:
     compile_s: float
     run_s: float
     build_s: float = 0.0
+    # Why the run ended: "converged" (target/quorum reached), "stalled"
+    # (the cfg.stall_chunks watchdog saw no converged-count progress — the
+    # reference's line-topology hang, program.fs:334, as a measured event),
+    # or "max_rounds" (the round cap). Always present in the JSONL record.
+    outcome: str = "converged"
     # push-sum only:
     true_mean: Optional[float] = None
     estimate_mae: Optional[float] = None
@@ -72,6 +78,58 @@ class RunResult:
         rec["wall_ms"] = self.wall_ms
         rec["rounds_per_sec"] = self.rounds / self.run_s if self.run_s > 0 else None
         return rec
+
+
+class StallWatchdog:
+    """Converged-count progress watchdog over chunk boundaries
+    (cfg.stall_chunks): the reference's only non-convergence behavior was
+    hanging forever (program.fs:334); here a stall becomes the measured
+    outcome="stalled". One instance per run drives EVERY chunked driver
+    (single-device, fused, and the sharded compositions) so the rule
+    cannot drift between engines. Callers guard the call with
+    ``cfg.stall_chunks`` — the converged-count read is a device sync that
+    a disabled watchdog must not pay."""
+
+    def __init__(self, stall_chunks: int):
+        self.limit = int(stall_chunks)
+        self.stalled = False
+        self._last = None
+        self._misses = 0
+
+    def no_progress(self, metric: int) -> bool:
+        """Record this chunk's progress metric (the termination
+        predicate's remaining gap, _progress_gap — NOT the raw conv count:
+        under a crash model the quorum need falls as nodes die, so a flat
+        conv count can still be progress); True once it has been flat for
+        ``limit`` consecutive chunks."""
+        if not self.limit:
+            return False
+        if metric == self._last:
+            self._misses += 1
+            if self._misses >= self.limit:
+                self.stalled = True
+        else:
+            self._last, self._misses = metric, 0
+        return self.stalled
+
+
+def _progress_gap(death_dev, quorum: float, target: int, conv, rounds: int):
+    """The stall watchdog's metric at a chunk boundary: remaining distance
+    to the SAME predicate the done flag evaluates. Legacy: target − conv
+    count. Crash model: quorum_need(alive) − conv-among-live at the last
+    executed round — both terms move, so a shrinking need counts as
+    progress even while the conv count is flat. ``conv`` and ``death_dev``
+    must be shape-aligned (both [n], or both padded planes — pad slots
+    carry death round 0 and conv 0, so they cancel)."""
+    conv_i = jnp.asarray(conv).astype(jnp.int32)
+    if death_dev is None:
+        return int(target) - int(jnp.sum(conv_i))
+    alive = death_dev > rounds - 1
+    conv_alive = int(jnp.sum(jnp.where(alive, conv_i, jnp.int32(0))))
+    need = int(faults_mod.quorum_need(
+        jnp.sum(alive.astype(jnp.int32)), quorum
+    ))
+    return need - conv_alive
 
 
 def _check_dtype(cfg: SimConfig) -> jnp.dtype:
@@ -92,6 +150,57 @@ def draw_leader(base_key: jax.Array, topo: Topology, cfg: SimConfig) -> jax.Arra
     return jax.random.randint(
         jax.random.fold_in(base_key, _LEADER_TAG), (), 0, upper, dtype=jnp.int32
     )
+
+
+def _death_dev(cfg: SimConfig, n: int):
+    """Device copy of the crash-priority plane (ops/faults.death_plane), or
+    None without a crash model. A pure function of (cfg, n) — every engine
+    rebuilds the identical plane, so checkpoints never store it."""
+    death = faults_mod.death_plane(cfg, n)
+    return None if death is None else jnp.asarray(death)
+
+
+def _freeze_dead(death_dev, old, new, round_idx):
+    """Crash-stop semantics for one round (ops/faults.py docstring): a node
+    dead during ``round_idx`` keeps its protocol state frozen — it neither
+    converges nor advances. Push-sum (s, w) deliberately take the NEW
+    values: mass delivered to a dead node parks there, so total mass over
+    live + dead nodes is conserved. No-op without a crash model."""
+    if death_dev is None:
+        return new
+    dead = death_dev <= round_idx
+    if isinstance(new, pushsum_mod.PushSumState):
+        return new._replace(
+            term=jnp.where(dead, old.term, new.term),
+            conv=jnp.where(dead, old.conv, new.conv),
+        )
+    return gossip_mod.GossipState(
+        count=jnp.where(dead, old.count, new.count),
+        active=jnp.where(dead, old.active, new.active),
+        conv=jnp.where(dead, old.conv, new.conv),
+    )
+
+
+def _done_predicate(cfg: SimConfig, death_dev, target: int):
+    """The while-loop termination predicate, as ``done(state, round_idx)``
+    with round_idx the round JUST EXECUTED. Legacy: converged_count >=
+    target. Crash model: quorum over live nodes — sum(conv & alive) >=
+    quorum_need(sum(alive)) (ops/faults.py), so a run with churn terminates
+    with a meaningful answer instead of spinning to max_rounds."""
+    if death_dev is None:
+        def done(state, round_idx):
+            return jnp.sum(state.conv) >= target
+    else:
+        quorum = cfg.quorum
+
+        def done(state, round_idx):
+            alive = death_dev > round_idx
+            need = faults_mod.quorum_need(
+                jnp.sum(alive.astype(jnp.int32)), quorum
+            )
+            return jnp.sum((state.conv & alive).astype(jnp.int32)) >= need
+
+    return done
 
 
 def resolve_deliver_fn(topo: Topology, cfg: SimConfig):
@@ -131,6 +240,13 @@ def make_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array):
     dtype = _check_dtype(cfg)
     n = topo.n
 
+    if cfg.delivery == "pool" and (cfg.dup_rate > 0 or cfg.delay_rounds > 0):
+        raise ValueError(
+            "dup/delay fault models run on the scatter/stencil chunked "
+            "paths only; pool delivery supports the drop gate "
+            "(--fault-rate) and crash models"
+        )
+
     if cfg.delivery == "pool":
         if topo.implicit:
             return _make_pool_round_fn(topo, cfg, base_key, dtype)
@@ -163,6 +279,7 @@ def make_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array):
         topo_args = (jnp.asarray(topo.neighbors), jnp.asarray(topo.degree))
 
     deliver_fn = resolve_deliver_fn(topo, cfg)
+    death_dev = _death_dev(cfg, n)
 
     def targets_and_gate(round_idx, key_data, *targs):
         # ids generated inside the trace (lax.iota) — never a baked constant.
@@ -180,19 +297,73 @@ def make_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array):
             gate = sampling.send_gate(kr, n, cfg.fault_rate)
             if gate is not True:
                 send_ok = send_ok & gate
-            return targets, send_ok
+            if death_dev is not None:
+                send_ok = send_ok & (death_dev > round_idx)  # dead: no sends
+            dup = sampling.dup_gate(kr, n, cfg.dup_rate)
+            return targets, send_ok, dup
+
+    def make_df(dup):
+        """Per-round delivery fn with the duplicate-delivery gate folded
+        in: a dup-gated sender's message lands twice (at-least-once
+        delivery). ``dup is False`` (dup_rate == 0) keeps the base fn —
+        zero-cost and bitwise the unfaulted delivery."""
+        if dup is False:
+            return deliver_fn
+
+        def df(v, t):
+            return deliver_fn(v, t) + deliver_fn(
+                jnp.where(dup, v, jnp.zeros((), v.dtype)), t
+            )
+
+        return df
+
+    D = cfg.delay_rounds
 
     if cfg.algorithm == "push-sum":
         state0 = pushsum_mod.init_state(n, dtype, cfg.initial_term_round)
         delta = cfg.resolved_delta
         term_rounds = cfg.term_rounds
 
-        def round_fn(state, round_idx, key_data, *targs):
-            targets, send_ok = targets_and_gate(round_idx, key_data, *targs)
-            return pushsum_mod.round_from_targets(
-                state, targets, send_ok, n, delta, term_rounds, deliver_fn,
-                cfg.termination == "global",
-            )
+        if D:
+            # Bounded message delay: this round's deliveries are parked in
+            # a ring of D send planes and absorbed D rounds later —
+            # in-flight mass lives in the ring, so Σs and Σw are conserved
+            # over state + ring (tests pin it). The carry is (state, ring).
+            ring0 = jnp.zeros((D, 2, n), dtype)
+            state0 = (state0, ring0)
+
+            def round_fn(carry, round_idx, key_data, *targs):
+                state, ring = carry
+                targets, send_ok, dup = targets_and_gate(
+                    round_idx, key_data, *targs
+                )
+                df = make_df(dup)
+                s_send, w_send, s_keep, w_keep = pushsum_mod.halve_and_send(
+                    state.s, state.w, send_ok
+                )
+                fresh = jnp.stack([df(s_send, targets), df(w_send, targets)])
+                slot = lax.rem(round_idx, jnp.int32(D))
+                arrive = lax.dynamic_index_in_dim(
+                    ring, slot, axis=0, keepdims=False
+                )
+                ring = lax.dynamic_update_index_in_dim(ring, fresh, slot, 0)
+                new = pushsum_mod.absorb(
+                    state, s_keep, w_keep, arrive[0], arrive[1], delta,
+                    term_rounds, cfg.termination == "global",
+                )
+                return (_freeze_dead(death_dev, state, new, round_idx), ring)
+
+        else:
+
+            def round_fn(state, round_idx, key_data, *targs):
+                targets, send_ok, dup = targets_and_gate(
+                    round_idx, key_data, *targs
+                )
+                new = pushsum_mod.round_from_targets(
+                    state, targets, send_ok, n, delta, term_rounds,
+                    make_df(dup), cfg.termination == "global",
+                )
+                return _freeze_dead(death_dev, state, new, round_idx)
 
     else:
         leader = draw_leader(base_key, topo, cfg)
@@ -202,11 +373,36 @@ def make_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array):
         rumor_target = cfg.resolved_rumor_target
         suppress = cfg.resolved_suppress
 
-        def round_fn(state, round_idx, key_data, *targs):
-            targets, send_ok = targets_and_gate(round_idx, key_data, *targs)
-            return gossip_mod.round_from_targets(
-                state, targets, send_ok, n, rumor_target, suppress, deliver_fn
-            )
+        if D:
+            ring0 = jnp.zeros((D, n), jnp.int32)
+            state0 = (state0, ring0)
+
+            def round_fn(carry, round_idx, key_data, *targs):
+                state, ring = carry
+                targets, send_ok, dup = targets_and_gate(
+                    round_idx, key_data, *targs
+                )
+                vals = gossip_mod.send_values(state, send_ok)
+                fresh = make_df(dup)(vals, targets)
+                slot = lax.rem(round_idx, jnp.int32(D))
+                arrive = lax.dynamic_index_in_dim(
+                    ring, slot, axis=0, keepdims=False
+                )
+                ring = lax.dynamic_update_index_in_dim(ring, fresh, slot, 0)
+                new = gossip_mod.absorb(state, arrive, rumor_target, suppress)
+                return (_freeze_dead(death_dev, state, new, round_idx), ring)
+
+        else:
+
+            def round_fn(state, round_idx, key_data, *targs):
+                targets, send_ok, dup = targets_and_gate(
+                    round_idx, key_data, *targs
+                )
+                new = gossip_mod.round_from_targets(
+                    state, targets, send_ok, n, rumor_target, suppress,
+                    make_df(dup),
+                )
+                return _freeze_dead(death_dev, state, new, round_idx)
 
     return round_fn, state0, key_data, topo_args
 
@@ -221,6 +417,7 @@ def _make_pool_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array, dty
     n = topo.n
     K = cfg.pool_size
     key_data, key_impl = sampling.key_split(base_key)
+    death_dev = _death_dev(cfg, n)
 
     def pool_parts(round_idx, key_data):
         with jax.named_scope("sample"):
@@ -232,6 +429,8 @@ def _make_pool_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array, dty
             choice = sampling.pool_choice_packed(kr, n, K)
             gate = sampling.send_gate(kr, n, cfg.fault_rate)
             send_ok = jnp.ones((n,), bool) if gate is True else gate
+            if death_dev is not None:
+                send_ok = send_ok & (death_dev > round_idx)
             return choice, offs, send_ok
 
     if cfg.algorithm == "push-sum":
@@ -250,10 +449,11 @@ def _make_pool_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array, dty
                     jnp.stack([s_send, w_send]), choice, offs
                 )
             with jax.named_scope("pushsum_absorb"):
-                return pushsum_mod.absorb(
+                new = pushsum_mod.absorb(
                     state, s_keep, w_keep, inbox[0], inbox[1], delta,
                     term_rounds, cfg.termination == "global",
                 )
+            return _freeze_dead(death_dev, state, new, round_idx)
 
     else:
         leader = draw_leader(base_key, topo, cfg)
@@ -272,7 +472,8 @@ def _make_pool_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array, dty
             with jax.named_scope("gossip_absorb"):
                 # Suppression is receiver-side (models/gossip.absorb): no
                 # pool_lookup backward rolls needed.
-                return gossip_mod.absorb(state, inbox, rumor_target, suppress)
+                new = gossip_mod.absorb(state, inbox, rumor_target, suppress)
+            return _freeze_dead(death_dev, state, new, round_idx)
 
     return round_fn, state0, key_data, ()
 
@@ -327,13 +528,19 @@ def _make_imp_pool_round_fn(
     key_data, key_impl = sampling.key_split(base_key)
     topo_args = (jnp.asarray(split.disp_cols), jnp.asarray(split.degree))
     lattice_offsets = tuple(int(q) for q in split.lattice_offsets)
+    death_dev = _death_dev(cfg, n)
 
     def parts(round_idx, key_data, disp_cols, degree):
         with jax.named_scope("sample"):
             kr = sampling.round_key(
                 sampling.key_join(key_data, key_impl), round_idx
             )
-            return imp_pool_parts(topo, cfg, kr, disp_cols, degree)
+            d, is_extra, choice, offs, send_ok = imp_pool_parts(
+                topo, cfg, kr, disp_cols, degree
+            )
+            if death_dev is not None:
+                send_ok = send_ok & (death_dev > round_idx)
+            return d, is_extra, choice, offs, send_ok
 
     if cfg.algorithm == "push-sum":
         state0 = pushsum_mod.init_state(n, dtype, cfg.initial_term_round)
@@ -352,10 +559,11 @@ def _make_imp_pool_round_fn(
                     lattice_offsets, offs,
                 )
             with jax.named_scope("pushsum_absorb"):
-                return pushsum_mod.absorb(
+                new = pushsum_mod.absorb(
                     state, s_keep, w_keep, inbox[0], inbox[1], delta,
                     term_rounds, cfg.termination == "global",
                 )
+            return _freeze_dead(death_dev, state, new, round_idx)
 
     else:
         leader = draw_leader(base_key, topo, cfg)
@@ -372,7 +580,8 @@ def _make_imp_pool_round_fn(
                     vals[None], d, is_extra, choice, lattice_offsets, offs
                 )[0]
             with jax.named_scope("gossip_absorb"):
-                return gossip_mod.absorb(state, inbox, rumor_target, suppress)
+                new = gossip_mod.absorb(state, inbox, rumor_target, suppress)
+            return _freeze_dead(death_dev, state, new, round_idx)
 
     return round_fn, state0, key_data, topo_args
 
@@ -396,6 +605,7 @@ def _run_reference_walk(topo: Topology, cfg: SimConfig, key, target: int) -> Run
         converged=converged_count >= target,
         compile_s=compile_s,
         run_s=run_s,
+        outcome="converged" if converged_count >= target else "max_rounds",
     )
     ratio = final.s / final.w
     true_mean = (topo.n - 1) / 2.0
@@ -405,8 +615,27 @@ def _run_reference_walk(topo: Topology, cfg: SimConfig, key, target: int) -> Run
     return result
 
 
-def _finalize_result(topo, cfg, state, rounds, target, compile_s, run_s) -> RunResult:
+def _host_done(cfg, death_np, state, rounds: int, target: int) -> bool:
+    """Host-side evaluation of the termination predicate against the final
+    state — the same rule _done_predicate traces (quorum over live nodes
+    under a crash model, converged_count >= target otherwise), for engines
+    whose in-kernel done flag is not directly observable."""
+    import numpy as np
+
+    conv = np.asarray(state.conv) != 0
+    if death_np is None:
+        return bool(conv.sum() >= target)
+    alive = death_np > (rounds - 1)
+    need = int(faults_mod.quorum_need(int(alive.sum()), cfg.quorum))
+    return bool((conv & alive).sum() >= need)
+
+
+def _finalize_result(
+    topo, cfg, state, rounds, target, compile_s, run_s,
+    done=None, stalled: bool = False,
+) -> RunResult:
     converged_count = int(jnp.sum(state.conv))
+    converged = (converged_count >= target) if done is None else bool(done)
     result = RunResult(
         algorithm=cfg.algorithm,
         topology=topo.kind,
@@ -416,9 +645,13 @@ def _finalize_result(topo, cfg, state, rounds, target, compile_s, run_s) -> RunR
         target_count=target,
         rounds=rounds,
         converged_count=converged_count,
-        converged=converged_count >= target,
+        converged=converged,
         compile_s=compile_s,
         run_s=run_s,
+        outcome=(
+            "converged" if converged
+            else ("stalled" if stalled else "max_rounds")
+        ),
     )
     if cfg.algorithm == "push-sum":
         ratio = state.s / state.w
@@ -577,6 +810,9 @@ def _run_fused(
     compile_s = time.perf_counter() - t0
 
     rounds = start_round
+    watchdog = StallWatchdog(cfg.stall_chunks)
+    death_np = faults_mod.death_plane(cfg, topo.n)
+    death_dev = None if death_np is None else jnp.asarray(death_np)
     t1 = time.perf_counter()
     while True:
         state_dev, executed = chunk_j(
@@ -588,10 +824,23 @@ def _run_fused(
             on_chunk(rounds, to_canonical(state_dev))
         if executed < K or rounds >= cfg.max_rounds:
             break
+        # Watchdog: the kernel executes full chunks while unconverged, so a
+        # stalled topology would otherwise spin to max_rounds. Canonical
+        # state, not the raw planes — pool2 packs term+conv in one plane.
+        if cfg.stall_chunks and watchdog.no_progress(
+            _progress_gap(
+                death_dev, cfg.quorum, target,
+                to_canonical(state_dev).conv, rounds,
+            )
+        ):
+            break
     run_s = time.perf_counter() - t1
 
+    final = to_canonical(state_dev)
+    done = _host_done(cfg, death_np, final, rounds, target)
     return _finalize_result(
-        topo, cfg, to_canonical(state_dev), rounds, target, compile_s, run_s
+        topo, cfg, final, rounds, target, compile_s, run_s,
+        done=done, stalled=watchdog.stalled,
     )
 
 
@@ -794,14 +1043,29 @@ def run(
             )
 
     round_fn, state0, key_data, topo_args = make_round_fn(topo, cfg, key)
+    has_ring = cfg.delay_rounds > 0  # carry is (state, delay ring)
+
+    def proto_of(carry_state):
+        return carry_state[0] if has_ring else carry_state
+
+    death_np = faults_mod.death_plane(cfg, topo.n)
+    death_dev = None if death_np is None else jnp.asarray(death_np)
+    done_fn = _done_predicate(cfg, death_dev, target)
     done0 = False
     if start_state is not None:
+        if has_ring:
+            raise ValueError(
+                "resume with delay_rounds > 0 is unsupported: the in-flight "
+                "delivery ring is not checkpointed, so the resumed "
+                "trajectory could not be bitwise-faithful"
+            )
         state0 = jax.tree.map(jnp.asarray, start_state)
         # Seed the loop predicate from the resumed state: a checkpoint taken
         # at/after convergence must execute ZERO further rounds, matching the
         # fused kernels (which seed their done flag from the incoming conv
         # plane) — otherwise the resumed trajectory gains a phantom round.
-        done0 = bool(jnp.sum(state0.conv) >= target)
+        # Same predicate the original run evaluated after its last round.
+        done0 = _host_done(cfg, death_np, state0, start_round, target)
 
     def chunk(carry, round_end, key_data, *targs):
         def cond(c):
@@ -811,7 +1075,7 @@ def run(
         def body(c):
             state, rnd, _ = c
             state = round_fn(state, rnd, key_data, *targs)
-            done = jnp.sum(state.conv) >= target
+            done = done_fn(proto_of(state), rnd)
             return (state, rnd + 1, done)
 
         return lax.while_loop(cond, body, carry)
@@ -836,17 +1100,26 @@ def run(
     compile_s = time.perf_counter() - t0
 
     rounds = start_round
+    watchdog = StallWatchdog(cfg.stall_chunks)
     t1 = time.perf_counter()
     while True:
         round_end = min(rounds + cfg.chunk_rounds, cfg.max_rounds)
         carry = chunk_j(carry, jnp.int32(round_end), key_data, *topo_args)
         state, rnd, done = carry
         rounds = int(rnd)  # forces a host sync at the chunk boundary
+        proto = proto_of(state)
         if on_chunk is not None:
-            on_chunk(rounds, state)
+            on_chunk(rounds, proto)
         if bool(done) or rounds >= cfg.max_rounds:
+            break
+        if cfg.stall_chunks and watchdog.no_progress(
+            _progress_gap(death_dev, cfg.quorum, target, proto.conv, rounds)
+        ):
             break
     run_s = time.perf_counter() - t1
 
-    state, _, _ = carry
-    return _finalize_result(topo, cfg, state, rounds, target, compile_s, run_s)
+    state, _, done = carry
+    return _finalize_result(
+        topo, cfg, proto_of(state), rounds, target, compile_s, run_s,
+        done=bool(done), stalled=watchdog.stalled,
+    )

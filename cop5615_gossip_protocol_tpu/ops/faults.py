@@ -1,0 +1,180 @@
+"""Failure model: crash-stop node death, quorum targets, message faults.
+
+The reference simulator models zero faults and simply hangs when a topology
+stalls (program.fs:334 — the famous line-topology non-convergence just
+spins); yet epidemic gossip and push-sum exist *because* they tolerate
+failures. This module is the single home for the framework's failure
+semantics, shared verbatim by the chunked XLA runner, the sharded runner,
+and the fused Pallas engines:
+
+Crash-stop (``--crash-rate`` / ``--crash-schedule``)
+    Every node gets a **death round** at run start — an int32 plane derived
+    deterministically from ``PRNGKey(cfg.seed)`` under a dedicated fold_in
+    tag (NOT from the runner's possibly-overridden base key, so every
+    engine — chunked, sharded, fused — rebuilds the identical plane from
+    the config alone, and checkpoints need not store it). Node ``i`` is
+    alive during round ``r`` iff ``death_round[i] > r`` — one integer
+    compare, exact on every backend. Dead nodes never send; push-sum mass
+    delivered to a dead node still lands in its (s, w) — the mass *parks*
+    there, so total mass over live + dead nodes is conserved — but its
+    protocol state (term counter, convergence latch; gossip receipt counts)
+    is frozen: dead nodes neither converge nor advance.
+
+    ``crash_rate`` p: each node independently survives each round with
+    probability 1-p (geometric death round via inverse CDF).
+    ``crash_schedule`` "round:count,...": exactly ``count`` uniformly random
+    distinct nodes die at each listed round — deterministic population
+    decay for reproducible experiments.
+
+Quorum termination (``--quorum``)
+    With nodes crashing, the legacy target (``converged_count >= n``) can
+    become permanently unreachable and the run would spin to max_rounds.
+    Under a crash model the while-loop target becomes a quorum over LIVE
+    nodes: ``sum(conv & alive) >= quorum_need(sum(alive), quorum)``. The
+    need is computed as ``alive - floor((1 - quorum) * alive)`` — integer
+    exact at quorum=1.0 for every population size (a plain
+    ``ceil(quorum * alive)`` at float32 is off by one above 2^24 nodes).
+
+Message faults
+    ``--fault-rate`` (send drop) and ``--dup-rate`` (duplicate delivery)
+    are per-round, per-node threefry gates (ops/sampling.send_gate /
+    dup_gate) — uint32 bits against a precomputed threshold, so the fused
+    kernels regenerate the identical gate in-kernel position-wise.
+    ``--delay-rounds`` defers every round's delivered planes through a ring
+    buffer (models/runner.py) — in-flight mass lives in the ring, so
+    conservation holds over state + ring.
+
+JAX imports are deferred to call sites: ``parse_crash_schedule`` must stay
+importable from SimConfig validation without touching a backend.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+# fold_in tag for the crash-priority draw off PRNGKey(cfg.seed). It shares
+# fold_in space with round indices (< 2**30, the SimConfig max_rounds cap
+# that exists exactly to keep base-key tags disjoint) and the leader tag
+# (2**31 - 1), so it must sit in [2**30, 2**31 - 1); the tags that fold
+# into per-round keys (sampling._POOL_TAG et al.) are a different stream
+# level entirely.
+CRASH_TAG = 2**30 + 0xDEAD
+
+# Death round of a node that never crashes. Above any reachable round
+# (max_rounds <= 2**30, enforced by SimConfig).
+NEVER = np.int32(np.iinfo(np.int32).max)
+
+
+def parse_crash_schedule(spec: str) -> tuple[tuple[int, int], ...]:
+    """Parse "round:count,round:count,..." into sorted (round, count) pairs.
+
+    Rounds must be distinct non-negative ints, counts positive. Raises
+    ValueError with the offending token — the CLI surfaces it verbatim.
+    """
+    events = []
+    for token in spec.split(","):
+        token = token.strip()
+        if not token:
+            continue
+        parts = token.split(":")
+        if len(parts) != 2:
+            raise ValueError(
+                f"crash schedule entry {token!r} is not 'round:count'"
+            )
+        try:
+            rnd, count = int(parts[0]), int(parts[1])
+        except ValueError:
+            raise ValueError(
+                f"crash schedule entry {token!r} is not 'round:count' "
+                "with integer fields"
+            ) from None
+        if rnd < 0:
+            raise ValueError(f"crash schedule round {rnd} must be >= 0")
+        if count <= 0:
+            raise ValueError(f"crash schedule count {count} must be > 0")
+        events.append((rnd, count))
+    if not events:
+        raise ValueError(f"crash schedule {spec!r} has no entries")
+    rounds = [r for r, _ in events]
+    if len(set(rounds)) != len(rounds):
+        raise ValueError(f"crash schedule {spec!r} repeats a round")
+    return tuple(sorted(events))
+
+
+def death_plane(cfg, n: int):
+    """int32 [n] death rounds (np.ndarray), or None when the config has no
+    crash model.
+
+    Derived from ``PRNGKey(cfg.seed)`` + CRASH_TAG only — a pure function
+    of (cfg, n), so the chunked, sharded, and fused engines (which bake the
+    plane as a kernel constant) all rebuild the identical plane, and resume
+    reconstructs it from the checkpoint's config. Memoized on the knobs it
+    actually reads (one run touches it several times: kernel constants,
+    the watchdog gap, the finalize predicate — at 16.8M nodes each rebuild
+    is a full permutation draw). Treat the returned array as READ-ONLY.
+    """
+    if not cfg.crash_model:
+        return None
+    return _death_plane_cached(cfg.seed, cfg.crash_rate, cfg.crash_schedule, n)
+
+
+@functools.lru_cache(maxsize=4)
+def _death_plane_cached(seed: int, crash_rate: float, crash_schedule, n: int):
+    import jax
+    import jax.numpy as jnp
+
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), CRASH_TAG)
+    if crash_schedule is not None:
+        events = parse_crash_schedule(crash_schedule)
+        total = sum(c for _, c in events)
+        if total > n:
+            raise ValueError(
+                f"crash schedule kills {total} nodes but the population "
+                f"is {n}"
+            )
+        perm = np.asarray(jax.random.permutation(key, n))
+        death = np.full((n,), NEVER, np.int32)
+        off = 0
+        for rnd, count in events:
+            death[perm[off : off + count]] = rnd
+            off += count
+        return death
+    p = float(crash_rate)
+    u = np.asarray(jax.random.uniform(key, (n,), jnp.float32), np.float64)
+    # P(death_round >= k) = (1-p)^k  ->  inverse CDF of the geometric;
+    # u in [0,1) so log1p(-u) is finite and <= 0.
+    death = np.floor(np.log1p(-u) / np.log1p(-p))
+    return np.clip(death, 0, float(NEVER)).astype(np.int32)
+
+
+def pad_death_plane(death: np.ndarray, n_pad: int) -> np.ndarray:
+    """Pad to n_pad with death round 0: padded slots count as DEAD, so
+    alive-count reductions over padded layouts (sharded shards, fused
+    kernel planes) equal the unpadded count without extra masking."""
+    if death.shape[0] == n_pad:
+        return death
+    return np.concatenate(
+        [death, np.zeros((n_pad - death.shape[0],), np.int32)]
+    )
+
+
+def alive_at(death, round_idx):
+    """bool alive mask for round ``round_idx`` (both may be traced)."""
+    return death > round_idx
+
+
+def quorum_need(alive_count, quorum: float):
+    """Converged-live count that terminates the run: the quorum over live
+    nodes, as ``alive - floor((1-quorum) * alive)``. Integer-exact at
+    quorum=1.0 (the float32 product is exactly 0); float32 rounding on the
+    slack term otherwise — identical jnp ops on every engine, so the
+    per-round targets agree across chunked / sharded / fused paths."""
+    import jax.numpy as jnp
+
+    ac = jnp.asarray(alive_count, jnp.int32)
+    slack = jnp.floor(
+        (jnp.float32(1.0) - jnp.float32(quorum)) * ac.astype(jnp.float32)
+    )
+    return ac - slack.astype(jnp.int32)

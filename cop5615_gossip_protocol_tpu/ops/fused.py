@@ -54,6 +54,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..config import SimConfig
+from . import faults as faults_mod
+from .sampling import GATE_TAG, gate_threshold
 from .topology import Topology, stencil_offsets
 
 LANES = 128
@@ -97,8 +99,11 @@ def fused_support(topo: Topology, cfg: SimConfig) -> Optional[str]:
             "requires jax_threefry_partitionable=True (the in-kernel "
             "threefry replicates the partitionable stream only)"
         )
-    if cfg.fault_rate > 0:
-        return "fault injection not supported in the fused kernel"
+    if cfg.dup_rate > 0 or cfg.delay_rounds > 0:
+        # Drop (--fault-rate) and crash models run in-kernel (the gate is
+        # regenerated position-wise, the crash plane rides as an input);
+        # dup/delay restructure delivery itself and stay chunked-only.
+        return "dup/delay fault models run on the chunked engine only"
     if cfg.n_devices is not None and cfg.n_devices > 1:
         return "fused engine is single-device"
     if topo.n > MAX_FUSED_NODES:
@@ -260,6 +265,61 @@ def _sample_disp(bits, disp_ref, deg):
     return d
 
 
+def gate_round_keys(keys: jax.Array) -> jax.Array:
+    """uint32 [K, 2] send-gate subkeys for the per-round keys: fold_in of
+    each round key with sampling.GATE_TAG — the exact stream
+    ops/sampling.send_gate draws, so a kernel's regenerated gate bits match
+    the chunked engine word for word. Computed inside the jitted chunk call
+    (same reasoning as round_keys)."""
+    return jax.vmap(lambda kd: jax.random.fold_in(kd, GATE_TAG))(keys)
+
+
+def build_death2d(cfg: SimConfig, n: int, n_pad: int):
+    """[n_pad // 128, 128] int32 crash plane for a fused kernel, or None
+    without a crash model. Padded with death round 0 — pad slots count as
+    dead, so in-kernel alive reductions equal the live population with no
+    extra masking (ops/faults.pad_death_plane)."""
+    death = faults_mod.death_plane(cfg, n)
+    if death is None:
+        return None
+    return jnp.asarray(
+        faults_mod.pad_death_plane(death, n_pad).reshape(n_pad // LANES, LANES)
+    )
+
+
+def make_done_flag(death_ref, target, quorum, masked_total: bool = False):
+    """In-kernel termination verdict, shared by every fused kernel builder
+    (call INSIDE the kernel body, where ``death_ref`` is the crash-plane
+    VMEM ref or None without a crash model): quorum over live nodes under
+    a crash model (faults.quorum_need — the same jnp ops as the chunked
+    predicate, so the per-round targets agree across engines), the legacy
+    target count otherwise.
+
+    The returned ``done_flag(conv, round_idx)`` takes either the raw conv
+    plane (``masked_total=False`` — it masks dead lanes itself) or an
+    already-live-masked scalar total (``masked_total=True`` — what the
+    pool absorb tiles return), and yields int32 0/1 for the kernel's done
+    flag."""
+
+    def done_flag(conv, round_idx):
+        if death_ref is None:
+            total = conv if masked_total else jnp.sum(conv)
+            return jnp.where(total >= target, jnp.int32(1), jnp.int32(0))
+        alive = death_ref[:] > round_idx
+        if masked_total:
+            conv_alive = conv
+        else:
+            conv_alive = jnp.sum(
+                jnp.where(alive, conv, jnp.int32(0)), dtype=jnp.int32
+            )
+        need = faults_mod.quorum_need(
+            jnp.sum(alive.astype(jnp.int32), dtype=jnp.int32), quorum
+        )
+        return jnp.where(conv_alive >= need, jnp.int32(1), jnp.int32(0))
+
+    return done_flag
+
+
 def clamp_cap_and_pad(start, cap, keys, extras=()):
     """Shared per-chunk SMEM stream prep for every fused engine.
 
@@ -308,14 +368,33 @@ def make_pushsum_chunk(
     term_rounds = np.int32(cfg.term_rounds)
     target = np.int32(cfg.resolved_target_count(topo.n, topo.target_count))
     global_term = cfg.termination == "global"
+    # Failure model (ops/faults.py): drop gate regenerated in-kernel from
+    # the per-round gate subkeys; crash plane as an extra input. Both are
+    # Python-level flags, so a fault-free config traces the IDENTICAL
+    # kernel as before — bitwise trajectory equivalence at fault_rate=0.
+    use_gate = cfg.fault_rate > 0
+    thresh = np.uint32(gate_threshold(cfg.fault_rate)) if use_gate else None
+    death2d = build_death2d(cfg, topo.n, layout.n_pad)
+    crashed = death2d is not None
+    quorum = cfg.quorum
 
-    def kernel(
-        start_ref, keys_ref, disp_ref, deg_ref, s0, w0, t0, c0,
-        s_o, w_o, t_o, c_o, meta_o,
-        s_v, w_v, t_v, c_v, flags,
-    ):
+    def kernel(*refs):
+        it = iter(refs)
+        start_ref, keys_ref = next(it), next(it)
+        gkeys_ref = next(it) if use_gate else None
+        disp_ref, deg_ref = next(it), next(it)
+        death_ref = next(it) if crashed else None
+        s0, w0, t0, c0 = next(it), next(it), next(it), next(it)
+        s_o, w_o, t_o, c_o, meta_o = (
+            next(it), next(it), next(it), next(it), next(it)
+        )
+        s_v, w_v, t_v, c_v, flags = (
+            next(it), next(it), next(it), next(it), next(it)
+        )
         k = pl.program_id(0)
         K = pl.num_programs(0)
+
+        done_flag = make_done_flag(death_ref, target, quorum)
 
         @pl.when(k == 0)
         def _init():
@@ -325,9 +404,10 @@ def make_pushsum_chunk(
             c_v[:] = c0[:]
             # done must seed from the incoming state, or a launch that starts
             # already-converged (resume, post-convergence chunk) would run
-            # one extra round the chunked runner would not.
-            flags[0] = jnp.where(jnp.sum(c0[:]) >= target, 1, 0)
-            flags[1] = 0  # rounds executed
+            # one extra round the chunked runner would not. The crash-model
+            # predicate is evaluated at the last executed round, start - 1.
+            flags[0] = done_flag(c0[:], start_ref[0] - 1)
+            flags[1] = jnp.int32(0)  # rounds executed
 
         active = (flags[0] == 0) & (start_ref[0] + k < start_ref[1])
 
@@ -338,6 +418,14 @@ def make_pushsum_chunk(
             deg = deg_ref[:]
             disp = _sample_disp(bits, disp_ref, deg)
             send_ok = deg > 0
+            if use_gate:
+                gbits = threefry_bits_2d(
+                    gkeys_ref[kk, 0], gkeys_ref[kk, 1], R, LANES
+                )
+                send_ok = send_ok & (gbits >= thresh)
+            if crashed:
+                alive = death_ref[:] > start_ref[0] + k
+                send_ok = send_ok & alive  # dead nodes never send
             s = s_v[:]
             w = w_v[:]
             zero = jnp.float32(0)
@@ -384,7 +472,7 @@ def make_pushsum_chunk(
                 w_v[:] = w_new
                 c_v[:] = conv_new
                 flags[1] = flags[1] + 1
-                flags[0] = jnp.where(all_ok, 1, 0)
+                flags[0] = jnp.where(all_ok, jnp.int32(1), jnp.int32(0))
             else:
                 received = inbox_w > 0
                 stable = jnp.abs(s_new / w_new - s / w) <= delta
@@ -397,12 +485,18 @@ def make_pushsum_chunk(
                     jnp.int32(1),
                     jnp.int32(0),
                 )
+                if crashed:
+                    # Crash-stop freeze (ops/faults.py): dead nodes keep
+                    # term/conv; s/w still take the round's update so
+                    # delivered mass parks on them (conserved).
+                    term_new = jnp.where(alive, term_new, term)
+                    conv_new = jnp.where(alive, conv_new, c_v[:])
                 s_v[:] = s_new
                 w_v[:] = w_new
                 t_v[:] = term_new
                 c_v[:] = conv_new
                 flags[1] = flags[1] + 1
-                flags[0] = jnp.where(jnp.sum(conv_new) >= target, 1, 0)
+                flags[0] = done_flag(conv_new, start_ref[0] + k)
 
         @pl.when(k == K - 1)
         def _emit():
@@ -421,30 +515,46 @@ def make_pushsum_chunk(
 
     def chunk_fn(state4, keys, start, cap):
         s, w, t, c = state4
-        cap, keys = clamp_cap_and_pad(start, cap, keys)
+        if use_gate:
+            gkeys = gate_round_keys(keys)
+            cap, keys, gkeys = clamp_cap_and_pad(
+                start, cap, keys, ((gkeys, 0),)
+            )
+        else:
+            cap, keys = clamp_cap_and_pad(start, cap, keys)
         K = keys.shape[0]
         grid = (K,)
         f32 = jax.ShapeDtypeStruct((R, LANES), jnp.float32)
         i32 = jax.ShapeDtypeStruct((R, LANES), jnp.int32)
+        smem_keys = pl.BlockSpec(
+            (8, 2), lambda k: (k // 8, 0), memory_space=pltpu.SMEM
+        )
+        plane = pl.BlockSpec((R, LANES), lambda k: (0, 0))
+        in_specs = [
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # start/cap
+            smem_keys,
+        ]
+        operands = [jnp.stack([jnp.int32(start), jnp.int32(cap)]), keys]
+        if use_gate:
+            in_specs.append(smem_keys)
+            operands.append(gkeys)
+        in_specs.append(
+            pl.BlockSpec((disp_cols.shape[0], R, LANES), lambda k: (0, 0, 0))
+        )
+        in_specs.append(plane)
+        operands += [disp_cols, degree2d]
+        if crashed:
+            in_specs.append(plane)
+            operands.append(death2d)
+        in_specs += [plane] * 4
+        operands += [s, w, t, c]
         outs = pl.pallas_call(
             kernel,
             grid=grid,
             out_shape=(f32, f32, i32, i32, jax.ShapeDtypeStruct((1,), jnp.int32)),
-            in_specs=[
-                pl.BlockSpec(memory_space=pltpu.SMEM),  # start/cap
-                pl.BlockSpec((8, 2), lambda k: (k // 8, 0), memory_space=pltpu.SMEM),
-                pl.BlockSpec((disp_cols.shape[0], R, LANES), lambda k: (0, 0, 0)),
-                pl.BlockSpec((R, LANES), lambda k: (0, 0)),
-                pl.BlockSpec((R, LANES), lambda k: (0, 0)),
-                pl.BlockSpec((R, LANES), lambda k: (0, 0)),
-                pl.BlockSpec((R, LANES), lambda k: (0, 0)),
-                pl.BlockSpec((R, LANES), lambda k: (0, 0)),
-            ],
+            in_specs=in_specs,
             out_specs=(
-                pl.BlockSpec((R, LANES), lambda k: (0, 0)),
-                pl.BlockSpec((R, LANES), lambda k: (0, 0)),
-                pl.BlockSpec((R, LANES), lambda k: (0, 0)),
-                pl.BlockSpec((R, LANES), lambda k: (0, 0)),
+                plane, plane, plane, plane,
                 pl.BlockSpec(memory_space=pltpu.SMEM),
             ),
             scratch_shapes=[
@@ -455,13 +565,7 @@ def make_pushsum_chunk(
                 pltpu.SMEM((2,), jnp.int32),
             ],
             interpret=interpret,
-        )(
-            jnp.stack([jnp.int32(start), jnp.int32(cap)]),
-            keys,
-            disp_cols,
-            degree2d,
-            s, w, t, c,
-        )
+        )(*operands)
         s2, w2, t2, c2, meta = outs
         return (s2, w2, t2, c2), meta[0]
 
@@ -480,21 +584,33 @@ def make_gossip_chunk(topo: Topology, cfg: SimConfig, *, interpret: bool = False
     rumor_target = np.int32(cfg.resolved_rumor_target)
     suppress = cfg.resolved_suppress
     target = np.int32(cfg.resolved_target_count(topo.n, topo.target_count))
-    def kernel(
-        start_ref, keys_ref, disp_ref, deg_ref, n0, a0, c0,
-        n_o, a_o, c_o, meta_o,
-        n_v, a_v, c_v, flags,
-    ):
+    use_gate = cfg.fault_rate > 0
+    thresh = np.uint32(gate_threshold(cfg.fault_rate)) if use_gate else None
+    death2d = build_death2d(cfg, topo.n, layout.n_pad)
+    crashed = death2d is not None
+    quorum = cfg.quorum
+
+    def kernel(*refs):
+        it = iter(refs)
+        start_ref, keys_ref = next(it), next(it)
+        gkeys_ref = next(it) if use_gate else None
+        disp_ref, deg_ref = next(it), next(it)
+        death_ref = next(it) if crashed else None
+        n0, a0, c0 = next(it), next(it), next(it)
+        n_o, a_o, c_o, meta_o = next(it), next(it), next(it), next(it)
+        n_v, a_v, c_v, flags = next(it), next(it), next(it), next(it)
         k = pl.program_id(0)
         K = pl.num_programs(0)
+
+        done_flag = make_done_flag(death_ref, target, quorum)
 
         @pl.when(k == 0)
         def _init():
             n_v[:] = n0[:]
             a_v[:] = a0[:]
             c_v[:] = c0[:]
-            flags[0] = jnp.where(jnp.sum(c0[:]) >= target, 1, 0)
-            flags[1] = 0
+            flags[0] = done_flag(c0[:], start_ref[0] - 1)
+            flags[1] = jnp.int32(0)
 
         active_chunk = (flags[0] == 0) & (start_ref[0] + k < start_ref[1])
 
@@ -505,6 +621,14 @@ def make_gossip_chunk(topo: Topology, cfg: SimConfig, *, interpret: bool = False
             deg = deg_ref[:]
             disp = _sample_disp(bits, disp_ref, deg)
             sending = (a_v[:] != 0) & (deg > 0)
+            if use_gate:
+                gbits = threefry_bits_2d(
+                    gkeys_ref[kk, 0], gkeys_ref[kk, 1], R, LANES
+                )
+                sending = sending & (gbits >= thresh)
+            if crashed:
+                alive = death_ref[:] > start_ref[0] + k
+                sending = sending & alive  # dead nodes never send
             vals = sending.astype(jnp.int32)
             inbox = jnp.zeros_like(vals)
             for d_mod, shift in layout.shifts:
@@ -517,6 +641,11 @@ def make_gossip_chunk(topo: Topology, cfg: SimConfig, *, interpret: bool = False
                 # plane (c_v not yet updated) — identical inbox to the
                 # sender-side probe, zero rolls.
                 inbox = jnp.where(c_v[:] != 0, jnp.int32(0), inbox)
+            if crashed:
+                # Dead nodes don't absorb: zeroing their inbox freezes
+                # count/active, and conv (count >= threshold, monotone)
+                # stays latched — the chunked _freeze_dead, element-wise.
+                inbox = jnp.where(alive, inbox, jnp.int32(0))
             count_new = n_v[:] + inbox
             active_new = jnp.where(
                 (a_v[:] != 0) | (inbox > 0), jnp.int32(1), jnp.int32(0)
@@ -526,7 +655,7 @@ def make_gossip_chunk(topo: Topology, cfg: SimConfig, *, interpret: bool = False
             a_v[:] = active_new
             c_v[:] = conv_new
             flags[1] = flags[1] + 1
-            flags[0] = jnp.where(jnp.sum(conv_new) >= target, 1, 0)
+            flags[0] = done_flag(conv_new, start_ref[0] + k)
 
         @pl.when(k == K - 1)
         def _emit():
@@ -540,25 +669,40 @@ def make_gossip_chunk(topo: Topology, cfg: SimConfig, *, interpret: bool = False
 
     def chunk_fn(state3, keys, start, cap):
         cnt, act, cv = state3
-        cap, keys = clamp_cap_and_pad(start, cap, keys)
+        if use_gate:
+            gkeys = gate_round_keys(keys)
+            cap, keys, gkeys = clamp_cap_and_pad(
+                start, cap, keys, ((gkeys, 0),)
+            )
+        else:
+            cap, keys = clamp_cap_and_pad(start, cap, keys)
         i32 = jax.ShapeDtypeStruct((R, LANES), jnp.int32)
+        smem_keys = pl.BlockSpec(
+            (8, 2), lambda k: (k // 8, 0), memory_space=pltpu.SMEM
+        )
+        plane = pl.BlockSpec((R, LANES), lambda k: (0, 0))
+        in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM), smem_keys]
+        operands = [jnp.stack([jnp.int32(start), jnp.int32(cap)]), keys]
+        if use_gate:
+            in_specs.append(smem_keys)
+            operands.append(gkeys)
+        in_specs.append(
+            pl.BlockSpec((disp_cols.shape[0], R, LANES), lambda k: (0, 0, 0))
+        )
+        in_specs.append(plane)
+        operands += [disp_cols, degree2d]
+        if crashed:
+            in_specs.append(plane)
+            operands.append(death2d)
+        in_specs += [plane] * 3
+        operands += [cnt, act, cv]
         outs = pl.pallas_call(
             kernel,
             grid=(keys.shape[0],),
             out_shape=(i32, i32, i32, jax.ShapeDtypeStruct((1,), jnp.int32)),
-            in_specs=[
-                pl.BlockSpec(memory_space=pltpu.SMEM),
-                pl.BlockSpec((8, 2), lambda k: (k // 8, 0), memory_space=pltpu.SMEM),
-                pl.BlockSpec((disp_cols.shape[0], R, LANES), lambda k: (0, 0, 0)),
-                pl.BlockSpec((R, LANES), lambda k: (0, 0)),
-                pl.BlockSpec((R, LANES), lambda k: (0, 0)),
-                pl.BlockSpec((R, LANES), lambda k: (0, 0)),
-                pl.BlockSpec((R, LANES), lambda k: (0, 0)),
-            ],
+            in_specs=in_specs,
             out_specs=(
-                pl.BlockSpec((R, LANES), lambda k: (0, 0)),
-                pl.BlockSpec((R, LANES), lambda k: (0, 0)),
-                pl.BlockSpec((R, LANES), lambda k: (0, 0)),
+                plane, plane, plane,
                 pl.BlockSpec(memory_space=pltpu.SMEM),
             ),
             scratch_shapes=[
@@ -568,13 +712,7 @@ def make_gossip_chunk(topo: Topology, cfg: SimConfig, *, interpret: bool = False
                 pltpu.SMEM((2,), jnp.int32),
             ],
             interpret=interpret,
-        )(
-            jnp.stack([jnp.int32(start), jnp.int32(cap)]),
-            keys,
-            disp_cols,
-            degree2d,
-            cnt, act, cv,
-        )
+        )(*operands)
         n2, a2, c2, meta = outs
         return (n2, a2, c2), meta[0]
 

@@ -44,6 +44,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..config import SimConfig
+from ..utils import compat
 from .fused import clamp_cap_and_pad, threefry_bits_2d
 from .fused_pool import LANES, build_pool_layout
 from .fused_pool2 import (
@@ -81,8 +82,13 @@ def imp_hbm_support(topo: Topology, cfg: SimConfig) -> Optional[str]:
             "requires jax_threefry_partitionable=True (the in-kernel "
             "threefry replicates the partitionable stream only)"
         )
-    if cfg.fault_rate > 0:
-        return "fault injection not supported in the fused kernel"
+    if cfg.faulted:
+        # No failure-model support in this engine yet — rejecting on
+        # the aggregate flag (not just fault_rate) keeps a crash/dup/
+        # delay config from silently running unfaulted here. The
+        # stencil (ops/fused.py) and pool tiers (ops/fused_pool.py,
+        # ops/fused_pool2.py) run drop+crash in-kernel.
+        return "failure models not supported in this fused kernel"
     if cfg.n_devices is not None and cfg.n_devices > 1:
         return "fused engine is single-device"
     if cfg.pool_size > 1 << POOL_CHOICE_BITS:
@@ -265,8 +271,8 @@ def make_pushsum_imp_hbm_chunk(
                 _copy_wait(scr_t, tA.at[pl.ds(r0, PT), :], sem_d)
                 _copy_wait(scr_c, cA.at[pl.ds(r0, PT), :], sem_d)
                 total = total + jnp.sum(scr_c[:], dtype=jnp.int32)
-            flags[0] = jnp.where(total >= target, 1, 0)
-            flags[1] = 0
+            flags[0] = jnp.where(total >= target, jnp.int32(1), jnp.int32(0))
+            flags[1] = jnp.int32(0)
 
         active = (flags[0] == 0) & (start_ref[1] + k < start_ref[2])
 
@@ -432,9 +438,9 @@ def make_pushsum_imp_hbm_chunk(
                         c_n, scr_c, sem_d, T, PT, N, row_l, lane
                     )
 
-                flags[0] = jnp.where(total == 0, 1, 0)
+                flags[0] = jnp.where(total == 0, jnp.int32(1), jnp.int32(0))
             else:
-                flags[0] = jnp.where(total >= target, 1, 0)
+                flags[0] = jnp.where(total >= target, jnp.int32(1), jnp.int32(0))
 
         A = (sA, wA, tA, cA)
         B = (sB, wB, tB, cB)
@@ -501,7 +507,7 @@ def make_pushsum_imp_hbm_chunk(
                 pltpu.SemaphoreType.DMA((1,)),
                 pltpu.SemaphoreType.DMA((3 * n_win,)),
             ],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=compat.pallas_tpu_compiler_params(
                 vmem_limit_bytes=96 * 1024 * 1024
             ),
             interpret=interpret,
@@ -567,8 +573,8 @@ def make_gossip_imp_hbm_chunk(
                 _copy_wait(scr_a, aA.at[pl.ds(r0, PT), :], sem_d)
                 _copy_wait(scr_c, cA.at[pl.ds(r0, PT), :], sem_d)
                 total = total + jnp.sum(scr_c[:], dtype=jnp.int32)
-            flags[0] = jnp.where(total >= target, 1, 0)
-            flags[1] = 0
+            flags[0] = jnp.where(total >= target, jnp.int32(1), jnp.int32(0))
+            flags[1] = jnp.int32(0)
 
         active = (flags[0] == 0) & (start_ref[1] + k < start_ref[2])
 
@@ -675,7 +681,7 @@ def make_gossip_imp_hbm_chunk(
 
             total = lax.fori_loop(0, T, p2, jnp.int32(0), unroll=False)
             flags[1] = flags[1] + 1
-            flags[0] = jnp.where(total >= target, 1, 0)
+            flags[0] = jnp.where(total >= target, jnp.int32(1), jnp.int32(0))
 
         A = (nA, aA, cA)
         B = (nB, aB, cB)
@@ -731,7 +737,7 @@ def make_gossip_imp_hbm_chunk(
                 pltpu.SemaphoreType.DMA((1,)),
                 pltpu.SemaphoreType.DMA((n_win,)),
             ],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=compat.pallas_tpu_compiler_params(
                 vmem_limit_bytes=96 * 1024 * 1024
             ),
             interpret=interpret,

@@ -53,8 +53,16 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..config import SimConfig
-from .fused import clamp_cap_and_pad, threefry_bits_2d
+from ..utils import compat
+from .fused import (
+    build_death2d,
+    clamp_cap_and_pad,
+    gate_round_keys,
+    make_done_flag,
+    threefry_bits_2d,
+)
 from .sampling import (
+    gate_threshold,
     POOL_CHOICE_BITS,
     POOL_PACK,
     POOL_TILE_ROWS,
@@ -104,8 +112,10 @@ def pool_common_support(topo: Topology, cfg: SimConfig) -> Optional[str]:
             "requires jax_threefry_partitionable=True (the in-kernel "
             "threefry replicates the partitionable stream only)"
         )
-    if cfg.fault_rate > 0:
-        return "fault injection not supported in the fused pool kernel"
+    if cfg.dup_rate > 0 or cfg.delay_rounds > 0:
+        # Drop (--fault-rate) and crash models run in-kernel; dup/delay
+        # restructure delivery itself and stay chunked-only.
+        return "dup/delay fault models run on the chunked engine only"
     if cfg.pool_size > 1 << POOL_CHOICE_BITS:
         return (
             f"pool_size {cfg.pool_size} exceeds the packed-choice limit "
@@ -293,7 +303,7 @@ def _copy_in(pairs, sems):
 def absorb_pushsum_tile(r0, padm, inbox_s, inbox_w,
                         s_v, w_v, t_v, c_v, ds_v, dw_v,
                         delta, term_rounds, global_term: bool = False,
-                        count_mask=None):
+                        count_mask=None, alive=None):
     """One tile of models/pushsum.absorb (program.fs:119-143) against VMEM
     state planes: s_keep = s - s_send (sends read back from the first copy
     of the doubled planes), term advances only on receipt, conv latches,
@@ -313,7 +323,12 @@ def absorb_pushsum_tile(r0, padm, inbox_s, inbox_w,
     ``count_mask`` (optional [TILE, 128] bool) further restricts the
     RETURNED global-mode metric — not the state update — to a subregion:
     the sharded compositions count only their middle (non-halo) rows, whose
-    redundant halo copies are counted by the row's home shard."""
+    redundant halo copies are counted by the row's home shard.
+
+    ``alive`` (optional [TILE, 128] bool) applies the crash-stop freeze
+    (ops/faults.py): dead lanes keep term/conv while s/w still absorb —
+    delivered mass parks on them. The return value then counts conv AMONG
+    LIVE lanes only (the quorum numerator), not all conv lanes."""
     inbox_s = jnp.where(padm, 0.0, inbox_s)
     inbox_w = jnp.where(padm, 0.0, inbox_w)
     s_t = s_v[pl.ds(r0, TILE), :]
@@ -332,6 +347,7 @@ def absorb_pushsum_tile(r0, padm, inbox_s, inbox_w,
     received = inbox_w > 0
     stable = jnp.abs(s_new / w_new - s_t / w_t) <= delta
     term = t_v[pl.ds(r0, TILE), :]
+    c_old = c_v[pl.ds(r0, TILE), :]
     term_new = jnp.where(
         received, jnp.where(stable, term + 1, jnp.int32(0)), term
     )
@@ -339,15 +355,22 @@ def absorb_pushsum_tile(r0, padm, inbox_s, inbox_w,
         padm,
         jnp.int32(0),
         jnp.where(
-            (c_v[pl.ds(r0, TILE), :] != 0) | (term_new >= term_rounds),
+            (c_old != 0) | (term_new >= term_rounds),
             jnp.int32(1),
             jnp.int32(0),
         ),
     )
+    if alive is not None:
+        term_new = jnp.where(alive, term_new, term)
+        conv_new = jnp.where(alive, conv_new, c_old)
     s_v[pl.ds(r0, TILE), :] = s_new
     w_v[pl.ds(r0, TILE), :] = w_new
     t_v[pl.ds(r0, TILE), :] = term_new
     c_v[pl.ds(r0, TILE), :] = conv_new
+    if alive is not None:
+        return jnp.sum(
+            jnp.where(alive, conv_new, jnp.int32(0)), dtype=jnp.int32
+        )
     return jnp.sum(conv_new, dtype=jnp.int32)
 
 
@@ -365,17 +388,24 @@ def latch_conv_global(c_v, n: int):
 
 
 def absorb_gossip_tile(r0, padm, inbox, n_v, a_v, c_v, rumor_target,
-                       suppress: bool = False):
+                       suppress: bool = False, alive=None):
     """One tile of models/gossip.absorb (program.fs:97-105) against VMEM
     state planes. Owns the pad masking of the inbox — callers pass it raw.
     ``suppress`` applies converged-target suppression receiver-side against
     the round-start conv tile (c_v not yet updated) — element-wise identical
     to the sender-side registry probe (models/gossip.py docstring).
     Writes the tile back; returns its converged count. Shared by the pool
-    and tiled-stencil engines."""
+    and tiled-stencil engines.
+
+    ``alive`` (optional [TILE, 128] bool) applies the crash-stop freeze:
+    a dead lane's inbox is dropped, freezing count/active (conv, being
+    count >= threshold on a monotone count, stays latched by itself). The
+    return value then counts conv among LIVE lanes (quorum numerator)."""
     inbox = jnp.where(padm, jnp.int32(0), inbox)
     if suppress:
         inbox = jnp.where(c_v[pl.ds(r0, TILE), :] != 0, jnp.int32(0), inbox)
+    if alive is not None:
+        inbox = jnp.where(alive, inbox, jnp.int32(0))
     count_new = n_v[pl.ds(r0, TILE), :] + inbox
     active_new = jnp.where(
         (a_v[pl.ds(r0, TILE), :] != 0) | (inbox > 0),
@@ -386,6 +416,10 @@ def absorb_gossip_tile(r0, padm, inbox, n_v, a_v, c_v, rumor_target,
     n_v[pl.ds(r0, TILE), :] = count_new
     a_v[pl.ds(r0, TILE), :] = active_new
     c_v[pl.ds(r0, TILE), :] = conv_new
+    if alive is not None:
+        return jnp.sum(
+            jnp.where(alive, conv_new, jnp.int32(0)), dtype=jnp.int32
+        )
     return jnp.sum(conv_new, dtype=jnp.int32)
 
 
@@ -412,26 +446,60 @@ def make_pushsum_pool_chunk(
     term_rounds = np.int32(cfg.term_rounds)
     target = np.int32(cfg.resolved_target_count(topo.n, topo.target_count))
     global_term = cfg.termination == "global"
+    # Failure model (ops/faults.py): drop gate regenerated in-kernel tile
+    # by tile from the per-round gate subkeys; crash plane as an extra
+    # input. Python-level flags — a fault-free config traces the IDENTICAL
+    # kernel as before (bitwise trajectory equivalence at fault_rate=0).
+    use_gate = cfg.fault_rate > 0
+    thresh = np.uint32(gate_threshold(cfg.fault_rate)) if use_gate else None
+    death2d = build_death2d(cfg, topo.n, layout.n_pad)
+    crashed = death2d is not None
+    quorum = cfg.quorum
 
-    def kernel(
-        start_ref, keys_ref, offs_ref, s0, w0, t0, c0,
-        s_o, w_o, t_o, c_o, meta_o,
-        s_v, w_v, t_v, c_v, ds_v, dw_v, dc_v, flags, sems,
-    ):
+    def kernel(*refs):
+        it = iter(refs)
+        start_ref, keys_ref = next(it), next(it)
+        gkeys_ref = next(it) if use_gate else None
+        offs_ref = next(it)
+        death_ref = next(it) if crashed else None
+        s0, w0, t0, c0 = next(it), next(it), next(it), next(it)
+        s_o, w_o, t_o, c_o, meta_o = (
+            next(it), next(it), next(it), next(it), next(it)
+        )
+        s_v, w_v, t_v, c_v, ds_v, dw_v, dc_v, flags, sems = (
+            next(it), next(it), next(it), next(it), next(it), next(it),
+            next(it), next(it), next(it),
+        )
         k = pl.program_id(0)
         K = pl.num_programs(0)
         gather_modn, _ = _make_gather_modn(layout, interpret)
         row_l = _iota2((TILE, LANES), 0)
         lane = _iota2((TILE, LANES), 1)
 
+        # The totals the absorb tiles return already count live lanes only.
+        done_flag = make_done_flag(death_ref, target, quorum, masked_total=True)
+
+        def conv_live_sum(round_idx):
+            """Quorum numerator over the resident conv plane (crash only)."""
+            alive = death_ref[:] > round_idx
+            return jnp.sum(
+                jnp.where(alive, c_v[:], jnp.int32(0)), dtype=jnp.int32
+            )
+
         @pl.when(k == 0)
         def _init():
             _copy_in([(s0, s_v), (w0, w_v), (t0, t_v), (c0, c_v)], sems)
             # done seeds from the incoming state so a launch that starts
             # already-converged (resume, post-convergence chunk) runs zero
-            # rounds, matching the chunked runner.
-            flags[0] = jnp.where(jnp.sum(c_v[:], dtype=jnp.int32) >= target, 1, 0)
-            flags[1] = 0
+            # rounds, matching the chunked runner. The crash-model predicate
+            # is evaluated at the last executed round, start - 1.
+            if crashed:
+                flags[0] = done_flag(
+                    conv_live_sum(start_ref[0] - 1), start_ref[0] - 1
+                )
+            else:
+                flags[0] = jnp.where(jnp.sum(c_v[:], dtype=jnp.int32) >= target, jnp.int32(1), jnp.int32(0))
+            flags[1] = jnp.int32(0)
 
         active = (flags[0] == 0) & (start_ref[0] + k < start_ref[1])
 
@@ -440,13 +508,24 @@ def make_pushsum_pool_chunk(
             kk = k % 8
             k1 = keys_ref[kk, 0]
             k2 = keys_ref[kk, 1]
+            rnd = start_ref[0] + k
 
             def p1(t, _):
                 r0 = t * TILE
                 choice = _choice_tile(k1, k2, t, P)
                 padm = (r0 + row_l) * LANES + lane >= N
-                ss = jnp.where(padm, 0.0, s_v[pl.ds(r0, TILE), :] * 0.5)
-                ws = jnp.where(padm, 0.0, w_v[pl.ds(r0, TILE), :] * 0.5)
+                blocked = padm
+                if use_gate:
+                    gbits = threefry_bits_2d(
+                        gkeys_ref[kk, 0], gkeys_ref[kk, 1], TILE, LANES,
+                        row0=r0,
+                    )
+                    blocked = blocked | (gbits < thresh)
+                if crashed:
+                    # Dead nodes never send (ops/faults.py).
+                    blocked = blocked | (death_ref[pl.ds(r0, TILE), :] <= rnd)
+                ss = jnp.where(blocked, 0.0, s_v[pl.ds(r0, TILE), :] * 0.5)
+                ws = jnp.where(blocked, 0.0, w_v[pl.ds(r0, TILE), :] * 0.5)
                 ds_v[pl.ds(r0, TILE), :] = ss
                 ds_v[pl.ds(R + r0, TILE), :] = ss
                 dw_v[pl.ds(r0, TILE), :] = ws
@@ -469,10 +548,13 @@ def make_pushsum_pool_chunk(
                     s1, w1 = gather_modn(dc_v, planes, d, t, slot, jflat)
                     inbox_s = inbox_s + s1
                     inbox_w = inbox_w + w1
+                alive_t = (
+                    death_ref[pl.ds(r0, TILE), :] > rnd if crashed else None
+                )
                 return acc + absorb_pushsum_tile(
                     r0, padm, inbox_s, inbox_w,
                     s_v, w_v, t_v, c_v, ds_v, dw_v, delta, term_rounds,
-                    global_term=global_term,
+                    global_term=global_term, alive=alive_t,
                 )
 
             total = lax.fori_loop(0, T, p2, jnp.int32(0))
@@ -484,9 +566,9 @@ def make_pushsum_pool_chunk(
                 def _latch():
                     latch_conv_global(c_v, N)
 
-                flags[0] = jnp.where(total == 0, 1, 0)
+                flags[0] = jnp.where(total == 0, jnp.int32(1), jnp.int32(0))
             else:
-                flags[0] = jnp.where(total >= target, 1, 0)
+                flags[0] = done_flag(total, rnd)
 
         @pl.when(k == K - 1)
         def _emit():
@@ -495,23 +577,44 @@ def make_pushsum_pool_chunk(
 
     def chunk_fn(state4, keys, offs, start, cap):
         s, w, t, c = state4
-        cap, keys, offs = clamp_cap_and_pad(start, cap, keys, ((offs, 1),))
+        if use_gate:
+            gkeys = gate_round_keys(keys)
+            cap, keys, gkeys, offs = clamp_cap_and_pad(
+                start, cap, keys, ((gkeys, 0), (offs, 1))
+            )
+        else:
+            cap, keys, offs = clamp_cap_and_pad(start, cap, keys, ((offs, 1),))
         K = keys.shape[0]
         f32 = jax.ShapeDtypeStruct((R, LANES), jnp.float32)
         i32 = jax.ShapeDtypeStruct((R, LANES), jnp.int32)
+        smem_keys = pl.BlockSpec(
+            (8, 2), lambda k: (k // 8, 0), memory_space=pltpu.SMEM
+        )
+        in_specs = [
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # start/cap
+            smem_keys,
+        ]
+        operands = [jnp.stack([jnp.int32(start), jnp.int32(cap)]), keys]
+        if use_gate:
+            in_specs.append(smem_keys)
+            operands.append(gkeys)
+        in_specs.append(
+            pl.BlockSpec((8, P), lambda k: (k // 8, 0), memory_space=pltpu.SMEM)
+        )
+        operands.append(offs)
+        if crashed:
+            # The crash plane rides in VMEM (same [R, 128] block every grid
+            # step) — the freeze masks and the quorum reductions read it
+            # directly, no DMA choreography needed.
+            in_specs.append(pl.BlockSpec((R, LANES), lambda k: (0, 0)))
+            operands.append(death2d)
+        in_specs += [pl.BlockSpec(memory_space=pl.ANY)] * 4
+        operands += [s, w, t, c]
         outs = pl.pallas_call(
             kernel,
             grid=(K,),
             out_shape=(f32, f32, i32, i32, jax.ShapeDtypeStruct((1,), jnp.int32)),
-            in_specs=[
-                pl.BlockSpec(memory_space=pltpu.SMEM),  # start/cap
-                pl.BlockSpec((8, 2), lambda k: (k // 8, 0), memory_space=pltpu.SMEM),
-                pl.BlockSpec((8, P), lambda k: (k // 8, 0), memory_space=pltpu.SMEM),
-                pl.BlockSpec(memory_space=pl.ANY),
-                pl.BlockSpec(memory_space=pl.ANY),
-                pl.BlockSpec(memory_space=pl.ANY),
-                pl.BlockSpec(memory_space=pl.ANY),
-            ],
+            in_specs=in_specs,
             out_specs=(
                 pl.BlockSpec(memory_space=pl.ANY),
                 pl.BlockSpec(memory_space=pl.ANY),
@@ -530,16 +633,11 @@ def make_pushsum_pool_chunk(
                 pltpu.SMEM((2,), jnp.int32),
                 pltpu.SemaphoreType.DMA((4,)),
             ],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=compat.pallas_tpu_compiler_params(
                 vmem_limit_bytes=120 * 1024 * 1024
             ),
             interpret=interpret,
-        )(
-            jnp.stack([jnp.int32(start), jnp.int32(cap)]),
-            keys,
-            offs,
-            s, w, t, c,
-        )
+        )(*operands)
         s2, w2, t2, c2, meta = outs
         return (s2, w2, t2, c2), meta[0]
 
@@ -561,22 +659,44 @@ def make_gossip_pool_chunk(
     rumor_target = np.int32(cfg.resolved_rumor_target)
     suppress = cfg.resolved_suppress
     target = np.int32(cfg.resolved_target_count(topo.n, topo.target_count))
+    # Failure model — same wiring as make_pushsum_pool_chunk.
+    use_gate = cfg.fault_rate > 0
+    thresh = np.uint32(gate_threshold(cfg.fault_rate)) if use_gate else None
+    death2d = build_death2d(cfg, topo.n, layout.n_pad)
+    crashed = death2d is not None
+    quorum = cfg.quorum
 
     def kernel(*refs):
-        (start_ref, keys_ref, offs_ref, n0, a0, c0,
-         n_o, a_o, c_o, meta_o,
-         n_v, a_v, c_v, dch_v, flags, sems) = refs
+        it = iter(refs)
+        start_ref, keys_ref = next(it), next(it)
+        gkeys_ref = next(it) if use_gate else None
+        offs_ref = next(it)
+        death_ref = next(it) if crashed else None
+        n0, a0, c0 = next(it), next(it), next(it)
+        n_o, a_o, c_o, meta_o = next(it), next(it), next(it), next(it)
+        n_v, a_v, c_v, dch_v, flags, sems = (
+            next(it), next(it), next(it), next(it), next(it), next(it)
+        )
         k = pl.program_id(0)
         K = pl.num_programs(0)
         _, gather_plain_modn = _make_gather_modn(layout, interpret)
         row_l = _iota2((TILE, LANES), 0)
         lane = _iota2((TILE, LANES), 1)
 
+        done_flag = make_done_flag(death_ref, target, quorum, masked_total=True)
+
         @pl.when(k == 0)
         def _init():
             _copy_in([(n0, n_v), (a0, a_v), (c0, c_v)], sems)
-            flags[0] = jnp.where(jnp.sum(c_v[:], dtype=jnp.int32) >= target, 1, 0)
-            flags[1] = 0
+            if crashed:
+                alive0 = death_ref[:] > start_ref[0] - 1
+                conv_live = jnp.sum(
+                    jnp.where(alive0, c_v[:], jnp.int32(0)), dtype=jnp.int32
+                )
+                flags[0] = done_flag(conv_live, start_ref[0] - 1)
+            else:
+                flags[0] = jnp.where(jnp.sum(c_v[:], dtype=jnp.int32) >= target, jnp.int32(1), jnp.int32(0))
+            flags[1] = jnp.int32(0)
 
         active_chunk = (flags[0] == 0) & (start_ref[0] + k < start_ref[1])
 
@@ -585,6 +705,7 @@ def make_gossip_pool_chunk(
             kk = k % 8
             k1 = keys_ref[kk, 0]
             k2 = keys_ref[kk, 1]
+            rnd = start_ref[0] + k
 
             def p1(t, _):
                 r0 = t * TILE
@@ -592,6 +713,15 @@ def make_gossip_pool_chunk(
                 jflat = (r0 + row_l) * LANES + lane
                 padm = jflat >= N
                 sending = (a_v[pl.ds(r0, TILE), :] != 0) & ~padm
+                if use_gate:
+                    gbits = threefry_bits_2d(
+                        gkeys_ref[kk, 0], gkeys_ref[kk, 1], TILE, LANES,
+                        row0=r0,
+                    )
+                    sending = sending & (gbits >= thresh)
+                if crashed:
+                    # Dead nodes never send (ops/faults.py).
+                    sending = sending & (death_ref[pl.ds(r0, TILE), :] > rnd)
                 # Fold the send gate into the choice plane: slot -1 delivers
                 # nothing, so the inbox gather needs no separate value plane.
                 marked = jnp.where(sending, choice, jnp.int32(-1))
@@ -610,13 +740,17 @@ def make_gossip_pool_chunk(
                     d = offs_ref[kk, slot]
                     g = gather_plain_modn(dch_v, d, t, jflat)
                     inbox = inbox + jnp.where(g == slot, jnp.int32(1), jnp.int32(0))
+                alive_t = (
+                    death_ref[pl.ds(r0, TILE), :] > rnd if crashed else None
+                )
                 return acc + absorb_gossip_tile(
-                    r0, padm, inbox, n_v, a_v, c_v, rumor_target, suppress
+                    r0, padm, inbox, n_v, a_v, c_v, rumor_target, suppress,
+                    alive=alive_t,
                 )
 
             total = lax.fori_loop(0, T, p2, jnp.int32(0))
             flags[1] = flags[1] + 1
-            flags[0] = jnp.where(total >= target, 1, 0)
+            flags[0] = done_flag(total, rnd)
 
         @pl.when(k == K - 1)
         def _emit():
@@ -625,7 +759,13 @@ def make_gossip_pool_chunk(
 
     def chunk_fn(state3, keys, offs, start, cap):
         cnt, act, cv = state3
-        cap, keys, offs = clamp_cap_and_pad(start, cap, keys, ((offs, 1),))
+        if use_gate:
+            gkeys = gate_round_keys(keys)
+            cap, keys, gkeys, offs = clamp_cap_and_pad(
+                start, cap, keys, ((gkeys, 0), (offs, 1))
+            )
+        else:
+            cap, keys, offs = clamp_cap_and_pad(start, cap, keys, ((offs, 1),))
         K = keys.shape[0]
         i32 = jax.ShapeDtypeStruct((R, LANES), jnp.int32)
         scratch = [
@@ -635,18 +775,28 @@ def make_gossip_pool_chunk(
             pltpu.VMEM((2 * R, LANES), jnp.int32),
         ]
         scratch += [pltpu.SMEM((2,), jnp.int32), pltpu.SemaphoreType.DMA((3,))]
+        smem_keys = pl.BlockSpec(
+            (8, 2), lambda k: (k // 8, 0), memory_space=pltpu.SMEM
+        )
+        in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM), smem_keys]
+        operands = [jnp.stack([jnp.int32(start), jnp.int32(cap)]), keys]
+        if use_gate:
+            in_specs.append(smem_keys)
+            operands.append(gkeys)
+        in_specs.append(
+            pl.BlockSpec((8, P), lambda k: (k // 8, 0), memory_space=pltpu.SMEM)
+        )
+        operands.append(offs)
+        if crashed:
+            in_specs.append(pl.BlockSpec((R, LANES), lambda k: (0, 0)))
+            operands.append(death2d)
+        in_specs += [pl.BlockSpec(memory_space=pl.ANY)] * 3
+        operands += [cnt, act, cv]
         outs = pl.pallas_call(
             kernel,
             grid=(K,),
             out_shape=(i32, i32, i32, jax.ShapeDtypeStruct((1,), jnp.int32)),
-            in_specs=[
-                pl.BlockSpec(memory_space=pltpu.SMEM),
-                pl.BlockSpec((8, 2), lambda k: (k // 8, 0), memory_space=pltpu.SMEM),
-                pl.BlockSpec((8, P), lambda k: (k // 8, 0), memory_space=pltpu.SMEM),
-                pl.BlockSpec(memory_space=pl.ANY),
-                pl.BlockSpec(memory_space=pl.ANY),
-                pl.BlockSpec(memory_space=pl.ANY),
-            ],
+            in_specs=in_specs,
             out_specs=(
                 pl.BlockSpec(memory_space=pl.ANY),
                 pl.BlockSpec(memory_space=pl.ANY),
@@ -654,16 +804,11 @@ def make_gossip_pool_chunk(
                 pl.BlockSpec(memory_space=pltpu.SMEM),
             ),
             scratch_shapes=scratch,
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=compat.pallas_tpu_compiler_params(
                 vmem_limit_bytes=120 * 1024 * 1024
             ),
             interpret=interpret,
-        )(
-            jnp.stack([jnp.int32(start), jnp.int32(cap)]),
-            keys,
-            offs,
-            cnt, act, cv,
-        )
+        )(*operands)
         n2, a2, c2, meta = outs
         return (n2, a2, c2), meta[0]
 

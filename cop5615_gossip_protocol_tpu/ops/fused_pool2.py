@@ -72,7 +72,15 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..config import SimConfig
-from .fused import clamp_cap_and_pad, threefry2x32_hash, threefry_bits_2d
+from ..utils import compat
+from . import faults as faults_mod
+from .fused import (
+    build_death2d,
+    clamp_cap_and_pad,
+    gate_round_keys,
+    threefry2x32_hash,
+    threefry_bits_2d,
+)
 from .fused_pool import (
     LANES,
     MAX_POOL_NODES,
@@ -81,7 +89,7 @@ from .fused_pool import (
     _lane_roll,
     build_pool_layout,
 )
-from .sampling import POOL_CHOICE_BITS, POOL_PACK
+from .sampling import POOL_CHOICE_BITS, POOL_PACK, gate_threshold
 from .topology import Topology
 
 # Processing-tile candidates, largest first. All are multiples of
@@ -123,8 +131,11 @@ def pool2_support(topo: Topology, cfg: SimConfig) -> Optional[str]:
             "requires jax_threefry_partitionable=True (the in-kernel "
             "threefry replicates the partitionable stream only)"
         )
-    if cfg.fault_rate > 0:
-        return "fault injection not supported in the fused kernel"
+    if cfg.dup_rate > 0 or cfg.delay_rounds > 0:
+        # Drop (--fault-rate) folds into the regenerated choice windows;
+        # the crash plane streams alongside the state windows. dup/delay
+        # restructure delivery itself and stay chunked-only.
+        return "dup/delay fault models run on the chunked engine only"
     if cfg.n_devices is not None and cfg.n_devices > 1:
         return "fused engine is single-device"
     if cfg.pool_size > 1 << POOL_CHOICE_BITS:
@@ -186,6 +197,19 @@ def _choice_window(k1, k2, ws8, rows: int, R: int, N: int, pool_size: int):
     wrapped = jnp.where(row_i >= R, row_i - R, row_i)
     jf = wrapped * LANES + lane
     return jnp.where(jf >= N, jnp.int32(-1), ch)
+
+
+def _gate_window(g1, g2, ws8, rows: int, R: int, thresh):
+    """[rows, 128] bool send-allowed mask for MIRRORED-plane window rows
+    [ws8, ws8+rows) — the window-positioned regeneration of
+    ops/sampling.send_gate (raw threefry words >= the precomputed
+    threshold; position-wise, so it matches the chunked gate draw word for
+    word). Mirror rows >= R wrap to rows-R like _choice_window."""
+    row_i = ws8 + lax.broadcasted_iota(jnp.int32, (rows, LANES), 0)
+    wrapped = jnp.where(row_i >= R, row_i - R, row_i)
+    lane = lax.broadcasted_iota(jnp.int32, (rows, LANES), 1)
+    i = wrapped.astype(jnp.uint32) * jnp.uint32(LANES) + lane.astype(jnp.uint32)
+    return threefry2x32_hash(g1, g2, i) >= thresh
 
 
 def _copy_wait(src, dst, sem):
@@ -319,6 +343,29 @@ def _counted_window_roll(act_ref, ch_ref, slot, off, pt, rlane, lane,
     )
 
 
+def _quorum_needs(death_sorted, n: int, start, num_rounds: int, quorum):
+    """Per-round quorum targets for one chunk launch, plus the seed target
+    at the last executed round (start − 1). alive(r) = n − #(death_round
+    <= r) via searchsorted over the SORTED death plane — a pure function
+    of (death plane, round), so the kernel reads an SMEM row per round
+    instead of sweeping the streamed plane. Shared by the push-sum and
+    gossip pool2 builders (one derivation, the engines cannot diverge).
+    Returns (needs [num_rounds] int32, need_init scalar int32)."""
+    rounds_arr = jnp.int32(start) + jnp.arange(num_rounds, dtype=jnp.int32)
+    alive_counts = jnp.int32(n) - jnp.searchsorted(
+        death_sorted, rounds_arr, side="right"
+    ).astype(jnp.int32)
+    needs = faults_mod.quorum_need(alive_counts, quorum)
+    need_init = faults_mod.quorum_need(
+        jnp.int32(n)
+        - jnp.searchsorted(
+            death_sorted, jnp.int32(start) - 1, side="right"
+        ).astype(jnp.int32),
+        quorum,
+    )
+    return needs, need_init
+
+
 def make_pushsum_pool2_chunk(
     topo: Topology, cfg: SimConfig, *, interpret: bool = False
 ):
@@ -337,13 +384,50 @@ def make_pushsum_pool2_chunk(
     term_rounds = np.int32(cfg.term_rounds)
     target = np.int32(cfg.resolved_target_count(topo.n, topo.target_count))
     global_term = cfg.termination == "global"
+    # Failure model (ops/faults.py): the drop gate is REGENERATED at window
+    # positions (like the choice windows — the plane never exists in
+    # memory); the crash plane cannot be regenerated (the schedule path is
+    # a permutation), so it streams through the same window/tile volleys as
+    # the state, from a margin-mirrored immutable input plane. Per-round
+    # quorum targets are a pure function of (death plane, round), so they
+    # are precomputed into SMEM rather than swept in-kernel. All
+    # Python-level flags — a fault-free config traces the IDENTICAL kernel.
+    use_gate = cfg.fault_rate > 0
+    thresh = np.uint32(gate_threshold(cfg.fault_rate)) if use_gate else None
+    death2d = build_death2d(cfg, topo.n, layout.n_pad)
+    crashed = death2d is not None
+    quorum = cfg.quorum
+    if crashed:
+        death_mir = jnp.concatenate([death2d, death2d[:M]], axis=0)
+        death_sorted = jnp.sort(
+            jnp.asarray(faults_mod.death_plane(cfg, topo.n))
+        )
+    n_fetch = (2 * P + 3) + ((P + 1) if crashed else 0)
 
-    def kernel(
-        start_ref, keys_ref, offs_ref, s_in, w_in, tc_in,
-        sA, wA, tcA, sB, wB, tcB, meta_o,
-        own_s, own_w, own_tc, out_s, out_w, out_tc, scr_ch, scr_ch2,
-        win_s, win_w, win_s2, win_w2, flags, sems, wr_sems, str_sems,
-    ):
+    def kernel(*refs):
+        it = iter(refs)
+        start_ref, keys_ref = next(it), next(it)
+        gkeys_ref = next(it) if use_gate else None
+        offs_ref = next(it)
+        needs_ref = next(it) if crashed else None
+        death_in = next(it) if crashed else None
+        s_in, w_in, tc_in = next(it), next(it), next(it)
+        sA, wA, tcA, sB, wB, tcB, meta_o = (
+            next(it), next(it), next(it), next(it), next(it), next(it),
+            next(it),
+        )
+        own_s, own_w, own_tc = next(it), next(it), next(it)
+        own_d = next(it) if crashed else None
+        out_s, out_w, out_tc, scr_ch, scr_ch2 = (
+            next(it), next(it), next(it), next(it), next(it)
+        )
+        win_s, win_w = next(it), next(it)
+        win_d = next(it) if crashed else None
+        win_s2, win_w2 = next(it), next(it)
+        win_d2 = next(it) if crashed else None
+        flags, sems, wr_sems, str_sems = (
+            next(it), next(it), next(it), next(it)
+        )
         k = pl.program_id(0)
         K = pl.num_programs(0)
         sem_d = str_sems.at[0]
@@ -355,22 +439,36 @@ def make_pushsum_pool2_chunk(
             total = jnp.int32(0)
             for t in range(T):
                 r0 = t * PT
-                _copy_all([
+                pairs = [
                     (s_in.at[pl.ds(r0, PT), :], own_s.at[0]),
                     (w_in.at[pl.ds(r0, PT), :], own_w.at[0]),
                     (tc_in.at[pl.ds(r0, PT), :], own_tc.at[0]),
-                ], str_sems)
+                ]
+                if crashed:
+                    pairs.append(
+                        (death_in.at[pl.ds(r0, PT), :], own_d.at[0])
+                    )
+                _copy_all(pairs, str_sems)
                 _write_tile_and_mirrors(
                     [(own_s.at[0], sA), (own_w.at[0], wA),
                      (own_tc.at[0], tcA)],
                     t, R, PT, str_sems,
                 )
+                conv0 = ((own_tc[0] & TC_CONV_BIT) != 0)
+                if crashed:
+                    # Quorum numerator at the last executed round start-1:
+                    # conv among live lanes (pads have death round 0).
+                    conv0 = conv0 & (own_d[0] > start_ref[0] - 1)
                 total = total + jnp.sum(
-                    ((own_tc[0] & TC_CONV_BIT) != 0).astype(jnp.int32),
-                    dtype=jnp.int32,
+                    conv0.astype(jnp.int32), dtype=jnp.int32
                 )
-            flags[0] = jnp.where(total >= target, 1, 0)
-            flags[1] = 0
+            if crashed:
+                flags[0] = jnp.where(
+                    total >= start_ref[2], jnp.int32(1), jnp.int32(0)
+                )
+            else:
+                flags[0] = jnp.where(total >= target, jnp.int32(1), jnp.int32(0))
+            flags[1] = jnp.int32(0)
 
         active = (flags[0] == 0) & (start_ref[0] + k < start_ref[1])
 
@@ -380,6 +478,9 @@ def make_pushsum_pool2_chunk(
             kk = k % 8
             k1 = keys_ref[kk, 0]
             k2 = keys_ref[kk, 1]
+            g1 = gkeys_ref[kk, 0] if use_gate else None
+            g2 = gkeys_ref[kk, 1] if use_gate else None
+            rnd = start_ref[0] + k
 
             def win_plans(t):
                 """Per-slot window plans for tile t — a pure function of
@@ -392,6 +493,20 @@ def make_pushsum_pool2_chunk(
                     straddle, ws8, rl, off = _slot_plan(r0, d, Z, R, PT)
                     plans.append((d, straddle, ws8, rl, off))
                 return plans
+
+            def masked_choice(ws8, death_win):
+                """Choice window with the failure model folded in: gate-
+                blocked and dead sources become choice -1 (deliver
+                nothing), replacing send-plane masking."""
+                ch = _choice_window(k1, k2, ws8, M, R, N, P)
+                if use_gate:
+                    ch = jnp.where(
+                        _gate_window(g1, g2, ws8, M, R, thresh), ch,
+                        jnp.int32(-1),
+                    )
+                if crashed:
+                    ch = jnp.where(death_win > rnd, ch, jnp.int32(-1))
+                return ch
 
             def fetch_volley(t, b):
                 """Copy descriptors for tile t's slot windows AND its own
@@ -409,10 +524,18 @@ def make_pushsum_pool2_chunk(
                     pairs.append(
                         (w_c.at[pl.ds(ws8, M), :], win_w.at[b, slot])
                     )
+                    if crashed:
+                        pairs.append(
+                            (death_in.at[pl.ds(ws8, M), :], win_d.at[b, slot])
+                        )
                 pairs.append((s_c.at[pl.ds(r0, PT), :], own_s.at[b]))
                 pairs.append((w_c.at[pl.ds(r0, PT), :], own_w.at[b]))
                 pairs.append((tc_c.at[pl.ds(r0, PT), :], own_tc.at[b]))
-                base = b * (2 * P + 3)
+                if crashed:
+                    pairs.append(
+                        (death_in.at[pl.ds(r0, PT), :], own_d.at[b])
+                    )
+                base = b * n_fetch
                 return plans, [
                     pltpu.make_async_copy(src, dst, sems.at[base + i])
                     for i, (src, dst) in enumerate(pairs)
@@ -492,7 +615,9 @@ def make_pushsum_pool2_chunk(
                 raw_w = jnp.zeros((PT, LANES), jnp.float32)
                 for slot in range(P):
                     d, straddle, ws8, rl, off = plans[slot]
-                    scr_ch[:] = _choice_window(k1, k2, ws8, M, R, N, P)
+                    scr_ch[:] = masked_choice(
+                        ws8, win_d[b, slot] if crashed else None
+                    )
                     cs = _masked_window_roll(
                         win_s.at[b, slot], scr_ch, slot, off, PT, rl,
                         lane, interpret, 0.0,
@@ -514,12 +639,17 @@ def make_pushsum_pool2_chunk(
                             # The hash regen rides the predicate too:
                             # stale scr_ch2 is masked by use2 exactly like
                             # the stale window buffers.
-                            _copy_all([
+                            wrap_pairs = [
                                 (s_c.at[pl.ds(ws8_2, M), :], win_s2),
                                 (w_c.at[pl.ds(ws8_2, M), :], win_w2),
-                            ], str_sems)
-                            scr_ch2[:] = _choice_window(
-                                k1, k2, ws8_2, M, R, N, P
+                            ]
+                            if crashed:
+                                wrap_pairs.append(
+                                    (death_in.at[pl.ds(ws8_2, M), :], win_d2)
+                                )
+                            _copy_all(wrap_pairs, str_sems)
+                            scr_ch2[:] = masked_choice(
+                                ws8_2, win_d2[:] if crashed else None
                             )
                         use2 = straddle & (jflat < d)
                         cs = jnp.where(
@@ -545,8 +675,18 @@ def make_pushsum_pool2_chunk(
                 inbox_w = jnp.where(padm, 0.0, raw_w * half)
                 s_t = own_s[b]
                 w_t = own_w[b]
-                s_send = jnp.where(padm, 0.0, s_t * half)
-                w_send = jnp.where(padm, 0.0, w_t * half)
+                blocked = padm
+                if use_gate:
+                    own_gate = threefry_bits_2d(
+                        g1, g2, PT, LANES, row0=r0
+                    ) >= thresh
+                    blocked = blocked | ~own_gate
+                if crashed:
+                    # Dead nodes never send: they keep full mass and still
+                    # absorb — delivered mass parks on them (ops/faults.py).
+                    blocked = blocked | (own_d[b] <= rnd)
+                s_send = jnp.where(blocked, 0.0, s_t * half)
+                w_send = jnp.where(blocked, 0.0, w_t * half)
                 s_new = (s_t - s_send) + inbox_s
                 w_new = (w_t - w_send) + inbox_w
                 if global_term:
@@ -574,12 +714,24 @@ def make_pushsum_pool2_chunk(
                     conv_new = (
                         conv_old | (term_new >= term_rounds)
                     ) & ~padm
-                    tc_new = jnp.where(
+                    tc_cand = jnp.where(
                         conv_new, term_new | TC_CONV_BIT, term_new
                     )
-                    tile_metric = jnp.sum(
-                        conv_new.astype(jnp.int32), dtype=jnp.int32
-                    )
+                    if crashed:
+                        # Crash-stop freeze: dead lanes keep their packed
+                        # term/conv; the metric is the quorum numerator
+                        # (conv among LIVE lanes).
+                        alive_own = own_d[b] > rnd
+                        tc_new = jnp.where(alive_own, tc_cand, own_tc[b])
+                        tile_metric = jnp.sum(
+                            (conv_new & alive_own).astype(jnp.int32),
+                            dtype=jnp.int32,
+                        )
+                    else:
+                        tc_new = tc_cand
+                        tile_metric = jnp.sum(
+                            conv_new.astype(jnp.int32), dtype=jnp.int32
+                        )
                 # out[b] is still the in-flight source of tile t-2's write
                 # volley — drain it before overwriting. By now those
                 # writes have had a full fetch-wait + compute to complete,
@@ -650,9 +802,18 @@ def make_pushsum_pool2_chunk(
 
                     lax.fori_loop(0, T, lt, 0, unroll=False)
 
-                flags[0] = jnp.where(total == 0, 1, 0)
+                flags[0] = jnp.where(total == 0, jnp.int32(1), jnp.int32(0))
+            elif crashed:
+                # total is the conv-among-live sum; needs_ref holds the
+                # precomputed per-round quorum targets (faults.quorum_need
+                # over the alive count — a pure function of the death
+                # plane and the round, so it never needs an in-kernel
+                # population sweep).
+                flags[0] = jnp.where(
+                    total >= needs_ref[kk], jnp.int32(1), jnp.int32(0)
+                )
             else:
-                flags[0] = jnp.where(total >= target, 1, 0)
+                flags[0] = jnp.where(total >= target, jnp.int32(1), jnp.int32(0))
 
         A = (sA, wA, tcA)
         B = (sB, wB, tcB)
@@ -677,10 +838,81 @@ def make_pushsum_pool2_chunk(
     def chunk_fn(state4, keys, offs, start, cap):
         s, w, t, c = state4
         tc = jnp.where(c != 0, t | TC_CONV_BIT, t)
-        cap, keys, offs = clamp_cap_and_pad(start, cap, keys, ((offs, 1),))
+        extras = []
+        if use_gate:
+            gkeys = gate_round_keys(keys)
+            extras.append((gkeys, 0))
+        extras.append((offs, 1))
+        if crashed:
+            needs, need_init = _quorum_needs(
+                death_sorted, topo.n, start, keys.shape[0], quorum
+            )
+            extras.append((needs, 0))
+        padded = clamp_cap_and_pad(start, cap, keys, tuple(extras))
+        cap, keys = padded[0], padded[1]
+        rest = list(padded[2:])
+        if use_gate:
+            gkeys = rest.pop(0)
+        offs = rest.pop(0)
+        if crashed:
+            needs = rest.pop(0)
         K = keys.shape[0]
         f32m = jax.ShapeDtypeStruct((R + M, LANES), jnp.float32)
         i32m = jax.ShapeDtypeStruct((R + M, LANES), jnp.int32)
+        smem_keys = pl.BlockSpec(
+            (8, 2), lambda k: (k // 8, 0), memory_space=pltpu.SMEM
+        )
+        scal = [jnp.int32(start), jnp.int32(cap)]
+        if crashed:
+            scal.append(need_init)
+        in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM), smem_keys]
+        operands = [jnp.stack(scal), keys]
+        if use_gate:
+            in_specs.append(smem_keys)
+            operands.append(gkeys)
+        in_specs.append(
+            pl.BlockSpec((8, P), lambda k: (k // 8, 0), memory_space=pltpu.SMEM)
+        )
+        operands.append(offs)
+        if crashed:
+            in_specs.append(
+                pl.BlockSpec((8,), lambda k: (k // 8,), memory_space=pltpu.SMEM)
+            )
+            operands.append(needs)
+            in_specs.append(pl.BlockSpec(memory_space=pl.ANY))
+            operands.append(death_mir)
+        in_specs += [pl.BlockSpec(memory_space=pl.ANY)] * 3
+        operands += [s, w, tc]
+        scratch = [
+            pltpu.VMEM((2, PT, LANES), jnp.float32),
+            pltpu.VMEM((2, PT, LANES), jnp.float32),
+            pltpu.VMEM((2, PT, LANES), jnp.int32),
+        ]
+        if crashed:
+            scratch.append(pltpu.VMEM((2, PT, LANES), jnp.int32))  # own_d
+        scratch += [
+            pltpu.VMEM((2, PT, LANES), jnp.float32),
+            pltpu.VMEM((2, PT, LANES), jnp.float32),
+            pltpu.VMEM((2, PT, LANES), jnp.int32),
+            pltpu.VMEM((M, LANES), jnp.int32),
+            pltpu.VMEM((M, LANES), jnp.int32),
+            pltpu.VMEM((2, P, M, LANES), jnp.float32),
+            pltpu.VMEM((2, P, M, LANES), jnp.float32),
+        ]
+        if crashed:
+            scratch.append(pltpu.VMEM((2, P, M, LANES), jnp.int32))  # win_d
+        scratch += [
+            pltpu.VMEM((M, LANES), jnp.float32),
+            pltpu.VMEM((M, LANES), jnp.float32),
+        ]
+        if crashed:
+            scratch.append(pltpu.VMEM((M, LANES), jnp.int32))  # win_d2
+        scratch += [
+            pltpu.SMEM((2,), jnp.int32),
+            pltpu.SemaphoreType.DMA((2 * n_fetch,)),
+            pltpu.SemaphoreType.DMA((12,)),
+            pltpu.SemaphoreType.DMA(((4 if crashed else 3),)),
+        ]
         outs = pl.pallas_call(
             kernel,
             grid=(K,),
@@ -689,46 +921,17 @@ def make_pushsum_pool2_chunk(
                 f32m, f32m, i32m,  # parity B
                 jax.ShapeDtypeStruct((2,), jnp.int32),
             ),
-            in_specs=[
-                pl.BlockSpec(memory_space=pltpu.SMEM),
-                pl.BlockSpec((8, 2), lambda k: (k // 8, 0), memory_space=pltpu.SMEM),
-                pl.BlockSpec((8, P), lambda k: (k // 8, 0), memory_space=pltpu.SMEM),
-                pl.BlockSpec(memory_space=pl.ANY),
-                pl.BlockSpec(memory_space=pl.ANY),
-                pl.BlockSpec(memory_space=pl.ANY),
-            ],
+            in_specs=in_specs,
             out_specs=tuple(
                 [pl.BlockSpec(memory_space=pl.ANY)] * 6
                 + [pl.BlockSpec(memory_space=pltpu.SMEM)]
             ),
-            scratch_shapes=[
-                pltpu.VMEM((2, PT, LANES), jnp.float32),
-                pltpu.VMEM((2, PT, LANES), jnp.float32),
-                pltpu.VMEM((2, PT, LANES), jnp.int32),
-                pltpu.VMEM((2, PT, LANES), jnp.float32),
-                pltpu.VMEM((2, PT, LANES), jnp.float32),
-                pltpu.VMEM((2, PT, LANES), jnp.int32),
-                pltpu.VMEM((M, LANES), jnp.int32),
-                pltpu.VMEM((M, LANES), jnp.int32),
-                pltpu.VMEM((2, P, M, LANES), jnp.float32),
-                pltpu.VMEM((2, P, M, LANES), jnp.float32),
-                pltpu.VMEM((M, LANES), jnp.float32),
-                pltpu.VMEM((M, LANES), jnp.float32),
-                pltpu.SMEM((2,), jnp.int32),
-                pltpu.SemaphoreType.DMA((2 * (2 * P + 3),)),
-                pltpu.SemaphoreType.DMA((12,)),
-                pltpu.SemaphoreType.DMA((3,)),
-            ],
-            compiler_params=pltpu.CompilerParams(
+            scratch_shapes=scratch,
+            compiler_params=compat.pallas_tpu_compiler_params(
                 vmem_limit_bytes=96 * 1024 * 1024
             ),
             interpret=interpret,
-        )(
-            jnp.stack([jnp.int32(start), jnp.int32(cap)]),
-            keys,
-            offs,
-            s, w, tc,
-        )
+        )(*operands)
         meta = outs[6]
         parity = meta[1]
 
@@ -766,13 +969,42 @@ def make_gossip_pool2_chunk(
     rumor_target = np.int32(cfg.resolved_rumor_target)
     suppress = cfg.resolved_suppress
     target = np.int32(cfg.resolved_target_count(topo.n, topo.target_count))
+    # Failure model — same wiring as make_pushsum_pool2_chunk.
+    use_gate = cfg.fault_rate > 0
+    thresh = np.uint32(gate_threshold(cfg.fault_rate)) if use_gate else None
+    death2d = build_death2d(cfg, topo.n, layout.n_pad)
+    crashed = death2d is not None
+    quorum = cfg.quorum
+    if crashed:
+        death_mir = jnp.concatenate([death2d, death2d[:M]], axis=0)
+        death_sorted = jnp.sort(
+            jnp.asarray(faults_mod.death_plane(cfg, topo.n))
+        )
+    n_fetch = (P + 2) + ((P + 1) if crashed else 0)
 
-    def kernel(
-        start_ref, keys_ref, offs_ref, n_in, a_in,
-        nA, aA, nB, aB, meta_o,
-        own_n, own_a, out_n, out_a, scr_ch, scr_ch2,
-        win_a, win_a2, flags, sems, wr_sems, str_sems,
-    ):
+    def kernel(*refs):
+        it = iter(refs)
+        start_ref, keys_ref = next(it), next(it)
+        gkeys_ref = next(it) if use_gate else None
+        offs_ref = next(it)
+        needs_ref = next(it) if crashed else None
+        death_in = next(it) if crashed else None
+        n_in, a_in = next(it), next(it)
+        nA, aA, nB, aB, meta_o = (
+            next(it), next(it), next(it), next(it), next(it)
+        )
+        own_n, own_a = next(it), next(it)
+        own_d = next(it) if crashed else None
+        out_n, out_a, scr_ch, scr_ch2 = (
+            next(it), next(it), next(it), next(it)
+        )
+        win_a = next(it)
+        win_d = next(it) if crashed else None
+        win_a2 = next(it)
+        win_d2 = next(it) if crashed else None
+        flags, sems, wr_sems, str_sems = (
+            next(it), next(it), next(it), next(it)
+        )
         k = pl.program_id(0)
         K = pl.num_programs(0)
         sem_d = str_sems.at[0]
@@ -784,20 +1016,32 @@ def make_gossip_pool2_chunk(
             total = jnp.int32(0)
             for t in range(T):
                 r0 = t * PT
-                _copy_all([
+                pairs = [
                     (n_in.at[pl.ds(r0, PT), :], own_n.at[0]),
                     (a_in.at[pl.ds(r0, PT), :], own_a.at[0]),
-                ], str_sems)
+                ]
+                if crashed:
+                    pairs.append(
+                        (death_in.at[pl.ds(r0, PT), :], own_d.at[0])
+                    )
+                _copy_all(pairs, str_sems)
                 _write_tile_and_mirrors(
                     [(own_n.at[0], nA), (own_a.at[0], aA)], t, R, PT,
                     str_sems,
                 )
+                conv0 = own_n[0] >= rumor_target
+                if crashed:
+                    conv0 = conv0 & (own_d[0] > start_ref[0] - 1)
                 total = total + jnp.sum(
-                    (own_n[0] >= rumor_target).astype(jnp.int32),
-                    dtype=jnp.int32,
+                    conv0.astype(jnp.int32), dtype=jnp.int32
                 )
-            flags[0] = jnp.where(total >= target, 1, 0)
-            flags[1] = 0
+            if crashed:
+                flags[0] = jnp.where(
+                    total >= start_ref[2], jnp.int32(1), jnp.int32(0)
+                )
+            else:
+                flags[0] = jnp.where(total >= target, jnp.int32(1), jnp.int32(0))
+            flags[1] = jnp.int32(0)
 
         active = (flags[0] == 0) & (start_ref[0] + k < start_ref[1])
 
@@ -807,6 +1051,9 @@ def make_gossip_pool2_chunk(
             kk = k % 8
             k1 = keys_ref[kk, 0]
             k2 = keys_ref[kk, 1]
+            g1 = gkeys_ref[kk, 0] if use_gate else None
+            g2 = gkeys_ref[kk, 1] if use_gate else None
+            rnd = start_ref[0] + k
 
             def win_plans(t):
                 r0 = t * PT
@@ -816,6 +1063,19 @@ def make_gossip_pool2_chunk(
                     straddle, ws8, rl, off = _slot_plan(r0, d, Z, R, PT)
                     plans.append((d, straddle, ws8, rl, off))
                 return plans
+
+            def masked_choice(ws8, death_win):
+                """Gate-blocked / dead sources -> choice -1 (send nothing);
+                see make_pushsum_pool2_chunk.masked_choice."""
+                ch = _choice_window(k1, k2, ws8, M, R, N, P)
+                if use_gate:
+                    ch = jnp.where(
+                        _gate_window(g1, g2, ws8, M, R, thresh), ch,
+                        jnp.int32(-1),
+                    )
+                if crashed:
+                    ch = jnp.where(death_win > rnd, ch, jnp.int32(-1))
+                return ch
 
             def fetch_volley(t, b):
                 """Windows + own tiles into buffer set b — the push-sum
@@ -827,9 +1087,17 @@ def make_gossip_pool2_chunk(
                     pairs.append(
                         (a_c.at[pl.ds(ws8, M), :], win_a.at[b, slot])
                     )
+                    if crashed:
+                        pairs.append(
+                            (death_in.at[pl.ds(ws8, M), :], win_d.at[b, slot])
+                        )
                 pairs.append((n_c.at[pl.ds(r0, PT), :], own_n.at[b]))
                 pairs.append((a_c.at[pl.ds(r0, PT), :], own_a.at[b]))
-                base = b * (P + 2)
+                if crashed:
+                    pairs.append(
+                        (death_in.at[pl.ds(r0, PT), :], own_d.at[b])
+                    )
+                base = b * n_fetch
                 return plans, [
                     pltpu.make_async_copy(src, dst, sems.at[base + i])
                     for i, (src, dst) in enumerate(pairs)
@@ -892,7 +1160,9 @@ def make_gossip_pool2_chunk(
                 inbox = jnp.zeros((PT, LANES), jnp.int32)
                 for slot in range(P):
                     d, straddle, ws8, rl, off = plans[slot]
-                    scr_ch[:] = _choice_window(k1, k2, ws8, M, R, N, P)
+                    scr_ch[:] = masked_choice(
+                        ws8, win_d[b, slot] if crashed else None
+                    )
                     g = _counted_window_roll(
                         win_a.at[b, slot], scr_ch, slot, off, PT, rl,
                         lane, interpret,
@@ -904,11 +1174,16 @@ def make_gossip_pool2_chunk(
 
                         @pl.when(straddle)
                         def _fetch_wrap():
-                            _copy_wait(
-                                a_c.at[pl.ds(ws8_2, M), :], win_a2, sem_d
-                            )
-                            scr_ch2[:] = _choice_window(
-                                k1, k2, ws8_2, M, R, N, P
+                            wrap_pairs = [
+                                (a_c.at[pl.ds(ws8_2, M), :], win_a2),
+                            ]
+                            if crashed:
+                                wrap_pairs.append(
+                                    (death_in.at[pl.ds(ws8_2, M), :], win_d2)
+                                )
+                            _copy_all(wrap_pairs, str_sems)
+                            scr_ch2[:] = masked_choice(
+                                ws8_2, win_d2[:] if crashed else None
                             )
                         use2 = straddle & (jflat < d)
                         g = jnp.where(
@@ -927,11 +1202,20 @@ def make_gossip_pool2_chunk(
                     inbox = jnp.where(
                         own_n[b] >= rumor_target, jnp.int32(0), inbox
                     )
+                if crashed:
+                    # Dead nodes don't absorb: a zeroed inbox freezes
+                    # count/active, and conv (count >= threshold on a
+                    # monotone count) stays latched — the chunked
+                    # _freeze_dead, element-wise.
+                    alive_own = own_d[b] > rnd
+                    inbox = jnp.where(alive_own, inbox, jnp.int32(0))
                 count_new = own_n[b] + inbox
                 active_new = jnp.where(
                     (own_a[b] != 0) | (inbox > 0), jnp.int32(1), jnp.int32(0)
                 )
                 conv_new = (count_new >= rumor_target) & ~padm
+                if crashed:
+                    conv_new = conv_new & alive_own  # quorum numerator
 
                 @pl.when(t >= 2)
                 def _drain_prev():
@@ -971,7 +1255,12 @@ def make_gossip_pool2_chunk(
             wait_writes(T - 2, 0)
             wait_writes(T - 1, 1)
             flags[1] = flags[1] + 1
-            flags[0] = jnp.where(total >= target, 1, 0)
+            if crashed:
+                flags[0] = jnp.where(
+                    total >= needs_ref[kk], jnp.int32(1), jnp.int32(0)
+                )
+            else:
+                flags[0] = jnp.where(total >= target, jnp.int32(1), jnp.int32(0))
 
         A = (nA, aA)
         B = (nB, aB)
@@ -992,8 +1281,73 @@ def make_gossip_pool2_chunk(
 
     def chunk_fn(state3, keys, offs, start, cap):
         cnt, act, _cv = state3
-        cap, keys, offs = clamp_cap_and_pad(start, cap, keys, ((offs, 1),))
+        extras = []
+        if use_gate:
+            gkeys = gate_round_keys(keys)
+            extras.append((gkeys, 0))
+        extras.append((offs, 1))
+        if crashed:
+            needs, need_init = _quorum_needs(
+                death_sorted, topo.n, start, keys.shape[0], quorum
+            )
+            extras.append((needs, 0))
+        padded = clamp_cap_and_pad(start, cap, keys, tuple(extras))
+        cap, keys = padded[0], padded[1]
+        rest = list(padded[2:])
+        if use_gate:
+            gkeys = rest.pop(0)
+        offs = rest.pop(0)
+        if crashed:
+            needs = rest.pop(0)
         i32m = jax.ShapeDtypeStruct((R + M, LANES), jnp.int32)
+        smem_keys = pl.BlockSpec(
+            (8, 2), lambda k: (k // 8, 0), memory_space=pltpu.SMEM
+        )
+        scal = [jnp.int32(start), jnp.int32(cap)]
+        if crashed:
+            scal.append(need_init)
+        in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM), smem_keys]
+        operands = [jnp.stack(scal), keys]
+        if use_gate:
+            in_specs.append(smem_keys)
+            operands.append(gkeys)
+        in_specs.append(
+            pl.BlockSpec((8, P), lambda k: (k // 8, 0), memory_space=pltpu.SMEM)
+        )
+        operands.append(offs)
+        if crashed:
+            in_specs.append(
+                pl.BlockSpec((8,), lambda k: (k // 8,), memory_space=pltpu.SMEM)
+            )
+            operands.append(needs)
+            in_specs.append(pl.BlockSpec(memory_space=pl.ANY))
+            operands.append(death_mir)
+        in_specs += [pl.BlockSpec(memory_space=pl.ANY)] * 2
+        operands += [cnt, act]
+        scratch = [
+            pltpu.VMEM((2, PT, LANES), jnp.int32),
+            pltpu.VMEM((2, PT, LANES), jnp.int32),
+        ]
+        if crashed:
+            scratch.append(pltpu.VMEM((2, PT, LANES), jnp.int32))  # own_d
+        scratch += [
+            pltpu.VMEM((2, PT, LANES), jnp.int32),
+            pltpu.VMEM((2, PT, LANES), jnp.int32),
+            pltpu.VMEM((M, LANES), jnp.int32),
+            pltpu.VMEM((M, LANES), jnp.int32),
+            pltpu.VMEM((2, P, M, LANES), jnp.int32),
+        ]
+        if crashed:
+            scratch.append(pltpu.VMEM((2, P, M, LANES), jnp.int32))  # win_d
+        scratch.append(pltpu.VMEM((M, LANES), jnp.int32))
+        if crashed:
+            scratch.append(pltpu.VMEM((M, LANES), jnp.int32))  # win_d2
+        scratch += [
+            pltpu.SMEM((2,), jnp.int32),
+            pltpu.SemaphoreType.DMA((2 * n_fetch,)),
+            pltpu.SemaphoreType.DMA((8,)),
+            pltpu.SemaphoreType.DMA(((3 if crashed else 2),)),
+        ]
         outs = pl.pallas_call(
             kernel,
             grid=(keys.shape[0],),
@@ -1001,41 +1355,17 @@ def make_gossip_pool2_chunk(
                 i32m, i32m, i32m, i32m,
                 jax.ShapeDtypeStruct((2,), jnp.int32),
             ),
-            in_specs=[
-                pl.BlockSpec(memory_space=pltpu.SMEM),
-                pl.BlockSpec((8, 2), lambda k: (k // 8, 0), memory_space=pltpu.SMEM),
-                pl.BlockSpec((8, P), lambda k: (k // 8, 0), memory_space=pltpu.SMEM),
-                pl.BlockSpec(memory_space=pl.ANY),
-                pl.BlockSpec(memory_space=pl.ANY),
-            ],
+            in_specs=in_specs,
             out_specs=tuple(
                 [pl.BlockSpec(memory_space=pl.ANY)] * 4
                 + [pl.BlockSpec(memory_space=pltpu.SMEM)]
             ),
-            scratch_shapes=[
-                pltpu.VMEM((2, PT, LANES), jnp.int32),
-                pltpu.VMEM((2, PT, LANES), jnp.int32),
-                pltpu.VMEM((2, PT, LANES), jnp.int32),
-                pltpu.VMEM((2, PT, LANES), jnp.int32),
-                pltpu.VMEM((M, LANES), jnp.int32),
-                pltpu.VMEM((M, LANES), jnp.int32),
-                pltpu.VMEM((2, P, M, LANES), jnp.int32),
-                pltpu.VMEM((M, LANES), jnp.int32),
-                pltpu.SMEM((2,), jnp.int32),
-                pltpu.SemaphoreType.DMA((2 * (P + 2),)),
-                pltpu.SemaphoreType.DMA((8,)),
-                pltpu.SemaphoreType.DMA((2,)),
-            ],
-            compiler_params=pltpu.CompilerParams(
+            scratch_shapes=scratch,
+            compiler_params=compat.pallas_tpu_compiler_params(
                 vmem_limit_bytes=96 * 1024 * 1024
             ),
             interpret=interpret,
-        )(
-            jnp.stack([jnp.int32(start), jnp.int32(cap)]),
-            keys,
-            offs,
-            cnt, act,
-        )
+        )(*operands)
         meta = outs[4]
         parity = meta[1]
 

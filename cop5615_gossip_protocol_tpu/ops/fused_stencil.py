@@ -45,6 +45,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..config import SimConfig
+from ..utils import compat
 from .fused import threefry_bits_2d
 from .fused_pool import (
     LANES,
@@ -92,8 +93,13 @@ def stencil2_support(topo: Topology, cfg: SimConfig) -> Optional[str]:
             "requires jax_threefry_partitionable=True (the in-kernel "
             "threefry replicates the partitionable stream only)"
         )
-    if cfg.fault_rate > 0:
-        return "fault injection not supported in the fused kernel"
+    if cfg.faulted:
+        # No failure-model support in this engine yet — rejecting on
+        # the aggregate flag (not just fault_rate) keeps a crash/dup/
+        # delay config from silently running unfaulted here. The
+        # stencil (ops/fused.py) and pool tiers (ops/fused_pool.py,
+        # ops/fused_pool2.py) run drop+crash in-kernel.
+        return "failure models not supported in this fused kernel"
     if cfg.n_devices is not None and cfg.n_devices > 1:
         return "fused engine is single-device"
     layout = build_pool_layout(topo.n)
@@ -177,9 +183,9 @@ def make_pushsum_stencil2_chunk(
                 sems,
             )
             flags[0] = jnp.where(
-                jnp.sum(c_v[:], dtype=jnp.int32) >= target, 1, 0
+                jnp.sum(c_v[:], dtype=jnp.int32) >= target, jnp.int32(1), jnp.int32(0)
             )
-            flags[1] = 0
+            flags[1] = jnp.int32(0)
 
         active = (flags[0] == 0) & (start_ref[0] + k < start_ref[1])
 
@@ -237,9 +243,9 @@ def make_pushsum_stencil2_chunk(
                 def _latch():
                     latch_conv_global(c_v, N)
 
-                flags[0] = jnp.where(total == 0, 1, 0)
+                flags[0] = jnp.where(total == 0, jnp.int32(1), jnp.int32(0))
             else:
-                flags[0] = jnp.where(total >= target, 1, 0)
+                flags[0] = jnp.where(total >= target, jnp.int32(1), jnp.int32(0))
 
         @pl.when(k == K - 1)
         def _emit():
@@ -293,7 +299,7 @@ def make_pushsum_stencil2_chunk(
                 pltpu.SMEM((2,), jnp.int32),
                 pltpu.SemaphoreType.DMA((6,)),
             ],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=compat.pallas_tpu_compiler_params(
                 vmem_limit_bytes=124 * 1024 * 1024
             ),
             interpret=interpret,
@@ -345,9 +351,9 @@ def make_gossip_stencil2_chunk(
                 sems,
             )
             flags[0] = jnp.where(
-                jnp.sum(c_v[:], dtype=jnp.int32) >= target, 1, 0
+                jnp.sum(c_v[:], dtype=jnp.int32) >= target, jnp.int32(1), jnp.int32(0)
             )
-            flags[1] = 0
+            flags[1] = jnp.int32(0)
 
         active_chunk = (flags[0] == 0) & (start_ref[0] + k < start_ref[1])
 
@@ -390,7 +396,7 @@ def make_gossip_stencil2_chunk(
 
             total = lax.fori_loop(0, T, p2, jnp.int32(0))
             flags[1] = flags[1] + 1
-            flags[0] = jnp.where(total >= target, 1, 0)
+            flags[0] = jnp.where(total >= target, jnp.int32(1), jnp.int32(0))
 
         @pl.when(k == K - 1)
         def _emit():
@@ -438,7 +444,7 @@ def make_gossip_stencil2_chunk(
                 pl.BlockSpec(memory_space=pltpu.SMEM),
             ),
             scratch_shapes=scratch,
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=compat.pallas_tpu_compiler_params(
                 vmem_limit_bytes=124 * 1024 * 1024
             ),
             interpret=interpret,

@@ -76,6 +76,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..config import SimConfig
+from ..utils import compat
 from .fused import clamp_cap_and_pad, threefry2x32_hash
 from .fused_pool import LANES, _lane_roll, build_pool_layout
 from .fused_pool2 import _PT_CANDIDATES, _copy_all, _copy_wait
@@ -103,8 +104,13 @@ def stencil_hbm_support(topo: Topology, cfg: SimConfig) -> Optional[str]:
             "requires jax_threefry_partitionable=True (the in-kernel "
             "threefry replicates the partitionable stream only)"
         )
-    if cfg.fault_rate > 0:
-        return "fault injection not supported in the fused kernel"
+    if cfg.faulted:
+        # No failure-model support in this engine yet — rejecting on
+        # the aggregate flag (not just fault_rate) keeps a crash/dup/
+        # delay config from silently running unfaulted here. The
+        # stencil (ops/fused.py) and pool tiers (ops/fused_pool.py,
+        # ops/fused_pool2.py) run drop+crash in-kernel.
+        return "failure models not supported in this fused kernel"
     if cfg.n_devices is not None and cfg.n_devices > 1:
         return "fused engine is single-device"
     if topo.n > MAX_STENCIL_HBM_NODES:
@@ -567,8 +573,8 @@ def make_pushsum_stencil_hbm_chunk(
                          wA.at[pl.ds(R + t * PT, rows_i), :]),
                     ], str_sems)
                 total = total + jnp.sum(own_c[0], dtype=jnp.int32)
-            flags[0] = jnp.where(total >= target, 1, 0)
-            flags[1] = 0
+            flags[0] = jnp.where(total >= target, jnp.int32(1), jnp.int32(0))
+            flags[1] = jnp.int32(0)
 
         active = (flags[0] == 0) & (start_ref[1] + k < start_ref[2])
 
@@ -778,9 +784,9 @@ def make_pushsum_stencil_hbm_chunk(
 
                     lax.fori_loop(0, T, lt, 0, unroll=False)
 
-                flags[0] = jnp.where(total == 0, 1, 0)
+                flags[0] = jnp.where(total == 0, jnp.int32(1), jnp.int32(0))
             else:
-                flags[0] = jnp.where(total >= target, 1, 0)
+                flags[0] = jnp.where(total >= target, jnp.int32(1), jnp.int32(0))
 
         A = (sA, wA, tA, cA)
         B = (sB, wB, tB, cB)
@@ -845,7 +851,7 @@ def make_pushsum_stencil_hbm_chunk(
                 + [pl.BlockSpec(memory_space=pltpu.SMEM)]
             ),
             scratch_shapes=scratch,
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=compat.pallas_tpu_compiler_params(
                 vmem_limit_bytes=100 * 1024 * 1024
             ),
             interpret=interpret,
@@ -947,8 +953,8 @@ def make_gossip_stencil_hbm_chunk(
                          aA.at[pl.ds(R + t * PT, rows_i), :]),
                     ], str_sems)
                 total = total + jnp.sum(own_c[0], dtype=jnp.int32)
-            flags[0] = jnp.where(total >= target, 1, 0)
-            flags[1] = 0
+            flags[0] = jnp.where(total >= target, jnp.int32(1), jnp.int32(0))
+            flags[1] = jnp.int32(0)
 
         active = (flags[0] == 0) & (start_ref[1] + k < start_ref[2])
 
@@ -1103,7 +1109,7 @@ def make_gossip_stencil_hbm_chunk(
             wait_writes(T - 2, 0)
             wait_writes(T - 1, 1)
             flags[1] = flags[1] + 1
-            flags[0] = jnp.where(total >= target, 1, 0)
+            flags[0] = jnp.where(total >= target, jnp.int32(1), jnp.int32(0))
 
         A = (nA, aA, cA)
         B = (nB, aB, cB)
@@ -1162,7 +1168,7 @@ def make_gossip_stencil_hbm_chunk(
                 + [pl.BlockSpec(memory_space=pltpu.SMEM)]
             ),
             scratch_shapes=scratch,
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=compat.pallas_tpu_compiler_params(
                 vmem_limit_bytes=100 * 1024 * 1024
             ),
             interpret=interpret,

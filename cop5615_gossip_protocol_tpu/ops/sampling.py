@@ -29,7 +29,10 @@ import jax.numpy as jnp
 # rejects mismatches. History:
 #   1 — per-node u32 draw for pool choices (rounds 1-2)
 #   2 — packed 4-bit pool choices, one word per 8 nodes (pool_choice_packed)
-STREAM_VERSION = 2
+#   3 — threshold-compare fault gates (send_gate/dup_gate draw raw uint32
+#       words against a precomputed threshold instead of uniform floats, so
+#       the fused kernels regenerate the identical gate in-kernel)
+STREAM_VERSION = 3
 
 
 def round_key(base_key: jax.Array, round_idx: jax.Array | int) -> jax.Array:
@@ -258,10 +261,39 @@ def targets_pool(choice: jax.Array, offsets: jax.Array, node_ids: jax.Array, n: 
     return (node_ids + shift) % n
 
 
+# fold_in tags for the per-round fault gates (ops/faults.py is the
+# semantics home). Disjoint from _POOL_TAG / IMP_CHOICE_TAG and from round
+# indices (these fold into the *round* key, whose own stream starts fresh).
+GATE_TAG = 0x5EED
+DUP_TAG = 0xD00B
+
+
+def gate_threshold(rate: float) -> int:
+    """uint32 threshold T with P(bits < T) = rate exactly (to 2^-32): the
+    single derivation shared by the XLA gates below and the fused kernels'
+    in-kernel regeneration (they compare the same threefry words against
+    the same constant)."""
+    return min(int(round(float(rate) * 2.0**32)), 2**32 - 1)
+
+
 def send_gate(key: jax.Array, n: int, fault_rate: float) -> jax.Array | bool:
-    """Per-round fault injection: True where the node is allowed to send this
-    round. fault_rate == 0 compiles to a constant (no RNG cost)."""
+    """Per-round fault injection: True where the node is allowed to send
+    this round. fault_rate == 0 compiles to a constant (no RNG cost). Raw
+    uint32 words against a threshold — position-wise under the
+    partitionable threefry, so padded-length draws agree with unpadded ones
+    and the fused kernels regenerate the gate tile by tile."""
     if fault_rate <= 0.0:
         return True
-    u = jax.random.uniform(jax.random.fold_in(key, 0x5EED), (n,))
-    return u >= fault_rate
+    bits = jax.random.bits(jax.random.fold_in(key, GATE_TAG), (n,), jnp.uint32)
+    return bits >= jnp.uint32(gate_threshold(fault_rate))
+
+
+def dup_gate(key: jax.Array, n: int, dup_rate: float) -> jax.Array | bool:
+    """Per-round duplicate delivery: True where the node's sent message is
+    delivered twice this round (at-least-once delivery). Same threshold
+    scheme as send_gate on its own tagged subkey. dup_rate == 0 compiles to
+    the constant False."""
+    if dup_rate <= 0.0:
+        return False
+    bits = jax.random.bits(jax.random.fold_in(key, DUP_TAG), (n,), jnp.uint32)
+    return bits < jnp.uint32(gate_threshold(dup_rate))

@@ -78,6 +78,7 @@ from ..ops.fused_stencil_hbm import (
     _window_vals,
 )
 from ..ops.topology import Topology, stencil_offsets
+from ..utils import compat
 from .fused_sharded import _signed_pad
 
 _PT_CANDIDATES = (2048, 1024, 512, 256)
@@ -148,8 +149,13 @@ def plan_stencil_hbm_sharded(topo: Topology, cfg: SimConfig, n_dev: int):
         return "fused engine supports float32 only"
     if not jax.config.jax_threefry_partitionable:
         return "requires jax_threefry_partitionable=True"
-    if cfg.fault_rate > 0:
-        return "fault injection not supported in the fused kernel"
+    if cfg.faulted:
+        # No failure-model support in this engine yet — rejecting on
+        # the aggregate flag (not just fault_rate) keeps a crash/dup/
+        # delay config from silently running unfaulted here. The
+        # stencil (ops/fused.py) and pool tiers (ops/fused_pool.py,
+        # ops/fused_pool2.py) run drop+crash in-kernel.
+        return "failure models not supported in this fused kernel"
     if cfg.delivery == "scatter":
         return (
             "the fused kernel delivers via the stencil formulation only; "
@@ -371,7 +377,7 @@ def make_pushsum_stencil_hbm_shard_chunk(
                 return 0
 
             lax.fori_loop(0, T, cp, 0, unroll=False)
-            flags[0] = 0  # rounds executed
+            flags[0] = jnp.int32(0)  # rounds executed
 
         u_o[k] = jnp.int32(-1)
         active = scal_ref[1] + k < scal_ref[2]
@@ -601,7 +607,7 @@ def make_pushsum_stencil_hbm_shard_chunk(
                 pltpu.SemaphoreType.DMA((4,)),
                 pltpu.SemaphoreType.DMA((C * stride * 3,)),
             ],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=compat.pallas_tpu_compiler_params(
                 vmem_limit_bytes=96 * 1024 * 1024
             ),
             interpret=interpret,
@@ -679,7 +685,7 @@ def make_gossip_stencil_hbm_shard_chunk(
                 return 0
 
             lax.fori_loop(0, T, cp, 0, unroll=False)
-            flags[0] = 0
+            flags[0] = jnp.int32(0)
 
         u_o[k] = jnp.int32(-1)
         active = scal_ref[1] + k < scal_ref[2]
@@ -836,7 +842,7 @@ def make_gossip_stencil_hbm_shard_chunk(
                 pltpu.SemaphoreType.DMA((3,)),
                 pltpu.SemaphoreType.DMA((C * stride,)),
             ],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=compat.pallas_tpu_compiler_params(
                 vmem_limit_bytes=96 * 1024 * 1024
             ),
             interpret=interpret,
@@ -991,7 +997,7 @@ def run_stencil_hbm_sharded(
 
     plane_specs = tuple(P(NODE_AXIS, None) for _ in planes0)
     chunk_sharded = jax.jit(
-        jax.shard_map(
+        compat.shard_map(
             chunk_local,
             mesh=mesh,
             in_specs=((plane_specs, P(), P()), P(), P()),
@@ -1025,7 +1031,10 @@ def run_stencil_hbm_sharded(
     del warm
     compile_s = time.perf_counter() - t0
 
+    from ..models.runner import StallWatchdog, _progress_gap
+
     rounds = start_round
+    watchdog = StallWatchdog(cfg.stall_chunks)
     t1 = time.perf_counter()
     while True:
         round_end = min(rounds + CR * 8, cfg.max_rounds)
@@ -1036,8 +1045,16 @@ def run_stencil_hbm_sharded(
             on_chunk(rounds, to_canonical(planes))
         if bool(done) or rounds >= cfg.max_rounds:
             break
+        # This engine rejects failure models (plan gate): legacy gap. The
+        # conv plane is unpacked here (packing is the single-device pool2
+        # tier's trick), so the plane sum IS the conv count.
+        if cfg.stall_chunks and watchdog.no_progress(
+            _progress_gap(None, cfg.quorum, target, planes[-1], rounds)
+        ):
+            break
     run_s = time.perf_counter() - t1
 
     return _finalize_result(
-        topo, cfg, to_canonical(carry[0]), rounds, target, compile_s, run_s
+        topo, cfg, to_canonical(carry[0]), rounds, target, compile_s, run_s,
+        done=bool(done), stalled=watchdog.stalled,
     )

@@ -75,6 +75,7 @@ from ..ops.fused_pool import (
     pool_common_support,
 )
 from ..ops.topology import Topology
+from ..utils import compat
 
 
 def plan_fused_pool_sharded(topo: Topology, cfg: SimConfig, n_dev: int):
@@ -115,9 +116,17 @@ def run_fused_pool_sharded(
 
     from ..models import gossip as gossip_mod
     from ..models import pushsum as pushsum_mod
-    from ..models.runner import _check_dtype, _finalize_result, draw_leader
+    from ..models.runner import (
+        StallWatchdog,
+        _check_dtype,
+        _finalize_result,
+        _host_done,
+        _progress_gap,
+        draw_leader,
+    )
+    from ..ops import faults as faults_mod
     from ..ops import sampling
-    from ..ops.fused import round_keys
+    from ..ops.fused import build_death2d, round_keys
     from ..ops.fused_pool import round_offsets
     from .mesh import NODE_AXIS, make_mesh
 
@@ -172,7 +181,13 @@ def run_fused_pool_sharded(
             leader_counts_receipt=cfg.reference and topo.kind == "full",
         )
     planes0 = tuple(jax.device_put(p, shard_rows) for p in to_planes(st0))
-    done0 = bool(np.asarray(st0.conv).sum() >= target)
+    death_np = faults_mod.death_plane(cfg, n)
+    done0 = _host_done(cfg, death_np, st0, start_round, target)
+    # Crash model: the reused pool kernel already runs the quorum verdict
+    # in-kernel; this replicated plane lets the composition's OWN done
+    # mirror it — without it a crash run's legacy target could stay
+    # unreachable and the inner while_loop would spin at executed == 0.
+    death2d = build_death2d(cfg, n, layout.n_pad)
 
     K = int(cfg.chunk_rounds)
 
@@ -197,9 +212,26 @@ def run_fused_pool_sharded(
             keys = round_keys(base, rnd, K)
             offs = round_offsets(base, rnd, K, cfg.pool_size, n)
             out_full, executed = chunk_fn(full, keys, offs, rnd, round_end)
-            done = jnp.sum(out_full[-1], dtype=jnp.int32) >= target
+            if death2d is None:
+                done = jnp.sum(out_full[-1], dtype=jnp.int32) >= target
+            else:
+                # Quorum over live nodes at the last executed round —
+                # replicated, so it agrees with the in-kernel verdict.
+                alive = death2d > rnd + executed - 1
+                conv_alive = jnp.sum(
+                    jnp.where(alive, out_full[-1], jnp.int32(0)),
+                    dtype=jnp.int32,
+                )
+                need = faults_mod.quorum_need(
+                    jnp.sum(alive.astype(jnp.int32), dtype=jnp.int32),
+                    cfg.quorum,
+                )
+                done = conv_alive >= need
             planes_new = tuple(
-                lax.dynamic_slice(o, (row0, 0), (rows_loc, LANES))
+                # Both indices pinned to int32: under x64 the bare literal
+                # promotes to int64 and dynamic_slice rejects the mixed
+                # index dtypes (the r5 tier-1 failure class).
+                lax.dynamic_slice(o, (row0, jnp.int32(0)), (rows_loc, LANES))
                 for o in out_full
             )
             return (planes_new, rnd + executed, done)
@@ -208,7 +240,7 @@ def run_fused_pool_sharded(
 
     plane_specs = tuple(P(NODE_AXIS, None) for _ in planes0)
     chunk_sharded = jax.jit(
-        jax.shard_map(
+        compat.shard_map(
             chunk_local,
             mesh=mesh,
             in_specs=((plane_specs, P(), P()), P(), P()),
@@ -244,6 +276,7 @@ def run_fused_pool_sharded(
     compile_s = time.perf_counter() - t0
 
     rounds = start_round
+    watchdog = StallWatchdog(cfg.stall_chunks)
     t1 = time.perf_counter()
     while True:
         round_end = min(rounds + cfg.chunk_rounds, cfg.max_rounds)
@@ -254,8 +287,14 @@ def run_fused_pool_sharded(
             on_chunk(rounds, to_canonical(planes))
         if bool(done) or rounds >= cfg.max_rounds:
             break
+        if cfg.stall_chunks and watchdog.no_progress(
+            _progress_gap(death2d, cfg.quorum, target, planes[-1], rounds)
+        ):
+            break
     run_s = time.perf_counter() - t1
 
+    _, _, done = carry
     return _finalize_result(
-        topo, cfg, to_canonical(carry[0]), rounds, target, compile_s, run_s
+        topo, cfg, to_canonical(carry[0]), rounds, target, compile_s, run_s,
+        done=bool(done), stalled=watchdog.stalled,
     )

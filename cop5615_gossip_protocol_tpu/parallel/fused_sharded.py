@@ -74,6 +74,7 @@ from ..ops.fused_pool import (
 )
 from ..ops.fused_stencil import _build_disp_planes
 from ..ops.topology import Topology, stencil_offsets
+from ..utils import compat
 
 _VMEM_BUDGET = 100 * 1024 * 1024
 
@@ -156,8 +157,13 @@ def plan_fused_sharded(topo: Topology, cfg: SimConfig, n_dev: int):
         return "fused engine supports float32 only"
     if not jax.config.jax_threefry_partitionable:
         return "requires jax_threefry_partitionable=True"
-    if cfg.fault_rate > 0:
-        return "fault injection not supported in the fused kernel"
+    if cfg.faulted:
+        # No failure-model support in this engine yet — rejecting on
+        # the aggregate flag (not just fault_rate) keeps a crash/dup/
+        # delay config from silently running unfaulted here. The
+        # stencil (ops/fused.py) and pool tiers (ops/fused_pool.py,
+        # ops/fused_pool2.py) run drop+crash in-kernel.
+        return "failure models not supported in this fused kernel"
     if cfg.delivery == "scatter":
         return (
             "the fused kernel delivers via the stencil formulation only; "
@@ -270,8 +276,8 @@ def make_stencil_shard_chunk(
             else:
                 _copy_in([(n0, n_v), (a0, a_v), (c0, c_v),
                           (disp_h, disp_v), (deg_h, deg_v)], sems)
-            flags[0] = 0
-            flags[1] = 0
+            flags[0] = jnp.int32(0)
+            flags[1] = jnp.int32(0)
 
         u_o[k] = jnp.int32(-1)
         active = scal_ref[1] + k < scal_ref[2]  # start + k < cap
@@ -426,7 +432,7 @@ def make_stencil_shard_chunk(
                 + [pl.BlockSpec(memory_space=pltpu.SMEM)] * 2
             ),
             scratch_shapes=scratch,
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=compat.pallas_tpu_compiler_params(
                 vmem_limit_bytes=120 * 1024 * 1024
             ),
             interpret=interpret,
@@ -590,7 +596,7 @@ def run_fused_sharded(
 
     plane_specs = tuple(P(NODE_AXIS, None) for _ in planes0)
     chunk_sharded = jax.jit(
-        jax.shard_map(
+        compat.shard_map(
             chunk_local,
             mesh=mesh,
             in_specs=(
@@ -627,7 +633,10 @@ def run_fused_sharded(
     del warm
     compile_s = time.perf_counter() - t0
 
+    from ..models.runner import StallWatchdog, _finalize_result, _progress_gap
+
     rounds = start_round
+    watchdog = StallWatchdog(cfg.stall_chunks)
     t1 = time.perf_counter()
     while True:
         round_end = min(rounds + cfg.chunk_rounds * 8, cfg.max_rounds)
@@ -640,10 +649,16 @@ def run_fused_sharded(
             on_chunk(rounds, to_canonical(planes))
         if bool(done) or rounds >= cfg.max_rounds:
             break
+        # This engine rejects crash models (plan gate), so the gap is the
+        # legacy target distance.
+        if cfg.stall_chunks and watchdog.no_progress(
+            _progress_gap(None, cfg.quorum, target, planes[-1], rounds)
+        ):
+            break
     run_s = time.perf_counter() - t1
 
-    from ..models.runner import _finalize_result
-
+    _, _, done = carry
     return _finalize_result(
-        topo, cfg, to_canonical(carry[0]), rounds, target, compile_s, run_s
+        topo, cfg, to_canonical(carry[0]), rounds, target, compile_s, run_s,
+        done=bool(done), stalled=watchdog.stalled,
     )

@@ -75,9 +75,19 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..config import SimConfig
 from ..models import gossip as gossip_mod
 from ..models import pushsum as pushsum_mod
-from ..models.runner import RunResult, _check_dtype, draw_leader
+from ..models.runner import (
+    RunResult,
+    StallWatchdog,
+    _check_dtype,
+    _freeze_dead,
+    _host_done,
+    _progress_gap,
+    draw_leader,
+)
+from ..ops import faults as faults_mod
 from ..ops import sampling
 from ..ops.topology import Topology, imp_split
+from ..utils import compat
 from . import halo as halo_mod
 from .mesh import NODE_AXIS, make_mesh
 
@@ -109,10 +119,25 @@ def run_sharded(
     if key is None:
         key = jax.random.PRNGKey(cfg.seed)
 
+    if cfg.dup_rate > 0 or cfg.delay_rounds > 0:
+        raise ValueError(
+            "dup/delay fault models are single-device chunked-engine "
+            "features; sharded runs support the drop gate (--fault-rate) "
+            "and crash models"
+        )
+
     n = topo.n
     n_pad = ((n + n_dev - 1) // n_dev) * n_dev
     n_loc = n_pad // n_dev
     target = cfg.resolved_target_count(n, topo.target_count)
+    # Crash plane: rebuilt from the config (ops/faults.py), padded with
+    # death round 0 so pad slots count as dead and alive-count psums need
+    # no extra masking. Closed over — sliced per shard inside the trace.
+    death_np = faults_mod.death_plane(cfg, n)
+    death_full = (
+        None if death_np is None
+        else jnp.asarray(faults_mod.pad_death_plane(death_np, n_pad))
+    )
     # The base key crosses the jit/shard_map boundary as a replicated runtime
     # ARGUMENT (raw data + static impl, ops/sampling.key_split): closed over,
     # it would bake into the executable as a constant, which the axon
@@ -222,6 +247,16 @@ def run_sharded(
 
     # --- local round bodies (operate on [n_loc] shards) -------------------
 
+    def _death_loc(start):
+        """This shard's slice of the crash plane (crash model only)."""
+        return lax.dynamic_slice(death_full, (start,), (n_loc,))
+
+    def _gate_crash(send_ok, start, round_idx):
+        """Dead nodes never send (ops/faults.py); no-op sans crash model."""
+        if death_full is None:
+            return send_ok
+        return send_ok & (_death_loc(start) > round_idx)
+
     def targets_and_gate(round_idx, key_data, *targs):
         kr = sampling.round_key(sampling.key_join(key_data, key_impl), round_idx)
         # Full-length draws on every device, then slice: keeps the stream
@@ -252,6 +287,7 @@ def run_sharded(
         gate_full = sampling.send_gate(kr, n_pad, cfg.fault_rate)
         if gate_full is not True:
             send_ok = send_ok & lax.dynamic_slice(gate_full, (start,), (n_loc,))
+        send_ok = _gate_crash(send_ok, start, round_idx)
         return targets, send_ok, valid_loc, gids
 
     def pool_parts(round_idx, key_data, valid_loc):
@@ -273,6 +309,7 @@ def run_sharded(
         gate_full = sampling.send_gate(kr, n_pad, cfg.fault_rate)
         if gate_full is not True:
             send_ok = send_ok & lax.dynamic_slice(gate_full, (start,), (n_loc,))
+        send_ok = _gate_crash(send_ok, start, round_idx)
         return choice, offs, send_ok
 
     if plan is not None:
@@ -320,6 +357,7 @@ def run_sharded(
         gate_full = sampling.send_gate(kr, n_pad, cfg.fault_rate)
         if gate_full is not True:
             send_ok = send_ok & lax.dynamic_slice(gate_full, (start,), (n_loc,))
+        send_ok = _gate_crash(send_ok, start, round_idx)
         return d, is_extra, choice, offs, send_ok
 
     def deliver_imp_sharded(channels, d, is_extra, choice, offs):
@@ -465,6 +503,16 @@ def run_sharded(
                 inbox = deliver_sharded(vals, targets, gids)
                 return gossip_mod.absorb(state, inbox, rumor_target, suppress)
 
+    if death_full is not None:
+        # Crash-stop freeze: dead nodes keep their protocol state
+        # (runner._freeze_dead — push-sum mass still parks in s/w).
+        base_round_fn = round_fn
+
+        def round_fn(state, round_idx, key_data, *targs):  # noqa: F811
+            new = base_round_fn(state, round_idx, key_data, *targs)
+            start = lax.axis_index(NODE_AXIS) * n_loc
+            return _freeze_dead(_death_loc(start), state, new, round_idx)
+
     done0 = False
     if start_state is not None:
         fills = {"s": 0.0, "w": 1.0, "term": cfg.initial_term_round,
@@ -476,7 +524,7 @@ def run_sharded(
         # Seed the loop predicate from the resumed state — a checkpoint taken
         # at/after convergence must execute zero further rounds (matches the
         # single-device runner and the fused kernels' conv-plane seeding).
-        done0 = bool(np.asarray(start_state.conv).sum() >= target)
+        done0 = _host_done(cfg, death_np, start_state, start_round, target)
 
     # --- chunked while_loop under shard_map -------------------------------
 
@@ -488,8 +536,26 @@ def run_sharded(
         def body(c):
             state, rnd, _ = c
             state = round_fn(state, rnd, key_data, *targs)
-            conv_count = lax.psum(jnp.sum(state.conv), NODE_AXIS)
-            return (state, rnd + 1, conv_count >= target)
+            if death_full is None:
+                conv_count = lax.psum(jnp.sum(state.conv), NODE_AXIS)
+                done = conv_count >= target
+            else:
+                # Quorum over live nodes (ops/faults.py): pad slots have
+                # death round 0, so the alive psum is exactly the live
+                # population with no valid-mask needed.
+                start = lax.axis_index(NODE_AXIS) * n_loc
+                alive = _death_loc(start) > rnd
+                conv_alive = lax.psum(
+                    jnp.sum((state.conv & alive).astype(jnp.int32)),
+                    NODE_AXIS,
+                )
+                alive_count = lax.psum(
+                    jnp.sum(alive.astype(jnp.int32)), NODE_AXIS
+                )
+                done = conv_alive >= faults_mod.quorum_need(
+                    alive_count, cfg.quorum
+                )
+            return (state, rnd + 1, done)
 
         return lax.while_loop(cond, body, carry)
 
@@ -499,7 +565,7 @@ def run_sharded(
         P(),
     )
     chunk_sharded = jax.jit(
-        jax.shard_map(
+        compat.shard_map(
             chunk_local,
             mesh=mesh,
             in_specs=(carry_specs, P(), P()) + topo_specs,
@@ -535,6 +601,7 @@ def run_sharded(
     compile_s = time.perf_counter() - t0
 
     rounds = start_round
+    watchdog = StallWatchdog(cfg.stall_chunks)
     t1 = time.perf_counter()
     while True:
         round_end = min(rounds + cfg.chunk_rounds, cfg.max_rounds)
@@ -547,10 +614,19 @@ def run_sharded(
             on_chunk(rounds, state)
         if bool(done) or rounds >= cfg.max_rounds:
             break
+        # Watchdog (models/runner.StallWatchdog): replicated scalar
+        # reduction, process-safe like the trace hook. Pad slots carry
+        # death round 0 / conv 0, so the padded gap equals the real one.
+        if cfg.stall_chunks and watchdog.no_progress(
+            _progress_gap(death_full, cfg.quorum, target, state.conv, rounds)
+        ):
+            break
     run_s = time.perf_counter() - t1
 
-    state, _, _ = carry
+    state, _, done = carry
     converged_count = int(jnp.sum(state.conv))
+    converged = bool(done)
+    stalled = watchdog.stalled
     result = RunResult(
         algorithm=cfg.algorithm,
         topology=topo.kind,
@@ -560,9 +636,13 @@ def run_sharded(
         target_count=target,
         rounds=rounds,
         converged_count=converged_count,
-        converged=converged_count >= target,
+        converged=converged,
         compile_s=compile_s,
         run_s=run_s,
+        outcome=(
+            "converged" if converged
+            else ("stalled" if stalled else "max_rounds")
+        ),
     )
     if cfg.algorithm == "push-sum":
         # jnp reductions, not host numpy: when the mesh spans processes the

@@ -35,15 +35,24 @@ def _normalize(path: str | Path) -> Path:
 
 def save(path: str | Path, state, rounds: int, cfg: SimConfig) -> None:
     """Write state arrays + round counter + config. `state` is a
-    PushSumState or GossipState."""
+    PushSumState or GossipState.
+
+    Both files land via write-to-temp + atomic rename: a run killed
+    mid-checkpoint (the exact population --resume auto exists for) leaves
+    the previous complete checkpoint in place, never a truncated archive."""
     path = _normalize(path)
     path.parent.mkdir(parents=True, exist_ok=True)
     arrays = {f: np.asarray(getattr(state, f)) for f in state._fields}
+    # The .npz suffix on the temp name keeps np.savez from appending one.
+    tmp = path.with_name(path.name + ".tmp.npz")
     np.savez_compressed(
-        path, __rounds__=rounds, __stream__=STREAM_VERSION, **arrays
+        tmp, __rounds__=rounds, __stream__=STREAM_VERSION, **arrays
     )
     sidecar = path.with_suffix(path.suffix + ".json")
-    sidecar.write_text(json.dumps(dataclasses.asdict(cfg), indent=2))
+    tmp_side = sidecar.with_name(sidecar.name + ".tmp")
+    tmp_side.write_text(json.dumps(dataclasses.asdict(cfg), indent=2))
+    tmp_side.replace(sidecar)
+    tmp.replace(path)
 
 
 def load(path: str | Path):
@@ -60,17 +69,27 @@ def load(path: str | Path):
             k: z[k] for k in z.files if k not in ("__rounds__", "__stream__")
         }
     cfg = SimConfig(**json.loads(path.with_suffix(path.suffix + ".json").read_text()))
-    # The v1 -> v2 stream change altered only the *packed* pool-choice
-    # derivation (sampling.STREAM_VERSION history), so only checkpoints
-    # whose config consumes that stream are unresumable: scatter/stencil
-    # runs replay bitwise-identically under either version, and so do
-    # pool_size > 16 runs (pool_choice_packed's wide fallback IS the v1
-    # derivation).
-    if (
-        stream != STREAM_VERSION
-        and cfg.delivery == "pool"
-        and cfg.pool_size <= 1 << POOL_CHOICE_BITS
-    ):
+    # Stream changes invalidate only checkpoints whose config CONSUMES a
+    # stream that changed BETWEEN the written and current versions
+    # (sampling.STREAM_VERSION history): v1 -> v2 altered the packed
+    # pool-choice derivation (scatter/stencil runs and pool_size > 16 runs
+    # replay bitwise-identically under either); v2 -> v3 altered only the
+    # fault-gate draws — a fault-free v2 pool checkpoint resumes bitwise
+    # under v3. Checkpoints from a NEWER stream than this build reject on
+    # either sensitivity (their derivations are unknown here).
+    pool_sensitive = (
+        cfg.delivery == "pool" and cfg.pool_size <= 1 << POOL_CHOICE_BITS
+    )
+    gate_sensitive = cfg.fault_rate > 0 or cfg.dup_rate > 0
+    sv = 0 if stream is None else stream
+    invalid = (
+        (pool_sensitive and sv < 2)
+        or (gate_sensitive and sv < 3)
+        # A NEWER stream than this build: what changed is unknowable here,
+        # so no sensitivity classification applies — always refuse.
+        or sv > STREAM_VERSION
+    )
+    if invalid:
         written = (
             f"under random-stream version {stream}" if stream is not None
             else "before stream versioning (version unknown)"

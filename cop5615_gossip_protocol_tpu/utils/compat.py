@@ -1,0 +1,76 @@
+"""JAX version-compatibility shims.
+
+The framework targets the current JAX API surface but must also run on the
+older runtimes baked into some execution images. Two surfaces moved:
+
+- ``jax.shard_map`` graduated from ``jax.experimental.shard_map`` (where the
+  replication-check kwarg is ``check_rep``) to the top level (where it is
+  ``check_vma``). Every sharded runner goes through :func:`shard_map` here so
+  the call sites stay written against the modern API.
+- ``jax_threefry_partitionable`` defaults to True on current JAX but False on
+  older releases. The framework's entire cross-engine stream contract
+  (ops/sampling.py: full-length position-wise draws sliced per shard; the
+  fused kernels' in-kernel threefry) is defined over the partitionable
+  stream, and every engine refuses to run without it — so the package opts in
+  at import (:func:`ensure_partitionable_threefry`) instead of failing every
+  run on an older JAX.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = False):
+    """``jax.shard_map`` with a fallback to the pre-graduation API."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )
+
+
+def pallas_tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams`` under its current or pre-rename
+    (``TPUCompilerParams``) spelling."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kwargs)
+
+
+def set_host_device_count(n: int) -> None:
+    """Request ``n`` virtual CPU devices. Current JAX exposes this as the
+    ``jax_num_cpu_devices`` config option; older releases only honor the
+    ``--xla_force_host_platform_device_count`` XLA flag, which is read at
+    (lazy) backend initialization — both paths require being called before
+    the first computation touches the backend."""
+    import os
+
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:
+        flags = os.environ.get("XLA_FLAGS", "")
+        flag = f"--xla_force_host_platform_device_count={n}"
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (flags + " " + flag).strip()
+
+
+def ensure_partitionable_threefry() -> None:
+    """UNCONDITIONALLY opt in to the partitionable threefry stream (on
+    current JAX, where it is the default, this is a no-op). The flag value
+    alone cannot distinguish "older JAX's False default" from "user set
+    False", so the framework's entry points (CLI, __graft_entry__) assert
+    the stream their cross-engine bitwise contract is defined over — a
+    False here would otherwise just make every engine's support gate
+    refuse to run. To experiment with the legacy length-dependent stream,
+    set the flag after this call or use the library API without these
+    entry points."""
+    if not jax.config.jax_threefry_partitionable:
+        jax.config.update("jax_threefry_partitionable", True)

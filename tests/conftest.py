@@ -38,3 +38,9 @@ jax.config.update("jax_platforms", "cpu")
 # rescales delta for float32 (see SimConfig.resolved_delta). Tests run on CPU
 # where x64 is native.
 jax.config.update("jax_enable_x64", True)
+
+# The cross-engine stream contract is defined over the partitionable
+# threefry (default on current JAX, off on older runtimes) — opt in
+# explicitly so golden trajectories and fused-vs-chunked bitwise pins hold
+# on either (utils/compat.py).
+jax.config.update("jax_threefry_partitionable", True)

@@ -81,6 +81,36 @@ def test_cli_checkpoint_resume_is_stream_exact(tmp_path, capsys):
     assert res_rec["estimate_mae"] == pytest.approx(full_rec["estimate_mae"], rel=1e-9)
 
 
+def test_cli_resume_auto_restart_workflow(tmp_path, capsys):
+    # The crash-only-restarts workflow: the SAME command line runs fresh
+    # when no sidecar exists, and picks up from the last auto-checkpoint
+    # when one does — landing on the uninterrupted trajectory exactly.
+    ck = tmp_path / "auto.npz"
+    args = ["256", "grid2d", "push-sum", "--dtype", "float64",
+            "--chunk-rounds", "200", "--checkpoint", str(ck),
+            "--resume", "auto"]
+    # Uninterrupted oracle (no checkpointing, no resume).
+    rc = main(["256", "grid2d", "push-sum", "--dtype", "float64",
+               "--chunk-rounds", "200"])
+    full_rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0
+    total_rounds = full_rec["rounds"]
+    half = (total_rounds // 2 // 200) * 200
+    # First launch: sidecar absent -> fresh start; "killed" at half.
+    rc = main(args + ["--max-rounds", str(half)])
+    capsys.readouterr()
+    assert rc == 1 and ck.exists()
+    # Relaunch of the identical command: resumes from the sidecar.
+    rc = main(args)
+    res_rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0
+    assert res_rec["rounds"] == total_rounds
+    # --resume auto without --checkpoint is a loud config error.
+    rc = main(["64", "full", "gossip", "--resume", "auto"])
+    assert rc == 2
+    assert "--resume auto" in capsys.readouterr().err
+
+
 def test_cli_trace_resume_seeds_newly_converged(tmp_path, capsys):
     # ADVICE r2: resuming with --trace-convergence must seed the baseline
     # from the checkpoint - nodes converged before the checkpoint are not
@@ -185,6 +215,25 @@ def test_checkpoint_rejects_mismatched_stream_version(tmp_path):
     rewrite_stream(1)
     _, rounds, _ = ckpt.load(p)
     assert rounds == 32
+
+    # v2 -> v3 changed only the fault-gate derivation: a fault-free pool
+    # checkpoint from v2 never consumed it and must keep loading...
+    ckpt.save(p, st, 32, cfg_pool)
+    rewrite_stream(2)
+    _, rounds, _ = ckpt.load(p)
+    assert rounds == 32
+    # ...while a drop-gated run consumed the changed stream and is refused,
+    # as is any checkpoint from a NEWER stream than this build understands.
+    cfg_gate = SimConfig(n=16, topology="full", algorithm="push-sum",
+                         delivery="pool", fault_rate=0.25)
+    ckpt.save(p, st, 32, cfg_gate)
+    rewrite_stream(2)
+    with pytest.raises(ValueError, match="stream version"):
+        ckpt.load(p)
+    ckpt.save(p, st, 32, cfg_pool)
+    rewrite_stream(99)
+    with pytest.raises(ValueError, match="stream version"):
+        ckpt.load(p)
 
 
 def test_cli_checkpoint_resume_across_device_counts(tmp_path, capsys):

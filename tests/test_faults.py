@@ -1,0 +1,337 @@
+"""Failure-model subsystem (ops/faults.py): crash-stop churn, quorum
+termination, message-level faults, and the stall watchdog.
+
+The reference models zero faults and hangs on a stalled topology
+(program.fs:334); these tests pin the semantics the failure subsystem
+promises instead:
+
+- crash schedules and rates produce a deterministic death plane, rebuilt
+  from the config alone on every engine;
+- a crash-schedule push-sum run terminates via quorum over LIVE nodes with
+  total mass (live + dead — dead nodes park delivered mass) conserved to
+  <= 1 ulp at float64;
+- the drop gate + crash plane run IN-KERNEL on the fused tiers, matching
+  the chunked XLA engine round for round (the regenerated threefry gate is
+  the same stream ops/sampling.send_gate draws);
+- dup/delay message faults conserve mass over state + in-flight ring;
+- the stall watchdog turns the reference's line-topology hang into a
+  measured outcome="stalled" record;
+- checkpoint-resume of a faulted run follows the original trajectory
+  bitwise (the death plane is derived from the config, never stored).
+"""
+
+import numpy as np
+import pytest
+
+from cop5615_gossip_protocol_tpu import SimConfig, build_topology
+from cop5615_gossip_protocol_tpu.models.runner import run
+from cop5615_gossip_protocol_tpu.ops import faults
+
+
+# ---------------------------------------------------------------- plumbing
+
+
+def test_parse_crash_schedule():
+    assert faults.parse_crash_schedule("5:10") == ((5, 10),)
+    assert faults.parse_crash_schedule("9:1, 3:7") == ((3, 7), (9, 1))
+    for bad in ["", "5", "5:0", "-1:3", "5:2,5:3", "a:b", "5:10:2"]:
+        with pytest.raises(ValueError):
+            faults.parse_crash_schedule(bad)
+
+
+def test_config_failure_model_validation():
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        SimConfig(n=64, topology="full", crash_rate=0.1, crash_schedule="5:3")
+    with pytest.raises(ValueError, match="quorum"):
+        SimConfig(n=64, topology="full", quorum=0.9)  # no crash model
+    with pytest.raises(ValueError, match="reference"):
+        SimConfig(n=64, topology="full", semantics="reference", crash_rate=0.1)
+    with pytest.raises(ValueError, match="global"):
+        SimConfig(n=64, topology="full", algorithm="push-sum",
+                  crash_rate=0.1, termination="global")
+    # config-time schedule validation, not first-run
+    with pytest.raises(ValueError, match="round:count"):
+        SimConfig(n=64, topology="full", crash_schedule="nope")
+
+
+def test_death_plane_deterministic_and_schedule_exact():
+    cfg = SimConfig(n=256, topology="full", crash_schedule="4:30,9:20")
+    d1 = faults.death_plane(cfg, 256)
+    d2 = faults.death_plane(cfg, 256)
+    assert (d1 == d2).all()  # pure function of (cfg, n)
+    assert (d1 == 4).sum() == 30 and (d1 == 9).sum() == 20
+    assert (d1 == faults.NEVER).sum() == 256 - 50
+    # alive_at: nodes with death round r are dead DURING round r; the
+    # round-9 cohort is still alive at round 4.
+    assert int(np.asarray(faults.alive_at(d1, 3)).sum()) == 256
+    assert int(np.asarray(faults.alive_at(d1, 4)).sum()) == 256 - 30
+    assert int(np.asarray(faults.alive_at(d1, 9)).sum()) == 256 - 50
+    assert faults.death_plane(
+        SimConfig(n=256, topology="full"), 256
+    ) is None
+
+
+def test_quorum_need_integer_exact_at_full_quorum():
+    # ceil(1.0 * alive) at float32 is off by one above 2^24; the
+    # alive - floor((1-q)*alive) form is exact.
+    for alive in [1, 7, 2**24 + 1, 2**26]:
+        assert int(faults.quorum_need(alive, 1.0)) == alive
+    assert int(faults.quorum_need(100, 0.9)) == 90
+    assert int(faults.quorum_need(10, 0.95)) == 10  # floor(0.5) = 0
+
+
+# ------------------------------------------- crash + quorum + conservation
+
+
+def _total_mass(state):
+    return (
+        np.asarray(state.s, np.float64).sum(),
+        np.asarray(state.w, np.float64).sum(),
+    )
+
+
+def test_crash_schedule_pushsum_quorum_conserves_mass():
+    # Acceptance pin: a crash-schedule push-sum run terminates via quorum
+    # (not max_rounds) and total mass over live + dead nodes is conserved
+    # to <= 1 ulp — dead nodes park delivered mass, they don't destroy it.
+    n = 512
+    cfg = SimConfig(n=n, topology="full", delivery="pool",
+                    algorithm="push-sum", engine="chunked",
+                    crash_schedule="3:100,6:50", quorum=0.95, fault_rate=0.3,
+                    max_rounds=8000, chunk_rounds=32, dtype="float64")
+    cap = {}
+    r = run(build_topology("full", n), cfg,
+            on_chunk=lambda rounds, st: cap.update(state=st))
+    assert r.converged and r.outcome == "converged"
+    assert r.rounds < cfg.max_rounds
+    # 150 dead nodes can never converge; quorum counts live ones only.
+    death = faults.death_plane(cfg, n)
+    alive = death > (r.rounds - 1)
+    assert int(alive.sum()) == n - 150
+    assert r.converged_count >= int(faults.quorum_need(int(alive.sum()), 0.95))
+    s_tot, w_tot = _total_mass(cap["state"])
+    s0, w0 = n * (n - 1) / 2.0, float(n)
+    assert abs(s_tot - s0) <= np.spacing(s0)
+    assert abs(w_tot - w0) <= np.spacing(w0)
+
+
+def test_crash_rate_churn_terminates_with_quorum():
+    # Geometric churn: every node independently survives each round with
+    # probability 1-p. Fixed seed -> deterministic death plane; the run
+    # must end via quorum instead of spinning to max_rounds.
+    n = 256
+    cfg = SimConfig(n=n, topology="full", delivery="pool",
+                    algorithm="push-sum", engine="chunked", crash_rate=0.002,
+                    quorum=0.7, max_rounds=8000, chunk_rounds=32, seed=7)
+    r = run(build_topology("full", n), cfg)
+    assert r.converged and r.outcome == "converged"
+    assert r.rounds < cfg.max_rounds
+
+
+def test_crash_gossip_sharded_matches_single_device():
+    # The sharded runner slices the SAME death plane per shard (padded
+    # slots count as dead) and runs the quorum psum in-trace — rounds must
+    # match the single-device chunked engine exactly (integer gossip
+    # state, identical stream), device count notwithstanding.
+    from cop5615_gossip_protocol_tpu.parallel.mesh import make_mesh
+    from cop5615_gossip_protocol_tpu.parallel.sharded import run_sharded
+
+    n = 512
+    cfg = SimConfig(n=n, topology="full", algorithm="gossip",
+                    crash_schedule="2:120", quorum=0.9, fault_rate=0.1,
+                    max_rounds=6000, chunk_rounds=32)
+    topo = build_topology("full", n)
+    a = run(topo, cfg)
+    b = run_sharded(topo, cfg, mesh=make_mesh(8))
+    assert a.rounds == b.rounds
+    assert a.converged_count == b.converged_count
+    assert a.converged and b.converged
+    assert a.outcome == b.outcome == "converged"
+
+
+# ------------------------------------------------------- dup/delay faults
+
+
+def test_dup_rate_inflates_gossip_receipts():
+    # At-least-once delivery: duplicated rumor receipts only speed the
+    # count toward the threshold — convergence still happens, and the
+    # faulted trajectory differs from the exact-once one.
+    n = 256
+    base = dict(n=n, topology="full", algorithm="gossip", engine="chunked",
+                max_rounds=6000, chunk_rounds=32)
+    a = run(build_topology("full", n), SimConfig(**base))
+    b = run(build_topology("full", n), SimConfig(dup_rate=0.5, **base))
+    assert a.converged and b.converged
+    assert b.rounds <= a.rounds  # duplicates never slow the rumor down
+
+
+def test_delay_ring_conserves_mass_in_flight():
+    # Bounded message delay: deliveries park in the D-deep ring before
+    # absorption, so at any chunk boundary Σmass(state) alone is down by
+    # the in-flight planes but Σmass(state) + Σmass(ring) is exact. The
+    # runner only exposes the state, so pin the observable consequences:
+    # convergence still happens and the estimate is still the true mean
+    # (mass was delayed, never destroyed).
+    n = 256
+    cfg = SimConfig(n=n, topology="full", algorithm="push-sum",
+                    engine="chunked", delay_rounds=3, max_rounds=8000,
+                    chunk_rounds=32, dtype="float64")
+    cap = {}
+    r = run(build_topology("full", n), cfg,
+            on_chunk=lambda rounds, st: cap.update(state=st))
+    assert r.converged
+    assert r.estimate_mae < 1e-6
+    # At termination every ring slot has been drained into some node's
+    # (s, w) or still rides the ring; the state total can be short by at
+    # most the in-flight fraction but never exceeds the initial total.
+    s_tot, w_tot = _total_mass(cap["state"])
+    assert s_tot <= n * (n - 1) / 2.0 + np.spacing(n * (n - 1) / 2.0)
+    assert w_tot <= n + np.spacing(float(n))
+
+
+def test_delay_rejects_resume():
+    cfg = SimConfig(n=64, topology="full", algorithm="push-sum",
+                    engine="chunked", delay_rounds=2, max_rounds=100)
+    topo = build_topology("full", 64)
+    from cop5615_gossip_protocol_tpu.models import pushsum
+
+    st = pushsum.init_state(64, np.float32, 0)
+    with pytest.raises(ValueError, match="delay_rounds"):
+        run(topo, cfg, start_state=st, start_round=10)
+
+
+# ---------------------------------------------------------- stall watchdog
+
+
+def test_watchdog_reports_stalled_line_gossip():
+    # The reference's famous line-topology hang as a measured event: with
+    # the drop gate this hot, the rumor never leaves the leader, the
+    # converged count makes no progress, and the watchdog ends the run
+    # with outcome="stalled" instead of spinning to max_rounds.
+    n = 128
+    cfg = SimConfig(n=n, topology="line", algorithm="gossip",
+                    engine="chunked", fault_rate=0.9999, stall_chunks=3,
+                    chunk_rounds=32, max_rounds=100000)
+    r = run(build_topology("line", n), cfg)
+    assert r.outcome == "stalled"
+    assert not r.converged
+    assert r.rounds < cfg.max_rounds  # ended early, not at the cap
+
+
+def test_watchdog_off_runs_to_max_rounds():
+    n = 128
+    cfg = SimConfig(n=n, topology="line", algorithm="gossip",
+                    engine="chunked", fault_rate=0.9999, stall_chunks=0,
+                    chunk_rounds=32, max_rounds=256)
+    r = run(build_topology("line", n), cfg)
+    assert r.outcome == "max_rounds"
+    assert r.rounds == 256
+
+
+def test_outcome_in_jsonl_record():
+    from cop5615_gossip_protocol_tpu.utils import metrics
+
+    n = 128
+    cfg = SimConfig(n=n, topology="line", algorithm="gossip",
+                    engine="chunked", fault_rate=0.9999, stall_chunks=3,
+                    chunk_rounds=32, max_rounds=100000)
+    topo = build_topology("line", n)
+    rec = metrics.run_record(cfg, topo, run(topo, cfg))
+    assert rec["outcome"] == "stalled"
+
+
+# ------------------------------------------------- checkpoint-resume pins
+
+
+def test_checkpoint_resume_faulted_run_bitwise(tmp_path):
+    # A faulted (drop + crash) run resumed from a mid-run checkpoint must
+    # follow the original trajectory bitwise: the gate stream is absolute-
+    # round keyed and the death plane is rebuilt from the config (never
+    # stored in the .npz).
+    from cop5615_gossip_protocol_tpu.utils import checkpoint as ckpt
+
+    n = 256
+    cfg = SimConfig(n=n, topology="full", delivery="pool",
+                    algorithm="push-sum", engine="chunked",
+                    crash_schedule="3:60", quorum=0.9, fault_rate=0.2,
+                    max_rounds=8000, chunk_rounds=16)
+    topo = build_topology("full", n)
+    snaps = {}
+    full_cap = {}
+
+    def hook(rounds, st):
+        full_cap.update(state=st, rounds=rounds)
+        if rounds == 32:
+            snaps[32] = st
+
+    r_full = run(topo, cfg, on_chunk=hook)
+    assert r_full.converged and 32 in snaps
+    path = tmp_path / "faulted.npz"
+    ckpt.save(path, snaps[32], 32, cfg)
+    st, rounds, saved_cfg = ckpt.load(path)
+    assert rounds == 32 and saved_cfg == cfg
+
+    cap2 = {}
+    r_res = run(topo, cfg, start_state=st, start_round=rounds,
+                on_chunk=lambda rd, s: cap2.update(state=s))
+    assert r_res.rounds == r_full.rounds
+    assert r_res.converged_count == r_full.converged_count
+    a, b = full_cap["state"], cap2["state"]
+    assert (np.asarray(a.s) == np.asarray(b.s)).all()
+    assert (np.asarray(a.w) == np.asarray(b.w)).all()
+    assert (np.asarray(a.conv) == np.asarray(b.conv)).all()
+
+
+def test_resumed_quorum_run_executes_zero_rounds_when_done(tmp_path):
+    # A checkpoint taken at/after quorum convergence must execute ZERO
+    # further rounds on resume — the host-side done predicate re-evaluates
+    # the quorum rule, not the legacy full-count target (which 60 dead
+    # nodes make permanently unreachable).
+    n = 256
+    cfg = SimConfig(n=n, topology="full", delivery="pool",
+                    algorithm="push-sum", engine="chunked",
+                    crash_schedule="3:60", quorum=0.9, fault_rate=0.2,
+                    max_rounds=8000, chunk_rounds=16)
+    topo = build_topology("full", n)
+    cap = {}
+    r = run(topo, cfg, on_chunk=lambda rd, st: cap.update(state=st))
+    assert r.converged
+    r2 = run(topo, cfg, start_state=cap["state"], start_round=r.rounds)
+    assert r2.rounds == r.rounds  # zero extra rounds
+    assert r2.converged and r2.outcome == "converged"
+
+
+# --------------------------------------- fused stencil engine fault parity
+
+
+def test_fused_stencil_drop_gate_matches_chunked_bitwise():
+    # Acceptance pin: --fault-rate accepted by the stencil fused engine
+    # (ops/fused.py), with the in-kernel regenerated threefry gate matching
+    # ops/sampling.send_gate word for word — integer gossip state, so
+    # round-count + converged-count equality IS bitwise trajectory
+    # equality.
+    n = 144
+    results = {}
+    for engine in ["chunked", "fused"]:
+        cfg = SimConfig(n=n, topology="grid2d", algorithm="gossip",
+                        engine=engine, fault_rate=0.2, max_rounds=4000,
+                        chunk_rounds=48)
+        results[engine] = run(build_topology("grid2d", n), cfg)
+    a, b = results["chunked"], results["fused"]
+    assert a.rounds == b.rounds
+    assert a.converged_count == b.converged_count
+    assert a.converged and b.converged
+
+
+def test_fused_stencil_crash_quorum_matches_chunked():
+    n = 144
+    results = {}
+    for engine in ["chunked", "fused"]:
+        cfg = SimConfig(n=n, topology="grid2d", algorithm="gossip",
+                        engine=engine, crash_schedule="5:20", quorum=0.9,
+                        max_rounds=4000, chunk_rounds=48)
+        results[engine] = run(build_topology("grid2d", n), cfg)
+    a, b = results["chunked"], results["fused"]
+    assert a.rounds == b.rounds
+    assert a.converged_count == b.converged_count
+    assert a.outcome == b.outcome == "converged"

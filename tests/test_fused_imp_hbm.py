@@ -17,6 +17,12 @@ from cop5615_gossip_protocol_tpu import SimConfig, build_topology
 from cop5615_gossip_protocol_tpu.models.runner import run
 from cop5615_gossip_protocol_tpu.ops import fused_imp, fused_imp_hbm
 
+# Interpret-mode Pallas oracle: bitwise engine validation that cannot
+# fit the ROADMAP tier-1 wall-clock budget on a CPU-only container (the
+# kernels run under the Pallas interpreter). Full-suite / TPU runs
+# execute it: `pytest tests/` (no -m filter) or `pytest -m slow`.
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture
 def force_hbm(monkeypatch):

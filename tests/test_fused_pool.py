@@ -62,6 +62,7 @@ def test_pool_choice_packed_wide_fallback():
     )
 
 
+@pytest.mark.slow  # interpret-mode run pair; see tier-1 budget note in test_fused.py
 @pytest.mark.parametrize("n", [1000, 65536])
 def test_fused_pool_gossip_matches_chunked_bitwise(n):
     results = {}
@@ -74,6 +75,7 @@ def test_fused_pool_gossip_matches_chunked_bitwise(n):
     assert a.converged_count == b.converged_count
 
 
+@pytest.mark.slow  # interpret-mode run pair; see tier-1 budget note in test_fused.py
 def test_fused_pool_gossip_two_tiles():
     # rows = 1024 -> two in-kernel tiles; cross-tile gathers exercised.
     n = 70000
@@ -83,6 +85,7 @@ def test_fused_pool_gossip_two_tiles():
     assert a.rounds == b.rounds and a.converged_count == b.converged_count
 
 
+@pytest.mark.slow  # interpret-mode run pair; see tier-1 budget note in test_fused.py
 @pytest.mark.parametrize("pool_size", [2, 4, 16])
 def test_fused_pool_pushsum_matches_chunked(pool_size):
     n = 1000
@@ -101,6 +104,7 @@ def test_fused_pool_pushsum_matches_chunked(pool_size):
     assert abs(a.estimate_mae - b.estimate_mae) < 1e-3
 
 
+@pytest.mark.slow  # interpret-mode run pair; see tier-1 budget note in test_fused.py
 def test_fused_pool_gossip_suppression_reference_mode():
     # Reference semantics on full: Q1 population n+1, Q2 11th receipt, C13
     # leader self-count, converged-target suppression via the doubled conv
@@ -120,6 +124,7 @@ def test_fused_pool_gossip_suppression_reference_mode():
     assert b.converged
 
 
+@pytest.mark.slow  # interpret-mode run pair; see tier-1 budget note in test_fused.py
 def test_fused_pool_mass_conservation():
     n = 1000
     seen = []
@@ -134,6 +139,45 @@ def test_fused_pool_mass_conservation():
         assert abs(w_tot - n) / n < 1e-5
 
 
+def test_fused_pool_drop_gate_matches_chunked_bitwise():
+    # Acceptance pin: --fault-rate accepted by the fused pool engine, the
+    # in-kernel regenerated threefry gate matching ops/sampling.send_gate
+    # word for word — integer gossip state, so round + converged-count
+    # equality is bitwise trajectory equality.
+    n = 1000
+    results = {}
+    for engine in ["chunked", "fused"]:
+        results[engine] = run(
+            build_topology("full", n), _cfg(n, engine=engine, fault_rate=0.2)
+        )
+    a, b = results["chunked"], results["fused"]
+    assert a.rounds == b.rounds
+    assert a.converged_count == b.converged_count
+    assert a.converged and b.converged
+
+
+@pytest.mark.slow  # interpret-mode run pair; see tier-1 budget note in test_fused.py
+def test_fused_pool_crash_quorum_matches_chunked():
+    # Crash plane + quorum verdict in-kernel (ops/faults.py): the fused
+    # pool run must stop on the same round as the chunked engine, via
+    # quorum — 150 dead nodes make the legacy full-count target
+    # permanently unreachable.
+    n = 512
+    results = {}
+    for engine in ["chunked", "fused"]:
+        results[engine] = run(
+            build_topology("full", n),
+            _cfg(n, algorithm="push-sum", engine=engine, fault_rate=0.3,
+                 crash_schedule="3:100,6:50", quorum=0.95, max_rounds=8000),
+        )
+    a, b = results["chunked"], results["fused"]
+    assert a.rounds == b.rounds
+    assert a.converged_count == b.converged_count
+    assert a.outcome == b.outcome == "converged"
+    assert a.converged_count < n  # quorum, not the legacy target
+
+
+@pytest.mark.slow  # interpret-mode run pair; see tier-1 budget note in test_fused.py
 def test_fused_pool_resume_midway():
     n = 1000
     cfg = _cfg(n, chunk_rounds=8)
@@ -148,6 +192,7 @@ def test_fused_pool_resume_midway():
     assert resumed.converged_count == full.converged_count
 
 
+@pytest.mark.slow  # interpret-mode run pair; see tier-1 budget note in test_fused.py
 @pytest.mark.parametrize("chunk_rounds", [5, 100])
 def test_fused_pool_chunk_rounds_not_multiple_of_8(chunk_rounds):
     # SMEM key/offset blocks pad to 8-round multiples with zeros; padded
@@ -167,9 +212,15 @@ def test_pool_fused_support_gating():
     assert "float32" in fused_pool.pool_fused_support(
         topo, _cfg(1000, dtype="float64", algorithm="push-sum")
     )
-    # fault injection
-    assert "fault" in fused_pool.pool_fused_support(
-        topo, _cfg(1000, fault_rate=0.1)
+    # drop-gate and crash fault models run IN-KERNEL (this PR's failure
+    # subsystem, ops/faults.py) — the engine must accept them...
+    assert fused_pool.pool_fused_support(topo, _cfg(1000, fault_rate=0.1)) is None
+    assert fused_pool.pool_fused_support(
+        topo, _cfg(1000, crash_rate=0.01, quorum=0.9)
+    ) is None
+    # ...while dup/delay restructure delivery itself and stay chunked-only.
+    assert "chunked" in fused_pool.pool_fused_support(
+        topo, _cfg(1000, dup_rate=0.1)
     )
     # population cap
     big = build_topology("full", fused_pool.MAX_POOL_NODES + 1)
@@ -180,6 +231,3 @@ def test_pool_fused_support_gating():
     line = build_topology("line", 100)
     cfg_line = SimConfig(n=100, topology="full", delivery="pool")
     assert "full topology only" in fused_pool.pool_fused_support(line, cfg_line)
-    # explicit engine request must fail loudly, not fall back
-    with pytest.raises(ValueError, match="fused.*unavailable|unavailable"):
-        run(topo, _cfg(1000, fault_rate=0.1, engine="fused"))

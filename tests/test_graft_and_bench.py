@@ -7,6 +7,7 @@ import sys
 from pathlib import Path
 
 import jax
+import pytest
 
 REPO = Path(__file__).resolve().parents[1]
 sys.path.insert(0, str(REPO))
@@ -23,6 +24,7 @@ def test_entry_jits_and_steps():
     assert float(out.s.sum()) == float(state0.s.sum())
 
 
+@pytest.mark.slow  # 8-virtual-device sweep across every sharded tier; ~3-4 min on CPU
 def test_dryrun_multichip():
     __graft_entry__.dryrun_multichip(8)
 

@@ -18,6 +18,7 @@ from cop5615_gossip_protocol_tpu import SimConfig, build_topology, run
 from cop5615_gossip_protocol_tpu.parallel import halo
 from cop5615_gossip_protocol_tpu.parallel.mesh import NODE_AXIS, make_mesh
 from cop5615_gossip_protocol_tpu.parallel.sharded import run_sharded
+from cop5615_gossip_protocol_tpu.utils import compat
 
 
 # --- plan_halo ------------------------------------------------------------
@@ -76,7 +77,9 @@ def test_halo_roll_is_global_circular_roll(s):
         return halo.halo_roll(x_loc, s, NODE_AXIS, 8)
 
     rolled = jax.jit(
-        jax.shard_map(f, mesh=mesh, in_specs=P(NODE_AXIS), out_specs=P(NODE_AXIS))
+        compat.shard_map(
+            f, mesh=mesh, in_specs=P(NODE_AXIS), out_specs=P(NODE_AXIS)
+        )
     )(x)
     np.testing.assert_array_equal(np.asarray(rolled), np.roll(x, s))
 
@@ -103,7 +106,7 @@ def test_global_roll_dynamic_matches_roll(r):
         return halo.global_roll_dynamic(x_loc, r, NODE_AXIS, 8)
 
     rolled = jax.jit(
-        jax.shard_map(
+        compat.shard_map(
             f, mesh=mesh, in_specs=(P(None, NODE_AXIS), P()),
             out_specs=P(None, NODE_AXIS),
         )

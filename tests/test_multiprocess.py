@@ -21,10 +21,31 @@ import subprocess
 import sys
 from pathlib import Path
 
+import pytest
+
 from cop5615_gossip_protocol_tpu import SimConfig, build_topology
 from cop5615_gossip_protocol_tpu.models.runner import run
 
 REPO = Path(__file__).resolve().parents[1]
+
+# Two-OS-process jax.distributed runs: minutes of subprocess spawns on a
+# capable runtime, and pure spawn overhead where the CPU backend lacks
+# multiprocess collectives — outside the tier-1 budget either way.
+pytestmark = pytest.mark.slow
+
+# Older jaxlib CPU clients have no cross-process collectives at all (no
+# gloo); the child dies with exactly this XLA error. An explicit skip gate
+# keeps the suite honest on such runtimes — any OTHER child failure still
+# fails the test.
+_NO_CPU_MULTIPROCESS = "aren't implemented on the CPU backend"
+
+
+def _skip_if_unsupported(logs: list[str]) -> None:
+    if any(_NO_CPU_MULTIPROCESS in log for log in logs):
+        pytest.skip(
+            "this jaxlib's CPU backend has no multiprocess collectives "
+            f"({_NO_CPU_MULTIPROCESS!r})"
+        )
 
 
 def _spawn(pid: int, port: int, args: list[str], jsonl: Path):
@@ -64,6 +85,7 @@ def test_two_process_sharded_matches_single_process(tmp_path):
     for pr in procs:
         out_bytes, _ = pr.communicate(timeout=300)
         logs.append(out_bytes.decode(errors="replace"))
+    _skip_if_unsupported(logs)
     assert all(pr.returncode == 0 for pr in procs), logs
 
     rec0 = json.loads(outs[0].read_text().splitlines()[-1])
@@ -82,6 +104,7 @@ def _run_pair(tmp_path, port, cli_args, expect_rc={0}, timeout=300):
     for pr in procs:
         out_bytes, _ = pr.communicate(timeout=timeout)
         logs.append(out_bytes.decode(errors="replace"))
+    _skip_if_unsupported(logs)
     assert all(pr.returncode in expect_rc for pr in procs), logs
     return json.loads(outs[0].read_text().splitlines()[-1])
 

@@ -188,6 +188,27 @@ def test_pool_fault_injection_conserves_mass():
     assert r.estimate_mae < 1e-2
 
 
+def test_pool_combined_drop_crash_conserves_mass():
+    # Drop gate + crash-stop churn together (ops/faults.py): dropped
+    # senders keep their full mass, dead nodes park delivered mass — the
+    # total over live + dead nodes never moves. float64 makes the halving
+    # and scatter-adds tight enough to pin <= 1 ulp of the initial totals.
+    import numpy as np
+
+    n = 1024
+    cfg = SimConfig(n=n, topology="full", algorithm="push-sum",
+                    delivery="pool", fault_rate=0.3, crash_schedule="4:200",
+                    quorum=0.9, max_rounds=8000, dtype="float64")
+    cap = {}
+    r = run(build_topology("full", n), cfg,
+            on_chunk=lambda rounds, st: cap.update(state=st))
+    assert r.converged and r.outcome == "converged"
+    st = cap["state"]
+    s0, w0 = n * (n - 1) / 2.0, float(n)
+    assert abs(np.asarray(st.s, np.float64).sum() - s0) <= np.spacing(s0)
+    assert abs(np.asarray(st.w, np.float64).sum() - w0) <= np.spacing(w0)
+
+
 def test_pool_rejected_for_reference_pushsum():
     cfg = SimConfig(n=64, topology="full", algorithm="push-sum",
                     semantics="reference", delivery="pool")

@@ -140,9 +140,16 @@ def test_nondivisible_population_requires_partitionable_threefry():
     import sys
 
     code = """
+import os
+# Virtual-device request via XLA_FLAGS (works on every JAX this repo
+# supports; the jax_num_cpu_devices config option is newer than some
+# runtimes — utils/compat.set_host_device_count).
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+).strip()
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
 jax.config.update("jax_threefry_partitionable", False)
 import sys
 sys.path.insert(0, {root!r})
