@@ -57,6 +57,8 @@ def main(argv=None) -> int:
     ap.add_argument("--delivery", default=None,
                     help="delivery override (default: pool on full, else auto)")
     ap.add_argument("--pool-size", type=int, default=2)
+    ap.add_argument("--no-compile-cache", action="store_true",
+                    help="skip the persistent XLA compilation cache")
     args = ap.parse_args(argv)
     if args.delivery is None:
         args.delivery = "pool" if args.topology == "full" else "auto"
@@ -65,6 +67,12 @@ def main(argv=None) -> int:
 
     if args.platform == "cpu":
         jax.config.update("jax_platforms", "cpu")
+    if not args.no_compile_cache:
+        from cop5615_gossip_protocol_tpu.utils.compat import (
+            enable_compilation_cache,
+        )
+
+        enable_compilation_cache()
 
     from cop5615_gossip_protocol_tpu import SimConfig, build_topology, run
 

@@ -181,6 +181,8 @@ def matched_run(
     algorithm: str,
     seed: int = 0,
     max_rounds: int = 1_000_000,
+    us_pairs: int = 3,
+    us_budgets: tuple[int, int] | None = None,
 ) -> MatchedRow:
     """Run both sides on one matched config and join the results."""
     from cop5615_gossip_protocol_tpu import SimConfig, build_topology, run
@@ -214,7 +216,10 @@ def matched_run(
     )
     topo = build_topology(kind, n, seed=seed, semantics="batched")
     result = run(topo, cfg)
-    us_round = engine_us_per_round(kind, algorithm, n, seed=seed)
+    r1, r2 = us_budgets if us_budgets is not None else (None, None)
+    us_round = engine_us_stats(
+        kind, algorithm, n, seed=seed, pairs=us_pairs, r1=r1, r2=r2
+    )["us_per_round"]
 
     return MatchedRow(
         n=n,

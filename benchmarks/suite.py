@@ -46,15 +46,26 @@ REFSIM_SCALE_CAP = 1_000_000
 # stencil/fused path, imp3d's random long-range edges force sort-based
 # scatter. Cube populations; push-sum only at 1M on the torus (a 100^3
 # torus mixes slowly: ~37k rounds).
-# (kind, n, algorithms, delivery, label-suffix)
+# (kind, n, algorithms, delivery, label-suffix, max_rounds or None=200k)
 GRID_SCALE = (
-    ("torus3d", 1_000_000, ("gossip", "push-sum"), "auto", ""),
-    ("torus3d", 8_000_000, ("gossip",), "auto", ""),
-    ("torus3d", 16_777_216, ("gossip",), "auto", ""),
+    ("torus3d", 1_000_000, ("gossip", "push-sum"), "auto", "", None),
+    ("torus3d", 8_000_000, ("gossip",), "auto", "", None),
+    ("torus3d", 16_777_216, ("gossip",), "auto", "", None),
     # Non-wrap lattice at HBM-streaming scale (VERDICT r3 #2b: boundary
     # masks + signed shifts in ops/fused_stencil_hbm.py).
-    ("grid2d", 8_000_000, ("gossip",), "auto", ""),
-    ("grid2d", 16_777_216, ("gossip",), "auto", ""),
+    ("grid2d", 8_000_000, ("gossip",), "auto", "", None),
+    ("grid2d", 16_777_216, ("gossip",), "auto", "", None),
+    # grid2d push-sum (VERDICT r5 #7 "missing" #3 — the last unbenched
+    # topology x algorithm cell): a 1000^2 non-wrap grid mixes over
+    # ~O(diameter^2) rounds, far beyond a table cell, so this is a
+    # bounded-round throughput sample like the 10M torus config.
+    ("grid2d", 1_000_000, ("push-sum",), "auto",
+     " (bounded 50,000 rounds)", 50_000),
+    # Chain-kind HBM-scale row (VERDICT r5 #7): ring at 2^24 exercises the
+    # stencil HBM tier's wrap columns on a degree-2 chain — information
+    # diffuses O(N) rounds on a chain, so bounded-round throughput sample.
+    ("ring", 16_777_216, ("gossip",), "auto",
+     " (bounded 2,000 rounds)", 2_000),
     # The reference's hardest config (Imp3D caps at 2000, report.pdf p.3),
     # both ways: the static random extra edge under sort-based scatter
     # (exact graph, addressing-bound — see the roofline section), and the
@@ -62,10 +73,13 @@ GRID_SCALE = (
     # fused engine) that puts imp3d at torus-class per-round cost — and
     # past the VMEM budget on the HBM-streaming imp tier (VERDICT r3 #2a,
     # ops/fused_imp_hbm.py).
-    ("imp3d", 1_000_000, ("gossip", "push-sum"), "scatter", " (static/scatter)"),
-    ("imp3d", 1_000_000, ("gossip", "push-sum"), "pool", " (pooled/fused)"),
-    ("imp3d", 8_000_000, ("gossip",), "pool", " (pooled/fused)"),
-    ("imp3d", 16_777_216, ("gossip", "push-sum"), "pool", " (pooled/fused)"),
+    ("imp3d", 1_000_000, ("gossip", "push-sum"), "scatter",
+     " (static/scatter)", None),
+    ("imp3d", 1_000_000, ("gossip", "push-sum"), "pool",
+     " (pooled/fused)", None),
+    ("imp3d", 8_000_000, ("gossip",), "pool", " (pooled/fused)", None),
+    ("imp3d", 16_777_216, ("gossip", "push-sum"), "pool",
+     " (pooled/fused)", None),
 )
 
 
@@ -85,20 +99,35 @@ def _fmt_us(x):
     return f"{x:,.2f}"
 
 
-def _table(rows: list[MatchedRow]) -> list[str]:
-    out = [
+def _table(rows: list[MatchedRow], sweeps=None) -> list[str]:
+    """Per-cell table; with ``sweeps`` (models/sweep.SweepResult per row,
+    the vmapped replica engine) two columns the reference never had:
+    rounds mean±CI95 over seeds, and the per-replica amortized wall."""
+    header = (
         "| #Nodes | Akka report (ms) | refsim native (ms) | gossip-tpu (ms) "
-        "| tpu rounds | engine µs/round | speedup vs Akka |",
-        "|---|---|---|---|---|---|---|",
-    ]
-    for r in rows:
+        "| tpu rounds | engine µs/round | speedup vs Akka |"
+    )
+    rule = "|---|---|---|---|---|---|---|"
+    if sweeps is not None:
+        header += " rounds mean±CI95 | sweep ms/replica |"
+        rule += "---|---|"
+    out = [header, rule]
+    for i, r in enumerate(rows):
         sp = r.speedup_vs_akka
-        out.append(
+        line = (
             f"| {r.n:,} | {_fmt(r.akka_report_ms)} | {_fmt(r.refsim_ms)} "
             f"| {_fmt(r.tpu_ms)} | {r.tpu_rounds:,} "
             f"| {_fmt_us(r.tpu_us_per_round)} "
             f"| {_fmt(sp, 1)}{'' if sp is None else 'x'} |"
         )
+        if sweeps is not None:
+            s = sweeps[i]
+            ci = "" if s.rounds_ci95 is None else f" ±{s.rounds_ci95:,.1f}"
+            line += (
+                f" {s.rounds_mean:,.1f}{ci} (R={s.replicas}) "
+                f"| {_fmt(s.wall_ms / s.replicas)} |"
+            )
+        out.append(line)
     return out
 
 
@@ -156,7 +185,23 @@ def _analysis(all_rows: dict, grid_n) -> list[str]:
     return out
 
 
-def generate(out_path: str, seed: int, grid_n, scale_n, platform_note: str) -> None:
+def _cell_sweep(n, topology, algorithm, seed, replicas):
+    """The 'benchmarks sweep' path: one vmapped dispatch runs all
+    ``replicas`` seeds of a grid cell (models/sweep.py buckets same-shape
+    cells by construction — a cell's seeds ARE its bucket)."""
+    from cop5615_gossip_protocol_tpu import SimConfig, build_topology
+    from cop5615_gossip_protocol_tpu.config import normalize_topology
+    from cop5615_gossip_protocol_tpu.models.sweep import run_replicas
+
+    kind = normalize_topology(topology, semantics="batched")
+    cfg = SimConfig(n=n, topology=kind, algorithm=algorithm, seed=seed)
+    topo = build_topology(kind, n, seed=seed, semantics="batched")
+    return run_replicas(topo, cfg, replicas, keep_states=False)
+
+
+def generate(out_path: str, seed: int, grid_n, scale_n, platform_note: str,
+             replicas: int = 0, us_pairs: int = 3,
+             us_budgets=None) -> None:
     lines = [
         "# BENCH_TABLES — old vs new on the reference's own grid",
         "",
@@ -200,6 +245,15 @@ def generate(out_path: str, seed: int, grid_n, scale_n, platform_note: str) -> N
         "benchmarks/baseline_data.py) — so that row's speedup inherits it.",
         "",
     ]
+    if replicas:
+        lines.append(
+            f"Replica-sweep columns: each cell additionally runs R="
+            f"{replicas} seeds in ONE vmapped chunked dispatch "
+            "(models/sweep.py; replica 0 = the tabulated run), reporting "
+            "rounds mean ±95% CI and the per-replica amortized wall — "
+            "dispatch/compile floors are paid once per cell, not per seed."
+        )
+        lines.append("")
     t_start = time.perf_counter()
     all_rows: dict[tuple[str, str], list[MatchedRow]] = {}
     for algo in ("gossip", "push-sum"):
@@ -207,8 +261,14 @@ def generate(out_path: str, seed: int, grid_n, scale_n, platform_note: str) -> N
         lines.append("")
         for topo in baseline_data.REF_TOPOLOGIES:
             rows = []
+            sweeps = [] if replicas else None
             for n in grid_n:
-                rows.append(matched_run(n, topo, algo, seed=seed))
+                rows.append(matched_run(
+                    n, topo, algo, seed=seed, us_pairs=us_pairs,
+                    us_budgets=us_budgets,
+                ))
+                if replicas:
+                    sweeps.append(_cell_sweep(n, topo, algo, seed, replicas))
                 print(
                     f"[suite] {algo}/{topo} N={n}: tpu {rows[-1].tpu_ms:.2f} ms "
                     f"({rows[-1].tpu_rounds} rounds), refsim {rows[-1].refsim_ms:.2f} ms",
@@ -217,7 +277,7 @@ def generate(out_path: str, seed: int, grid_n, scale_n, platform_note: str) -> N
             all_rows[(algo, topo)] = rows
             lines.append(f"### {topo}")
             lines.append("")
-            lines.extend(_table(rows))
+            lines.extend(_table(rows, sweeps))
             lines.append("")
         lines.append("")
 
@@ -285,11 +345,11 @@ def generate(out_path: str, seed: int, grid_n, scale_n, platform_note: str) -> N
         lines.append("|---|---|---|---|---|")
         from cop5615_gossip_protocol_tpu import SimConfig, build_topology, run
 
-        for kind, n, algos, delivery, label in GRID_SCALE:
+        for kind, n, algos, delivery, label, cap in GRID_SCALE:
             topo = build_topology(kind, n, seed=seed)  # shared across algos
             for algo in algos:
                 cfg = SimConfig(n=n, topology=kind, algorithm=algo,
-                                seed=seed, max_rounds=200_000,
+                                seed=seed, max_rounds=cap or 200_000,
                                 delivery=delivery)
                 res = run(topo, cfg)
                 lines.append(
@@ -313,6 +373,15 @@ def generate(out_path: str, seed: int, grid_n, scale_n, platform_note: str) -> N
 
         lines.extend(roofline_section())
         lines.extend(_termination_section(seed))
+
+    if scale_n:
+        # Dispatch-floor metrology (benchmarks/microbench.py): itemize the
+        # per-run overhead the small-N reading note describes instead of
+        # leaving it folded into the wall columns.
+        from benchmarks.microbench import collect as micro_collect
+        from benchmarks.microbench import section as micro_section
+
+        lines.extend(micro_section(micro_collect()))
 
     lines.append(
         f"_Suite wall time: {time.perf_counter() - t_start:.0f} s._"
@@ -448,23 +517,49 @@ def main(argv=None) -> int:
     ap.add_argument("--platform", choices=["auto", "cpu"], default="auto")
     ap.add_argument("--quick", action="store_true",
                     help="N<=200 cells only (CI smoke; full grid ~minutes)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="smallest-N cells with truncated differential "
+                    "budgets — exercises the whole code path in ~a minute "
+                    "(the CI bench-smoke job)")
     ap.add_argument("--no-scale", action="store_true",
                     help="skip the beyond-reference scale rows")
+    ap.add_argument("--replicas", type=int, default=0,
+                    help="add vmapped replica-sweep columns (rounds "
+                    "mean±CI95 over R seeds per cell, one dispatch per "
+                    "cell; models/sweep.py)")
+    ap.add_argument("--no-compile-cache", action="store_true",
+                    help="skip the persistent XLA compilation cache "
+                    "(enabled by default so repeated suite runs stop "
+                    "re-paying compile)")
     args = ap.parse_args(argv)
 
-    if args.platform == "cpu":
-        import jax
+    import jax
 
+    if args.platform == "cpu":
         jax.config.update("jax_platforms", "cpu")
         platform_note = "CPU (forced)"
     else:
-        import jax
-
         platform_note = jax.devices()[0].platform
-    grid_n = tuple(n for n in baseline_data.GRID_N if n <= 200) if args.quick \
-        else baseline_data.GRID_N
-    scale_n = () if (args.no_scale or args.quick) else SCALE_N
-    generate(args.out, args.seed, grid_n, scale_n, platform_note)
+    if not args.no_compile_cache:
+        from cop5615_gossip_protocol_tpu.utils.compat import (
+            enable_compilation_cache,
+        )
+
+        print(f"[suite] compile cache: {enable_compilation_cache()}",
+              flush=True)
+    if args.smoke:
+        grid_n = (min(baseline_data.GRID_N),)
+    elif args.quick:
+        grid_n = tuple(n for n in baseline_data.GRID_N if n <= 200)
+    else:
+        grid_n = baseline_data.GRID_N
+    scale_n = () if (args.no_scale or args.quick or args.smoke) else SCALE_N
+    generate(
+        args.out, args.seed, grid_n, scale_n, platform_note,
+        replicas=args.replicas,
+        us_pairs=1 if args.smoke else 3,
+        us_budgets=(16, 128) if args.smoke else None,
+    )
     return 0
 
 

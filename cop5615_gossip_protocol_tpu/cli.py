@@ -72,6 +72,18 @@ def build_parser() -> argparse.ArgumentParser:
                    "is <= delta (the honest global-residual criterion)")
     p.add_argument("--max-rounds", type=int, default=1_000_000)
     p.add_argument("--chunk-rounds", type=int, default=4096)
+    p.add_argument("--pipeline-chunks", type=int, default=2,
+                   help="speculative chunk pipelining depth: how many jit'd "
+                   "chunks the host keeps in flight (chunk k+1 dispatches "
+                   "before chunk k's predicate is read, hiding the "
+                   "per-dispatch launch floor; 1 = serial loop; bitwise-"
+                   "neutral by the overshoot contract, models/pipeline.py)")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="run this many replicas (distinct per-replica key "
+                   "streams, replica 0 = the unbatched run) of the "
+                   "configuration in ONE vmapped chunked program and report "
+                   "per-replica + mean/CI95 statistics (models/sweep.py); "
+                   "chunked engines only")
     p.add_argument("--target-frac", type=float, default=None)
     p.add_argument("--suppress", choices=["auto", "on", "off"], default="auto",
                    help="suppress gossip sends to converged targets (auto: on in reference semantics)")
@@ -120,6 +132,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="shard the node dimension over this many devices")
     p.add_argument("--platform", choices=["auto", "cpu", "tpu"], default="auto",
                    help="force a JAX platform (cpu useful for dev boxes)")
+    p.add_argument("--compile-cache", type=str, default=None, metavar="DIR",
+                   help="enable XLA's persistent compilation cache at DIR "
+                   "('auto' = ~/.cache/gossip_tpu_xla or "
+                   "$GOSSIP_TPU_COMPILE_CACHE) so repeated runs stop "
+                   "re-paying compile")
     p.add_argument("--x64", action="store_true", help="enable float64 support")
     p.add_argument("--distributed", action="store_true",
                    help="call jax.distributed.initialize for multi-host meshes "
@@ -186,6 +203,9 @@ def _main_refsim(args, parser) -> int:
         "--termination": changed("termination"),
         "--max-rounds": changed("max_rounds"),
         "--chunk-rounds": changed("chunk_rounds"),
+        "--pipeline-chunks": changed("pipeline_chunks"),
+        "--replicas": changed("replicas"),
+        "--compile-cache": changed("compile_cache"),
         "--target-frac": changed("target_frac"),
         "--suppress": changed("suppress"),
         "--fault-rate": changed("fault_rate"),
@@ -296,6 +316,12 @@ def main(argv: Optional[list[str]] = None) -> int:
 
     if args.platform != "auto":
         jax.config.update("jax_platforms", args.platform)
+    if args.compile_cache is not None:
+        from .utils.compat import enable_compilation_cache
+
+        enable_compilation_cache(
+            None if args.compile_cache == "auto" else args.compile_cache
+        )
     if args.num_processes and args.devices and args.devices % args.num_processes:
         print(
             f"Invalid: --devices {args.devices} (global mesh size) must be "
@@ -350,6 +376,7 @@ def main(argv: Optional[list[str]] = None) -> int:
             termination=args.termination,
             max_rounds=args.max_rounds,
             chunk_rounds=args.chunk_rounds,
+            pipeline_chunks=args.pipeline_chunks,
             target_frac=args.target_frac,
             suppress_converged=None if args.suppress == "auto" else args.suppress == "on",
             fault_rate=args.fault_rate,
@@ -379,6 +406,52 @@ def main(argv: Optional[list[str]] = None) -> int:
     t0 = time.perf_counter()
     topo = build_topology(kind, args.numNodes, seed=args.seed, semantics=args.semantics)
     build_s = time.perf_counter() - t0
+
+    if args.replicas > 1:
+        # Vmapped replica sweep (models/sweep.py): one chunked program runs
+        # all replicas; chunk-boundary hooks are per-run features.
+        for flag, set_ in (
+            ("--checkpoint", args.checkpoint),
+            ("--resume", args.resume),
+            ("--trace-convergence", args.trace_convergence),
+        ):
+            if set_:
+                print(
+                    f"Invalid: {flag} does not apply to --replicas sweeps "
+                    "(chunk-boundary hooks are per-run; run replicas "
+                    "unbatched to checkpoint/trace them)",
+                    file=sys.stderr,
+                )
+                return 2
+        from .models.sweep import run_replicas
+
+        try:
+            sres = run_replicas(topo, cfg, args.replicas, keep_states=False)
+        except (ValueError, NotImplementedError) as e:
+            print(f"Invalid: {e}", file=sys.stderr)
+            return 2
+        record = sres.to_record()
+        record["config"] = {
+            "n": cfg.n, "topology": cfg.topology,
+            "algorithm": cfg.algorithm, "seed": cfg.seed,
+        }
+        record["build_s"] = build_s
+        if jax.process_index() == 0:
+            ci = (
+                f" ±{sres.rounds_ci95:.1f}" if sres.rounds_ci95 is not None
+                else ""
+            )
+            print(
+                f"{args.replicas} replicas: rounds mean "
+                f"{sres.rounds_mean:.1f}{ci} (95% CI), wall "
+                f"{sres.wall_ms:.2f} ms total "
+                f"({sres.wall_ms / args.replicas:.2f} ms/replica)"
+            )
+        if not args.quiet:
+            print(json.dumps(record))
+        if args.jsonl and jax.process_index() == 0:
+            metrics.append_jsonl(args.jsonl, record)
+        return 0 if sres.all_converged else 1
 
     hooks = []
     trace_prev = {"conv": 0}
@@ -494,7 +567,8 @@ def main(argv: Optional[list[str]] = None) -> int:
         # Resume is only bitwise-faithful if every stream-relevant knob
         # matches the original run; loop-control knobs may differ.
         loop_knobs = {"max_rounds": cfg.max_rounds, "chunk_rounds": cfg.chunk_rounds,
-                      "n_devices": cfg.n_devices}
+                      "n_devices": cfg.n_devices,
+                      "pipeline_chunks": cfg.pipeline_chunks}
         if dataclasses.replace(saved_cfg, **loop_knobs) != cfg:
             print(
                 "Invalid: checkpoint config mismatch — resume requires the "

@@ -120,6 +120,15 @@ class SimConfig:
     max_rounds: int = 1_000_000
     chunk_rounds: int = 4096  # rounds per jit'd while_loop chunk (checkpoint/metrics cadence)
 
+    # Speculative chunk pipelining depth (models/pipeline.py): how many
+    # chunks the host keeps in flight — chunk k+1 is dispatched before
+    # chunk k's termination predicate is read, hiding the per-dispatch
+    # launch floor under compute. 1 = the serial loop. Bitwise-neutral by
+    # the overshoot contract (a chunk dispatched past termination is a
+    # no-op), pinned per engine by tests/test_pipeline.py; a loop-control
+    # knob like chunk_rounds, so resume accepts a changed value.
+    pipeline_chunks: int = 2
+
     # Fraction of population that must converge. None → 1.0 in batched mode;
     # in reference semantics the builder's target_count (N of N+1, Q1) rules.
     target_frac: float | None = None
@@ -284,6 +293,13 @@ class SimConfig:
             raise ValueError("max_rounds must be in [1, 2**30]")
         if self.chunk_rounds < 1:
             raise ValueError("chunk_rounds must be >= 1")
+        if not (1 <= self.pipeline_chunks <= 64):
+            raise ValueError(
+                f"pipeline_chunks must be in [1, 64], got "
+                f"{self.pipeline_chunks} (each in-flight chunk holds live "
+                "round state; depth beyond a few buys nothing past the "
+                "dispatch floor)"
+            )
         if self.delivery not in ("auto", "scatter", "stencil", "pool"):
             raise ValueError(
                 f"unknown delivery {self.delivery!r}; "

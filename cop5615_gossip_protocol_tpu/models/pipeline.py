@@ -1,0 +1,168 @@
+"""Speculative chunk pipelining — the shared host-side chunk-loop driver.
+
+Every execution engine runs rounds in jit'd chunks, and before this module
+each paid a blocking host sync per chunk: dispatch chunk k, read its round
+counter, decide, dispatch chunk k+1. On the remote-tunnel TPU every one of
+those syncs costs a full dispatch floor (~110-140 ms measured,
+BENCH_TABLES.md "dispatch floor"), so a multi-chunk run paid
+chunks x floor in series instead of hiding the floor under compute.
+
+This driver keeps ``cfg.pipeline_chunks`` chunks in flight: chunk k+1 is
+dispatched BEFORE chunk k's termination predicate is read, and the
+predicate scalars are fetched asynchronously so the retire-side block is
+one transfer, not a round trip per scalar. Correctness hinges on the
+overshoot contract every chunk function must satisfy (pinned per engine by
+tests/test_pipeline.py): dispatched at an already-terminal carry, a chunk
+is a bitwise NO-OP — protocol state unchanged, round counter unchanged.
+The XLA engines get this from the ``~done`` guard in their while_loop
+predicate; the fused Pallas kernels seed their in-kernel done flag from
+the incoming conv plane (the same property checkpoint resume already
+relies on). Because overshoot is free, the reported ``rounds`` stays
+EXACT — it is the retired carry's own counter, never rounded up to the
+pipeline depth.
+
+Chunk-boundary side effects keep their serial semantics:
+
+- ``on_retire`` (the checkpoint/metrics hook) fires at RETIRED chunks, in
+  order, with that chunk's state — never for an in-flight speculative
+  chunk — so a checkpoint written at boundary k is exactly the serial
+  loop's boundary-k checkpoint.
+- ``should_stop`` (the stall watchdog) is consulted at retired boundaries
+  in order. When it fires at chunk k, the in-flight speculative chunks
+  are DISCARDED: the run's result is carry k, bitwise the serial loop's —
+  the speculative compute past a stall is wasted, not observed.
+- Both callbacks read retired state, which is incompatible with buffer
+  donation (a donated carry's buffers die when the next chunk consumes
+  them); engines therefore donate only on hook-free runs. ``run_chunks``
+  enforces the invariant.
+
+Buffer donation: with ``donate=True`` the engine's ``dispatch`` consumes
+its state argument (``jax.jit(..., donate_argnums=(0,))``), so
+steady-state chunks alias their output planes onto the input's buffers and
+copy nothing. The round/done scalars ride OUTSIDE the donated argument —
+they stay readable after the state buffers are reused, which is what lets
+the driver retire chunk k while chunk k+1 already owns its memory. On a
+done/max_rounds exit the newest in-flight carry is returned (its buffers
+are the only live ones); the overshoot contract makes it bitwise the
+retired carry.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+from typing import Callable, Optional
+
+
+def _prefetch(x) -> None:
+    """Start the device->host copy of a predicate scalar without blocking —
+    by retire time the value is usually already resident."""
+    fn = getattr(x, "copy_to_host_async", None)
+    if fn is not None:
+        try:
+            fn()
+        except Exception:  # noqa: BLE001 — a failed hint must never kill a run
+            pass
+
+
+@dataclasses.dataclass
+class ChunkLoopResult:
+    """Outcome of one pipelined chunk loop."""
+
+    state: object  # final carry state (live buffers, donate-safe)
+    rounds: int  # exact executed-round count (the retired carry's counter)
+    done: bool  # the engine's own termination flag at the final boundary
+    chunks_retired: int  # boundaries observed (serial-equivalent count)
+    chunks_speculative: int  # dispatched-then-discarded chunks (stall exits)
+
+
+def run_chunks(
+    *,
+    dispatch: Callable,
+    state0,
+    rnd0,
+    done0,
+    start_round: int,
+    max_rounds: int,
+    stride: int,
+    depth: int,
+    donate: bool = False,
+    on_retire: Optional[Callable[[int, object], None]] = None,
+    should_stop: Optional[Callable[[int, object], bool]] = None,
+) -> ChunkLoopResult:
+    """Drive ``dispatch(state, rnd, done, round_end) -> (state, rnd, done)``
+    to termination with up to ``depth`` chunks in flight.
+
+    ``dispatch`` is the engine's jitted chunk: it advances up to
+    ``round_end`` (absolute round index), early-exits on its own
+    termination predicate, and must be an overshoot no-op (see module
+    docstring). ``rnd``/``done`` are device scalars returned fresh each
+    call — with ``donate=True`` only the state argument is donated, so
+    they remain readable after the state's buffers are recycled.
+
+    ``stride`` is the engine's natural chunk length in rounds: a chunk
+    dispatched at boundary k targets ``min(start + (k+1)*stride,
+    max_rounds)`` — the identical schedule the serial loop produces,
+    because a non-terminal chunk always runs to its round_end exactly.
+    """
+    depth = max(1, int(depth))
+    if donate and (on_retire is not None or should_stop is not None):
+        raise ValueError(
+            "buffer donation recycles retired chunk state; chunk-boundary "
+            "hooks (checkpoint/trace/watchdog) require donate=False"
+        )
+
+    inflight: collections.deque = collections.deque()
+    head = (state0, rnd0, done0)  # newest dispatched carry
+    last_end = start_round
+    retired_count = 0
+
+    def fill() -> None:
+        """Top the pipeline up. Chunks whose round_end would not advance
+        past max_rounds are guaranteed no-ops and are never dispatched —
+        except the very first chunk, which the serial loops also issue
+        (a resume at max_rounds still observes one boundary)."""
+        nonlocal head, last_end
+        while len(inflight) < depth and (
+            last_end < max_rounds or (not inflight and retired_count == 0)
+        ):
+            last_end = min(last_end + stride, max_rounds)
+            state, rnd, done = dispatch(head[0], head[1], head[2], last_end)
+            _prefetch(rnd)
+            _prefetch(done)
+            head = (state, rnd, done)
+            inflight.append(head)
+
+    fill()  # dispatches at least one chunk, so the retire loop runs
+    final = head
+    rounds = start_round
+    done_b = False
+    while inflight:
+        cur = inflight.popleft()
+        rounds = int(cur[1])  # blocks until chunk k completes
+        done_b = bool(cur[2])
+        retired_count += 1
+        if on_retire is not None:
+            on_retire(rounds, cur[0])
+        if done_b or rounds >= max_rounds:
+            # Overshoot chunks are bitwise no-ops, so the newest carry IS
+            # this one — and under donation it is the one with live buffers.
+            final = head if donate else cur
+            inflight.clear()
+            break
+        if should_stop is not None and should_stop(rounds, cur[0]):
+            # Serial semantics: the run ends AT this boundary. In-flight
+            # speculative chunks executed real rounds past the stall —
+            # discard them unobserved (donate=False here by construction).
+            final = cur
+            return ChunkLoopResult(
+                state=final[0], rounds=rounds, done=done_b,
+                chunks_retired=retired_count,
+                chunks_speculative=len(inflight),
+            )
+        final = cur
+        fill()
+    return ChunkLoopResult(
+        state=final[0], rounds=rounds, done=done_b,
+        chunks_retired=retired_count, chunks_speculative=0,
+    )

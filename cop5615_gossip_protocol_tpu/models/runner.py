@@ -32,6 +32,7 @@ from ..ops import faults as faults_mod
 from ..ops import sampling
 from ..ops.topology import Topology, imp_split, stencil_offsets
 from . import gossip as gossip_mod
+from . import pipeline as pipeline_mod
 from . import pushsum as pushsum_mod
 
 # fold_in tag for the leader draw. Round keys are fold_in(base, round) with
@@ -685,6 +686,15 @@ def _run_fused(
     consumes per-round choice keys."""
     from ..ops import fused
 
+    if start_state is not None:
+        # COPY the resume state: the padding/astype transforms below are
+        # identities on already-aligned float32 arrays, and under buffer
+        # donation the first chunk would otherwise consume the CALLER's
+        # arrays (models/runner.run applies the same rule).
+        start_state = jax.tree.map(
+            lambda x: jnp.array(x, copy=True), start_state
+        )
+
     target = cfg.resolved_target_count(topo.n, topo.target_count)
 
     def extra_args(start, count):
@@ -781,7 +791,7 @@ def _run_fused(
 
     K = cfg.chunk_rounds
 
-    def chunk_call(state_dev, start, cap):
+    def chunk_call(state_dev, rnd, done, cap):
         # Keys/offsets are derived INSIDE the jit: per-chunk eager fold_in
         # vmaps cost ~120 ms/chunk over the remote tunnel. The base key is
         # deliberately CLOSED OVER (a baked constant): this loop is
@@ -789,57 +799,81 @@ def _run_fused(
         # argument instead costs a consistent ~30 ms per dispatch on the
         # axon tunnel (measured on the 1M-node flagship chunk, ~140 ms
         # baked vs ~170 ms as argument).
-        keys = fused.round_keys(key, start, K)
-        return chunk_fn(state_dev, keys, *extra_args(start, K), start, cap)
+        keys = fused.round_keys(key, rnd, K)
+        new_state, executed = chunk_fn(
+            state_dev, keys, *extra_args(rnd, K), rnd, cap
+        )
+        # Early exit (executed short of this chunk's budget) means the
+        # kernel's own termination predicate fired; latching it into a
+        # carried done flag makes an overshoot dispatch observable as a
+        # no-op (executed == 0, the kernel seeds done from the incoming
+        # conv plane) — the contract the pipelined driver relies on.
+        expected = jnp.minimum(jnp.int32(K), jnp.maximum(cap - rnd, 0))
+        return new_state, rnd + executed, done | (executed < expected)
 
-    chunk_j = jax.jit(chunk_call)
+    # Donation aliases each chunk's output planes onto its input's buffers
+    # (zero steady-state copies) — legal only when nothing reads retired
+    # state: chunk hooks and the watchdog do (models/pipeline.py).
+    donate = on_chunk is None and not cfg.stall_chunks
+    chunk_j = jax.jit(chunk_call, donate_argnums=(0,) if donate else ())
 
+    rnd0 = jnp.int32(start_round)
+    done0_dev = jnp.bool_(False)
     t0 = time.perf_counter()
     # Warmup executes ONE real round and discards the result (state_dev is
-    # untouched; round keys are absolute, so the main loop recomputes the
-    # same round 0 identically). A zero-round warmup (cap == start) would
-    # leave the kernel's active path unexercised, and the axon tunnel defers
-    # a ~1 s one-time cost to the first execution that reaches it — which
-    # would land inside the timed run loop instead of here.
+    # untouched — under donation the warmup consumes a copy; round keys are
+    # absolute, so the main loop recomputes the same round 0 identically).
+    # A zero-round warmup (cap == start) would leave the kernel's active
+    # path unexercised, and the axon tunnel defers a ~1 s one-time cost to
+    # the first execution that reaches it — which would land inside the
+    # timed run loop instead of here.
     warm = chunk_j(
-        state_dev, jnp.int32(start_round),
+        jax.tree.map(jnp.copy, state_dev) if donate else state_dev,
+        rnd0, done0_dev,
         jnp.int32(min(start_round + 1, cfg.max_rounds)),
     )
     int(warm[1])  # sync via data-dependent output (block_until_ready can
     del warm      # return early over the tunnel)
     compile_s = time.perf_counter() - t0
 
-    rounds = start_round
     watchdog = StallWatchdog(cfg.stall_chunks)
     death_np = faults_mod.death_plane(cfg, topo.n)
     death_dev = None if death_np is None else jnp.asarray(death_np)
-    t1 = time.perf_counter()
-    while True:
-        state_dev, executed = chunk_j(
-            state_dev, jnp.int32(rounds), jnp.int32(cfg.max_rounds)
-        )
-        executed = int(executed)  # host sync at the chunk boundary
-        rounds += executed
-        if on_chunk is not None:
-            on_chunk(rounds, to_canonical(state_dev))
-        if executed < K or rounds >= cfg.max_rounds:
-            break
-        # Watchdog: the kernel executes full chunks while unconverged, so a
-        # stalled topology would otherwise spin to max_rounds. Canonical
-        # state, not the raw planes — pool2 packs term+conv in one plane.
-        if cfg.stall_chunks and watchdog.no_progress(
-            _progress_gap(
-                death_dev, cfg.quorum, target,
-                to_canonical(state_dev).conv, rounds,
+
+    def dispatch(state, rnd, done, round_end):
+        return chunk_j(state, rnd, done, jnp.int32(round_end))
+
+    on_retire = None
+    if on_chunk is not None:
+        def on_retire(rounds, state):
+            on_chunk(rounds, to_canonical(state))
+
+    should_stop = None
+    if cfg.stall_chunks:
+        # The kernel executes full chunks while unconverged, so a stalled
+        # topology would otherwise spin to max_rounds. Canonical state,
+        # not the raw planes — pool2 packs term+conv in one plane.
+        def should_stop(rounds, state):
+            return watchdog.no_progress(
+                _progress_gap(
+                    death_dev, cfg.quorum, target,
+                    to_canonical(state).conv, rounds,
+                )
             )
-        ):
-            break
+
+    t1 = time.perf_counter()
+    loop = pipeline_mod.run_chunks(
+        dispatch=dispatch, state0=state_dev, rnd0=rnd0, done0=done0_dev,
+        start_round=start_round, max_rounds=cfg.max_rounds, stride=K,
+        depth=cfg.pipeline_chunks, donate=donate,
+        on_retire=on_retire, should_stop=should_stop,
+    )
     run_s = time.perf_counter() - t1
 
-    final = to_canonical(state_dev)
-    done = _host_done(cfg, death_np, final, rounds, target)
+    final = to_canonical(loop.state)
+    done = _host_done(cfg, death_np, final, loop.rounds, target)
     return _finalize_result(
-        topo, cfg, final, rounds, target, compile_s, run_s,
+        topo, cfg, final, loop.rounds, target, compile_s, run_s,
         done=done, stalled=watchdog.stalled,
     )
 
@@ -1059,7 +1093,11 @@ def run(
                 "delivery ring is not checkpointed, so the resumed "
                 "trajectory could not be bitwise-faithful"
             )
-        state0 = jax.tree.map(jnp.asarray, start_state)
+        # COPY, not asarray: on jax-array inputs asarray is identity, and
+        # under buffer donation the first chunk would consume the CALLER's
+        # arrays (resume callers — cli --resume, hooks capturing state —
+        # still hold references).
+        state0 = jax.tree.map(lambda x: jnp.array(x, copy=True), start_state)
         # Seed the loop predicate from the resumed state: a checkpoint taken
         # at/after convergence must execute ZERO further rounds, matching the
         # fused kernels (which seed their done flag from the incoming conv
@@ -1067,59 +1105,78 @@ def run(
         # Same predicate the original run evaluated after its last round.
         done0 = _host_done(cfg, death_np, state0, start_round, target)
 
-    def chunk(carry, round_end, key_data, *targs):
+    def chunk(state, rnd, done, round_end, key_data, *targs):
         def cond(c):
-            _, rnd, done = c
-            return jnp.logical_and(~done, rnd < round_end)
+            _, r, d = c
+            return jnp.logical_and(~d, r < round_end)
 
         def body(c):
-            state, rnd, _ = c
-            state = round_fn(state, rnd, key_data, *targs)
-            done = done_fn(proto_of(state), rnd)
-            return (state, rnd + 1, done)
+            s, r, _ = c
+            s = round_fn(s, r, key_data, *targs)
+            d = done_fn(proto_of(s), r)
+            return (s, r + 1, d)
 
-        return lax.while_loop(cond, body, carry)
+        return lax.while_loop(cond, body, (state, rnd, done))
 
-    chunk_j = jax.jit(chunk)
-    carry = (state0, jnp.int32(start_round), jnp.bool_(done0))
+    # Donation: steady-state chunks alias their output state onto the input
+    # buffers (zero copies). Off when retired state must stay readable —
+    # chunk hooks and the stall watchdog (models/pipeline.py docstring).
+    donate = on_chunk is None and not cfg.stall_chunks
+    chunk_j = jax.jit(chunk, donate_argnums=(0,) if donate else ())
+    rnd0 = jnp.int32(start_round)
+    done0_dev = jnp.bool_(done0)
 
     t0 = time.perf_counter()
     # Warmup runs ONE real round and DISCARDS the result — the timed loop
-    # recomputes round 0 from the original carry on the same absolute-round
+    # recomputes round 0 from the original state on the same absolute-round
     # key stream, so run_s covers every round that `rounds` counts (same
-    # accounting rule as _run_fused). A zero-round warmup would leave the
-    # while body unexecuted, and the axon tunnel defers a one-time cost to
-    # the first execution that reaches it — which would land inside the
-    # timed loop. Clamped so max_rounds still bounds the trajectory.
+    # accounting rule as _run_fused). Under donation the warmup consumes a
+    # COPY so state0 stays live for the timed loop. A zero-round warmup
+    # would leave the while body unexecuted, and the axon tunnel defers a
+    # one-time cost to the first execution that reaches it — which would
+    # land inside the timed loop. Clamped so max_rounds still bounds the
+    # trajectory.
     warm = chunk_j(
-        carry, jnp.int32(min(start_round + 1, cfg.max_rounds)),
+        jax.tree.map(jnp.copy, state0) if donate else state0,
+        rnd0, done0_dev, jnp.int32(min(start_round + 1, cfg.max_rounds)),
         key_data, *topo_args,
     )
     int(warm[1])  # data-dependent sync; block_until_ready can return early
     del warm
     compile_s = time.perf_counter() - t0
 
-    rounds = start_round
     watchdog = StallWatchdog(cfg.stall_chunks)
+
+    def dispatch(state, rnd, done, round_end):
+        return chunk_j(
+            state, rnd, done, jnp.int32(round_end), key_data, *topo_args
+        )
+
+    on_retire = None
+    if on_chunk is not None:
+        def on_retire(rounds, state):
+            on_chunk(rounds, proto_of(state))
+
+    should_stop = None
+    if cfg.stall_chunks:
+        def should_stop(rounds, state):
+            return watchdog.no_progress(
+                _progress_gap(
+                    death_dev, cfg.quorum, target,
+                    proto_of(state).conv, rounds,
+                )
+            )
+
     t1 = time.perf_counter()
-    while True:
-        round_end = min(rounds + cfg.chunk_rounds, cfg.max_rounds)
-        carry = chunk_j(carry, jnp.int32(round_end), key_data, *topo_args)
-        state, rnd, done = carry
-        rounds = int(rnd)  # forces a host sync at the chunk boundary
-        proto = proto_of(state)
-        if on_chunk is not None:
-            on_chunk(rounds, proto)
-        if bool(done) or rounds >= cfg.max_rounds:
-            break
-        if cfg.stall_chunks and watchdog.no_progress(
-            _progress_gap(death_dev, cfg.quorum, target, proto.conv, rounds)
-        ):
-            break
+    loop = pipeline_mod.run_chunks(
+        dispatch=dispatch, state0=state0, rnd0=rnd0, done0=done0_dev,
+        start_round=start_round, max_rounds=cfg.max_rounds,
+        stride=cfg.chunk_rounds, depth=cfg.pipeline_chunks, donate=donate,
+        on_retire=on_retire, should_stop=should_stop,
+    )
     run_s = time.perf_counter() - t1
 
-    state, _, done = carry
     return _finalize_result(
-        topo, cfg, proto_of(state), rounds, target, compile_s, run_s,
-        done=bool(done), stalled=watchdog.stalled,
+        topo, cfg, proto_of(loop.state), loop.rounds, target,
+        compile_s, run_s, done=loop.done, stalled=watchdog.stalled,
     )

@@ -1,0 +1,294 @@
+"""Vmapped replica sweep — many independent simulations in one device program.
+
+Every small-N cell of the reference grid pays the same per-run floor
+(dispatch plumbing + compile + per-chunk sync) regardless of how little it
+computes, so R independent runs cost R floors. This engine batches R
+replicas of one configuration — same (n, topology, algorithm), different
+seeds — into ONE chunked program by vmapping the pure-JAX round loop over
+the replica axis: the whole sweep pays one compile and one dispatch floor
+per chunk, the trick that made TPU Monte-Carlo simulation viable (Ising on
+TPU clusters, PAPERS.md). Grid cells with the same shape bucket the same
+way: a cell's R seeds ARE its bucket.
+
+Per-replica keys (the fold_in tag space, shared with models/runner.py and
+ops/faults.py):
+
+- replica 0 uses the run's base key UNCHANGED, so replica 0's trajectory
+  is bitwise the unbatched run's with the same seed (pinned by
+  tests/test_sweep.py);
+- replica r > 0 uses ``fold_in(base_key, REPLICA_TAG0 + r)``. Base-key
+  fold_in consumers are round indices (< 2**30 — the SimConfig max_rounds
+  cap exists to keep this region closed), CRASH_TAG (2**30 + 0xDEAD) and
+  _LEADER_TAG (2**31 - 1); REPLICA_TAG0 = 2**30 + 2**29 opens a region
+  disjoint from all three for r < 2**29 - 0xDEAD... — MAX_REPLICAS (4096)
+  keeps it far inside.
+
+The crash plane (ops/faults.death_plane) is a pure function of the CONFIG
+— ``PRNGKey(cfg.seed) + CRASH_TAG`` — so all replicas share one death
+plane by construction; replicas vary the message/partner streams (and the
+gossip leader), not the churn. This keeps every engine's "rebuild the
+plane from cfg alone" contract intact.
+
+Freezing: ``jax.vmap`` of ``lax.while_loop`` runs the body while ANY
+replica's predicate holds and select-masks finished replicas' carries, so
+a converged replica's state and round counter stay bitwise frozen while
+its batch-mates continue — no per-replica masking code needed, and the
+reported per-replica ``rounds`` stay exact.
+
+The fused Pallas tiers do not grow a batch dimension: the sweep always
+drives the chunked XLA engines (the existing plan/tiering gate in
+models/runner.run is simply never consulted), and engine='fused' is
+rejected loudly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..config import SimConfig
+from ..ops.topology import Topology
+from .runner import (
+    _death_dev,
+    _done_predicate,
+    make_round_fn,
+)
+
+# First replica tag. Sits above the round-index region (< 2**30) and
+# CRASH_TAG (2**30 + 0xDEAD), below _LEADER_TAG (2**31 - 1); replica 0
+# deliberately has NO tag — it rides the base key itself.
+REPLICA_TAG0 = 2**30 + 2**29
+
+MAX_REPLICAS = 4096
+
+
+def replica_keys(base_key: jax.Array, replicas: int) -> list:
+    """Per-replica base keys. Replica 0 IS base_key (bitwise contract with
+    the unbatched run); replica r > 0 folds REPLICA_TAG0 + r."""
+    if not (1 <= replicas <= MAX_REPLICAS):
+        raise ValueError(
+            f"replicas must be in [1, {MAX_REPLICAS}], got {replicas}"
+        )
+    return [base_key] + [
+        jax.random.fold_in(base_key, REPLICA_TAG0 + r)
+        for r in range(1, replicas)
+    ]
+
+
+def _mean_ci95(values) -> tuple[Optional[float], Optional[float]]:
+    """(mean, half-width of the normal-approximation 95% CI), None mean on
+    empty input, None CI below two samples."""
+    vals = [float(v) for v in values if v is not None]
+    if not vals:
+        return None, None
+    mean = sum(vals) / len(vals)
+    if len(vals) < 2:
+        return mean, None
+    var = sum((v - mean) ** 2 for v in vals) / (len(vals) - 1)
+    return mean, 1.96 * math.sqrt(var / len(vals))
+
+
+@dataclasses.dataclass
+class SweepResult:
+    """Aggregate of one vmapped replica sweep (one configuration, R seeds).
+
+    ``rounds``/``converged``/``outcome`` are per-replica (replica 0 first —
+    bitwise the unbatched run). ``final_states`` holds each replica's
+    canonical protocol state for parity checks; it is excluded from
+    ``to_record`` (it is data, not a measurement)."""
+
+    algorithm: str
+    topology: str
+    semantics: str
+    n_requested: int
+    population: int
+    target_count: int
+    replicas: int
+    rounds: list
+    converged: list
+    outcome: list
+    compile_s: float
+    run_s: float
+    rounds_mean: Optional[float] = None
+    rounds_ci95: Optional[float] = None
+    estimate_mae: Optional[list] = None  # push-sum only, per replica
+    estimate_mae_mean: Optional[float] = None
+    estimate_mae_ci95: Optional[float] = None
+    true_mean: Optional[float] = None
+    final_states: Optional[list] = None
+
+    @property
+    def wall_ms(self) -> float:
+        return self.run_s * 1e3
+
+    @property
+    def all_converged(self) -> bool:
+        return all(self.converged)
+
+    def to_record(self) -> dict:
+        rec = dataclasses.asdict(self)
+        rec.pop("final_states")
+        rec["wall_ms"] = self.wall_ms
+        rec["wall_ms_per_replica"] = self.wall_ms / max(self.replicas, 1)
+        rec["all_converged"] = self.all_converged
+        return rec
+
+
+def _reject_unsupported(cfg: SimConfig) -> None:
+    if cfg.reference:
+        raise ValueError(
+            "replica sweeps vmap the batched synchronous-round engines; "
+            "reference semantics (single-walk push-sum, Q1 population) has "
+            "no batched replica axis — use batched semantics"
+        )
+    if cfg.engine == "fused":
+        raise ValueError(
+            "engine='fused' does not apply to replica sweeps: the Pallas "
+            "tiers opt out of the batch dimension (plan/tiering gate); the "
+            "sweep always runs the chunked XLA engines — drop the engine "
+            "override"
+        )
+    if cfg.n_devices is not None and cfg.n_devices > 1:
+        raise ValueError(
+            "replica sweeps are single-device (the replica axis IS the "
+            "parallelism); drop n_devices or run replicas unbatched"
+        )
+    if cfg.stall_chunks:
+        raise ValueError(
+            "stall_chunks watchdog semantics are per-run; a batched sweep "
+            "has no single progress gap to watch — run stall diagnostics "
+            "unbatched"
+        )
+
+
+def run_replicas(
+    topo: Topology,
+    cfg: SimConfig,
+    replicas: int,
+    key: Optional[jax.Array] = None,
+    keep_states: bool = True,
+) -> SweepResult:
+    """Run ``replicas`` seeds of one configuration in one vmapped chunked
+    program. Replica 0 bitwise-matches ``models.runner.run`` with the same
+    key (tests/test_sweep.py pins it)."""
+    _reject_unsupported(cfg)
+    if key is None:
+        key = jax.random.PRNGKey(cfg.seed)
+    keys = replica_keys(key, replicas)
+    target = cfg.resolved_target_count(topo.n, topo.target_count)
+
+    # One make_round_fn call per replica: the round functions are identical
+    # closures (key material rides the key_data ARGUMENT), but state0
+    # (gossip leader) and key_data differ per replica — stack those.
+    parts = [make_round_fn(topo, cfg, k) for k in keys]
+    round_fn = parts[0][0]
+    topo_args = parts[0][3]
+    state0 = jax.tree.map(lambda *xs: jnp.stack(xs), *(p[1] for p in parts))
+    key_data = jnp.stack([jnp.asarray(p[2]) for p in parts])
+
+    has_ring = cfg.delay_rounds > 0
+
+    def proto_of(carry_state):
+        return carry_state[0] if has_ring else carry_state
+
+    death_dev = _death_dev(cfg, topo.n)  # config-pure: shared by replicas
+    done_fn = _done_predicate(cfg, death_dev, target)
+
+    def chunk(state, rnd, done, round_end, kd, *targs):
+        def cond(c):
+            _, r, d = c
+            return jnp.logical_and(~d, r < round_end)
+
+        def body(c):
+            s, r, _ = c
+            s = round_fn(s, r, kd, *targs)
+            d = done_fn(proto_of(s), r)
+            return (s, r + 1, d)
+
+        return lax.while_loop(cond, body, (state, rnd, done))
+
+    chunk_b = jax.jit(
+        jax.vmap(
+            chunk,
+            in_axes=(0, 0, 0, None, 0) + (None,) * len(topo_args),
+        ),
+        donate_argnums=(0,),
+    )
+
+    rnd0 = jnp.zeros((replicas,), jnp.int32)
+    done0 = jnp.zeros((replicas,), bool)
+
+    t0 = time.perf_counter()
+    # The uniform warmup rule (models/runner.py): one real round on a COPY
+    # (the chunk donates its state argument), discarded — the timed loop
+    # recomputes round 0 identically off the absolute-round key stream.
+    warm = chunk_b(
+        jax.tree.map(jnp.copy, state0), rnd0, done0,
+        jnp.int32(min(1, cfg.max_rounds)), key_data, *topo_args,
+    )
+    int(warm[1][0])
+    del warm
+    compile_s = time.perf_counter() - t0
+
+    state, rnd, done = state0, rnd0, done0
+    rounds_end = 0
+    t1 = time.perf_counter()
+    while True:
+        rounds_end = min(rounds_end + cfg.chunk_rounds, cfg.max_rounds)
+        state, rnd, done = chunk_b(
+            state, rnd, done, jnp.int32(rounds_end), key_data, *topo_args
+        )
+        if bool(jnp.all(done)) or rounds_end >= cfg.max_rounds:
+            break
+    run_s = time.perf_counter() - t1
+
+    rounds_np = np.asarray(rnd)
+    done_np = np.asarray(done)
+    protos = proto_of(state)
+
+    result = SweepResult(
+        algorithm=cfg.algorithm,
+        topology=topo.kind,
+        semantics=cfg.semantics,
+        n_requested=topo.n_requested,
+        population=topo.n,
+        target_count=target,
+        replicas=replicas,
+        rounds=[int(r) for r in rounds_np],
+        converged=[bool(d) for d in done_np],
+        outcome=[
+            "converged" if bool(d) else "max_rounds" for d in done_np
+        ],
+        compile_s=compile_s,
+        run_s=run_s,
+    )
+    result.rounds_mean, result.rounds_ci95 = _mean_ci95(result.rounds)
+
+    if keep_states:
+        result.final_states = [
+            jax.tree.map(lambda x, r=r: np.asarray(x[r]), protos)
+            for r in range(replicas)
+        ]
+    if cfg.algorithm == "push-sum":
+        true_mean = (topo.n - 1) / 2.0
+        s = np.asarray(protos.s)
+        w = np.asarray(protos.w)
+        conv = np.asarray(protos.conv)
+        w_safe = np.where(w != 0, w, 1)
+        err = np.where(conv, np.abs(s / w_safe - true_mean), 0.0)
+        counts = np.maximum(conv.sum(axis=1), 1)
+        result.true_mean = true_mean
+        result.estimate_mae = [
+            float(e) for e in err.sum(axis=1) / counts
+        ]
+        result.estimate_mae_mean, result.estimate_mae_ci95 = _mean_ci95(
+            result.estimate_mae
+        )
+    return result

@@ -56,8 +56,6 @@ across a mesh.
 
 from __future__ import annotations
 
-from typing import Optional
-
 import numpy as np
 
 import jax
@@ -964,7 +962,7 @@ def run_stencil_hbm_sharded(
         right = lax.ppermute(x[:H], NODE_AXIS, perm_bwd)
         return jnp.concatenate([left, x, right], axis=0)
 
-    def chunk_local(carry, round_end, key_data):
+    def chunk_local(planes_in, rnd_in, done_in, round_end, key_data):
         def cond(c):
             _, rnd, done = c
             return jnp.logical_and(~done, rnd < round_end)
@@ -993,24 +991,29 @@ def run_stencil_hbm_sharded(
             total = lax.psum(conv_last, NODE_AXIS)
             return (out, rnd + executed, total >= target)
 
-        return lax.while_loop(cond, body, carry)
+        return lax.while_loop(cond, body, (planes_in, rnd_in, done_in))
 
     plane_specs = tuple(P(NODE_AXIS, None) for _ in planes0)
+    # Donation (models/pipeline.py): output planes alias the input's
+    # buffers; off when retired state must stay readable.
+    donate = on_chunk is None and not cfg.stall_chunks
     chunk_sharded = jax.jit(
         compat.shard_map(
             chunk_local,
             mesh=mesh,
-            in_specs=((plane_specs, P(), P()), P(), P()),
+            in_specs=(plane_specs, P(), P(), P(), P()),
             out_specs=(plane_specs, P(), P()),
             check_vma=False,
-        )
+        ),
+        donate_argnums=(0,) if donate else (),
     )
 
     def rep_put(x):
         return jax.device_put(x, repl)
 
     kd_dev = rep_put(np.asarray(key_data_host))
-    carry = (planes0, rep_put(np.int32(start_round)), rep_put(np.bool_(done0)))
+    rnd0 = rep_put(np.int32(start_round))
+    done0_dev = rep_put(np.bool_(done0))
 
     def to_canonical(planes):
         flats = [p.reshape(-1)[:n] for p in planes]
@@ -1024,37 +1027,50 @@ def run_stencil_hbm_sharded(
 
     t0 = time.perf_counter()
     warm = chunk_sharded(
-        carry, rep_put(np.int32(min(start_round + CR, cfg.max_rounds))),
+        tuple(jnp.copy(p) for p in planes0) if donate else planes0,
+        rnd0, done0_dev,
+        rep_put(np.int32(min(start_round + CR, cfg.max_rounds))),
         kd_dev,
     )
     int(warm[1])
     del warm
     compile_s = time.perf_counter() - t0
 
+    from ..models import pipeline as pipeline_mod
     from ..models.runner import StallWatchdog, _progress_gap
 
-    rounds = start_round
     watchdog = StallWatchdog(cfg.stall_chunks)
-    t1 = time.perf_counter()
-    while True:
-        round_end = min(rounds + CR * 8, cfg.max_rounds)
-        carry = chunk_sharded(carry, rep_put(np.int32(round_end)), kd_dev)
-        planes, rnd, done = carry
-        rounds = int(rnd)
-        if on_chunk is not None:
+
+    def dispatch(planes, rnd, done, round_end):
+        return chunk_sharded(
+            planes, rnd, done, rep_put(np.int32(round_end)), kd_dev
+        )
+
+    on_retire = None
+    if on_chunk is not None:
+        def on_retire(rounds, planes):
             on_chunk(rounds, to_canonical(planes))
-        if bool(done) or rounds >= cfg.max_rounds:
-            break
+
+    should_stop = None
+    if cfg.stall_chunks:
         # This engine rejects failure models (plan gate): legacy gap. The
         # conv plane is unpacked here (packing is the single-device pool2
         # tier's trick), so the plane sum IS the conv count.
-        if cfg.stall_chunks and watchdog.no_progress(
-            _progress_gap(None, cfg.quorum, target, planes[-1], rounds)
-        ):
-            break
+        def should_stop(rounds, planes):
+            return watchdog.no_progress(
+                _progress_gap(None, cfg.quorum, target, planes[-1], rounds)
+            )
+
+    t1 = time.perf_counter()
+    loop = pipeline_mod.run_chunks(
+        dispatch=dispatch, state0=planes0, rnd0=rnd0, done0=done0_dev,
+        start_round=start_round, max_rounds=cfg.max_rounds,
+        stride=CR * 8, depth=cfg.pipeline_chunks, donate=donate,
+        on_retire=on_retire, should_stop=should_stop,
+    )
     run_s = time.perf_counter() - t1
 
     return _finalize_result(
-        topo, cfg, to_canonical(carry[0]), rounds, target, compile_s, run_s,
-        done=bool(done), stalled=watchdog.stalled,
+        topo, cfg, to_canonical(loop.state), loop.rounds, target,
+        compile_s, run_s, done=loop.done, stalled=watchdog.stalled,
     )

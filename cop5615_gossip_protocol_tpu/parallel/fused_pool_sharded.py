@@ -115,6 +115,7 @@ def run_fused_pool_sharded(
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from ..models import gossip as gossip_mod
+    from ..models import pipeline as pipeline_mod
     from ..models import pushsum as pushsum_mod
     from ..models.runner import (
         StallWatchdog,
@@ -191,7 +192,7 @@ def run_fused_pool_sharded(
 
     K = int(cfg.chunk_rounds)
 
-    def chunk_local(carry, round_end, key_data):
+    def chunk_local(planes_in, rnd_in, done_in, round_end, key_data):
         base = sampling.key_join(key_data, key_impl)
         dev = lax.axis_index(NODE_AXIS)
         row0 = dev.astype(jnp.int32) * rows_loc
@@ -236,24 +237,29 @@ def run_fused_pool_sharded(
             )
             return (planes_new, rnd + executed, done)
 
-        return lax.while_loop(cond, body, carry)
+        return lax.while_loop(cond, body, (planes_in, rnd_in, done_in))
 
     plane_specs = tuple(P(NODE_AXIS, None) for _ in planes0)
+    # Donation (models/pipeline.py): output shards alias the input's
+    # buffers; off when retired state must stay readable.
+    donate = on_chunk is None and not cfg.stall_chunks
     chunk_sharded = jax.jit(
         compat.shard_map(
             chunk_local,
             mesh=mesh,
-            in_specs=((plane_specs, P(), P()), P(), P()),
+            in_specs=(plane_specs, P(), P(), P(), P()),
             out_specs=(plane_specs, P(), P()),
             check_vma=False,
-        )
+        ),
+        donate_argnums=(0,) if donate else (),
     )
 
     def rep_put(x):
         return jax.device_put(x, repl)
 
     kd_dev = rep_put(np.asarray(key_data_host))
-    carry = (planes0, rep_put(np.int32(start_round)), rep_put(np.bool_(done0)))
+    rnd0 = rep_put(np.int32(start_round))
+    done0_dev = rep_put(np.bool_(done0))
 
     def to_canonical(planes):
         flats = [p.reshape(-1)[:n] for p in planes]
@@ -268,33 +274,47 @@ def run_fused_pool_sharded(
     t0 = time.perf_counter()
     # One real round, discarded — the absolute-round key stream makes the
     # timed loop recompute round 0 identically (the uniform warmup rule).
+    # Under donation the warmup consumes a COPY so planes0 stays live.
     warm = chunk_sharded(
-        carry, rep_put(np.int32(min(start_round + 1, cfg.max_rounds))), kd_dev
+        tuple(jnp.copy(p) for p in planes0) if donate else planes0,
+        rnd0, done0_dev,
+        rep_put(np.int32(min(start_round + 1, cfg.max_rounds))), kd_dev,
     )
     int(warm[1])
     del warm
     compile_s = time.perf_counter() - t0
 
-    rounds = start_round
     watchdog = StallWatchdog(cfg.stall_chunks)
-    t1 = time.perf_counter()
-    while True:
-        round_end = min(rounds + cfg.chunk_rounds, cfg.max_rounds)
-        carry = chunk_sharded(carry, rep_put(np.int32(round_end)), kd_dev)
-        planes, rnd, done = carry
-        rounds = int(rnd)
-        if on_chunk is not None:
+
+    def dispatch(planes, rnd, done, round_end):
+        return chunk_sharded(
+            planes, rnd, done, rep_put(np.int32(round_end)), kd_dev
+        )
+
+    on_retire = None
+    if on_chunk is not None:
+        def on_retire(rounds, planes):
             on_chunk(rounds, to_canonical(planes))
-        if bool(done) or rounds >= cfg.max_rounds:
-            break
-        if cfg.stall_chunks and watchdog.no_progress(
-            _progress_gap(death2d, cfg.quorum, target, planes[-1], rounds)
-        ):
-            break
+
+    should_stop = None
+    if cfg.stall_chunks:
+        def should_stop(rounds, planes):
+            return watchdog.no_progress(
+                _progress_gap(
+                    death2d, cfg.quorum, target, planes[-1], rounds
+                )
+            )
+
+    t1 = time.perf_counter()
+    loop = pipeline_mod.run_chunks(
+        dispatch=dispatch, state0=planes0, rnd0=rnd0, done0=done0_dev,
+        start_round=start_round, max_rounds=cfg.max_rounds,
+        stride=cfg.chunk_rounds, depth=cfg.pipeline_chunks, donate=donate,
+        on_retire=on_retire, should_stop=should_stop,
+    )
     run_s = time.perf_counter() - t1
 
-    _, _, done = carry
     return _finalize_result(
-        topo, cfg, to_canonical(carry[0]), rounds, target, compile_s, run_s,
-        done=bool(done), stalled=watchdog.stalled,
+        topo, cfg, to_canonical(loop.state), loop.rounds, target,
+        compile_s, run_s, done=loop.done, stalled=watchdog.stalled,
     )

@@ -49,8 +49,6 @@ actor-per-node on one machine's threads, program.fs:23) — the hot loop
 
 from __future__ import annotations
 
-from typing import Optional
-
 import numpy as np
 
 import jax
@@ -550,7 +548,8 @@ def run_fused_sharded(
         right = lax.ppermute(x[:H], NODE_AXIS, perm_bwd)
         return jnp.concatenate([left, x, right], axis=0)
 
-    def chunk_local(carry, round_end, key_data, disp_loc, deg_loc):
+    def chunk_local(planes_in, rnd_in, done_in, round_end, key_data,
+                    disp_loc, deg_loc):
         # The displacement/degree planes are round-invariant: assemble
         # their halo-extended form ONCE per jitted call, not per super-step
         # (max_deg+1 loop-invariant ppermute pairs otherwise).
@@ -592,27 +591,32 @@ def run_fused_sharded(
             total = lax.psum(conv_mid, NODE_AXIS)
             return (planes, rnd + executed, total >= target)
 
-        return lax.while_loop(cond, body, carry)
+        return lax.while_loop(cond, body, (planes_in, rnd_in, done_in))
 
     plane_specs = tuple(P(NODE_AXIS, None) for _ in planes0)
+    # Donation (models/pipeline.py): output planes alias the input's
+    # buffers; off when retired state must stay readable.
+    donate = on_chunk is None and not cfg.stall_chunks
     chunk_sharded = jax.jit(
         compat.shard_map(
             chunk_local,
             mesh=mesh,
             in_specs=(
-                (plane_specs, P(), P()),
+                plane_specs, P(), P(),
                 P(), P(), P(None, NODE_AXIS, None), P(NODE_AXIS, None),
             ),
             out_specs=(plane_specs, P(), P()),
             check_vma=False,
-        )
+        ),
+        donate_argnums=(0,) if donate else (),
     )
 
     def rep_put(x):
         return jax.device_put(x, repl)
 
     kd_dev = rep_put(np.asarray(key_data_host))
-    carry = (planes0, rep_put(np.int32(start_round)), rep_put(np.bool_(done0)))
+    rnd0 = rep_put(np.int32(start_round))
+    done0_dev = rep_put(np.bool_(done0))
 
     def to_canonical(planes):
         flats = [p.reshape(-1)[:n] for p in planes]
@@ -626,39 +630,50 @@ def run_fused_sharded(
 
     t0 = time.perf_counter()
     warm = chunk_sharded(
-        carry, rep_put(np.int32(min(start_round + CR, cfg.max_rounds))),
+        tuple(jnp.copy(p) for p in planes0) if donate else planes0,
+        rnd0, done0_dev,
+        rep_put(np.int32(min(start_round + CR, cfg.max_rounds))),
         kd_dev, disp_dev, deg_dev,
     )
     int(warm[1])
     del warm
     compile_s = time.perf_counter() - t0
 
+    from ..models import pipeline as pipeline_mod
     from ..models.runner import StallWatchdog, _finalize_result, _progress_gap
 
-    rounds = start_round
     watchdog = StallWatchdog(cfg.stall_chunks)
-    t1 = time.perf_counter()
-    while True:
-        round_end = min(rounds + cfg.chunk_rounds * 8, cfg.max_rounds)
-        carry = chunk_sharded(
-            carry, rep_put(np.int32(round_end)), kd_dev, disp_dev, deg_dev
+
+    def dispatch(planes, rnd, done, round_end):
+        return chunk_sharded(
+            planes, rnd, done, rep_put(np.int32(round_end)), kd_dev,
+            disp_dev, deg_dev,
         )
-        planes, rnd, done = carry
-        rounds = int(rnd)
-        if on_chunk is not None:
+
+    on_retire = None
+    if on_chunk is not None:
+        def on_retire(rounds, planes):
             on_chunk(rounds, to_canonical(planes))
-        if bool(done) or rounds >= cfg.max_rounds:
-            break
+
+    should_stop = None
+    if cfg.stall_chunks:
         # This engine rejects crash models (plan gate), so the gap is the
         # legacy target distance.
-        if cfg.stall_chunks and watchdog.no_progress(
-            _progress_gap(None, cfg.quorum, target, planes[-1], rounds)
-        ):
-            break
+        def should_stop(rounds, planes):
+            return watchdog.no_progress(
+                _progress_gap(None, cfg.quorum, target, planes[-1], rounds)
+            )
+
+    t1 = time.perf_counter()
+    loop = pipeline_mod.run_chunks(
+        dispatch=dispatch, state0=planes0, rnd0=rnd0, done0=done0_dev,
+        start_round=start_round, max_rounds=cfg.max_rounds,
+        stride=cfg.chunk_rounds * 8, depth=cfg.pipeline_chunks,
+        donate=donate, on_retire=on_retire, should_stop=should_stop,
+    )
     run_s = time.perf_counter() - t1
 
-    _, _, done = carry
     return _finalize_result(
-        topo, cfg, to_canonical(carry[0]), rounds, target, compile_s, run_s,
-        done=bool(done), stalled=watchdog.stalled,
+        topo, cfg, to_canonical(loop.state), loop.rounds, target,
+        compile_s, run_s, done=loop.done, stalled=watchdog.stalled,
     )

@@ -84,6 +84,7 @@ from ..models.runner import (
     _progress_gap,
     draw_leader,
 )
+from ..models import pipeline as pipeline_mod
 from ..ops import faults as faults_mod
 from ..ops import sampling
 from ..ops.topology import Topology, imp_split
@@ -528,7 +529,7 @@ def run_sharded(
 
     # --- chunked while_loop under shard_map -------------------------------
 
-    def chunk_local(carry, round_end, key_data, *targs):
+    def chunk_local(state_in, rnd_in, done_in, round_end, key_data, *targs):
         def cond(c):
             _, rnd, done = c
             return jnp.logical_and(~done, rnd < round_end)
@@ -557,75 +558,83 @@ def run_sharded(
                 )
             return (state, rnd + 1, done)
 
-        return lax.while_loop(cond, body, carry)
+        return lax.while_loop(cond, body, (state_in, rnd_in, done_in))
 
-    carry_specs = (
-        jax.tree.map(lambda _: P(NODE_AXIS), state0),
-        P(),
-        P(),
-    )
+    state_specs = jax.tree.map(lambda _: P(NODE_AXIS), state0)
+    # Donation (models/pipeline.py): each chunk's output shards alias the
+    # input's buffers. Off when retired state must stay readable (chunk
+    # hooks / stall watchdog).
+    donate = on_chunk is None and not cfg.stall_chunks
     chunk_sharded = jax.jit(
         compat.shard_map(
             chunk_local,
             mesh=mesh,
-            in_specs=(carry_specs, P(), P()) + topo_specs,
-            out_specs=carry_specs,
+            in_specs=(state_specs, P(), P(), P(), P()) + topo_specs,
+            out_specs=(state_specs, P(), P()),
             check_vma=False,
-        )
+        ),
+        donate_argnums=(0,) if donate else (),
     )
 
     def rep_put(x):
         return dev_put(x, repl)
 
-    carry = (
-        state0,
-        rep_put(np.int32(start_round)),
-        rep_put(np.bool_(done0)),
-    )
-
+    rnd0 = rep_put(np.int32(start_round))
+    done0_dev = rep_put(np.bool_(done0))
     kd_dev = rep_put(np.asarray(key_data_host))
 
     t0 = time.perf_counter()
     # Warmup runs ONE real round and DISCARDS the result — the timed loop
-    # recomputes round 0 from the original carry (absolute-round keys make
-    # both exact), so run_s covers every round that `rounds` counts. A
+    # recomputes round 0 from the original state (absolute-round keys make
+    # both exact), so run_s covers every round that `rounds` counts. Under
+    # donation the warmup consumes a COPY so state0 stays live. A
     # zero-round warmup would leave the while body unexecuted and the axon
     # tunnel defers a one-time cost to the first execution that reaches it,
     # which would land in the timed loop.
     warm = chunk_sharded(
-        carry, rep_put(np.int32(min(start_round + 1, cfg.max_rounds))),
+        jax.tree.map(jnp.copy, state0) if donate else state0,
+        rnd0, done0_dev,
+        rep_put(np.int32(min(start_round + 1, cfg.max_rounds))),
         kd_dev, *topo_args,
     )
     int(warm[1])  # data-dependent sync; block_until_ready can return early
     del warm
     compile_s = time.perf_counter() - t0
 
-    rounds = start_round
     watchdog = StallWatchdog(cfg.stall_chunks)
-    t1 = time.perf_counter()
-    while True:
-        round_end = min(rounds + cfg.chunk_rounds, cfg.max_rounds)
-        carry = chunk_sharded(
-            carry, rep_put(np.int32(round_end)), kd_dev, *topo_args
+
+    def dispatch(state, rnd, done, round_end):
+        return chunk_sharded(
+            state, rnd, done, rep_put(np.int32(round_end)), kd_dev,
+            *topo_args,
         )
-        state, rnd, done = carry
-        rounds = int(rnd)  # host sync at the chunk boundary
-        if on_chunk is not None:
-            on_chunk(rounds, state)
-        if bool(done) or rounds >= cfg.max_rounds:
-            break
+
+    on_retire = None if on_chunk is None else on_chunk
+
+    should_stop = None
+    if cfg.stall_chunks:
         # Watchdog (models/runner.StallWatchdog): replicated scalar
         # reduction, process-safe like the trace hook. Pad slots carry
         # death round 0 / conv 0, so the padded gap equals the real one.
-        if cfg.stall_chunks and watchdog.no_progress(
-            _progress_gap(death_full, cfg.quorum, target, state.conv, rounds)
-        ):
-            break
+        def should_stop(rounds, state):
+            return watchdog.no_progress(
+                _progress_gap(
+                    death_full, cfg.quorum, target, state.conv, rounds
+                )
+            )
+
+    t1 = time.perf_counter()
+    loop = pipeline_mod.run_chunks(
+        dispatch=dispatch, state0=state0, rnd0=rnd0, done0=done0_dev,
+        start_round=start_round, max_rounds=cfg.max_rounds,
+        stride=cfg.chunk_rounds, depth=cfg.pipeline_chunks, donate=donate,
+        on_retire=on_retire, should_stop=should_stop,
+    )
     run_s = time.perf_counter() - t1
 
-    state, _, done = carry
+    state, rounds = loop.state, loop.rounds
     converged_count = int(jnp.sum(state.conv))
-    converged = bool(done)
+    converged = loop.done
     stalled = watchdog.stalled
     result = RunResult(
         algorithm=cfg.algorithm,

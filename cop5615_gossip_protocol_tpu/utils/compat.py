@@ -62,6 +62,40 @@ def set_host_device_count(n: int) -> None:
             os.environ["XLA_FLAGS"] = (flags + " " + flag).strip()
 
 
+def enable_compilation_cache(cache_dir: str | None = None) -> str:
+    """Point XLA's persistent compilation cache at ``cache_dir`` (default:
+    ``$GOSSIP_TPU_COMPILE_CACHE`` or ``~/.cache/gossip_tpu_xla``) with the
+    size/compile-time floors zeroed so every executable is eligible.
+
+    The benchmark harness re-pays compile on every process start without
+    this — the suite compiles one chunk program per (n, topology,
+    algorithm, engine) cell, which on the reference grid is most of the
+    small-N wall (measured in CHANGES.md PR 2). Returns the directory so
+    callers can report it."""
+    import os
+    from pathlib import Path
+
+    if cache_dir is None:
+        cache_dir = os.environ.get("GOSSIP_TPU_COMPILE_CACHE") or str(
+            Path.home() / ".cache" / "gossip_tpu_xla"
+        )
+    Path(cache_dir).mkdir(parents=True, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+    # Defaults skip sub-second/small executables — exactly the small-N grid
+    # programs the cache exists to serve here.
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    try:
+        # jax-level executable caching only: the XLA-level cache flags the
+        # default injects SEGFAULT the CPU thunk runtime on cache-hit
+        # deserialization of shard_map programs (reproduced on jax 0.4.37,
+        # 8 virtual CPU devices — the warm second process dies in XLA).
+        jax.config.update("jax_persistent_cache_enable_xla_caches", "none")
+    except AttributeError:
+        pass  # older jax without the option never injects those flags
+    return str(cache_dir)
+
+
 def ensure_partitionable_threefry() -> None:
     """UNCONDITIONALLY opt in to the partitionable threefry stream (on
     current JAX, where it is the default, this is a no-op). The flag value

@@ -1,9 +1,16 @@
 #!/usr/bin/env bash
 # Tier-1 verify — the ROADMAP.md command, verbatim. CI and local runs share
 # this one definition so "tier-1 green" means the same thing everywhere.
+# The DOTS_PASSED count prints from an EXIT trap so every exit path —
+# pytest failures, the timeout kill, an unexpected bash error — still
+# reports how many tests got through before the run ended.
 set -o pipefail
 rm -f /tmp/_t1.log
+
+print_dots() {
+  echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log 2>/dev/null | tr -cd . | wc -c)"
+}
+trap print_dots EXIT
+
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
-rc=${PIPESTATUS[0]}
-echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
-exit $rc
+exit ${PIPESTATUS[0]}

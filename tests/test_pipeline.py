@@ -1,0 +1,263 @@
+"""Speculative chunk pipelining (models/pipeline.py): bitwise parity of
+pipelined vs serial chunk loops on every engine class, exact rounds
+accounting, and the overshoot no-op contract.
+
+The pipelined driver dispatches chunk k+1 before reading chunk k's
+termination predicate; correctness rests on two properties these tests pin
+per engine:
+
+- a chunk dispatched at an already-terminal carry is a bitwise NO-OP on
+  protocol state and the round counter (so speculative overshoot past
+  convergence changes nothing, and reported ``rounds`` stays exact);
+- chunk-boundary side effects (hooks, the stall watchdog) observe the same
+  boundaries with the same states as the serial loop, in order.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from cop5615_gossip_protocol_tpu import SimConfig, build_topology
+from cop5615_gossip_protocol_tpu.models import pipeline as pipeline_mod
+from cop5615_gossip_protocol_tpu.models.runner import run
+
+
+def _run_capture(kind, n, depth, hooks=True, **cfg_kwargs):
+    """Run one config at the given pipeline depth, capturing every chunk
+    boundary's (rounds, state-as-numpy)."""
+    cfg = SimConfig(n=n, topology=kind, pipeline_chunks=depth, **cfg_kwargs)
+    topo = build_topology(kind, n, seed=cfg.seed)
+    boundaries = []
+
+    def hook(rounds, state):
+        boundaries.append((rounds, jax.tree.map(np.asarray, state)))
+
+    result = run(topo, cfg, on_chunk=hook if hooks else None)
+    return result, boundaries
+
+
+def _assert_identical(res_a, bounds_a, res_b, bounds_b):
+    assert res_a.rounds == res_b.rounds
+    assert res_a.converged_count == res_b.converged_count
+    assert res_a.converged == res_b.converged
+    assert res_a.outcome == res_b.outcome
+    assert [r for r, _ in bounds_a] == [r for r, _ in bounds_b]
+    for (_, sa), (_, sb) in zip(bounds_a, bounds_b):
+        for f in sa._fields:
+            np.testing.assert_array_equal(
+                getattr(sa, f), getattr(sb, f), err_msg=f
+            )
+
+
+# ------------------------------------------------------- per-engine parity
+
+
+@pytest.mark.parametrize("depth", [2, 4])
+def test_chunked_scatter_parity_mid_chunk_convergence(depth):
+    # chunk_rounds=7 does not divide the convergence round: the final chunk
+    # early-exits mid-chunk, and the speculative in-flight chunk must be a
+    # no-op (rounds stays exact, not rounded up to a chunk boundary).
+    serial = _run_capture("full", 64, 1, algorithm="gossip", seed=3,
+                          chunk_rounds=7, delivery="scatter")
+    piped = _run_capture("full", 64, depth, algorithm="gossip", seed=3,
+                         chunk_rounds=7, delivery="scatter")
+    _assert_identical(*serial, *piped)
+    assert serial[0].outcome == "converged"
+    assert serial[0].rounds % 7 != 0  # genuinely mid-chunk
+
+
+def test_chunked_stencil_pushsum_parity():
+    serial = _run_capture("line", 48, 1, algorithm="push-sum", seed=0,
+                          chunk_rounds=512, delivery="stencil")
+    piped = _run_capture("line", 48, 3, algorithm="push-sum", seed=0,
+                         chunk_rounds=512, delivery="stencil")
+    _assert_identical(*serial, *piped)
+    assert serial[0].converged
+
+
+def test_chunked_pool_parity():
+    serial = _run_capture("full", 64, 1, algorithm="push-sum", seed=1,
+                          chunk_rounds=16, delivery="pool")
+    piped = _run_capture("full", 64, 2, algorithm="push-sum", seed=1,
+                         chunk_rounds=16, delivery="pool")
+    _assert_identical(*serial, *piped)
+
+
+def test_chunked_crash_schedule_parity():
+    # Faulted run (crash-stop schedule + quorum): the termination predicate
+    # is the quorum over live nodes; pipelined boundaries must replay it
+    # bitwise, including the frozen dead nodes' state.
+    kwargs = dict(algorithm="gossip", seed=2, chunk_rounds=8,
+                  crash_schedule="3:8,6:4", quorum=0.9, max_rounds=4000)
+    serial = _run_capture("full", 64, 1, **kwargs)
+    piped = _run_capture("full", 64, 3, **kwargs)
+    _assert_identical(*serial, *piped)
+    assert serial[0].outcome == "converged"
+
+
+def test_chunked_delay_dup_parity():
+    # Delay ring + duplicate delivery: the carry is (state, ring) — the
+    # pipeline must thread the compound carry unchanged.
+    kwargs = dict(algorithm="push-sum", seed=0, chunk_rounds=64,
+                  delay_rounds=2, dup_rate=0.05, delivery="scatter")
+    serial = _run_capture("full", 48, 1, **kwargs)
+    piped = _run_capture("full", 48, 2, **kwargs)
+    _assert_identical(*serial, *piped)
+
+
+def test_stalled_watchdog_parity_discards_speculation():
+    # A stalled run (the reference's line-topology hang as a measured
+    # outcome): the watchdog fires at a retired boundary while speculative
+    # chunks are in flight — those must be DISCARDED, leaving outcome,
+    # rounds, and final state bitwise the serial loop's.
+    kwargs = dict(algorithm="gossip", seed=0, engine="chunked",
+                  fault_rate=0.9999, stall_chunks=3, chunk_rounds=16,
+                  max_rounds=5000)
+    serial = _run_capture("line", 60, 1, **kwargs)
+    piped = _run_capture("line", 60, 4, **kwargs)
+    _assert_identical(*serial, *piped)
+    assert serial[0].outcome == "stalled"
+    assert serial[0].rounds < 5000
+
+
+def test_sharded_parity():
+    serial = _run_capture("full", 64, 1, algorithm="gossip", seed=3,
+                          chunk_rounds=7, n_devices=8)
+    piped = _run_capture("full", 64, 2, algorithm="gossip", seed=3,
+                         chunk_rounds=7, n_devices=8)
+    _assert_identical(*serial, *piped)
+    assert serial[0].converged
+
+
+def test_fused_interpret_parity():
+    # The fused Pallas engine (interpret mode off-TPU): parity of the
+    # threaded (rnd, done) carry against the serial loop at a bounded
+    # round budget (full convergence on a ring is interpret-mode slow).
+    kwargs = dict(algorithm="gossip", seed=0, engine="fused",
+                  chunk_rounds=8, max_rounds=24)
+    serial = _run_capture("ring", 256, 1, **kwargs)
+    piped = _run_capture("ring", 256, 3, **kwargs)
+    _assert_identical(*serial, *piped)
+    assert serial[0].rounds == 24
+
+
+# ------------------------------------------------- overshoot no-op contract
+
+
+def test_overshoot_chunk_is_noop_on_resume():
+    # Run to convergence, then resume AT the converged state with a deep
+    # pipeline: every dispatched chunk is past termination, so the run must
+    # retire with zero additional rounds and a bitwise-unchanged state.
+    res, bounds = _run_capture("full", 64, 2, algorithm="gossip", seed=3,
+                               chunk_rounds=7)
+    assert res.outcome == "converged"
+    final_rounds, final_state = bounds[-1]
+    assert final_rounds == res.rounds
+
+    cfg = SimConfig(n=64, topology="full", algorithm="gossip", seed=3,
+                    chunk_rounds=7, pipeline_chunks=4)
+    topo = build_topology("full", 64, seed=3)
+    import cop5615_gossip_protocol_tpu.models.gossip as gossip_mod
+
+    start = gossip_mod.GossipState(*(jax.numpy.asarray(x)
+                                     for x in final_state))
+    boundaries = []
+
+    def hook(rounds, state):
+        boundaries.append((rounds, jax.tree.map(np.asarray, state)))
+
+    res2 = run(topo, cfg, on_chunk=hook, start_state=start,
+               start_round=final_rounds)
+    assert res2.rounds == final_rounds  # exact: no phantom rounds
+    assert res2.outcome == "converged"
+    for rounds, state in boundaries:
+        assert rounds == final_rounds
+        for f in state._fields:
+            np.testing.assert_array_equal(
+                getattr(state, f), getattr(final_state, f), err_msg=f
+            )
+
+
+def test_donating_path_matches_hooked_path():
+    # No hooks -> donation + speculation; hooks -> buffered path. Same
+    # trajectory either way (donation aliases buffers, never values).
+    cfg_kwargs = dict(algorithm="push-sum", seed=1, chunk_rounds=32,
+                      delivery="pool")
+    hooked, _ = _run_capture("full", 64, 2, hooks=True, **cfg_kwargs)
+    donating, _ = _run_capture("full", 64, 2, hooks=False, **cfg_kwargs)
+    assert donating.rounds == hooked.rounds
+    assert donating.converged_count == hooked.converged_count
+    assert donating.estimate_mae == hooked.estimate_mae
+
+
+# ------------------------------------------------------- driver unit tests
+
+
+def _fake_dispatch(log, fail_after=None):
+    """Host-side model of a conforming chunk fn: advances rnd to round_end
+    unless a 'convergence' round is crossed; no-op once done."""
+
+    def dispatch(state, rnd, done, round_end):
+        log.append(("dispatch", int(rnd), int(round_end)))
+        if done:
+            return state, rnd, done
+        conv_at = state["conv_at"]
+        new_rnd = min(round_end, conv_at) if conv_at is not None else round_end
+        return state, new_rnd, conv_at is not None and new_rnd >= conv_at
+
+    return dispatch
+
+
+def test_driver_exact_rounds_and_retire_order():
+    log, retired = [], []
+    result = pipeline_mod.run_chunks(
+        dispatch=_fake_dispatch(log),
+        state0={"conv_at": 23}, rnd0=0, done0=False,
+        start_round=0, max_rounds=1000, stride=10, depth=3,
+        on_retire=lambda r, s: retired.append(r),
+    )
+    assert result.rounds == 23  # exact, not rounded to a chunk boundary
+    assert result.done
+    assert retired == [10, 20, 23]  # serial boundary sequence, in order
+
+
+def test_driver_watchdog_discards_inflight():
+    log = []
+    stops = iter([False, True])
+    result = pipeline_mod.run_chunks(
+        dispatch=_fake_dispatch(log),
+        state0={"conv_at": None}, rnd0=0, done0=False,
+        start_round=0, max_rounds=1000, stride=10, depth=4,
+        should_stop=lambda r, s: next(stops),
+    )
+    assert result.rounds == 20  # stopped at the second retired boundary
+    assert not result.done
+    assert result.chunks_speculative > 0  # in-flight work was discarded
+
+
+def test_driver_donate_rejects_hooks():
+    with pytest.raises(ValueError, match="donation"):
+        pipeline_mod.run_chunks(
+            dispatch=lambda *a: a[:3], state0=None, rnd0=0, done0=False,
+            start_round=0, max_rounds=10, stride=5, depth=2, donate=True,
+            on_retire=lambda r, s: None,
+        )
+
+
+def test_pipeline_chunks_validation():
+    with pytest.raises(ValueError, match="pipeline_chunks"):
+        SimConfig(n=4, pipeline_chunks=0)
+    with pytest.raises(ValueError, match="pipeline_chunks"):
+        SimConfig(n=4, pipeline_chunks=65)
+
+
+def test_driver_resume_at_max_rounds_observes_one_boundary():
+    log, retired = [], []
+    result = pipeline_mod.run_chunks(
+        dispatch=_fake_dispatch(log),
+        state0={"conv_at": None}, rnd0=50, done0=False,
+        start_round=50, max_rounds=50, stride=10, depth=2,
+        on_retire=lambda r, s: retired.append(r),
+    )
+    assert result.rounds == 50
+    assert retired == [50]  # the serial loop also fires the hook once

@@ -1,0 +1,179 @@
+"""Vmapped replica sweep (models/sweep.py): replica-0 bitwise parity with
+the unbatched run, the fold_in tag-space contract, aggregate statistics,
+and the support gates."""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from cop5615_gossip_protocol_tpu import SimConfig, build_topology
+from cop5615_gossip_protocol_tpu.models.runner import _LEADER_TAG, run
+from cop5615_gossip_protocol_tpu.models.sweep import (
+    MAX_REPLICAS,
+    REPLICA_TAG0,
+    SweepResult,
+    replica_keys,
+    run_replicas,
+)
+from cop5615_gossip_protocol_tpu.ops.faults import CRASH_TAG
+
+
+def _unbatched_final(topo, cfg):
+    cap = {}
+
+    def hook(rounds, state):
+        cap["state"] = jax.tree.map(np.asarray, state)
+        cap["rounds"] = rounds
+
+    res = run(topo, cfg, on_chunk=hook)
+    return res, cap["state"]
+
+
+def _assert_state_equal(a, b):
+    for f in a._fields:
+        np.testing.assert_array_equal(getattr(a, f), getattr(b, f),
+                                      err_msg=f)
+
+
+# --------------------------------------------------- replica-0 bitwise pin
+
+
+def test_replica0_bitwise_gossip():
+    cfg = SimConfig(n=64, topology="full", algorithm="gossip", seed=3,
+                    chunk_rounds=7)
+    topo = build_topology("full", 64, seed=3)
+    res, final = _unbatched_final(topo, cfg)
+    sweep = run_replicas(topo, cfg, 4)
+    assert sweep.rounds[0] == res.rounds
+    assert sweep.converged[0] == res.converged
+    _assert_state_equal(sweep.final_states[0], final)
+    # Replicas genuinely differ: not every replica repeats replica 0.
+    assert len({tuple(s.count.tolist()) for s in sweep.final_states}) > 1
+
+
+def test_replica0_bitwise_pushsum_stencil():
+    cfg = SimConfig(n=48, topology="line", algorithm="push-sum", seed=0,
+                    chunk_rounds=512, delivery="stencil")
+    topo = build_topology("line", 48, seed=0)
+    res, final = _unbatched_final(topo, cfg)
+    sweep = run_replicas(topo, cfg, 3)
+    assert sweep.rounds[0] == res.rounds
+    _assert_state_equal(sweep.final_states[0], final)
+    assert sweep.estimate_mae[0] == pytest.approx(res.estimate_mae)
+
+
+def test_replica0_bitwise_crash_schedule():
+    # The death plane is a pure function of cfg (PRNGKey(seed)+CRASH_TAG),
+    # so all replicas share it — replica 0 must still replay the unbatched
+    # faulted trajectory bitwise, quorum predicate included.
+    cfg = SimConfig(n=64, topology="full", algorithm="gossip", seed=2,
+                    chunk_rounds=8, crash_schedule="3:8", quorum=0.9,
+                    max_rounds=4000)
+    topo = build_topology("full", 64, seed=2)
+    res, final = _unbatched_final(topo, cfg)
+    sweep = run_replicas(topo, cfg, 3)
+    assert sweep.rounds[0] == res.rounds
+    _assert_state_equal(sweep.final_states[0], final)
+
+
+# -------------------------------------------------------- fold_in tag space
+
+
+def test_replica_tag_space_disjoint():
+    # Base-key fold_in consumers: round indices (< 2**30), CRASH_TAG,
+    # _LEADER_TAG. The replica tag range must collide with none of them.
+    lo = REPLICA_TAG0 + 1
+    hi = REPLICA_TAG0 + MAX_REPLICAS - 1
+    assert lo >= 2**30  # above every round index
+    assert not (lo <= CRASH_TAG <= hi)
+    assert CRASH_TAG < lo  # CRASH_TAG sits below the replica region
+    assert hi < _LEADER_TAG  # leader tag sits above it
+    assert hi < 2**31  # int32 fold_in range
+
+
+def test_replica_keys_distinct_and_replica0_is_base():
+    base = jax.random.PRNGKey(7)
+    keys = replica_keys(base, 8)
+    data = [np.asarray(jax.random.key_data(k)) for k in keys]
+    assert np.array_equal(data[0], np.asarray(jax.random.key_data(base)))
+    as_tuples = {tuple(d.tolist()) for d in data}
+    assert len(as_tuples) == 8  # no collisions
+
+
+def test_replica_keys_bounds():
+    base = jax.random.PRNGKey(0)
+    with pytest.raises(ValueError):
+        replica_keys(base, 0)
+    with pytest.raises(ValueError):
+        replica_keys(base, MAX_REPLICAS + 1)
+
+
+# ------------------------------------------------------------- aggregates
+
+
+def test_sweep_statistics_and_record():
+    cfg = SimConfig(n=64, topology="full", algorithm="push-sum", seed=0,
+                    chunk_rounds=64, delivery="pool")
+    topo = build_topology("full", 64, seed=0)
+    sweep = run_replicas(topo, cfg, 5)
+    assert isinstance(sweep, SweepResult)
+    assert len(sweep.rounds) == 5
+    assert min(sweep.rounds) <= sweep.rounds_mean <= max(sweep.rounds)
+    assert sweep.rounds_ci95 is not None and sweep.rounds_ci95 >= 0
+    assert len(sweep.estimate_mae) == 5
+    rec = sweep.to_record()
+    assert "final_states" not in rec  # data, not a measurement
+    assert rec["all_converged"] is True
+    assert rec["wall_ms_per_replica"] == pytest.approx(rec["wall_ms"] / 5)
+    json.dumps(rec)  # JSONL-ready
+
+
+def test_sweep_single_replica_has_no_ci():
+    cfg = SimConfig(n=64, topology="full", algorithm="gossip", seed=0)
+    topo = build_topology("full", 64, seed=0)
+    sweep = run_replicas(topo, cfg, 1)
+    assert sweep.rounds_ci95 is None
+    assert sweep.rounds_mean == sweep.rounds[0]
+
+
+# ------------------------------------------------------------ support gates
+
+
+def test_sweep_rejects_unsupported_configs():
+    topo = build_topology("full", 64)
+    with pytest.raises(ValueError, match="reference"):
+        run_replicas(topo, SimConfig(n=64, semantics="reference"), 2)
+    with pytest.raises(ValueError, match="fused"):
+        run_replicas(topo, SimConfig(n=64, engine="fused"), 2)
+    with pytest.raises(ValueError, match="n_devices"):
+        run_replicas(topo, SimConfig(n=64, n_devices=4), 2)
+    with pytest.raises(ValueError, match="stall"):
+        run_replicas(topo, SimConfig(n=64, stall_chunks=2), 2)
+
+
+# ------------------------------------------------------------------- CLI
+
+
+def test_cli_replicas_sweep(capsys):
+    from cop5615_gossip_protocol_tpu.cli import main
+
+    rc = main(["64", "full", "gossip", "--replicas", "3",
+               "--chunk-rounds", "16"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    rec = json.loads(out.strip().splitlines()[-1])
+    assert rec["replicas"] == 3
+    assert len(rec["rounds"]) == 3
+    assert rec["all_converged"] is True
+    assert rec["rounds_ci95"] is not None
+
+
+def test_cli_replicas_rejects_checkpoint(capsys, tmp_path):
+    from cop5615_gossip_protocol_tpu.cli import main
+
+    rc = main(["64", "full", "gossip", "--replicas", "2",
+               "--checkpoint", str(tmp_path / "ck.npz")])
+    assert rc == 2
+    assert "Invalid:" in capsys.readouterr().err
