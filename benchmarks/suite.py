@@ -199,9 +199,38 @@ def _cell_sweep(n, topology, algorithm, seed, replicas):
     return run_replicas(topo, cfg, replicas, keep_states=False)
 
 
+def _trajectory_section(seed: int, trajectory_path: str, grid_n) -> list[str]:
+    """Run the smallest grid cell's full-topology gossip config with the
+    telemetry plane on, write its per-round trajectory JSONL to
+    ``trajectory_path``, and return the rounds-to-X% + ASCII-curve section
+    (benchmarks/trajectory.py) for the output markdown — the telemetry
+    smoke the CI bench job drives end to end."""
+    from benchmarks import trajectory as traj_mod
+    from cop5615_gossip_protocol_tpu import SimConfig, build_topology, run
+    from cop5615_gossip_protocol_tpu.utils import metrics
+
+    n = min(grid_n)
+    cfg = SimConfig(n=n, topology="full", algorithm="gossip", seed=seed,
+                    telemetry=True)
+    topo = build_topology("full", n, seed=seed)
+    res = run(topo, cfg)
+    Path(trajectory_path).unlink(missing_ok=True)
+    metrics.append_jsonl_many(
+        trajectory_path,
+        res.telemetry.to_trace_records(cfg.algorithm),
+    )
+    print(f"[suite] trajectory: full/gossip N={n} -> {trajectory_path} "
+          f"({res.telemetry.rounds} rounds)", flush=True)
+    return traj_mod.section(
+        traj_mod.load_trace(trajectory_path), population=topo.n,
+        title=f"Convergence trajectory (full gossip N={n:,}, telemetry "
+        "plane)",
+    )
+
+
 def generate(out_path: str, seed: int, grid_n, scale_n, platform_note: str,
              replicas: int = 0, us_pairs: int = 3,
-             us_budgets=None) -> None:
+             us_budgets=None, trajectory_path: str | None = None) -> None:
     lines = [
         "# BENCH_TABLES — old vs new on the reference's own grid",
         "",
@@ -282,6 +311,9 @@ def generate(out_path: str, seed: int, grid_n, scale_n, platform_note: str,
         lines.append("")
 
     lines.extend(_analysis(all_rows, grid_n))
+
+    if trajectory_path:
+        lines.extend(_trajectory_section(seed, trajectory_path, grid_n))
 
     if scale_n:
         lines.append("## Beyond the reference's ceiling (full topology, push-sum)")
@@ -531,6 +563,11 @@ def main(argv=None) -> int:
                     help="skip the persistent XLA compilation cache "
                     "(enabled by default so repeated suite runs stop "
                     "re-paying compile)")
+    ap.add_argument("--trajectory", type=str, default=None, metavar="FILE",
+                    help="run the smallest grid cell with the telemetry "
+                    "plane on, write its per-round trajectory JSONL here, "
+                    "and add the rounds-to-X%% / ASCII-curve section "
+                    "(benchmarks/trajectory.py) to the output markdown")
     args = ap.parse_args(argv)
 
     import jax
@@ -559,6 +596,7 @@ def main(argv=None) -> int:
         replicas=args.replicas,
         us_pairs=1 if args.smoke else 3,
         us_budgets=(16, 128) if args.smoke else None,
+        trajectory_path=args.trajectory,
     )
     return 0
 

@@ -1,0 +1,186 @@
+"""Trajectory analyzer — rounds-to-X% tables and ASCII convergence curves.
+
+Consumes the per-round trajectory JSONL the telemetry plane emits
+(`--trace-convergence FILE`, ops/telemetry.py): one record per round with
+``rounds``, ``converged_count``, ``newly_converged`` and either
+``active_count`` (gossip) or ``estimate_mae`` (push-sum). Produces the
+analysis BENCH_TABLES.md wants per flagship config:
+
+- **rounds-to-X%** — the first round at which X% of the final converged
+  count is reached, for the standard fractions. This is the number that
+  survives engine and wall-clock changes: convergence SHAPE, not speed.
+- **ASCII convergence curve** — converged fraction vs rounds on a fixed
+  character grid, so a trajectory is legible in a terminal, a CI log, or
+  a markdown code block without a plotting stack.
+
+Usage:
+  python benchmarks/trajectory.py TRACE.jsonl [--population N] [--md]
+                                  [--width 64] [--height 12]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from pathlib import Path
+
+PERCENTILES = (10, 25, 50, 75, 90, 95, 99, 100)
+
+
+def load_trace(path: str | Path) -> list[dict]:
+    recs = []
+    for line in Path(path).read_text().splitlines():
+        if line.strip():
+            recs.append(json.loads(line))
+    if not recs:
+        raise ValueError(f"{path}: empty trajectory")
+    return recs
+
+
+def rounds_to_fraction(recs: list[dict], denominator: int) -> dict[int, int | None]:
+    """First ``rounds`` value at which converged_count reaches each
+    PERCENTILES fraction of ``denominator`` — None if never reached, and
+    None for fractions a PARTIAL trace (resume: first record past round 1)
+    had already crossed before it begins: the true crossing round predates
+    the file and reporting the trace's first round would be wrong."""
+    first = recs[0]
+    partial = first["rounds"] > 1
+    out: dict[int, int | None] = {}
+    for pct in PERCENTILES:
+        need = pct * denominator / 100.0
+        hit = None
+        for r in recs:
+            if r["converged_count"] >= need:
+                hit = r["rounds"]
+                break
+        if partial and hit == first["rounds"] and first["converged_count"] >= need:
+            hit = None  # crossed before the trace starts — unknowable here
+        out[pct] = hit
+    return out
+
+
+def ascii_curve(recs: list[dict], denominator: int,
+                width: int = 64, height: int = 12) -> list[str]:
+    """Converged fraction (y, 0..100%) vs rounds (x) on a width x height
+    character grid — each column shows the max fraction reached in its
+    round bucket. The x axis spans the TRACE's rounds (first..last), so a
+    partial/resumed trace plots its own window instead of rendering the
+    pre-trace rounds as a false flatline at 0%."""
+    first = recs[0]["rounds"]
+    last = recs[-1]["rounds"]
+    span = max(last - first + 1, 1)
+    cols = [0.0] * width
+    for r in recs:
+        x = min(width - 1, (r["rounds"] - first) * width // span)
+        frac = r["converged_count"] / max(denominator, 1)
+        cols[x] = max(cols[x], frac)
+    # Forward-fill empty buckets (fewer rounds than columns).
+    running = 0.0
+    for x in range(width):
+        running = max(running, cols[x])
+        cols[x] = running
+    lines = []
+    for row in range(height, 0, -1):
+        cut = row / height
+        body = "".join("#" if c >= cut - 1e-12 and c > 0 else " "
+                       for c in cols)
+        label = f"{int(cut * 100):>4d}% |"
+        lines.append(label + body)
+    lines.append("      +" + "-" * width)
+    left = f"{first:,} round" + ("s" if first > 1 else "")
+    lines.append(
+        f"       {left}{'':<{max(width - len(left) - len(f'{last:,}') - 1, 1)}}"
+        f"{last:,}"
+    )
+    return lines
+
+
+def analyze(recs: list[dict], population: int | None = None) -> dict:
+    final = recs[-1]
+    denom = population or final["converged_count"]
+    if denom <= 0:
+        raise ValueError(
+            "no nodes converged and no --population given; nothing to "
+            "normalize the curve against"
+        )
+    out = {
+        "rounds_total": final["rounds"],
+        "converged_final": final["converged_count"],
+        "denominator": denom,
+        # A resumed run's trace starts mid-stream: percentiles crossed
+        # before the file begins report None, and consumers should prefer
+        # the uninterrupted run's trace for shape analysis.
+        "partial_trace": recs[0]["rounds"] > 1,
+        "rounds_to_pct": rounds_to_fraction(recs, denom),
+    }
+    if "estimate_mae" in final:
+        out["estimate_mae_final"] = final["estimate_mae"]
+    if "active_count" in final:
+        out["active_final"] = final["active_count"]
+    return out
+
+
+def section(recs: list[dict], population: int | None = None,
+            title: str = "Convergence trajectory",
+            width: int = 64, height: int = 12) -> list[str]:
+    """Markdown section (BENCH_TABLES.md style) for one trajectory."""
+    a = analyze(recs, population)
+    denom = a["denominator"]
+    lines = [
+        f"## {title}",
+        "",
+        *(
+            ["PARTIAL trace (starts mid-run, e.g. a resume): percentiles "
+             "crossed before the trace begins show —.", ""]
+            if a["partial_trace"] else []
+        ),
+        f"{a['rounds_total']:,} rounds traced; final converged "
+        f"{a['converged_final']:,} / {denom:,}"
+        + (
+            f", estimate MAE {a['estimate_mae_final']:.3g}"
+            if "estimate_mae_final" in a else ""
+        )
+        + ".",
+        "",
+        "| % converged | " + " | ".join(f"{p}%" for p in PERCENTILES) + " |",
+        "|---|" + "---|" * len(PERCENTILES),
+        "| rounds | " + " | ".join(
+            "—" if a["rounds_to_pct"][p] is None
+            else f"{a['rounds_to_pct'][p]:,}"
+            for p in PERCENTILES
+        ) + " |",
+        "",
+        "```",
+        *ascii_curve(recs, denom, width=width, height=height),
+        "```",
+        "",
+    ]
+    return lines
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace", help="trajectory JSONL (--trace-convergence)")
+    ap.add_argument("--population", type=int, default=None,
+                    help="normalize against this population instead of the "
+                    "final converged count")
+    ap.add_argument("--md", action="store_true",
+                    help="print the BENCH_TABLES.md-style markdown section")
+    ap.add_argument("--width", type=int, default=64)
+    ap.add_argument("--height", type=int, default=12)
+    args = ap.parse_args(argv)
+
+    recs = load_trace(args.trace)
+    if args.md:
+        print("\n".join(section(
+            recs, args.population, width=args.width, height=args.height
+        )))
+    else:
+        a = analyze(recs, args.population)
+        a["rounds_to_pct"] = {str(k): v for k, v in a["rounds_to_pct"].items()}
+        print(json.dumps(a, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -152,17 +152,32 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile", type=str, default=None, metavar="DIR",
                    help="capture a jax.profiler trace of the run into DIR "
                    "(viewable in TensorBoard/Perfetto; round phases are "
-                   "named_scope-tagged: sample / deliver / absorb)")
+                   "named_scope-tagged sample / deliver / absorb, and chunk "
+                   "boundaries carry chunkloop.dispatch / fetch / retire "
+                   "annotations from the pipelined driver)")
     p.add_argument("--jsonl", type=str, default=None,
                    help="append the structured run record to this JSONL file")
+    p.add_argument("--telemetry", action="store_true",
+                   help="enable the in-program telemetry plane "
+                   "(ops/telemetry.py): per-ROUND counters accumulated on "
+                   "device inside the chunk program and fetched "
+                   "asynchronously — no extra host syncs, donation and "
+                   "pipelining stay on; the trajectory rides the RunResult "
+                   "(and --trace-convergence serializes it)")
     p.add_argument("--trace-convergence", type=str, default=None,
                    metavar="FILE",
-                   help="append per-chunk convergence counters (rounds, "
+                   help="write the per-ROUND convergence trajectory (rounds, "
                    "converged/newly-converged counts, active count or "
-                   "estimate error) as JSONL — the SURVEY §5 per-round "
-                   "counters, at chunk granularity since every sample costs "
-                   "a device->host sync; lower --chunk-rounds for finer "
-                   "resolution")
+                   "estimate error) as JSONL — implies --telemetry; the "
+                   "counters come from the on-device telemetry plane, so "
+                   "the run keeps its pipelined/donated hot path (the "
+                   "pre-telemetry chunk-granularity host-sync sampler is "
+                   "gone; field names are unchanged)")
+    p.add_argument("--events", type=str, default=None, metavar="FILE",
+                   help="append schema-versioned lifecycle events (run-start, "
+                   "resume, crash-schedule-applied, chunk-retired with "
+                   "dispatch/fetch timing splits, checkpoint-written, "
+                   "watchdog-fired, run-end) as JSONL (utils/events.py)")
     p.add_argument("--checkpoint", type=str, default=None,
                    help="write round-state checkpoints to this .npz path")
     p.add_argument("--checkpoint-every", type=int, default=1,
@@ -229,6 +244,8 @@ def _main_refsim(args, parser) -> int:
         "--checkpoint": changed("checkpoint") or changed("checkpoint_every"),
         "--resume": changed("resume"),
         "--trace-convergence": changed("trace_convergence"),
+        "--telemetry": changed("telemetry"),
+        "--events": changed("events"),
     }
     bad = [flag for flag, set_ in inapplicable.items() if set_]
     if bad:
@@ -390,6 +407,8 @@ def main(argv: Optional[list[str]] = None) -> int:
             pool_size=args.pool_size,
             engine=args.engine,
             n_devices=args.devices,
+            # --trace-convergence is the telemetry plane's serializer.
+            telemetry=args.telemetry or bool(args.trace_convergence),
         )
     except ValueError as e:
         print(f"Invalid: {e}", file=sys.stderr)
@@ -414,12 +433,19 @@ def main(argv: Optional[list[str]] = None) -> int:
             ("--checkpoint", args.checkpoint),
             ("--resume", args.resume),
             ("--trace-convergence", args.trace_convergence),
+            ("--events", args.events),
+            # run_replicas collects per-replica trajectories (models/
+            # sweep.py, tested via the API), but the CLI has no sweep
+            # serializer — accepting the flag would pay the collection
+            # cost and silently discard the data.
+            ("--telemetry", args.telemetry),
         ):
             if set_:
                 print(
                     f"Invalid: {flag} does not apply to --replicas sweeps "
-                    "(chunk-boundary hooks are per-run; run replicas "
-                    "unbatched to checkpoint/trace them)",
+                    "(per-run observability surfaces; run replicas "
+                    "unbatched, or use models/sweep.run_replicas for "
+                    "per-replica trajectories)",
                     file=sys.stderr,
                 )
                 return 2
@@ -453,38 +479,36 @@ def main(argv: Optional[list[str]] = None) -> int:
             metrics.append_jsonl(args.jsonl, record)
         return 0 if sres.all_converged else 1
 
+    # Lifecycle event log (utils/events.py). Opened before the run so
+    # run-start lands first even if the run dies.
+    events = None
+    if args.events and jax.process_index() == 0:
+        from .utils.events import RunEventLog
+
+        events = RunEventLog(args.events)
+        events.emit(
+            "run-start",
+            config={"n": cfg.n, "topology": cfg.topology,
+                    "algorithm": cfg.algorithm, "seed": cfg.seed,
+                    "semantics": cfg.semantics},
+            population=topo.n,
+        )
+        if cfg.crash_model:
+            events.emit(
+                "crash-schedule-applied",
+                crash_rate=cfg.crash_rate,
+                crash_schedule=cfg.crash_schedule,
+                quorum=cfg.quorum,
+            )
+
+    # The chunk-boundary hook API is CHECKPOINT-ONLY: a hook reads retired
+    # device state, which turns off buffer donation and serializes the
+    # boundary (models/pipeline.py). Convergence tracing no longer rides it
+    # — the on-device telemetry plane (cfg.telemetry) carries the counters
+    # with the hot path intact, and the legacy per-chunk
+    # `int(jnp.sum(...))` host syncs are gone.
     hooks = []
     trace_prev = {"conv": 0}
-    if args.trace_convergence:
-        prev = trace_prev
-
-        def trace_hook(rounds, state):
-            # jnp reductions, not host numpy: when the mesh spans processes
-            # the arrays are not host-addressable, but every process can run
-            # the same replicated-scalar reduction. Padded slots never
-            # converge / never activate, so no explicit slicing is needed.
-            import jax.numpy as jnp
-
-            conv = int(jnp.sum(state.conv))
-            rec = {
-                "rounds": rounds,
-                "converged_count": conv,
-                "newly_converged": conv - prev["conv"],
-            }
-            prev["conv"] = conv
-            if hasattr(state, "s"):  # push-sum: converged-estimate error
-                w_safe = jnp.where(state.w != 0, state.w, 1)
-                ratio = jnp.where(state.w != 0, state.s / w_safe, 0.0)
-                err = jnp.where(
-                    state.conv, jnp.abs(ratio - (topo.n - 1) / 2.0), 0.0
-                )
-                rec["estimate_mae"] = float(jnp.sum(err)) / max(conv, 1)
-            else:  # gossip: how many nodes have heard the rumor
-                rec["active_count"] = int(jnp.sum(state.active))
-            if jax.process_index() == 0:
-                metrics.append_jsonl(args.trace_convergence, rec)
-
-        hooks.append(trace_hook)
     if args.checkpoint:
         counter = {"chunks": 0}
 
@@ -513,6 +537,11 @@ def main(argv: Optional[list[str]] = None) -> int:
                     *(np.asarray(x)[: topo.n] for x in state)
                 )
                 ckpt.save(args.checkpoint, state, rounds, cfg)
+                if events is not None:
+                    events.emit(
+                        "checkpoint-written", rounds=rounds,
+                        path=args.checkpoint,
+                    )
 
         hooks.append(checkpoint_hook)
 
@@ -566,9 +595,12 @@ def main(argv: Optional[list[str]] = None) -> int:
     if resume_path:
         # Resume is only bitwise-faithful if every stream-relevant knob
         # matches the original run; loop-control knobs may differ.
+        # telemetry is observability, not stream state: a resumed run may
+        # toggle it freely without touching the trajectory.
         loop_knobs = {"max_rounds": cfg.max_rounds, "chunk_rounds": cfg.chunk_rounds,
                       "n_devices": cfg.n_devices,
-                      "pipeline_chunks": cfg.pipeline_chunks}
+                      "pipeline_chunks": cfg.pipeline_chunks,
+                      "telemetry": cfg.telemetry}
         if dataclasses.replace(saved_cfg, **loop_knobs) != cfg:
             print(
                 "Invalid: checkpoint config mismatch — resume requires the "
@@ -582,6 +614,28 @@ def main(argv: Optional[list[str]] = None) -> int:
         import numpy as np
 
         trace_prev["conv"] = int(np.asarray(start_state.conv).sum())
+        if events is not None:
+            events.emit("resume", rounds=start_round, path=str(resume_path))
+
+    # Streaming trajectory writer: the telemetry collector hands each
+    # retired chunk's fresh counter rows to this callback, which appends
+    # them in the legacy trace schema (one fsync per chunk,
+    # metrics.append_jsonl_many) — a killed run's trace file holds every
+    # retired chunk's rounds, like the pre-telemetry per-chunk hook did,
+    # without that hook's blocking syncs or donation opt-out.
+    tele_writer = None
+    if args.trace_convergence and jax.process_index() == 0:
+        from .ops import telemetry as telemetry_mod
+
+        def tele_writer(chunk_start, rows):
+            recs = telemetry_mod.rows_to_trace_records(
+                rows, chunk_start, cfg.algorithm,
+                prev_conv=trace_prev["conv"],
+            )
+            trace_prev["conv"] = recs[-1]["converged_count"] if recs else (
+                trace_prev["conv"]
+            )
+            metrics.append_jsonl_many(args.trace_convergence, recs)
 
     # SURVEY.md §5 tracing plan: the trace spans compile + run, and the
     # in-kernel named_scope tags split per-round cost into sample / deliver /
@@ -595,11 +649,27 @@ def main(argv: Optional[list[str]] = None) -> int:
             result = run(
                 topo, cfg, on_chunk=on_chunk,
                 start_state=start_state, start_round=start_round,
+                on_telemetry=tele_writer,
             )
     except (ValueError, NotImplementedError) as e:
         print(f"Invalid: {e}", file=sys.stderr)
         return 2
     result.build_s = build_s
+
+    if events is not None:
+        events.emit_chunks(result.chunk_log)
+        if result.outcome == "stalled":
+            events.emit("watchdog-fired", rounds=result.rounds)
+        events.emit(
+            "run-end",
+            outcome=result.outcome,
+            rounds=result.rounds,
+            converged_count=result.converged_count,
+            compile_s=result.compile_s,
+            run_s=result.run_s,
+            dispatch_s=result.dispatch_s,
+            fetch_s=result.fetch_s,
+        )
 
     if jax.process_index() == 0:
         print(metrics.reference_format(result))

@@ -176,6 +176,18 @@ class SimConfig:
     # 0 disables.
     stall_chunks: int = 0
 
+    # In-program telemetry plane (ops/telemetry.py): the chunk program
+    # accumulates one per-round counter row (converged/live counts, quorum
+    # gap, active count or estimate MAE, mass residual, drop/dup events) on
+    # device and returns the block alongside the termination predicate, so
+    # full per-round trajectories stream out of the pipelined, donated
+    # engines with no extra host syncs. A Python-level flag: off (default)
+    # traces the bitwise-identical program as a build without the plane.
+    # Supported by the chunked, sharded, fused stencil/pool, and replica-
+    # sweep engines; the streaming HBM tiers and sharded fused compositions
+    # reject it.
+    telemetry: bool = False
+
     # Round engine: "chunked" = jit'd lax.while_loop dispatching one fused
     # XLA round program per round; "fused" = the Pallas multi-round kernel
     # (ops/fused.py — whole chunks of rounds with VMEM-resident state and
@@ -268,6 +280,17 @@ class SimConfig:
             )
         if self.stall_chunks < 0:
             raise ValueError("stall_chunks must be >= 0")
+        if (
+            self.telemetry
+            and self.semantics == "reference"
+            and self.algorithm == "push-sum"
+        ):
+            raise ValueError(
+                "telemetry accumulates per-ROUND counters inside the "
+                "synchronous chunk program; reference-semantics push-sum is "
+                "a single random walk (one message in flight) with no round "
+                "structure to trace — use batched semantics"
+            )
         if self.semantics == "reference" and (
             self.crash_model or self.dup_rate > 0 or self.delay_rounds > 0
         ):

@@ -45,13 +45,44 @@ the driver retire chunk k while chunk k+1 already owns its memory. On a
 done/max_rounds exit the newest in-flight carry is returned (its buffers
 are the only live ones); the overshoot contract makes it bitwise the
 retired carry.
+
+Telemetry rides the same machinery (ops/telemetry.py): a chunk may return a
+fourth element — an auxiliary on-device buffer (the per-round counter
+block) — which the driver prefetches with the predicate scalars and hands
+to ``on_aux`` at retire time. Aux buffers are fresh chunk OUTPUTS, never
+part of the donated state carry, so ``on_aux`` composes with donation and
+speculation: the telemetry plane observes the run without de-optimizing it.
+Aux of a discarded speculative chunk is never observed (it executed no real
+rounds past the retired boundary by the overshoot contract).
+
+The driver also measures the per-chunk timing split — ``dispatch_s`` (host
+time to enqueue the chunk) and ``fetch_s`` (host time blocked on the
+predicate readback + aux collection) — into ``ChunkLoopResult.chunk_log``
+for the structured run-event log, and tags dispatch/fetch/retire with
+``jax.profiler`` trace annotations so chunk boundaries are legible in a
+Perfetto/TensorBoard capture (``--profile DIR``).
 """
 
 from __future__ import annotations
 
 import collections
 import dataclasses
+import time
 from typing import Callable, Optional
+
+try:  # host-side profiler annotations; inert when no trace is active
+    from jax.profiler import TraceAnnotation as _TraceAnnotation
+except Exception:  # noqa: BLE001 — the driver must not require jax
+
+    class _TraceAnnotation:
+        def __init__(self, *a, **k):
+            pass
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
 
 
 def _prefetch(x) -> None:
@@ -74,6 +105,11 @@ class ChunkLoopResult:
     done: bool  # the engine's own termination flag at the final boundary
     chunks_retired: int  # boundaries observed (serial-equivalent count)
     chunks_speculative: int  # dispatched-then-discarded chunks (stall exits)
+    dispatch_s: float = 0.0  # total host time enqueueing chunks
+    fetch_s: float = 0.0  # total host time blocked on predicate/aux readback
+    # Per RETIRED chunk, in order: {"rounds", "dispatch_s", "fetch_s"} —
+    # the structured run-event log's chunk-retired events (utils/events.py).
+    chunk_log: list = dataclasses.field(default_factory=list)
 
 
 def run_chunks(
@@ -89,6 +125,7 @@ def run_chunks(
     donate: bool = False,
     on_retire: Optional[Callable[[int, object], None]] = None,
     should_stop: Optional[Callable[[int, object], bool]] = None,
+    on_aux: Optional[Callable[[int, int, object], None]] = None,
 ) -> ChunkLoopResult:
     """Drive ``dispatch(state, rnd, done, round_end) -> (state, rnd, done)``
     to termination with up to ``depth`` chunks in flight.
@@ -100,6 +137,13 @@ def run_chunks(
     call — with ``donate=True`` only the state argument is donated, so
     they remain readable after the state's buffers are recycled.
 
+    ``dispatch`` may return a fourth element, an auxiliary device buffer
+    (the telemetry counter block); it is prefetched with the predicate
+    scalars and handed to ``on_aux(rounds_before, rounds_after, aux)`` at
+    each retired boundary, in order. Unlike ``on_retire``/``should_stop``,
+    ``on_aux`` reads no protocol state and is LEGAL under donation — aux
+    buffers are fresh chunk outputs outside the donated carry.
+
     ``stride`` is the engine's natural chunk length in rounds: a chunk
     dispatched at boundary k targets ``min(start + (k+1)*stride,
     max_rounds)`` — the identical schedule the serial loop produces,
@@ -109,41 +153,73 @@ def run_chunks(
     if donate and (on_retire is not None or should_stop is not None):
         raise ValueError(
             "buffer donation recycles retired chunk state; chunk-boundary "
-            "hooks (checkpoint/trace/watchdog) require donate=False"
+            "hooks (checkpoint/watchdog) require donate=False"
         )
 
     inflight: collections.deque = collections.deque()
-    head = (state0, rnd0, done0)  # newest dispatched carry
+    head = (state0, rnd0, done0, None)  # newest dispatched carry (+aux)
     last_end = start_round
     retired_count = 0
+    dispatch_total = 0.0
+    fetch_total = 0.0
+    chunk_log: list = []
 
     def fill() -> None:
         """Top the pipeline up. Chunks whose round_end would not advance
         past max_rounds are guaranteed no-ops and are never dispatched —
         except the very first chunk, which the serial loops also issue
         (a resume at max_rounds still observes one boundary)."""
-        nonlocal head, last_end
+        nonlocal head, last_end, dispatch_total
         while len(inflight) < depth and (
             last_end < max_rounds or (not inflight and retired_count == 0)
         ):
             last_end = min(last_end + stride, max_rounds)
-            state, rnd, done = dispatch(head[0], head[1], head[2], last_end)
-            _prefetch(rnd)
-            _prefetch(done)
-            head = (state, rnd, done)
-            inflight.append(head)
+            t0 = time.perf_counter()
+            with _TraceAnnotation("chunkloop.dispatch"):
+                out = dispatch(head[0], head[1], head[2], last_end)
+            disp_s = time.perf_counter() - t0
+            dispatch_total += disp_s
+            aux = out[3] if len(out) > 3 else None
+            _prefetch(out[1])
+            _prefetch(out[2])
+            if aux is not None:
+                _prefetch(aux)
+            head = (out[0], out[1], out[2], aux)
+            inflight.append((head, disp_s))
 
     fill()  # dispatches at least one chunk, so the retire loop runs
     final = head
     rounds = start_round
     done_b = False
+
+    def result(state_tuple, spec: int) -> ChunkLoopResult:
+        return ChunkLoopResult(
+            state=state_tuple[0], rounds=rounds, done=done_b,
+            chunks_retired=retired_count, chunks_speculative=spec,
+            dispatch_s=dispatch_total, fetch_s=fetch_total,
+            chunk_log=chunk_log,
+        )
+
     while inflight:
-        cur = inflight.popleft()
-        rounds = int(cur[1])  # blocks until chunk k completes
-        done_b = bool(cur[2])
+        cur, disp_s = inflight.popleft()
+        prev_rounds = rounds
+        t0 = time.perf_counter()
+        with _TraceAnnotation("chunkloop.fetch"):
+            rounds = int(cur[1])  # blocks until chunk k completes
+            done_b = bool(cur[2])
+            if on_aux is not None and cur[3] is not None:
+                # The aux copy was prefetched at dispatch; by retire time it
+                # is usually resident — this is a collection, not a sync.
+                on_aux(prev_rounds, rounds, cur[3])
+        fetch_s = time.perf_counter() - t0
+        fetch_total += fetch_s
         retired_count += 1
+        chunk_log.append(
+            {"rounds": rounds, "dispatch_s": disp_s, "fetch_s": fetch_s}
+        )
         if on_retire is not None:
-            on_retire(rounds, cur[0])
+            with _TraceAnnotation("chunkloop.retire"):
+                on_retire(rounds, cur[0])
         if done_b or rounds >= max_rounds:
             # Overshoot chunks are bitwise no-ops, so the newest carry IS
             # this one — and under donation it is the one with live buffers.
@@ -154,15 +230,7 @@ def run_chunks(
             # Serial semantics: the run ends AT this boundary. In-flight
             # speculative chunks executed real rounds past the stall —
             # discard them unobserved (donate=False here by construction).
-            final = cur
-            return ChunkLoopResult(
-                state=final[0], rounds=rounds, done=done_b,
-                chunks_retired=retired_count,
-                chunks_speculative=len(inflight),
-            )
+            return result(cur, len(inflight))
         final = cur
         fill()
-    return ChunkLoopResult(
-        state=final[0], rounds=rounds, done=done_b,
-        chunks_retired=retired_count, chunks_speculative=0,
-    )
+    return result(final, 0)

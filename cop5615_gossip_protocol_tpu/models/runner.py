@@ -30,7 +30,9 @@ from ..config import SimConfig
 from ..ops import delivery as delivery_mod
 from ..ops import faults as faults_mod
 from ..ops import sampling
+from ..ops import telemetry as telemetry_mod
 from ..ops.topology import Topology, imp_split, stencil_offsets
+from ..utils.metrics import RUN_RECORD_SCHEMA_VERSION
 from . import gossip as gossip_mod
 from . import pipeline as pipeline_mod
 from . import pushsum as pushsum_mod
@@ -66,6 +68,19 @@ class RunResult:
     # push-sum only:
     true_mean: Optional[float] = None
     estimate_mae: Optional[float] = None
+    # JSONL format version (utils/metrics.RUN_RECORD_SCHEMA_VERSION) so
+    # consumers can detect field drift instead of guessing from shape.
+    schema_version: int = RUN_RECORD_SCHEMA_VERSION
+    # Per-chunk timing split of run_s (models/pipeline.py): host time spent
+    # enqueueing chunks vs blocked on the predicate/telemetry readback.
+    dispatch_s: float = 0.0
+    fetch_s: float = 0.0
+    # Observability payloads — data, not measurements: excluded from
+    # to_record. telemetry is an ops/telemetry.TelemetryTrajectory when
+    # cfg.telemetry was on; chunk_log is the driver's per-chunk event list
+    # (the run-event log's chunk-retired events, utils/events.py).
+    telemetry: Optional[object] = None
+    chunk_log: Optional[list] = None
 
     @property
     def wall_ms(self) -> float:
@@ -75,7 +90,11 @@ class RunResult:
         return self.run_s * 1e3
 
     def to_record(self) -> dict:
-        rec = dataclasses.asdict(self)
+        rec = {
+            f.name: getattr(self, f.name)
+            for f in dataclasses.fields(self)
+            if f.name not in ("telemetry", "chunk_log")
+        }
         rec["wall_ms"] = self.wall_ms
         rec["rounds_per_sec"] = self.rounds / self.run_s if self.run_s > 0 else None
         return rec
@@ -633,7 +652,7 @@ def _host_done(cfg, death_np, state, rounds: int, target: int) -> bool:
 
 def _finalize_result(
     topo, cfg, state, rounds, target, compile_s, run_s,
-    done=None, stalled: bool = False,
+    done=None, stalled: bool = False, loop=None, collector=None,
 ) -> RunResult:
     converged_count = int(jnp.sum(state.conv))
     converged = (converged_count >= target) if done is None else bool(done)
@@ -660,6 +679,12 @@ def _finalize_result(
         err = jnp.where(state.conv, jnp.abs(ratio - true_mean), 0.0)
         result.true_mean = true_mean
         result.estimate_mae = float(jnp.sum(err) / jnp.maximum(converged_count, 1))
+    if loop is not None:
+        result.dispatch_s = loop.dispatch_s
+        result.fetch_s = loop.fetch_s
+        result.chunk_log = loop.chunk_log
+    if collector is not None:
+        result.telemetry = collector.finalize()
     return result
 
 
@@ -672,6 +697,7 @@ def _run_fused(
     start_round: int,
     interpret: bool,
     variant: str = "stencil",
+    on_telemetry=None,
 ) -> RunResult:
     """Chunk loop over a Pallas multi-round engine: one kernel launch per
     cfg.chunk_rounds rounds. ``variant`` picks the kernel family:
@@ -790,6 +816,15 @@ def _run_fused(
             return gossip_mod.GossipState(count=cnt, active=act != 0, conv=cv != 0)
 
     K = cfg.chunk_rounds
+    telemetry = cfg.telemetry
+    if telemetry and variant not in ("stencil", "pool"):
+        # Callers gate on this too (run()'s tier selection); defense in
+        # depth because a silent arity mismatch here would be cryptic.
+        raise ValueError(
+            "telemetry counters run in the fused stencil and pool kernels "
+            f"only; the {variant!r} tier does not carry the counter block — "
+            "use engine='chunked' or a telemetry-capable population"
+        )
 
     def chunk_call(state_dev, rnd, done, cap):
         # Keys/offsets are derived INSIDE the jit: per-chunk eager fold_in
@@ -800,16 +835,21 @@ def _run_fused(
         # axon tunnel (measured on the 1M-node flagship chunk, ~140 ms
         # baked vs ~170 ms as argument).
         keys = fused.round_keys(key, rnd, K)
-        new_state, executed = chunk_fn(
-            state_dev, keys, *extra_args(rnd, K), rnd, cap
-        )
+        outs = chunk_fn(state_dev, keys, *extra_args(rnd, K), rnd, cap)
+        new_state, executed = outs[0], outs[1]
         # Early exit (executed short of this chunk's budget) means the
         # kernel's own termination predicate fired; latching it into a
         # carried done flag makes an overshoot dispatch observable as a
         # no-op (executed == 0, the kernel seeds done from the incoming
         # conv plane) — the contract the pipelined driver relies on.
         expected = jnp.minimum(jnp.int32(K), jnp.maximum(cap - rnd, 0))
-        return new_state, rnd + executed, done | (executed < expected)
+        ret = (new_state, rnd + executed, done | (executed < expected))
+        if telemetry:
+            # The in-kernel counter block: [K_pad, 128] with the schema's
+            # columns in the first lanes (ops/telemetry.py), a fresh OUTPUT
+            # outside the donated state argument.
+            ret += (outs[2],)
+        return ret
 
     # Donation aliases each chunk's output planes onto its input's buffers
     # (zero steady-state copies) — legal only when nothing reads retired
@@ -861,12 +901,18 @@ def _run_fused(
                 )
             )
 
+    collector = (
+        telemetry_mod.Collector(start_round, on_rows=on_telemetry)
+        if telemetry else None
+    )
+
     t1 = time.perf_counter()
     loop = pipeline_mod.run_chunks(
         dispatch=dispatch, state0=state_dev, rnd0=rnd0, done0=done0_dev,
         start_round=start_round, max_rounds=cfg.max_rounds, stride=K,
         depth=cfg.pipeline_chunks, donate=donate,
         on_retire=on_retire, should_stop=should_stop,
+        on_aux=collector.on_aux if collector else None,
     )
     run_s = time.perf_counter() - t1
 
@@ -874,7 +920,8 @@ def _run_fused(
     done = _host_done(cfg, death_np, final, loop.rounds, target)
     return _finalize_result(
         topo, cfg, final, loop.rounds, target, compile_s, run_s,
-        done=done, stalled=watchdog.stalled,
+        done=done, stalled=watchdog.stalled, loop=loop,
+        collector=collector,
     )
 
 
@@ -885,14 +932,21 @@ def run(
     on_chunk: Optional[Callable[[int, object], None]] = None,
     start_state=None,
     start_round: int = 0,
+    on_telemetry: Optional[Callable[[int, object], None]] = None,
 ) -> RunResult:
     """Run one simulation to convergence (or cfg.max_rounds) on one device.
 
-    ``on_chunk(rounds_done, state)`` fires at every chunk boundary — the
-    checkpoint/metrics hook point. ``start_state``/``start_round`` resume a
-    checkpointed run: round keys are derived from the absolute round index,
-    so the resumed trajectory is bitwise the one the original run would have
-    taken (utils/checkpoint.py).
+    ``on_chunk(rounds_done, state)`` fires at every chunk boundary. It is
+    the CHECKPOINT hook: it reads retired device state, which forces buffer
+    donation off and serializes the boundary (models/pipeline.py) — use it
+    only for state capture (checkpoints, debugging). Counters and
+    trajectories belong to the telemetry plane (``cfg.telemetry`` /
+    ``RunResult.telemetry``, ops/telemetry.py), which accumulates per-round
+    rows on device and keeps donation + speculative pipelining intact.
+
+    ``start_state``/``start_round`` resume a checkpointed run: round keys
+    are derived from the absolute round index, so the resumed trajectory is
+    bitwise the one the original run would have taken (utils/checkpoint.py).
     """
     if key is None:
         key = jax.random.PRNGKey(cfg.seed)
@@ -904,6 +958,14 @@ def run(
                 "n_devices or use batched semantics"
             )
         if cfg.engine == "fused":
+            if cfg.telemetry:
+                raise ValueError(
+                    "telemetry counters run in the single-device fused "
+                    "stencil/pool kernels and the chunked/sharded XLA "
+                    "engines; the sharded fused compositions do not carry "
+                    "the counter block — drop the engine override (the "
+                    "sharded XLA engine psums the block in-trace)"
+                )
             if topo.implicit and cfg.delivery == "pool":
                 # Implicit-full pool composition (VERDICT r3 #1): local
                 # halve, one all_gather of the send planes per round, then
@@ -963,6 +1025,7 @@ def run(
         return run_sharded(
             topo, cfg, key=key, on_chunk=on_chunk,
             start_state=start_state, start_round=start_round,
+            on_telemetry=on_telemetry,
         )
     target = cfg.resolved_target_count(topo.n, topo.target_count)
     if cfg.reference and cfg.algorithm == "push-sum":
@@ -1054,6 +1117,19 @@ def run(
             # like explicit delivery='pool' does on the pool branch (only
             # 'scatter' pins the XLA path).
             auto_ok = reason is None and cfg.delivery in ("auto", "stencil")
+        if cfg.telemetry and reason is None and variant not in (
+            "stencil", "pool"
+        ):
+            # The counter block is implemented in the VMEM-resident stencil
+            # and pool kernels; the streaming HBM/imp tiers do not carry it.
+            # Under engine='auto' this demotes the run to the chunked XLA
+            # engine (which always supports telemetry); engine='fused'
+            # fails loudly below.
+            reason = (
+                "telemetry counters run in the fused stencil/pool kernels "
+                f"only (selected tier: {variant!r})"
+            )
+            auto_ok = False
         if cfg.engine == "fused":
             if variant != "pool" and cfg.delivery == "scatter":
                 raise ValueError(
@@ -1067,6 +1143,7 @@ def run(
             return _run_fused(
                 topo, cfg, key, on_chunk, start_state, start_round,
                 interpret=jax.default_backend() != "tpu", variant=variant,
+                on_telemetry=on_telemetry,
             )
         # auto: compiled engines on TPU only — interpret mode would make CPU
         # runs slower, and the chunked XLA path is already fast there.
@@ -1074,6 +1151,7 @@ def run(
             return _run_fused(
                 topo, cfg, key, on_chunk, start_state, start_round,
                 interpret=False, variant=variant,
+                on_telemetry=on_telemetry,
             )
 
     round_fn, state0, key_data, topo_args = make_round_fn(topo, cfg, key)
@@ -1105,18 +1183,40 @@ def run(
         # Same predicate the original run evaluated after its last round.
         done0 = _host_done(cfg, death_np, state0, start_round, target)
 
+    # Telemetry plane (ops/telemetry.py): the while body additionally
+    # writes one float32 counter row per executed round into a fixed
+    # (chunk_rounds, N_COLS) buffer created INSIDE the chunk — a fresh
+    # output outside the donated carry, returned alongside the predicate
+    # scalars and fetched asynchronously by the driver. A Python-level
+    # flag, so telemetry=False traces the identical program as before.
+    telemetry = cfg.telemetry
+    row_fn = (
+        telemetry_mod.make_row_fn(topo, cfg, key) if telemetry else None
+    )
+    stride = cfg.chunk_rounds
+
     def chunk(state, rnd, done, round_end, key_data, *targs):
+        rnd_in = rnd  # loop-entry round: telemetry rows index from here
+
         def cond(c):
-            _, r, d = c
-            return jnp.logical_and(~d, r < round_end)
+            return jnp.logical_and(~c[2], c[1] < round_end)
 
         def body(c):
-            s, r, _ = c
+            s, r = c[0], c[1]
             s = round_fn(s, r, key_data, *targs)
             d = done_fn(proto_of(s), r)
-            return (s, r + 1, d)
+            out = (s, r + 1, d)
+            if telemetry:
+                row = row_fn(proto_of(s), r, key_data)
+                out += (lax.dynamic_update_index_in_dim(
+                    c[3], row, r - rnd_in, 0
+                ),)
+            return out
 
-        return lax.while_loop(cond, body, (state, rnd, done))
+        carry = (state, rnd, done)
+        if telemetry:
+            carry += (jnp.zeros((stride, telemetry_mod.N_COLS), jnp.float32),)
+        return lax.while_loop(cond, body, carry)
 
     # Donation: steady-state chunks alias their output state onto the input
     # buffers (zero copies). Off when retired state must stay readable —
@@ -1167,16 +1267,23 @@ def run(
                 )
             )
 
+    collector = (
+        telemetry_mod.Collector(start_round, on_rows=on_telemetry)
+        if telemetry else None
+    )
+
     t1 = time.perf_counter()
     loop = pipeline_mod.run_chunks(
         dispatch=dispatch, state0=state0, rnd0=rnd0, done0=done0_dev,
         start_round=start_round, max_rounds=cfg.max_rounds,
         stride=cfg.chunk_rounds, depth=cfg.pipeline_chunks, donate=donate,
         on_retire=on_retire, should_stop=should_stop,
+        on_aux=collector.on_aux if collector else None,
     )
     run_s = time.perf_counter() - t1
 
     return _finalize_result(
         topo, cfg, proto_of(loop.state), loop.rounds, target,
         compile_s, run_s, done=loop.done, stalled=watchdog.stalled,
+        loop=loop, collector=collector,
     )

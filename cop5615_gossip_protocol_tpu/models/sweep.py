@@ -54,7 +54,9 @@ import numpy as np
 from jax import lax
 
 from ..config import SimConfig
+from ..ops import telemetry as telemetry_mod
 from ..ops.topology import Topology
+from ..utils.metrics import RUN_RECORD_SCHEMA_VERSION
 from .runner import (
     _death_dev,
     _done_predicate,
@@ -116,6 +118,9 @@ class SweepResult:
     outcome: list
     compile_s: float
     run_s: float
+    # Same JSONL format version as RunResult (utils/metrics.py): a --jsonl
+    # stream mixing run and sweep records stays uniformly drift-detectable.
+    schema_version: int = RUN_RECORD_SCHEMA_VERSION
     rounds_mean: Optional[float] = None
     rounds_ci95: Optional[float] = None
     estimate_mae: Optional[list] = None  # push-sum only, per replica
@@ -123,6 +128,10 @@ class SweepResult:
     estimate_mae_ci95: Optional[float] = None
     true_mean: Optional[float] = None
     final_states: Optional[list] = None
+    # Per-replica TelemetryTrajectory (ops/telemetry.py) when cfg.telemetry
+    # was on: R full per-round counter trajectories out of ONE vmapped
+    # program. Data, not a measurement — excluded from to_record.
+    telemetry: Optional[list] = None
 
     @property
     def wall_ms(self) -> float:
@@ -133,8 +142,14 @@ class SweepResult:
         return all(self.converged)
 
     def to_record(self) -> dict:
-        rec = dataclasses.asdict(self)
-        rec.pop("final_states")
+        # Field-filtered, not dataclasses.asdict: asdict would deep-copy
+        # every replica's final state and telemetry trajectory only to be
+        # discarded (same reasoning as RunResult.to_record).
+        rec = {
+            f.name: getattr(self, f.name)
+            for f in dataclasses.fields(self)
+            if f.name not in ("final_states", "telemetry")
+        }
         rec["wall_ms"] = self.wall_ms
         rec["wall_ms_per_replica"] = self.wall_ms / max(self.replicas, 1)
         rec["all_converged"] = self.all_converged
@@ -201,18 +216,39 @@ def run_replicas(
     death_dev = _death_dev(cfg, topo.n)  # config-pure: shared by replicas
     done_fn = _done_predicate(cfg, death_dev, target)
 
+    # Telemetry plane: the vmapped chunk grows a per-replica counter block
+    # — R full per-round trajectories out of one program, the same move
+    # that batches the runs themselves. One row_fn serves every replica
+    # (the crash plane is config-pure; per-replica key material rides the
+    # vmapped kd argument).
+    telemetry = cfg.telemetry
+    row_fn = (
+        telemetry_mod.make_row_fn(topo, cfg, keys[0]) if telemetry else None
+    )
+    stride = cfg.chunk_rounds
+
     def chunk(state, rnd, done, round_end, kd, *targs):
+        rnd_in = rnd  # per-replica loop-entry round (telemetry row base)
+
         def cond(c):
-            _, r, d = c
-            return jnp.logical_and(~d, r < round_end)
+            return jnp.logical_and(~c[2], c[1] < round_end)
 
         def body(c):
-            s, r, _ = c
+            s, r = c[0], c[1]
             s = round_fn(s, r, kd, *targs)
             d = done_fn(proto_of(s), r)
-            return (s, r + 1, d)
+            out = (s, r + 1, d)
+            if telemetry:
+                row = row_fn(proto_of(s), r, kd)
+                out += (lax.dynamic_update_index_in_dim(
+                    c[3], row, r - rnd_in, 0
+                ),)
+            return out
 
-        return lax.while_loop(cond, body, (state, rnd, done))
+        carry = (state, rnd, done)
+        if telemetry:
+            carry += (jnp.zeros((stride, telemetry_mod.N_COLS), jnp.float32),)
+        return lax.while_loop(cond, body, carry)
 
     chunk_b = jax.jit(
         jax.vmap(
@@ -238,13 +274,29 @@ def run_replicas(
     compile_s = time.perf_counter() - t0
 
     state, rnd, done = state0, rnd0, done0
+    trajs = [[] for _ in range(replicas)] if telemetry else None
     rounds_end = 0
     t1 = time.perf_counter()
     while True:
         rounds_end = min(rounds_end + cfg.chunk_rounds, cfg.max_rounds)
-        state, rnd, done = chunk_b(
+        if telemetry:
+            rnd_before = np.asarray(rnd)
+        out = chunk_b(
             state, rnd, done, jnp.int32(rounds_end), key_data, *topo_args
         )
+        state, rnd, done = out[:3]
+        if telemetry:
+            # Per-replica row counts differ: a replica frozen at its own
+            # convergence executed 0 rows this chunk (vmap select-masks its
+            # carry), so each replica slices its own executed prefix.
+            buf = np.asarray(out[3])
+            rnd_after = np.asarray(rnd)
+            for r in range(replicas):
+                ex = int(rnd_after[r] - rnd_before[r])
+                if ex > 0:
+                    trajs[r].append(
+                        np.array(buf[r, :ex], dtype=np.float32)
+                    )
         if bool(jnp.all(done)) or rounds_end >= cfg.max_rounds:
             break
     run_s = time.perf_counter() - t1
@@ -271,6 +323,17 @@ def run_replicas(
     )
     result.rounds_mean, result.rounds_ci95 = _mean_ci95(result.rounds)
 
+    if telemetry:
+        result.telemetry = [
+            telemetry_mod.TelemetryTrajectory(
+                start_round=0,
+                data=(
+                    np.concatenate(t)
+                    if t else np.zeros((0, telemetry_mod.N_COLS), np.float32)
+                ),
+            )
+            for t in trajs
+        ]
     if keep_states:
         result.final_states = [
             jax.tree.map(lambda x, r=r: np.asarray(x[r]), protos)

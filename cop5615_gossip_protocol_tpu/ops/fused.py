@@ -320,6 +320,19 @@ def make_done_flag(death_ref, target, quorum, masked_total: bool = False):
     return done_flag
 
 
+def telemetry_row(vals):
+    """(1, 128) float32 telemetry row with the ops/telemetry.py schema's
+    columns in the first lanes (unused lanes zero) — the in-kernel form of
+    one counter-block row, shared by every fused kernel that carries the
+    plane. Scalars only; Mosaic has no scalar->lane store, so the row is
+    assembled with lane-iota selects."""
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, LANES), 1)
+    row = jnp.zeros((1, LANES), jnp.float32)
+    for i, v in enumerate(vals):
+        row = jnp.where(lane == i, jnp.asarray(v).astype(jnp.float32), row)
+    return row
+
+
 def clamp_cap_and_pad(start, cap, keys, extras=()):
     """Shared per-chunk SMEM stream prep for every fused engine.
 
@@ -377,6 +390,12 @@ def make_pushsum_chunk(
     death2d = build_death2d(cfg, topo.n, layout.n_pad)
     crashed = death2d is not None
     quorum = cfg.quorum
+    # Telemetry plane (ops/telemetry.py): each active grid step folds one
+    # counter row into a VMEM scratch register; every grid step copies it
+    # to that step's row of the counter-block output. Python-level flag —
+    # telemetry=False traces the identical kernel as before.
+    telemetry = cfg.telemetry
+    tmean = np.float32((topo.n - 1) / 2.0)
 
     def kernel(*refs):
         it = iter(refs)
@@ -388,9 +407,11 @@ def make_pushsum_chunk(
         s_o, w_o, t_o, c_o, meta_o = (
             next(it), next(it), next(it), next(it), next(it)
         )
+        tele_o = next(it) if telemetry else None
         s_v, w_v, t_v, c_v, flags = (
             next(it), next(it), next(it), next(it), next(it)
         )
+        trow = next(it) if telemetry else None
         k = pl.program_id(0)
         K = pl.num_programs(0)
 
@@ -408,6 +429,8 @@ def make_pushsum_chunk(
             # predicate is evaluated at the last executed round, start - 1.
             flags[0] = done_flag(c0[:], start_ref[0] - 1)
             flags[1] = jnp.int32(0)  # rounds executed
+            if telemetry:
+                trow[:] = jnp.zeros((1, LANES), jnp.float32)
 
         active = (flags[0] == 0) & (start_ref[0] + k < start_ref[1])
 
@@ -497,6 +520,45 @@ def make_pushsum_chunk(
                 c_v[:] = conv_new
                 flags[1] = flags[1] + 1
                 flags[0] = done_flag(conv_new, start_ref[0] + k)
+            if telemetry:
+                conv_ct = jnp.sum(conv_new, dtype=jnp.int32)
+                if crashed:
+                    live = jnp.sum(alive.astype(jnp.int32), dtype=jnp.int32)
+                    conv_alive = jnp.sum(
+                        jnp.where(alive, conv_new, jnp.int32(0)),
+                        dtype=jnp.int32,
+                    )
+                    gap = faults_mod.quorum_need(live, quorum) - conv_alive
+                else:
+                    live = jnp.int32(layout.n)
+                    gap = target - conv_ct
+                err = jnp.where(
+                    conv_new != 0,
+                    jnp.abs(s_new / w_new - tmean),
+                    jnp.float32(0),
+                )
+                mae = jnp.sum(err) / jnp.maximum(conv_ct, 1)
+                # Pad lanes carry w = 1, so the padded total's invariant is
+                # n_pad, not n — the residual is identical to the chunked
+                # engine's Σw − n either way.
+                mass = jnp.sum(w_new) - jnp.float32(layout.n_pad)
+                drops = jnp.float32(0)
+                if use_gate:
+                    pos = (
+                        jax.lax.broadcasted_iota(jnp.int32, (R, LANES), 0)
+                        * LANES
+                        + jax.lax.broadcasted_iota(jnp.int32, (R, LANES), 1)
+                    )
+                    fired = (gbits < thresh) & (pos < layout.n)
+                    if crashed:
+                        fired = fired & alive
+                    drops = jnp.sum(fired.astype(jnp.int32), dtype=jnp.int32)
+                trow[:] = telemetry_row(
+                    [conv_ct, live, gap, 0.0, mae, mass, drops, 0.0]
+                )
+
+        if telemetry:
+            tele_o[:] = trow[:]
 
         @pl.when(k == K - 1)
         def _emit():
@@ -548,25 +610,36 @@ def make_pushsum_chunk(
             operands.append(death2d)
         in_specs += [plane] * 4
         operands += [s, w, t, c]
+        out_shape = [f32, f32, i32, i32, jax.ShapeDtypeStruct((1,), jnp.int32)]
+        out_specs = [
+            plane, plane, plane, plane,
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ]
+        scratch = [
+            pltpu.VMEM((R, LANES), jnp.float32),
+            pltpu.VMEM((R, LANES), jnp.float32),
+            pltpu.VMEM((R, LANES), jnp.int32),
+            pltpu.VMEM((R, LANES), jnp.int32),
+            pltpu.SMEM((2,), jnp.int32),
+        ]
+        if cfg.telemetry:
+            # Counter block: one (1, 128) row per grid step (the telemetry
+            # scratch register copied out), first N_COLS lanes meaningful.
+            out_shape.append(jax.ShapeDtypeStruct((K, LANES), jnp.float32))
+            out_specs.append(pl.BlockSpec((1, LANES), lambda k: (k, 0)))
+            scratch.append(pltpu.VMEM((1, LANES), jnp.float32))
         outs = pl.pallas_call(
             kernel,
             grid=grid,
-            out_shape=(f32, f32, i32, i32, jax.ShapeDtypeStruct((1,), jnp.int32)),
+            out_shape=tuple(out_shape),
             in_specs=in_specs,
-            out_specs=(
-                plane, plane, plane, plane,
-                pl.BlockSpec(memory_space=pltpu.SMEM),
-            ),
-            scratch_shapes=[
-                pltpu.VMEM((R, LANES), jnp.float32),
-                pltpu.VMEM((R, LANES), jnp.float32),
-                pltpu.VMEM((R, LANES), jnp.int32),
-                pltpu.VMEM((R, LANES), jnp.int32),
-                pltpu.SMEM((2,), jnp.int32),
-            ],
+            out_specs=tuple(out_specs),
+            scratch_shapes=scratch,
             interpret=interpret,
         )(*operands)
-        s2, w2, t2, c2, meta = outs
+        s2, w2, t2, c2, meta = outs[:5]
+        if cfg.telemetry:
+            return (s2, w2, t2, c2), meta[0], outs[5]
         return (s2, w2, t2, c2), meta[0]
 
     return chunk_fn, layout
@@ -589,6 +662,7 @@ def make_gossip_chunk(topo: Topology, cfg: SimConfig, *, interpret: bool = False
     death2d = build_death2d(cfg, topo.n, layout.n_pad)
     crashed = death2d is not None
     quorum = cfg.quorum
+    telemetry = cfg.telemetry  # see make_pushsum_chunk: Python-level flag
 
     def kernel(*refs):
         it = iter(refs)
@@ -598,7 +672,9 @@ def make_gossip_chunk(topo: Topology, cfg: SimConfig, *, interpret: bool = False
         death_ref = next(it) if crashed else None
         n0, a0, c0 = next(it), next(it), next(it)
         n_o, a_o, c_o, meta_o = next(it), next(it), next(it), next(it)
+        tele_o = next(it) if telemetry else None
         n_v, a_v, c_v, flags = next(it), next(it), next(it), next(it)
+        trow = next(it) if telemetry else None
         k = pl.program_id(0)
         K = pl.num_programs(0)
 
@@ -611,6 +687,8 @@ def make_gossip_chunk(topo: Topology, cfg: SimConfig, *, interpret: bool = False
             c_v[:] = c0[:]
             flags[0] = done_flag(c0[:], start_ref[0] - 1)
             flags[1] = jnp.int32(0)
+            if telemetry:
+                trow[:] = jnp.zeros((1, LANES), jnp.float32)
 
         active_chunk = (flags[0] == 0) & (start_ref[0] + k < start_ref[1])
 
@@ -656,6 +734,36 @@ def make_gossip_chunk(topo: Topology, cfg: SimConfig, *, interpret: bool = False
             c_v[:] = conv_new
             flags[1] = flags[1] + 1
             flags[0] = done_flag(conv_new, start_ref[0] + k)
+            if telemetry:
+                conv_ct = jnp.sum(conv_new, dtype=jnp.int32)
+                if crashed:
+                    live = jnp.sum(alive.astype(jnp.int32), dtype=jnp.int32)
+                    conv_alive = jnp.sum(
+                        jnp.where(alive, conv_new, jnp.int32(0)),
+                        dtype=jnp.int32,
+                    )
+                    gap = faults_mod.quorum_need(live, quorum) - conv_alive
+                else:
+                    live = jnp.int32(layout.n)
+                    gap = target - conv_ct
+                act = jnp.sum(active_new, dtype=jnp.int32)
+                drops = jnp.float32(0)
+                if use_gate:
+                    pos = (
+                        jax.lax.broadcasted_iota(jnp.int32, (R, LANES), 0)
+                        * LANES
+                        + jax.lax.broadcasted_iota(jnp.int32, (R, LANES), 1)
+                    )
+                    fired = (gbits < thresh) & (pos < layout.n)
+                    if crashed:
+                        fired = fired & alive
+                    drops = jnp.sum(fired.astype(jnp.int32), dtype=jnp.int32)
+                trow[:] = telemetry_row(
+                    [conv_ct, live, gap, act, 0.0, 0.0, drops, 0.0]
+                )
+
+        if telemetry:
+            tele_o[:] = trow[:]
 
         @pl.when(k == K - 1)
         def _emit():
@@ -696,24 +804,35 @@ def make_gossip_chunk(topo: Topology, cfg: SimConfig, *, interpret: bool = False
             operands.append(death2d)
         in_specs += [plane] * 3
         operands += [cnt, act, cv]
+        out_shape = [i32, i32, i32, jax.ShapeDtypeStruct((1,), jnp.int32)]
+        out_specs = [
+            plane, plane, plane,
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ]
+        scratch = [
+            pltpu.VMEM((R, LANES), jnp.int32),
+            pltpu.VMEM((R, LANES), jnp.int32),
+            pltpu.VMEM((R, LANES), jnp.int32),
+            pltpu.SMEM((2,), jnp.int32),
+        ]
+        if cfg.telemetry:
+            out_shape.append(
+                jax.ShapeDtypeStruct((keys.shape[0], LANES), jnp.float32)
+            )
+            out_specs.append(pl.BlockSpec((1, LANES), lambda k: (k, 0)))
+            scratch.append(pltpu.VMEM((1, LANES), jnp.float32))
         outs = pl.pallas_call(
             kernel,
             grid=(keys.shape[0],),
-            out_shape=(i32, i32, i32, jax.ShapeDtypeStruct((1,), jnp.int32)),
+            out_shape=tuple(out_shape),
             in_specs=in_specs,
-            out_specs=(
-                plane, plane, plane,
-                pl.BlockSpec(memory_space=pltpu.SMEM),
-            ),
-            scratch_shapes=[
-                pltpu.VMEM((R, LANES), jnp.int32),
-                pltpu.VMEM((R, LANES), jnp.int32),
-                pltpu.VMEM((R, LANES), jnp.int32),
-                pltpu.SMEM((2,), jnp.int32),
-            ],
+            out_specs=tuple(out_specs),
+            scratch_shapes=scratch,
             interpret=interpret,
         )(*operands)
-        n2, a2, c2, meta = outs
+        n2, a2, c2, meta = outs[:4]
+        if cfg.telemetry:
+            return (n2, a2, c2), meta[0], outs[4]
         return (n2, a2, c2), meta[0]
 
     return chunk_fn, layout

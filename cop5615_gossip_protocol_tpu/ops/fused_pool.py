@@ -54,11 +54,13 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ..config import SimConfig
 from ..utils import compat
+from . import faults as faults_mod
 from .fused import (
     build_death2d,
     clamp_cap_and_pad,
     gate_round_keys,
     make_done_flag,
+    telemetry_row,
     threefry_bits_2d,
 )
 from .sampling import (
@@ -455,6 +457,11 @@ def make_pushsum_pool_chunk(
     death2d = build_death2d(cfg, topo.n, layout.n_pad)
     crashed = death2d is not None
     quorum = cfg.quorum
+    # Telemetry plane (ops/telemetry.py): per-round counter rows folded
+    # into a scratch register in the absorb phase and copied out one row
+    # per grid step. Python-level flag — off traces the identical kernel.
+    telemetry = cfg.telemetry
+    tmean = np.float32((topo.n - 1) / 2.0)
 
     def kernel(*refs):
         it = iter(refs)
@@ -466,10 +473,12 @@ def make_pushsum_pool_chunk(
         s_o, w_o, t_o, c_o, meta_o = (
             next(it), next(it), next(it), next(it), next(it)
         )
+        tele_o = next(it) if telemetry else None
         s_v, w_v, t_v, c_v, ds_v, dw_v, dc_v, flags, sems = (
             next(it), next(it), next(it), next(it), next(it), next(it),
             next(it), next(it), next(it),
         )
+        trow = next(it) if telemetry else None
         k = pl.program_id(0)
         K = pl.num_programs(0)
         gather_modn, _ = _make_gather_modn(layout, interpret)
@@ -500,6 +509,8 @@ def make_pushsum_pool_chunk(
             else:
                 flags[0] = jnp.where(jnp.sum(c_v[:], dtype=jnp.int32) >= target, jnp.int32(1), jnp.int32(0))
             flags[1] = jnp.int32(0)
+            if telemetry:
+                trow[:] = jnp.zeros((1, LANES), jnp.float32)
 
         active = (flags[0] == 0) & (start_ref[0] + k < start_ref[1])
 
@@ -510,7 +521,7 @@ def make_pushsum_pool_chunk(
             k2 = keys_ref[kk, 1]
             rnd = start_ref[0] + k
 
-            def p1(t, _):
+            def p1(t, acc):
                 r0 = t * TILE
                 choice = _choice_tile(k1, k2, t, P)
                 padm = (r0 + row_l) * LANES + lane >= N
@@ -532,9 +543,14 @@ def make_pushsum_pool_chunk(
                 dw_v[pl.ds(R + r0, TILE), :] = ws
                 dc_v[pl.ds(r0, TILE), :] = choice
                 dc_v[pl.ds(R + r0, TILE), :] = choice
-                return 0
+                if telemetry and use_gate:
+                    fired = (gbits < thresh) & ~padm
+                    if crashed:
+                        fired = fired & (death_ref[pl.ds(r0, TILE), :] > rnd)
+                    acc = acc + jnp.sum(fired.astype(jnp.int32), dtype=jnp.int32)
+                return acc
 
-            lax.fori_loop(0, T, p1, 0)
+            drops = lax.fori_loop(0, T, p1, jnp.int32(0))
 
             def p2(t, acc):
                 r0 = t * TILE
@@ -569,6 +585,36 @@ def make_pushsum_pool_chunk(
                 flags[0] = jnp.where(total == 0, jnp.int32(1), jnp.int32(0))
             else:
                 flags[0] = done_flag(total, rnd)
+            if telemetry:
+                # Row computed from the post-round resident planes (c_v
+                # already reflects the global latch above). Pad lanes carry
+                # conv 0 / w 1 by construction.
+                conv_plane = c_v[:]
+                conv_ct = jnp.sum(conv_plane, dtype=jnp.int32)
+                if crashed:
+                    alive = death_ref[:] > rnd
+                    live = jnp.sum(alive.astype(jnp.int32), dtype=jnp.int32)
+                    conv_alive = jnp.sum(
+                        jnp.where(alive, conv_plane, jnp.int32(0)),
+                        dtype=jnp.int32,
+                    )
+                    gap = faults_mod.quorum_need(live, quorum) - conv_alive
+                else:
+                    live = jnp.int32(N)
+                    gap = target - conv_ct
+                err = jnp.where(
+                    conv_plane != 0,
+                    jnp.abs(s_v[:] / w_v[:] - tmean),
+                    jnp.float32(0),
+                )
+                mae = jnp.sum(err) / jnp.maximum(conv_ct, 1)
+                mass = jnp.sum(w_v[:]) - jnp.float32(layout.n_pad)
+                trow[:] = telemetry_row(
+                    [conv_ct, live, gap, 0.0, mae, mass, drops, 0.0]
+                )
+
+        if telemetry:
+            tele_o[:] = trow[:]
 
         @pl.when(k == K - 1)
         def _emit():
@@ -610,35 +656,44 @@ def make_pushsum_pool_chunk(
             operands.append(death2d)
         in_specs += [pl.BlockSpec(memory_space=pl.ANY)] * 4
         operands += [s, w, t, c]
+        out_shape = [f32, f32, i32, i32, jax.ShapeDtypeStruct((1,), jnp.int32)]
+        out_specs = [
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ]
+        scratch = [
+            pltpu.VMEM((R, LANES), jnp.float32),
+            pltpu.VMEM((R, LANES), jnp.float32),
+            pltpu.VMEM((R, LANES), jnp.int32),
+            pltpu.VMEM((R, LANES), jnp.int32),
+            pltpu.VMEM((2 * R, LANES), jnp.float32),
+            pltpu.VMEM((2 * R, LANES), jnp.float32),
+            pltpu.VMEM((2 * R, LANES), jnp.int32),
+            pltpu.SMEM((2,), jnp.int32),
+            pltpu.SemaphoreType.DMA((4,)),
+        ]
+        if cfg.telemetry:
+            out_shape.append(jax.ShapeDtypeStruct((K, LANES), jnp.float32))
+            out_specs.append(pl.BlockSpec((1, LANES), lambda k: (k, 0)))
+            scratch.append(pltpu.VMEM((1, LANES), jnp.float32))
         outs = pl.pallas_call(
             kernel,
             grid=(K,),
-            out_shape=(f32, f32, i32, i32, jax.ShapeDtypeStruct((1,), jnp.int32)),
+            out_shape=tuple(out_shape),
             in_specs=in_specs,
-            out_specs=(
-                pl.BlockSpec(memory_space=pl.ANY),
-                pl.BlockSpec(memory_space=pl.ANY),
-                pl.BlockSpec(memory_space=pl.ANY),
-                pl.BlockSpec(memory_space=pl.ANY),
-                pl.BlockSpec(memory_space=pltpu.SMEM),
-            ),
-            scratch_shapes=[
-                pltpu.VMEM((R, LANES), jnp.float32),
-                pltpu.VMEM((R, LANES), jnp.float32),
-                pltpu.VMEM((R, LANES), jnp.int32),
-                pltpu.VMEM((R, LANES), jnp.int32),
-                pltpu.VMEM((2 * R, LANES), jnp.float32),
-                pltpu.VMEM((2 * R, LANES), jnp.float32),
-                pltpu.VMEM((2 * R, LANES), jnp.int32),
-                pltpu.SMEM((2,), jnp.int32),
-                pltpu.SemaphoreType.DMA((4,)),
-            ],
+            out_specs=tuple(out_specs),
+            scratch_shapes=scratch,
             compiler_params=compat.pallas_tpu_compiler_params(
                 vmem_limit_bytes=120 * 1024 * 1024
             ),
             interpret=interpret,
         )(*operands)
-        s2, w2, t2, c2, meta = outs
+        s2, w2, t2, c2, meta = outs[:5]
+        if cfg.telemetry:
+            return (s2, w2, t2, c2), meta[0], outs[5]
         return (s2, w2, t2, c2), meta[0]
 
     return chunk_fn, layout
@@ -665,6 +720,7 @@ def make_gossip_pool_chunk(
     death2d = build_death2d(cfg, topo.n, layout.n_pad)
     crashed = death2d is not None
     quorum = cfg.quorum
+    telemetry = cfg.telemetry  # see make_pushsum_pool_chunk
 
     def kernel(*refs):
         it = iter(refs)
@@ -674,9 +730,11 @@ def make_gossip_pool_chunk(
         death_ref = next(it) if crashed else None
         n0, a0, c0 = next(it), next(it), next(it)
         n_o, a_o, c_o, meta_o = next(it), next(it), next(it), next(it)
+        tele_o = next(it) if telemetry else None
         n_v, a_v, c_v, dch_v, flags, sems = (
             next(it), next(it), next(it), next(it), next(it), next(it)
         )
+        trow = next(it) if telemetry else None
         k = pl.program_id(0)
         K = pl.num_programs(0)
         _, gather_plain_modn = _make_gather_modn(layout, interpret)
@@ -697,6 +755,8 @@ def make_gossip_pool_chunk(
             else:
                 flags[0] = jnp.where(jnp.sum(c_v[:], dtype=jnp.int32) >= target, jnp.int32(1), jnp.int32(0))
             flags[1] = jnp.int32(0)
+            if telemetry:
+                trow[:] = jnp.zeros((1, LANES), jnp.float32)
 
         active_chunk = (flags[0] == 0) & (start_ref[0] + k < start_ref[1])
 
@@ -707,7 +767,7 @@ def make_gossip_pool_chunk(
             k2 = keys_ref[kk, 1]
             rnd = start_ref[0] + k
 
-            def p1(t, _):
+            def p1(t, acc):
                 r0 = t * TILE
                 choice = _choice_tile(k1, k2, t, P)
                 jflat = (r0 + row_l) * LANES + lane
@@ -727,9 +787,14 @@ def make_gossip_pool_chunk(
                 marked = jnp.where(sending, choice, jnp.int32(-1))
                 dch_v[pl.ds(r0, TILE), :] = marked
                 dch_v[pl.ds(R + r0, TILE), :] = marked
-                return 0
+                if telemetry and use_gate:
+                    fired = (gbits < thresh) & ~padm
+                    if crashed:
+                        fired = fired & (death_ref[pl.ds(r0, TILE), :] > rnd)
+                    acc = acc + jnp.sum(fired.astype(jnp.int32), dtype=jnp.int32)
+                return acc
 
-            lax.fori_loop(0, T, p1, 0)
+            drops = lax.fori_loop(0, T, p1, jnp.int32(0))
 
             def p2(t, acc):
                 r0 = t * TILE
@@ -751,6 +816,27 @@ def make_gossip_pool_chunk(
             total = lax.fori_loop(0, T, p2, jnp.int32(0))
             flags[1] = flags[1] + 1
             flags[0] = done_flag(total, rnd)
+            if telemetry:
+                conv_plane = c_v[:]
+                conv_ct = jnp.sum(conv_plane, dtype=jnp.int32)
+                if crashed:
+                    alive = death_ref[:] > rnd
+                    live = jnp.sum(alive.astype(jnp.int32), dtype=jnp.int32)
+                    conv_alive = jnp.sum(
+                        jnp.where(alive, conv_plane, jnp.int32(0)),
+                        dtype=jnp.int32,
+                    )
+                    gap = faults_mod.quorum_need(live, quorum) - conv_alive
+                else:
+                    live = jnp.int32(N)
+                    gap = target - conv_ct
+                act = jnp.sum(a_v[:], dtype=jnp.int32)
+                trow[:] = telemetry_row(
+                    [conv_ct, live, gap, act, 0.0, 0.0, drops, 0.0]
+                )
+
+        if telemetry:
+            tele_o[:] = trow[:]
 
         @pl.when(k == K - 1)
         def _emit():
@@ -792,24 +878,32 @@ def make_gossip_pool_chunk(
             operands.append(death2d)
         in_specs += [pl.BlockSpec(memory_space=pl.ANY)] * 3
         operands += [cnt, act, cv]
+        out_shape = [i32, i32, i32, jax.ShapeDtypeStruct((1,), jnp.int32)]
+        out_specs = [
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ]
+        if cfg.telemetry:
+            out_shape.append(jax.ShapeDtypeStruct((K, LANES), jnp.float32))
+            out_specs.append(pl.BlockSpec((1, LANES), lambda k: (k, 0)))
+            scratch.append(pltpu.VMEM((1, LANES), jnp.float32))
         outs = pl.pallas_call(
             kernel,
             grid=(K,),
-            out_shape=(i32, i32, i32, jax.ShapeDtypeStruct((1,), jnp.int32)),
+            out_shape=tuple(out_shape),
             in_specs=in_specs,
-            out_specs=(
-                pl.BlockSpec(memory_space=pl.ANY),
-                pl.BlockSpec(memory_space=pl.ANY),
-                pl.BlockSpec(memory_space=pl.ANY),
-                pl.BlockSpec(memory_space=pltpu.SMEM),
-            ),
+            out_specs=tuple(out_specs),
             scratch_shapes=scratch,
             compiler_params=compat.pallas_tpu_compiler_params(
                 vmem_limit_bytes=120 * 1024 * 1024
             ),
             interpret=interpret,
         )(*operands)
-        n2, a2, c2, meta = outs
+        n2, a2, c2, meta = outs[:4]
+        if cfg.telemetry:
+            return (n2, a2, c2), meta[0], outs[4]
         return (n2, a2, c2), meta[0]
 
     return chunk_fn, layout

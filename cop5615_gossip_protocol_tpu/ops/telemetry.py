@@ -1,0 +1,344 @@
+"""In-program telemetry plane — per-round counters accumulated ON DEVICE.
+
+The legacy observability path (`--trace-convergence` before this module)
+hooked the chunk boundary and paid a blocking device->host sync per chunk
+for each counter — and, because chunk hooks read retired state, it silently
+disabled the buffer donation and speculative pipelining the chunk drivers
+rely on: you could have trajectories or performance, not both. The fix is
+the Ising-on-TPU move (arxiv 1903.11714, PAPERS.md): fold the measurement
+into the device program. Each engine's chunk accumulates one small float32
+counter row per executed round into a fixed ``(chunk_rounds, N_COLS)``
+buffer that rides OUT of the chunk alongside the termination predicate
+scalars — outside the donated state carry, so it stays readable after the
+next chunk recycles the state buffers — and is fetched asynchronously by
+the pipelined driver (models/pipeline.py ``on_aux``) with no extra host
+round-trips. ``cfg.telemetry`` is a Python-level flag: off (the default)
+traces the bitwise-identical program as a build without this module, so
+the golden trajectories stay pinned (tests/test_telemetry.py).
+
+Column schema (SCHEMA_VERSION, all float32 — counts are exact below 2**24;
+the 16.8M-node tiers round their counts in the last bits):
+
+    0 converged_count  sum of the conv plane (all nodes, dead included —
+                       conv latches through a crash, matching RunResult)
+    1 live_count       nodes alive AFTER this round (population without a
+                       crash model)
+    2 progress_gap     signed distance to the termination predicate — the
+                       stall watchdog's metric (models/runner._progress_gap):
+                       target − conv, or quorum_need(live) − conv-among-live
+    3 active_count     gossip: nodes that have heard the rumor; 0 for
+                       push-sum
+    4 estimate_mae     push-sum: mean |s/w − true_mean| over converged
+                       nodes; 0 for gossip
+    5 mass_residual    push-sum: Σw − population, the conservation
+                       observable (0 in a fault-free run; in-flight delay-
+                       ring mass and dup-created mass show up here); 0 for
+                       gossip
+    6 drop_count       fault-gate firings among live nodes this round
+                       (an upper bound on dropped sends — a gated node
+                       with nothing to send drops nothing); 0 at
+                       fault_rate=0. Counted by every supporting engine
+                       (the sharded row re-draws the padded gate and
+                       psums the shard counts).
+    7 dup_count        dup-gate firings among live nodes (chunked
+                       scatter/stencil engines only — the only ones that
+                       support --dup-rate); 0 elsewhere
+
+Engine support: the chunked XLA engine, the sharded engine (rows are
+in-trace ``psum`` reductions, so every device carries the identical
+replicated counter block), the fused stencil and fused pool Pallas kernels
+(rows computed in-kernel from the VMEM-resident planes), and the vmapped
+replica sweep (per-replica trajectories out of ONE program). The streaming
+HBM tiers and the sharded fused compositions reject ``cfg.telemetry``
+loudly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..config import SimConfig
+from . import faults as faults_mod
+from . import sampling
+from .topology import Topology
+
+SCHEMA_VERSION = 1
+
+COLUMNS = (
+    "converged_count",
+    "live_count",
+    "progress_gap",
+    "active_count",
+    "estimate_mae",
+    "mass_residual",
+    "drop_count",
+    "dup_count",
+)
+N_COLS = len(COLUMNS)
+
+COL_CONV = 0
+COL_LIVE = 1
+COL_GAP = 2
+COL_ACTIVE = 3
+COL_MAE = 4
+COL_MASS = 5
+COL_DROPS = 6
+COL_DUPS = 7
+
+
+def true_mean(n: int) -> float:
+    """Push-sum ground truth: node i holds value i, so the mean is
+    (n-1)/2 — the quantity estimate_mae measures against."""
+    return (n - 1) / 2.0
+
+
+def make_row_fn(topo: Topology, cfg: SimConfig, base_key):
+    """Build ``row_fn(proto_state, round_idx, key_data) -> float32[N_COLS]``
+    for the single-device chunked engine (and, vmapped over key_data, the
+    replica sweep — the crash plane is config-pure, so one row_fn serves
+    every replica).
+
+    The row is traced INSIDE the chunk program: every quantity is a small
+    reduction over state already in registers/VMEM, and the drop/dup
+    counters regenerate the per-round gate words from the round key (the
+    same counter-based stream the round itself consumed) rather than
+    threading the gates out of the round function.
+    """
+    n = topo.n
+    target = cfg.resolved_target_count(topo.n, topo.target_count)
+    pushsum = cfg.algorithm == "push-sum"
+    tmean = jnp.float32(true_mean(n))
+    death = faults_mod.death_plane(cfg, n)
+    death_dev = None if death is None else jnp.asarray(death)
+    _, key_impl = sampling.key_split(base_key)
+    quorum = cfg.quorum
+    fault_rate = cfg.fault_rate
+    dup_rate = cfg.dup_rate
+
+    def row_fn(state, round_idx, key_data):
+        conv_i = jnp.asarray(state.conv).astype(jnp.int32)
+        conv_ct = jnp.sum(conv_i)
+        if death_dev is None:
+            alive = None
+            live = jnp.int32(n)
+            gap = jnp.int32(target) - conv_ct
+        else:
+            alive = death_dev > round_idx
+            live = jnp.sum(alive.astype(jnp.int32))
+            conv_alive = jnp.sum(jnp.where(alive, conv_i, jnp.int32(0)))
+            gap = faults_mod.quorum_need(live, quorum) - conv_alive
+        if pushsum:
+            act = jnp.float32(0)
+            w_safe = jnp.where(state.w != 0, state.w, 1)
+            ratio = jnp.where(state.w != 0, state.s / w_safe, 0.0)
+            err = jnp.where(conv_i != 0, jnp.abs(ratio - tmean), 0.0)
+            mae = (jnp.sum(err) / jnp.maximum(conv_ct, 1)).astype(jnp.float32)
+            mass = (jnp.sum(state.w) - n).astype(jnp.float32)
+        else:
+            act = jnp.sum(jnp.asarray(state.active).astype(jnp.int32))
+            act = act.astype(jnp.float32)
+            mae = jnp.float32(0)
+            mass = jnp.float32(0)
+        drops = jnp.float32(0)
+        dups = jnp.float32(0)
+        if fault_rate > 0 or dup_rate > 0:
+            kr = sampling.round_key(
+                sampling.key_join(key_data, key_impl), round_idx
+            )
+            live_mask = True if alive is None else alive
+            gate = sampling.send_gate(kr, n, fault_rate)
+            if gate is not True:
+                fired = ~gate if live_mask is True else (~gate & live_mask)
+                drops = jnp.sum(fired.astype(jnp.int32)).astype(jnp.float32)
+            dup = sampling.dup_gate(kr, n, dup_rate)
+            if dup is not False:
+                fired = dup if live_mask is True else (dup & live_mask)
+                dups = jnp.sum(fired.astype(jnp.int32)).astype(jnp.float32)
+        return jnp.stack([
+            conv_ct.astype(jnp.float32),
+            live.astype(jnp.float32),
+            gap.astype(jnp.float32),
+            act, mae, mass, drops, dups,
+        ])
+
+    return row_fn
+
+
+def make_sharded_row_fn(
+    topo: Topology, cfg: SimConfig, n_pad: int, n_loc: int,
+    axis_name: str, death_full, key_impl,
+):
+    """Sharded analog of ``make_row_fn``: operates on a device's [n_loc]
+    state shard and reduces every column with an in-trace ``psum``, so the
+    counter block is replicated — identical on every device (and every
+    process), exactly like the termination predicate scalars. Pad slots
+    carry conv 0 / active 0 / w 1 / death round 0, so the only correction
+    needed is the mass column's pad weight. Runs inside the shard_mapped
+    chunk body (models/pipeline fetches the block asynchronously like any
+    aux output)."""
+    from jax import lax
+
+    n = topo.n
+    target = cfg.resolved_target_count(topo.n, topo.target_count)
+    pushsum = cfg.algorithm == "push-sum"
+    tmean = jnp.float32(true_mean(n))
+    quorum = cfg.quorum
+    fault_rate = cfg.fault_rate
+
+    def psum_i(x):
+        return lax.psum(jnp.sum(x.astype(jnp.int32)), axis_name)
+
+    def row_fn(state, round_idx, key_data):
+        dev = lax.axis_index(axis_name)
+        start = dev * n_loc
+        conv_i = jnp.asarray(state.conv).astype(jnp.int32)
+        conv_ct = lax.psum(jnp.sum(conv_i), axis_name)
+        if death_full is None:
+            alive = None
+            live = jnp.int32(n)
+            gap = jnp.int32(target) - conv_ct
+        else:
+            alive = lax.dynamic_slice(death_full, (start,), (n_loc,)) > round_idx
+            live = psum_i(alive)
+            conv_alive = lax.psum(
+                jnp.sum(jnp.where(alive, conv_i, jnp.int32(0))), axis_name
+            )
+            gap = faults_mod.quorum_need(live, quorum) - conv_alive
+        if pushsum:
+            act = jnp.float32(0)
+            w_safe = jnp.where(state.w != 0, state.w, 1)
+            ratio = jnp.where(state.w != 0, state.s / w_safe, 0.0)
+            err = jnp.where(conv_i != 0, jnp.abs(ratio - tmean), 0.0)
+            mae = (
+                lax.psum(jnp.sum(err), axis_name)
+                / jnp.maximum(conv_ct, 1)
+            ).astype(jnp.float32)
+            # Pad slots carry weight 1 by construction (parallel/sharded.py
+            # state0 fills), so the padded total exceeds the real one by
+            # exactly n_pad - n.
+            mass = (lax.psum(jnp.sum(state.w), axis_name) - n_pad).astype(
+                jnp.float32
+            )
+        else:
+            act = psum_i(jnp.asarray(state.active)).astype(jnp.float32)
+            mae = jnp.float32(0)
+            mass = jnp.float32(0)
+        drops = jnp.float32(0)
+        if fault_rate > 0:
+            kr = sampling.round_key(
+                sampling.key_join(key_data, key_impl), round_idx
+            )
+            gate_full = sampling.send_gate(kr, n_pad, fault_rate)
+            gate = lax.dynamic_slice(gate_full, (start,), (n_loc,))
+            gids = start + jnp.arange(n_loc, dtype=jnp.int32)
+            fired = ~gate & (gids < n)
+            if alive is not None:
+                fired = fired & alive
+            drops = psum_i(fired).astype(jnp.float32)
+        # dup_count: the sharded engine rejects --dup-rate, so the column
+        # is structurally 0 here.
+        return jnp.stack([
+            conv_ct.astype(jnp.float32),
+            live.astype(jnp.float32),
+            gap.astype(jnp.float32),
+            act, mae, mass, drops, jnp.float32(0),
+        ])
+
+    return row_fn
+
+
+def rows_to_trace_records(
+    data: np.ndarray, start_round: int, algorithm: str, prev_conv: int = 0
+) -> list:
+    """Per-round records in the legacy ``--trace-convergence`` JSONL schema
+    for counter rows ``data`` whose first row follows absolute round
+    ``start_round``: rounds / converged_count / newly_converged plus
+    active_count (gossip) or estimate_mae (push-sum). ``prev_conv`` is the
+    newly_converged baseline (the converged count just before these rows —
+    the checkpoint's count on resume, the previous chunk's when streaming).
+    """
+    out = []
+    prev = int(prev_conv)
+    pushsum = algorithm == "push-sum"
+    for i in range(data.shape[0]):
+        row = data[i]
+        conv = int(row[COL_CONV])
+        rec = {
+            "rounds": start_round + i + 1,
+            "converged_count": conv,
+            "newly_converged": conv - prev,
+        }
+        prev = conv
+        if pushsum:
+            rec["estimate_mae"] = float(row[COL_MAE])
+        else:
+            rec["active_count"] = int(row[COL_ACTIVE])
+        out.append(rec)
+    return out
+
+
+@dataclasses.dataclass
+class TelemetryTrajectory:
+    """Host-side result of one run's telemetry plane: ``data[i]`` is the
+    counter row AFTER absolute round ``start_round + i`` executed (resume
+    starts mid-stream, so ``start_round`` is not always 0)."""
+
+    start_round: int
+    data: np.ndarray  # [rounds_executed, N_COLS] float32
+    schema_version: int = SCHEMA_VERSION
+    columns: tuple = COLUMNS
+
+    @property
+    def rounds(self) -> int:
+        return int(self.data.shape[0])
+
+    def to_trace_records(self, algorithm: str, prev_conv: int = 0) -> list:
+        """Per-round records in the legacy ``--trace-convergence`` JSONL
+        schema (same field names the chunk-boundary hook emitted, now at
+        round granularity) — see rows_to_trace_records. ``prev_conv``
+        seeds the newly_converged baseline on resume — nodes converged
+        before the checkpoint are not newly converged here."""
+        return rows_to_trace_records(
+            self.data, self.start_round, algorithm, prev_conv
+        )
+
+
+class Collector:
+    """Host-side accumulator wired into models/pipeline.run_chunks as the
+    ``on_aux`` callback: at each RETIRED chunk it receives the chunk's
+    counter buffer (already en route to the host via the async prefetch
+    hint), slices the rows the chunk actually executed, and drops the rest
+    (overshoot/no-op rows are stale repeats, never data). Reads no protocol
+    state, so it composes with buffer donation — the whole point.
+
+    ``on_rows(chunk_start_round, rows)``, when given, fires at each retired
+    chunk with that chunk's fresh row slice — the streaming consumer hook
+    (the CLI's incremental trace writer): a killed run's trace file then
+    holds every retired chunk's rounds, matching the event log's
+    kill-durability instead of losing the whole trajectory."""
+
+    def __init__(self, start_round: int = 0, on_rows=None):
+        self._start = int(start_round)
+        self._parts: list = []
+        self._on_rows = on_rows
+
+    def on_aux(self, rounds_before: int, rounds_after: int, aux) -> None:
+        executed = int(rounds_after) - int(rounds_before)
+        if executed <= 0:
+            return
+        buf = np.asarray(aux)
+        rows = np.array(buf[:executed, :N_COLS], dtype=np.float32)
+        self._parts.append(rows)
+        if self._on_rows is not None:
+            self._on_rows(int(rounds_before), rows)
+
+    def finalize(self) -> TelemetryTrajectory:
+        if not self._parts:
+            data = np.zeros((0, N_COLS), np.float32)
+        else:
+            data = np.concatenate(self._parts, axis=0)
+        return TelemetryTrajectory(start_round=self._start, data=data)

@@ -88,6 +88,12 @@ def plan_fused_pool_sharded(topo: Topology, cfg: SimConfig, n_dev: int):
     reason = pool_common_support(topo, cfg)
     if reason is not None:
         return reason
+    if cfg.telemetry:
+        return (
+            "telemetry counters run in the single-device fused kernels and "
+            "the chunked/sharded XLA engines; this composition does not "
+            "carry the counter block"
+        )
     layout = build_pool_layout(topo.n)
     R = layout.rows
     if R % n_dev != 0 or (R // n_dev) % TILE != 0:

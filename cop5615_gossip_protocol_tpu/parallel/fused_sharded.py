@@ -155,6 +155,12 @@ def plan_fused_sharded(topo: Topology, cfg: SimConfig, n_dev: int):
         return "fused engine supports float32 only"
     if not jax.config.jax_threefry_partitionable:
         return "requires jax_threefry_partitionable=True"
+    if cfg.telemetry:
+        return (
+            "telemetry counters run in the single-device fused kernels and "
+            "the chunked/sharded XLA engines; this composition does not "
+            "carry the counter block"
+        )
     if cfg.faulted:
         # No failure-model support in this engine yet — rejecting on
         # the aggregate flag (not just fault_rate) keeps a crash/dup/

@@ -87,6 +87,7 @@ from ..models.runner import (
 from ..models import pipeline as pipeline_mod
 from ..ops import faults as faults_mod
 from ..ops import sampling
+from ..ops import telemetry as telemetry_mod
 from ..ops.topology import Topology, imp_split
 from ..utils import compat
 from . import halo as halo_mod
@@ -108,6 +109,7 @@ def run_sharded(
     on_chunk: Optional[Callable[[int, object], None]] = None,
     start_state=None,
     start_round: int = 0,
+    on_telemetry: Optional[Callable[[int, object], None]] = None,
 ) -> RunResult:
     """Sharded analog of models.runner.run — same config, same result.
     ``start_state`` (unpadded, from utils/checkpoint.py) resumes a run;
@@ -529,13 +531,28 @@ def run_sharded(
 
     # --- chunked while_loop under shard_map -------------------------------
 
+    # Telemetry plane: each executed round psums one counter row into a
+    # replicated (chunk_rounds, N_COLS) block that rides out of the chunk
+    # next to the predicate scalars (ops/telemetry.py — the "in-trace psum
+    # of the counter block"). Python-level flag: off traces the identical
+    # program as before.
+    telemetry = cfg.telemetry
+    tele_row = (
+        telemetry_mod.make_sharded_row_fn(
+            topo, cfg, n_pad, n_loc, NODE_AXIS, death_full, key_impl
+        )
+        if telemetry else None
+    )
+    stride = cfg.chunk_rounds
+
     def chunk_local(state_in, rnd_in, done_in, round_end, key_data, *targs):
+        rnd0_in = rnd_in  # loop-entry round: telemetry rows index from here
+
         def cond(c):
-            _, rnd, done = c
-            return jnp.logical_and(~done, rnd < round_end)
+            return jnp.logical_and(~c[2], c[1] < round_end)
 
         def body(c):
-            state, rnd, _ = c
+            state, rnd = c[0], c[1]
             state = round_fn(state, rnd, key_data, *targs)
             if death_full is None:
                 conv_count = lax.psum(jnp.sum(state.conv), NODE_AXIS)
@@ -556,21 +573,33 @@ def run_sharded(
                 done = conv_alive >= faults_mod.quorum_need(
                     alive_count, cfg.quorum
                 )
-            return (state, rnd + 1, done)
+            out = (state, rnd + 1, done)
+            if telemetry:
+                row = tele_row(state, rnd, key_data)
+                out += (lax.dynamic_update_index_in_dim(
+                    c[3], row, rnd - rnd0_in, 0
+                ),)
+            return out
 
-        return lax.while_loop(cond, body, (state_in, rnd_in, done_in))
+        carry = (state_in, rnd_in, done_in)
+        if telemetry:
+            carry += (jnp.zeros((stride, telemetry_mod.N_COLS), jnp.float32),)
+        return lax.while_loop(cond, body, carry)
 
     state_specs = jax.tree.map(lambda _: P(NODE_AXIS), state0)
     # Donation (models/pipeline.py): each chunk's output shards alias the
     # input's buffers. Off when retired state must stay readable (chunk
     # hooks / stall watchdog).
     donate = on_chunk is None and not cfg.stall_chunks
+    out_specs = (state_specs, P(), P())
+    if telemetry:
+        out_specs += (P(),)  # replicated counter block
     chunk_sharded = jax.jit(
         compat.shard_map(
             chunk_local,
             mesh=mesh,
             in_specs=(state_specs, P(), P(), P(), P()) + topo_specs,
-            out_specs=(state_specs, P(), P()),
+            out_specs=out_specs,
             check_vma=False,
         ),
         donate_argnums=(0,) if donate else (),
@@ -623,12 +652,18 @@ def run_sharded(
                 )
             )
 
+    collector = (
+        telemetry_mod.Collector(start_round, on_rows=on_telemetry)
+        if telemetry else None
+    )
+
     t1 = time.perf_counter()
     loop = pipeline_mod.run_chunks(
         dispatch=dispatch, state0=state0, rnd0=rnd0, done0=done0_dev,
         start_round=start_round, max_rounds=cfg.max_rounds,
         stride=cfg.chunk_rounds, depth=cfg.pipeline_chunks, donate=donate,
         on_retire=on_retire, should_stop=should_stop,
+        on_aux=collector.on_aux if collector else None,
     )
     run_s = time.perf_counter() - t1
 
@@ -652,7 +687,12 @@ def run_sharded(
             "converged" if converged
             else ("stalled" if stalled else "max_rounds")
         ),
+        dispatch_s=loop.dispatch_s,
+        fetch_s=loop.fetch_s,
+        chunk_log=loop.chunk_log,
     )
+    if collector is not None:
+        result.telemetry = collector.finalize()
     if cfg.algorithm == "push-sum":
         # jnp reductions, not host numpy: when the mesh spans processes the
         # state arrays are not host-addressable, but every process can run

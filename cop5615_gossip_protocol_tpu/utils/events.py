@@ -1,0 +1,96 @@
+"""Structured run-event log — schema-versioned JSONL lifecycle events.
+
+The reference's lifecycle story is stdout banners and Environment.Exit
+(program.fs:50-60); the run record (utils/metrics.run_record) captures the
+END of a run but nothing about its shape in time. This log captures the
+in-between as append-only JSONL, one event per line, each line flushed and
+fsynced (metrics.append_jsonl) so a killed run's log is complete up to the
+kill — the observability counterpart of the crash-only-restarts checkpoint
+workflow.
+
+Event vocabulary (the ``event`` field; every line also carries
+``schema_version``, ``t_wall`` — seconds since the epoch — and ``t_run`` —
+seconds since the log was opened):
+
+  run-start               config + population, once, first
+  crash-schedule-applied  the failure plane in force (crash_rate/schedule,
+                          quorum) — emitted at start so a log is
+                          self-describing about its churn
+  resume                  checkpoint path + round the run restarted from
+  checkpoint-written      rounds + path, at each sidecar write
+  chunk-retired           per retired chunk, in order: rounds at the
+                          boundary plus the driver's dispatch_s/fetch_s
+                          timing split (models/pipeline.ChunkLoopResult
+                          .chunk_log)
+  watchdog-fired          the stall watchdog ended the run (rounds)
+  run-end                 outcome, rounds, wall/compile/dispatch/fetch
+                          splits, once, last
+
+Consumers detect format drift via ``schema_version`` — bump EVENT_SCHEMA_
+VERSION whenever a field changes meaning, never reuse a name.
+"""
+
+from __future__ import annotations
+
+import time
+from pathlib import Path
+
+from . import metrics
+
+EVENT_SCHEMA_VERSION = 1
+
+
+class RunEventLog:
+    """Append-only event writer. One instance per run; ``emit`` is cheap
+    enough for per-chunk events but is never called from inside the chunk
+    hot path — chunk-retired events are emitted post-run from the driver's
+    chunk_log, so the log cannot de-optimize the pipelined engines."""
+
+    def __init__(self, path: str | Path):
+        self.path = Path(path)
+        self._t0 = time.perf_counter()
+
+    def emit(self, event: str, **fields) -> None:
+        metrics.append_jsonl(self.path, {
+            "schema_version": EVENT_SCHEMA_VERSION,
+            "event": event,
+            "t_wall": time.time(),
+            "t_run": time.perf_counter() - self._t0,
+            **fields,
+        })
+
+    def emit_chunks(self, chunk_log) -> None:
+        """chunk-retired events from the driver's per-chunk timing log, in
+        retire order (one batched write, one fsync)."""
+        if not chunk_log:
+            return
+        t_wall = time.time()
+        t_run = time.perf_counter() - self._t0
+        metrics.append_jsonl_many(self.path, ({
+            "schema_version": EVENT_SCHEMA_VERSION,
+            "event": "chunk-retired",
+            "t_wall": t_wall,
+            "t_run": t_run,
+            "chunk": i,
+            **entry,
+        } for i, entry in enumerate(chunk_log)))
+
+
+def read_events(path: str | Path) -> list:
+    """Parse an event log back (tests + ad-hoc analysis). Refuses a file
+    from a NEWER schema than this build understands."""
+    import json
+
+    out = []
+    for line in Path(path).read_text().splitlines():
+        if not line.strip():
+            continue
+        rec = json.loads(line)
+        if rec.get("schema_version", 0) > EVENT_SCHEMA_VERSION:
+            raise ValueError(
+                f"event log {path} uses schema "
+                f"{rec.get('schema_version')}; this build reads up to "
+                f"{EVENT_SCHEMA_VERSION}"
+            )
+        out.append(rec)
+    return out

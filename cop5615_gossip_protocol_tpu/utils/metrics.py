@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 from pathlib import Path
 from typing import TYPE_CHECKING, Optional
 
@@ -20,6 +21,14 @@ if TYPE_CHECKING:  # type-only: keeps this module importable without JAX
     from ..config import SimConfig
     from ..models.runner import RunResult
     from ..ops.topology import Topology
+
+# Format version of the structured run record (RunResult.to_record /
+# run_record JSONL lines), bumped whenever fields change meaning or move,
+# so downstream consumers detect drift instead of mis-parsing. History:
+#   1 — implicit (unversioned) records through PR 2
+#   2 — schema_version field itself, dispatch_s/fetch_s per-chunk timing
+#       splits, telemetry plane fields
+RUN_RECORD_SCHEMA_VERSION = 2
 
 
 def banner(cfg: SimConfig) -> str:
@@ -53,6 +62,7 @@ def run_record(
     cfg: SimConfig, topo: Topology, result: RunResult, extra: Optional[dict] = None
 ) -> dict:
     rec = {
+        "schema_version": RUN_RECORD_SCHEMA_VERSION,
         "config": dataclasses.asdict(cfg),
         "topology_kind": topo.kind,
         "population": topo.n,
@@ -66,7 +76,25 @@ def run_record(
 
 
 def append_jsonl(path: str | Path, record: dict) -> None:
+    """Append one record, flushed and fsynced before returning: a consumer
+    tailing the file (or a run killed right after) never sees a torn line —
+    the durability contract the run-event log (utils/events.py) relies on."""
     p = Path(path)
     p.parent.mkdir(parents=True, exist_ok=True)
     with p.open("a") as f:
         f.write(json.dumps(record) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def append_jsonl_many(path: str | Path, records) -> None:
+    """Batch append with ONE flush+fsync for the whole batch — the
+    per-round telemetry trajectory writer (thousands of lines per run)
+    would otherwise pay a disk sync per round."""
+    p = Path(path)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    with p.open("a") as f:
+        for record in records:
+            f.write(json.dumps(record) + "\n")
+        f.flush()
+        os.fsync(f.fileno())

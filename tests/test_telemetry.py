@@ -1,0 +1,599 @@
+"""In-program telemetry plane (ops/telemetry.py): telemetry-on runs trace
+the SAME trajectories as telemetry-off (the plane observes, never
+perturbs), counters agree with independently computed chunk-boundary
+values, donation + speculative pipelining survive telemetry (the whole
+point — the legacy trace hook disabled both), the run-event log round-trips
+its schema, and the trajectory analyzer reduces real JSONL.
+"""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from cop5615_gossip_protocol_tpu import SimConfig, build_topology, run
+from cop5615_gossip_protocol_tpu.models import pipeline as pipeline_mod
+from cop5615_gossip_protocol_tpu.models.sweep import run_replicas
+from cop5615_gossip_protocol_tpu.ops import telemetry as telemetry_mod
+from cop5615_gossip_protocol_tpu.ops.telemetry import (
+    COL_ACTIVE,
+    COL_CONV,
+    COL_DROPS,
+    COL_GAP,
+    COL_LIVE,
+    COL_MAE,
+    N_COLS,
+)
+from cop5615_gossip_protocol_tpu.utils import events as events_mod
+
+
+def _run_pair(kind, n, **cfg_kwargs):
+    """(result_on, result_off, boundary-states-on, boundary-states-off):
+    the same config with and without telemetry, boundary states captured
+    via the checkpoint hook for bitwise comparison."""
+    topo = build_topology(kind, n, seed=cfg_kwargs.get("seed", 0))
+    out = []
+    for tele in (True, False):
+        cfg = SimConfig(n=n, topology=kind, telemetry=tele, **cfg_kwargs)
+        bounds = []
+
+        def hook(rounds, state, bounds=bounds):
+            bounds.append((rounds, jax.tree.map(np.asarray, state)))
+
+        out.append((run(topo, cfg, on_chunk=hook), bounds))
+    (res_on, b_on), (res_off, b_off) = out
+    return res_on, res_off, b_on, b_off
+
+
+def _assert_bitwise(res_on, res_off, b_on, b_off):
+    assert res_on.rounds == res_off.rounds
+    assert res_on.converged_count == res_off.converged_count
+    assert res_on.outcome == res_off.outcome
+    assert [r for r, _ in b_on] == [r for r, _ in b_off]
+    for (_, sa), (_, sb) in zip(b_on, b_off):
+        for f in sa._fields:
+            np.testing.assert_array_equal(
+                getattr(sa, f), getattr(sb, f), err_msg=f
+            )
+
+
+# ------------------------------------------------ on/off bitwise per engine
+
+
+def test_chunked_scatter_on_off_bitwise():
+    res_on, res_off, b_on, b_off = _run_pair(
+        "full", 64, algorithm="gossip", seed=3, chunk_rounds=7,
+        delivery="scatter",
+    )
+    _assert_bitwise(res_on, res_off, b_on, b_off)
+    t = res_on.telemetry
+    assert t is not None and res_off.telemetry is None
+    assert t.data.shape == (res_on.rounds, N_COLS)
+    assert t.data[-1][COL_CONV] == 64
+    # conv is a latch: the trajectory must be monotone.
+    assert (np.diff(t.data[:, COL_CONV]) >= 0).all()
+
+
+def test_chunked_pushsum_pool_on_off_bitwise():
+    res_on, res_off, b_on, b_off = _run_pair(
+        "full", 64, algorithm="push-sum", seed=1, chunk_rounds=16,
+        delivery="pool",
+    )
+    _assert_bitwise(res_on, res_off, b_on, b_off)
+    t = res_on.telemetry
+    # Final row's MAE equals the result's (same reduction, same state).
+    assert t.data[-1][COL_MAE] == pytest.approx(res_on.estimate_mae, rel=1e-6)
+    # Fault-free run conserves mass: residual stays ~0.
+    assert np.abs(t.data[:, telemetry_mod.COL_MASS]).max() < 1e-2
+
+
+def test_sharded_on_off_bitwise_and_matches_single_device():
+    res_on, res_off, b_on, b_off = _run_pair(
+        "full", 64, algorithm="gossip", seed=3, chunk_rounds=7, n_devices=8,
+    )
+    _assert_bitwise(res_on, res_off, b_on, b_off)
+    # Integer counters over a device-count-invariant stream: the sharded
+    # counter block is bitwise the single-device one.
+    single = run(
+        build_topology("full", 64, seed=3),
+        SimConfig(n=64, topology="full", algorithm="gossip", seed=3,
+                  chunk_rounds=7, telemetry=True),
+    )
+    np.testing.assert_array_equal(
+        res_on.telemetry.data, single.telemetry.data
+    )
+
+
+def test_fused_stencil_interpret_on_off_bitwise():
+    kwargs = dict(algorithm="gossip", seed=0, engine="fused",
+                  chunk_rounds=8, max_rounds=24)
+    res_on, res_off, b_on, b_off = _run_pair("ring", 256, **kwargs)
+    _assert_bitwise(res_on, res_off, b_on, b_off)
+    # The in-kernel counters equal the chunked XLA engine's (integer state,
+    # shared stream contract).
+    chunked = run(
+        build_topology("ring", 256, seed=0),
+        SimConfig(n=256, topology="ring", telemetry=True,
+                  **{**kwargs, "engine": "chunked"}),
+    )
+    np.testing.assert_array_equal(
+        res_on.telemetry.data, chunked.telemetry.data
+    )
+
+
+def test_fused_pool_interpret_on_off_bitwise():
+    kwargs = dict(algorithm="gossip", seed=1, engine="fused",
+                  delivery="pool", chunk_rounds=8, max_rounds=24)
+    res_on, res_off, b_on, b_off = _run_pair("full", 64, **kwargs)
+    _assert_bitwise(res_on, res_off, b_on, b_off)
+    chunked = run(
+        build_topology("full", 64, seed=1),
+        SimConfig(n=64, topology="full", telemetry=True,
+                  **{**kwargs, "engine": "chunked"}),
+    )
+    np.testing.assert_array_equal(
+        res_on.telemetry.data, chunked.telemetry.data
+    )
+
+
+def test_fused_pushsum_telemetry_columns_match_chunked():
+    # The push-sum-specific in-kernel columns (estimate MAE, mass
+    # residual) against the chunked engine: integer columns exact, float
+    # columns to reassociation tolerance. Both fused families.
+    for kind, delivery in (("ring", "auto"), ("full", "pool")):
+        kwargs = dict(algorithm="push-sum", seed=1, engine="fused",
+                      delivery=delivery, chunk_rounds=8, max_rounds=16)
+        topo = build_topology(kind, 256 if kind == "ring" else 64, seed=1)
+        fused = run(topo, SimConfig(n=topo.n, topology=kind, telemetry=True,
+                                    **kwargs))
+        chunked = run(topo, SimConfig(n=topo.n, topology=kind,
+                                      telemetry=True,
+                                      **{**kwargs, "engine": "chunked"}))
+        tf, tc = fused.telemetry.data, chunked.telemetry.data
+        for col in (COL_CONV, COL_LIVE, COL_GAP, telemetry_mod.COL_DROPS):
+            np.testing.assert_array_equal(tf[:, col], tc[:, col], err_msg=kind)
+        np.testing.assert_allclose(
+            tf[:, COL_MAE], tc[:, COL_MAE], rtol=1e-5, atol=1e-7,
+            err_msg=kind,
+        )
+        np.testing.assert_allclose(
+            tf[:, telemetry_mod.COL_MASS], tc[:, telemetry_mod.COL_MASS],
+            atol=1e-2, err_msg=kind,
+        )
+
+
+def test_fused_drop_counts_match_chunked():
+    # The in-kernel fault-gate drop counters (use_gate branches) against
+    # the chunked row_fn's recomputed gate — integer-exact, same stream.
+    for kind, delivery in (("ring", "auto"), ("full", "pool")):
+        kwargs = dict(algorithm="gossip", seed=0, engine="fused",
+                      delivery=delivery, fault_rate=0.3, chunk_rounds=8,
+                      max_rounds=16)
+        topo = build_topology(kind, 256 if kind == "ring" else 64, seed=0)
+        fused = run(topo, SimConfig(n=topo.n, topology=kind, telemetry=True,
+                                    **kwargs))
+        chunked = run(topo, SimConfig(n=topo.n, topology=kind,
+                                      telemetry=True,
+                                      **{**kwargs, "engine": "chunked"}))
+        np.testing.assert_array_equal(
+            fused.telemetry.data[:, COL_DROPS],
+            chunked.telemetry.data[:, COL_DROPS], err_msg=kind,
+        )
+        assert fused.telemetry.data[:, COL_DROPS].sum() > 0
+
+
+def test_sweep_replica0_matches_unbatched():
+    cfg = SimConfig(n=64, topology="full", algorithm="gossip", seed=3,
+                    chunk_rounds=7, telemetry=True)
+    topo = build_topology("full", 64, seed=3)
+    sweep = run_replicas(topo, cfg, 3, keep_states=False)
+    single = run(topo, cfg)
+    assert sweep.rounds[0] == single.rounds
+    np.testing.assert_array_equal(
+        sweep.telemetry[0].data, single.telemetry.data
+    )
+    for r in range(3):
+        assert sweep.telemetry[r].data.shape == (sweep.rounds[r], N_COLS)
+    # Telemetry does not perturb the sweep either.
+    sweep_off = run_replicas(
+        topo, SimConfig(n=64, topology="full", algorithm="gossip", seed=3,
+                        chunk_rounds=7),
+        3, keep_states=False,
+    )
+    assert sweep.rounds == sweep_off.rounds
+    assert sweep_off.telemetry is None
+
+
+# ------------------------------------------- counter-value cross-checks
+
+
+def test_counters_match_legacy_hook_values():
+    # The pre-telemetry --trace-convergence hook computed (conv, active) or
+    # (conv, mae) at chunk boundaries with blocking host reductions.
+    # Recompute those boundary values independently and check them against
+    # the telemetry rows at the same rounds.
+    topo = build_topology("grid2d", 256)
+    for algo in ("gossip", "push-sum"):
+        cfg = SimConfig(n=256, topology="grid2d", algorithm=algo,
+                        chunk_rounds=32, telemetry=True)
+        boundary = []
+
+        def hook(rounds, state, boundary=boundary):
+            import jax.numpy as jnp
+
+            conv = int(jnp.sum(state.conv))
+            if hasattr(state, "s"):
+                w_safe = jnp.where(state.w != 0, state.w, 1)
+                ratio = jnp.where(state.w != 0, state.s / w_safe, 0.0)
+                err = jnp.where(
+                    state.conv, jnp.abs(ratio - (topo.n - 1) / 2.0), 0.0
+                )
+                extra = float(jnp.sum(err)) / max(conv, 1)
+            else:
+                extra = int(jnp.sum(state.active))
+            boundary.append((rounds, conv, extra))
+
+        res = run(topo, cfg, on_chunk=hook)
+        t = res.telemetry
+        for rounds, conv, extra in boundary:
+            row = t.data[rounds - 1]  # row i is the state AFTER round i+1
+            assert row[COL_CONV] == conv, (algo, rounds)
+            if algo == "push-sum":
+                assert row[COL_MAE] == pytest.approx(extra, rel=1e-5)
+            else:
+                assert row[COL_ACTIVE] == extra, (algo, rounds)
+
+
+def test_crash_model_columns_and_drop_counts():
+    # Crash model: live_count tracks the schedule, gap is the quorum
+    # predicate's distance. fault_rate=1 drops every live sender.
+    topo = build_topology("full", 64)
+    cfg = SimConfig(n=64, topology="full", algorithm="gossip", seed=2,
+                    chunk_rounds=8, crash_schedule="3:8,6:4", quorum=0.9,
+                    max_rounds=4000, telemetry=True)
+    res = run(topo, cfg)
+    t = res.telemetry.data
+    live = t[:, COL_LIVE]
+    assert live[0] == 64
+    # Kills at round 3 (8 nodes) and 6 (4 nodes): live drops stepwise.
+    assert live[-1] == 64 - 12
+    assert (np.diff(live) <= 0).all()
+    # Run ended because the quorum gap closed.
+    assert res.outcome == "converged" and t[-1][COL_GAP] <= 0
+
+    cfg_drop = SimConfig(n=64, topology="full", algorithm="gossip", seed=0,
+                         chunk_rounds=8, fault_rate=0.999999999,
+                         max_rounds=32, telemetry=True)
+    res_drop = run(topo, cfg_drop)
+    # With the gate ~always firing, every node's gate fires every round.
+    assert (res_drop.telemetry.data[:, COL_DROPS] == 64).all()
+    # And without faults the column is identically zero.
+    assert (t[:, COL_DROPS] == 0).all()
+
+
+# --------------------------------- donation + speculation stay on (pinned)
+
+
+def test_telemetry_keeps_donation_and_pipeline_depth(monkeypatch):
+    # The acceptance pin: with telemetry on and no hooks, the runner must
+    # still hand the pipelined driver donate=True and the configured
+    # speculation depth — the legacy trace hook forced both off.
+    seen = {}
+    orig = pipeline_mod.run_chunks
+
+    def spy(**kw):
+        seen["donate"] = kw.get("donate")
+        seen["depth"] = kw.get("depth")
+        seen["on_aux"] = kw.get("on_aux")
+        return orig(**kw)
+
+    monkeypatch.setattr(pipeline_mod, "run_chunks", spy)
+    topo = build_topology("full", 64)
+    cfg = SimConfig(n=64, topology="full", algorithm="gossip",
+                    chunk_rounds=7, pipeline_chunks=3, telemetry=True)
+    res = run(topo, cfg)
+    assert res.telemetry is not None and res.telemetry.rounds == res.rounds
+    assert seen["donate"] is True
+    assert seen["depth"] == 3
+    assert seen["on_aux"] is not None
+
+
+def test_driver_aux_is_speculative_not_blocking():
+    # Driver-level pin of "no per-chunk blocking sync": with depth 2 the
+    # dispatch of chunk k+1 happens BEFORE chunk k's aux is collected, and
+    # on_aux composes with donate=True (unlike on_retire, which raises).
+    log = []
+
+    def dispatch(state, rnd, done, round_end):
+        log.append(("dispatch", int(rnd), int(round_end)))
+        return state, round_end, False, f"aux@{round_end}"
+
+    auxes = []
+    result = pipeline_mod.run_chunks(
+        dispatch=dispatch, state0={}, rnd0=0, done0=False,
+        start_round=0, max_rounds=40, stride=10, depth=2, donate=True,
+        on_aux=lambda a, b, aux: log.append(("aux", a, b)) or auxes.append(aux),
+    )
+    assert result.rounds == 40
+    assert auxes == ["aux@10", "aux@20", "aux@30", "aux@40"]
+    # Chunk 2 was dispatched before chunk 1's aux was observed.
+    assert log.index(("dispatch", 10, 20)) < log.index(("aux", 0, 10))
+    # Timing splits recorded per retired chunk.
+    assert len(result.chunk_log) == 4
+    assert all(
+        e["dispatch_s"] >= 0 and e["fetch_s"] >= 0 for e in result.chunk_log
+    )
+
+
+def test_driver_stall_discards_speculative_aux():
+    # Aux of discarded speculative chunks is never observed: the stalled
+    # boundary's aux is the last one collected.
+    log = []
+
+    def dispatch(state, rnd, done, round_end):
+        return state, round_end, False, round_end
+
+    stops = iter([False, True])
+    auxes = []
+    result = pipeline_mod.run_chunks(
+        dispatch=dispatch, state0={}, rnd0=0, done0=False,
+        start_round=0, max_rounds=1000, stride=10, depth=4,
+        should_stop=lambda r, s: next(stops),
+        on_aux=lambda a, b, aux: auxes.append(aux),
+    )
+    assert result.rounds == 20
+    assert auxes == [10, 20]
+    assert result.chunks_speculative > 0
+
+
+def test_collector_streams_rows_per_retired_chunk():
+    # The streaming hook (Collector.on_rows): each retired chunk's fresh
+    # row slice arrives incrementally — a killed run's trace holds every
+    # retired chunk — and the streamed concatenation equals the finalized
+    # trajectory bitwise.
+    streamed = []
+    topo = build_topology("full", 64)
+    cfg = SimConfig(n=64, topology="full", algorithm="gossip", seed=3,
+                    chunk_rounds=7, telemetry=True)
+    res = run(topo, cfg,
+              on_telemetry=lambda start, rows: streamed.append((start, rows)))
+    assert len(streamed) >= 2  # multiple chunks, delivered one by one
+    starts = [s for s, _ in streamed]
+    assert starts == sorted(starts) and starts[0] == 0
+    np.testing.assert_array_equal(
+        np.concatenate([r for _, r in streamed]), res.telemetry.data
+    )
+
+
+# ------------------------------------------------- tier gating + fallbacks
+
+
+def test_fused_unsupported_tier_rejects_and_auto_falls_back():
+    # imp3d pooled delivery selects the fused imp tier, which has no
+    # counter block: engine='fused' must fail loudly...
+    topo = build_topology("imp3d", 64, seed=0)
+    cfg = SimConfig(n=64, topology="imp3d", algorithm="gossip",
+                    delivery="pool", engine="fused", telemetry=True,
+                    max_rounds=16)
+    with pytest.raises(ValueError, match="telemetry"):
+        run(topo, cfg)
+    # ...while engine='auto' demotes to the chunked engine and still
+    # produces a trajectory.
+    res = run(topo, SimConfig(n=64, topology="imp3d", algorithm="gossip",
+                              delivery="pool", engine="auto",
+                              telemetry=True, max_rounds=16))
+    assert res.telemetry is not None and res.telemetry.rounds == res.rounds
+
+
+def test_sharded_fused_composition_rejects_telemetry():
+    from cop5615_gossip_protocol_tpu.parallel.fused_sharded import (
+        plan_fused_sharded,
+    )
+
+    topo = build_topology("ring", 1024)
+    cfg = SimConfig(n=1024, topology="ring", engine="fused", n_devices=8,
+                    telemetry=True)
+    plan = plan_fused_sharded(topo, cfg, 8)
+    assert isinstance(plan, str) and "telemetry" in plan
+
+
+def test_reference_walk_rejects_telemetry():
+    with pytest.raises(ValueError, match="single random walk"):
+        SimConfig(n=25, topology="full", algorithm="push-sum",
+                  semantics="reference", telemetry=True)
+
+
+# ------------------------------------------------ event log + run record
+
+
+def test_event_log_schema_roundtrip(tmp_path):
+    p = tmp_path / "events.jsonl"
+    log = events_mod.RunEventLog(p)
+    log.emit("run-start", config={"n": 4}, population=4)
+    log.emit_chunks([
+        {"rounds": 8, "dispatch_s": 0.1, "fetch_s": 0.2},
+        {"rounds": 16, "dispatch_s": 0.1, "fetch_s": 0.2},
+    ])
+    log.emit("run-end", outcome="converged", rounds=16)
+    recs = events_mod.read_events(p)
+    assert [r["event"] for r in recs] == [
+        "run-start", "chunk-retired", "chunk-retired", "run-end",
+    ]
+    assert all(
+        r["schema_version"] == events_mod.EVENT_SCHEMA_VERSION for r in recs
+    )
+    assert recs[1]["chunk"] == 0 and recs[2]["rounds"] == 16
+    assert all("t_wall" in r and "t_run" in r for r in recs)
+    # A NEWER schema is refused, not mis-parsed.
+    with p.open("a") as f:
+        f.write(json.dumps(
+            {"schema_version": events_mod.EVENT_SCHEMA_VERSION + 1,
+             "event": "x"}
+        ) + "\n")
+    with pytest.raises(ValueError, match="schema"):
+        events_mod.read_events(p)
+
+
+def test_cli_events_lifecycle(tmp_path, capsys):
+    from cop5615_gossip_protocol_tpu.cli import main
+
+    ev = tmp_path / "ev.jsonl"
+    ck = tmp_path / "ck.npz"
+    rc = main(["256", "grid2d", "gossip", "--quiet", "--chunk-rounds", "32",
+               "--events", str(ev), "--checkpoint", str(ck),
+               "--crash-schedule", "5:16", "--quorum", "0.9"])
+    capsys.readouterr()
+    assert rc == 0
+    recs = events_mod.read_events(ev)
+    kinds = [r["event"] for r in recs]
+    assert kinds[0] == "run-start"
+    assert kinds[1] == "crash-schedule-applied"
+    assert "checkpoint-written" in kinds
+    assert "chunk-retired" in kinds
+    assert kinds[-1] == "run-end"
+    end = recs[-1]
+    assert end["outcome"] == "converged"
+    assert end["rounds"] > 0 and end["dispatch_s"] >= 0
+    chunk_rounds = [r["rounds"] for r in recs if r["event"] == "chunk-retired"]
+    assert chunk_rounds == sorted(chunk_rounds)
+    assert chunk_rounds[-1] == end["rounds"]
+
+
+def test_run_record_schema_version():
+    from cop5615_gossip_protocol_tpu.utils import metrics
+
+    topo = build_topology("full", 64)
+    cfg = SimConfig(n=64, topology="full", algorithm="gossip")
+    res = run(topo, cfg)
+    rec = metrics.run_record(cfg, topo, res)
+    assert rec["schema_version"] == metrics.RUN_RECORD_SCHEMA_VERSION
+    assert "dispatch_s" in rec and "fetch_s" in rec
+    assert "telemetry" not in rec and "chunk_log" not in rec
+    json.dumps(rec)  # JSONL-serializable end to end
+
+
+def test_append_jsonl_fsyncs_line(tmp_path):
+    from cop5615_gossip_protocol_tpu.utils import metrics
+
+    p = tmp_path / "out.jsonl"
+    metrics.append_jsonl(p, {"a": 1})
+    metrics.append_jsonl_many(p, [{"b": 2}, {"c": 3}])
+    lines = [json.loads(x) for x in p.read_text().splitlines()]
+    assert lines == [{"a": 1}, {"b": 2}, {"c": 3}]
+
+
+# ---------------------------------------------------- trajectory analyzer
+
+
+def test_trajectory_analyzer_on_real_trace(tmp_path):
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+    from benchmarks import trajectory as traj_mod
+    from cop5615_gossip_protocol_tpu.utils import metrics
+
+    topo = build_topology("grid2d", 256)
+    cfg = SimConfig(n=256, topology="grid2d", algorithm="gossip",
+                    telemetry=True)
+    res = run(topo, cfg)
+    p = tmp_path / "traj.jsonl"
+    metrics.append_jsonl_many(
+        p, res.telemetry.to_trace_records(cfg.algorithm)
+    )
+    recs = traj_mod.load_trace(p)
+    a = traj_mod.analyze(recs, population=256)
+    assert a["rounds_total"] == res.rounds
+    assert a["converged_final"] == 256
+    r2p = a["rounds_to_pct"]
+    assert r2p[100] == res.rounds
+    assert all(
+        r2p[p1] <= r2p[p2]
+        for p1, p2 in zip(traj_mod.PERCENTILES, traj_mod.PERCENTILES[1:])
+    )
+    md = traj_mod.section(recs, population=256)
+    assert any("100%" in line for line in md)
+    curve = traj_mod.ascii_curve(recs, 256, width=32, height=8)
+    assert len(curve) == 10  # 8 rows + axis + label
+    assert any("#" in line for line in curve)
+
+
+def test_trajectory_analyzer_flags_partial_traces():
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+    from benchmarks import trajectory as traj_mod
+
+    # A resumed run's trace starts mid-stream with conv already nonzero:
+    # percentiles crossed before the file begins must report None (the
+    # true crossing round predates the trace), not the first record.
+    recs = [
+        {"rounds": r, "converged_count": c, "newly_converged": 0}
+        for r, c in ((101, 60), (102, 80), (103, 100))
+    ]
+    a = traj_mod.analyze(recs, population=100)
+    assert a["partial_trace"] is True
+    assert a["rounds_to_pct"][50] is None  # crossed before round 101
+    assert a["rounds_to_pct"][75] == 102
+    assert a["rounds_to_pct"][100] == 103
+    # The curve spans the trace's own window, not rounds 1..last.
+    curve = traj_mod.ascii_curve(recs, 100, width=16, height=4)
+    assert "101" in curve[-1] and "103" in curve[-1]
+    top_row = curve[0]
+    assert "#" in top_row  # 100% is reached inside the window
+    # A full trace is not flagged.
+    full = [{"rounds": r, "converged_count": r, "newly_converged": 1}
+            for r in range(1, 11)]
+    assert traj_mod.analyze(full, population=10)["partial_trace"] is False
+
+
+def test_sweep_record_carries_schema_version():
+    from cop5615_gossip_protocol_tpu.utils.metrics import (
+        RUN_RECORD_SCHEMA_VERSION,
+    )
+
+    topo = build_topology("full", 64)
+    cfg = SimConfig(n=64, topology="full", algorithm="gossip", seed=0)
+    rec = run_replicas(topo, cfg, 2, keep_states=False).to_record()
+    assert rec["schema_version"] == RUN_RECORD_SCHEMA_VERSION
+    json.dumps(rec)
+
+
+def test_resume_trajectory_starts_at_checkpoint_round(tmp_path):
+    # Telemetry across checkpoint/resume: the resumed trajectory indexes
+    # from the checkpoint round and concatenates with the original to the
+    # full run's trajectory bitwise (gossip integer counters).
+    topo = build_topology("full", 64)
+    cfg = SimConfig(n=64, topology="full", algorithm="gossip", seed=3,
+                    chunk_rounds=7, telemetry=True)
+    full = run(topo, cfg)
+
+    grabbed = {}
+
+    def grab(rounds, state):
+        if rounds <= 14 and "st" not in grabbed:
+            grabbed["st"], grabbed["rounds"] = (
+                jax.tree.map(np.asarray, state), rounds
+            )
+
+    run(topo, cfg, on_chunk=grab)
+    import jax.numpy as jnp
+
+    start = type(grabbed["st"])(
+        *(jnp.asarray(x) for x in grabbed["st"])
+    )
+    resumed = run(topo, cfg, start_state=start,
+                  start_round=grabbed["rounds"])
+    t = resumed.telemetry
+    assert t.start_round == grabbed["rounds"]
+    np.testing.assert_array_equal(
+        t.data, full.telemetry.data[grabbed["rounds"]:]
+    )
+    # to_trace_records seeds newly_converged from the checkpoint baseline.
+    pre = int(np.asarray(grabbed["st"].conv).sum())
+    recs = t.to_trace_records("gossip", prev_conv=pre)
+    assert recs[0]["rounds"] == grabbed["rounds"] + 1
+    assert recs[0]["newly_converged"] == recs[0]["converged_count"] - pre
+    assert sum(r["newly_converged"] for r in recs) == 64 - pre
