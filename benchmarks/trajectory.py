@@ -59,21 +59,36 @@ def rounds_to_fraction(recs: list[dict], denominator: int) -> dict[int, int | No
     return out
 
 
+def revival_rounds(recs: list[dict]) -> list[int]:
+    """Rounds where crash-recovery revivals landed (the ``revived`` field
+    telemetry schema v2 emits on rejoin rounds only) — empty for non-churn
+    traces."""
+    return [r["rounds"] for r in recs if r.get("revived", 0) > 0]
+
+
 def ascii_curve(recs: list[dict], denominator: int,
                 width: int = 64, height: int = 12) -> list[str]:
     """Converged fraction (y, 0..100%) vs rounds (x) on a width x height
     character grid — each column shows the max fraction reached in its
     round bucket. The x axis spans the TRACE's rounds (first..last), so a
     partial/resumed trace plots its own window instead of rendering the
-    pre-trace rounds as a false flatline at 0%."""
+    pre-trace rounds as a false flatline at 0%.
+
+    Crash-recovery traces (any record with a ``revived`` count) get a
+    marker row under the axis: ``^`` in every column where a revival
+    landed, plus a summary line of the rejoin rounds — the shape of the
+    curve is only interpretable next to when the population grew back."""
     first = recs[0]["rounds"]
     last = recs[-1]["rounds"]
     span = max(last - first + 1, 1)
     cols = [0.0] * width
+    revive_cols = [False] * width
     for r in recs:
         x = min(width - 1, (r["rounds"] - first) * width // span)
         frac = r["converged_count"] / max(denominator, 1)
         cols[x] = max(cols[x], frac)
+        if r.get("revived", 0) > 0:
+            revive_cols[x] = True
     # Forward-fill empty buckets (fewer rounds than columns).
     running = 0.0
     for x in range(width):
@@ -92,6 +107,15 @@ def ascii_curve(recs: list[dict], denominator: int,
         f"       {left}{'':<{max(width - len(left) - len(f'{last:,}') - 1, 1)}}"
         f"{last:,}"
     )
+    revs = revival_rounds(recs)
+    if revs:
+        lines.insert(
+            height + 1,
+            "       " + "".join("^" if m else " " for m in revive_cols),
+        )
+        shown = ", ".join(f"{r:,}" for r in revs[:12])
+        more = f" (+{len(revs) - 12} more)" if len(revs) > 12 else ""
+        lines.append(f"       ^ revivals at rounds: {shown}{more}")
     return lines
 
 
@@ -112,6 +136,10 @@ def analyze(recs: list[dict], population: int | None = None) -> dict:
         # the uninterrupted run's trace for shape analysis.
         "partial_trace": recs[0]["rounds"] > 1,
         "rounds_to_pct": rounds_to_fraction(recs, denom),
+        # Crash-recovery annotation (telemetry schema v2 traces): rounds
+        # where revivals landed and the total rejoin count.
+        "revival_rounds": revival_rounds(recs),
+        "revived_total": sum(r.get("revived", 0) for r in recs),
     }
     if "estimate_mae" in final:
         out["estimate_mae_final"] = final["estimate_mae"]

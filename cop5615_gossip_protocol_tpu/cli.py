@@ -98,6 +98,33 @@ def build_parser() -> argparse.ArgumentParser:
                    help="deterministic crash-stop schedule: kill COUNT "
                    "uniformly random nodes at each listed round "
                    "(mutually exclusive with --crash-rate)")
+    p.add_argument("--revive-rate", type=float, default=0.0,
+                   help="crash-recovery churn: per-round probability each "
+                   "DEAD node rejoins (geometric dead-time; requires a "
+                   "crash model). Gossip revivals rejoin susceptible; "
+                   "push-sum rejoin semantics per --rejoin")
+    p.add_argument("--revive-schedule", type=str, default=None,
+                   metavar="ROUND:COUNT,...",
+                   help="deterministic recovery schedule: rejoin COUNT "
+                   "uniformly random dead nodes at each listed round "
+                   "(mutually exclusive with --revive-rate; requires a "
+                   "crash model)")
+    p.add_argument("--rejoin", choices=["restore", "fresh"], default="restore",
+                   help="push-sum revival semantics: restore = reclaim the "
+                   "parked (s, w) mass (conserving); fresh = reset to "
+                   "(s=x_i, w=0), discarding parked mass (the modeled "
+                   "fault)")
+    p.add_argument("--mass-tolerance", type=float, default=None,
+                   help="health sentinel (push-sum, chunked/sharded "
+                   "engines): every round also checks state finiteness and "
+                   "|sum(w) - n| against this tolerance; a trip ends the "
+                   "run with outcome=unhealthy + the offending round "
+                   "instead of converging wrong")
+    p.add_argument("--strict-engine", action="store_true",
+                   help="fail fast on engine errors instead of walking the "
+                   "graceful-degradation ladder (fused->chunked, "
+                   "sharded->single-device; models/runner.py). The "
+                   "GOSSIP_TPU_STRICT_ENGINE env var overrides either way")
     p.add_argument("--dup-rate", type=float, default=0.0,
                    help="per-round probability a sent message is delivered "
                    "twice (at-least-once delivery; chunked engine, "
@@ -226,6 +253,11 @@ def _main_refsim(args, parser) -> int:
         "--fault-rate": changed("fault_rate"),
         "--crash-rate/--crash-schedule": changed("crash_rate")
         or changed("crash_schedule"),
+        "--revive-rate/--revive-schedule": changed("revive_rate")
+        or changed("revive_schedule"),
+        "--rejoin": changed("rejoin"),
+        "--mass-tolerance": changed("mass_tolerance"),
+        "--strict-engine": changed("strict_engine"),
         "--dup-rate": changed("dup_rate"),
         "--delay-rounds": changed("delay_rounds"),
         "--quorum": changed("quorum"),
@@ -399,10 +431,15 @@ def main(argv: Optional[list[str]] = None) -> int:
             fault_rate=args.fault_rate,
             crash_rate=args.crash_rate,
             crash_schedule=args.crash_schedule,
+            revive_rate=args.revive_rate,
+            revive_schedule=args.revive_schedule,
+            rejoin=args.rejoin,
             dup_rate=args.dup_rate,
             delay_rounds=args.delay_rounds,
             quorum=args.quorum,
             stall_chunks=args.stall_chunks,
+            mass_tolerance=args.mass_tolerance,
+            strict_engine=args.strict_engine,
             delivery=args.delivery,
             pool_size=args.pool_size,
             engine=args.engine,
@@ -419,7 +456,13 @@ def main(argv: Optional[list[str]] = None) -> int:
     from .utils import checkpoint as ckpt
     from .utils import metrics
 
+    # Valid-but-suspect flag combinations (SimConfig.lint_warnings, e.g.
+    # quorum < 1.0 without a crash model): warn loudly on stderr — and stamp
+    # them into the run-start event below — rather than silently ignoring.
+    lint = cfg.lint_warnings
     if jax.process_index() == 0:
+        for w in lint:
+            print(f"Warning: {w}", file=sys.stderr)
         print(metrics.banner(cfg))
 
     t0 = time.perf_counter()
@@ -492,12 +535,16 @@ def main(argv: Optional[list[str]] = None) -> int:
                     "algorithm": cfg.algorithm, "seed": cfg.seed,
                     "semantics": cfg.semantics},
             population=topo.n,
+            warnings=list(lint),
         )
         if cfg.crash_model:
             events.emit(
                 "crash-schedule-applied",
                 crash_rate=cfg.crash_rate,
                 crash_schedule=cfg.crash_schedule,
+                revive_rate=cfg.revive_rate,
+                revive_schedule=cfg.revive_schedule,
+                rejoin=cfg.rejoin if cfg.revive_model else None,
                 quorum=cfg.quorum,
             )
 
@@ -597,10 +644,16 @@ def main(argv: Optional[list[str]] = None) -> int:
         # matches the original run; loop-control knobs may differ.
         # telemetry is observability, not stream state: a resumed run may
         # toggle it freely without touching the trajectory.
+        # telemetry/mass_tolerance/strict_engine are observability and
+        # harness-resilience knobs, not stream state: a resumed run may
+        # toggle them without touching the trajectory (the sentinel can
+        # change WHEN the loop stops, never what any round computes).
         loop_knobs = {"max_rounds": cfg.max_rounds, "chunk_rounds": cfg.chunk_rounds,
                       "n_devices": cfg.n_devices,
                       "pipeline_chunks": cfg.pipeline_chunks,
-                      "telemetry": cfg.telemetry}
+                      "telemetry": cfg.telemetry,
+                      "mass_tolerance": cfg.mass_tolerance,
+                      "strict_engine": cfg.strict_engine}
         if dataclasses.replace(saved_cfg, **loop_knobs) != cfg:
             print(
                 "Invalid: checkpoint config mismatch — resume requires the "
@@ -627,7 +680,21 @@ def main(argv: Optional[list[str]] = None) -> int:
     if args.trace_convergence and jax.process_index() == 0:
         from .ops import telemetry as telemetry_mod
 
+        # Highest absolute round already serialized: an engine retry or a
+        # degradation-ladder rung (models/runner.run) restarts the run and
+        # REPLAYS rounds whose rows this writer already fsynced — without
+        # the high-water mark the trace would hold duplicate per-round
+        # records and every round-count consumer would double-read them.
+        # Replayed rounds are dropped; the file stays one record per round.
+        trace_prev["hi"] = start_round
+
         def tele_writer(chunk_start, rows):
+            skip = trace_prev["hi"] - chunk_start
+            if skip > 0:
+                if skip >= rows.shape[0]:
+                    return  # the whole chunk was already written
+                rows = rows[skip:]
+                chunk_start += skip
             recs = telemetry_mod.rows_to_trace_records(
                 rows, chunk_start, cfg.algorithm,
                 prev_conv=trace_prev["conv"],
@@ -635,6 +702,7 @@ def main(argv: Optional[list[str]] = None) -> int:
             trace_prev["conv"] = recs[-1]["converged_count"] if recs else (
                 trace_prev["conv"]
             )
+            trace_prev["hi"] = chunk_start + rows.shape[0]
             metrics.append_jsonl_many(args.trace_convergence, recs)
 
     # SURVEY.md §5 tracing plan: the trace spans compile + run, and the
@@ -650,6 +718,9 @@ def main(argv: Optional[list[str]] = None) -> int:
                 topo, cfg, on_chunk=on_chunk,
                 start_state=start_state, start_round=start_round,
                 on_telemetry=tele_writer,
+                # engine-degraded events land in the log AT degradation
+                # time — a later crash still leaves the rung walk durable.
+                on_event=events.emit if events is not None else None,
             )
     except (ValueError, NotImplementedError) as e:
         print(f"Invalid: {e}", file=sys.stderr)
@@ -660,6 +731,13 @@ def main(argv: Optional[list[str]] = None) -> int:
         events.emit_chunks(result.chunk_log)
         if result.outcome == "stalled":
             events.emit("watchdog-fired", rounds=result.rounds)
+        if result.outcome == "unhealthy":
+            events.emit(
+                "sentinel-tripped",
+                rounds=result.rounds,
+                unhealthy_round=result.unhealthy_round,
+                mass_tolerance=cfg.mass_tolerance,
+            )
         events.emit(
             "run-end",
             outcome=result.outcome,

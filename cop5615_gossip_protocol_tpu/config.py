@@ -151,6 +151,23 @@ class SimConfig:
     crash_rate: float = 0.0
     crash_schedule: str | None = None
 
+    # Crash-recovery (ops/faults.revival_plane): with revive_rate p every
+    # DEAD node independently rejoins each round with probability p
+    # (geometric dead-time, revival >= death + 1); revive_schedule
+    # "round:count,..." rejoins exactly count uniformly random dead nodes
+    # at each listed round instead. Requires a crash model (there is
+    # nothing to revive otherwise — hard error).
+    revive_rate: float = 0.0
+    revive_schedule: str | None = None
+
+    # Push-sum rejoin semantics (gossip revivals always rejoin susceptible
+    # with count 0): "restore" — the node reclaims its parked (s, w) mass
+    # (total mass over live + dead + parked conserved, the crash-stop
+    # invariant extended); "fresh" — the node resets to (s=x_i, w=0),
+    # discarding parked mass and re-creating its value (the modeled fault:
+    # conservation intentionally breaks, like dup_rate).
+    rejoin: str = "restore"
+
     # Per round, each sent message is additionally delivered twice with
     # this probability — at-least-once delivery. For push-sum duplicated
     # mass is CREATED (total mass inflates by the duplicate): that loss of
@@ -175,6 +192,27 @@ class SimConfig:
     # reference's line-topology hang, program.fs:334, as a measured event).
     # 0 disables.
     stall_chunks: int = 0
+
+    # Health sentinel (push-sum, chunked/sharded XLA engines): when set,
+    # every round body additionally reduces a non-finite flag over (s, w)
+    # and the mass-conservation residual |Σw − population| against this
+    # tolerance; the first round either trips ends the run with
+    # outcome="unhealthy" and the offending round in
+    # RunResult.unhealthy_round — silent numerical corruption becomes a
+    # structured outcome instead of converging wrong or spinning to
+    # max_rounds. None (default) traces the bitwise-identical program
+    # without the checks (a Python-level flag, like telemetry). The fused
+    # tiers do not carry the sentinel: engine='auto' demotes to chunked,
+    # engine='fused' rejects loudly.
+    mass_tolerance: float | None = None
+
+    # Fail-fast engine selection: disable models/runner.py's graceful
+    # degradation ladder (fused→chunked, sharded→single-device on
+    # environmental failures) and re-raise the first engine error — the
+    # pre-recovery-plane behavior. The GOSSIP_TPU_STRICT_ENGINE env var
+    # ("1"/"0") overrides this flag either way (scripts/tier1.sh exports 1
+    # so CI never silently degrades).
+    strict_engine: bool = False
 
     # In-program telemetry plane (ops/telemetry.py): the chunk program
     # accumulates one per-round counter row (converged/live counts, quorum
@@ -264,6 +302,27 @@ class SimConfig:
             from .ops.faults import parse_crash_schedule
 
             parse_crash_schedule(self.crash_schedule)  # fail at config time
+        if not (0.0 <= self.revive_rate < 1.0):
+            raise ValueError("revive_rate must be in [0, 1)")
+        if self.revive_schedule is not None:
+            if self.revive_rate > 0:
+                raise ValueError(
+                    "revive_rate and revive_schedule are mutually exclusive "
+                    "(the schedule IS the recovery process)"
+                )
+            from .ops.faults import parse_crash_schedule
+
+            parse_crash_schedule(self.revive_schedule)  # same grammar
+        if self.revive_model and not self.crash_model:
+            raise ValueError(
+                "revive_rate/revive_schedule describe how CRASHED nodes "
+                "rejoin; without crash_rate/crash_schedule there is nothing "
+                "to revive — the flags would silently mean nothing"
+            )
+        if self.rejoin not in ("restore", "fresh"):
+            raise ValueError(
+                f"unknown rejoin {self.rejoin!r}; expected restore|fresh"
+            )
         if not (0 <= self.delay_rounds <= 64):
             raise ValueError(
                 f"delay_rounds must be in [0, 64], got {self.delay_rounds} "
@@ -271,15 +330,48 @@ class SimConfig:
             )
         if not (0.0 < self.quorum <= 1.0):
             raise ValueError(f"quorum must be in (0, 1], got {self.quorum}")
-        if self.quorum != 1.0 and not self.crash_model:
-            raise ValueError(
-                "quorum < 1.0 is the crash-model termination rule "
-                "(sum(conv & alive) >= quorum over LIVE nodes) and is a "
-                "silent no-op without one; set crash_rate/crash_schedule, "
-                "or use target_frac to relax a fault-free target"
-            )
+        # Valid-but-suspect combinations (a silent no-op is not an invalid
+        # config — sweep drivers reuse a quorum across faulted and
+        # fault-free cells): lint_warnings is the single source of the
+        # conditions and texts; warn here for API users, while the CLI
+        # prints the same strings to stderr and stamps them into the
+        # run-start event.
+        for lint in self.lint_warnings:
+            import warnings
+
+            warnings.warn(lint, RuntimeWarning, stacklevel=2)
         if self.stall_chunks < 0:
             raise ValueError("stall_chunks must be >= 0")
+        if self.mass_tolerance is not None:
+            if self.mass_tolerance <= 0:
+                raise ValueError(
+                    f"mass_tolerance must be > 0, got {self.mass_tolerance}"
+                )
+            if self.algorithm != "push-sum":
+                raise ValueError(
+                    "mass_tolerance watches the push-sum conservation "
+                    "invariant Σw == population; gossip state has no mass "
+                    "to diverge"
+                )
+            if self.dup_rate > 0:
+                raise ValueError(
+                    "mass_tolerance contradicts dup_rate: at-least-once "
+                    "delivery CREATES mass by design, so the sentinel "
+                    "would trip on the modeled fault, not corruption"
+                )
+            if self.revive_model and self.rejoin == "fresh":
+                raise ValueError(
+                    "mass_tolerance contradicts rejoin='fresh': fresh "
+                    "revivals discard parked mass and re-create their "
+                    "value by design — use rejoin='restore' (conserving) "
+                    "with the sentinel"
+                )
+            if self.semantics == "reference":
+                raise ValueError(
+                    "mass_tolerance runs inside the synchronous chunk "
+                    "program; reference-semantics push-sum is a single "
+                    "random walk with no round body — use batched semantics"
+                )
         if (
             self.telemetry
             and self.semantics == "reference"
@@ -390,6 +482,28 @@ class SimConfig:
     def crash_model(self) -> bool:
         """True when nodes can die (ops/faults.death_plane is non-None)."""
         return self.crash_rate > 0.0 or self.crash_schedule is not None
+
+    @property
+    def revive_model(self) -> bool:
+        """True when crashed nodes can rejoin (ops/faults.revival_plane is
+        non-None)."""
+        return self.revive_rate > 0.0 or self.revive_schedule is not None
+
+    @property
+    def lint_warnings(self) -> tuple[str, ...]:
+        """Valid-but-suspect combinations, as human-readable strings — the
+        single source of both the conditions and the texts. The CLI prints
+        each to stderr and stamps them into the run-start event;
+        __post_init__ raises each as a RuntimeWarning for API users."""
+        out = []
+        if self.quorum != 1.0 and not self.crash_model:
+            out.append(
+                "quorum < 1.0 without a crash model has no effect (the "
+                "legacy converged_count >= target predicate rules); set "
+                "crash_rate/crash_schedule, or use target_frac to relax a "
+                "fault-free target"
+            )
+        return tuple(out)
 
     @property
     def faulted(self) -> bool:

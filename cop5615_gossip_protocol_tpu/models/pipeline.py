@@ -110,6 +110,11 @@ class ChunkLoopResult:
     # Per RETIRED chunk, in order: {"rounds", "dispatch_s", "fetch_s"} —
     # the structured run-event log's chunk-retired events (utils/events.py).
     chunk_log: list = dataclasses.field(default_factory=list)
+    # The engine's health-sentinel scalar at the final boundary (the first
+    # round the sentinel tripped, or the engine's NEVER constant while
+    # healthy); None when the loop ran without a health carry (health0 not
+    # given). The driver maps it to outcome="unhealthy".
+    health: object = None
 
 
 def run_chunks(
@@ -126,6 +131,7 @@ def run_chunks(
     on_retire: Optional[Callable[[int, object], None]] = None,
     should_stop: Optional[Callable[[int, object], bool]] = None,
     on_aux: Optional[Callable[[int, int, object], None]] = None,
+    health0=None,
 ) -> ChunkLoopResult:
     """Drive ``dispatch(state, rnd, done, round_end) -> (state, rnd, done)``
     to termination with up to ``depth`` chunks in flight.
@@ -137,12 +143,21 @@ def run_chunks(
     call — with ``donate=True`` only the state argument is donated, so
     they remain readable after the state's buffers are recycled.
 
-    ``dispatch`` may return a fourth element, an auxiliary device buffer
+    ``dispatch`` may return one more element, an auxiliary device buffer
     (the telemetry counter block); it is prefetched with the predicate
     scalars and handed to ``on_aux(rounds_before, rounds_after, aux)`` at
     each retired boundary, in order. Unlike ``on_retire``/``should_stop``,
     ``on_aux`` reads no protocol state and is LEGAL under donation — aux
     buffers are fresh chunk outputs outside the donated carry.
+
+    ``health0`` (optional) threads an engine health-sentinel scalar through
+    the loop: the contract becomes ``dispatch(state, rnd, done, health,
+    round_end) -> (state, rnd, done, health[, aux])``. The scalar rides
+    next to the done flag — outside any donated buffers, prefetched with
+    the other scalars — and the final boundary's value lands in
+    ``ChunkLoopResult.health``. A sentinel trip must also raise the
+    engine's done flag (the loop itself never interprets health values, so
+    termination stays the engine's decision).
 
     ``stride`` is the engine's natural chunk length in rounds: a chunk
     dispatched at boundary k targets ``min(start + (k+1)*stride,
@@ -155,9 +170,12 @@ def run_chunks(
             "buffer donation recycles retired chunk state; chunk-boundary "
             "hooks (checkpoint/watchdog) require donate=False"
         )
+    has_health = health0 is not None
+    aux_i = 4 if has_health else 3  # dispatch-output index of the aux buffer
 
     inflight: collections.deque = collections.deque()
-    head = (state0, rnd0, done0, None)  # newest dispatched carry (+aux)
+    # Newest dispatched carry: (state, rnd, done, health, aux).
+    head = (state0, rnd0, done0, health0, None)
     last_end = start_round
     retired_count = 0
     dispatch_total = 0.0
@@ -176,15 +194,21 @@ def run_chunks(
             last_end = min(last_end + stride, max_rounds)
             t0 = time.perf_counter()
             with _TraceAnnotation("chunkloop.dispatch"):
-                out = dispatch(head[0], head[1], head[2], last_end)
+                if has_health:
+                    out = dispatch(head[0], head[1], head[2], head[3], last_end)
+                else:
+                    out = dispatch(head[0], head[1], head[2], last_end)
             disp_s = time.perf_counter() - t0
             dispatch_total += disp_s
-            aux = out[3] if len(out) > 3 else None
+            health = out[3] if has_health else None
+            aux = out[aux_i] if len(out) > aux_i else None
             _prefetch(out[1])
             _prefetch(out[2])
+            if health is not None:
+                _prefetch(health)
             if aux is not None:
                 _prefetch(aux)
-            head = (out[0], out[1], out[2], aux)
+            head = (out[0], out[1], out[2], health, aux)
             inflight.append((head, disp_s))
 
     fill()  # dispatches at least one chunk, so the retire loop runs
@@ -192,12 +216,13 @@ def run_chunks(
     rounds = start_round
     done_b = False
 
-    def result(state_tuple, spec: int) -> ChunkLoopResult:
+    def result(carry, spec: int) -> ChunkLoopResult:
         return ChunkLoopResult(
-            state=state_tuple[0], rounds=rounds, done=done_b,
+            state=carry[0], rounds=rounds, done=done_b,
             chunks_retired=retired_count, chunks_speculative=spec,
             dispatch_s=dispatch_total, fetch_s=fetch_total,
             chunk_log=chunk_log,
+            health=int(carry[3]) if has_health else None,
         )
 
     while inflight:
@@ -207,10 +232,10 @@ def run_chunks(
         with _TraceAnnotation("chunkloop.fetch"):
             rounds = int(cur[1])  # blocks until chunk k completes
             done_b = bool(cur[2])
-            if on_aux is not None and cur[3] is not None:
+            if on_aux is not None and cur[4] is not None:
                 # The aux copy was prefetched at dispatch; by retire time it
                 # is usually resident — this is a collection, not a sync.
-                on_aux(prev_rounds, rounds, cur[3])
+                on_aux(prev_rounds, rounds, cur[4])
         fetch_s = time.perf_counter() - t0
         fetch_total += fetch_s
         retired_count += 1

@@ -19,6 +19,8 @@ Stopwatch numbers.
 from __future__ import annotations
 
 import dataclasses
+import os
+import sys
 import time
 from typing import Callable, Optional
 
@@ -63,8 +65,18 @@ class RunResult:
     # Why the run ended: "converged" (target/quorum reached), "stalled"
     # (the cfg.stall_chunks watchdog saw no converged-count progress — the
     # reference's line-topology hang, program.fs:334, as a measured event),
-    # or "max_rounds" (the round cap). Always present in the JSONL record.
+    # "max_rounds" (the round cap), or "unhealthy" (the cfg.mass_tolerance
+    # health sentinel tripped — non-finite state or mass divergence; the
+    # offending round is in unhealthy_round). Always present in the JSONL
+    # record.
     outcome: str = "converged"
+    # First round the health sentinel tripped (outcome="unhealthy" only).
+    unhealthy_round: Optional[int] = None
+    # Graceful-degradation audit trail (models/runner.run's fallback
+    # ladder): one {"from", "to", "reason", "transient_retries"} dict per
+    # rung walked, None when the requested engine ran. Rides the JSONL
+    # record so a degraded run is visible downstream.
+    degradations: Optional[list] = None
     # push-sum only:
     true_mean: Optional[float] = None
     estimate_mae: Optional[float] = None
@@ -133,18 +145,19 @@ class StallWatchdog:
         return self.stalled
 
 
-def _progress_gap(death_dev, quorum: float, target: int, conv, rounds: int):
+def _progress_gap(life, quorum: float, target: int, conv, rounds: int):
     """The stall watchdog's metric at a chunk boundary: remaining distance
     to the SAME predicate the done flag evaluates. Legacy: target − conv
     count. Crash model: quorum_need(alive) − conv-among-live at the last
     executed round — both terms move, so a shrinking need counts as
-    progress even while the conv count is flat. ``conv`` and ``death_dev``
-    must be shape-aligned (both [n], or both padded planes — pad slots
-    carry death round 0 and conv 0, so they cancel)."""
+    progress even while the conv count is flat. ``conv`` and the ``life``
+    planes must be shape-aligned (both [n], or both padded planes — pad
+    slots carry death round 0 / revival NEVER and conv 0, so they
+    cancel)."""
     conv_i = jnp.asarray(conv).astype(jnp.int32)
-    if death_dev is None:
+    if life is None:
         return int(target) - int(jnp.sum(conv_i))
-    alive = death_dev > rounds - 1
+    alive = faults_mod.alive_at(life.death, rounds - 1, life.revive)
     conv_alive = int(jnp.sum(jnp.where(alive, conv_i, jnp.int32(0))))
     need = int(faults_mod.quorum_need(
         jnp.sum(alive.astype(jnp.int32)), quorum
@@ -172,23 +185,30 @@ def draw_leader(base_key: jax.Array, topo: Topology, cfg: SimConfig) -> jax.Arra
     )
 
 
-def _death_dev(cfg: SimConfig, n: int):
-    """Device copy of the crash-priority plane (ops/faults.death_plane), or
-    None without a crash model. A pure function of (cfg, n) — every engine
-    rebuilds the identical plane, so checkpoints never store it."""
-    death = faults_mod.death_plane(cfg, n)
-    return None if death is None else jnp.asarray(death)
+def _life_dev(cfg: SimConfig, n: int):
+    """Device copies of the churn planes (ops/faults.life_planes), or None
+    without a crash model. Pure functions of (cfg, n) — every engine
+    rebuilds the identical planes, so checkpoints never store them."""
+    planes = faults_mod.life_planes(cfg, n)
+    if planes is None:
+        return None
+    return faults_mod.LifePlanes(
+        death=jnp.asarray(planes.death),
+        revive=None if planes.revive is None else jnp.asarray(planes.revive),
+    )
 
 
-def _freeze_dead(death_dev, old, new, round_idx):
-    """Crash-stop semantics for one round (ops/faults.py docstring): a node
-    dead during ``round_idx`` keeps its protocol state frozen — it neither
+def _freeze_dead(life, old, new, round_idx):
+    """Crash semantics for one round (ops/faults.py docstring): a node dead
+    during ``round_idx`` keeps its protocol state frozen — it neither
     converges nor advances. Push-sum (s, w) deliberately take the NEW
     values: mass delivered to a dead node parks there, so total mass over
-    live + dead nodes is conserved. No-op without a crash model."""
-    if death_dev is None:
+    live + dead nodes is conserved. Under a recovery model the dead set
+    shrinks as revivals land (faults.alive_at). No-op without a crash
+    model."""
+    if life is None:
         return new
-    dead = death_dev <= round_idx
+    dead = ~faults_mod.alive_at(life.death, round_idx, life.revive)
     if isinstance(new, pushsum_mod.PushSumState):
         return new._replace(
             term=jnp.where(dead, old.term, new.term),
@@ -201,20 +221,63 @@ def _freeze_dead(death_dev, old, new, round_idx):
     )
 
 
-def _done_predicate(cfg: SimConfig, death_dev, target: int):
+def make_revive_fn(cfg: SimConfig, n: int, life):
+    """Rejoin reset applied at the START of the revival round's body
+    (ops/faults.py "Crash-recovery"), or None when the round needs no
+    reset: gossip revivals ALWAYS rejoin susceptible (count 0, inactive,
+    unconverged — the receiver-side suppression then sees conv=0, so the
+    rejoined node can absorb again); push-sum revivals reset to
+    (s=x_i, w=0, term=initial, conv=0) under rejoin='fresh' and keep their
+    parked state untouched under rejoin='restore' (no reset — the alive
+    mask alone resumes them). Applying the reset inside round ``revival``'s
+    body keeps checkpoint resume bitwise: a checkpoint cut just before the
+    revival round holds the un-reset state, and the resumed round applies
+    the identical reset."""
+    if life is None or life.revive is None:
+        return None
+    revive = life.revive
+    if cfg.algorithm == "push-sum":
+        if cfg.rejoin != "fresh":
+            return None
+        init_term = cfg.initial_term_round
+
+        def revive_fn(state, round_idx):
+            rn = faults_mod.revived_at(revive, round_idx)
+            return pushsum_mod.PushSumState(
+                s=jnp.where(rn, jnp.arange(n, dtype=state.s.dtype), state.s),
+                w=jnp.where(rn, jnp.zeros((), state.w.dtype), state.w),
+                term=jnp.where(rn, jnp.int32(init_term), state.term),
+                conv=jnp.where(rn, False, state.conv),
+            )
+
+    else:
+
+        def revive_fn(state, round_idx):
+            rn = faults_mod.revived_at(revive, round_idx)
+            return gossip_mod.GossipState(
+                count=jnp.where(rn, jnp.int32(0), state.count),
+                active=jnp.where(rn, False, state.active),
+                conv=jnp.where(rn, False, state.conv),
+            )
+
+    return revive_fn
+
+
+def _done_predicate(cfg: SimConfig, life, target: int):
     """The while-loop termination predicate, as ``done(state, round_idx)``
     with round_idx the round JUST EXECUTED. Legacy: converged_count >=
     target. Crash model: quorum over live nodes — sum(conv & alive) >=
     quorum_need(sum(alive)) (ops/faults.py), so a run with churn terminates
-    with a meaningful answer instead of spinning to max_rounds."""
-    if death_dev is None:
+    with a meaningful answer instead of spinning to max_rounds. Under a
+    recovery model the live set grows back as revivals land."""
+    if life is None:
         def done(state, round_idx):
             return jnp.sum(state.conv) >= target
     else:
         quorum = cfg.quorum
 
         def done(state, round_idx):
-            alive = death_dev > round_idx
+            alive = faults_mod.alive_at(life.death, round_idx, life.revive)
             need = faults_mod.quorum_need(
                 jnp.sum(alive.astype(jnp.int32)), quorum
             )
@@ -299,7 +362,16 @@ def make_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array):
         topo_args = (jnp.asarray(topo.neighbors), jnp.asarray(topo.degree))
 
     deliver_fn = resolve_deliver_fn(topo, cfg)
-    death_dev = _death_dev(cfg, n)
+    life = _life_dev(cfg, n)
+    revive_fn = make_revive_fn(cfg, n, life)
+
+    def _rejoin(state, round_idx):
+        """Revival-round reset, applied at round-body entry (see
+        make_revive_fn). Identity without a recovery model / under
+        rejoin='restore' push-sum."""
+        if revive_fn is None:
+            return state
+        return revive_fn(state, round_idx)
 
     def targets_and_gate(round_idx, key_data, *targs):
         # ids generated inside the trace (lax.iota) — never a baked constant.
@@ -317,8 +389,11 @@ def make_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array):
             gate = sampling.send_gate(kr, n, cfg.fault_rate)
             if gate is not True:
                 send_ok = send_ok & gate
-            if death_dev is not None:
-                send_ok = send_ok & (death_dev > round_idx)  # dead: no sends
+            if life is not None:
+                # Dead nodes never send; revived nodes resume.
+                send_ok = send_ok & faults_mod.alive_at(
+                    life.death, round_idx, life.revive
+                )
             dup = sampling.dup_gate(kr, n, cfg.dup_rate)
             return targets, send_ok, dup
 
@@ -354,6 +429,7 @@ def make_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array):
 
             def round_fn(carry, round_idx, key_data, *targs):
                 state, ring = carry
+                state = _rejoin(state, round_idx)
                 targets, send_ok, dup = targets_and_gate(
                     round_idx, key_data, *targs
                 )
@@ -371,11 +447,12 @@ def make_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array):
                     state, s_keep, w_keep, arrive[0], arrive[1], delta,
                     term_rounds, cfg.termination == "global",
                 )
-                return (_freeze_dead(death_dev, state, new, round_idx), ring)
+                return (_freeze_dead(life, state, new, round_idx), ring)
 
         else:
 
             def round_fn(state, round_idx, key_data, *targs):
+                state = _rejoin(state, round_idx)
                 targets, send_ok, dup = targets_and_gate(
                     round_idx, key_data, *targs
                 )
@@ -383,7 +460,7 @@ def make_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array):
                     state, targets, send_ok, n, delta, term_rounds,
                     make_df(dup), cfg.termination == "global",
                 )
-                return _freeze_dead(death_dev, state, new, round_idx)
+                return _freeze_dead(life, state, new, round_idx)
 
     else:
         leader = draw_leader(base_key, topo, cfg)
@@ -399,6 +476,7 @@ def make_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array):
 
             def round_fn(carry, round_idx, key_data, *targs):
                 state, ring = carry
+                state = _rejoin(state, round_idx)
                 targets, send_ok, dup = targets_and_gate(
                     round_idx, key_data, *targs
                 )
@@ -410,11 +488,12 @@ def make_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array):
                 )
                 ring = lax.dynamic_update_index_in_dim(ring, fresh, slot, 0)
                 new = gossip_mod.absorb(state, arrive, rumor_target, suppress)
-                return (_freeze_dead(death_dev, state, new, round_idx), ring)
+                return (_freeze_dead(life, state, new, round_idx), ring)
 
         else:
 
             def round_fn(state, round_idx, key_data, *targs):
+                state = _rejoin(state, round_idx)
                 targets, send_ok, dup = targets_and_gate(
                     round_idx, key_data, *targs
                 )
@@ -422,7 +501,7 @@ def make_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array):
                     state, targets, send_ok, n, rumor_target, suppress,
                     make_df(dup),
                 )
-                return _freeze_dead(death_dev, state, new, round_idx)
+                return _freeze_dead(life, state, new, round_idx)
 
     return round_fn, state0, key_data, topo_args
 
@@ -437,7 +516,13 @@ def _make_pool_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array, dty
     n = topo.n
     K = cfg.pool_size
     key_data, key_impl = sampling.key_split(base_key)
-    death_dev = _death_dev(cfg, n)
+    life = _life_dev(cfg, n)
+    revive_fn = make_revive_fn(cfg, n, life)
+
+    def _rejoin(state, round_idx):
+        if revive_fn is None:
+            return state
+        return revive_fn(state, round_idx)
 
     def pool_parts(round_idx, key_data):
         with jax.named_scope("sample"):
@@ -449,8 +534,10 @@ def _make_pool_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array, dty
             choice = sampling.pool_choice_packed(kr, n, K)
             gate = sampling.send_gate(kr, n, cfg.fault_rate)
             send_ok = jnp.ones((n,), bool) if gate is True else gate
-            if death_dev is not None:
-                send_ok = send_ok & (death_dev > round_idx)
+            if life is not None:
+                send_ok = send_ok & faults_mod.alive_at(
+                    life.death, round_idx, life.revive
+                )
             return choice, offs, send_ok
 
     if cfg.algorithm == "push-sum":
@@ -459,6 +546,7 @@ def _make_pool_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array, dty
         term_rounds = cfg.term_rounds
 
         def round_fn(state, round_idx, key_data):
+            state = _rejoin(state, round_idx)
             choice, offs, send_ok = pool_parts(round_idx, key_data)
             with jax.named_scope("pushsum_halve"):
                 s_send, w_send, s_keep, w_keep = pushsum_mod.halve_and_send(
@@ -473,7 +561,7 @@ def _make_pool_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array, dty
                     state, s_keep, w_keep, inbox[0], inbox[1], delta,
                     term_rounds, cfg.termination == "global",
                 )
-            return _freeze_dead(death_dev, state, new, round_idx)
+            return _freeze_dead(life, state, new, round_idx)
 
     else:
         leader = draw_leader(base_key, topo, cfg)
@@ -484,6 +572,7 @@ def _make_pool_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array, dty
         suppress = cfg.resolved_suppress
 
         def round_fn(state, round_idx, key_data):
+            state = _rejoin(state, round_idx)
             choice, offs, send_ok = pool_parts(round_idx, key_data)
             with jax.named_scope("gossip_send"):
                 vals = gossip_mod.send_values(state, send_ok)
@@ -493,7 +582,7 @@ def _make_pool_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array, dty
                 # Suppression is receiver-side (models/gossip.absorb): no
                 # pool_lookup backward rolls needed.
                 new = gossip_mod.absorb(state, inbox, rumor_target, suppress)
-            return _freeze_dead(death_dev, state, new, round_idx)
+            return _freeze_dead(life, state, new, round_idx)
 
     return round_fn, state0, key_data, ()
 
@@ -548,7 +637,13 @@ def _make_imp_pool_round_fn(
     key_data, key_impl = sampling.key_split(base_key)
     topo_args = (jnp.asarray(split.disp_cols), jnp.asarray(split.degree))
     lattice_offsets = tuple(int(q) for q in split.lattice_offsets)
-    death_dev = _death_dev(cfg, n)
+    life = _life_dev(cfg, n)
+    revive_fn = make_revive_fn(cfg, n, life)
+
+    def _rejoin(state, round_idx):
+        if revive_fn is None:
+            return state
+        return revive_fn(state, round_idx)
 
     def parts(round_idx, key_data, disp_cols, degree):
         with jax.named_scope("sample"):
@@ -558,8 +653,10 @@ def _make_imp_pool_round_fn(
             d, is_extra, choice, offs, send_ok = imp_pool_parts(
                 topo, cfg, kr, disp_cols, degree
             )
-            if death_dev is not None:
-                send_ok = send_ok & (death_dev > round_idx)
+            if life is not None:
+                send_ok = send_ok & faults_mod.alive_at(
+                    life.death, round_idx, life.revive
+                )
             return d, is_extra, choice, offs, send_ok
 
     if cfg.algorithm == "push-sum":
@@ -568,6 +665,7 @@ def _make_imp_pool_round_fn(
         term_rounds = cfg.term_rounds
 
         def round_fn(state, round_idx, key_data, *targs):
+            state = _rejoin(state, round_idx)
             d, is_extra, choice, offs, send_ok = parts(round_idx, key_data, *targs)
             with jax.named_scope("pushsum_halve"):
                 s_send, w_send, s_keep, w_keep = pushsum_mod.halve_and_send(
@@ -583,7 +681,7 @@ def _make_imp_pool_round_fn(
                     state, s_keep, w_keep, inbox[0], inbox[1], delta,
                     term_rounds, cfg.termination == "global",
                 )
-            return _freeze_dead(death_dev, state, new, round_idx)
+            return _freeze_dead(life, state, new, round_idx)
 
     else:
         leader = draw_leader(base_key, topo, cfg)
@@ -592,6 +690,7 @@ def _make_imp_pool_round_fn(
         suppress = cfg.resolved_suppress
 
         def round_fn(state, round_idx, key_data, *targs):
+            state = _rejoin(state, round_idx)
             d, is_extra, choice, offs, send_ok = parts(round_idx, key_data, *targs)
             with jax.named_scope("gossip_send"):
                 vals = gossip_mod.send_values(state, send_ok)
@@ -601,7 +700,7 @@ def _make_imp_pool_round_fn(
                 )[0]
             with jax.named_scope("gossip_absorb"):
                 new = gossip_mod.absorb(state, inbox, rumor_target, suppress)
-            return _freeze_dead(death_dev, state, new, round_idx)
+            return _freeze_dead(life, state, new, round_idx)
 
     return round_fn, state0, key_data, topo_args
 
@@ -635,7 +734,7 @@ def _run_reference_walk(topo: Topology, cfg: SimConfig, key, target: int) -> Run
     return result
 
 
-def _host_done(cfg, death_np, state, rounds: int, target: int) -> bool:
+def _host_done(cfg, life_np, state, rounds: int, target: int) -> bool:
     """Host-side evaluation of the termination predicate against the final
     state — the same rule _done_predicate traces (quorum over live nodes
     under a crash model, converged_count >= target otherwise), for engines
@@ -643,9 +742,11 @@ def _host_done(cfg, death_np, state, rounds: int, target: int) -> bool:
     import numpy as np
 
     conv = np.asarray(state.conv) != 0
-    if death_np is None:
+    if life_np is None:
         return bool(conv.sum() >= target)
-    alive = death_np > (rounds - 1)
+    alive = np.asarray(
+        faults_mod.alive_at(life_np.death, rounds - 1, life_np.revive)
+    )
     need = int(faults_mod.quorum_need(int(alive.sum()), cfg.quorum))
     return bool((conv & alive).sum() >= need)
 
@@ -653,9 +754,15 @@ def _host_done(cfg, death_np, state, rounds: int, target: int) -> bool:
 def _finalize_result(
     topo, cfg, state, rounds, target, compile_s, run_s,
     done=None, stalled: bool = False, loop=None, collector=None,
+    unhealthy_round=None,
 ) -> RunResult:
     converged_count = int(jnp.sum(state.conv))
     converged = (converged_count >= target) if done is None else bool(done)
+    if unhealthy_round is not None:
+        # A tripped sentinel overrides everything: the state is corrupt (or
+        # conservation broke), so any "converged" verdict it produced is
+        # untrusted.
+        converged = False
     result = RunResult(
         algorithm=cfg.algorithm,
         topology=topo.kind,
@@ -669,16 +776,25 @@ def _finalize_result(
         compile_s=compile_s,
         run_s=run_s,
         outcome=(
-            "converged" if converged
+            "unhealthy" if unhealthy_round is not None
+            else "converged" if converged
             else ("stalled" if stalled else "max_rounds")
         ),
+        unhealthy_round=unhealthy_round,
     )
     if cfg.algorithm == "push-sum":
-        ratio = state.s / state.w
+        # w == 0 is reachable under rejoin='fresh' (revived nodes restart
+        # weightless) and in unhealthy states — guard the ratio so the MAE
+        # report never manufactures inf/NaN of its own.
+        w_safe = jnp.where(state.w != 0, state.w, 1)
+        ratio = jnp.where(state.w != 0, state.s / w_safe, 0.0)
         true_mean = (topo.n - 1) / 2.0
         err = jnp.where(state.conv, jnp.abs(ratio - true_mean), 0.0)
         result.true_mean = true_mean
-        result.estimate_mae = float(jnp.sum(err) / jnp.maximum(converged_count, 1))
+        mae = float(jnp.sum(err) / jnp.maximum(converged_count, 1))
+        import math
+
+        result.estimate_mae = mae if math.isfinite(mae) else None
     if loop is not None:
         result.dispatch_s = loop.dispatch_s
         result.fetch_s = loop.fetch_s
@@ -877,8 +993,8 @@ def _run_fused(
     compile_s = time.perf_counter() - t0
 
     watchdog = StallWatchdog(cfg.stall_chunks)
-    death_np = faults_mod.death_plane(cfg, topo.n)
-    death_dev = None if death_np is None else jnp.asarray(death_np)
+    life_np = faults_mod.life_planes(cfg, topo.n)
+    life_dev = _life_dev(cfg, topo.n)
 
     def dispatch(state, rnd, done, round_end):
         return chunk_j(state, rnd, done, jnp.int32(round_end))
@@ -896,7 +1012,7 @@ def _run_fused(
         def should_stop(rounds, state):
             return watchdog.no_progress(
                 _progress_gap(
-                    death_dev, cfg.quorum, target,
+                    life_dev, cfg.quorum, target,
                     to_canonical(state).conv, rounds,
                 )
             )
@@ -917,12 +1033,74 @@ def _run_fused(
     run_s = time.perf_counter() - t1
 
     final = to_canonical(loop.state)
-    done = _host_done(cfg, death_np, final, loop.rounds, target)
+    done = _host_done(cfg, life_np, final, loop.rounds, target)
     return _finalize_result(
         topo, cfg, final, loop.rounds, target, compile_s, run_s,
         done=done, stalled=watchdog.stalled, loop=loop,
         collector=collector,
     )
+
+
+# Graceful engine degradation (run()'s fallback ladder). Environmental
+# failures — a Pallas/XLA compile error, OOM, a missing collective
+# implementation, a dropped device tunnel — surface as these exception
+# types; config-contract errors stay ValueError and always fail fast (a
+# silently degraded answer to an invalid request would mask the bug).
+# OSError is deliberately NOT here: inside _run_resolved it comes from
+# user hooks (checkpoint writes, log appends — e.g. a full disk), which no
+# other engine rung can fix; re-simulating on their account would only
+# replay the same I/O failure.
+_DEGRADABLE_ERRORS = (
+    RuntimeError,  # jaxlib XlaRuntimeError derives from it (compile/OOM)
+    ImportError,  # missing shard_map / Pallas on old runtimes
+    MemoryError,
+    NotImplementedError,
+)
+
+# Substrings marking an error as a TRANSIENT dispatch failure (gRPC-status
+# vocabulary the TPU runtime uses): retried on the same rung with
+# exponential backoff before the ladder moves down.
+_TRANSIENT_MARKERS = (
+    "UNAVAILABLE", "DEADLINE_EXCEEDED", "ABORTED", "CANCELLED",
+)
+_TRANSIENT_RETRIES = 3
+
+
+def _strict_engine(cfg: SimConfig) -> bool:
+    """cfg.strict_engine, overridable either way by the
+    GOSSIP_TPU_STRICT_ENGINE env var (scripts/tier1.sh exports 1 so CI
+    never silently degrades; the chaos job exercises the ladder with 0)."""
+    env = os.environ.get("GOSSIP_TPU_STRICT_ENGINE", "")
+    if env != "":
+        return env not in ("0", "false", "no")
+    return cfg.strict_engine
+
+
+def _engine_desc(cfg: SimConfig) -> str:
+    return f"engine={cfg.engine}/devices={cfg.n_devices or 1}"
+
+
+def _engine_ladder(cfg: SimConfig) -> list:
+    """The documented fallback ladder, most- to least-capable:
+
+        requested config
+          -> engine='chunked' (same devices)   [fused/auto kernel failures]
+          -> engine='chunked', single device   [sharded/collective failures]
+
+    Every step preserves semantics: the chunked XLA engines are the
+    reference implementations the fused kernels are pinned against, and
+    the sharded engine is stream-identical to single-device (gossip
+    bitwise; push-sum up to documented reassociation on the scatter path).
+    """
+    rungs = [cfg]
+    c = cfg
+    if c.engine != "chunked":
+        c = dataclasses.replace(c, engine="chunked")
+        rungs.append(c)
+    if c.n_devices is not None and c.n_devices > 1:
+        c = dataclasses.replace(c, n_devices=None)
+        rungs.append(c)
+    return rungs
 
 
 def run(
@@ -933,8 +1111,89 @@ def run(
     start_state=None,
     start_round: int = 0,
     on_telemetry: Optional[Callable[[int, object], None]] = None,
+    on_event: Optional[Callable] = None,
 ) -> RunResult:
-    """Run one simulation to convergence (or cfg.max_rounds) on one device.
+    """Run one simulation to convergence (or cfg.max_rounds) — the public
+    entry every caller (CLI, suite, tests) goes through.
+
+    Engine resilience: environmental failures (_DEGRADABLE_ERRORS — compile
+    errors, OOM, missing runtime features, dropped device connections) walk
+    the documented fallback ladder (_engine_ladder: fused->chunked,
+    sharded->single-device) instead of killing the run; transient dispatch
+    errors (_TRANSIENT_MARKERS) retry the same rung with exponential
+    backoff first. Each rung change is printed to stderr, reported through
+    ``on_event("engine-degraded", ...)`` (the CLI wires this to the
+    run-event log, utils/events.py), and recorded in
+    ``RunResult.degradations``. ``cfg.strict_engine`` / the
+    GOSSIP_TPU_STRICT_ENGINE env var restore fail-fast. ValueError —
+    config-contract violations — always fails fast: a degraded answer to an
+    invalid request would mask the bug.
+
+    See _run_resolved for the hook/resume contracts.
+    """
+    strict = _strict_engine(cfg)
+    rungs = _engine_ladder(cfg)
+    degradations: list = []
+    backoff = float(os.environ.get("GOSSIP_TPU_RETRY_BASE_S", "0.5") or 0.5)
+    for i, rung in enumerate(rungs):
+        attempt = 0
+        while True:
+            try:
+                result = _run_resolved(
+                    topo, rung, key=key, on_chunk=on_chunk,
+                    start_state=start_state, start_round=start_round,
+                    on_telemetry=on_telemetry,
+                )
+                if degradations:
+                    result.degradations = degradations
+                return result
+            except _DEGRADABLE_ERRORS as e:
+                if strict:
+                    raise
+                msg = f"{type(e).__name__}: {e}"
+                if any(m in str(e) for m in _TRANSIENT_MARKERS) and (
+                    attempt < _TRANSIENT_RETRIES
+                ):
+                    attempt += 1
+                    delay = backoff * 2 ** (attempt - 1)
+                    print(
+                        f"transient engine error (retry {attempt}/"
+                        f"{_TRANSIENT_RETRIES} in {delay:.1f}s): {msg}",
+                        file=sys.stderr,
+                    )
+                    time.sleep(delay)
+                    continue
+                if i == len(rungs) - 1:
+                    raise  # bottom of the ladder — nothing left to try
+                step = {
+                    "from": _engine_desc(rung),
+                    "to": _engine_desc(rungs[i + 1]),
+                    "reason": msg[:500],
+                    "transient_retries": attempt,
+                }
+                degradations.append(step)
+                print(
+                    f"engine degraded ({step['from']} -> {step['to']}): "
+                    f"{msg}",
+                    file=sys.stderr,
+                )
+                if on_event is not None:
+                    on_event("engine-degraded", **step)
+                break
+    raise AssertionError("unreachable: ladder loop exits by return/raise")
+
+
+def _run_resolved(
+    topo: Topology,
+    cfg: SimConfig,
+    key: Optional[jax.Array] = None,
+    on_chunk: Optional[Callable[[int, object], None]] = None,
+    start_state=None,
+    start_round: int = 0,
+    on_telemetry: Optional[Callable[[int, object], None]] = None,
+) -> RunResult:
+    """One attempt at one ladder rung: dispatch to the engine cfg names and
+    run to completion on it.
 
     ``on_chunk(rounds_done, state)`` fires at every chunk boundary. It is
     the CHECKPOINT hook: it reads retired device state, which forces buffer
@@ -965,6 +1224,13 @@ def run(
                     "engines; the sharded fused compositions do not carry "
                     "the counter block — drop the engine override (the "
                     "sharded XLA engine psums the block in-trace)"
+                )
+            if cfg.mass_tolerance is not None:
+                raise ValueError(
+                    "the health sentinel (--mass-tolerance) runs in the "
+                    "chunked and sharded XLA round bodies; the sharded "
+                    "fused compositions do not carry it — drop the engine "
+                    "override"
                 )
             if topo.implicit and cfg.delivery == "pool":
                 # Implicit-full pool composition (VERDICT r3 #1): local
@@ -1130,6 +1396,16 @@ def run(
                 f"only (selected tier: {variant!r})"
             )
             auto_ok = False
+        if cfg.mass_tolerance is not None and reason is None:
+            # The health sentinel reduces over every round's state inside
+            # the XLA while body; the Pallas tiers do not carry it. Under
+            # engine='auto' this demotes the run to the chunked engine;
+            # engine='fused' fails loudly below.
+            reason = (
+                "the health sentinel (--mass-tolerance) runs in the "
+                "chunked/sharded XLA round bodies only"
+            )
+            auto_ok = False
         if cfg.engine == "fused":
             if variant != "pool" and cfg.delivery == "scatter":
                 raise ValueError(
@@ -1160,9 +1436,9 @@ def run(
     def proto_of(carry_state):
         return carry_state[0] if has_ring else carry_state
 
-    death_np = faults_mod.death_plane(cfg, topo.n)
-    death_dev = None if death_np is None else jnp.asarray(death_np)
-    done_fn = _done_predicate(cfg, death_dev, target)
+    life_np = faults_mod.life_planes(cfg, topo.n)
+    life_dev = _life_dev(cfg, topo.n)
+    done_fn = _done_predicate(cfg, life_dev, target)
     done0 = False
     if start_state is not None:
         if has_ring:
@@ -1181,7 +1457,7 @@ def run(
         # fused kernels (which seed their done flag from the incoming conv
         # plane) — otherwise the resumed trajectory gains a phantom round.
         # Same predicate the original run evaluated after its last round.
-        done0 = _host_done(cfg, death_np, state0, start_round, target)
+        done0 = _host_done(cfg, life_np, state0, start_round, target)
 
     # Telemetry plane (ops/telemetry.py): the while body additionally
     # writes one float32 counter row per executed round into a fixed
@@ -1195,8 +1471,42 @@ def run(
     )
     stride = cfg.chunk_rounds
 
-    def chunk(state, rnd, done, round_end, key_data, *targs):
+    # Health sentinel (cfg.mass_tolerance, push-sum only — SimConfig
+    # validates): every executed round additionally reduces a non-finite
+    # flag over (s, w) (and the delay ring) and the mass-conservation
+    # residual |Σw − n| against the tolerance. The first round either check
+    # trips latches into a ``health`` int32 scalar riding the carry next to
+    # the done flag (NEVER = healthy) and forces termination — the driver
+    # reports outcome="unhealthy" with the offending round instead of
+    # converging wrong or spinning to max_rounds. A Python-level flag:
+    # sentinel off traces the bitwise-identical program.
+    sentinel = cfg.mass_tolerance is not None
+    never_i32 = jnp.int32(faults_mod.NEVER)
+    if sentinel:
+        tol = cfg.mass_tolerance
+
+        def sentinel_bad(carry_state):
+            st = proto_of(carry_state)
+            finite = jnp.isfinite(st.s).all() & jnp.isfinite(st.w).all()
+            total_w = jnp.sum(st.w)
+            if has_ring:
+                ring = carry_state[1]
+                finite = finite & jnp.isfinite(ring).all()
+                # In-flight delivery mass counts: conservation holds over
+                # state + ring (ops/faults.py docstring).
+                total_w = total_w + jnp.sum(ring[:, 1, :])
+            resid = jnp.abs(total_w - jnp.asarray(topo.n, st.w.dtype))
+            return (~finite) | (resid > jnp.asarray(tol, st.w.dtype))
+
+    def chunk(state, rnd, done, *rest):
+        if sentinel:
+            health, round_end, key_data = rest[0], rest[1], rest[2]
+            targs = rest[3:]
+        else:
+            round_end, key_data = rest[0], rest[1]
+            targs = rest[2:]
         rnd_in = rnd  # loop-entry round: telemetry rows index from here
+        buf_i = 4 if sentinel else 3
 
         def cond(c):
             return jnp.logical_and(~c[2], c[1] < round_end)
@@ -1205,15 +1515,25 @@ def run(
             s, r = c[0], c[1]
             s = round_fn(s, r, key_data, *targs)
             d = done_fn(proto_of(s), r)
-            out = (s, r + 1, d)
+            if sentinel:
+                h = c[3]
+                h = jnp.where(
+                    (h == never_i32) & sentinel_bad(s), r, h
+                )
+                d = d | (h != never_i32)
+                out = (s, r + 1, d, h)
+            else:
+                out = (s, r + 1, d)
             if telemetry:
                 row = row_fn(proto_of(s), r, key_data)
                 out += (lax.dynamic_update_index_in_dim(
-                    c[3], row, r - rnd_in, 0
+                    c[buf_i], row, r - rnd_in, 0
                 ),)
             return out
 
         carry = (state, rnd, done)
+        if sentinel:
+            carry += (health,)
         if telemetry:
             carry += (jnp.zeros((stride, telemetry_mod.N_COLS), jnp.float32),)
         return lax.while_loop(cond, body, carry)
@@ -1225,6 +1545,11 @@ def run(
     chunk_j = jax.jit(chunk, donate_argnums=(0,) if donate else ())
     rnd0 = jnp.int32(start_round)
     done0_dev = jnp.bool_(done0)
+    health0 = never_i32 if sentinel else None
+
+    def _chunk_args(health, round_end):
+        pre = (health,) if sentinel else ()
+        return pre + (jnp.int32(round_end), key_data) + topo_args
 
     t0 = time.perf_counter()
     # Warmup runs ONE real round and DISCARDS the result — the timed loop
@@ -1238,8 +1563,8 @@ def run(
     # trajectory.
     warm = chunk_j(
         jax.tree.map(jnp.copy, state0) if donate else state0,
-        rnd0, done0_dev, jnp.int32(min(start_round + 1, cfg.max_rounds)),
-        key_data, *topo_args,
+        rnd0, done0_dev,
+        *_chunk_args(health0, min(start_round + 1, cfg.max_rounds)),
     )
     int(warm[1])  # data-dependent sync; block_until_ready can return early
     del warm
@@ -1247,10 +1572,12 @@ def run(
 
     watchdog = StallWatchdog(cfg.stall_chunks)
 
-    def dispatch(state, rnd, done, round_end):
-        return chunk_j(
-            state, rnd, done, jnp.int32(round_end), key_data, *topo_args
-        )
+    if sentinel:
+        def dispatch(state, rnd, done, health, round_end):
+            return chunk_j(state, rnd, done, *_chunk_args(health, round_end))
+    else:
+        def dispatch(state, rnd, done, round_end):
+            return chunk_j(state, rnd, done, *_chunk_args(None, round_end))
 
     on_retire = None
     if on_chunk is not None:
@@ -1262,7 +1589,7 @@ def run(
         def should_stop(rounds, state):
             return watchdog.no_progress(
                 _progress_gap(
-                    death_dev, cfg.quorum, target,
+                    life_dev, cfg.quorum, target,
                     proto_of(state).conv, rounds,
                 )
             )
@@ -1279,11 +1606,16 @@ def run(
         stride=cfg.chunk_rounds, depth=cfg.pipeline_chunks, donate=donate,
         on_retire=on_retire, should_stop=should_stop,
         on_aux=collector.on_aux if collector else None,
+        health0=health0,
     )
     run_s = time.perf_counter() - t1
+
+    unhealthy_round = None
+    if sentinel and loop.health is not None and loop.health != int(never_i32):
+        unhealthy_round = int(loop.health)
 
     return _finalize_result(
         topo, cfg, proto_of(loop.state), loop.rounds, target,
         compile_s, run_s, done=loop.done, stalled=watchdog.stalled,
-        loop=loop, collector=collector,
+        loop=loop, collector=collector, unhealthy_round=unhealthy_round,
     )

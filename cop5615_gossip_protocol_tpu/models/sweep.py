@@ -58,14 +58,15 @@ from ..ops import telemetry as telemetry_mod
 from ..ops.topology import Topology
 from ..utils.metrics import RUN_RECORD_SCHEMA_VERSION
 from .runner import (
-    _death_dev,
     _done_predicate,
+    _life_dev,
     make_round_fn,
 )
 
-# First replica tag. Sits above the round-index region (< 2**30) and
-# CRASH_TAG (2**30 + 0xDEAD), below _LEADER_TAG (2**31 - 1); replica 0
-# deliberately has NO tag — it rides the base key itself.
+# First replica tag. Sits above the round-index region (< 2**30) and the
+# CRASH_TAG/REVIVE_TAG churn-plane tags, below _LEADER_TAG (2**31 - 1) —
+# canonical tag map in ops/faults.py; replica 0 deliberately has NO tag —
+# it rides the base key itself.
 REPLICA_TAG0 = 2**30 + 2**29
 
 MAX_REPLICAS = 4096
@@ -181,6 +182,13 @@ def _reject_unsupported(cfg: SimConfig) -> None:
             "has no single progress gap to watch — run stall diagnostics "
             "unbatched"
         )
+    if cfg.mass_tolerance is not None:
+        raise ValueError(
+            "the health sentinel (mass_tolerance) carries one per-run "
+            "health scalar through the chunk loop; a batched sweep has no "
+            "per-replica outcome channel for it — run health-sentinel "
+            "diagnostics unbatched"
+        )
 
 
 def run_replicas(
@@ -213,8 +221,8 @@ def run_replicas(
     def proto_of(carry_state):
         return carry_state[0] if has_ring else carry_state
 
-    death_dev = _death_dev(cfg, topo.n)  # config-pure: shared by replicas
-    done_fn = _done_predicate(cfg, death_dev, target)
+    life_dev = _life_dev(cfg, topo.n)  # config-pure: shared by replicas
+    done_fn = _done_predicate(cfg, life_dev, target)
 
     # Telemetry plane: the vmapped chunk grows a per-replica counter block
     # — R full per-round trajectories out of one program, the same move

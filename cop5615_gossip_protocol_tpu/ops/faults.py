@@ -1,4 +1,4 @@
-"""Failure model: crash-stop node death, quorum targets, message faults.
+"""Failure model: crash-recovery node churn, quorum targets, message faults.
 
 The reference simulator models zero faults and simply hangs when a topology
 stalls (program.fs:334 — the famous line-topology non-convergence just
@@ -12,19 +12,42 @@ Crash-stop (``--crash-rate`` / ``--crash-schedule``)
     deterministically from ``PRNGKey(cfg.seed)`` under a dedicated fold_in
     tag (NOT from the runner's possibly-overridden base key, so every
     engine — chunked, sharded, fused — rebuilds the identical plane from
-    the config alone, and checkpoints need not store it). Node ``i`` is
-    alive during round ``r`` iff ``death_round[i] > r`` — one integer
-    compare, exact on every backend. Dead nodes never send; push-sum mass
-    delivered to a dead node still lands in its (s, w) — the mass *parks*
-    there, so total mass over live + dead nodes is conserved — but its
-    protocol state (term counter, convergence latch; gossip receipt counts)
-    is frozen: dead nodes neither converge nor advance.
+    the config alone, and checkpoints need not store it). Dead nodes never
+    send; push-sum mass delivered to a dead node still lands in its (s, w)
+    — the mass *parks* there, so total mass over live + dead nodes is
+    conserved — but its protocol state (term counter, convergence latch;
+    gossip receipt counts) is frozen: dead nodes neither converge nor
+    advance.
 
     ``crash_rate`` p: each node independently survives each round with
     probability 1-p (geometric death round via inverse CDF).
     ``crash_schedule`` "round:count,...": exactly ``count`` uniformly random
     distinct nodes die at each listed round — deterministic population
     decay for reproducible experiments.
+
+Crash-recovery (``--revive-rate`` / ``--revive-schedule``)
+    Each crashed node may additionally get a **revival round** — a second
+    int32 plane derived from ``PRNGKey(cfg.seed)`` + REVIVE_TAG, so the
+    whole churn history is a pure function of the config (checkpoints
+    store neither plane). Node ``i`` is alive during round ``r`` iff
+    ``death[i] > r or revival[i] <= r`` (``alive_at``): dead EXACTLY during
+    ``death <= r < revival`` — two integer compares, exact on every
+    backend. Rejoin semantics live in the engines (models/runner.py
+    ``make_revive_fn`` and the fused kernels' in-kernel mirror): gossip
+    revivals rejoin susceptible (count 0, inactive, unconverged — they can
+    re-converge; the quorum predicate recomputes live counts per round);
+    push-sum revivals either reclaim their parked (s, w) mass under
+    ``--rejoin restore`` (total mass over live + dead + parked conserved,
+    the crash-stop invariant extended) or reset to ``(s=x_i, w=0)`` under
+    ``--rejoin fresh`` (the discarded parked mass and the re-created value
+    ARE the modeled fault — conservation intentionally breaks, like
+    ``--dup-rate``).
+
+    ``revive_rate`` p: each dead node independently revives each round
+    after its death with probability p (geometric dead-time via inverse
+    CDF; revival >= death + 1).
+    ``revive_schedule`` "round:count,...": exactly ``count`` uniformly
+    random nodes dead at each listed round rejoin there.
 
 Quorum termination (``--quorum``)
     With nodes crashing, the legacy target (``converged_count >= n``) can
@@ -44,6 +67,21 @@ Message faults
     buffer (models/runner.py) — in-flight mass lives in the ring, so
     conservation holds over state + ring.
 
+Base-key fold_in TAG MAP (the canonical home — every other module's tag
+comment points here). All of these fold into ``PRNGKey(cfg.seed)`` (or the
+runner's base key) and must stay pairwise disjoint; the tags that fold
+into per-ROUND keys (sampling._POOL_TAG, GATE_TAG, DUP_TAG,
+IMP_CHOICE_TAG) are a different stream level entirely:
+
+    [0, 2**30)            round indices (SimConfig caps max_rounds at 2**30
+                          exactly to keep this region closed)
+    CRASH_TAG             2**30 + 0xDEAD        death-plane draw
+    REVIVE_TAG            2**30 + 0xA11FE       revival-plane draw
+    REPLICA_TAG0 + r      2**30 + 2**29 + r     replica keys, r < 4096
+                          (models/sweep.py; replica 0 rides the base key)
+    _LEADER_TAG           2**31 - 1             gossip leader draw
+                          (models/runner.py)
+
 JAX imports are deferred to call sites: ``parse_crash_schedule`` must stay
 importable from SimConfig validation without touching a backend.
 """
@@ -51,20 +89,33 @@ importable from SimConfig validation without touching a backend.
 from __future__ import annotations
 
 import functools
+from typing import NamedTuple, Optional
 
 import numpy as np
 
-# fold_in tag for the crash-priority draw off PRNGKey(cfg.seed). It shares
-# fold_in space with round indices (< 2**30, the SimConfig max_rounds cap
-# that exists exactly to keep base-key tags disjoint) and the leader tag
-# (2**31 - 1), so it must sit in [2**30, 2**31 - 1); the tags that fold
-# into per-round keys (sampling._POOL_TAG et al.) are a different stream
-# level entirely.
+# Death-plane fold_in tag — see the TAG MAP in the module docstring.
 CRASH_TAG = 2**30 + 0xDEAD
 
-# Death round of a node that never crashes. Above any reachable round
-# (max_rounds <= 2**30, enforced by SimConfig).
+# Revival-plane fold_in tag. Same [2**30, 2**30 + 2**29) region as
+# CRASH_TAG (disjoint from round indices, replica tags and the leader tag
+# by construction — TAG MAP above), distinct value so the revival draw can
+# never be bitwise the death draw.
+REVIVE_TAG = 2**30 + 0xA11FE
+
+# Death round of a node that never crashes / revival round of a node that
+# never rejoins. Above any reachable round (max_rounds <= 2**30, enforced
+# by SimConfig).
 NEVER = np.int32(np.iinfo(np.int32).max)
+
+
+class LifePlanes(NamedTuple):
+    """The churn history of one run: per-node death rounds plus (with a
+    recovery model) per-node revival rounds. Arrays are host numpy in the
+    builders and device jnp in the engines — ``alive_at`` accepts both.
+    ``revive`` is None for crash-stop (death only) configs."""
+
+    death: object  # int32 [n]
+    revive: Optional[object]  # int32 [n] or None
 
 
 def parse_crash_schedule(spec: str) -> tuple[tuple[int, int], ...]:
@@ -149,6 +200,74 @@ def _death_plane_cached(seed: int, crash_rate: float, crash_schedule, n: int):
     return np.clip(death, 0, float(NEVER)).astype(np.int32)
 
 
+def revival_plane(cfg, n: int):
+    """int32 [n] revival rounds (np.ndarray), or None when the config has
+    no recovery model. NEVER where the node never rejoins (including every
+    node that never dies).
+
+    Derived from ``PRNGKey(cfg.seed)`` + REVIVE_TAG (plus the death plane,
+    itself config-pure), so every engine rebuilds the identical plane and
+    checkpoints never store it. Memoized like the death plane; treat the
+    returned array as READ-ONLY."""
+    if not cfg.revive_model:
+        return None
+    return _revival_plane_cached(
+        cfg.seed, cfg.crash_rate, cfg.crash_schedule,
+        cfg.revive_rate, cfg.revive_schedule, n,
+    )
+
+
+@functools.lru_cache(maxsize=4)
+def _revival_plane_cached(
+    seed: int, crash_rate: float, crash_schedule,
+    revive_rate: float, revive_schedule, n: int,
+):
+    import jax
+    import jax.numpy as jnp
+
+    death = _death_plane_cached(seed, crash_rate, crash_schedule, n)
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), REVIVE_TAG)
+    revive = np.full((n,), NEVER, np.int32)
+    dead = death != NEVER
+    if revive_schedule is not None:
+        # Deterministic rejoin: at each listed round, the first `count`
+        # still-dead nodes in a fixed uniform permutation order rejoin.
+        events = parse_crash_schedule(revive_schedule)  # same grammar
+        perm = np.asarray(jax.random.permutation(key, n))
+        assigned = np.zeros((n,), bool)
+        for rnd, count in events:
+            eligible = perm[
+                (death[perm] < rnd) & (revive[perm] > rnd) & ~assigned[perm]
+            ]
+            if eligible.shape[0] < count:
+                raise ValueError(
+                    f"revive schedule rejoins {count} nodes at round {rnd} "
+                    f"but only {eligible.shape[0]} are dead there"
+                )
+            chosen = eligible[:count]
+            revive[chosen] = rnd
+            assigned[chosen] = True
+        return revive
+    p = float(revive_rate)
+    u = np.asarray(jax.random.uniform(key, (n,), jnp.float32), np.float64)
+    # Dead-time D >= 1 rounds: P(D > k) = (1-p)^k — the geometric inverse
+    # CDF, same derivation as the death plane's.
+    dead_time = 1.0 + np.floor(np.log1p(-u) / np.log1p(-p))
+    rev = death.astype(np.int64) + dead_time.astype(np.int64)
+    revive[dead] = np.clip(rev, 0, int(NEVER)).astype(np.int32)[dead]
+    return revive
+
+
+def life_planes(cfg, n: int) -> Optional[LifePlanes]:
+    """The run's churn history as host numpy planes, or None without a
+    crash model — the single constructor every engine calls (the fused
+    kernels pad/reshape the same arrays)."""
+    death = death_plane(cfg, n)
+    if death is None:
+        return None
+    return LifePlanes(death=death, revive=revival_plane(cfg, n))
+
+
 def pad_death_plane(death: np.ndarray, n_pad: int) -> np.ndarray:
     """Pad to n_pad with death round 0: padded slots count as DEAD, so
     alive-count reductions over padded layouts (sharded shards, fused
@@ -160,9 +279,29 @@ def pad_death_plane(death: np.ndarray, n_pad: int) -> np.ndarray:
     )
 
 
-def alive_at(death, round_idx):
-    """bool alive mask for round ``round_idx`` (both may be traced)."""
-    return death > round_idx
+def pad_revival_plane(revive: np.ndarray, n_pad: int) -> np.ndarray:
+    """Pad to n_pad with NEVER: padded slots (death round 0) must stay dead
+    forever, so their revival never comes."""
+    if revive.shape[0] == n_pad:
+        return revive
+    return np.concatenate(
+        [revive, np.full((n_pad - revive.shape[0],), NEVER, np.int32)]
+    )
+
+
+def alive_at(death, round_idx, revive=None):
+    """bool alive mask for round ``round_idx`` (all may be traced): dead
+    exactly during ``death <= round_idx < revive``."""
+    alive = death > round_idx
+    if revive is not None:
+        alive = alive | (revive <= round_idx)
+    return alive
+
+
+def revived_at(revive, round_idx):
+    """bool mask of nodes whose revival round IS ``round_idx`` — the
+    rejoin-reset trigger every engine keys its revival semantics on."""
+    return revive == round_idx
 
 
 def quorum_need(alive_count, quorum: float):

@@ -287,13 +287,40 @@ def build_death2d(cfg: SimConfig, n: int, n_pad: int):
     )
 
 
-def make_done_flag(death_ref, target, quorum, masked_total: bool = False):
+def build_revive2d(cfg: SimConfig, n: int, n_pad: int):
+    """[n_pad // 128, 128] int32 revival plane for a fused kernel, or None
+    without a recovery model. Padded with NEVER — pad slots (death round 0)
+    stay dead forever (ops/faults.pad_revival_plane)."""
+    revive = faults_mod.revival_plane(cfg, n)
+    if revive is None:
+        return None
+    return jnp.asarray(
+        faults_mod.pad_revival_plane(revive, n_pad).reshape(
+            n_pad // LANES, LANES
+        )
+    )
+
+
+def alive_plane(death_ref, revive_ref, round_idx):
+    """In-kernel alive mask over whole [R, 128] churn-plane refs —
+    faults.alive_at on VMEM refs (revive_ref None without a recovery
+    model)."""
+    alive = death_ref[:] > round_idx
+    if revive_ref is not None:
+        alive = alive | (revive_ref[:] <= round_idx)
+    return alive
+
+
+def make_done_flag(
+    death_ref, target, quorum, masked_total: bool = False, revive_ref=None
+):
     """In-kernel termination verdict, shared by every fused kernel builder
-    (call INSIDE the kernel body, where ``death_ref`` is the crash-plane
-    VMEM ref or None without a crash model): quorum over live nodes under
-    a crash model (faults.quorum_need — the same jnp ops as the chunked
-    predicate, so the per-round targets agree across engines), the legacy
-    target count otherwise.
+    (call INSIDE the kernel body, where ``death_ref``/``revive_ref`` are
+    the churn-plane VMEM refs or None without a crash/recovery model):
+    quorum over live nodes under a crash model (faults.quorum_need — the
+    same jnp ops as the chunked predicate, so the per-round targets agree
+    across engines), the legacy target count otherwise. Under a recovery
+    model the live set grows back as revivals land.
 
     The returned ``done_flag(conv, round_idx)`` takes either the raw conv
     plane (``masked_total=False`` — it masks dead lanes itself) or an
@@ -305,7 +332,7 @@ def make_done_flag(death_ref, target, quorum, masked_total: bool = False):
         if death_ref is None:
             total = conv if masked_total else jnp.sum(conv)
             return jnp.where(total >= target, jnp.int32(1), jnp.int32(0))
-        alive = death_ref[:] > round_idx
+        alive = alive_plane(death_ref, revive_ref, round_idx)
         if masked_total:
             conv_alive = conv
         else:
@@ -382,13 +409,17 @@ def make_pushsum_chunk(
     target = np.int32(cfg.resolved_target_count(topo.n, topo.target_count))
     global_term = cfg.termination == "global"
     # Failure model (ops/faults.py): drop gate regenerated in-kernel from
-    # the per-round gate subkeys; crash plane as an extra input. Both are
+    # the per-round gate subkeys; churn planes as extra inputs. All are
     # Python-level flags, so a fault-free config traces the IDENTICAL
     # kernel as before — bitwise trajectory equivalence at fault_rate=0.
     use_gate = cfg.fault_rate > 0
     thresh = np.uint32(gate_threshold(cfg.fault_rate)) if use_gate else None
     death2d = build_death2d(cfg, topo.n, layout.n_pad)
     crashed = death2d is not None
+    revive2d = build_revive2d(cfg, topo.n, layout.n_pad)
+    revived = revive2d is not None
+    fresh_rejoin = cfg.rejoin == "fresh"
+    init_term = np.int32(cfg.initial_term_round)
     quorum = cfg.quorum
     # Telemetry plane (ops/telemetry.py): each active grid step folds one
     # counter row into a VMEM scratch register; every grid step copies it
@@ -403,6 +434,7 @@ def make_pushsum_chunk(
         gkeys_ref = next(it) if use_gate else None
         disp_ref, deg_ref = next(it), next(it)
         death_ref = next(it) if crashed else None
+        revive_ref = next(it) if revived else None
         s0, w0, t0, c0 = next(it), next(it), next(it), next(it)
         s_o, w_o, t_o, c_o, meta_o = (
             next(it), next(it), next(it), next(it), next(it)
@@ -415,7 +447,9 @@ def make_pushsum_chunk(
         k = pl.program_id(0)
         K = pl.num_programs(0)
 
-        done_flag = make_done_flag(death_ref, target, quorum)
+        done_flag = make_done_flag(
+            death_ref, target, quorum, revive_ref=revive_ref
+        )
 
         @pl.when(k == 0)
         def _init():
@@ -437,6 +471,22 @@ def make_pushsum_chunk(
         @pl.when(active)
         def _round():
             kk = k % 8
+            rnd = start_ref[0] + k
+            if revived and fresh_rejoin:
+                # Rejoin reset at round-body entry (the in-kernel mirror of
+                # models/runner.make_revive_fn): fresh revivals restart at
+                # (s=x_i, w=0, term=initial, conv=0). Pad lanes carry
+                # revival NEVER, so rn never fires there.
+                rn = revive_ref[:] == rnd
+                pos = (
+                    jax.lax.broadcasted_iota(jnp.int32, (R, LANES), 0)
+                    * LANES
+                    + jax.lax.broadcasted_iota(jnp.int32, (R, LANES), 1)
+                )
+                s_v[:] = jnp.where(rn, pos.astype(jnp.float32), s_v[:])
+                w_v[:] = jnp.where(rn, jnp.float32(0), w_v[:])
+                t_v[:] = jnp.where(rn, init_term, t_v[:])
+                c_v[:] = jnp.where(rn, jnp.int32(0), c_v[:])
             bits = threefry_bits_2d(keys_ref[kk, 0], keys_ref[kk, 1], R, LANES)
             deg = deg_ref[:]
             disp = _sample_disp(bits, disp_ref, deg)
@@ -447,8 +497,8 @@ def make_pushsum_chunk(
                 )
                 send_ok = send_ok & (gbits >= thresh)
             if crashed:
-                alive = death_ref[:] > start_ref[0] + k
-                send_ok = send_ok & alive  # dead nodes never send
+                alive = alive_plane(death_ref, revive_ref, rnd)
+                send_ok = send_ok & alive  # dead: no sends; revived resume
             s = s_v[:]
             w = w_v[:]
             zero = jnp.float32(0)
@@ -553,8 +603,16 @@ def make_pushsum_chunk(
                     if crashed:
                         fired = fired & alive
                     drops = jnp.sum(fired.astype(jnp.int32), dtype=jnp.int32)
+                revived_ct = (
+                    jnp.sum(
+                        (revive_ref[:] == rnd).astype(jnp.int32),
+                        dtype=jnp.int32,
+                    )
+                    if revived else jnp.int32(0)
+                )
                 trow[:] = telemetry_row(
-                    [conv_ct, live, gap, 0.0, mae, mass, drops, 0.0]
+                    [conv_ct, live, gap, 0.0, mae, mass, drops, 0.0,
+                     revived_ct]
                 )
 
         if telemetry:
@@ -608,6 +666,9 @@ def make_pushsum_chunk(
         if crashed:
             in_specs.append(plane)
             operands.append(death2d)
+        if revived:
+            in_specs.append(plane)
+            operands.append(revive2d)
         in_specs += [plane] * 4
         operands += [s, w, t, c]
         out_shape = [f32, f32, i32, i32, jax.ShapeDtypeStruct((1,), jnp.int32)]
@@ -661,6 +722,8 @@ def make_gossip_chunk(topo: Topology, cfg: SimConfig, *, interpret: bool = False
     thresh = np.uint32(gate_threshold(cfg.fault_rate)) if use_gate else None
     death2d = build_death2d(cfg, topo.n, layout.n_pad)
     crashed = death2d is not None
+    revive2d = build_revive2d(cfg, topo.n, layout.n_pad)
+    revived = revive2d is not None
     quorum = cfg.quorum
     telemetry = cfg.telemetry  # see make_pushsum_chunk: Python-level flag
 
@@ -670,6 +733,7 @@ def make_gossip_chunk(topo: Topology, cfg: SimConfig, *, interpret: bool = False
         gkeys_ref = next(it) if use_gate else None
         disp_ref, deg_ref = next(it), next(it)
         death_ref = next(it) if crashed else None
+        revive_ref = next(it) if revived else None
         n0, a0, c0 = next(it), next(it), next(it)
         n_o, a_o, c_o, meta_o = next(it), next(it), next(it), next(it)
         tele_o = next(it) if telemetry else None
@@ -678,7 +742,9 @@ def make_gossip_chunk(topo: Topology, cfg: SimConfig, *, interpret: bool = False
         k = pl.program_id(0)
         K = pl.num_programs(0)
 
-        done_flag = make_done_flag(death_ref, target, quorum)
+        done_flag = make_done_flag(
+            death_ref, target, quorum, revive_ref=revive_ref
+        )
 
         @pl.when(k == 0)
         def _init():
@@ -695,6 +761,17 @@ def make_gossip_chunk(topo: Topology, cfg: SimConfig, *, interpret: bool = False
         @pl.when(active_chunk)
         def _round():
             kk = k % 8
+            rnd = start_ref[0] + k
+            if revived:
+                # Gossip revivals ALWAYS rejoin susceptible (count 0,
+                # inactive, unconverged) — the reset runs before the send
+                # mask reads a_v and before suppression reads c_v, the
+                # same ordering as the chunked engine's round-body-entry
+                # reset (models/runner.make_revive_fn).
+                rn = revive_ref[:] == rnd
+                n_v[:] = jnp.where(rn, jnp.int32(0), n_v[:])
+                a_v[:] = jnp.where(rn, jnp.int32(0), a_v[:])
+                c_v[:] = jnp.where(rn, jnp.int32(0), c_v[:])
             bits = threefry_bits_2d(keys_ref[kk, 0], keys_ref[kk, 1], R, LANES)
             deg = deg_ref[:]
             disp = _sample_disp(bits, disp_ref, deg)
@@ -705,8 +782,8 @@ def make_gossip_chunk(topo: Topology, cfg: SimConfig, *, interpret: bool = False
                 )
                 sending = sending & (gbits >= thresh)
             if crashed:
-                alive = death_ref[:] > start_ref[0] + k
-                sending = sending & alive  # dead nodes never send
+                alive = alive_plane(death_ref, revive_ref, rnd)
+                sending = sending & alive  # dead: no sends; revived resume
             vals = sending.astype(jnp.int32)
             inbox = jnp.zeros_like(vals)
             for d_mod, shift in layout.shifts:
@@ -758,8 +835,16 @@ def make_gossip_chunk(topo: Topology, cfg: SimConfig, *, interpret: bool = False
                     if crashed:
                         fired = fired & alive
                     drops = jnp.sum(fired.astype(jnp.int32), dtype=jnp.int32)
+                revived_ct = (
+                    jnp.sum(
+                        (revive_ref[:] == rnd).astype(jnp.int32),
+                        dtype=jnp.int32,
+                    )
+                    if revived else jnp.int32(0)
+                )
                 trow[:] = telemetry_row(
-                    [conv_ct, live, gap, act, 0.0, 0.0, drops, 0.0]
+                    [conv_ct, live, gap, act, 0.0, 0.0, drops, 0.0,
+                     revived_ct]
                 )
 
         if telemetry:
@@ -802,6 +887,9 @@ def make_gossip_chunk(topo: Topology, cfg: SimConfig, *, interpret: bool = False
         if crashed:
             in_specs.append(plane)
             operands.append(death2d)
+        if revived:
+            in_specs.append(plane)
+            operands.append(revive2d)
         in_specs += [plane] * 3
         operands += [cnt, act, cv]
         out_shape = [i32, i32, i32, jax.ShapeDtypeStruct((1,), jnp.int32)]

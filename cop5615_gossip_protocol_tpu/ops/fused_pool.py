@@ -57,6 +57,7 @@ from ..utils import compat
 from . import faults as faults_mod
 from .fused import (
     build_death2d,
+    build_revive2d,
     clamp_cap_and_pad,
     gate_round_keys,
     make_done_flag,
@@ -456,6 +457,10 @@ def make_pushsum_pool_chunk(
     thresh = np.uint32(gate_threshold(cfg.fault_rate)) if use_gate else None
     death2d = build_death2d(cfg, topo.n, layout.n_pad)
     crashed = death2d is not None
+    revive2d = build_revive2d(cfg, topo.n, layout.n_pad)
+    revived = revive2d is not None
+    fresh_rejoin = cfg.rejoin == "fresh"
+    init_term = np.int32(cfg.initial_term_round)
     quorum = cfg.quorum
     # Telemetry plane (ops/telemetry.py): per-round counter rows folded
     # into a scratch register in the absorb phase and copied out one row
@@ -469,6 +474,7 @@ def make_pushsum_pool_chunk(
         gkeys_ref = next(it) if use_gate else None
         offs_ref = next(it)
         death_ref = next(it) if crashed else None
+        revive_ref = next(it) if revived else None
         s0, w0, t0, c0 = next(it), next(it), next(it), next(it)
         s_o, w_o, t_o, c_o, meta_o = (
             next(it), next(it), next(it), next(it), next(it)
@@ -485,12 +491,24 @@ def make_pushsum_pool_chunk(
         row_l = _iota2((TILE, LANES), 0)
         lane = _iota2((TILE, LANES), 1)
 
+        def alive_tile(r0, round_idx):
+            """Revive-aware alive mask for tile rows [r0, r0+TILE)."""
+            alive = death_ref[pl.ds(r0, TILE), :] > round_idx
+            if revived:
+                alive = alive | (revive_ref[pl.ds(r0, TILE), :] <= round_idx)
+            return alive
+
         # The totals the absorb tiles return already count live lanes only.
-        done_flag = make_done_flag(death_ref, target, quorum, masked_total=True)
+        done_flag = make_done_flag(
+            death_ref, target, quorum, masked_total=True,
+            revive_ref=revive_ref,
+        )
 
         def conv_live_sum(round_idx):
             """Quorum numerator over the resident conv plane (crash only)."""
             alive = death_ref[:] > round_idx
+            if revived:
+                alive = alive | (revive_ref[:] <= round_idx)
             return jnp.sum(
                 jnp.where(alive, c_v[:], jnp.int32(0)), dtype=jnp.int32
             )
@@ -525,6 +543,26 @@ def make_pushsum_pool_chunk(
                 r0 = t * TILE
                 choice = _choice_tile(k1, k2, t, P)
                 padm = (r0 + row_l) * LANES + lane >= N
+                if revived and fresh_rejoin:
+                    # Rejoin reset at round entry (models/runner.
+                    # make_revive_fn's in-kernel mirror): fresh revivals
+                    # restart at (s=x_i, w=0, term=initial, conv=0),
+                    # written back BEFORE the send read below. Pad lanes
+                    # carry revival NEVER.
+                    rn = revive_ref[pl.ds(r0, TILE), :] == rnd
+                    posf = ((r0 + row_l) * LANES + lane).astype(jnp.float32)
+                    s_v[pl.ds(r0, TILE), :] = jnp.where(
+                        rn, posf, s_v[pl.ds(r0, TILE), :]
+                    )
+                    w_v[pl.ds(r0, TILE), :] = jnp.where(
+                        rn, jnp.float32(0), w_v[pl.ds(r0, TILE), :]
+                    )
+                    t_v[pl.ds(r0, TILE), :] = jnp.where(
+                        rn, init_term, t_v[pl.ds(r0, TILE), :]
+                    )
+                    c_v[pl.ds(r0, TILE), :] = jnp.where(
+                        rn, jnp.int32(0), c_v[pl.ds(r0, TILE), :]
+                    )
                 blocked = padm
                 if use_gate:
                     gbits = threefry_bits_2d(
@@ -534,7 +572,7 @@ def make_pushsum_pool_chunk(
                     blocked = blocked | (gbits < thresh)
                 if crashed:
                     # Dead nodes never send (ops/faults.py).
-                    blocked = blocked | (death_ref[pl.ds(r0, TILE), :] <= rnd)
+                    blocked = blocked | ~alive_tile(r0, rnd)
                 ss = jnp.where(blocked, 0.0, s_v[pl.ds(r0, TILE), :] * 0.5)
                 ws = jnp.where(blocked, 0.0, w_v[pl.ds(r0, TILE), :] * 0.5)
                 ds_v[pl.ds(r0, TILE), :] = ss
@@ -546,7 +584,7 @@ def make_pushsum_pool_chunk(
                 if telemetry and use_gate:
                     fired = (gbits < thresh) & ~padm
                     if crashed:
-                        fired = fired & (death_ref[pl.ds(r0, TILE), :] > rnd)
+                        fired = fired & alive_tile(r0, rnd)
                     acc = acc + jnp.sum(fired.astype(jnp.int32), dtype=jnp.int32)
                 return acc
 
@@ -564,9 +602,7 @@ def make_pushsum_pool_chunk(
                     s1, w1 = gather_modn(dc_v, planes, d, t, slot, jflat)
                     inbox_s = inbox_s + s1
                     inbox_w = inbox_w + w1
-                alive_t = (
-                    death_ref[pl.ds(r0, TILE), :] > rnd if crashed else None
-                )
+                alive_t = alive_tile(r0, rnd) if crashed else None
                 return acc + absorb_pushsum_tile(
                     r0, padm, inbox_s, inbox_w,
                     s_v, w_v, t_v, c_v, ds_v, dw_v, delta, term_rounds,
@@ -593,6 +629,8 @@ def make_pushsum_pool_chunk(
                 conv_ct = jnp.sum(conv_plane, dtype=jnp.int32)
                 if crashed:
                     alive = death_ref[:] > rnd
+                    if revived:
+                        alive = alive | (revive_ref[:] <= rnd)
                     live = jnp.sum(alive.astype(jnp.int32), dtype=jnp.int32)
                     conv_alive = jnp.sum(
                         jnp.where(alive, conv_plane, jnp.int32(0)),
@@ -602,15 +640,28 @@ def make_pushsum_pool_chunk(
                 else:
                     live = jnp.int32(N)
                     gap = target - conv_ct
+                # w == 0 is reachable under rejoin='fresh' (weightless
+                # restarts); such lanes carry conv 0, so the masked ratio
+                # never reaches the MAE sum.
+                w_plane = w_v[:]
+                w_safe = jnp.where(w_plane != 0, w_plane, jnp.float32(1))
                 err = jnp.where(
                     conv_plane != 0,
-                    jnp.abs(s_v[:] / w_v[:] - tmean),
+                    jnp.abs(s_v[:] / w_safe - tmean),
                     jnp.float32(0),
                 )
                 mae = jnp.sum(err) / jnp.maximum(conv_ct, 1)
-                mass = jnp.sum(w_v[:]) - jnp.float32(layout.n_pad)
+                mass = jnp.sum(w_plane) - jnp.float32(layout.n_pad)
+                revived_ct = (
+                    jnp.sum(
+                        (revive_ref[:] == rnd).astype(jnp.int32),
+                        dtype=jnp.int32,
+                    )
+                    if revived else jnp.int32(0)
+                )
                 trow[:] = telemetry_row(
-                    [conv_ct, live, gap, 0.0, mae, mass, drops, 0.0]
+                    [conv_ct, live, gap, 0.0, mae, mass, drops, 0.0,
+                     revived_ct]
                 )
 
         if telemetry:
@@ -649,11 +700,14 @@ def make_pushsum_pool_chunk(
         )
         operands.append(offs)
         if crashed:
-            # The crash plane rides in VMEM (same [R, 128] block every grid
-            # step) — the freeze masks and the quorum reductions read it
+            # The churn planes ride in VMEM (same [R, 128] block every grid
+            # step) — the freeze masks and the quorum reductions read them
             # directly, no DMA choreography needed.
             in_specs.append(pl.BlockSpec((R, LANES), lambda k: (0, 0)))
             operands.append(death2d)
+        if revived:
+            in_specs.append(pl.BlockSpec((R, LANES), lambda k: (0, 0)))
+            operands.append(revive2d)
         in_specs += [pl.BlockSpec(memory_space=pl.ANY)] * 4
         operands += [s, w, t, c]
         out_shape = [f32, f32, i32, i32, jax.ShapeDtypeStruct((1,), jnp.int32)]
@@ -719,6 +773,8 @@ def make_gossip_pool_chunk(
     thresh = np.uint32(gate_threshold(cfg.fault_rate)) if use_gate else None
     death2d = build_death2d(cfg, topo.n, layout.n_pad)
     crashed = death2d is not None
+    revive2d = build_revive2d(cfg, topo.n, layout.n_pad)
+    revived = revive2d is not None
     quorum = cfg.quorum
     telemetry = cfg.telemetry  # see make_pushsum_pool_chunk
 
@@ -728,6 +784,7 @@ def make_gossip_pool_chunk(
         gkeys_ref = next(it) if use_gate else None
         offs_ref = next(it)
         death_ref = next(it) if crashed else None
+        revive_ref = next(it) if revived else None
         n0, a0, c0 = next(it), next(it), next(it)
         n_o, a_o, c_o, meta_o = next(it), next(it), next(it), next(it)
         tele_o = next(it) if telemetry else None
@@ -741,13 +798,24 @@ def make_gossip_pool_chunk(
         row_l = _iota2((TILE, LANES), 0)
         lane = _iota2((TILE, LANES), 1)
 
-        done_flag = make_done_flag(death_ref, target, quorum, masked_total=True)
+        def alive_tile(r0, round_idx):
+            alive = death_ref[pl.ds(r0, TILE), :] > round_idx
+            if revived:
+                alive = alive | (revive_ref[pl.ds(r0, TILE), :] <= round_idx)
+            return alive
+
+        done_flag = make_done_flag(
+            death_ref, target, quorum, masked_total=True,
+            revive_ref=revive_ref,
+        )
 
         @pl.when(k == 0)
         def _init():
             _copy_in([(n0, n_v), (a0, a_v), (c0, c_v)], sems)
             if crashed:
                 alive0 = death_ref[:] > start_ref[0] - 1
+                if revived:
+                    alive0 = alive0 | (revive_ref[:] <= start_ref[0] - 1)
                 conv_live = jnp.sum(
                     jnp.where(alive0, c_v[:], jnp.int32(0)), dtype=jnp.int32
                 )
@@ -772,6 +840,21 @@ def make_gossip_pool_chunk(
                 choice = _choice_tile(k1, k2, t, P)
                 jflat = (r0 + row_l) * LANES + lane
                 padm = jflat >= N
+                if revived:
+                    # Gossip revivals rejoin susceptible (count 0,
+                    # inactive, unconverged) — reset BEFORE the send mask
+                    # reads a_v and before p2's suppression reads c_v, the
+                    # chunked engine's round-entry ordering.
+                    rn = revive_ref[pl.ds(r0, TILE), :] == rnd
+                    n_v[pl.ds(r0, TILE), :] = jnp.where(
+                        rn, jnp.int32(0), n_v[pl.ds(r0, TILE), :]
+                    )
+                    a_v[pl.ds(r0, TILE), :] = jnp.where(
+                        rn, jnp.int32(0), a_v[pl.ds(r0, TILE), :]
+                    )
+                    c_v[pl.ds(r0, TILE), :] = jnp.where(
+                        rn, jnp.int32(0), c_v[pl.ds(r0, TILE), :]
+                    )
                 sending = (a_v[pl.ds(r0, TILE), :] != 0) & ~padm
                 if use_gate:
                     gbits = threefry_bits_2d(
@@ -781,7 +864,7 @@ def make_gossip_pool_chunk(
                     sending = sending & (gbits >= thresh)
                 if crashed:
                     # Dead nodes never send (ops/faults.py).
-                    sending = sending & (death_ref[pl.ds(r0, TILE), :] > rnd)
+                    sending = sending & alive_tile(r0, rnd)
                 # Fold the send gate into the choice plane: slot -1 delivers
                 # nothing, so the inbox gather needs no separate value plane.
                 marked = jnp.where(sending, choice, jnp.int32(-1))
@@ -790,7 +873,7 @@ def make_gossip_pool_chunk(
                 if telemetry and use_gate:
                     fired = (gbits < thresh) & ~padm
                     if crashed:
-                        fired = fired & (death_ref[pl.ds(r0, TILE), :] > rnd)
+                        fired = fired & alive_tile(r0, rnd)
                     acc = acc + jnp.sum(fired.astype(jnp.int32), dtype=jnp.int32)
                 return acc
 
@@ -805,9 +888,7 @@ def make_gossip_pool_chunk(
                     d = offs_ref[kk, slot]
                     g = gather_plain_modn(dch_v, d, t, jflat)
                     inbox = inbox + jnp.where(g == slot, jnp.int32(1), jnp.int32(0))
-                alive_t = (
-                    death_ref[pl.ds(r0, TILE), :] > rnd if crashed else None
-                )
+                alive_t = alive_tile(r0, rnd) if crashed else None
                 return acc + absorb_gossip_tile(
                     r0, padm, inbox, n_v, a_v, c_v, rumor_target, suppress,
                     alive=alive_t,
@@ -821,6 +902,8 @@ def make_gossip_pool_chunk(
                 conv_ct = jnp.sum(conv_plane, dtype=jnp.int32)
                 if crashed:
                     alive = death_ref[:] > rnd
+                    if revived:
+                        alive = alive | (revive_ref[:] <= rnd)
                     live = jnp.sum(alive.astype(jnp.int32), dtype=jnp.int32)
                     conv_alive = jnp.sum(
                         jnp.where(alive, conv_plane, jnp.int32(0)),
@@ -831,8 +914,16 @@ def make_gossip_pool_chunk(
                     live = jnp.int32(N)
                     gap = target - conv_ct
                 act = jnp.sum(a_v[:], dtype=jnp.int32)
+                revived_ct = (
+                    jnp.sum(
+                        (revive_ref[:] == rnd).astype(jnp.int32),
+                        dtype=jnp.int32,
+                    )
+                    if revived else jnp.int32(0)
+                )
                 trow[:] = telemetry_row(
-                    [conv_ct, live, gap, act, 0.0, 0.0, drops, 0.0]
+                    [conv_ct, live, gap, act, 0.0, 0.0, drops, 0.0,
+                     revived_ct]
                 )
 
         if telemetry:
@@ -876,6 +967,9 @@ def make_gossip_pool_chunk(
         if crashed:
             in_specs.append(pl.BlockSpec((R, LANES), lambda k: (0, 0)))
             operands.append(death2d)
+        if revived:
+            in_specs.append(pl.BlockSpec((R, LANES), lambda k: (0, 0)))
+            operands.append(revive2d)
         in_specs += [pl.BlockSpec(memory_space=pl.ANY)] * 3
         operands += [cnt, act, cv]
         out_shape = [i32, i32, i32, jax.ShapeDtypeStruct((1,), jnp.int32)]

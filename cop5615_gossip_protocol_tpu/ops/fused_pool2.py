@@ -136,6 +136,15 @@ def pool2_support(topo: Topology, cfg: SimConfig) -> Optional[str]:
         # the crash plane streams alongside the state windows. dup/delay
         # restructure delivery itself and stay chunked-only.
         return "dup/delay fault models run on the chunked engine only"
+    if cfg.revive_model:
+        # The streaming tier precomputes per-round quorum needs from the
+        # SORTED death plane (_quorum_needs) — a revival plane breaks that
+        # precompute and the windowed freeze; crash-recovery runs stay on
+        # the chunked/sharded engines and the VMEM stencil/pool kernels.
+        return (
+            "crash-recovery (revive) runs on the chunked, sharded, and "
+            "VMEM fused stencil/pool engines only"
+        )
     if cfg.n_devices is not None and cfg.n_devices > 1:
         return "fused engine is single-device"
     if cfg.pool_size > 1 << POOL_CHOICE_BITS:

@@ -32,7 +32,10 @@ import jax.numpy as jnp
 #   3 — threshold-compare fault gates (send_gate/dup_gate draw raw uint32
 #       words against a precomputed threshold instead of uniform floats, so
 #       the fused kernels regenerate the identical gate in-kernel)
-STREAM_VERSION = 3
+#   4 — revival-plane draws (ops/faults.REVIVE_TAG): crash-recovery configs
+#       consume a new base-key stream for the rejoin rounds; crash-stop and
+#       fault-free configs draw exactly the v3 streams
+STREAM_VERSION = 4
 
 
 def round_key(base_key: jax.Array, round_idx: jax.Array | int) -> jax.Array:

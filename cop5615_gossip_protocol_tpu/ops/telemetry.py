@@ -43,6 +43,11 @@ the 16.8M-node tiers round their counts in the last bits):
     7 dup_count        dup-gate firings among live nodes (chunked
                        scatter/stencil engines only — the only ones that
                        support --dup-rate); 0 elsewhere
+    8 revived_count    nodes whose revival round IS this round (schema v2,
+                       crash-recovery model — ops/faults.revival_plane);
+                       0 without one. Cumulative revivals are the running
+                       sum; the trajectory analyzer annotates these rounds
+                       on the ASCII curve.
 
 Engine support: the chunked XLA engine, the sharded engine (rows are
 in-trace ``psum`` reductions, so every device carries the identical
@@ -66,7 +71,9 @@ from . import faults as faults_mod
 from . import sampling
 from .topology import Topology
 
-SCHEMA_VERSION = 1
+# 2 — revived_count column appended (crash-recovery churn); columns 0-7
+#     keep their v1 meanings.
+SCHEMA_VERSION = 2
 
 COLUMNS = (
     "converged_count",
@@ -77,6 +84,7 @@ COLUMNS = (
     "mass_residual",
     "drop_count",
     "dup_count",
+    "revived_count",
 )
 N_COLS = len(COLUMNS)
 
@@ -88,6 +96,7 @@ COL_MAE = 4
 COL_MASS = 5
 COL_DROPS = 6
 COL_DUPS = 7
+COL_REVIVED = 8
 
 
 def true_mean(n: int) -> float:
@@ -112,8 +121,12 @@ def make_row_fn(topo: Topology, cfg: SimConfig, base_key):
     target = cfg.resolved_target_count(topo.n, topo.target_count)
     pushsum = cfg.algorithm == "push-sum"
     tmean = jnp.float32(true_mean(n))
-    death = faults_mod.death_plane(cfg, n)
-    death_dev = None if death is None else jnp.asarray(death)
+    planes = faults_mod.life_planes(cfg, n)
+    death_dev = None if planes is None else jnp.asarray(planes.death)
+    revive_dev = (
+        None if planes is None or planes.revive is None
+        else jnp.asarray(planes.revive)
+    )
     _, key_impl = sampling.key_split(base_key)
     quorum = cfg.quorum
     fault_rate = cfg.fault_rate
@@ -127,7 +140,7 @@ def make_row_fn(topo: Topology, cfg: SimConfig, base_key):
             live = jnp.int32(n)
             gap = jnp.int32(target) - conv_ct
         else:
-            alive = death_dev > round_idx
+            alive = faults_mod.alive_at(death_dev, round_idx, revive_dev)
             live = jnp.sum(alive.astype(jnp.int32))
             conv_alive = jnp.sum(jnp.where(alive, conv_i, jnp.int32(0)))
             gap = faults_mod.quorum_need(live, quorum) - conv_alive
@@ -158,11 +171,16 @@ def make_row_fn(topo: Topology, cfg: SimConfig, base_key):
             if dup is not False:
                 fired = dup if live_mask is True else (dup & live_mask)
                 dups = jnp.sum(fired.astype(jnp.int32)).astype(jnp.float32)
+        revived = jnp.float32(0)
+        if revive_dev is not None:
+            revived = jnp.sum(
+                faults_mod.revived_at(revive_dev, round_idx).astype(jnp.int32)
+            ).astype(jnp.float32)
         return jnp.stack([
             conv_ct.astype(jnp.float32),
             live.astype(jnp.float32),
             gap.astype(jnp.float32),
-            act, mae, mass, drops, dups,
+            act, mae, mass, drops, dups, revived,
         ])
 
     return row_fn
@@ -170,7 +188,7 @@ def make_row_fn(topo: Topology, cfg: SimConfig, base_key):
 
 def make_sharded_row_fn(
     topo: Topology, cfg: SimConfig, n_pad: int, n_loc: int,
-    axis_name: str, death_full, key_impl,
+    axis_name: str, death_full, key_impl, revive_full=None,
 ):
     """Sharded analog of ``make_row_fn``: operates on a device's [n_loc]
     state shard and reduces every column with an in-trace ``psum``, so the
@@ -197,12 +215,19 @@ def make_sharded_row_fn(
         start = dev * n_loc
         conv_i = jnp.asarray(state.conv).astype(jnp.int32)
         conv_ct = lax.psum(jnp.sum(conv_i), axis_name)
+        revive_loc = (
+            None if revive_full is None
+            else lax.dynamic_slice(revive_full, (start,), (n_loc,))
+        )
         if death_full is None:
             alive = None
             live = jnp.int32(n)
             gap = jnp.int32(target) - conv_ct
         else:
-            alive = lax.dynamic_slice(death_full, (start,), (n_loc,)) > round_idx
+            alive = faults_mod.alive_at(
+                lax.dynamic_slice(death_full, (start,), (n_loc,)),
+                round_idx, revive_loc,
+            )
             live = psum_i(alive)
             conv_alive = lax.psum(
                 jnp.sum(jnp.where(alive, conv_i, jnp.int32(0))), axis_name
@@ -239,13 +264,18 @@ def make_sharded_row_fn(
             if alive is not None:
                 fired = fired & alive
             drops = psum_i(fired).astype(jnp.float32)
+        revived = jnp.float32(0)
+        if revive_loc is not None:
+            revived = psum_i(
+                faults_mod.revived_at(revive_loc, round_idx)
+            ).astype(jnp.float32)
         # dup_count: the sharded engine rejects --dup-rate, so the column
         # is structurally 0 here.
         return jnp.stack([
             conv_ct.astype(jnp.float32),
             live.astype(jnp.float32),
             gap.astype(jnp.float32),
-            act, mae, mass, drops, jnp.float32(0),
+            act, mae, mass, drops, jnp.float32(0), revived,
         ])
 
     return row_fn
@@ -277,6 +307,11 @@ def rows_to_trace_records(
             rec["estimate_mae"] = float(row[COL_MAE])
         else:
             rec["active_count"] = int(row[COL_ACTIVE])
+        # Crash-recovery annotation (schema v2 rows only; v1 buffers have
+        # no column 8): emitted only on rounds where somebody rejoined, so
+        # non-churn traces keep the exact legacy record shape.
+        if row.shape[0] > COL_REVIVED and row[COL_REVIVED] > 0:
+            rec["revived"] = int(row[COL_REVIVED])
         out.append(rec)
     return out
 

@@ -88,6 +88,18 @@ def plan_fused_pool_sharded(topo: Topology, cfg: SimConfig, n_dev: int):
     reason = pool_common_support(topo, cfg)
     if reason is not None:
         return reason
+    if cfg.revive_model:
+        # The composition's kernels predate the revival plane; a revive
+        # config must not silently run crash-stop here.
+        return (
+            "crash-recovery (revive) runs on the chunked, sharded, and "
+            "single-device VMEM fused stencil/pool engines only"
+        )
+    if cfg.mass_tolerance is not None:
+        return (
+            "the health sentinel (--mass-tolerance) runs in the chunked "
+            "and sharded XLA round bodies only"
+        )
     if cfg.telemetry:
         return (
             "telemetry counters run in the single-device fused kernels and "
@@ -188,8 +200,9 @@ def run_fused_pool_sharded(
             leader_counts_receipt=cfg.reference and topo.kind == "full",
         )
     planes0 = tuple(jax.device_put(p, shard_rows) for p in to_planes(st0))
-    death_np = faults_mod.death_plane(cfg, n)
-    done0 = _host_done(cfg, death_np, st0, start_round, target)
+    done0 = _host_done(
+        cfg, faults_mod.life_planes(cfg, n), st0, start_round, target
+    )
     # Crash model: the reused pool kernel already runs the quorum verdict
     # in-kernel; this replicated plane lets the composition's OWN done
     # mirror it — without it a crash run's legacy target could stay
@@ -305,9 +318,13 @@ def run_fused_pool_sharded(
     should_stop = None
     if cfg.stall_chunks:
         def should_stop(rounds, planes):
+            life2d = (
+                None if death2d is None
+                else faults_mod.LifePlanes(death=death2d, revive=None)
+            )
             return watchdog.no_progress(
                 _progress_gap(
-                    death2d, cfg.quorum, target, planes[-1], rounds
+                    life2d, cfg.quorum, target, planes[-1], rounds
                 )
             )
 

@@ -79,6 +79,7 @@ from ..models.runner import (
     RunResult,
     StallWatchdog,
     _check_dtype,
+    _finalize_result,
     _freeze_dead,
     _host_done,
     _progress_gap,
@@ -133,13 +134,18 @@ def run_sharded(
     n_pad = ((n + n_dev - 1) // n_dev) * n_dev
     n_loc = n_pad // n_dev
     target = cfg.resolved_target_count(n, topo.target_count)
-    # Crash plane: rebuilt from the config (ops/faults.py), padded with
-    # death round 0 so pad slots count as dead and alive-count psums need
-    # no extra masking. Closed over — sliced per shard inside the trace.
-    death_np = faults_mod.death_plane(cfg, n)
+    # Churn planes: rebuilt from the config (ops/faults.py), death padded
+    # with round 0 so pad slots count as dead (and revival padded with
+    # NEVER so they stay dead) — alive-count psums need no extra masking.
+    # Closed over — sliced per shard inside the trace.
+    life_np = faults_mod.life_planes(cfg, n)
     death_full = (
-        None if death_np is None
-        else jnp.asarray(faults_mod.pad_death_plane(death_np, n_pad))
+        None if life_np is None
+        else jnp.asarray(faults_mod.pad_death_plane(life_np.death, n_pad))
+    )
+    revive_full = (
+        None if life_np is None or life_np.revive is None
+        else jnp.asarray(faults_mod.pad_revival_plane(life_np.revive, n_pad))
     )
     # The base key crosses the jit/shard_map boundary as a replicated runtime
     # ARGUMENT (raw data + static impl, ops/sampling.key_split): closed over,
@@ -254,11 +260,30 @@ def run_sharded(
         """This shard's slice of the crash plane (crash model only)."""
         return lax.dynamic_slice(death_full, (start,), (n_loc,))
 
+    def _revive_loc(start):
+        """This shard's slice of the revival plane, None sans recovery."""
+        if revive_full is None:
+            return None
+        return lax.dynamic_slice(revive_full, (start,), (n_loc,))
+
+    def _life_loc(start):
+        """This shard's churn planes as a LifePlanes of local slices —
+        feeds the shared freeze/predicate helpers (models/runner.py)."""
+        return faults_mod.LifePlanes(
+            death=_death_loc(start), revive=_revive_loc(start)
+        )
+
+    def _alive_loc(start, round_idx):
+        return faults_mod.alive_at(
+            _death_loc(start), round_idx, _revive_loc(start)
+        )
+
     def _gate_crash(send_ok, start, round_idx):
-        """Dead nodes never send (ops/faults.py); no-op sans crash model."""
+        """Dead nodes never send (ops/faults.py); revived nodes resume;
+        no-op sans crash model."""
         if death_full is None:
             return send_ok
-        return send_ok & (_death_loc(start) > round_idx)
+        return send_ok & _alive_loc(start, round_idx)
 
     def targets_and_gate(round_idx, key_data, *targs):
         kr = sampling.round_key(sampling.key_join(key_data, key_impl), round_idx)
@@ -507,14 +532,43 @@ def run_sharded(
                 return gossip_mod.absorb(state, inbox, rumor_target, suppress)
 
     if death_full is not None:
-        # Crash-stop freeze: dead nodes keep their protocol state
-        # (runner._freeze_dead — push-sum mass still parks in s/w).
+        # Crash semantics around the base round: a revival-round reset at
+        # body entry (the sharded mirror of runner.make_revive_fn — gossip
+        # rejoins susceptible; push-sum resets only under rejoin='fresh')
+        # and the dead-node freeze after (runner._freeze_dead — push-sum
+        # mass still parks in s/w). Elementwise on local shards, so the
+        # trajectory matches the single-device engine exactly.
         base_round_fn = round_fn
+        pushsum = cfg.algorithm == "push-sum"
+        fresh_rejoin = cfg.rejoin == "fresh"
+        init_term = cfg.initial_term_round
+
+        def _rejoin_loc(state, round_idx, start):
+            revive_loc = _revive_loc(start)
+            if revive_loc is None:
+                return state
+            if pushsum and not fresh_rejoin:
+                return state
+            rn = faults_mod.revived_at(revive_loc, round_idx)
+            if pushsum:
+                gids = start + jnp.arange(n_loc, dtype=jnp.int32)
+                return pushsum_mod.PushSumState(
+                    s=jnp.where(rn, gids.astype(state.s.dtype), state.s),
+                    w=jnp.where(rn, jnp.zeros((), state.w.dtype), state.w),
+                    term=jnp.where(rn, jnp.int32(init_term), state.term),
+                    conv=jnp.where(rn, False, state.conv),
+                )
+            return gossip_mod.GossipState(
+                count=jnp.where(rn, jnp.int32(0), state.count),
+                active=jnp.where(rn, False, state.active),
+                conv=jnp.where(rn, False, state.conv),
+            )
 
         def round_fn(state, round_idx, key_data, *targs):  # noqa: F811
-            new = base_round_fn(state, round_idx, key_data, *targs)
             start = lax.axis_index(NODE_AXIS) * n_loc
-            return _freeze_dead(_death_loc(start), state, new, round_idx)
+            state = _rejoin_loc(state, round_idx, start)
+            new = base_round_fn(state, round_idx, key_data, *targs)
+            return _freeze_dead(_life_loc(start), state, new, round_idx)
 
     done0 = False
     if start_state is not None:
@@ -527,7 +581,7 @@ def run_sharded(
         # Seed the loop predicate from the resumed state — a checkpoint taken
         # at/after convergence must execute zero further rounds (matches the
         # single-device runner and the fused kernels' conv-plane seeding).
-        done0 = _host_done(cfg, death_np, start_state, start_round, target)
+        done0 = _host_done(cfg, life_np, start_state, start_round, target)
 
     # --- chunked while_loop under shard_map -------------------------------
 
@@ -539,14 +593,45 @@ def run_sharded(
     telemetry = cfg.telemetry
     tele_row = (
         telemetry_mod.make_sharded_row_fn(
-            topo, cfg, n_pad, n_loc, NODE_AXIS, death_full, key_impl
+            topo, cfg, n_pad, n_loc, NODE_AXIS, death_full, key_impl,
+            revive_full,
         )
         if telemetry else None
     )
     stride = cfg.chunk_rounds
 
-    def chunk_local(state_in, rnd_in, done_in, round_end, key_data, *targs):
+    # Health sentinel (cfg.mass_tolerance; see models/runner.py for the
+    # full contract): psum'd non-finite count and mass residual per
+    # executed round; a trip latches the replicated health scalar and
+    # raises the done flag. Python-level flag — off traces the identical
+    # program.
+    sentinel = cfg.mass_tolerance is not None
+    never_i32 = jnp.int32(faults_mod.NEVER)
+    if sentinel:
+        tol = cfg.mass_tolerance
+
+        def sentinel_bad(state):
+            bad_ct = lax.psum(
+                jnp.sum((~jnp.isfinite(state.s)).astype(jnp.int32))
+                + jnp.sum((~jnp.isfinite(state.w)).astype(jnp.int32)),
+                NODE_AXIS,
+            )
+            # Pad slots carry weight 1 by construction, so the padded
+            # invariant is n_pad (same correction as the telemetry mass
+            # column).
+            total_w = lax.psum(jnp.sum(state.w), NODE_AXIS)
+            resid = jnp.abs(total_w - jnp.asarray(n_pad, state.w.dtype))
+            return (bad_ct > 0) | (resid > jnp.asarray(tol, state.w.dtype))
+
+    def chunk_local(state_in, rnd_in, done_in, *rest):
+        if sentinel:
+            health_in, round_end, key_data = rest[0], rest[1], rest[2]
+            targs = rest[3:]
+        else:
+            round_end, key_data = rest[0], rest[1]
+            targs = rest[2:]
         rnd0_in = rnd_in  # loop-entry round: telemetry rows index from here
+        buf_i = 4 if sentinel else 3
 
         def cond(c):
             return jnp.logical_and(~c[2], c[1] < round_end)
@@ -559,10 +644,10 @@ def run_sharded(
                 done = conv_count >= target
             else:
                 # Quorum over live nodes (ops/faults.py): pad slots have
-                # death round 0, so the alive psum is exactly the live
-                # population with no valid-mask needed.
+                # death round 0 / revival NEVER, so the alive psum is
+                # exactly the live population with no valid-mask needed.
                 start = lax.axis_index(NODE_AXIS) * n_loc
-                alive = _death_loc(start) > rnd
+                alive = _alive_loc(start, rnd)
                 conv_alive = lax.psum(
                     jnp.sum((state.conv & alive).astype(jnp.int32)),
                     NODE_AXIS,
@@ -573,15 +658,25 @@ def run_sharded(
                 done = conv_alive >= faults_mod.quorum_need(
                     alive_count, cfg.quorum
                 )
-            out = (state, rnd + 1, done)
+            if sentinel:
+                health = c[3]
+                health = jnp.where(
+                    (health == never_i32) & sentinel_bad(state), rnd, health
+                )
+                done = done | (health != never_i32)
+                out = (state, rnd + 1, done, health)
+            else:
+                out = (state, rnd + 1, done)
             if telemetry:
                 row = tele_row(state, rnd, key_data)
                 out += (lax.dynamic_update_index_in_dim(
-                    c[3], row, rnd - rnd0_in, 0
+                    c[buf_i], row, rnd - rnd0_in, 0
                 ),)
             return out
 
         carry = (state_in, rnd_in, done_in)
+        if sentinel:
+            carry += (health_in,)
         if telemetry:
             carry += (jnp.zeros((stride, telemetry_mod.N_COLS), jnp.float32),)
         return lax.while_loop(cond, body, carry)
@@ -592,13 +687,17 @@ def run_sharded(
     # hooks / stall watchdog).
     donate = on_chunk is None and not cfg.stall_chunks
     out_specs = (state_specs, P(), P())
+    in_scalar_specs = (P(), P(), P())  # rnd, done, round_end
+    if sentinel:
+        out_specs += (P(),)  # replicated health scalar
+        in_scalar_specs = (P(), P(), P(), P())  # + health
     if telemetry:
         out_specs += (P(),)  # replicated counter block
     chunk_sharded = jax.jit(
         compat.shard_map(
             chunk_local,
             mesh=mesh,
-            in_specs=(state_specs, P(), P(), P(), P()) + topo_specs,
+            in_specs=(state_specs,) + in_scalar_specs + (P(),) + topo_specs,
             out_specs=out_specs,
             check_vma=False,
         ),
@@ -611,6 +710,11 @@ def run_sharded(
     rnd0 = rep_put(np.int32(start_round))
     done0_dev = rep_put(np.bool_(done0))
     kd_dev = rep_put(np.asarray(key_data_host))
+    health0 = rep_put(np.int32(faults_mod.NEVER)) if sentinel else None
+
+    def _chunk_args(health, round_end):
+        pre = (health,) if sentinel else ()
+        return pre + (rep_put(np.int32(round_end)), kd_dev) + topo_args
 
     t0 = time.perf_counter()
     # Warmup runs ONE real round and DISCARDS the result — the timed loop
@@ -623,8 +727,7 @@ def run_sharded(
     warm = chunk_sharded(
         jax.tree.map(jnp.copy, state0) if donate else state0,
         rnd0, done0_dev,
-        rep_put(np.int32(min(start_round + 1, cfg.max_rounds))),
-        kd_dev, *topo_args,
+        *_chunk_args(health0, min(start_round + 1, cfg.max_rounds)),
     )
     int(warm[1])  # data-dependent sync; block_until_ready can return early
     del warm
@@ -632,11 +735,16 @@ def run_sharded(
 
     watchdog = StallWatchdog(cfg.stall_chunks)
 
-    def dispatch(state, rnd, done, round_end):
-        return chunk_sharded(
-            state, rnd, done, rep_put(np.int32(round_end)), kd_dev,
-            *topo_args,
-        )
+    if sentinel:
+        def dispatch(state, rnd, done, health, round_end):
+            return chunk_sharded(
+                state, rnd, done, *_chunk_args(health, round_end)
+            )
+    else:
+        def dispatch(state, rnd, done, round_end):
+            return chunk_sharded(
+                state, rnd, done, *_chunk_args(None, round_end)
+            )
 
     on_retire = None if on_chunk is None else on_chunk
 
@@ -644,11 +752,17 @@ def run_sharded(
     if cfg.stall_chunks:
         # Watchdog (models/runner.StallWatchdog): replicated scalar
         # reduction, process-safe like the trace hook. Pad slots carry
-        # death round 0 / conv 0, so the padded gap equals the real one.
+        # death round 0 / revival NEVER / conv 0, so the padded gap equals
+        # the real one.
+        life_pad = (
+            None if death_full is None
+            else faults_mod.LifePlanes(death=death_full, revive=revive_full)
+        )
+
         def should_stop(rounds, state):
             return watchdog.no_progress(
                 _progress_gap(
-                    death_full, cfg.quorum, target, state.conv, rounds
+                    life_pad, cfg.quorum, target, state.conv, rounds
                 )
             )
 
@@ -664,44 +778,22 @@ def run_sharded(
         stride=cfg.chunk_rounds, depth=cfg.pipeline_chunks, donate=donate,
         on_retire=on_retire, should_stop=should_stop,
         on_aux=collector.on_aux if collector else None,
+        health0=health0,
     )
     run_s = time.perf_counter() - t1
 
-    state, rounds = loop.state, loop.rounds
-    converged_count = int(jnp.sum(state.conv))
-    converged = loop.done
-    stalled = watchdog.stalled
-    result = RunResult(
-        algorithm=cfg.algorithm,
-        topology=topo.kind,
-        semantics=cfg.semantics,
-        n_requested=topo.n_requested,
-        population=n,
-        target_count=target,
-        rounds=rounds,
-        converged_count=converged_count,
-        converged=converged,
-        compile_s=compile_s,
-        run_s=run_s,
-        outcome=(
-            "converged" if converged
-            else ("stalled" if stalled else "max_rounds")
-        ),
-        dispatch_s=loop.dispatch_s,
-        fetch_s=loop.fetch_s,
-        chunk_log=loop.chunk_log,
+    unhealthy_round = None
+    if sentinel and loop.health is not None and (
+        loop.health != int(faults_mod.NEVER)
+    ):
+        unhealthy_round = int(loop.health)
+
+    # _finalize_result's reductions are jnp, not host numpy: when the mesh
+    # spans processes the state arrays are not host-addressable, but every
+    # process can run the same global reduction (replicated scalar out).
+    # Padded slots never converge, so gating on `conv` excludes them.
+    return _finalize_result(
+        topo, cfg, loop.state, loop.rounds, target, compile_s, run_s,
+        done=loop.done, stalled=watchdog.stalled, loop=loop,
+        collector=collector, unhealthy_round=unhealthy_round,
     )
-    if collector is not None:
-        result.telemetry = collector.finalize()
-    if cfg.algorithm == "push-sum":
-        # jnp reductions, not host numpy: when the mesh spans processes the
-        # state arrays are not host-addressable, but every process can run
-        # the same global reduction (replicated scalar out). Padded slots
-        # never converge, so gating on `conv` also excludes them.
-        true_mean = (n - 1) / 2.0
-        w_safe = jnp.where(state.w != 0, state.w, 1)
-        ratio = jnp.where(state.w != 0, state.s / w_safe, 0.0)
-        err = jnp.where(state.conv, jnp.abs(ratio - true_mean), 0.0)
-        result.true_mean = true_mean
-        result.estimate_mae = float(jnp.sum(err)) / max(converged_count, 1)
-    return result

@@ -75,16 +75,21 @@ def load(path: str | Path):
     # pool-choice derivation (scatter/stencil runs and pool_size > 16 runs
     # replay bitwise-identically under either); v2 -> v3 altered only the
     # fault-gate draws — a fault-free v2 pool checkpoint resumes bitwise
-    # under v3. Checkpoints from a NEWER stream than this build reject on
-    # either sensitivity (their derivations are unknown here).
+    # under v3; v3 -> v4 only ADDED the revival-plane stream — every
+    # pre-revival config replays bitwise under v4, and a revival config
+    # written before v4 cannot exist (the flags did not). Checkpoints from
+    # a NEWER stream than this build reject on any sensitivity (their
+    # derivations are unknown here).
     pool_sensitive = (
         cfg.delivery == "pool" and cfg.pool_size <= 1 << POOL_CHOICE_BITS
     )
     gate_sensitive = cfg.fault_rate > 0 or cfg.dup_rate > 0
+    revive_sensitive = cfg.revive_model
     sv = 0 if stream is None else stream
     invalid = (
         (pool_sensitive and sv < 2)
         or (gate_sensitive and sv < 3)
+        or (revive_sensitive and sv < 4)
         # A NEWER stream than this build: what changed is unknowable here,
         # so no sensitivity classification applies — always refuse.
         or sv > STREAM_VERSION

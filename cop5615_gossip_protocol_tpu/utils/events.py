@@ -12,22 +12,34 @@ Event vocabulary (the ``event`` field; every line also carries
 ``schema_version``, ``t_wall`` — seconds since the epoch — and ``t_run`` —
 seconds since the log was opened):
 
-  run-start               config + population, once, first
-  crash-schedule-applied  the failure plane in force (crash_rate/schedule,
-                          quorum) — emitted at start so a log is
-                          self-describing about its churn
+  run-start               config + population + lint warnings, once, first
+  crash-schedule-applied  the churn planes in force (crash_rate/schedule,
+                          revive_rate/schedule, rejoin, quorum) — emitted
+                          at start so a log is self-describing
   resume                  checkpoint path + round the run restarted from
+  engine-degraded         models/runner.run walked one rung of the
+                          graceful-degradation ladder: from/to engine
+                          descriptions, the triggering error, and how many
+                          transient retries preceded it — emitted AT
+                          degradation time, so a later crash still leaves
+                          the walk durable (schema v2)
   checkpoint-written      rounds + path, at each sidecar write
   chunk-retired           per retired chunk, in order: rounds at the
                           boundary plus the driver's dispatch_s/fetch_s
                           timing split (models/pipeline.ChunkLoopResult
                           .chunk_log)
   watchdog-fired          the stall watchdog ended the run (rounds)
+  sentinel-tripped        the health sentinel ended the run: rounds,
+                          unhealthy_round (first bad round), the
+                          mass_tolerance in force (schema v2)
   run-end                 outcome, rounds, wall/compile/dispatch/fetch
                           splits, once, last
 
 Consumers detect format drift via ``schema_version`` — bump EVENT_SCHEMA_
-VERSION whenever a field changes meaning, never reuse a name.
+VERSION whenever a field changes meaning, never reuse a name. History:
+1 — the PR 3 vocabulary; 2 — engine-degraded + sentinel-tripped event
+types, run-start gains ``warnings``, crash-schedule-applied gains the
+revive_rate/revive_schedule/rejoin recovery fields.
 """
 
 from __future__ import annotations
@@ -37,7 +49,7 @@ from pathlib import Path
 
 from . import metrics
 
-EVENT_SCHEMA_VERSION = 1
+EVENT_SCHEMA_VERSION = 2
 
 
 class RunEventLog:

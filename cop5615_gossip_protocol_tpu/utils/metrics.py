@@ -28,7 +28,10 @@ if TYPE_CHECKING:  # type-only: keeps this module importable without JAX
 #   1 — implicit (unversioned) records through PR 2
 #   2 — schema_version field itself, dispatch_s/fetch_s per-chunk timing
 #       splits, telemetry plane fields
-RUN_RECORD_SCHEMA_VERSION = 2
+#   3 — recovery plane: outcome gains "unhealthy", records gain
+#       unhealthy_round (health sentinel) and degradations (the engine
+#       fallback ladder's rung walk)
+RUN_RECORD_SCHEMA_VERSION = 3
 
 
 def banner(cfg: SimConfig) -> str:

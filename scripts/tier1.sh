@@ -7,6 +7,13 @@
 set -o pipefail
 rm -f /tmp/_t1.log
 
+# CI must never silently degrade engines: a fused/sharded failure under
+# tier-1 is a bug, not a condition to recover from (models/runner.py
+# honors this env var over cfg.strict_engine). The degradation ladder
+# itself is still exercised — by the explicit ladder tests (which locally
+# override the var to 0) and by the chaos CI job.
+export GOSSIP_TPU_STRICT_ENGINE=1
+
 print_dots() {
   echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log 2>/dev/null | tr -cd . | wc -c)"
 }

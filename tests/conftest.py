@@ -18,6 +18,13 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# Fail fast on engine errors during tests: the graceful-degradation ladder
+# (models/runner.run) would otherwise mask real engine bugs by silently
+# falling back to the chunked single-device path. Ladder tests monkeypatch
+# this to "0" explicitly. scripts/tier1.sh exports the same default, so a
+# bare `pytest tests/` matches CI.
+os.environ.setdefault("GOSSIP_TPU_STRICT_ENGINE", "1")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

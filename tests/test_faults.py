@@ -42,8 +42,13 @@ def test_parse_crash_schedule():
 def test_config_failure_model_validation():
     with pytest.raises(ValueError, match="mutually exclusive"):
         SimConfig(n=64, topology="full", crash_rate=0.1, crash_schedule="5:3")
-    with pytest.raises(ValueError, match="quorum"):
-        SimConfig(n=64, topology="full", quorum=0.9)  # no crash model
+    # quorum < 1.0 without a crash model is a no-op, not an invalid config:
+    # it must warn LOUDLY (stderr via the CLI, RuntimeWarning for API
+    # users) instead of erroring or silently ignoring.
+    with pytest.warns(RuntimeWarning, match="quorum"):
+        cfg = SimConfig(n=64, topology="full", quorum=0.9)  # no crash model
+    assert any("quorum" in w for w in cfg.lint_warnings)
+    assert SimConfig(n=64, topology="full").lint_warnings == ()
     with pytest.raises(ValueError, match="reference"):
         SimConfig(n=64, topology="full", semantics="reference", crash_rate=0.1)
     with pytest.raises(ValueError, match="global"):

@@ -1,0 +1,374 @@
+"""Recovery plane (ISSUE 4 tentpole): crash-recovery churn with node
+rejoin, threaded config -> ops/faults.revival_plane -> every supporting
+engine.
+
+Pinned contracts:
+
+- the death+revival planes are deterministic, tag-disjoint, and identical
+  across rebuilds for random (seed, rate, schedule) draws — a seeded sweep
+  standing in for a hypothesis property test (hypothesis is not in the
+  image);
+- crash-recovery runs are bitwise-identical across the chunked, sharded,
+  and fused-stencil engines at the same config (gossip: exact trajectories;
+  push-sum: rounds + converged set on the stencil path's shared op order);
+- gossip revivals rejoin susceptible (count 0) and can re-converge;
+- push-sum --rejoin restore conserves mass over live + dead + parked to
+  <= 1 ulp at float64 (the PR 1 invariant extended); --rejoin fresh
+  deliberately breaks it (the modeled fault);
+- checkpoint resume of a crash-recovery run is bitwise, and the stream
+  version (v4) gates resumes per the PR 1 sensitivity rules;
+- telemetry schema v2's revived_count column reports the rejoin rounds;
+- tiers without revival support reject loudly; --revive-* without a crash
+  model is a config-time hard error.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cop5615_gossip_protocol_tpu import SimConfig, build_topology
+from cop5615_gossip_protocol_tpu.models.runner import run
+from cop5615_gossip_protocol_tpu.ops import faults, telemetry as telemetry_mod
+from cop5615_gossip_protocol_tpu.utils import checkpoint as ckpt
+
+
+# ---------------------------------------------------------------- config
+
+
+def test_revive_without_crash_model_is_hard_error():
+    with pytest.raises(ValueError, match="nothing to revive"):
+        SimConfig(n=64, topology="full", revive_rate=0.1)
+    with pytest.raises(ValueError, match="nothing to revive"):
+        SimConfig(n=64, topology="full", revive_schedule="5:3")
+
+
+def test_revive_rate_and_schedule_mutually_exclusive():
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        SimConfig(n=64, topology="full", crash_rate=0.1,
+                  revive_rate=0.1, revive_schedule="5:3")
+
+
+def test_rejoin_validated():
+    with pytest.raises(ValueError, match="rejoin"):
+        SimConfig(n=64, topology="full", crash_rate=0.1, revive_rate=0.1,
+                  rejoin="bogus")
+
+
+# ------------------------------------------------- plane properties (sweep)
+
+
+def test_planes_deterministic_and_tag_disjoint_seeded_sweep():
+    # Seeded property sweep over random (seed, rate/schedule) draws: the
+    # planes must rebuild identically (every engine derives them from the
+    # config alone), revival must strictly follow death, and the two
+    # draws must be tag-disjoint — distinct tags, and visibly different
+    # streams off the same base key.
+    assert faults.CRASH_TAG != faults.REVIVE_TAG
+    assert 2**30 <= faults.CRASH_TAG < 2**30 + 2**29
+    assert 2**30 <= faults.REVIVE_TAG < 2**30 + 2**29
+    from cop5615_gossip_protocol_tpu.models.sweep import REPLICA_TAG0
+    assert faults.REVIVE_TAG < REPLICA_TAG0
+
+    rng = np.random.default_rng(0)
+    for trial in range(8):
+        seed = int(rng.integers(0, 2**31 - 1))
+        n = int(rng.integers(40, 400))
+        if trial % 2 == 0:
+            kill = int(rng.integers(1, n // 2))
+            rej = int(rng.integers(1, kill + 1))
+            cfg = SimConfig(
+                n=n, topology="full", seed=seed,
+                crash_schedule=f"2:{kill}",
+                revive_schedule=f"{int(rng.integers(3, 20))}:{rej}",
+            )
+        else:
+            cfg = SimConfig(
+                n=n, topology="full", seed=seed,
+                crash_rate=float(rng.uniform(0.001, 0.05)),
+                revive_rate=float(rng.uniform(0.01, 0.5)),
+            )
+        a = faults.life_planes(cfg, n)
+        faults._death_plane_cached.cache_clear()
+        faults._revival_plane_cached.cache_clear()
+        b = faults.life_planes(cfg, n)
+        np.testing.assert_array_equal(a.death, b.death)
+        np.testing.assert_array_equal(a.revive, b.revive)
+        # Revival strictly after death; never-dead nodes never revive.
+        assert ((a.revive == faults.NEVER) | (a.revive > a.death)).all()
+        assert (a.revive[a.death == faults.NEVER] == faults.NEVER).all()
+        # Tag disjointness as an observable: the uniform draw under the
+        # revive tag differs from the crash tag's on the same base key.
+        key = jax.random.PRNGKey(seed)
+        u_crash = jax.random.uniform(
+            jax.random.fold_in(key, faults.CRASH_TAG), (n,))
+        u_rev = jax.random.uniform(
+            jax.random.fold_in(key, faults.REVIVE_TAG), (n,))
+        assert not np.array_equal(np.asarray(u_crash), np.asarray(u_rev))
+
+
+def test_revive_schedule_exact_counts_and_overflow():
+    cfg = SimConfig(n=200, topology="full", crash_schedule="2:50",
+                    revive_schedule="5:20,9:30")
+    lp = faults.life_planes(cfg, 200)
+    assert int((lp.revive == 5).sum()) == 20
+    assert int((lp.revive == 9).sum()) == 30
+    # Only dead nodes rejoin.
+    assert (lp.death[lp.revive != faults.NEVER] <
+            lp.revive[lp.revive != faults.NEVER]).all()
+    with pytest.raises(ValueError, match="only .* dead"):
+        faults.life_planes(
+            SimConfig(n=200, topology="full", crash_schedule="2:10",
+                      revive_schedule="5:11"),
+            200,
+        )
+
+
+def test_alive_at_dead_window():
+    death = np.array([3, faults.NEVER, 0], np.int32)
+    revive = np.array([7, faults.NEVER, faults.NEVER], np.int32)
+    for r, want in [(2, [1, 1, 0]), (3, [0, 1, 0]), (6, [0, 1, 0]),
+                    (7, [1, 1, 0]), (100, [1, 1, 0])]:
+        got = np.asarray(faults.alive_at(death, r, revive)).astype(int)
+        assert got.tolist() == want, r
+
+
+# ------------------------------------------- engine parity + rejoin quirks
+
+
+def _gossip_cfg(**kw):
+    kw.setdefault("max_rounds", 4000)
+    kw.setdefault("chunk_rounds", 32)
+    return SimConfig(n=256, topology="ring", algorithm="gossip",
+                     crash_schedule="4:60", revive_schedule="10:60",
+                     quorum=0.95, **kw)
+
+
+def test_gossip_crash_revive_bitwise_chunked_sharded_fused():
+    # Acceptance pin: the same crash-recovery config is bitwise-identical
+    # across chunked, sharded, and fused-stencil engines. All 60 dead
+    # nodes rejoin at round 10, so the healed ring converges fully.
+    topo = build_topology("ring", 256)
+    results = {
+        "chunked": run(topo, _gossip_cfg(engine="chunked")),
+        "sharded": run(topo, _gossip_cfg(n_devices=4)),
+        "fused": run(topo, _gossip_cfg(engine="fused")),
+    }
+    ref = results["chunked"]
+    assert ref.outcome == "converged"
+    for name, r in results.items():
+        assert (r.rounds, r.converged_count, r.outcome) == (
+            ref.rounds, ref.converged_count, ref.outcome
+        ), name
+
+
+def test_gossip_revivals_rejoin_susceptible_and_reconverge():
+    # A revived node restarts at count 0 — so at the revival round the
+    # converged count among live nodes DROPS (rejoined nodes are
+    # unconverged) and then recovers: they re-converge.
+    topo = build_topology("full", 128)
+    cfg = SimConfig(n=128, topology="full", algorithm="gossip",
+                    crash_schedule="3:40", revive_schedule="30:40",
+                    quorum=1.0, max_rounds=4000, chunk_rounds=16,
+                    telemetry=True)
+    r = run(topo, cfg)
+    assert r.outcome == "converged"
+    # Quorum 1.0 over live nodes with everyone revived == full population.
+    assert r.converged_count == 128
+    t = r.telemetry.data
+    rev_round = 30  # data[i] is round i's row (start_round 0)
+    assert t[rev_round][telemetry_mod.COL_REVIVED] == 40
+    assert t[:, telemetry_mod.COL_REVIVED].sum() == 40
+    # Live count grows back at the revival round.
+    assert t[rev_round][telemetry_mod.COL_LIVE] == 128
+    assert t[rev_round - 1][telemetry_mod.COL_LIVE] == 88
+
+
+def test_pushsum_restore_conserves_mass_to_ulp_float64():
+    # The PR 1 invariant extended: with rejoin='restore', total (s, w)
+    # mass over live + dead + parked nodes is conserved through death AND
+    # rejoin to <= 1 ulp at float64.
+    topo = build_topology("full", 200)
+    cfg = SimConfig(n=200, topology="full", algorithm="push-sum",
+                    dtype="float64", crash_schedule="3:80,7:20",
+                    revive_schedule="12:60", quorum=0.9, rejoin="restore",
+                    fault_rate=0.2, max_rounds=4000, chunk_rounds=16)
+    r = run(topo, cfg)
+    assert r.outcome == "converged"
+    states = []
+    run(topo, cfg, on_chunk=lambda rounds, st: states.append(st))
+    total_w = float(jnp.sum(states[-1].w))
+    total_s = float(jnp.sum(states[-1].s))
+    assert total_w == pytest.approx(200.0, abs=np.spacing(200.0))
+    want_s = 200 * 199 / 2.0
+    assert total_s == pytest.approx(want_s, abs=4 * np.spacing(want_s))
+
+
+def test_pushsum_fresh_discards_parked_mass():
+    # rejoin='fresh' is the non-conserving fault: revived nodes restart at
+    # (s=x_i, w=0), so total weight mass DROPS by the parked weight.
+    topo = build_topology("full", 200)
+    cfg = SimConfig(n=200, topology="full", algorithm="push-sum",
+                    dtype="float64", crash_schedule="3:80",
+                    revive_schedule="12:80", quorum=1.0, rejoin="fresh",
+                    max_rounds=4000, chunk_rounds=16)
+    states = []
+    r = run(topo, cfg, on_chunk=lambda rounds, st: states.append(st))
+    assert r.outcome == "converged"
+    total_w = float(jnp.sum(states[-1].w))
+    assert total_w < 200.0 - 1e-6  # parked weight was discarded at rejoin
+
+
+def test_pushsum_revive_parity_chunked_vs_sharded_and_fused():
+    base = dict(n=256, topology="ring", algorithm="push-sum",
+                crash_schedule="4:50", revive_rate=0.08, quorum=0.85,
+                max_rounds=6000, chunk_rounds=32)
+    topo = build_topology("ring", 256)
+    for rejoin in ("restore", "fresh"):
+        rc = run(topo, SimConfig(**base, rejoin=rejoin, engine="chunked"))
+        rf = run(topo, SimConfig(**base, rejoin=rejoin, engine="fused"))
+        rs = run(topo, SimConfig(**base, rejoin=rejoin, n_devices=4))
+        assert rc.rounds == rf.rounds == rs.rounds, rejoin
+        assert rc.converged_count == rf.converged_count == rs.converged_count
+
+
+def test_pool_delivery_revive_parity():
+    base = dict(n=1000, topology="full", algorithm="gossip",
+                delivery="pool", crash_schedule="3:200",
+                revive_schedule="8:100", quorum=0.9, max_rounds=500,
+                chunk_rounds=16)
+    topo = build_topology("full", 1000)
+    rc = run(topo, SimConfig(**base, engine="chunked"))
+    rs = run(topo, SimConfig(**base, engine="chunked", n_devices=4))
+    assert rc.outcome == "converged"
+    assert (rc.rounds, rc.converged_count) == (rs.rounds, rs.converged_count)
+
+
+@pytest.mark.slow  # interpret-mode pool kernel run; tier-1 budget note in test_fused.py
+def test_fused_pool_revive_parity_bitwise():
+    base = dict(n=1000, topology="full", algorithm="gossip",
+                delivery="pool", crash_schedule="3:200",
+                revive_schedule="8:100", quorum=0.9, max_rounds=500,
+                chunk_rounds=16)
+    topo = build_topology("full", 1000)
+    rc = run(topo, SimConfig(**base, engine="chunked"))
+    rf = run(topo, SimConfig(**base, engine="fused"))
+    assert (rc.rounds, rc.converged_count) == (rf.rounds, rf.converged_count)
+
+
+# ------------------------------------------------------ checkpoint/resume
+
+
+def test_checkpoint_resume_revive_run_bitwise(tmp_path):
+    topo = build_topology("full", 200)
+    cfg = SimConfig(n=200, topology="full", algorithm="push-sum",
+                    crash_schedule="3:80", revive_schedule="20:60",
+                    quorum=0.9, rejoin="restore", max_rounds=4000,
+                    chunk_rounds=8)
+    snaps = []
+    ref = run(topo, cfg, on_chunk=lambda rounds, st: snaps.append((rounds, st)))
+    assert ref.outcome == "converged"
+    # Resume from a boundary BEFORE the revival round: the rejoin reset
+    # runs inside the revival round's body, so the resumed trajectory
+    # replays it identically.
+    rounds0, st0 = snaps[1]
+    assert rounds0 < 20
+    path = tmp_path / "ck.npz"
+    ckpt.save(path, st0, rounds0, cfg)
+    st, rnds, cfg2 = ckpt.load(path)
+    resumed = run(topo, cfg2, start_state=st, start_round=rnds)
+    assert resumed.rounds == ref.rounds
+    assert resumed.converged_count == ref.converged_count
+    assert resumed.estimate_mae == ref.estimate_mae
+
+
+def test_checkpoint_stream_v4_sensitivity(tmp_path):
+    # A revive config refuses checkpoints written before stream v4 (their
+    # revival derivation is unknowable); a crash-stop config from v3 still
+    # loads — only configs that consume a changed stream are refused.
+    from cop5615_gossip_protocol_tpu.models import pushsum as ps
+    cfg = SimConfig(n=64, topology="full", algorithm="push-sum",
+                    crash_rate=0.01, revive_rate=0.1)
+    st = ps.init_state(64, jnp.float32, 0)
+    path = tmp_path / "old.npz"
+    ckpt.save(path, st, 8, cfg)
+    # Rewrite the archive with a v3 stream marker.
+    with np.load(path) as z:
+        arrays = {k: z[k] for k in z.files}
+    arrays["__stream__"] = np.asarray(3)
+    np.savez_compressed(path, **arrays)
+    with pytest.raises(ValueError, match="stream"):
+        ckpt.load(path)
+    # Same vintage marker, no revive model: loads fine.
+    cfg_stop = dataclasses.replace(cfg, revive_rate=0.0)
+    path2 = tmp_path / "old_stop.npz"
+    ckpt.save(path2, st, 8, cfg_stop)
+    with np.load(path2) as z:
+        arrays = {k: z[k] for k in z.files}
+    arrays["__stream__"] = np.asarray(3)
+    np.savez_compressed(path2, **arrays)
+    _, rnds, _ = ckpt.load(path2)
+    assert rnds == 8
+
+
+# ------------------------------------------------------- tier rejections
+
+
+def test_unsupported_tiers_reject_revive_loudly():
+    cfg_kw = dict(algorithm="gossip", crash_rate=0.01, revive_rate=0.1,
+                  quorum=0.9)
+
+    # Streaming pool tier (pool2).
+    from cop5615_gossip_protocol_tpu.ops import fused_pool2
+    topo = build_topology("full", 4096)
+    reason = fused_pool2.pool2_support(
+        topo, SimConfig(n=4096, topology="full", delivery="pool", **cfg_kw)
+    )
+    assert reason is not None and "revive" in reason
+
+    # Sharded fused pool composition.
+    from cop5615_gossip_protocol_tpu.parallel.fused_pool_sharded import (
+        plan_fused_pool_sharded,
+    )
+    plan = plan_fused_pool_sharded(
+        topo, SimConfig(n=4096, topology="full", delivery="pool",
+                        n_devices=2, engine="fused", **cfg_kw), 2
+    )
+    assert isinstance(plan, str) and "revive" in plan
+
+    # Lattice compositions reject the whole failure model already.
+    from cop5615_gossip_protocol_tpu.parallel.fused_sharded import (
+        plan_fused_sharded,
+    )
+    topo_r = build_topology("ring", 65536)
+    plan = plan_fused_sharded(
+        topo_r, SimConfig(n=65536, topology="ring", n_devices=2,
+                          engine="fused", **cfg_kw), 2
+    )
+    assert isinstance(plan, str)
+
+    # engine='fused' on an ineligible tier raises through run().
+    with pytest.raises(ValueError, match="revive|failure"):
+        run(
+            build_topology("full", 4096),
+            SimConfig(n=4096, topology="full", delivery="pool",
+                      engine="fused", n_devices=2, **cfg_kw),
+        )
+
+
+def test_replica_sweep_shares_config_pure_planes():
+    # The vmapped sweep reuses make_round_fn + _done_predicate, so the
+    # revival plane (config-pure) serves every replica; replica 0 stays
+    # bitwise the unbatched run under churn + recovery.
+    from cop5615_gossip_protocol_tpu.models.sweep import run_replicas
+    topo = build_topology("full", 128)
+    cfg = SimConfig(n=128, topology="full", algorithm="gossip",
+                    crash_schedule="3:40", revive_schedule="9:40",
+                    quorum=0.95, max_rounds=2000, chunk_rounds=16)
+    sweep = run_replicas(topo, cfg, 3)
+    solo = run(topo, cfg)
+    assert sweep.rounds[0] == solo.rounds
+    assert sweep.converged[0] == solo.converged

@@ -1,0 +1,306 @@
+"""Health sentinels + graceful engine degradation (ISSUE 4 harness layers).
+
+Pinned contracts:
+
+- a non-finite state or a mass-divergence past --mass-tolerance surfaces
+  as outcome="unhealthy" with the offending round — a structured outcome
+  in RunResult/JSONL/events, never a traceback and never a wrong
+  "converged" — on the chunked AND sharded engines;
+- the sentinel is a Python-level flag: off traces the bitwise-identical
+  program (trajectories match sentinel-on for healthy runs);
+- fused tiers do not carry the sentinel: engine='auto' demotes to the
+  chunked engine, engine='fused' rejects loudly;
+- environmental engine failures walk the documented degradation ladder
+  (fused->chunked, sharded->single-device) with transient-error retries,
+  emitting structured engine-degraded events — unless strict mode
+  (cfg.strict_engine / GOSSIP_TPU_STRICT_ENGINE) restores fail-fast;
+- config-contract errors (ValueError) always fail fast, ladder or not.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from cop5615_gossip_protocol_tpu import SimConfig, build_topology
+from cop5615_gossip_protocol_tpu.models import pushsum as pushsum_mod
+from cop5615_gossip_protocol_tpu.models import runner
+from cop5615_gossip_protocol_tpu.models.runner import run
+from cop5615_gossip_protocol_tpu.utils import metrics
+
+
+def _ps_state(n, corrupt=None):
+    st = pushsum_mod.init_state(n, jnp.float32, 0)
+    if corrupt == "nan":
+        st = st._replace(s=st.s.at[3].set(jnp.nan))
+    elif corrupt == "mass":
+        st = st._replace(w=st.w.at[5].set(2.5))  # residual 1.5
+    return st
+
+
+# ------------------------------------------------------------- validation
+
+
+def test_mass_tolerance_config_contracts():
+    with pytest.raises(ValueError, match="push-sum"):
+        SimConfig(n=64, topology="full", algorithm="gossip",
+                  mass_tolerance=1e-3)
+    with pytest.raises(ValueError, match="dup_rate"):
+        SimConfig(n=64, topology="full", algorithm="push-sum",
+                  mass_tolerance=1e-3, dup_rate=0.1)
+    with pytest.raises(ValueError, match="fresh"):
+        SimConfig(n=64, topology="full", algorithm="push-sum",
+                  mass_tolerance=1e-3, crash_rate=0.01, revive_rate=0.1,
+                  rejoin="fresh")
+    with pytest.raises(ValueError, match="> 0"):
+        SimConfig(n=64, topology="full", algorithm="push-sum",
+                  mass_tolerance=0.0)
+
+
+# --------------------------------------------------------------- sentinel
+
+
+@pytest.mark.parametrize("corrupt,n_devices", [
+    ("nan", None), ("mass", None), ("nan", 4), ("mass", 4),
+])
+def test_sentinel_trips_to_unhealthy_outcome(corrupt, n_devices):
+    # A corrupt resume state (the smallest reproducible stand-in for
+    # silent numerical corruption) must trip the sentinel on the FIRST
+    # executed round — structured outcome, offending round, no traceback,
+    # converged=False.
+    topo = build_topology("full", 64)
+    cfg = SimConfig(n=64, topology="full", algorithm="push-sum",
+                    mass_tolerance=1e-3, chunk_rounds=8,
+                    n_devices=n_devices)
+    r = run(topo, cfg, start_state=_ps_state(64, corrupt), start_round=5)
+    assert r.outcome == "unhealthy"
+    assert r.unhealthy_round == 5
+    assert not r.converged
+    rec = metrics.run_record(cfg, topo, r)
+    assert rec["outcome"] == "unhealthy"
+    assert rec["unhealthy_round"] == 5
+    import json
+
+    json.dumps(rec)  # JSONL-serializable even with a corrupt final state
+
+
+def test_sentinel_healthy_run_matches_sentinel_off_bitwise():
+    # Python-level flag: the sentinel must not perturb a healthy run's
+    # trajectory or verdict.
+    topo = build_topology("full", 128)
+    base = dict(n=128, topology="full", algorithm="push-sum",
+                chunk_rounds=16)
+    r_off = run(topo, SimConfig(**base))
+    r_on = run(topo, SimConfig(**base, mass_tolerance=1e-2))
+    assert r_on.outcome == "converged"
+    assert r_on.unhealthy_round is None
+    assert (r_on.rounds, r_on.converged_count, r_on.estimate_mae) == (
+        r_off.rounds, r_off.converged_count, r_off.estimate_mae
+    )
+
+
+def test_sentinel_tolerance_is_respected():
+    # Residual 1.5 passes a loose tolerance, trips a tight one.
+    topo = build_topology("full", 64)
+    loose = SimConfig(n=64, topology="full", algorithm="push-sum",
+                      mass_tolerance=10.0, chunk_rounds=8)
+    r = run(topo, loose, start_state=_ps_state(64, "mass"), start_round=0)
+    assert r.outcome == "converged"
+
+
+def test_sentinel_mid_run_offending_round_is_exact():
+    # Trip at a known round: resume a healthy run whose mass is nudged
+    # past tolerance — the reported round is the first EXECUTED round.
+    topo = build_topology("full", 64)
+    cfg = SimConfig(n=64, topology="full", algorithm="push-sum",
+                    mass_tolerance=1e-3, chunk_rounds=4)
+    r = run(topo, cfg, start_state=_ps_state(64, "mass"), start_round=42)
+    assert r.outcome == "unhealthy" and r.unhealthy_round == 42
+
+
+def test_sentinel_fused_rejected_and_auto_demoted():
+    cfg = SimConfig(n=1000, topology="full", algorithm="push-sum",
+                    delivery="pool", engine="fused", mass_tolerance=1e-3,
+                    chunk_rounds=16, max_rounds=400)
+    with pytest.raises(ValueError, match="sentinel|mass"):
+        run(build_topology("full", 1000), cfg)
+    # auto demotes to chunked and still honors the sentinel contract.
+    import dataclasses
+
+    r = run(build_topology("full", 1000),
+            dataclasses.replace(cfg, engine="auto"))
+    assert r.outcome in ("converged", "max_rounds")
+
+
+def test_sentinel_rejected_by_replica_sweep():
+    from cop5615_gossip_protocol_tpu.models.sweep import run_replicas
+
+    with pytest.raises(ValueError, match="sentinel|unbatched"):
+        run_replicas(
+            build_topology("full", 64),
+            SimConfig(n=64, topology="full", algorithm="push-sum",
+                      mass_tolerance=1e-3),
+            2,
+        )
+
+
+# ------------------------------------------------------ degradation ladder
+
+
+def _fail_sharded(monkeypatch, exc_factory):
+    from cop5615_gossip_protocol_tpu.parallel import sharded
+
+    calls = {"n": 0}
+
+    def boom(*a, **k):
+        calls["n"] += 1
+        raise exc_factory(calls["n"])
+
+    monkeypatch.setattr(sharded, "run_sharded", boom)
+    return calls
+
+
+def test_ladder_degrades_sharded_to_single_device(monkeypatch):
+    monkeypatch.setenv("GOSSIP_TPU_STRICT_ENGINE", "0")
+    monkeypatch.setenv("GOSSIP_TPU_RETRY_BASE_S", "0")
+    _fail_sharded(monkeypatch, lambda n: RuntimeError("XLA compile exploded"))
+    events = []
+    topo = build_topology("full", 128)
+    cfg = SimConfig(n=128, topology="full", algorithm="gossip",
+                    n_devices=4, chunk_rounds=16)
+    r = run(topo, cfg, on_event=lambda ev, **f: events.append((ev, f)))
+    assert r.converged and r.outcome == "converged"
+    assert r.degradations, "rung walk must be recorded on the result"
+    assert "devices=1" in r.degradations[-1]["to"]
+    assert all(ev == "engine-degraded" for ev, _ in events) and events
+    # The degraded answer equals the single-device run (the ladder
+    # preserves semantics).
+    solo = run(topo, SimConfig(n=128, topology="full", algorithm="gossip",
+                               chunk_rounds=16))
+    assert (r.rounds, r.converged_count) == (solo.rounds, solo.converged_count)
+    rec = metrics.run_record(cfg, topo, r)
+    assert rec["degradations"] == r.degradations  # JSONL-visible
+
+
+def test_ladder_transient_errors_retry_before_degrading(monkeypatch):
+    monkeypatch.setenv("GOSSIP_TPU_STRICT_ENGINE", "0")
+    monkeypatch.setenv("GOSSIP_TPU_RETRY_BASE_S", "0")
+    from cop5615_gossip_protocol_tpu.parallel import sharded
+
+    real = sharded.run_sharded
+    calls = {"n": 0}
+
+    def flaky(*a, **k):
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise RuntimeError("tunnel UNAVAILABLE: device dropped")
+        return real(*a, **k)
+
+    monkeypatch.setattr(sharded, "run_sharded", flaky)
+    cfg = SimConfig(n=128, topology="full", algorithm="gossip",
+                    n_devices=4, chunk_rounds=16)
+    r = run(build_topology("full", 128), cfg)
+    # Two transient failures retried on the SAME rung: no degradation.
+    assert calls["n"] == 3
+    assert r.degradations is None
+    assert r.converged
+
+
+def test_strict_engine_env_restores_fail_fast(monkeypatch):
+    monkeypatch.setenv("GOSSIP_TPU_STRICT_ENGINE", "1")
+    _fail_sharded(monkeypatch, lambda n: RuntimeError("XLA compile exploded"))
+    with pytest.raises(RuntimeError, match="exploded"):
+        run(build_topology("full", 128),
+            SimConfig(n=128, topology="full", n_devices=4))
+
+
+def test_strict_engine_cfg_flag(monkeypatch):
+    monkeypatch.delenv("GOSSIP_TPU_STRICT_ENGINE", raising=False)
+    _fail_sharded(monkeypatch, lambda n: RuntimeError("XLA compile exploded"))
+    with pytest.raises(RuntimeError, match="exploded"):
+        run(build_topology("full", 128),
+            SimConfig(n=128, topology="full", n_devices=4,
+                      strict_engine=True))
+
+
+def test_value_errors_never_degrade(monkeypatch):
+    # Config-contract violations fail fast even with the ladder armed: a
+    # silently degraded answer to an invalid request would mask the bug.
+    monkeypatch.setenv("GOSSIP_TPU_STRICT_ENGINE", "0")
+    with pytest.raises(ValueError, match="telemetry"):
+        run(build_topology("full", 1000),
+            SimConfig(n=1000, topology="full", delivery="pool",
+                      engine="fused", n_devices=2, telemetry=True))
+
+
+def test_ladder_bottom_rung_reraises(monkeypatch):
+    # Nothing below single-device chunked: the error propagates (as a
+    # real traceback — there is no structured outcome left to produce).
+    monkeypatch.setenv("GOSSIP_TPU_STRICT_ENGINE", "0")
+    monkeypatch.setenv("GOSSIP_TPU_RETRY_BASE_S", "0")
+    monkeypatch.setattr(
+        runner, "_run_resolved",
+        lambda *a, **k: (_ for _ in ()).throw(RuntimeError("engine dead")),
+    )
+    with pytest.raises(RuntimeError, match="engine dead"):
+        run(build_topology("full", 64), SimConfig(n=64, topology="full"))
+
+
+def test_engine_desc_and_ladder_shape():
+    cfg = SimConfig(n=64, topology="full", engine="fused", n_devices=4,
+                    delivery="pool")
+    rungs = runner._engine_ladder(cfg)
+    assert [runner._engine_desc(c) for c in rungs] == [
+        "engine=fused/devices=4",
+        "engine=chunked/devices=4",
+        "engine=chunked/devices=1",
+    ]
+    assert runner._engine_ladder(SimConfig(n=64, topology="full",
+                                           engine="chunked")) == [
+        SimConfig(n=64, topology="full", engine="chunked")
+    ]
+
+
+# --------------------------------------------------------------- CLI surface
+
+
+def test_cli_sentinel_tripped_event_and_unhealthy_exit(tmp_path):
+    # End to end through the CLI: a resumed corrupt checkpoint trips the
+    # sentinel; the run exits nonzero with outcome=unhealthy in the JSONL
+    # record and a sentinel-tripped event in the log — never a traceback.
+    import json
+
+    from cop5615_gossip_protocol_tpu.cli import main
+    from cop5615_gossip_protocol_tpu.utils import checkpoint as ckpt
+    from cop5615_gossip_protocol_tpu.utils.events import read_events
+
+    cfg = SimConfig(n=64, topology="full", algorithm="push-sum",
+                    mass_tolerance=1e-3, chunk_rounds=8)
+    ck = tmp_path / "ck.npz"
+    ckpt.save(ck, _ps_state(64, "nan"), 5, cfg)
+    ev = tmp_path / "events.jsonl"
+    rec_path = tmp_path / "rec.jsonl"
+    rc = main(["64", "full", "push-sum", "--mass-tolerance", "1e-3",
+               "--chunk-rounds", "8", "--resume", str(ck),
+               "--events", str(ev), "--jsonl", str(rec_path), "--quiet"])
+    assert rc == 1
+    rec = json.loads(rec_path.read_text().splitlines()[-1])
+    assert rec["outcome"] == "unhealthy" and rec["unhealthy_round"] == 5
+    kinds = [e["event"] for e in read_events(ev)]
+    assert "sentinel-tripped" in kinds
+    assert kinds[-1] == "run-end"
+
+
+def test_cli_lint_warning_lands_in_run_start_event(tmp_path, capsys):
+    from cop5615_gossip_protocol_tpu.cli import main
+    from cop5615_gossip_protocol_tpu.utils.events import read_events
+
+    ev = tmp_path / "events.jsonl"
+    with pytest.warns(RuntimeWarning, match="quorum"):
+        rc = main(["64", "full", "gossip", "--quorum", "0.5",
+                   "--events", str(ev), "--quiet"])
+    assert rc == 0
+    assert "quorum" in capsys.readouterr().err
+    start = read_events(ev)[0]
+    assert start["event"] == "run-start"
+    assert any("quorum" in w for w in start["warnings"])
