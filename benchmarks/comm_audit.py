@@ -1,0 +1,293 @@
+"""Comm audit — collectives per round/super-step, counted from the jaxpr.
+
+A comm-volume regression (an engine quietly re-growing per-plane wires, a
+collective slipping inside the hot loop) historically only surfaced as an
+on-chip ms/round drift — which needs a TPU session to even notice. This
+tool walks the jitted chunk program of each sharded engine (the engines
+expose it through their ``probe`` hook — the program is TRACED, never
+executed, so the audit runs in seconds on CPU) and reports, per engine x
+topology x overlap schedule:
+
+- collectives INSIDE the chunk's while body — the per-round (chunked
+  engine) / per-super-step (fused compositions) steady-state cost;
+- collectives OUTSIDE the body — per-dispatch setup (the overlap
+  schedule's pre-loop exchange and drain psum live here);
+- payload bytes per collective class (operand aval sizes).
+
+tests/test_comm_audit.py pins the counts, so a regression fails tier-1 on
+CPU without needing a TPU — including the tentpole pin that the batched
+halo wire is exactly ONE ppermute pair per super-step (down from one pair
+per plane per class).
+
+Usage:
+  python benchmarks/comm_audit.py                # markdown table to stdout
+  python benchmarks/comm_audit.py --json FILE    # CI artifact
+  python benchmarks/comm_audit.py --quick        # XLA engines only (skip
+                                                 # the fused-composition
+                                                 # traces, ~seconds)
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+COLLECTIVE_PRIMS = (
+    "ppermute", "psum", "all_gather", "reduce_scatter", "all_to_all",
+)
+
+
+@dataclasses.dataclass
+class AuditReport:
+    """Collective counts for one engine x config x schedule."""
+
+    engine: str
+    topology: str
+    algorithm: str
+    n: int
+    n_devices: int
+    overlap: bool
+    # {"body": {prim: {"count": int, "bytes": int}}, "setup": {...}} —
+    # "body" is inside the chunk's while loop (per round / super-step),
+    # "setup" is the rest of the dispatch (paid once per chunk).
+    counts: dict
+
+    def body_count(self, prim: str) -> int:
+        return self.counts["body"].get(prim, {}).get("count", 0)
+
+    def setup_count(self, prim: str) -> int:
+        return self.counts["setup"].get(prim, {}).get("count", 0)
+
+    def body_bytes(self, prim: str) -> int:
+        return self.counts["body"].get(prim, {}).get("bytes", 0)
+
+    def to_record(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _aval_bytes(aval) -> int:
+    try:
+        import numpy as np
+
+        return int(np.prod(aval.shape)) * aval.dtype.itemsize
+    except Exception:  # noqa: BLE001 — abstract tokens etc. carry no bytes
+        return 0
+
+
+def _sub_jaxprs(eqn):
+    """(jaxpr, enters_loop_body) for every sub-jaxpr of an eqn. A while
+    loop's cond and body both run once per iteration, so both count as
+    loop-body regions; everything else inherits the caller's region."""
+    for name, val in eqn.params.items():
+        vals = val if isinstance(val, (list, tuple)) else [val]
+        for v in vals:
+            jx = getattr(v, "jaxpr", None)
+            if jx is not None:
+                yield jx, eqn.primitive.name == "while"
+            elif hasattr(v, "eqns"):
+                yield v, eqn.primitive.name == "while"
+
+
+def _walk(jaxpr, counts: dict, in_body: bool) -> None:
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name in COLLECTIVE_PRIMS:
+            region = counts["body" if in_body else "setup"]
+            slot = region.setdefault(name, {"count": 0, "bytes": 0})
+            slot["count"] += 1
+            slot["bytes"] += sum(_aval_bytes(v.aval) for v in eqn.invars)
+        for sub, enters_body in _sub_jaxprs(eqn):
+            _walk(sub, counts, in_body or enters_body)
+
+
+def count_collectives(fn, args) -> dict:
+    """Trace ``fn(*args)`` to a jaxpr and count collective primitives by
+    region (inside/outside while bodies). Never executes the program."""
+    import jax
+
+    jaxpr = jax.make_jaxpr(fn)(*args)
+    counts = {"body": {}, "setup": {}}
+    _walk(jaxpr.jaxpr, counts, False)
+    return counts
+
+
+# --- engine probes ---------------------------------------------------------
+
+
+def _probe(counts_sink):
+    def probe(chunk_fn, args):
+        counts_sink.update(count_collectives(chunk_fn, args))
+        return None
+
+    return probe
+
+
+def audit_engine(engine: str, topology: str, algorithm: str, n: int,
+                 n_devices: int, overlap: bool,
+                 cfg_overrides: dict | None = None) -> AuditReport:
+    """Build one sharded engine's jitted chunk through its run function's
+    ``probe`` hook and count its collectives. ``engine`` is one of
+    'sharded' (chunked XLA), 'fused-sharded' (VMEM lattice composition),
+    'fused-pool-sharded', 'hbm-sharded'."""
+    from cop5615_gossip_protocol_tpu import SimConfig, build_topology
+    from cop5615_gossip_protocol_tpu.parallel.mesh import make_mesh
+
+    cfg = SimConfig(
+        n=n, topology=topology, algorithm=algorithm,
+        overlap_collectives=overlap, **(cfg_overrides or {}),
+    )
+    topo = build_topology(topology, n)
+    mesh = make_mesh(n_devices)
+    counts: dict = {}
+    probe = _probe(counts)
+    if engine == "sharded":
+        from cop5615_gossip_protocol_tpu.parallel.sharded import run_sharded
+
+        run_sharded(topo, cfg, mesh=mesh, probe=probe)
+    elif engine == "fused-sharded":
+        from cop5615_gossip_protocol_tpu.parallel.fused_sharded import (
+            run_fused_sharded,
+        )
+
+        run_fused_sharded(topo, cfg, mesh=mesh, probe=probe)
+    elif engine == "fused-pool-sharded":
+        from cop5615_gossip_protocol_tpu.parallel.fused_pool_sharded import (
+            run_fused_pool_sharded,
+        )
+
+        run_fused_pool_sharded(topo, cfg, mesh=mesh, probe=probe)
+    elif engine == "hbm-sharded":
+        from cop5615_gossip_protocol_tpu.parallel.fused_hbm_sharded import (
+            run_stencil_hbm_sharded,
+        )
+
+        run_stencil_hbm_sharded(topo, cfg, mesh=mesh, probe=probe)
+    else:
+        raise ValueError(f"unknown engine {engine!r}")
+    return AuditReport(
+        engine=engine, topology=topology, algorithm=algorithm, n=n,
+        n_devices=n_devices, overlap=overlap, counts=counts,
+    )
+
+
+# (engine, topology, algorithm, n, n_devices, extra cfg) — the audited
+# grid. Populations are the smallest each composition's plan accepts; the
+# counts are shape-independent (the jaxpr structure is), so small is right.
+AUDIT_GRID = (
+    ("sharded", "torus3d", "gossip", 4096, 8, {}),
+    ("sharded", "torus3d", "push-sum", 4096, 8, {}),
+    ("sharded", "full", "push-sum", 1024, 8, {"delivery": "pool"}),
+    # Non-divisible ring: no exact halo plan -> scatter + reduce-scatter
+    # fallback (wire batching does not apply; audited for the record).
+    ("sharded", "ring", "gossip", 1001, 8, {}),
+    ("fused-sharded", "torus3d", "gossip", 131072, 2,
+     {"engine": "fused", "chunk_rounds": 8}),
+    ("fused-sharded", "torus3d", "push-sum", 131072, 2,
+     {"engine": "fused", "chunk_rounds": 8}),
+    ("fused-pool-sharded", "full", "gossip", 131072, 2,
+     {"engine": "fused", "delivery": "pool"}),
+    ("fused-pool-sharded", "full", "push-sum", 131072, 2,
+     {"engine": "fused", "delivery": "pool"}),
+    # 125000 (the interpret-suite torus), not the 2^24 flagship: the jaxpr
+    # structure — and hence every count — is population-independent, and
+    # the smaller planes keep the CI trace in seconds.
+    ("hbm-sharded", "torus3d", "gossip", 125000, 2,
+     {"engine": "fused", "chunk_rounds": 8}),
+    ("hbm-sharded", "torus3d", "push-sum", 125000, 2,
+     {"engine": "fused", "chunk_rounds": 8}),
+)
+
+
+def _fmt_bytes(b: int) -> str:
+    if b >= 1 << 20:
+        return f"{b / (1 << 20):.1f} MiB"
+    if b >= 1 << 10:
+        return f"{b / (1 << 10):.1f} KiB"
+    return f"{b} B"
+
+
+def table(reports) -> list[str]:
+    out = [
+        "| engine | topology | algorithm | overlap | ppermute/step "
+        "| psum/step | all_gather/step | reduce_scatter/step "
+        "| wire bytes/step | setup collectives |",
+        "|---|---|---|---|---|---|---|---|---|---|",
+    ]
+    for r in reports:
+        wire_bytes = sum(
+            r.body_bytes(p)
+            for p in ("ppermute", "all_gather", "reduce_scatter")
+        )
+        setup = sum(r.setup_count(p) for p in COLLECTIVE_PRIMS)
+        out.append(
+            f"| {r.engine} | {r.topology} | {r.algorithm} "
+            f"| {'on' if r.overlap else 'off'} "
+            f"| {r.body_count('ppermute')} | {r.body_count('psum')} "
+            f"| {r.body_count('all_gather')} "
+            f"| {r.body_count('reduce_scatter')} "
+            f"| {_fmt_bytes(wire_bytes)} | {setup} |"
+        )
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--json", type=str, default=None, metavar="FILE",
+                    help="write the reports as JSONL (CI artifact)")
+    ap.add_argument("--quick", action="store_true",
+                    help="XLA chunked engine only (skip the fused-"
+                    "composition traces)")
+    ap.add_argument("--devices", type=int, default=None,
+                    help="override the audited mesh sizes (XLA rows only)")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from cop5615_gossip_protocol_tpu.utils import compat
+
+    jax.config.update("jax_threefry_partitionable", True)
+    need = max(
+        args.devices or 0,
+        max(g[4] for g in AUDIT_GRID),
+    )
+    compat.set_host_device_count(need)
+
+    reports = []
+    for engine, topo, algo, n, n_dev, extra in AUDIT_GRID:
+        if args.quick and engine != "sharded":
+            continue
+        if args.devices and engine == "sharded":
+            n_dev = args.devices
+        for overlap in (True, False):
+            r = audit_engine(engine, topo, algo, n, n_dev, overlap, extra)
+            reports.append(r)
+            print(
+                f"[comm_audit] {engine}/{topo}/{algo} overlap="
+                f"{'on' if overlap else 'off'}: "
+                f"body ppermute={r.body_count('ppermute')} "
+                f"psum={r.body_count('psum')} "
+                f"all_gather={r.body_count('all_gather')} "
+                f"reduce_scatter={r.body_count('reduce_scatter')}",
+                file=sys.stderr, flush=True,
+            )
+
+    print("\n".join(
+        ["# Comm audit — collectives per round/super-step", ""]
+        + table(reports)
+    ))
+    if args.json:
+        with open(args.json, "w") as f:
+            for r in reports:
+                f.write(json.dumps(r.to_record()) + "\n")
+        print(f"[comm_audit] wrote {args.json}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

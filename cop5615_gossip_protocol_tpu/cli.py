@@ -78,6 +78,15 @@ def build_parser() -> argparse.ArgumentParser:
                    "before chunk k's predicate is read, hiding the "
                    "per-dispatch launch floor; 1 = serial loop; bitwise-"
                    "neutral by the overshoot contract, models/pipeline.py)")
+    p.add_argument("--overlap-collectives", choices=["on", "off"],
+                   default="on",
+                   help="sharded-engine collective/compute overlap "
+                   "(parallel/overlap.py): on (default) = batched "
+                   "single-pair halo wires + the fused compositions' "
+                   "termination psum deferred under the next super-step's "
+                   "kernel; off = the serial per-plane/per-class schedule. "
+                   "Bitwise-identical trajectories either way (pure "
+                   "scheduling; tests/test_overlap.py)")
     p.add_argument("--replicas", type=int, default=1,
                    help="run this many replicas (distinct per-replica key "
                    "streams, replica 0 = the unbatched run) of the "
@@ -246,6 +255,7 @@ def _main_refsim(args, parser) -> int:
         "--max-rounds": changed("max_rounds"),
         "--chunk-rounds": changed("chunk_rounds"),
         "--pipeline-chunks": changed("pipeline_chunks"),
+        "--overlap-collectives": changed("overlap_collectives"),
         "--replicas": changed("replicas"),
         "--compile-cache": changed("compile_cache"),
         "--target-frac": changed("target_frac"),
@@ -426,6 +436,7 @@ def main(argv: Optional[list[str]] = None) -> int:
             max_rounds=args.max_rounds,
             chunk_rounds=args.chunk_rounds,
             pipeline_chunks=args.pipeline_chunks,
+            overlap_collectives=args.overlap_collectives == "on",
             target_frac=args.target_frac,
             suppress_converged=None if args.suppress == "auto" else args.suppress == "on",
             fault_rate=args.fault_rate,
@@ -651,6 +662,7 @@ def main(argv: Optional[list[str]] = None) -> int:
         loop_knobs = {"max_rounds": cfg.max_rounds, "chunk_rounds": cfg.chunk_rounds,
                       "n_devices": cfg.n_devices,
                       "pipeline_chunks": cfg.pipeline_chunks,
+                      "overlap_collectives": cfg.overlap_collectives,
                       "telemetry": cfg.telemetry,
                       "mass_tolerance": cfg.mass_tolerance,
                       "strict_engine": cfg.strict_engine}

@@ -129,6 +129,18 @@ class SimConfig:
     # knob like chunk_rounds, so resume accepts a changed value.
     pipeline_chunks: int = 2
 
+    # Collective/compute overlap for the sharded engines (parallel/halo.py
+    # batched wires + parallel/overlap.py deferred-verdict super-steps):
+    # on (default) packs every plane's/class's halo slices into ONE
+    # ppermute pair (or one all_gather) per round/super-step and folds the
+    # fused compositions' termination psum under the next super-step's
+    # kernel; off restores the serial per-plane/per-class schedule. Pure
+    # scheduling — trajectories are bitwise-identical either way
+    # (tests/test_overlap.py), so resume accepts a changed value like the
+    # other loop-control knobs. benchmarks/comm_audit.py pins the
+    # per-super-step collective counts both ways.
+    overlap_collectives: bool = True
+
     # Fraction of population that must converge. None → 1.0 in batched mode;
     # in reference semantics the builder's target_count (N of N+1, Q1) rules.
     target_frac: float | None = None

@@ -46,6 +46,16 @@ done/max_rounds exit the newest in-flight carry is returned (its buffers
 are the only live ones); the overshoot contract makes it bitwise the
 retired carry.
 
+The sharded fused compositions stack a second speculation layer INSIDE the
+dispatch (parallel/overlap.py): their super-step loop defers each
+termination psum under the next super-step's kernel and rolls back to a
+double-buffered copy when the verdict fires. The contracts compose because
+that loop preserves exactly what this driver assumes — the retired carry
+is the serial schedule's bitwise state, ``rounds`` is exact, and a
+dispatch at a terminal carry stays a no-op (the pending verdict is drained
+before the chunk returns, so the ``done`` scalar this driver prefetches is
+never stale across dispatches).
+
 Telemetry rides the same machinery (ops/telemetry.py): a chunk may return a
 fourth element — an auxiliary on-device buffer (the per-round counter
 block) — which the driver prefetches with the predicate scalars and hands

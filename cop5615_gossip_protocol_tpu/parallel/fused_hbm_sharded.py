@@ -10,12 +10,20 @@ halo-amortized shard_map skeleton:
 
 - each device holds its shard of the global [R_glob, 128] padded node
   layout plus an H-row halo per side, ALL IN HBM (that is the point);
-- one super-step = one ppermute pair per plane (halo exchange), then ONE
-  per-shard `pallas_call` that streams PT-row processing tiles through VMEM
-  for CR whole rounds — ping/pong parity planes, mirrored-margin delivery
-  windows, in-consumer threefry at GLOBAL positions: the single-device
-  streamed architecture of ops/fused_stencil_hbm.py re-indexed so that
-  extended row r is global row (row0 + r) mod R_glob;
+- one super-step = ONE batched ppermute pair carrying every plane's halo
+  slices (parallel/halo.exchange_rows_batched; one pair per plane under
+  --overlap-collectives off), then ONE per-shard `pallas_call` that streams
+  PT-row processing tiles through VMEM for CR whole rounds — ping/pong
+  parity planes, mirrored-margin delivery windows, in-consumer threefry at
+  GLOBAL positions: the single-device streamed architecture of
+  ops/fused_stencil_hbm.py re-indexed so that extended row r is global row
+  (row0 + r) mod R_glob;
+- under the default overlap schedule (parallel/overlap.py) the super-steps
+  are double-buffered: the exchange for super-step k+1 writes the inactive
+  ring copy right after super-step k's kernel, and the termination psum for
+  super-step k reduces under super-step k+1's kernel (one-super-step
+  verdict lag; `rounds` stays exact — a fired deferred verdict discards
+  the in-flight speculative super-step and returns the retired copy);
 - halo regions are recomputed redundantly and stay valid for exactly CR
   rounds: delivery is exact in slot space (out[j] reads in[j - e]), so
   contamination from the buffer edges advances at most w slots per round
@@ -179,9 +187,22 @@ def plan_stencil_hbm_sharded(topo: Topology, cfg: SimConfig, n_dev: int):
     w = _halo_width_slots(topo, layout)
     pushsum = cfg.algorithm == "push-sum"
     hbm_planes = 11 if pushsum else 7  # 2 parities x state + delivery
+    # The overlapped super-step schedule (parallel/overlap.py) carries the
+    # halo-extended ring AND a retired mid copy per plane in the XLA-side
+    # loop carry (the double buffer the deferred verdict rolls back to);
+    # those rows live in HBM next to the kernel's resident planes, so the
+    # plan budgets them UNCONDITIONALLY — even for the serial schedule
+    # (--overlap-collectives off, or termination='global', which keeps the
+    # serial loop), which never allocates them. Deliberate conservatism:
+    # the plan's geometry (H, CR, PT) must be identical across the overlap
+    # knob, or a budget-edge population would pick a smaller CR only on
+    # one schedule and super-step-granular `rounds` would differ — breaking
+    # the knob's bitwise-interchangeability and resume contracts for a few
+    # spare rows of headroom.
+    n_state = 4 if pushsum else 3
+    CR0 = max(1, min(int(cfg.chunk_rounds), 64))
     win_per_class = (3 if pushsum else 1) * (2 if blend else 1)
     n_win = len(offsets) * win_per_class
-    CR0 = max(1, min(int(cfg.chunk_rounds), 64))
 
     def fit(cr):
         h_min = -(-(cr * w) // LANES) + 1
@@ -200,7 +221,11 @@ def plan_stencil_hbm_sharded(topo: Topology, cfg: SimConfig, n_dev: int):
             )
             if vmem > _VMEM_SCRATCH_BUDGET:
                 continue
-            if hbm_planes * (rows_ext + pt + 16) * LANES * 4 > _HBM_PLANE_BUDGET:
+            carry_rows = n_state * (rows_ext + rows_loc)
+            hbm = (
+                hbm_planes * (rows_ext + pt + 16) + carry_rows
+            ) * LANES * 4
+            if hbm > _HBM_PLANE_BUDGET:
                 continue
             cands.append((rows_ext, pt, h))
         if not cands:
@@ -877,6 +902,7 @@ def run_stencil_hbm_sharded(
     on_chunk=None,
     start_state=None,
     start_round: int = 0,
+    probe=None,
 ):
     """Sharded HBM-streaming run — engine='fused', n_devices > 1, lattices
     past the VMEM composition's per-shard budget.
@@ -887,7 +913,18 @@ def run_stencil_hbm_sharded(
     the kernel reports per-round middle unstable counts, the psum'd vector
     names the first globally-stable round, and a capped rerun of the same
     chunk (same keys — deterministic) lands the state there, matching the
-    chunked sharded global path's stop round and state."""
+    chunked sharded global path's stop round and state.
+
+    cfg.overlap_collectives (default on) runs the overlapped super-step
+    schedule (parallel/overlap.py): batched single-pair halo wires,
+    double-buffered ring, the termination psum folded under the next
+    super-step's kernel. Off = the serial schedule; both are
+    bitwise-identical (pure scheduling). termination='global' keeps the
+    serial loop (its verdict can demand a capped chunk rerun) but still
+    rides the batched wires. ``probe(chunk_sharded, args)``, when given,
+    receives the jitted chunk program and example arguments and its return
+    value replaces the run (benchmarks/comm_audit.py's trace hook — no
+    execution happens)."""
     import time
 
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -897,6 +934,8 @@ def run_stencil_hbm_sharded(
     from ..models.runner import _check_dtype, _finalize_result, draw_leader
     from ..ops import sampling
     from ..ops.fused import round_keys
+    from . import halo as halo_mod
+    from . import overlap as overlap_mod
     from .fused_sharded import global_verdict_step
     from .mesh import NODE_AXIS, make_mesh
 
@@ -962,26 +1001,61 @@ def run_stencil_hbm_sharded(
 
     perm_fwd = [(d, (d + 1) % n_dev) for d in range(n_dev)]
     perm_bwd = [(d, (d - 1) % n_dev) for d in range(n_dev)]
+    overlap = cfg.overlap_collectives
 
-    def ext_rows(x):
-        left = lax.ppermute(x[-H:], NODE_AXIS, perm_fwd)
-        right = lax.ppermute(x[:H], NODE_AXIS, perm_bwd)
-        return jnp.concatenate([left, x, right], axis=0)
+    def exchange(planes):
+        """Halo-extend the mid planes: the batched wire (one ppermute pair
+        for all planes, parallel/halo.py) under the overlap schedule, one
+        pair per plane on the serial one — identical received bytes."""
+        if overlap:
+            return halo_mod.exchange_rows_batched(
+                planes, H, NODE_AXIS, n_dev
+            )
+
+        def ext_rows(x):
+            left = lax.ppermute(x[-H:], NODE_AXIS, perm_fwd)
+            right = lax.ppermute(x[:H], NODE_AXIS, perm_bwd)
+            return jnp.concatenate([left, x, right], axis=0)
+
+        return tuple(ext_rows(p) for p in planes)
 
     def chunk_local(planes_in, rnd_in, done_in, round_end, key_data):
+        base = sampling.key_join(key_data, key_impl)
+        dev = lax.axis_index(NODE_AXIS)
+        row0 = lax.rem(
+            dev.astype(jnp.int32) * rows_loc - H + 2 * R_glob,
+            jnp.int32(R_glob),
+        )
+
+        if overlap and not (pushsum and global_term):
+            # Overlapped schedule (parallel/overlap.py): the verdict psum
+            # for super-step k reduces under super-step k+1's kernel, the
+            # next exchange writes the inactive ring copy right after the
+            # kernel, and a fired deferred verdict rolls back to the
+            # retired double-buffer copy — rounds stay exact.
+            def compute(ext_state, rnd, cap):
+                keys = round_keys(base, rnd, CR)
+                out, executed, u = chunk_fn(ext_state, keys, row0, rnd, cap)
+                conv_last = lax.dynamic_index_in_dim(
+                    u, jnp.maximum(executed - 1, 0), keepdims=False
+                )
+                return out, executed, conv_last
+
+            return overlap_mod.overlapped_superstep_loop(
+                planes_in, rnd_in, done_in, round_end,
+                exchange=exchange, compute=compute,
+                psum_metric=lambda m: lax.psum(m, NODE_AXIS),
+                target=target,
+            )
+
         def cond(c):
             _, rnd, done = c
             return jnp.logical_and(~done, rnd < round_end)
 
         def body(c):
             planes, rnd, _ = c
-            ext_state = tuple(ext_rows(p) for p in planes)
-            keys = round_keys(sampling.key_join(key_data, key_impl), rnd, CR)
-            dev = lax.axis_index(NODE_AXIS)
-            row0 = lax.rem(
-                dev.astype(jnp.int32) * rows_loc - H + 2 * R_glob,
-                jnp.int32(R_glob),
-            )
+            ext_state = exchange(planes)
+            keys = round_keys(base, rnd, CR)
             out, executed, u = chunk_fn(ext_state, keys, row0, rnd, round_end)
             if pushsum and global_term:
                 def run_capped(cap):
@@ -1030,6 +1104,13 @@ def run_stencil_hbm_sharded(
         return gossip_mod.GossipState(
             count=flats[0], active=flats[1] != 0, conv=flats[2] != 0
         )
+
+    if probe is not None:
+        return probe(chunk_sharded, (
+            planes0, rnd0, done0_dev,
+            rep_put(np.int32(min(start_round + CR, cfg.max_rounds))),
+            kd_dev,
+        ))
 
     t0 = time.perf_counter()
     warm = chunk_sharded(

@@ -124,10 +124,19 @@ def run_fused_pool_sharded(
     on_chunk=None,
     start_state=None,
     start_round: int = 0,
+    probe=None,
 ):
     """Sharded fused pool run — engine='fused', n_devices > 1, implicit full
     topology with delivery='pool'. Same contract as run_sharded; rounds are
-    EXACT (the replicated in-kernel verdict is already global)."""
+    EXACT (the replicated in-kernel verdict is already global).
+
+    cfg.overlap_collectives (default on) batches the super-step's gather
+    wire: ONE all_gather carrying every plane (parallel/halo.py
+    gather_rows_batched, bitcast-packed) instead of one per plane.
+    Termination is already off the critical path here by construction — the
+    in-kernel verdict is computed on the replicated full copy, no reduction
+    collective exists to defer. ``probe(chunk_sharded, args)``
+    short-circuits the run for benchmarks/comm_audit.py."""
     import time
 
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -147,6 +156,7 @@ def run_fused_pool_sharded(
     from ..ops import sampling
     from ..ops.fused import build_death2d, round_keys
     from ..ops.fused_pool import round_offsets
+    from . import halo as halo_mod
     from .mesh import NODE_AXIS, make_mesh
 
     if mesh is None:
@@ -222,13 +232,18 @@ def run_fused_pool_sharded(
 
         def body(c):
             planes, rnd, _ = c
-            # ONE gather per super-step; the replicated chunk then runs up
-            # to K rounds with state VMEM-resident and the global verdict
-            # in-kernel.
-            full = tuple(
-                lax.all_gather(p, NODE_AXIS, axis=0, tiled=True)
-                for p in planes
-            )
+            # ONE gather wire per super-step (batched across planes under
+            # the default overlap schedule — parallel/halo.py; one
+            # all_gather per plane with --overlap-collectives off); the
+            # replicated chunk then runs up to K rounds with state
+            # VMEM-resident and the global verdict in-kernel.
+            if cfg.overlap_collectives:
+                full = halo_mod.gather_rows_batched(planes, NODE_AXIS)
+            else:
+                full = tuple(
+                    lax.all_gather(p, NODE_AXIS, axis=0, tiled=True)
+                    for p in planes
+                )
             keys = round_keys(base, rnd, K)
             offs = round_offsets(base, rnd, K, cfg.pool_size, n)
             out_full, executed = chunk_fn(full, keys, offs, rnd, round_end)
@@ -289,6 +304,12 @@ def run_fused_pool_sharded(
         return gossip_mod.GossipState(
             count=flats[0], active=flats[1] != 0, conv=flats[2] != 0
         )
+
+    if probe is not None:
+        return probe(chunk_sharded, (
+            planes0, rnd0, done0_dev,
+            rep_put(np.int32(min(start_round + 1, cfg.max_rounds))), kd_dev,
+        ))
 
     t0 = time.perf_counter()
     # One real round, discarded — the absolute-round key stream makes the

@@ -7,13 +7,18 @@ them with the halo-amortization trick:
 
 - each device holds its shard of the [R_glob, 128] padded node layout plus
   an H-row halo on each side (H >= CR * per-round halo width);
-- one "super-step" = exchange halos (one ppermute per side per plane),
-  then run CR whole rounds INSIDE one per-shard `pallas_call` — the halo
-  regions are *recomputed redundantly* on each device, shrinking by the
-  stencil width per round, and stay valid for exactly CR rounds;
+- one "super-step" = exchange halos (ONE batched ppermute pair for every
+  plane under the default overlap schedule — parallel/halo.py; one pair
+  per plane with --overlap-collectives off), then run CR whole rounds
+  INSIDE one per-shard `pallas_call` — the halo regions are *recomputed
+  redundantly* on each device, shrinking by the stencil width per round,
+  and stay valid for exactly CR rounds;
 - global convergence (`lax.psum` of middle-region converged counts) is
-  evaluated at super-step boundaries only. Collectives per CR rounds: a
-  handful of halo slices + one scalar psum, instead of per-round exchanges.
+  evaluated at super-step boundaries only — and, under the overlap
+  schedule, DEFERRED one super-step so the reduction rides under the next
+  kernel instead of between two kernels (parallel/overlap.py; rounds stay
+  exact via the double-buffered rollback). Collectives per CR rounds: one
+  batched halo volley + one scalar psum, instead of per-round exchanges.
 
 Exactness at any population:
 - sampling runs at GLOBAL positions — the kernel hashes each extended slot's
@@ -464,12 +469,20 @@ def run_fused_sharded(
     on_chunk=None,
     start_state=None,
     start_round: int = 0,
+    probe=None,
 ):
     """Sharded fused run — the engine='fused', n_devices > 1 path.
 
     Same contract as parallel/sharded.run_sharded; convergence is detected
     at super-step (fused-chunk) granularity, so `rounds` is the first
-    boundary at/after true convergence (exact at chunk_rounds=1)."""
+    boundary at/after true convergence (exact at chunk_rounds=1).
+
+    cfg.overlap_collectives (default on): batched single-pair halo wires
+    and the deferred-verdict overlapped super-step loop
+    (parallel/overlap.py) — bitwise-identical to the serial schedule.
+    termination='global' keeps the serial loop (capped-rerun verdict) on
+    batched wires. ``probe(chunk_sharded, args)`` short-circuits the run
+    for benchmarks/comm_audit.py (trace, never execute)."""
     import time
 
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -479,6 +492,8 @@ def run_fused_sharded(
     from ..models.runner import _check_dtype, draw_leader
     from ..ops import sampling
     from ..ops.fused import round_keys
+    from . import halo as halo_mod
+    from . import overlap as overlap_mod
     from .mesh import NODE_AXIS, make_mesh
 
     if mesh is None:
@@ -545,6 +560,7 @@ def run_fused_sharded(
 
     perm_fwd = [(d, (d + 1) % n_dev) for d in range(n_dev)]
     perm_bwd = [(d, (d - 1) % n_dev) for d in range(n_dev)]
+    overlap = cfg.overlap_collectives
 
     def ext_rows(x):
         """[rows_loc, ...] local plane -> halo-extended [rows_ext, ...]:
@@ -554,15 +570,60 @@ def run_fused_sharded(
         right = lax.ppermute(x[:H], NODE_AXIS, perm_bwd)
         return jnp.concatenate([left, x, right], axis=0)
 
+    def exchange(planes):
+        """State-plane halo exchange: one batched ppermute pair for all
+        planes under the overlap schedule, one pair per plane otherwise."""
+        if overlap:
+            return halo_mod.exchange_rows_batched(
+                planes, H, NODE_AXIS, n_dev
+            )
+        return tuple(ext_rows(p) for p in planes)
+
     def chunk_local(planes_in, rnd_in, done_in, round_end, key_data,
                     disp_loc, deg_loc):
         # The displacement/degree planes are round-invariant: assemble
         # their halo-extended form ONCE per jitted call, not per super-step
-        # (max_deg+1 loop-invariant ppermute pairs otherwise).
-        disp_ext = jnp.stack(
-            [ext_rows(disp_loc[j]) for j in range(disp_loc.shape[0])]
+        # (max_deg+1 loop-invariant ppermute pairs otherwise); the batched
+        # wire folds even those into one pair.
+        if overlap:
+            topo_ext = halo_mod.exchange_rows_batched(
+                tuple(disp_loc[j] for j in range(disp_loc.shape[0]))
+                + (deg_loc,),
+                H, NODE_AXIS, n_dev,
+            )
+            disp_ext = jnp.stack(topo_ext[:-1])
+            deg_ext = topo_ext[-1]
+        else:
+            disp_ext = jnp.stack(
+                [ext_rows(disp_loc[j]) for j in range(disp_loc.shape[0])]
+            )
+            deg_ext = ext_rows(deg_loc)
+
+        base = sampling.key_join(key_data, key_impl)
+        dev = lax.axis_index(NODE_AXIS)
+        row0 = lax.rem(
+            dev.astype(jnp.int32) * rows_loc - H + 2 * R_glob,
+            jnp.int32(R_glob),
         )
-        deg_ext = ext_rows(deg_loc)
+
+        if overlap and not global_term:
+            # Overlapped super-step schedule (parallel/overlap.py): verdict
+            # psum deferred under the next kernel, next exchange adjacent
+            # to the kernel output, exact rollback on a fired verdict.
+            def compute(ext_state, rnd, cap):
+                keys = round_keys(base, rnd, CR)
+                out_ext, executed, conv_mid, _u = chunk_fn(
+                    ext_state, keys, row0, rnd, cap, disp_ext, deg_ext
+                )
+                mid = tuple(o[H:H + rows_loc] for o in out_ext)
+                return mid, executed, conv_mid
+
+            return overlap_mod.overlapped_superstep_loop(
+                planes_in, rnd_in, done_in, round_end,
+                exchange=exchange, compute=compute,
+                psum_metric=lambda m: lax.psum(m, NODE_AXIS),
+                target=target,
+            )
 
         def cond(c):
             _, rnd, done = c
@@ -570,15 +631,8 @@ def run_fused_sharded(
 
         def body(c):
             planes, rnd, _ = c
-            ext_state = tuple(ext_rows(p) for p in planes)
-            keys = round_keys(
-                sampling.key_join(key_data, key_impl), rnd, CR
-            )
-            dev = lax.axis_index(NODE_AXIS)
-            row0 = lax.rem(
-                dev.astype(jnp.int32) * rows_loc - H + 2 * R_glob,
-                jnp.int32(R_glob),
-            )
+            ext_state = exchange(planes)
+            keys = round_keys(base, rnd, CR)
             out_ext, executed, conv_mid, u = chunk_fn(
                 ext_state, keys, row0, rnd, round_end, disp_ext, deg_ext
             )
@@ -633,6 +687,13 @@ def run_fused_sharded(
         return gossip_mod.GossipState(
             count=flats[0], active=flats[1] != 0, conv=flats[2] != 0
         )
+
+    if probe is not None:
+        return probe(chunk_sharded, (
+            planes0, rnd0, done0_dev,
+            rep_put(np.int32(min(start_round + CR, cfg.max_rounds))),
+            kd_dev, disp_dev, deg_dev,
+        ))
 
     t0 = time.perf_counter()
     warm = chunk_sharded(

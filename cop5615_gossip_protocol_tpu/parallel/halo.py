@@ -20,6 +20,15 @@ Offsets are used in *signed* form (d > n/2 ≡ d - n): a torus wrap edge such
 as x = g-1 → x = 0 has modular displacement n-(g-1) but signed displacement
 -(g-1) — the halo stays a few lattice rows wide instead of O(n).
 
+Wire packaging is orthogonal to delivery semantics: the per-class schedule
+issues one ppermute per offset class, the BATCHED schedule
+(deliver_halo_batched / exchange_rows_batched / gather_rows_batched —
+cfg.overlap_collectives, default on) packs every class's / plane's boundary
+slices into one contiguous buffer and issues ONE ppermute pair (or one
+all_gather) per round/super-step. Same bytes, same values, same
+accumulation order — bitwise-identical trajectories, fewer larger wires
+(benchmarks/comm_audit.py pins the counts).
+
 Correctness at padded populations (n_pad > n): a signed roll is only the
 same as the modular roll when no real edge's value crosses the global
 [0, n) boundary — wrap edges of ring/torus at non-divisible populations
@@ -204,7 +213,8 @@ def deliver_pool_sharded(channels_loc, choice_loc, offsets, axis: str, n_dev: in
     return inbox
 
 
-def deliver_halo(values_loc, disp_loc, plan: HaloPlan, axis: str):
+def deliver_halo(values_loc, disp_loc, plan: HaloPlan, axis: str,
+                 batched: bool = False):
     """Sharded stencil delivery: inbox shard from |offsets| masked halo
     rolls. ``values_loc`` is [..., n_loc] — push-sum stacks its s and w
     channels so both ride one ppermute per offset class. ``disp_loc`` is the
@@ -212,11 +222,127 @@ def deliver_halo(values_loc, disp_loc, plan: HaloPlan, axis: str):
     shard; masking selects, per offset class, exactly the senders using that
     displacement (mirrors ops/delivery.deliver_stencil); per-channel
     accumulation order is unchanged by stacking, so results stay bit-identical
-    to the single-device stencil path."""
+    to the single-device stencil path.
+
+    ``batched=True`` routes the BATCHED HALO WIRE (``deliver_halo_batched``):
+    every class's boundary slice rides ONE ppermute pair per round instead of
+    one ppermute per class — the same bytes in fewer, larger wires, which on
+    ICI turns per-class wire latency into a single volley. The delivered
+    values and the accumulation order are identical either way, so the two
+    schedules are bitwise-interchangeable (tests/test_overlap.py)."""
+    if batched:
+        return deliver_halo_batched(values_loc, disp_loc, plan, axis)
     zero = jnp.zeros((), values_loc.dtype)
     inbox = jnp.zeros_like(values_loc)
     for d, s in zip(plan.offsets_mod, plan.offsets_signed):
         masked = jnp.where(disp_loc == d, values_loc, zero)
         inbox = inbox + halo_roll(masked, int(s), axis, plan.n_dev)
     return inbox
+
+
+def deliver_halo_batched(values_loc, disp_loc, plan: HaloPlan, axis: str):
+    """Batched-wire variant of ``deliver_halo``: pack every offset class's
+    boundary slice into ONE contiguous send buffer per ring direction, issue
+    a single ppermute pair (forward + backward) per round, then unpack and
+    stitch each class's roll locally. Per-class masked values, per-class
+    stitch geometry, and the accumulation order all match the per-class
+    schedule exactly — only the wire packaging changes, so trajectories are
+    bitwise-identical (ints exactly, floats to the last bit).
+
+    On a single-device "mesh" there are no wires at all; the per-class
+    jnp.roll path is already wire-free and is reused unchanged."""
+    n_dev = plan.n_dev
+    classes = list(zip(plan.offsets_mod, plan.offsets_signed))
+    zero = jnp.zeros((), values_loc.dtype)
+    masked = [
+        jnp.where(disp_loc == d, values_loc, zero) for d, _ in classes
+    ]
+    if n_dev == 1:
+        inbox = jnp.zeros_like(values_loc)
+        for m, (_, s) in zip(masked, classes):
+            inbox = inbox + halo_roll(m, int(s), axis, 1)
+        return inbox
+    # Wire layout: positive rolls ship the top |s| lanes to device k+1,
+    # negative rolls the bottom |s| lanes to device k-1 (halo_roll's own
+    # geometry). Classes with s == 0 need no wire.
+    fwd = [(i, int(s)) for i, (_, s) in enumerate(classes) if s > 0]
+    bwd = [(i, -int(s)) for i, (_, s) in enumerate(classes) if s < 0]
+
+    def volley(sends, step):
+        if not sends:
+            return {}
+        packed = jnp.concatenate(
+            [masked[i][..., -w:] if step > 0 else masked[i][..., :w]
+             for i, w in sends],
+            axis=-1,
+        )
+        recv = lax.ppermute(packed, axis, _ring_perm(n_dev, step))
+        out, off = {}, 0
+        for i, w in sends:
+            out[i] = recv[..., off:off + w]
+            off += w
+        return out
+
+    recv_f = volley(fwd, +1)
+    recv_b = volley(bwd, -1)
+    inbox = jnp.zeros_like(values_loc)
+    for i, (_, s) in enumerate(classes):
+        s = int(s)
+        if s == 0:
+            rolled = masked[i]
+        elif s > 0:
+            rolled = jnp.concatenate(
+                [recv_f[i], masked[i][..., :-s]], axis=-1
+            )
+        else:
+            rolled = jnp.concatenate(
+                [masked[i][..., -s:], recv_b[i]], axis=-1
+            )
+        inbox = inbox + rolled
+    return inbox
+
+
+def exchange_rows_batched(planes, H: int, axis: str, n_dev: int):
+    """Halo-extend node-sharded [rows_loc, LANES] planes with ONE ppermute
+    pair for ALL planes: each plane is bitcast to int32 (bitwise-exact for
+    the compositions' float32/int32 planes), stacked, the H-row boundary
+    slices exchanged around the device ring in a single forward + backward
+    volley, and unpacked back to the original dtypes. Replaces one ppermute
+    pair PER PLANE (parallel/fused_sharded.ext_rows): a push-sum super-step's
+    8 wires become 2, same bytes. Left halo = left neighbor's last H rows,
+    right = right neighbor's first H rows (ring order = global row order) —
+    identical to the per-plane exchange, hence bitwise-neutral."""
+    cast = [
+        p if p.dtype == jnp.int32 else lax.bitcast_convert_type(p, jnp.int32)
+        for p in planes
+    ]
+    stack = jnp.stack(cast)
+    left = lax.ppermute(stack[:, -H:], axis, _ring_perm(n_dev, +1))
+    right = lax.ppermute(stack[:, :H], axis, _ring_perm(n_dev, -1))
+    ext = jnp.concatenate([left, stack, right], axis=1)
+    return tuple(
+        ext[i] if p.dtype == jnp.int32
+        else lax.bitcast_convert_type(ext[i], p.dtype)
+        for i, p in enumerate(planes)
+    )
+
+
+def gather_rows_batched(planes, axis: str):
+    """All-gather node-sharded [rows_loc, LANES] planes into full
+    [R_glob, LANES] copies with ONE all_gather for ALL planes (bitcast to
+    int32, stacked, gathered along the row axis, unpacked) — the batched
+    wire for the fused pool x sharded composition, which previously paid
+    one all_gather per plane per super-step. Bitcast is bitwise-exact, so
+    the gathered copies are identical to the per-plane gathers."""
+    cast = [
+        p if p.dtype == jnp.int32 else lax.bitcast_convert_type(p, jnp.int32)
+        for p in planes
+    ]
+    stack = jnp.stack(cast)
+    full = lax.all_gather(stack, axis, axis=1, tiled=True)
+    return tuple(
+        full[i] if p.dtype == jnp.int32
+        else lax.bitcast_convert_type(full[i], p.dtype)
+        for i, p in enumerate(planes)
+    )
 

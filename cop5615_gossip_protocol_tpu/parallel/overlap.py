@@ -1,0 +1,134 @@
+"""Collective/compute overlap for the sharded super-step compositions.
+
+The serial super-step schedule of the fused x sharded lattice compositions
+(parallel/fused_sharded.py, parallel/fused_hbm_sharded.py) put BOTH
+collectives on the critical path between kernel launches:
+
+    exchange halos -> kernel (CR rounds) -> psum verdict -> cond
+         ^------------- next super-step waits here -------------'
+
+so inter-device traffic serialized against the tile-streaming grid — the
+measured 2.30x ms/round gap of the HBM x sharded composition against the
+single-device streamed engine (tests_tpu/test_fused_hbm_sharded_compiled.py
+budget history). This module restructures the schedule along the overlap
+discipline of distributed training stacks (PAPERS.md: Ring Attention's
+ring-exchange overlap; Wang et al.'s decomposition-for-overlap):
+
+1. **Batched halo wires** — the exchange arrives here already packed into
+   one ppermute pair for ALL planes (parallel/halo.exchange_rows_batched):
+   a super-step issues one wire volley, not a pair per plane per class.
+
+2. **Double-buffered extended ring** — the loop carries the halo-EXTENDED
+   planes for the next super-step next to the retired mid planes of the
+   last one. The exchange for super-step k+1 is issued immediately after
+   super-step k's kernel writes its planes — adjacent in the schedule,
+   writing the inactive ring copy — so the only thing between kernel k and
+   kernel k+1 is the wire itself; everything else has moved off that edge.
+
+3. **Off-critical-path termination** — the converged-count psum for
+   super-step k is folded into super-step k+1's body: the verdict for k is
+   reduced WHILE k+1's kernel runs (the two are data-independent, which is
+   what lets the scheduler overlap them), a one-super-step verdict lag.
+   ``rounds`` stays EXACT via the same double buffer: when the deferred
+   verdict fires, the in-flight speculative super-step is discarded
+   unobserved and the loop returns the retired mid planes and round counter
+   of the verdict's own super-step — bitwise the serial schedule's exit
+   state (the models/pipeline.py overshoot idea, one level down). The last
+   pending verdict of a dispatch is drained after the loop, so the chunk's
+   returned ``done`` flag is never stale across dispatches.
+
+All three are pure scheduling: every kernel consumes exactly the operands
+the serial schedule feeds it, so trajectories stay bitwise-identical to the
+single-device engines (tests/test_overlap.py pins the loop against the
+serial schedule; the existing parity suites pin the compositions against
+the single-device engines with the overlap schedule ON).
+
+A note on tile order: the ideal schedule would also start the halo wires
+as soon as the kernel's BOUNDARY tiles retire (interior-first tile order,
+so only the next super-step's boundary tiles wait on the in-flight halo).
+At the XLA graph boundary a `pallas_call` is one atomic op — a consumer
+cannot observe partial outputs — so within-kernel tile reordering cannot
+release the wires early; issuing the batched exchange ADJACENT to the
+kernel output (this module) is the implementable form of that idea, and
+moving the wires into the kernels themselves (Pallas remote DMA between
+boundary tiles) is the documented next step if the on-chip ratio still
+shows wire latency after this schedule.
+
+Cost: one speculative super-step of kernel work is wasted per converged
+run; the carry holds one extra copy of the mid planes; and each DISPATCH
+pays one redundant exchange volley — the pre-loop exchange recomputes what
+the previous dispatch's last body iteration produced and dropped (the
+final ``ext_next`` at a round_end exit is equally unobserved), so N
+super-steps cost N+1 volleys, ~1/N extra wire volume at the default
+8-super-step stride. Deliberate: carrying the extended ring ACROSS
+dispatches would put rows_ext-shaped planes into the pipelined driver's
+dispatch contract (models/pipeline.py) and grow every engine's
+checkpoint/resume surface for a boundary-only saving that the drain psum
+already overlaps; benchmarks/comm_audit.py reports the volley under
+"setup collectives" so the cost stays visible. termination='global' keeps
+the serial schedule: its verdict can demand a capped RErun of the same
+chunk (parallel/fused_sharded.global_verdict_step), which needs the
+chunk's input still at hand — deferring it would mean carrying two
+extended generations.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def overlapped_superstep_loop(
+    planes_in, rnd_in, done_in, round_end, *, exchange, compute, psum_metric,
+    target,
+):
+    """Run super-steps to ``round_end`` with the deferred-verdict schedule.
+
+    ``exchange(planes) -> ext``: halo-extend mid planes (the batched wire).
+    ``compute(ext, rnd, cap) -> (mid, executed, metric)``: one super-step —
+    up to CR rounds; ``metric`` is the LOCAL termination contribution of the
+    last executed round (per-shard middle-region converged count).
+    ``psum_metric(metric) -> total``: the cross-device reduction.
+    ``target``: the verdict fires when the reduced metric reaches it.
+
+    Returns ``(planes, rnd, done)`` with the exact semantics of the serial
+    loop: ``planes``/``rnd`` are the state and round counter of the LAST
+    super-step at/before the verdict, and ``done`` reflects the verdict of
+    the last executed super-step (drained before returning, never deferred
+    across dispatches). A call at ``done_in`` or ``rnd_in >= round_end``
+    executes zero super-steps and is a bitwise no-op on the planes — the
+    overshoot contract the pipelined driver (models/pipeline.py) relies on.
+    """
+    zero_metric = jnp.int32(0)  # psums below any target (targets are >= 1)
+
+    def cond(c):
+        _, _, rnd, _, done = c
+        return jnp.logical_and(~done, rnd < round_end)
+
+    def body(c):
+        ext, mid_prev, rnd, pend, _ = c
+        # Speculative kernel for this super-step and the deferred verdict
+        # for the previous one are data-independent: the reduction rides
+        # UNDER the kernel instead of between two kernels.
+        mid, executed, metric = compute(ext, rnd, round_end)
+        fired = psum_metric(pend) >= target
+        # Next super-step's wires, issued adjacent to the kernel output —
+        # the inactive ring copy of the double buffer. Unused when the
+        # verdict fired (the loop exits), like any overshoot work.
+        ext_next = exchange(mid)
+        mid_keep = tuple(
+            jnp.where(fired, a, b) for a, b in zip(mid_prev, mid)
+        )
+        rnd_keep = jnp.where(fired, rnd, rnd + executed)
+        pend_keep = jnp.where(fired, zero_metric, metric.astype(jnp.int32))
+        return (ext_next, mid_keep, rnd_keep, pend_keep, fired)
+
+    ext0 = exchange(planes_in)
+    ext_f, mid_f, rnd_f, pend_f, done_f = lax.while_loop(
+        cond, body, (ext0, tuple(planes_in), rnd_in, zero_metric, done_in)
+    )
+    # Drain: the last super-step's verdict is still pending when the loop
+    # exits at round_end; a fired exit zeroed its pend, so the extra psum
+    # is inert there. One reduction per dispatch, not per super-step.
+    done_final = done_f | (psum_metric(pend_f) >= target)
+    return mid_f, rnd_f, done_final

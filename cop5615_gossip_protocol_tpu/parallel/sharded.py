@@ -111,11 +111,19 @@ def run_sharded(
     start_state=None,
     start_round: int = 0,
     on_telemetry: Optional[Callable[[int, object], None]] = None,
+    probe=None,
 ) -> RunResult:
     """Sharded analog of models.runner.run — same config, same result.
     ``start_state`` (unpadded, from utils/checkpoint.py) resumes a run;
     round keys use absolute round indices, so a resumed sharded run follows
-    the same stream as the uninterrupted one."""
+    the same stream as the uninterrupted one.
+
+    cfg.overlap_collectives (default on) routes halo delivery through the
+    BATCHED wire (parallel/halo.deliver_halo_batched): every offset class's
+    boundary slice rides one ppermute pair per round instead of one
+    ppermute per class — bitwise-identical delivery, fewer larger wires.
+    ``probe(chunk_sharded, args)``, when given, replaces the run with the
+    probe's return value (benchmarks/comm_audit.py's trace hook)."""
     if mesh is None:
         mesh = make_mesh(cfg.n_devices)
     n_dev = mesh.devices.size
@@ -347,9 +355,14 @@ def run_sharded(
             ppermute of the boundary slice (parallel/halo.py). ``values``
             may be [..., n_loc] (stacked channels share the ppermutes).
             Same static accumulation order as the single-device stencil
-            path — sharded trajectories stay bit-identical."""
+            path — sharded trajectories stay bit-identical. Batched wires
+            (one ppermute pair for all classes) under the default overlap
+            schedule; per-class wires with --overlap-collectives off."""
             disp = jnp.remainder(targets - gids, n)
-            return halo_mod.deliver_halo(values, disp, plan, NODE_AXIS)
+            return halo_mod.deliver_halo(
+                values, disp, plan, NODE_AXIS,
+                batched=cfg.overlap_collectives,
+            )
 
     else:
 
@@ -391,7 +404,9 @@ def run_sharded(
     def deliver_imp_sharded(channels, d, is_extra, choice, offs):
         zero = jnp.zeros((), channels.dtype)
         lat = jnp.where(is_extra[None, :], zero, channels)
-        inbox = halo_mod.deliver_halo(lat, d, imp_plan, NODE_AXIS)
+        inbox = halo_mod.deliver_halo(
+            lat, d, imp_plan, NODE_AXIS, batched=cfg.overlap_collectives
+        )
         choice_eff = jnp.where(is_extra, choice, jnp.int32(-1))
         ext = jnp.where(is_extra[None, :], channels, zero)
         # Pool rolls accumulate INTO the lattice inbox (not into a separate
@@ -715,6 +730,12 @@ def run_sharded(
     def _chunk_args(health, round_end):
         pre = (health,) if sentinel else ()
         return pre + (rep_put(np.int32(round_end)), kd_dev) + topo_args
+
+    if probe is not None:
+        return probe(chunk_sharded, (
+            state0, rnd0, done0_dev,
+            *_chunk_args(health0, min(start_round + 1, cfg.max_rounds)),
+        ))
 
     t0 = time.perf_counter()
     # Warmup runs ONE real round and DISCARDS the result — the timed loop

@@ -14,6 +14,12 @@ rm -f /tmp/_t1.log
 # override the var to 0) and by the chaos CI job.
 export GOSSIP_TPU_STRICT_ENGINE=1
 
+# Comm-volume pins ride inside the suite below (tests/test_comm_audit.py:
+# collectives per round/super-step traced from the real jitted chunks —
+# the batched-wire contract of ISSUE 5 fails here on CPU, no TPU needed);
+# the human-readable table is the CI bench-smoke artifact
+# (`python benchmarks/comm_audit.py`).
+
 print_dots() {
   echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log 2>/dev/null | tr -cd . | wc -c)"
 }

@@ -1,0 +1,108 @@
+"""Comm-audit pins (benchmarks/comm_audit.py): collectives per round /
+super-step, counted from the TRACED chunk program — a comm-volume
+regression fails here on CPU without needing a TPU.
+
+The tentpole pin: with the overlap schedule on (the default), the batched
+halo wire is exactly ONE ppermute pair per super-step — down from one pair
+per plane (compositions) / one ppermute per offset class (chunked halo
+delivery) — and the verdict psum stays exactly one per super-step (it is
+deferred, not duplicated). The engines' probe hook traces the real jitted
+chunk, so these counts cannot drift from what runs.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from benchmarks.comm_audit import audit_engine  # noqa: E402
+
+
+def test_chunked_halo_wire_counts():
+    # torus3d has 10 offset classes (lattice +/-1, +/-g, +/-g^2 and their
+    # wrap variants): per-class = 10 ppermutes per round, batched = 1 pair.
+    for algo in ("gossip", "push-sum"):
+        on = audit_engine("sharded", "torus3d", algo, 4096, 8, True)
+        off = audit_engine("sharded", "torus3d", algo, 4096, 8, False)
+        assert on.body_count("ppermute") == 2, on.counts
+        assert off.body_count("ppermute") == 10, off.counts
+        assert on.body_count("psum") == off.body_count("psum") == 1
+        # Same bytes on the wire — batching changes packaging, not payload.
+        assert on.body_bytes("ppermute") == off.body_bytes("ppermute")
+
+
+def test_chunked_scatter_fallback_counts():
+    # Non-divisible ring: no halo plan -> scatter + ONE reduce-scatter per
+    # round on either schedule (wire batching does not apply).
+    for ov in (True, False):
+        r = audit_engine("sharded", "ring", "gossip", 1001, 8, ov)
+        assert r.body_count("reduce_scatter") == 1, r.counts
+        assert r.body_count("ppermute") == 0
+
+
+def test_chunked_pool_roll_counts():
+    # Pool-roll delivery: K=4 dynamic rolls x log2(8)+1 ppermute stages,
+    # schedule-invariant (dynamic rolls cannot be statically packed) —
+    # audited so a regression in the roll decomposition is visible.
+    for ov in (True, False):
+        r = audit_engine(
+            "sharded", "full", "push-sum", 1024, 8, ov,
+            {"delivery": "pool"},
+        )
+        assert r.body_count("ppermute") == 16, r.counts
+        assert r.body_count("psum") == 1
+
+
+def test_fused_sharded_batched_wire_counts():
+    cfg = {"engine": "fused", "chunk_rounds": 8}
+    on = audit_engine(
+        "fused-sharded", "torus3d", "push-sum", 131072, 2, True, cfg
+    )
+    off = audit_engine(
+        "fused-sharded", "torus3d", "push-sum", 131072, 2, False, cfg
+    )
+    # Batched: one pair for all 4 push-sum planes; serial: a pair per plane.
+    assert on.body_count("ppermute") == 2, on.counts
+    assert off.body_count("ppermute") == 8, off.counts
+    # Verdict psum: one per super-step either way (deferred, not removed).
+    assert on.body_count("psum") == off.body_count("psum") == 1
+    # Per-dispatch setup: batched = one pre-loop state exchange pair + one
+    # drain psum + one pair for the round-invariant disp/deg planes;
+    # serial extends disp/deg per plane (max_deg+1 pairs, no drain).
+    assert on.setup_count("ppermute") == 4
+    assert on.setup_count("psum") == 1
+    assert off.setup_count("ppermute") == 14
+
+
+def test_hbm_sharded_batched_wire_counts():
+    # The 2.30x offender (ISSUE 5): the HBM-streaming composition's
+    # super-step must issue exactly ONE batched ppermute pair.
+    cfg = {"engine": "fused", "chunk_rounds": 8}
+    on = audit_engine(
+        "hbm-sharded", "torus3d", "push-sum", 125000, 2, True, cfg
+    )
+    off = audit_engine(
+        "hbm-sharded", "torus3d", "push-sum", 125000, 2, False, cfg
+    )
+    assert on.body_count("ppermute") == 2, on.counts
+    assert off.body_count("ppermute") == 8, off.counts
+    assert on.body_count("psum") == off.body_count("psum") == 1
+    assert on.setup_count("ppermute") == 2  # pre-loop exchange only
+    assert on.setup_count("psum") == 1  # the drain
+
+
+def test_fused_pool_sharded_batched_gather_counts():
+    cfg = {"engine": "fused", "delivery": "pool"}
+    for algo, per_plane in (("gossip", 3), ("push-sum", 4)):
+        on = audit_engine(
+            "fused-pool-sharded", "full", algo, 131072, 2, True, cfg
+        )
+        off = audit_engine(
+            "fused-pool-sharded", "full", algo, 131072, 2, False, cfg
+        )
+        assert on.body_count("all_gather") == 1, on.counts
+        assert off.body_count("all_gather") == per_plane, off.counts
+        # The composition's verdict is replicated in-kernel: no reduction
+        # collective exists on either schedule.
+        assert on.body_count("psum") == off.body_count("psum") == 0
+        assert on.body_bytes("all_gather") == off.body_bytes("all_gather")

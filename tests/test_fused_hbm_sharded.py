@@ -161,6 +161,39 @@ def test_pushsum_global_exact_vs_chunked_sharded():
     assert b.converged_count == N
 
 
+def test_overlap_on_off_bitwise_fixed_rounds():
+    # Batched wires + deferred verdict vs the serial schedule on the HBM
+    # streaming composition: fixed-round push-sum state must be bitwise
+    # schedule-invariant (pure scheduling, same kernel operands).
+    topo = build_topology("torus3d", N)
+    final, res = {}, {}
+    for ov in (True, False):
+        cfg = SimConfig(n=N, topology="torus3d", algorithm="push-sum",
+                        engine="fused", n_devices=2, chunk_rounds=8,
+                        max_rounds=16, overlap_collectives=ov)
+        res[ov] = _hbm_run(topo, cfg, _mesh2(), on_chunk=_grab(final, ov))
+    assert res[True].rounds == res[False].rounds == 16
+    for f in ("s", "w", "term", "conv"):
+        a = np.asarray(getattr(final[True], f))
+        b = np.asarray(getattr(final[False], f))
+        assert (a == b).all(), f
+
+
+def test_overlap_deferred_verdict_converging_run():
+    # A converging gossip run through the deferred-verdict loop: rounds and
+    # counts must match the serial schedule exactly (mid-dispatch fire).
+    topo = build_topology("torus3d", N)
+    res = {}
+    for ov in (True, False):
+        cfg = SimConfig(n=N, topology="torus3d", algorithm="gossip",
+                        engine="fused", n_devices=2, chunk_rounds=8,
+                        max_rounds=3000, overlap_collectives=ov)
+        res[ov] = _hbm_run(topo, cfg, _mesh2())
+    assert res[True].converged and res[False].converged
+    assert res[True].rounds == res[False].rounds
+    assert res[True].converged_count == res[False].converged_count
+
+
 def test_resume_midway():
     topo = build_topology("torus3d", N)
     cfg = SimConfig(n=N, topology="torus3d", algorithm="gossip",

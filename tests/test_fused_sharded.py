@@ -152,6 +152,45 @@ def test_pushsum_global_exact_vs_chunked_sharded():
     assert b.converged_count == N
 
 
+def test_overlap_deferred_verdict_exact_rounds_and_state():
+    # The overlapped schedule (parallel/overlap.py) on a CONVERGING run:
+    # the verdict psum is deferred one super-step and resolved mid-dispatch
+    # (stride = CR*8, so the fire is interior), yet rounds, outcome, and
+    # the final planes must be bitwise the serial schedule's — the
+    # double-buffer rollback discards the speculative super-step unobserved.
+    topo = build_topology("torus3d", N)
+    final, res = {}, {}
+    for ov in (True, False):
+        cfg = SimConfig(n=N, topology="torus3d", algorithm="gossip",
+                        engine="fused", n_devices=2, chunk_rounds=8,
+                        max_rounds=3000, overlap_collectives=ov)
+        res[ov] = run_fused_sharded(topo, cfg, mesh=make_mesh(2),
+                                    on_chunk=_grab(final, ov))
+    assert res[True].converged and res[False].converged
+    assert res[True].rounds == res[False].rounds
+    assert res[True].outcome == res[False].outcome
+    for f in ("count", "active", "conv"):
+        a = np.asarray(getattr(final[True], f))
+        b = np.asarray(getattr(final[False], f))
+        assert (a == b).all(), f
+
+
+def test_overlap_stall_watchdog_unchanged():
+    # Stall-watchdog runs consult retired boundaries; under the overlapped
+    # schedule the retired planes are the rolled-back exact states, so the
+    # watchdog must fire at the identical boundary with outcome="stalled".
+    topo = build_topology("torus3d", N)
+    res = {}
+    for ov in (True, False):
+        cfg = SimConfig(n=N, topology="torus3d", algorithm="gossip",
+                        engine="fused", n_devices=2, chunk_rounds=2,
+                        rumor_threshold=10**6, stall_chunks=2,
+                        max_rounds=400, overlap_collectives=ov)
+        res[ov] = run_fused_sharded(topo, cfg, mesh=make_mesh(2))
+    assert res[True].outcome == res[False].outcome == "stalled"
+    assert res[True].rounds == res[False].rounds
+
+
 def test_gossip_grid2d_cr1_bitwise():
     # Non-wrap lattice: the engine's blend handles boundary-truncated
     # displacement classes too, not just wrap topologies.

@@ -228,6 +228,69 @@ def test_sharded_stencil_request_fails_loudly_without_plan():
         run(topo, cfg)
 
 
+@pytest.mark.slow
+def test_two_process_batched_wire_matches_per_class_bitwise(tmp_path):
+    # Batched vs per-class halo wires over REAL two-OS-process gloo
+    # collectives (the packed ppermute pair crosses the process boundary):
+    # both schedules must reproduce the single-process mesh bit-for-bit —
+    # gossip state is integer and the random stream is process-count-
+    # invariant, so rounds and converged counts pin the delivery exactly.
+    import json
+    import os
+    import subprocess
+    import sys as _sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parents[1]
+    n = 4096  # 16^3 torus: halo delivery, 10 offset classes
+    ref = run_sharded(
+        build_topology("torus3d", n),
+        SimConfig(n=n, topology="torus3d", algorithm="gossip", n_devices=8),
+        mesh=make_mesh(8),
+    )
+    assert ref.converged
+
+    def pair(overlap: str, port: int):
+        outs = [tmp_path / f"{overlap}{pid}.jsonl" for pid in range(2)]
+        env = {k: v for k, v in os.environ.items()
+               if k not in ("PYTHONPATH", "JAX_PLATFORMS", "XLA_FLAGS")}
+        env["PYTHONPATH"] = str(repo)
+        env["JAX_PLATFORMS"] = "cpu"
+        procs = [
+            subprocess.Popen(
+                [_sys.executable, "-m", "cop5615_gossip_protocol_tpu",
+                 str(n), "torus3d", "gossip", "--platform", "cpu",
+                 "--devices", "8", "--overlap-collectives", overlap,
+                 "--coordinator", f"127.0.0.1:{port}",
+                 "--num-processes", "2", "--process-id", str(pid),
+                 "--jsonl", str(outs[pid])],
+                cwd=repo, env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            )
+            for pid in range(2)
+        ]
+        try:
+            logs = [p.communicate(timeout=300)[0].decode(errors="replace")
+                    for p in procs]
+        finally:
+            # A hung coordinator barrier (one child dead at startup) must
+            # not leak the survivor holding the port across test runs.
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        if any("aren't implemented on the CPU backend" in s for s in logs):
+            pytest.skip("this jaxlib's CPU backend has no multiprocess "
+                        "collectives")
+        assert all(p.returncode == 0 for p in procs), logs
+        return json.loads(outs[0].read_text().splitlines()[-1])
+
+    base = 21000 + (os.getpid() + 616) % 9000
+    for i, overlap in enumerate(("on", "off")):
+        rec = pair(overlap, base + i)
+        assert rec["rounds"] == ref.rounds, overlap
+        assert rec["converged_count"] == ref.converged_count, overlap
+
+
 def test_ring_padded_auto_falls_back_to_scatter():
     # No exact halo plan (wrap edges + padding) → auto silently uses the
     # scatter + psum_scatter path and still converges on real nodes only.

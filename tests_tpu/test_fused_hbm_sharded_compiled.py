@@ -53,23 +53,34 @@ def test_compiled_hbm_sharded_gossip_bitwise_vs_single_device():
 # runlog chip (v5e-1):
 #   r5 engines as first committed: 1.23x (10.0 vs 8.1 ms/round at 2^24,
 #     CR=64 x 256 rounds) — the original 1.35x budget dates from here.
-#   r5 engines as now in-tree (post stencil_hbm one-sweep redesign): 2.30x
-#     measured — the single-device engine got ~2x faster and the
-#     composition's per-super-step halo assembly + state round-trip did
-#     not, so the RATIO grew while both absolute numbers improved.
-# Default budget = measured + noise headroom. Override without editing the
-# repo (e.g. on a different chip generation) via
+#   r5 engines post stencil_hbm one-sweep redesign: 2.30x measured — the
+#     single-device engine got ~2x faster and the composition's
+#     per-super-step halo assembly + state round-trip did not, so the
+#     RATIO grew while both absolute numbers improved; PR 1 papered over
+#     it by relaxing the default budget to 2.5x.
+#   ISSUE 5 overlap schedule (parallel/overlap.py): batched single-pair
+#     halo wires (8 ppermutes/super-step -> 2, comm_audit-pinned on CPU),
+#     double-buffered ring, termination psum deferred under the next
+#     super-step's kernel — the serialized-collective overhead that grew
+#     the ratio is off the critical path, so the default budget returns to
+#     the <=1.5x class. NOT yet re-measured on chip (no TPU session in the
+#     authoring container): first on-chip run should record the measured
+#     ratio in tests_tpu/RUNLOG.md + BENCH_TABLES.md and tighten further
+#     toward the r5 1.23x class if it holds.
+# Default budget = target class + noise headroom. Override without editing
+# the repo (e.g. on a different chip generation, or to compare the serial
+# schedule via --overlap-collectives off) via
 # GOSSIP_TPU_HBM_SHARDED_BUDGET=<float>.
 HBM_SHARDED_RATIO_BUDGET = float(
-    os.environ.get("GOSSIP_TPU_HBM_SHARDED_BUDGET", "2.5")
+    os.environ.get("GOSSIP_TPU_HBM_SHARDED_BUDGET", "1.5")
 )
 
 
 def test_compiled_hbm_sharded_pushsum_throughput_class():
-    # Regression tripwire, not an aspiration: the budget tracks the
-    # MEASURED ratio (see HBM_SHARDED_RATIO_BUDGET above) so the suite is
-    # honest about where the composition stands; closing the gap back
-    # toward the r5 1.23x class is an open ROADMAP item, not a test.
+    # Regression tripwire tracking the overlap schedule's throughput class
+    # (see HBM_SHARDED_RATIO_BUDGET above); the comm-volume half of the
+    # contract — one batched ppermute pair per super-step — is pinned
+    # hardware-free by tests/test_comm_audit.py.
     topo = build_topology("torus3d", N)
     cfg = SimConfig(n=N, topology="torus3d", algorithm="push-sum",
                     engine="fused", chunk_rounds=64, max_rounds=256)
@@ -81,3 +92,18 @@ def test_compiled_hbm_sharded_pushsum_throughput_class():
     assert per_shard < per_single * HBM_SHARDED_RATIO_BUDGET, (
         per_shard, per_single, HBM_SHARDED_RATIO_BUDGET,
     )
+
+
+def test_compiled_hbm_sharded_overlap_on_off_equivalent():
+    # The overlap schedule is pure scheduling: compiled on-chip gossip
+    # counts must be identical with it on and off (the CPU interpret suite
+    # pins full bitwise state; this is the compiled-kernel smoke).
+    topo = build_topology("torus3d", N)
+    res = {}
+    for ov in (True, False):
+        cfg = SimConfig(n=N, topology="torus3d", algorithm="gossip",
+                        engine="fused", chunk_rounds=16, max_rounds=64,
+                        overlap_collectives=ov)
+        res[ov] = run_stencil_hbm_sharded(topo, cfg, mesh=make_mesh(1))
+    assert res[True].rounds == res[False].rounds
+    assert res[True].converged_count == res[False].converged_count
