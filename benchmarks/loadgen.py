@@ -1,0 +1,588 @@
+"""Closed-loop load harness for the serving plane (ISSUE 6 / ROADMAP item 2).
+
+Spawns ``serve.py`` as a real OS process, drives it with N closed-loop
+HTTP clients (each client keeps exactly one request in flight over a
+persistent connection — classic closed-loop load, so offered load adapts
+to service capacity instead of queueing unboundedly), replays a mixed
+small-N request trace spanning several key buckets, and reports
+throughput + p50/p99 latency.
+
+``--smoke`` is the CI serve-smoke contract (env-overridable pins):
+
+  1. correctness: every response demultiplexes a valid telemetry
+     trajectory (row count == rounds, last row's converged count == the
+     result's) across >= 2 distinct buckets;
+  2. throughput: sustained closed-loop requests/s >=
+     GOSSIP_TPU_SERVE_RPS_FLOOR (default 1000) with p99 latency <=
+     GOSSIP_TPU_SERVE_P99_MS (default 250 ms);
+  3. batching beats a batching-off control (--no-batching server, same
+     trace/clients) by >= GOSSIP_TPU_SERVE_BATCH_RATIO (default 1.3x);
+  4. /stats counters add up (admission identities, admission.py) and the
+     server shuts down cleanly (SIGINT -> exit 0 with a final stats line).
+
+Default mode runs the same phases with longer windows and no hard pins —
+the BENCH_TABLES.md "Serving plane" row generator
+(``python benchmarks/loadgen.py --md serving.md --json serving.json``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+# Mixed small-N trace: three distinct key buckets (topology/algorithm
+# axes), all fast-converging small configs — the many-users regime the
+# serving plane multiplexes. Seeds are assigned per request. The push-sum
+# cell uses a load-test-grade delta (1e-3, ~40 rounds) and scatter
+# delivery — the tight default delta's ~300-round straggler tail is an
+# engine property, not a serving-plane one, and this harness measures the
+# serving plane.
+MIXED_SMALL_TRACE = (
+    {"n": 32, "topology": "full", "algorithm": "gossip",
+     "params": {"rumor_threshold": 5}},
+    {"n": 36, "topology": "grid2d", "algorithm": "gossip",
+     "params": {"rumor_threshold": 3}},
+    {"n": 32, "topology": "full", "algorithm": "push-sum",
+     "params": {"delta": 3e-3, "term_rounds": 1}},
+)
+
+
+def _env_float(name: str, default: float) -> float:
+    return float(os.environ.get(name, "") or default)
+
+
+def pick_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class ServerProc:
+    """One serve.py OS process: spawn, await readiness, drive, shut down
+    cleanly (SIGINT -> exit 0)."""
+
+    def __init__(self, extra_args=(), platform: str = "cpu",
+                 window_ms: float = 3.0, max_lanes: int = 64):
+        self.port = pick_port()
+        cmd = [
+            sys.executable, str(REPO / "serve.py"),
+            "--port", str(self.port),
+            "--platform", platform,
+            "--window-ms", str(window_ms),
+            "--max-lanes", str(max_lanes),
+            *extra_args,
+        ]
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", platform if platform != "auto" else "")
+        self.proc = subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, cwd=str(REPO), env=env,
+        )
+        self.host = "127.0.0.1"
+        self.jsonl_port = -1
+        self._tail: list = []
+        self._await_ready()
+
+    def _await_ready(self, timeout_s: float = 120.0) -> None:
+        deadline = time.monotonic() + timeout_s
+        ready = False
+        # serve.py prints "SERVING host port" once the socket is bound.
+        while time.monotonic() < deadline:
+            line = self.proc.stdout.readline()
+            if not line:
+                raise RuntimeError(
+                    "server exited before readiness: "
+                    + "".join(self._tail[-20:])
+                )
+            self._tail.append(line)
+            if line.startswith("SERVING "):
+                parts = line.split()
+                if len(parts) >= 4:
+                    self.jsonl_port = int(parts[3])
+                ready = True
+                break
+        if not ready:
+            raise RuntimeError("server never printed SERVING line")
+        # Drain stdout in the background so the server never blocks on a
+        # full pipe; the final stats line is captured for shutdown checks.
+        self._drain = threading.Thread(target=self._drain_stdout, daemon=True)
+        self._drain.start()
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            try:
+                conn = http.client.HTTPConnection(self.host, self.port,
+                                                  timeout=5)
+                conn.request("GET", "/healthz")
+                if conn.getresponse().status == 200:
+                    conn.close()
+                    return
+            except OSError:
+                time.sleep(0.05)
+        raise RuntimeError("server /healthz never came up")
+
+    def _drain_stdout(self) -> None:
+        for line in self.proc.stdout:
+            self._tail.append(line)
+            if len(self._tail) > 200:
+                del self._tail[:100]
+
+    def stats(self) -> dict:
+        conn = http.client.HTTPConnection(self.host, self.port, timeout=30)
+        conn.request("GET", "/stats")
+        out = json.loads(conn.getresponse().read())
+        conn.close()
+        return out
+
+    def shutdown(self) -> dict:
+        """SIGINT, await exit, assert rc == 0, return the final stats
+        record the server prints on the way out."""
+        self.proc.send_signal(signal.SIGINT)
+        rc = self.proc.wait(timeout=60)
+        if self._drain is not None:
+            self._drain.join(timeout=10)
+        if rc != 0:
+            raise RuntimeError(
+                f"server exited rc={rc}: " + "".join(self._tail[-20:])
+            )
+        for line in reversed(self._tail):
+            if line.startswith("{"):
+                rec = json.loads(line)
+                if "server-stats" in rec:
+                    return rec["server-stats"]
+        raise RuntimeError("server printed no final stats line")
+
+
+class ClosedLoopClient(threading.Thread):
+    """One closed-loop client: request -> wait -> next, over a persistent
+    connection. ``transport`` picks the wire: "jsonl" (the socket
+    transport — the throughput phases) or "http" (POST /run keep-alive —
+    the correctness phase exercises the HTTP front too). Latencies are
+    per-request wall seconds."""
+
+    def __init__(self, host, port, trace, seed0: int, deadline: float,
+                 max_requests: int | None = None, telemetry: bool = False,
+                 transport: str = "jsonl", users: int = 1):
+        super().__init__(daemon=True)
+        self.host, self.port = host, port
+        self.trace = trace
+        self.seed0 = seed0
+        self.deadline = deadline
+        self.max_requests = max_requests
+        self.telemetry = telemetry
+        self.transport = transport
+        # >1 multiplexes this many closed-loop USERS over one connection
+        # via the {"requests": [...]} envelope (jsonl transport only): one
+        # socket/JSON round trip per wave carries every user's next
+        # request — the client shape that keeps transport overhead off the
+        # serving plane's ledger.
+        self.users = users
+        self.latencies: list = []
+        self.responses: list = []
+        self.errors: list = []
+
+    def _body(self, i: int, user: int = 0) -> dict:
+        # Each user walks the trace at its own offset so one wave spans
+        # every bucket (they co-batch server-side).
+        body = dict(self.trace[(i + user) % len(self.trace)])
+        body["schema_version"] = 1
+        body["seed"] = self.seed0 + 10_000 * user + i
+        if self.telemetry:
+            body["telemetry"] = True
+        return body
+
+    def _run_http(self) -> None:
+        conn = http.client.HTTPConnection(self.host, self.port, timeout=120)
+        i = 0
+        while time.monotonic() < self.deadline:
+            if self.max_requests is not None and i >= self.max_requests:
+                break
+            body = self._body(i)
+            t0 = time.monotonic()
+            try:
+                conn.request(
+                    "POST", "/run", json.dumps(body),
+                    {"Content-Type": "application/json"},
+                )
+                resp = conn.getresponse()
+                payload = json.loads(resp.read())
+                status = resp.status
+            except OSError as e:
+                self.errors.append(f"{type(e).__name__}: {e}")
+                conn.close()
+                conn = http.client.HTTPConnection(self.host, self.port,
+                                                  timeout=120)
+                continue
+            self._record(t0, status, payload)
+            i += 1
+        conn.close()
+
+    def _run_jsonl(self) -> None:
+        sock = socket.create_connection((self.host, self.port), timeout=120)
+        rfile = sock.makefile("rb")
+        i = 0
+        try:
+            while time.monotonic() < self.deadline:
+                if self.max_requests is not None and i >= self.max_requests:
+                    break
+                if self.users > 1:
+                    wave = {"requests": [
+                        self._body(i, u) for u in range(self.users)
+                    ]}
+                else:
+                    wave = self._body(i)
+                t0 = time.monotonic()
+                sock.sendall(json.dumps(wave).encode() + b"\n")
+                line = rfile.readline()
+                if not line:
+                    self.errors.append("jsonl connection closed")
+                    break
+                payload = json.loads(line)
+                if self.users > 1:
+                    lat = time.monotonic() - t0
+                    members = payload.get("responses")
+                    if not payload.get("ok") or not isinstance(members, list):
+                        self.errors.append(f"bad envelope: {str(payload)[:200]}")
+                    else:
+                        for m in members:
+                            self.latencies.append(lat)
+                            if m.get("status") != 200 or not m.get("ok"):
+                                self.errors.append(
+                                    f"status {m.get('status')}: "
+                                    f"{str(m)[:200]}"
+                                )
+                            elif self.telemetry or len(self.responses) < 64:
+                                self.responses.append(m)
+                else:
+                    self._record(t0, payload.get("status", 0), payload)
+                i += 1
+        finally:
+            rfile.close()
+            sock.close()
+
+    def _record(self, t0: float, status: int, payload: dict) -> None:
+        self.latencies.append(time.monotonic() - t0)
+        if status != 200 or not payload.get("ok"):
+            self.errors.append(f"status {status}: {str(payload)[:200]}")
+        elif self.telemetry or len(self.responses) < 64:
+            self.responses.append(payload)
+
+    def run(self) -> None:
+        if self.transport == "jsonl":
+            self._run_jsonl()
+        else:
+            self._run_http()
+
+
+def drive(server: ServerProc, clients: int, duration_s: float,
+          trace=MIXED_SMALL_TRACE, max_requests_per_client=None,
+          telemetry: bool = False, transport: str = "jsonl",
+          conns: int | None = None) -> dict:
+    """Run one closed-loop phase with ``clients`` total users spread over
+    ``conns`` connections (threads); returns aggregate throughput/latency.
+    """
+    port = server.jsonl_port if transport == "jsonl" else server.port
+    if transport == "jsonl" and server.jsonl_port < 0:
+        transport, port = "http", server.port
+    if conns is None or transport == "http":
+        conns = clients
+    conns = min(conns, clients)
+    base, extra = divmod(clients, conns)
+    deadline = time.monotonic() + duration_s
+    pool = [
+        ClosedLoopClient(
+            server.host, port, trace, seed0=1_000_000 * (c + 1),
+            deadline=deadline, max_requests=max_requests_per_client,
+            telemetry=telemetry, transport=transport,
+            users=base + (1 if c < extra else 0),
+        )
+        for c in range(conns)
+    ]
+    t0 = time.monotonic()
+    for c in pool:
+        c.start()
+    for c in pool:
+        c.join(timeout=duration_s + 300)
+    elapsed = time.monotonic() - t0
+    lat = sorted(x for c in pool for x in c.latencies)
+    errors = [e for c in pool for e in c.errors]
+    responses = [r for c in pool for r in c.responses]
+    n = len(lat)
+    from cop5615_gossip_protocol_tpu.serving.admission import percentile
+
+    return {
+        "clients": clients,
+        "elapsed_s": elapsed,
+        "requests": n,
+        "errors": len(errors),
+        "error_samples": errors[:5],
+        "rps": n / elapsed if elapsed > 0 else 0.0,
+        "p50_ms": 1e3 * percentile(lat, 0.50) if lat else None,
+        "p99_ms": 1e3 * percentile(lat, 0.99) if lat else None,
+        "responses": responses,
+    }
+
+
+def check_telemetry_responses(responses: list) -> int:
+    """Every telemetry response must demultiplex a valid per-request
+    trajectory: one row per executed round, final converged count matching
+    the result. Returns the number checked."""
+    checked = 0
+    for r in responses:
+        res = r["result"]
+        traj = r.get("telemetry")
+        assert traj is not None and len(traj) > 0, f"no telemetry in {r}"
+        assert len(traj) == res["rounds"], (len(traj), res["rounds"])
+        assert traj[-1]["converged_count"] == res["converged_count"], r
+        assert res["outcome"] == "converged", r
+        assert traj[-1]["rounds"] == res["rounds"]
+        checked += 1
+    return checked
+
+
+def check_stats(stats: dict, min_buckets: int = 2) -> None:
+    """The /stats identities the admission counters promise."""
+    assert stats["received"] == (
+        stats["admitted"] + stats["rejected"] + stats["invalid"]
+    ), stats
+    assert stats["admitted"] == (
+        stats["completed"] + stats["failed"] + stats["in_flight"]
+    ), stats
+    assert stats["batched_requests"] == (
+        stats["completed"] + stats["failed"]
+    ), stats
+    assert len(stats["buckets"]) >= min_buckets, stats["buckets"]
+
+
+def fmt_row(label: str, phase: dict, extra: str = "") -> str:
+    return (
+        f"| {label} | {phase['clients']} | {phase['requests']:,} "
+        f"| {phase['rps']:,.0f} | {phase['p50_ms']:.1f} "
+        f"| {phase['p99_ms']:.1f} | {extra} |"
+    )
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--url", default=None,
+                    help="drive an already-running server (host:port) "
+                    "instead of spawning serve.py; skips the control phase "
+                    "and shutdown checks")
+    ap.add_argument("--platform", choices=["auto", "cpu", "tpu"],
+                    default="cpu")
+    ap.add_argument("--clients", type=int, default=128,
+                    help="total closed-loop users")
+    ap.add_argument("--conns", type=int, default=4,
+                    help="connections (threads) the users multiplex over "
+                    "via the JSONL batch envelope")
+    ap.add_argument("--trials", type=int, default=2,
+                    help="throughput-phase trials; the best is reported "
+                    "(min-over-trials rejects scheduler-noise outliers)")
+    ap.add_argument("--duration", type=float, default=10.0,
+                    help="throughput-phase seconds")
+    ap.add_argument("--control-duration", type=float, default=None,
+                    help="batching-off control seconds (default: duration)")
+    ap.add_argument("--window-ms", type=float, default=3.0)
+    ap.add_argument("--max-lanes", type=int, default=32,
+                    help="server-side batch width cap (32 keeps the "
+                    "per-bucket compiled-width count at two on this "
+                    "trace's occupancies)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI serve-smoke: shorter phases, HARD pins on "
+                    "rps/p99/batching-ratio/stats (env-overridable)")
+    ap.add_argument("--md", type=str, default=None,
+                    help="write the latency table as markdown here")
+    ap.add_argument("--json", type=str, default=None,
+                    help="write the raw phase records as JSON here")
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        args.duration = min(args.duration, 8.0)
+    control_duration = args.control_duration or args.duration
+
+    rps_floor = _env_float("GOSSIP_TPU_SERVE_RPS_FLOOR", 1000.0)
+    p99_ms_bound = _env_float("GOSSIP_TPU_SERVE_P99_MS", 250.0)
+    ratio_floor = _env_float("GOSSIP_TPU_SERVE_BATCH_RATIO", 1.3)
+
+    record: dict = {"trace_buckets": len(MIXED_SMALL_TRACE)}
+    trace_desc = ", ".join(
+        f"{t['algorithm']}/{t['topology']}/n{t['n']}"
+        for t in MIXED_SMALL_TRACE
+    )
+    lines = [
+        "## Serving plane (benchmarks/loadgen.py closed loop)",
+        "",
+        f"Mixed small-N trace, {len(MIXED_SMALL_TRACE)} key buckets "
+        f"({trace_desc}); {args.clients} closed-loop users over "
+        f"{args.conns} JSONL-socket connections (telemetry phase rides "
+        "HTTP POST /run).",
+        "",
+        "| phase | clients | requests | req/s | p50 ms | p99 ms | note |",
+        "|---|---|---|---|---|---|---|",
+    ]
+
+    if args.url:
+        host, port = args.url.rsplit(":", 1)
+
+        class _Remote:
+            jsonl_port = -1  # remote JSONL port unknown: phases ride HTTP
+
+            def stats(self):
+                return ServerProc.stats(self)
+
+        server = _Remote()
+        server.host, server.port = host.replace("http://", ""), int(port)
+    else:
+        print(f"[loadgen] spawning serve.py (platform={args.platform}, "
+              f"window={args.window_ms}ms, lanes={args.max_lanes})",
+              flush=True)
+        server = ServerProc(
+            platform=args.platform, window_ms=args.window_ms,
+            max_lanes=args.max_lanes,
+        )
+
+    # Phase 0 — warm: populate the warm-engine pool for every bucket and
+    # lane WIDTH the measured phases can hit (compiles are a property of
+    # process start, not steady-state serving — without the ladder, a
+    # first-occupancy-of-this-width batch mid-phase would eat a multi-
+    # second trace+compile and pollute p99). Client counts are chosen so
+    # synchronized-bucket occupancy lands in each power-of-two width
+    # between the server's min_lanes floor (8) and max_lanes.
+    ladder, w = [], 8
+    while w < args.clients:
+        ladder.append(w)
+        w *= 2
+    ladder.append(args.clients)
+    warm_total = 0
+    for w in ladder:
+        warm = drive(server, clients=w, conns=min(args.conns, w),
+                     duration_s=120.0, max_requests_per_client=3)
+        warm_total += warm["requests"]
+        if warm["errors"]:
+            raise AssertionError(
+                f"warm phase errors: {warm['error_samples']}"
+            )
+    print(f"[loadgen] warm: {warm_total} requests over user ladder "
+          f"{ladder}, 0 errors", flush=True)
+
+    # Phase 1 — correctness: telemetry demux on every response, over the
+    # HTTP front (the throughput phases ride the JSONL socket — this
+    # phase keeps POST /run honest too).
+    tele = drive(server, clients=4, duration_s=120.0,
+                 max_requests_per_client=6, telemetry=True,
+                 transport="http")
+    checked = check_telemetry_responses(tele["responses"])
+    print(f"[loadgen] telemetry demux: {checked} responses valid",
+          flush=True)
+    record["telemetry_checked"] = checked
+    lines.append(fmt_row("telemetry demux", tele, "every response checked"))
+
+    # Phase 2 — throughput (batched), best of N trials.
+    batched = None
+    for trial in range(max(args.trials, 1)):
+        t = drive(server, clients=args.clients, conns=args.conns,
+                  duration_s=args.duration)
+        print(f"[loadgen] batched trial {trial + 1}: {t['rps']:,.0f} req/s "
+              f"(p50 {t['p50_ms']:.1f} ms, p99 {t['p99_ms']:.1f} ms, "
+              f"{t['errors']} errors)", flush=True)
+        if batched is None or t["rps"] > batched["rps"]:
+            batched = t
+    print(f"[loadgen] batched best: {batched['rps']:,.0f} req/s "
+          f"(p50 {batched['p50_ms']:.1f} ms, p99 {batched['p99_ms']:.1f} "
+          f"ms)", flush=True)
+    record["batched"] = {k: v for k, v in batched.items() if k != "responses"}
+    lines.append(fmt_row("batched", batched, "micro-batcher on"))
+
+    stats = server.stats()
+    check_stats(stats, min_buckets=2)
+    record["stats"] = stats
+    print(f"[loadgen] stats ok: {stats['batches']} batches, "
+          f"occupancy mean {stats['batch_occupancy_mean']:.1f}, "
+          f"buckets {list(stats['buckets'])}", flush=True)
+
+    ratio = None
+    if not args.url:
+        final_stats = server.shutdown()
+        check_stats(final_stats, min_buckets=2)
+        print("[loadgen] clean shutdown (rc=0, final stats consistent)",
+              flush=True)
+
+        # Phase 3 — control: identical trace/clients, batching OFF.
+        print("[loadgen] spawning --no-batching control", flush=True)
+        control_server = ServerProc(
+            extra_args=("--no-batching",), platform=args.platform,
+            window_ms=args.window_ms, max_lanes=args.max_lanes,
+        )
+        cwarm = drive(control_server, clients=args.clients,
+                      conns=args.conns, duration_s=120.0,
+                      max_requests_per_client=2)
+        if cwarm["errors"]:
+            raise AssertionError(
+                f"control warm errors: {cwarm['error_samples']}"
+            )
+        control = drive(control_server, clients=args.clients,
+                        conns=args.conns, duration_s=control_duration)
+        control_server.shutdown()
+        ratio = (batched["rps"] / control["rps"]) if control["rps"] else None
+        print(f"[loadgen] control (batching off): {control['rps']:,.0f} "
+              f"req/s -> batching speedup {ratio:.2f}x", flush=True)
+        record["control"] = {
+            k: v for k, v in control.items() if k != "responses"
+        }
+        record["batching_ratio"] = ratio
+        lines.append(fmt_row("batching-off control", control,
+                             f"batching speedup {ratio:.2f}x"))
+
+    lines.append("")
+    failures = []
+    if batched["errors"]:
+        failures.append(
+            f"batched phase had {batched['errors']} errors: "
+            f"{batched['error_samples']}"
+        )
+    if args.smoke:
+        if batched["rps"] < rps_floor:
+            failures.append(
+                f"throughput {batched['rps']:,.0f} req/s under the "
+                f"GOSSIP_TPU_SERVE_RPS_FLOOR={rps_floor:,.0f} pin"
+            )
+        if batched["p99_ms"] > p99_ms_bound:
+            failures.append(
+                f"p99 {batched['p99_ms']:.1f} ms over the "
+                f"GOSSIP_TPU_SERVE_P99_MS={p99_ms_bound:.0f} pin"
+            )
+        if ratio is not None and ratio < ratio_floor:
+            failures.append(
+                f"batching speedup {ratio:.2f}x under the "
+                f"GOSSIP_TPU_SERVE_BATCH_RATIO={ratio_floor} pin"
+            )
+
+    if args.md:
+        Path(args.md).write_text("\n".join(lines) + "\n")
+        print(f"[loadgen] wrote {args.md}", flush=True)
+    if args.json:
+        Path(args.json).write_text(json.dumps(record, indent=2))
+        print(f"[loadgen] wrote {args.json}", flush=True)
+    print("\n".join(lines), flush=True)
+
+    if failures:
+        for f in failures:
+            print(f"[loadgen] FAIL: {f}", file=sys.stderr, flush=True)
+        return 1
+    print("[loadgen] all checks passed", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -193,7 +193,12 @@ def _analysis(all_rows: dict, grid_n) -> list[str]:
 def _cell_sweep(n, topology, algorithm, seed, replicas):
     """The 'benchmarks sweep' path: one vmapped dispatch runs all
     ``replicas`` seeds of a grid cell (models/sweep.py buckets same-shape
-    cells by construction — a cell's seeds ARE its bucket)."""
+    cells by construction — a cell's seeds ARE its bucket). Compiled
+    engines come from the warm pool under the canonical engine key
+    (serving/keys.py, seed excluded), so identical-shape cells — and
+    reruns of a cell at a different seed — reuse the live executable
+    instead of retracing; the suite prints the pool's hit/miss tally at
+    the end."""
     from cop5615_gossip_protocol_tpu import SimConfig, build_topology
     from cop5615_gossip_protocol_tpu.config import normalize_topology
     from cop5615_gossip_protocol_tpu.models.sweep import run_replicas
@@ -425,6 +430,10 @@ def generate(out_path: str, seed: int, grid_n, scale_n, platform_note: str,
     )
     lines.append("")
     Path(out_path).write_text("\n".join(lines))
+    from cop5615_gossip_protocol_tpu.serving import pool as pool_mod
+
+    print(f"[suite] warm-engine pool: {pool_mod.default_pool().stats()}",
+          flush=True)
     print(f"[suite] wrote {out_path}")
 
 

@@ -455,6 +455,9 @@ def main(argv: Optional[list[str]] = None) -> int:
             pool_size=args.pool_size,
             engine=args.engine,
             n_devices=args.devices,
+            # Config-level so sweep-engine contracts (e.g. --replicas with
+            # --engine fused) fail HERE, before topology build.
+            replicas=args.replicas,
             # --trace-convergence is the telemetry plane's serializer.
             telemetry=args.telemetry or bool(args.trace_convergence),
         )

@@ -43,6 +43,12 @@ TOPOLOGIES = (
 ALGORITHMS = ("gossip", "push-sum")
 SEMANTICS = ("batched", "reference")
 
+# Replica-sweep / serving-batch lane cap (models/sweep.py re-exports it):
+# bounds the REPLICA_TAG0 fold_in region — see the TAG MAP in ops/faults.py.
+# Lives here so SimConfig.__post_init__ can validate `replicas` without
+# importing the sweep engine.
+MAX_REPLICAS = 4096
+
 _CLI_TOPOLOGY_ALIASES = {
     "line": "line",
     "ring": "ring",
@@ -267,6 +273,13 @@ class SimConfig:
     # Sharding: number of mesh devices for the node dimension; None/1 → single device.
     n_devices: int | None = None
 
+    # Vmapped replica sweep (models/sweep.py, --replicas): run this many
+    # seeds of the configuration as lanes of ONE chunked program. 1 = the
+    # plain single run. A config-level field (not just a CLI flag) so the
+    # sweep engine's support contract fails at CONFIG time — before any
+    # topology build — instead of deep in models/sweep._reject_unsupported.
+    replicas: int = 1
+
     # Push-sum termination criterion. "local" is the reference's own
     # (program.fs:119-137): each node latches converged after term_rounds
     # consecutive sub-delta receipt rounds — local stability, which on
@@ -449,6 +462,50 @@ class SimConfig:
             raise ValueError(
                 f"unknown engine {self.engine!r}; expected auto|chunked|fused"
             )
+        if not (1 <= self.replicas <= MAX_REPLICAS):
+            raise ValueError(
+                f"replicas must be in [1, {MAX_REPLICAS}], got "
+                f"{self.replicas} (the REPLICA_TAG0 fold_in region caps the "
+                "lane count — TAG MAP in ops/faults.py)"
+            )
+        if self.replicas > 1:
+            # The replica sweep vmaps the chunked XLA engines
+            # (models/sweep.py); these contracts used to surface only after
+            # topology build (_reject_unsupported) — fail at config time,
+            # like the revive/crash checks above.
+            if self.engine == "fused":
+                raise ValueError(
+                    "engine='fused' does not apply to replica sweeps: the "
+                    "Pallas tiers opt out of the batch dimension "
+                    "(plan/tiering gate); the sweep always runs the chunked "
+                    "XLA engines — drop the engine override"
+                )
+            if self.semantics == "reference":
+                raise ValueError(
+                    "replica sweeps vmap the batched synchronous-round "
+                    "engines; reference semantics (single-walk push-sum, Q1 "
+                    "population) has no batched replica axis — use batched "
+                    "semantics"
+                )
+            if self.n_devices is not None and self.n_devices > 1:
+                raise ValueError(
+                    "replica sweeps are single-device (the replica axis IS "
+                    "the parallelism); drop n_devices or run replicas "
+                    "unbatched"
+                )
+            if self.stall_chunks:
+                raise ValueError(
+                    "stall_chunks watchdog semantics are per-run; a batched "
+                    "sweep has no single progress gap to watch — run stall "
+                    "diagnostics unbatched"
+                )
+            if self.mass_tolerance is not None:
+                raise ValueError(
+                    "the health sentinel (mass_tolerance) carries one "
+                    "per-run health scalar through the chunk loop; a "
+                    "batched sweep has no per-replica outcome channel for "
+                    "it — run health-sentinel diagnostics unbatched"
+                )
         if (
             self.dtype == "bfloat16"
             and self.algorithm == "push-sum"

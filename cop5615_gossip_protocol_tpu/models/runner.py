@@ -1542,7 +1542,20 @@ def _run_resolved(
     # buffers (zero copies). Off when retired state must stay readable —
     # chunk hooks and the stall watchdog (models/pipeline.py docstring).
     donate = on_chunk is None and not cfg.stall_chunks
-    chunk_j = jax.jit(chunk, donate_argnums=(0,) if donate else ())
+    # Warm-engine pool (serving/pool.py): the jitted chunk is cached under
+    # the canonical engine key (serving/keys.py — seed excluded: key
+    # material and topology tensors ride the chunk arguments; crash models
+    # re-pin the seed via the fault class, whose planes ARE baked
+    # constants), so repeated same-shape runs — suite grids, serving
+    # fallbacks, CI reruns — skip retracing. The donate flag splits the
+    # key: donating and non-donating wrappers compile differently.
+    from ..serving import keys as keys_mod
+    from ..serving import pool as pool_mod
+
+    chunk_j, _ = pool_mod.default_pool().get_or_build(
+        ("run-chunk", keys_mod.canonical_key(cfg, topo), donate),
+        lambda: jax.jit(chunk, donate_argnums=(0,) if donate else ()),
+    )
     rnd0 = jnp.int32(start_round)
     done0_dev = jnp.bool_(done0)
     health0 = never_i32 if sentinel else None

@@ -1,16 +1,28 @@
-"""Vmapped replica sweep — many independent simulations in one device program.
+"""Vmapped batch engine — many independent simulations in one device program.
 
-Every small-N cell of the reference grid pays the same per-run floor
-(dispatch plumbing + compile + per-chunk sync) regardless of how little it
-computes, so R independent runs cost R floors. This engine batches R
-replicas of one configuration — same (n, topology, algorithm), different
-seeds — into ONE chunked program by vmapping the pure-JAX round loop over
-the replica axis: the whole sweep pays one compile and one dispatch floor
-per chunk, the trick that made TPU Monte-Carlo simulation viable (Ising on
-TPU clusters, PAPERS.md). Grid cells with the same shape bucket the same
-way: a cell's R seeds ARE its bucket.
+Every small-N run pays the same per-run floor (dispatch plumbing + compile
++ per-chunk sync) regardless of how little it computes, so R independent
+runs cost R floors. This engine batches R lanes of one COMPILE CLASS
+(serving/keys.py: same topology/algorithm/fault-class, different base
+keys) into ONE chunked program by vmapping the pure-JAX round loop over
+the lane axis: the whole batch pays one compile and one dispatch floor per
+chunk, the trick that made TPU Monte-Carlo simulation viable (Ising on TPU
+clusters, PAPERS.md). Two front ends share it:
 
-Per-replica keys (the fold_in tag space, shared with models/runner.py and
+- ``run_replicas`` — the replica sweep: R seeds derived from one run's
+  base key (suite grid cells; a cell's R seeds ARE its bucket);
+- ``run_batched_keys`` — the serving plane's micro-batcher
+  (serving/batcher.py): each lane carries an INDEPENDENT request's own
+  base key (``PRNGKey(request.seed)``), so every lane's trajectory is
+  bitwise the one-shot ``models.runner.run`` of that request — the
+  heterogeneous-batch parity contract pinned by tests/test_serving.py.
+
+The compiled vmapped chunk is cached in the warm-engine pool
+(serving/pool.py) under the canonical key + lane count, so same-shape
+batches reuse the live executable across calls (suite cells differing
+only in seed, repeated serving buckets, CI reruns).
+
+Per-replica keys (the fold_in tag space — canonical TAG MAP in
 ops/faults.py):
 
 - replica 0 uses the run's base key UNCHANGED, so replica 0's trajectory
@@ -22,6 +34,14 @@ ops/faults.py):
   _LEADER_TAG (2**31 - 1); REPLICA_TAG0 = 2**30 + 2**29 opens a region
   disjoint from all three for r < 2**29 - 0xDEAD... — MAX_REPLICAS (4096)
   keeps it far inside.
+- batch FILLER lanes (lane-count bucketing rounds a batch's occupancy up
+  to the next power of two so a bucket compiles O(log max_lanes) engine
+  variants, not one per occupancy) use
+  ``fold_in(keys[0], LANE_FILLER_TAG0 + i)`` — the slice of the replica
+  region just above MAX_REPLICAS, so filler streams are disjoint from
+  every real lane's round/crash/leader/replica folds. Filler lanes start
+  pre-converged (done=True at batch entry) and execute ZERO rounds —
+  their keys seed only the lane-init state draw.
 
 The crash plane (ops/faults.death_plane) is a pure function of the CONFIG
 — ``PRNGKey(cfg.seed) + CRASH_TAG`` — so all replicas share one death
@@ -53,13 +73,20 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ..config import SimConfig
+from ..config import MAX_REPLICAS, SimConfig
+from ..ops import sampling
 from ..ops import telemetry as telemetry_mod
 from ..ops.topology import Topology
+from ..serving import keys as keys_mod
+from ..serving import pool as pool_mod
 from ..utils.metrics import RUN_RECORD_SCHEMA_VERSION
+from . import gossip as gossip_mod
+from . import pushsum as pushsum_mod
 from .runner import (
+    _check_dtype,
     _done_predicate,
     _life_dev,
+    draw_leader,
     make_round_fn,
 )
 
@@ -69,7 +96,11 @@ from .runner import (
 # it rides the base key itself.
 REPLICA_TAG0 = 2**30 + 2**29
 
-MAX_REPLICAS = 4096
+# First batch-filler tag (serving lane-count bucketing): the replica-region
+# slice just above the real replica tags, so a filler lane's stream can
+# never collide with any real lane's replica/round/crash/leader folds —
+# TAG MAP in ops/faults.py.
+LANE_FILLER_TAG0 = REPLICA_TAG0 + MAX_REPLICAS
 
 
 def replica_keys(base_key: jax.Array, replicas: int) -> list:
@@ -133,6 +164,12 @@ class SweepResult:
     # was on: R full per-round counter trajectories out of ONE vmapped
     # program. Data, not a measurement — excluded from to_record.
     telemetry: Optional[list] = None
+    # Lane-count bucketing (serving plane): the vmapped program's actual
+    # lane count — >= replicas; the difference is discarded filler lanes.
+    lanes: Optional[int] = None
+    # Warm-engine pool verdict for this batch's compiled chunk
+    # (serving/pool.py): "hit" (reused a live executable) or "miss".
+    engine_cache: Optional[str] = None
 
     @property
     def wall_ms(self) -> float:
@@ -191,98 +228,210 @@ def _reject_unsupported(cfg: SimConfig) -> None:
         )
 
 
-def run_replicas(
+def _host_key_data(key_or_seed) -> np.ndarray:
+    """uint32[2] raw key data for one lane, computed WITHOUT a device
+    dispatch where possible. An int is a seed: for seeds below 2**32 the
+    threefry seeding layout is ``[0, seed]`` — bitwise what
+    ``jax.random.PRNGKey(seed)`` holds regardless of the x64 flag (pinned
+    against jax by tests/test_serving.py, so a silent upstream change
+    fails loudly); larger seeds fall back to the real PRNGKey (their hi
+    word is x64-mode-dependent). A jax key goes through
+    ops/sampling.key_split."""
+    if isinstance(key_or_seed, (int, np.integer)):
+        s = int(key_or_seed)
+        if s < 0:
+            raise ValueError(f"seeds must be >= 0, got {s}")
+        if s < 2**32:
+            return np.array([0, s], np.uint32)
+        key_or_seed = jax.random.PRNGKey(s)
+    return np.asarray(sampling.key_split(key_or_seed)[0])
+
+
+def run_batched_keys(
     topo: Topology,
     cfg: SimConfig,
-    replicas: int,
-    key: Optional[jax.Array] = None,
+    keys: list,
+    lanes: Optional[int] = None,
     keep_states: bool = True,
 ) -> SweepResult:
-    """Run ``replicas`` seeds of one configuration in one vmapped chunked
-    program. Replica 0 bitwise-matches ``models.runner.run`` with the same
-    key (tests/test_sweep.py pins it)."""
+    """Run ``len(keys)`` independent simulations of one compile class in
+    ONE vmapped chunked program — lane ``i`` rides ``keys[i]`` as its base
+    key, so its trajectory is bitwise the one-shot ``models.runner.run``
+    with that key (the serving micro-batcher's parity contract,
+    tests/test_serving.py).
+
+    ``lanes`` pads the batch width (lane-count bucketing): lanes beyond
+    ``len(keys)`` are FILLER — keys from the LANE_FILLER_TAG0 region,
+    pre-converged at entry so they execute zero rounds — so a serving
+    bucket compiles one engine per power-of-two width instead of one per
+    occupancy. The compiled vmapped chunk comes from the warm-engine pool
+    (serving/pool.py) keyed by the canonical engine key + lane count."""
     _reject_unsupported(cfg)
-    if key is None:
-        key = jax.random.PRNGKey(cfg.seed)
-    keys = replica_keys(key, replicas)
+    requests = len(keys)
+    if requests < 1:
+        raise ValueError("run_batched_keys needs at least one base key")
+    if lanes is None:
+        lanes = requests
+    if not (requests <= lanes <= MAX_REPLICAS):
+        raise ValueError(
+            f"lanes must be in [len(keys)={requests}, {MAX_REPLICAS}], "
+            f"got {lanes}"
+        )
     target = cfg.resolved_target_count(topo.n, topo.target_count)
-
-    # One make_round_fn call per replica: the round functions are identical
-    # closures (key material rides the key_data ARGUMENT), but state0
-    # (gossip leader) and key_data differ per replica — stack those.
-    parts = [make_round_fn(topo, cfg, k) for k in keys]
-    round_fn = parts[0][0]
-    topo_args = parts[0][3]
-    state0 = jax.tree.map(lambda *xs: jnp.stack(xs), *(p[1] for p in parts))
-    key_data = jnp.stack([jnp.asarray(p[2]) for p in parts])
-
+    dtype = _check_dtype(cfg)
+    telemetry = cfg.telemetry
     has_ring = cfg.delay_rounds > 0
 
     def proto_of(carry_state):
         return carry_state[0] if has_ring else carry_state
 
-    life_dev = _life_dev(cfg, topo.n)  # config-pure: shared by replicas
-    done_fn = _done_predicate(cfg, life_dev, target)
+    # Warm-engine pool (serving/pool.py): EVERYTHING program-shaped — the
+    # shared round function, the jitted vmapped chunk, the jitted lane-init
+    # program, the device topology tensors — is built once per
+    # (canonical engine key, lane count) and reused. A steady-state batch
+    # then costs host key-data assembly plus a handful of dispatches: one
+    # lane-init, one-plus chunk dispatches, one epilogue fetch — the
+    # serving plane's throughput rests on this.
+    def _build_engine():
+        base_key = jax.random.PRNGKey(cfg.seed)
+        round_fn, _, _, topo_args = make_round_fn(topo, cfg, base_key)
+        life_dev = _life_dev(cfg, topo.n)  # config-pure: shared by lanes
+        done_fn = _done_predicate(cfg, life_dev, target)
+        # One row_fn serves every lane (the crash plane is config-pure;
+        # per-lane key material rides the vmapped kd argument).
+        row_fn = (
+            telemetry_mod.make_row_fn(topo, cfg, base_key)
+            if telemetry else None
+        )
+        stride = cfg.chunk_rounds
+        impl = sampling.key_split(base_key)[1]
+        n = topo.n
+        D = cfg.delay_rounds
 
-    # Telemetry plane: the vmapped chunk grows a per-replica counter block
-    # — R full per-round trajectories out of one program, the same move
-    # that batches the runs themselves. One row_fn serves every replica
-    # (the crash plane is config-pure; per-replica key material rides the
-    # vmapped kd argument).
-    telemetry = cfg.telemetry
-    row_fn = (
-        telemetry_mod.make_row_fn(topo, cfg, keys[0]) if telemetry else None
-    )
-    stride = cfg.chunk_rounds
+        def chunk(state, rnd, done, round_end, kd, *targs):
+            rnd_in = rnd  # per-lane loop-entry round (telemetry row base)
 
-    def chunk(state, rnd, done, round_end, kd, *targs):
-        rnd_in = rnd  # per-replica loop-entry round (telemetry row base)
+            def cond(c):
+                return jnp.logical_and(~c[2], c[1] < round_end)
 
-        def cond(c):
-            return jnp.logical_and(~c[2], c[1] < round_end)
+            def body(c):
+                s, r = c[0], c[1]
+                s = round_fn(s, r, kd, *targs)
+                d = done_fn(proto_of(s), r)
+                out = (s, r + 1, d)
+                if telemetry:
+                    row = row_fn(proto_of(s), r, kd)
+                    out += (lax.dynamic_update_index_in_dim(
+                        c[3], row, r - rnd_in, 0
+                    ),)
+                return out
 
-        def body(c):
-            s, r = c[0], c[1]
-            s = round_fn(s, r, kd, *targs)
-            d = done_fn(proto_of(s), r)
-            out = (s, r + 1, d)
+            carry = (state, rnd, done)
             if telemetry:
-                row = row_fn(proto_of(s), r, kd)
-                out += (lax.dynamic_update_index_in_dim(
-                    c[3], row, r - rnd_in, 0
-                ),)
-            return out
+                carry += (
+                    jnp.zeros((stride, telemetry_mod.N_COLS), jnp.float32),
+                )
+            return lax.while_loop(cond, body, carry)
 
-        carry = (state, rnd, done)
-        if telemetry:
-            carry += (jnp.zeros((stride, telemetry_mod.N_COLS), jnp.float32),)
-        return lax.while_loop(cond, body, carry)
+        def lane_init(kd_padded, n_requests):
+            """All lanes' (state0, key_data) in ONE program: filler lanes
+            (index >= n_requests) swap in keys folded from the
+            LANE_FILLER_TAG0 region off lane 0's key; gossip lanes draw
+            their per-lane leader in-trace (bitwise the eager
+            draw_leader — same fold_in/randint off the same key data)."""
+            lane = jnp.arange(lanes, dtype=jnp.int32)
+            kd0 = sampling.key_join(kd_padded[0], impl)
+            filler = jax.vmap(
+                lambda t: jax.random.fold_in(kd0, LANE_FILLER_TAG0 + t)
+            )(lane)
+            kd = jnp.where(
+                (lane < n_requests)[:, None], kd_padded, filler
+            )
+            if cfg.algorithm == "push-sum":
+                st = pushsum_mod.init_state(n, dtype, cfg.initial_term_round)
+                state0 = jax.tree.map(
+                    lambda x: jnp.broadcast_to(
+                        x[None], (lanes,) + x.shape
+                    ),
+                    st,
+                )
+            else:
+                # Reference semantics is rejected for batches, so the
+                # reference-only leader_counts_receipt quirk is off here.
+                state0 = jax.vmap(
+                    lambda k: gossip_mod.init_state(
+                        n,
+                        draw_leader(sampling.key_join(k, impl), topo, cfg),
+                        leader_counts_receipt=False,
+                    )
+                )(kd)
+            if D:
+                ring = (
+                    jnp.zeros((lanes, D, 2, n), dtype)
+                    if cfg.algorithm == "push-sum"
+                    else jnp.zeros((lanes, D, n), jnp.int32)
+                )
+                state0 = (state0, ring)
+            return state0, kd
 
-    chunk_b = jax.jit(
-        jax.vmap(
-            chunk,
-            in_axes=(0, 0, 0, None, 0) + (None,) * len(topo_args),
-        ),
-        donate_argnums=(0,),
+        return {
+            "chunk_b": jax.jit(
+                jax.vmap(
+                    chunk,
+                    in_axes=(0, 0, 0, None, 0) + (None,) * len(topo_args),
+                ),
+                donate_argnums=(0,),
+            ),
+            "lane_init_b": jax.jit(lane_init),
+            "topo_args": topo_args,
+        }
+
+    engine, cache_hit = pool_mod.default_pool().get_or_build(
+        ("batch-engine", keys_mod.canonical_key(cfg, topo), lanes),
+        _build_engine,
+    )
+    chunk_b = engine["chunk_b"]
+    topo_args = engine["topo_args"]
+
+    # Host-side key-data assembly (no per-lane device dispatches): real
+    # lanes' raw uint32 pairs, padded to width with repeats of lane 0 —
+    # lane_init swaps the pad rows for LANE_FILLER_TAG0 folds in-trace.
+    kd_np = np.stack(
+        [_host_key_data(k) for k in keys]
+        + [_host_key_data(keys[0])] * (lanes - requests)
+    )
+    state0, key_data = engine["lane_init_b"](
+        jnp.asarray(kd_np), jnp.int32(requests)
     )
 
-    rnd0 = jnp.zeros((replicas,), jnp.int32)
-    done0 = jnp.zeros((replicas,), bool)
+    rnd0 = jnp.zeros((lanes,), jnp.int32)
+    # Filler lanes start PRE-CONVERGED: the vmapped while_loop runs until
+    # every lane's predicate is false, so a filler simulated for real
+    # would gate the whole batch's latency on throwaway work (and under a
+    # never-converging fault config would run it to max_rounds). done=True
+    # at entry makes them execute zero rounds — select-masked from the
+    # first iteration, bitwise-invisible to the real lanes.
+    done0 = jnp.arange(lanes) >= requests
 
     t0 = time.perf_counter()
-    # The uniform warmup rule (models/runner.py): one real round on a COPY
-    # (the chunk donates its state argument), discarded — the timed loop
-    # recomputes round 0 identically off the absolute-round key stream.
-    warm = chunk_b(
-        jax.tree.map(jnp.copy, state0), rnd0, done0,
-        jnp.int32(min(1, cfg.max_rounds)), key_data, *topo_args,
-    )
-    int(warm[1][0])
-    del warm
+    if not cache_hit:
+        # The uniform warmup rule (models/runner.py): one real round on a
+        # COPY (the chunk donates its state argument), discarded — the
+        # timed loop recomputes round 0 identically off the absolute-round
+        # key stream. Skipped on a warm pool hit: the executable is live,
+        # and the extra dispatch would cost serving throughput.
+        warm = chunk_b(
+            jax.tree.map(jnp.copy, state0), rnd0, done0,
+            jnp.int32(min(1, cfg.max_rounds)), key_data, *topo_args,
+        )
+        int(warm[1][0])
+        del warm
     compile_s = time.perf_counter() - t0
 
     state, rnd, done = state0, rnd0, done0
-    trajs = [[] for _ in range(replicas)] if telemetry else None
+    # Filler lanes collect no telemetry and report no results — everything
+    # below slices the first ``requests`` lanes.
+    trajs = [[] for _ in range(requests)] if telemetry else None
     rounds_end = 0
     t1 = time.perf_counter()
     while True:
@@ -294,12 +443,12 @@ def run_replicas(
         )
         state, rnd, done = out[:3]
         if telemetry:
-            # Per-replica row counts differ: a replica frozen at its own
+            # Per-lane row counts differ: a lane frozen at its own
             # convergence executed 0 rows this chunk (vmap select-masks its
-            # carry), so each replica slices its own executed prefix.
+            # carry), so each lane slices its own executed prefix.
             buf = np.asarray(out[3])
             rnd_after = np.asarray(rnd)
-            for r in range(replicas):
+            for r in range(requests):
                 ex = int(rnd_after[r] - rnd_before[r])
                 if ex > 0:
                     trajs[r].append(
@@ -309,9 +458,11 @@ def run_replicas(
             break
     run_s = time.perf_counter() - t1
 
-    rounds_np = np.asarray(rnd)
-    done_np = np.asarray(done)
-    protos = proto_of(state)
+    rounds_np = np.asarray(rnd)[:requests]
+    done_np = np.asarray(done)[:requests]
+    # ONE host fetch per state plane (not one per lane) — the per-request
+    # views below slice host memory for free.
+    protos = jax.tree.map(np.asarray, proto_of(state))
 
     result = SweepResult(
         algorithm=cfg.algorithm,
@@ -320,7 +471,7 @@ def run_replicas(
         n_requested=topo.n_requested,
         population=topo.n,
         target_count=target,
-        replicas=replicas,
+        replicas=requests,
         rounds=[int(r) for r in rounds_np],
         converged=[bool(d) for d in done_np],
         outcome=[
@@ -328,6 +479,8 @@ def run_replicas(
         ],
         compile_s=compile_s,
         run_s=run_s,
+        lanes=lanes,
+        engine_cache="hit" if cache_hit else "miss",
     )
     result.rounds_mean, result.rounds_ci95 = _mean_ci95(result.rounds)
 
@@ -344,14 +497,14 @@ def run_replicas(
         ]
     if keep_states:
         result.final_states = [
-            jax.tree.map(lambda x, r=r: np.asarray(x[r]), protos)
-            for r in range(replicas)
+            jax.tree.map(lambda x, r=r: x[r], protos)
+            for r in range(requests)
         ]
     if cfg.algorithm == "push-sum":
         true_mean = (topo.n - 1) / 2.0
-        s = np.asarray(protos.s)
-        w = np.asarray(protos.w)
-        conv = np.asarray(protos.conv)
+        s = protos.s[:requests]
+        w = protos.w[:requests]
+        conv = protos.conv[:requests]
         w_safe = np.where(w != 0, w, 1)
         err = np.where(conv, np.abs(s / w_safe - true_mean), 0.0)
         counts = np.maximum(conv.sum(axis=1), 1)
@@ -363,3 +516,22 @@ def run_replicas(
             result.estimate_mae
         )
     return result
+
+
+def run_replicas(
+    topo: Topology,
+    cfg: SimConfig,
+    replicas: int,
+    key: Optional[jax.Array] = None,
+    keep_states: bool = True,
+) -> SweepResult:
+    """Run ``replicas`` seeds of one configuration in one vmapped chunked
+    program. Replica 0 bitwise-matches ``models.runner.run`` with the same
+    key (tests/test_sweep.py pins it); replica r > 0 folds
+    REPLICA_TAG0 + r. A thin front end over ``run_batched_keys`` — the
+    replica keys ARE the batch lanes."""
+    if key is None:
+        key = jax.random.PRNGKey(cfg.seed)
+    return run_batched_keys(
+        topo, cfg, replica_keys(key, replicas), keep_states=keep_states
+    )

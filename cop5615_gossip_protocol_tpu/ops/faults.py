@@ -79,6 +79,15 @@ IMP_CHOICE_TAG) are a different stream level entirely:
     REVIVE_TAG            2**30 + 0xA11FE       revival-plane draw
     REPLICA_TAG0 + r      2**30 + 2**29 + r     replica keys, r < 4096
                           (models/sweep.py; replica 0 rides the base key)
+    LANE_FILLER_TAG0 + i  2**30 + 2**29 + 4096 + i   serving batch FILLER
+                          lanes (models/sweep.run_batched_keys lane-count
+                          bucketing: a batch padded to its power-of-two
+                          width fills the empty lanes with keys folded
+                          from this region off lane 0's base key — their
+                          streams are disjoint from every real lane's
+                          round/crash/replica/leader folds, and the lanes
+                          start pre-converged so they execute zero
+                          rounds), i < max batch lanes
     _LEADER_TAG           2**31 - 1             gossip leader draw
                           (models/runner.py)
 

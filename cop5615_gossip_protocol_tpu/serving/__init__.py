@@ -1,0 +1,30 @@
+"""Serving plane — gossip-as-a-service (ISSUE 6 / ROADMAP item 2).
+
+Everything before this package was a one-shot CLI process: build topology,
+compile, run, exit. This package keeps compiled engines WARM and
+multiplexes many concurrent simulation requests through batched programs —
+the accelerator-offload-for-many-actor-workloads shape of the OpenCL-Actors
+/ PGAS-actors papers (PAPERS.md), realized as JAX programs:
+
+- ``keys``    — the canonical config→compiled-engine key (padded-N
+                bucketing, fault-class normalization). The single home of
+                engine-cache keying; models/sweep.py and models/runner.py
+                consult it instead of re-jitting per call.
+- ``pool``    — the process-wide warm-engine LRU pool those keys index.
+- ``admission`` — bounded-queue admission control + the serving counters
+                behind the ``/stats`` endpoint.
+- ``batcher`` — the heterogeneous micro-batcher: requests landing in the
+                same key bucket within a batching window execute as ONE
+                vmapped program (models/sweep.run_batched_keys), with
+                per-request seeds as batch axes and per-request
+                telemetry/event streams demultiplexed into each response.
+- ``server``  — stdlib ``http.server`` front end (``serve.py`` /
+                ``python -m cop5615_gossip_protocol_tpu.serving``):
+                POST /run, GET /stats, GET /healthz. The PR 4 degradation
+                ladder is the availability story — a rung walk is a
+                structured ``engine_degraded`` response field, never a 500.
+
+Deliberately import-light: submodules import models/* lazily enough that
+``models.runner``/``models.sweep`` can import ``serving.keys``/
+``serving.pool`` without a cycle.
+"""

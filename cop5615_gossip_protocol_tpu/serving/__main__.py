@@ -1,0 +1,9 @@
+"""``python -m cop5615_gossip_protocol_tpu.serving`` — the serving-plane
+entry point (same as ``serve.py`` at the repo root)."""
+
+import sys
+
+from .server import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,165 @@
+"""Admission control + serving counters (the ``/stats`` endpoint's data).
+
+The admission front is a bounded queue: a request is ADMITTED when the
+number of requests waiting for a batch is below ``queue_limit``, else
+REJECTED with a structured payload (HTTP 429 — never an unbounded queue
+that converts overload into unbounded latency). The counters follow the
+closed-loop accounting identity the serve-smoke CI job asserts:
+
+    received  == admitted + rejected + invalid
+    admitted  == completed + failed + in_flight
+    batched_requests (Σ batch occupancy) == completed + failed
+
+Latency percentiles are computed over a bounded reservoir of the most
+recent completions (classic sliding window, not a full history — the
+serving plane must not grow memory with traffic).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+
+
+class AdmissionError(Exception):
+    """Request rejected at the admission front (bounded queue full)."""
+
+    def __init__(self, queue_depth: int, queue_limit: int):
+        super().__init__(
+            f"admission rejected: queue depth {queue_depth} at limit "
+            f"{queue_limit}"
+        )
+        self.queue_depth = queue_depth
+        self.queue_limit = queue_limit
+
+
+def percentile(sorted_vals, q: float):
+    """Nearest-rank percentile over an already-sorted list (no numpy on
+    the serving hot path). None on empty input."""
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1, max(0, round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[int(idx)]
+
+
+class ServingStats:
+    """Thread-safe serving counters. One instance per server; the batcher
+    and HTTP handlers both write it."""
+
+    RESERVOIR = 4096
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.received = 0
+        self.admitted = 0
+        self.rejected = 0
+        self.invalid = 0
+        self.completed = 0
+        self.failed = 0
+        self.degraded = 0
+        self.batches = 0
+        self.batched_requests = 0  # Σ occupancy over executed batches
+        self.batch_lanes_sum = 0   # Σ lanes (padding included)
+        self.buckets: collections.Counter = collections.Counter()
+        self.wait_s_sum = 0.0      # admission → batch-dispatch
+        self.service_s_sum = 0.0   # admission → response ready
+        self._latency: collections.deque = collections.deque(
+            maxlen=self.RESERVOIR
+        )
+        self._depth_fn = None  # wired by the batcher (live queue depth)
+
+    def wire_depth(self, fn) -> None:
+        self._depth_fn = fn
+
+    # -- writers -----------------------------------------------------------
+
+    def on_received(self) -> None:
+        with self._lock:
+            self.received += 1
+
+    def on_admitted(self) -> None:
+        with self._lock:
+            self.admitted += 1
+
+    def on_rejected(self) -> None:
+        with self._lock:
+            self.rejected += 1
+
+    def on_invalid(self) -> None:
+        with self._lock:
+            self.invalid += 1
+
+    def on_batch(self, bucket: str, occupancy: int, lanes: int) -> None:
+        with self._lock:
+            self.batches += 1
+            self.batched_requests += occupancy
+            self.batch_lanes_sum += lanes
+            self.buckets[bucket] += 1
+
+    def on_completed(self, wait_s: float, service_s: float,
+                     degraded: bool = False) -> None:
+        with self._lock:
+            self.completed += 1
+            if degraded:
+                self.degraded += 1
+            self.wait_s_sum += wait_s
+            self.service_s_sum += service_s
+            self._latency.append(service_s)
+
+    def on_failed(self) -> None:
+        with self._lock:
+            self.failed += 1
+
+    # -- readers -----------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """The /stats payload. Derived fields are computed here so every
+        consumer reads one consistent view.
+
+        The live queue depth is read BEFORE taking the stats lock: the
+        depth fn acquires the batcher's queue lock, and the batcher's
+        submit path takes these locks in the opposite order (queue lock →
+        stats lock via on_admitted) — holding the stats lock across the
+        depth call would be an ABBA deadlock with live traffic."""
+        depth = self._depth_fn() if self._depth_fn else 0
+        with self._lock:
+            lat = sorted(self._latency)
+            done = self.completed + self.failed
+            snap = {
+                "received": self.received,
+                "admitted": self.admitted,
+                "rejected": self.rejected,
+                "invalid": self.invalid,
+                "completed": self.completed,
+                "failed": self.failed,
+                "degraded": self.degraded,
+                "in_flight": self.admitted - done,
+                "queue_depth": depth,
+                "batches": self.batches,
+                "batched_requests": self.batched_requests,
+                "batch_occupancy_mean": (
+                    self.batched_requests / self.batches
+                    if self.batches else None
+                ),
+                "batch_fill": (
+                    self.batched_requests / self.batch_lanes_sum
+                    if self.batch_lanes_sum else None
+                ),
+                "buckets": dict(self.buckets),
+                "wait_ms_mean": (
+                    1e3 * self.wait_s_sum / done if done else None
+                ),
+                "service_ms_mean": (
+                    1e3 * self.service_s_sum / done if done else None
+                ),
+                "service_ms_p50": (
+                    1e3 * percentile(lat, 0.50) if lat else None
+                ),
+                "service_ms_p99": (
+                    1e3 * percentile(lat, 0.99) if lat else None
+                ),
+            }
+        from . import pool as pool_mod
+
+        snap["engine_pool"] = pool_mod.default_pool().stats()
+        return snap

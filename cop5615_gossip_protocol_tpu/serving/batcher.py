@@ -1,0 +1,446 @@
+"""Heterogeneous micro-batcher — many concurrent requests, one program per
+bucket.
+
+Requests landing in the same key bucket (serving/keys.serve_bucket_key)
+within a batching window execute as ONE vmapped chunked program
+(models/sweep.run_batched_keys): per-request seeds ride the batch axis as
+per-lane base keys, lane counts round up to the next power of two
+(lane-count bucketing — filler lanes draw from the LANE_FILLER_TAG0 region
+and are discarded), and per-request telemetry rows (ops/telemetry.py) and
+event streams are demultiplexed back into each response. Lane ``i`` of a
+batch is bitwise the one-shot ``models.runner.run`` of request ``i``
+(tests/test_serving.py pins it).
+
+Availability: a batched execution failing ENVIRONMENTALLY (the PR 4
+``_DEGRADABLE_ERRORS`` vocabulary) walks down to per-request one-shot runs
+through ``models.runner.run`` — which then walks its own
+fused→chunked→single-device ladder — and every rung taken is reported as a
+structured ``engine_degraded`` field in the response, never a 500.
+``GOSSIP_TPU_STRICT_ENGINE`` (models/runner._strict_engine) restores
+fail-fast, surfacing as a structured 503.
+
+Threading: HTTP handler threads ``submit()`` into the bounded admission
+queue and block on the request's event; ONE executor thread drains the
+queue per window, groups by bucket, and runs each group. JAX dispatch
+happens only on the executor thread.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+import time
+import uuid
+from typing import Optional
+
+import numpy as np
+
+from ..config import SimConfig
+from . import keys as keys_mod
+from .admission import AdmissionError, ServingStats
+
+_REQ_COUNTER = itertools.count()
+
+
+def lane_bucket(occupancy: int, max_lanes: int, min_lanes: int = 1) -> int:
+    """Lane-count bucketing: next power of two >= occupancy, clamped to
+    [min_lanes, max_lanes] — a bucket compiles O(log(max/min)) engine
+    variants instead of one per occupancy. ``min_lanes`` trades a little
+    filler compute on straggler batches for fewer compiled widths (the
+    serving default is 8: four widths at max_lanes=64)."""
+    lanes = 1
+    while lanes < occupancy:
+        lanes *= 2
+    return max(min(lanes, max_lanes), min(min_lanes, max_lanes))
+
+
+@dataclasses.dataclass
+class ServeRequest:
+    """One admitted request in flight. ``ready`` is set by the executor
+    once ``status``/``response`` hold the final verdict."""
+
+    request_id: str
+    cfg: SimConfig
+    topo: object
+    bucket: tuple
+    bucket_label: str
+    want_telemetry: bool
+    t_received: float
+    ready: threading.Event = dataclasses.field(
+        default_factory=threading.Event
+    )
+    status: int = 0
+    response: Optional[dict] = None
+    events: list = dataclasses.field(default_factory=list)
+
+    def emit(self, event: str, **fields) -> None:
+        """Per-request lifecycle stream, demultiplexed into the response —
+        the request-scoped analog of the run-event log (utils/events.py)."""
+        self.events.append({
+            "event": event,
+            "t_req": time.monotonic() - self.t_received,
+            **fields,
+        })
+
+
+class MicroBatcher:
+    def __init__(
+        self,
+        stats: Optional[ServingStats] = None,
+        window_s: float = 0.003,
+        max_lanes: int = 64,
+        queue_limit: int = 256,
+        batching: bool = True,
+        event_log=None,
+        min_lanes: int = 8,
+    ):
+        if max_lanes < 1:
+            raise ValueError("max_lanes must be >= 1")
+        if min_lanes < 1:
+            raise ValueError("min_lanes must be >= 1")
+        if queue_limit < 1:
+            raise ValueError("queue_limit must be >= 1")
+        self.window_s = float(window_s)
+        self.max_lanes = int(max_lanes)
+        self.min_lanes = int(min_lanes)
+        self.queue_limit = int(queue_limit)
+        self.batching = bool(batching)
+        self.stats = stats if stats is not None else ServingStats()
+        self.event_log = event_log
+        self._queue: list = []
+        self._cv = threading.Condition()
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        self.stats.wire_depth(self.queue_depth)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "MicroBatcher":
+        self._thread = threading.Thread(
+            target=self._worker, name="gossip-serve-batcher", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True) -> None:
+        """Stop the executor; with ``drain`` (default) every already-
+        admitted request still completes before the thread exits."""
+        with self._cv:
+            self._stop = True
+            if not drain:
+                for r in self._queue:
+                    r.status = 503
+                    r.response = _error_body(
+                        r, "server-stopping", "server shut down before "
+                        "this request was dispatched"
+                    )
+                    r.ready.set()
+                    self.stats.on_failed()
+                self._queue.clear()
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+
+    def queue_depth(self) -> int:
+        with self._cv:
+            return len(self._queue)
+
+    # -- admission ---------------------------------------------------------
+
+    def submit(self, cfg: SimConfig, want_telemetry: bool) -> ServeRequest:
+        """Admit one request into the batching queue, or raise
+        AdmissionError (the bounded-queue front). Topology build/lookup is
+        cached (serving/keys.get_topology) and happens on the caller's
+        thread — the executor only runs programs."""
+        # Only the imp kinds' builders consume the seed (the random extra
+        # edge); keying the cache on it for every kind would make each
+        # distinct-seed request a cache miss + O(n·deg) rebuild in the
+        # hot path.
+        topo_seed = (
+            cfg.seed if cfg.topology in keys_mod.SEED_BUILT_KINDS else 0
+        )
+        topo = keys_mod.get_topology(
+            cfg.topology, cfg.n, seed=topo_seed, semantics=cfg.semantics
+        )
+        req = ServeRequest(
+            request_id=f"r{next(_REQ_COUNTER)}-{uuid.uuid4().hex[:8]}",
+            cfg=cfg,
+            topo=topo,
+            bucket=keys_mod.serve_bucket_key(cfg, topo),
+            bucket_label=keys_mod.bucket_label(cfg, topo),
+            want_telemetry=want_telemetry,
+            t_received=time.monotonic(),
+        )
+        with self._cv:
+            if self._stop:
+                raise AdmissionError(len(self._queue), self.queue_limit)
+            if len(self._queue) >= self.queue_limit:
+                raise AdmissionError(len(self._queue), self.queue_limit)
+            # Count the admission BEFORE the worker can see (and finish)
+            # the request — a /stats snapshot must never read
+            # completed > admitted.
+            self.stats.on_admitted()
+            self._queue.append(req)
+            self._cv.notify_all()
+        req.emit("request-admitted", bucket=req.bucket_label)
+        return req
+
+    # -- executor ----------------------------------------------------------
+
+    def _worker(self) -> None:
+        while True:
+            with self._cv:
+                while not self._queue and not self._stop:
+                    self._cv.wait(timeout=0.1)
+                if not self._queue:
+                    if self._stop:
+                        return
+                    continue
+                if self.batching:
+                    # Batching window: hold the door open briefly so
+                    # concurrent arrivals co-batch, close early once a
+                    # full batch is waiting.
+                    deadline = time.monotonic() + self.window_s
+                    while not self._stop and len(self._queue) < self.max_lanes:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            break
+                        self._cv.wait(timeout=remaining)
+                batch = list(self._queue)
+                self._queue.clear()
+            if self.batching:
+                groups: dict = {}
+                for r in batch:
+                    groups.setdefault(r.bucket, []).append(r)
+                for group in groups.values():
+                    for i in range(0, len(group), self.max_lanes):
+                        self._execute_safe(group[i:i + self.max_lanes])
+            else:
+                # Batching-off control (benchmarks/loadgen.py's ratio
+                # baseline): every request is its own single-lane program
+                # — same warm pool, no shared dispatch.
+                for r in batch:
+                    self._execute_safe([r])
+
+    def _execute_safe(self, group: list) -> None:
+        """The executor is ONE thread serving every request: an exception
+        escaping a batch must fail that batch structurally, never kill the
+        thread (a dead executor hangs all in-flight and all future
+        requests — a one-request denial of service). _execute handles the
+        expected vocabularies; this guard catches everything else."""
+        try:
+            self._execute(group)
+        except Exception as e:  # noqa: BLE001 — the whole point
+            unset = [r for r in group if not r.ready.is_set()]
+            if unset:
+                self.stats.on_batch(
+                    group[0].bucket_label, len(unset), len(unset)
+                )
+            for r in unset:
+                r.status = 503
+                r.response = _error_body(
+                    r, "internal-error", f"{type(e).__name__}: {e}"[:500]
+                )
+                r.ready.set()
+                self.stats.on_failed()
+
+    def _execute(self, group: list) -> None:
+        from ..models import runner as runner_mod
+        from ..models import sweep as sweep_mod
+
+        t_dispatch = time.monotonic()
+        req0 = group[0]
+        cfg = req0.cfg
+        topo = req0.topo
+        # Batching-off control mode runs honest single-lane programs (the
+        # loadgen ratio baseline must not inherit filler-lane padding).
+        lanes = (
+            lane_bucket(len(group), self.max_lanes, self.min_lanes)
+            if self.batching else 1
+        )
+        for r in group:
+            r.emit(
+                "batch-dispatched", bucket=req0.bucket_label,
+                occupancy=len(group), lanes=lanes,
+            )
+        sres = None
+        error: Optional[BaseException] = None
+        try:
+            # Seeds, not PRNGKeys: run_batched_keys assembles raw key data
+            # on the host (no per-request device dispatch) — lane i is
+            # still bitwise runner.run with PRNGKey(seed_i).
+            sres = sweep_mod.run_batched_keys(
+                topo, cfg, [r.cfg.seed for r in group],
+                lanes=lanes, keep_states=True,
+            )
+        except runner_mod._DEGRADABLE_ERRORS as e:  # noqa: SLF001 — the
+            # PR 4 degradation vocabulary is the serving availability
+            # contract; config errors (ValueError) stay fail-fast below.
+            error = e
+        except ValueError as e:
+            error = e
+
+        t_done = time.monotonic()
+        if self.event_log is not None:
+            self.event_log.emit(
+                "batch-retired", bucket=req0.bucket_label,
+                occupancy=len(group), lanes=lanes,
+                ok=sres is not None,
+                engine_cache=None if sres is None else sres.engine_cache,
+                batch_ms=1e3 * (t_done - t_dispatch),
+            )
+
+        if sres is not None:
+            self.stats.on_batch(req0.bucket_label, len(group), lanes)
+            for i, r in enumerate(group):
+                self._finish(
+                    r, self._lane_body(r, i, sres, len(group), lanes),
+                    t_dispatch,
+                )
+            return
+
+        # Batched execution failed. Environmental failures walk down to
+        # per-request one-shot runs (never a 500); config-contract errors
+        # and strict mode fail the requests with a structured verdict.
+        # The occupancy accounting follows the path taken — the degraded
+        # branch counts one single-lane batch per request in _one_shot, so
+        # batched_requests == completed + failed stays an identity.
+        strict = runner_mod._strict_engine(cfg)  # noqa: SLF001
+        degradable = isinstance(error, runner_mod._DEGRADABLE_ERRORS)
+        if not degradable or strict:
+            self.stats.on_batch(req0.bucket_label, len(group), lanes)
+            for r in group:
+                r.status = 503 if degradable else 400
+                r.response = _error_body(
+                    r,
+                    "engine-unavailable" if degradable else "invalid-config",
+                    f"{type(error).__name__}: {error}",
+                )
+                r.ready.set()
+                self.stats.on_failed()
+            return
+        for r in group:
+            self._one_shot(r, error, t_dispatch)
+
+    def _one_shot(self, r: ServeRequest, reason, t_dispatch: float) -> None:
+        """Degraded path: run this request alone through models.runner.run
+        (which walks its own engine ladder) and stamp the full rung walk
+        into the response."""
+        from ..models import runner as runner_mod
+
+        walk = [{
+            "from": "batched-vmap",
+            "to": "one-shot",
+            "reason": f"{type(reason).__name__}: {reason}"[:500],
+            "transient_retries": 0,
+        }]
+
+        def on_event(name, **fields):
+            if name == "engine-degraded":
+                walk.append(fields)
+
+        self.stats.on_batch(r.bucket_label, 1, 1)
+        try:
+            res = runner_mod.run(r.topo, r.cfg, on_event=on_event)
+        except Exception as e:  # noqa: BLE001 — bottom of every ladder:
+            # the availability contract still owes a structured verdict.
+            r.status = 503
+            r.response = _error_body(
+                r, "engine-unavailable", f"{type(e).__name__}: {e}",
+                engine_degraded=walk,
+            )
+            r.ready.set()
+            self.stats.on_failed()
+            return
+        if res.degradations:
+            walk.extend(res.degradations)
+        body = {
+            "result": {
+                "algorithm": r.cfg.algorithm,
+                "topology": r.topo.kind,
+                "population": r.topo.n,
+                "n_requested": r.topo.n_requested,
+                "target_count": res.target_count,
+                "rounds": res.rounds,
+                "converged": res.converged,
+                "outcome": res.outcome,
+                "converged_count": res.converged_count,
+            },
+            "serving": {
+                "bucket": r.bucket_label,
+                "batch_lanes": 1,
+                "batch_occupancy": 1,
+                "engine_cache": None,
+                "engine_degraded": walk,
+            },
+        }
+        if r.cfg.algorithm == "push-sum":
+            body["result"]["estimate_mae"] = res.estimate_mae
+            body["result"]["true_mean"] = res.true_mean
+        if r.want_telemetry and res.telemetry is not None:
+            body["telemetry"] = res.telemetry.to_trace_records(
+                r.cfg.algorithm
+            )
+        self._finish(r, body, t_dispatch, degraded=True)
+
+    def _lane_body(self, r: ServeRequest, lane: int, sres, occupancy: int,
+                  lanes: int) -> dict:
+        state = sres.final_states[lane]
+        body = {
+            "result": {
+                "algorithm": sres.algorithm,
+                "topology": sres.topology,
+                "population": sres.population,
+                # THIS request's ask, not the batch's: padded-N bucketing
+                # can co-batch different requested n onto one population.
+                "n_requested": r.topo.n_requested,
+                "target_count": sres.target_count,
+                "rounds": sres.rounds[lane],
+                "converged": sres.converged[lane],
+                "outcome": sres.outcome[lane],
+                "converged_count": int(np.asarray(state.conv).sum()),
+            },
+            "serving": {
+                "bucket": r.bucket_label,
+                "batch_lanes": lanes,
+                "batch_occupancy": occupancy,
+                "engine_cache": sres.engine_cache,
+                "engine_degraded": None,
+            },
+        }
+        if sres.algorithm == "push-sum":
+            body["result"]["estimate_mae"] = sres.estimate_mae[lane]
+            body["result"]["true_mean"] = sres.true_mean
+        if r.want_telemetry and sres.telemetry is not None:
+            body["telemetry"] = sres.telemetry[lane].to_trace_records(
+                sres.algorithm
+            )
+        return body
+
+    def _finish(self, r: ServeRequest, body: dict, t_dispatch: float,
+                degraded: bool = False) -> None:
+        t_now = time.monotonic()
+        wait_s = t_dispatch - r.t_received
+        service_s = t_now - r.t_received
+        r.emit("request-completed", outcome=body["result"]["outcome"])
+        body["serving"]["queue_wait_ms"] = 1e3 * wait_s
+        body["serving"]["service_ms"] = 1e3 * service_s
+        body["request_id"] = r.request_id
+        body["ok"] = True
+        body["events"] = r.events
+        r.status = 200
+        r.response = body
+        r.ready.set()
+        self.stats.on_completed(wait_s, service_s, degraded=degraded)
+
+
+def _error_body(r: ServeRequest, error: str, detail: str, **extra) -> dict:
+    return {
+        "ok": False,
+        "request_id": r.request_id,
+        "error": error,
+        "detail": detail,
+        "events": r.events,
+        **extra,
+    }

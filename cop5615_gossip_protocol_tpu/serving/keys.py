@@ -1,0 +1,215 @@
+"""Canonical config→compiled-engine keys — the single home of engine-cache
+keying (ISSUE 6 satellite: refactored OUT of models/sweep.py / runner.py).
+
+Two configs that trace the IDENTICAL chunk program must map to the same
+key, and two configs that trace different programs must never collide. The
+jit'd chunk closures bake in everything that is not threaded through the
+chunk boundary as an argument, so the key is built from three parts:
+
+- the config **compile class**: every SimConfig field except the ones that
+  are host-loop-only (seed, max_rounds, pipeline_chunks, strict_engine,
+  stall_chunks, replicas), with the resolved-policy fields NORMALIZED so
+  spelling differences that trace the same program share an engine
+  (delta=None vs delta=resolved_delta, suppress=None vs resolved, gossip
+  configs ignoring push-sum-only knobs and vice versa);
+- the **fault class**: the normalized failure model. Fault-free configs
+  collapse to one class regardless of quorum/rejoin spellings (those knobs
+  are only consulted under a crash model); a crash model additionally pins
+  ``cfg.seed`` — the churn planes derive from ``PRNGKey(seed)`` and are
+  baked into the traced round body as constants (ops/faults.py), so
+  crash-model engines are per-seed by construction;
+- the **topology class**: kind + populations + neighbor-tensor SHAPES.
+  Neighbor values ride the chunk boundary as arguments, so same-shape
+  topologies share a compiled engine. Padded-N bucketing happens here:
+  the population is the BUILT topology's ``n`` (builders round requests —
+  grid2d up to a square, imp3d down to a cube), so every request that
+  rounds to the same population lands in the same bucket
+  (``padded_population``).
+
+The key also pins the JAX runtime mode (x64 flag, backend): flipping
+either changes the traced program for the same config.
+
+``serve_bucket_key`` is the micro-batcher's stricter grouping: on top of
+the compiled-engine key it pins ``max_rounds`` (the shared host loop's
+round cap is batch-wide) and, for seed-built topologies (imp2d/imp3d),
+the topology seed — co-batched lanes share ONE neighbor tensor, so its
+values must match, not just its shape.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+from ..config import SimConfig
+from ..ops.topology import Topology, build_topology
+
+# SimConfig fields that never change the traced chunk program: they drive
+# the host loop (round caps, pipeline depth, watchdog cadence) or harness
+# policy (strict_engine), never the trace. Everything NOT listed here is
+# part of the compile class by default, so a future SimConfig field is
+# conservatively key-splitting until proven host-only.
+HOST_ONLY_FIELDS = frozenset({
+    "seed",            # key material rides the chunk boundary as key_data;
+                       # crash models re-pin it via fault_class
+    "n",               # padded-N bucketing: the BUILT population
+                       # (topology_class) rules — every request that
+                       # rounds to the same population shares the engine
+    "max_rounds",      # round_end / cap are chunk ARGUMENTS
+    "pipeline_chunks",
+    "stall_chunks",    # watchdog is a host-side retire callback (the
+                       # donation flag it implies is a separate pool-key
+                       # component chosen by the engine)
+    "strict_engine",
+    "replicas",        # lane count is a separate pool-key component
+})
+
+# Fields replaced by normalized entries below (resolved-policy collapse).
+_NORMALIZED_FIELDS = frozenset({
+    "delta", "suppress_converged", "rumor_threshold", "term_rounds",
+    "termination", "pool_size", "quorum", "rejoin",
+    "fault_rate", "crash_rate", "crash_schedule",
+    "revive_rate", "revive_schedule", "dup_rate", "delay_rounds",
+})
+
+# Topology kinds whose neighbor tensors depend on the build seed (the
+# random long-range extra edge): co-batching lanes over one shared tensor
+# requires identical build seeds for these.
+SEED_BUILT_KINDS = frozenset({"imp2d", "imp3d"})
+
+
+def fault_class(cfg: SimConfig) -> tuple:
+    """Normalized failure-model identity. Fault-free configs collapse to
+    one class (quorum/rejoin/revive spellings are only consulted under a
+    crash model — a quorum=0.9 fault-free config traces the same program
+    as quorum=1.0). A crash model pins ``cfg.seed``: the death/revival
+    planes derive from ``PRNGKey(seed)`` and are baked into the traced
+    round body as device constants (models/runner._life_dev)."""
+    if not cfg.faulted:
+        return ("fault-free",)
+    out: list = ["faulted"]
+    if cfg.fault_rate > 0:
+        out.append(("drop", cfg.fault_rate))
+    if cfg.dup_rate > 0:
+        out.append(("dup", cfg.dup_rate))
+    if cfg.delay_rounds > 0:
+        out.append(("delay", cfg.delay_rounds))
+    if cfg.crash_model:
+        out.append((
+            "crash", cfg.crash_rate, cfg.crash_schedule, cfg.quorum,
+            cfg.seed,
+        ))
+        if cfg.revive_model:
+            rejoin = cfg.rejoin if cfg.algorithm == "push-sum" else "susceptible"
+            out.append((
+                "revive", cfg.revive_rate, cfg.revive_schedule, rejoin,
+            ))
+    return tuple(out)
+
+
+def compile_class(cfg: SimConfig) -> tuple:
+    """The config side of the engine key: raw fields minus host-only ones,
+    with resolved-policy normalization (see module docstring)."""
+    pushsum = cfg.algorithm == "push-sum"
+    items = tuple(sorted(
+        (f.name, getattr(cfg, f.name))
+        for f in dataclasses.fields(cfg)
+        if f.name not in HOST_ONLY_FIELDS and f.name not in _NORMALIZED_FIELDS
+    ))
+    normalized = (
+        ("delta", cfg.resolved_delta if pushsum else None),
+        ("term", (cfg.initial_term_round, cfg.term_rounds, cfg.termination)
+         if pushsum else None),
+        ("rumor_target", None if pushsum else cfg.resolved_rumor_target),
+        ("suppress", None if pushsum else cfg.resolved_suppress),
+        ("pool_size", cfg.pool_size if cfg.delivery == "pool" else None),
+    )
+    return items + normalized + (("faults", fault_class(cfg)),)
+
+
+def topology_class(topo: Topology) -> tuple:
+    """The topology side: kind + BUILT population (the padded-N bucket —
+    the requested n is deliberately absent: every traced quantity derives
+    from the rounded population) + neighbor-tensor SHAPES (the values are
+    chunk arguments — same-shape topologies share an engine). For the
+    SEED_BUILT kinds the key additionally pins a content fingerprint of
+    the neighbor tensors: the batch engine (models/sweep.run_batched_keys)
+    caches the DEVICE topology tensors alongside the compiled chunk, so
+    two same-shape imp graphs built from different seeds must never share
+    an entry — shape identity alone would silently serve the wrong
+    graph."""
+    fingerprint = None
+    if topo.kind in SEED_BUILT_KINDS and topo.neighbors is not None:
+        import hashlib
+
+        h = hashlib.sha1()
+        h.update(topo.neighbors.tobytes())
+        h.update(topo.degree.tobytes())
+        fingerprint = h.hexdigest()[:16]
+    return (
+        "topo", topo.kind, topo.n, topo.target_count,
+        topo.max_deg, topo.implicit, fingerprint,
+    )
+
+
+def _runtime_class() -> tuple:
+    """x64 flag + backend + threefry mode: flipping any retraces every
+    program (the partitionable flag changes the traced key streams —
+    utils/compat.ensure_partitionable_threefry)."""
+    import jax
+
+    return ("x64", bool(jax.config.jax_enable_x64),
+            "backend", jax.default_backend(),
+            "tf-part", bool(getattr(jax.config, "jax_threefry_partitionable",
+                                    True)))
+
+
+def canonical_key(cfg: SimConfig, topo: Topology) -> tuple:
+    """The compiled-engine identity of (cfg, topo) on the current JAX
+    runtime — hashable, order-stable, and safe to use as a warm-pool key
+    (serving/pool.py)."""
+    return (compile_class(cfg), topology_class(topo), _runtime_class())
+
+
+@functools.lru_cache(maxsize=256)
+def get_topology(kind: str, n: int, seed: int = 0,
+                 semantics: str = "batched") -> Topology:
+    """Build-once topology cache. Builders are pure functions of these
+    four arguments, and every consumer treats the neighbor arrays as
+    read-only (they go straight into jnp.asarray), so sharing one instance
+    across requests/suite cells is safe — and skips the O(n·deg) rebuild
+    the one-shot CLI pays per run."""
+    return build_topology(kind, n, seed=seed, semantics=semantics)
+
+
+def padded_population(kind: str, n: int, seed: int = 0,
+                      semantics: str = "batched") -> int:
+    """The padded-N bucket of a requested population: the BUILT topology's
+    node count after builder rounding (grid2d rounds up to a square, imp3d
+    down to a cube, …). Requests whose n rounds to the same population —
+    and whose compile/fault classes match — share one warm engine and can
+    co-batch."""
+    return get_topology(kind, n, seed=seed, semantics=semantics).n
+
+
+def serve_bucket_key(cfg: SimConfig, topo: Topology) -> tuple:
+    """The micro-batcher's grouping key: the compiled-engine key plus the
+    batch-wide host knobs (max_rounds — one shared round cap per vmapped
+    loop) and, for seed-built topologies, the build seed (co-batched lanes
+    share ONE neighbor tensor; its VALUES must match, not just shapes)."""
+    topo_seed = cfg.seed if topo.kind in SEED_BUILT_KINDS else None
+    return canonical_key(cfg, topo) + (
+        ("max_rounds", cfg.max_rounds), ("topo_seed", topo_seed),
+    )
+
+
+def bucket_label(cfg: SimConfig, topo: Topology) -> str:
+    """Human-readable bucket name for /stats and responses — the ISSUE 6
+    key tuple (protocol, topology-kind, padded-N bucket, engine, fault
+    class), compressed."""
+    fc = fault_class(cfg)
+    fc_s = fc[0] if fc == ("fault-free",) else "faulted"
+    return (
+        f"{cfg.algorithm}/{topo.kind}/n{topo.n}/{cfg.engine}/{fc_s}"
+        + ("/tele" if cfg.telemetry else "")
+    )

@@ -1,0 +1,96 @@
+"""Warm compiled-engine pool — a process-wide LRU keyed by the canonical
+engine keys (serving/keys.py).
+
+JAX's jit cache is per-wrapper: every ``jax.jit(fresh_closure)`` retraces,
+so before this pool each ``models.runner.run`` / ``models.sweep`` call
+re-paid tracing for a program the process had already compiled (the
+persistent XLA cache from PR 2 only removes the XLA-compile part, not the
+trace). The pool stores the jitted wrapper itself under the canonical key,
+so identical-shape runs — suite grid cells, serving requests, CI reruns —
+reuse the live executable.
+
+Entries are whole jitted callables; eviction drops the wrapper (and with
+it the executable) once the LRU capacity (``GOSSIP_TPU_ENGINE_POOL_CAP``,
+default 64) is exceeded. Thread-safe: the serving plane's HTTP threads and
+batch executor share the default pool.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+from typing import Callable, Tuple
+
+DEFAULT_CAPACITY = 64
+
+
+class WarmEnginePool:
+    """LRU of canonical-key → compiled engine (a jitted callable or any
+    build product). ``get_or_build`` returns ``(engine, hit)`` so callers
+    can report warm/cold per dispatch (the serving stats do)."""
+
+    def __init__(self, capacity: int | None = None):
+        if capacity is None:
+            capacity = int(
+                os.environ.get("GOSSIP_TPU_ENGINE_POOL_CAP", "")
+                or DEFAULT_CAPACITY
+            )
+        if capacity < 1:
+            raise ValueError(f"pool capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self._entries: collections.OrderedDict = collections.OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def get_or_build(self, key, build: Callable[[], object]) -> Tuple[object, bool]:
+        """Return ``(engine, True)`` on a warm hit, else build, insert, and
+        return ``(engine, False)``. The build runs under the lock — builds
+        are cheap wrapper constructions (jax.jit is lazy; tracing happens
+        at first call), and serializing them keeps double-builds out."""
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                return self._entries[key], True
+            engine = build()
+            self._entries[key] = engine
+            self.misses += 1
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+                self.evictions += 1
+            return engine, False
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "capacity": self.capacity,
+                "entries": len(self._entries),
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+            }
+
+
+_DEFAULT: WarmEnginePool | None = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def default_pool() -> WarmEnginePool:
+    """The process-wide pool models/runner.py, models/sweep.py and the
+    serving plane share."""
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        if _DEFAULT is None:
+            _DEFAULT = WarmEnginePool()
+        return _DEFAULT

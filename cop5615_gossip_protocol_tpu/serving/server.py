@@ -1,0 +1,482 @@
+"""gossip-as-a-service — stdlib HTTP + JSONL-socket front ends (no new
+runtime deps).
+
+HTTP endpoints:
+
+  POST /run      one simulation request (JSON, REQUEST_SCHEMA_VERSION).
+                 Responses are always structured JSON: 200 with the
+                 demultiplexed per-request result/telemetry/events, 400 on
+                 an invalid config (the SimConfig contract text verbatim),
+                 429 when the admission queue is full, 503 when every
+                 engine rung is exhausted — an engine rung walk is a
+                 structured ``serving.engine_degraded`` field on a 200,
+                 never a 500.
+  GET /stats     serving counters (admission/queue/batch-occupancy/latency
+                 percentiles + warm-pool stats; serving/admission.py).
+  GET /healthz   liveness probe.
+
+JSONL socket (the high-throughput transport — ``--jsonl-port``, on by
+default next to the HTTP port): newline-delimited JSON over a plain TCP
+connection, one request line in, one response line out (same request/
+response schema; the HTTP status rides in a ``status`` field). Python's
+HTTP machinery costs ~2 ms/request of pure parsing on a small box — at
+the >= 1k requests/s the load harness pins, that IS the budget — while a
+readline/JSON loop stays far under it. Ops endpoints (/stats, /healthz)
+stay HTTP-only.
+
+Request schema (v1)::
+
+    {"schema_version": 1, "n": 256, "topology": "grid2d",
+     "algorithm": "gossip", "seed": 7, "telemetry": false,
+     "params": {"fault_rate": 0.01, "quorum": 0.9, ...}}
+
+``params`` accepts the serving-compatible SimConfig knobs
+(_ALLOWED_PARAMS); anything else — sharding, watchdogs, reference
+semantics — is rejected loudly (400), matching the repo's loud-contract
+style. The entry points are ``serve.py`` at the repo root and
+``python -m cop5615_gossip_protocol_tpu.serving``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socketserver
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+
+from ..config import SimConfig, normalize_algorithm, normalize_topology
+from .admission import AdmissionError, ServingStats
+from .batcher import MicroBatcher
+
+REQUEST_SCHEMA_VERSION = 1
+RESPONSE_SCHEMA_VERSION = 1
+
+# SimConfig knobs a request's ``params`` may set. Everything here is
+# compatible with the vmapped batch engine (models/sweep.py) or its
+# one-shot degradation path; the absent ones (n_devices, stall_chunks,
+# mass_tolerance, replicas, engine, semantics, strict_engine,
+# pipeline_chunks) are host/per-run machinery a multiplexed service must
+# own itself.
+_ALLOWED_PARAMS = frozenset({
+    "dtype", "delta", "rumor_threshold", "term_rounds", "termination",
+    "max_rounds", "chunk_rounds", "target_frac", "suppress_converged",
+    "fault_rate", "crash_rate", "crash_schedule", "revive_rate",
+    "revive_schedule", "rejoin", "dup_rate", "delay_rounds", "quorum",
+    "delivery", "pool_size", "overlap_collectives",
+})
+
+
+def config_from_request(body: dict, max_n: int) -> Tuple[SimConfig, bool]:
+    """Build the SimConfig for one request body, or raise ValueError with
+    the contract text a 400 response carries."""
+    if not isinstance(body, dict):
+        raise ValueError("request body must be a JSON object")
+    version = body.get("schema_version", 1)
+    if not isinstance(version, int) or version < 1:
+        raise ValueError(f"schema_version must be a positive int, got {version!r}")
+    if version > REQUEST_SCHEMA_VERSION:
+        raise ValueError(
+            f"request schema_version {version} is newer than this server's "
+            f"{REQUEST_SCHEMA_VERSION}"
+        )
+    missing = [k for k in ("n", "topology", "algorithm") if k not in body]
+    if missing:
+        raise ValueError(f"request is missing required fields: {missing}")
+    n = body["n"]
+    if not isinstance(n, int) or n < 1:
+        raise ValueError(f"n must be a positive int, got {n!r}")
+    if n > max_n:
+        raise ValueError(
+            f"n={n} exceeds this server's per-request population cap "
+            f"{max_n} (GOSSIP_TPU_SERVE_MAX_N); the serving plane "
+            "multiplexes many small requests — run giant populations "
+            "through the CLI"
+        )
+    params = body.get("params", {}) or {}
+    if not isinstance(params, dict):
+        raise ValueError("params must be a JSON object")
+    unknown = sorted(set(params) - _ALLOWED_PARAMS)
+    if unknown:
+        raise ValueError(
+            f"unsupported params {unknown}; serving accepts "
+            f"{sorted(_ALLOWED_PARAMS)}"
+        )
+    want_telemetry = bool(body.get("telemetry", False))
+    seed = body.get("seed", 0)
+    if not isinstance(seed, int) or not (0 <= seed < 2**32):
+        # The upper bound keeps the host-side threefry key-data fast path
+        # exact (models/sweep._host_key_data) and is x64-mode-independent
+        # (PRNGKey truncates or overflows on wider seeds depending on
+        # mode — neither belongs in a serving response).
+        raise ValueError(
+            f"seed must be an int in [0, 2**32), got {seed!r}"
+        )
+    cfg = SimConfig(
+        n=n,
+        topology=normalize_topology(str(body["topology"])),
+        algorithm=normalize_algorithm(str(body["algorithm"])),
+        seed=seed,
+        engine="chunked",
+        telemetry=want_telemetry,
+        **params,
+    )
+    return cfg, want_telemetry
+
+
+class ServingApp:
+    """The HTTP-free core: admission → micro-batcher → response. Tests and
+    in-process load drivers use it directly; the HTTP handler is a thin
+    JSON shim over ``handle_run``/``stats``."""
+
+    def __init__(
+        self,
+        window_s: float = 0.003,
+        max_lanes: int = 64,
+        queue_limit: int = 256,
+        batching: bool = True,
+        event_log=None,
+        request_timeout_s: float = 300.0,
+        max_n: Optional[int] = None,
+        min_lanes: int = 8,
+    ):
+        self.stats = ServingStats()
+        self.event_log = event_log
+        self.request_timeout_s = float(request_timeout_s)
+        self.max_n = int(
+            max_n if max_n is not None
+            else os.environ.get("GOSSIP_TPU_SERVE_MAX_N", "") or 65536
+        )
+        self.batcher = MicroBatcher(
+            stats=self.stats, window_s=window_s, max_lanes=max_lanes,
+            queue_limit=queue_limit, batching=batching, event_log=event_log,
+            min_lanes=min_lanes,
+        ).start()
+
+    def _submit(self, body) -> Tuple[int, object]:
+        """Admit one request. Returns (0, ServeRequest) on admission, or
+        (status, error_body) on validation/admission failure."""
+        self.stats.on_received()
+        try:
+            cfg, want_telemetry = config_from_request(body, self.max_n)
+        except (ValueError, TypeError) as e:
+            # TypeError too: SimConfig validation compares raw param
+            # values (e.g. 0.0 <= "0.1" raises TypeError), and the
+            # "always a structured response, never a dropped connection"
+            # contract — plus the received == admitted+rejected+invalid
+            # identity — must survive wrong-typed params.
+            self.stats.on_invalid()
+            return 400, {
+                "ok": False, "error": "invalid-config", "detail": str(e),
+                "schema_version": RESPONSE_SCHEMA_VERSION,
+            }
+        try:
+            return 0, self.batcher.submit(cfg, want_telemetry)
+        except AdmissionError as e:
+            self.stats.on_rejected()
+            if self.event_log is not None:
+                self.event_log.emit(
+                    "admission-rejected", queue_depth=e.queue_depth,
+                    queue_limit=e.queue_limit,
+                )
+            return 429, {
+                "ok": False, "error": "admission-rejected",
+                "detail": str(e),
+                "queue_depth": e.queue_depth,
+                "queue_limit": e.queue_limit,
+                "schema_version": RESPONSE_SCHEMA_VERSION,
+            }
+
+    def _await(self, req) -> Tuple[int, dict]:
+        if not req.ready.wait(timeout=self.request_timeout_s):
+            return 503, {
+                "ok": False, "error": "timeout",
+                "detail": f"request {req.request_id} still queued/running "
+                f"after {self.request_timeout_s}s",
+                "request_id": req.request_id,
+                "schema_version": RESPONSE_SCHEMA_VERSION,
+            }
+        resp = dict(req.response)
+        resp["schema_version"] = RESPONSE_SCHEMA_VERSION
+        return req.status, resp
+
+    def handle_run(self, body) -> Tuple[int, dict]:
+        status, out = self._submit(body)
+        if status:
+            return status, out
+        return self._await(out)
+
+    MAX_BATCH_REQUEST = 1024
+
+    def handle_batch(self, body) -> Tuple[int, dict]:
+        """Multi-request envelope: ``{"requests": [run-request, ...]}`` ->
+        ``{"responses": [run-response-with-status, ...]}`` in order. All
+        member requests are ADMITTED before any is awaited, so one
+        envelope's requests co-batch by construction; per-member failures
+        (invalid config, admission rejection) ride in that member's slot —
+        the envelope itself only 400s on a malformed envelope. This is the
+        high-throughput client shape: one connection multiplexes many
+        closed-loop users at one socket/JSON round trip per wave
+        (benchmarks/loadgen.py)."""
+        if not isinstance(body, dict) or not isinstance(
+            body.get("requests"), list
+        ):
+            return 400, {
+                "ok": False, "error": "invalid-batch",
+                "detail": "body must be {\"requests\": [run-request, ...]}",
+                "schema_version": RESPONSE_SCHEMA_VERSION,
+            }
+        members = body["requests"]
+        if not (1 <= len(members) <= self.MAX_BATCH_REQUEST):
+            return 400, {
+                "ok": False, "error": "invalid-batch",
+                "detail": f"requests must hold 1..{self.MAX_BATCH_REQUEST} "
+                f"entries, got {len(members)}",
+                "schema_version": RESPONSE_SCHEMA_VERSION,
+            }
+        slots = [self._submit(m) for m in members]
+        out = []
+        for status, item in slots:
+            if status:
+                err = dict(item)
+                err["status"] = status
+                out.append(err)
+            else:
+                status, resp = self._await(item)
+                resp["status"] = status
+                out.append(resp)
+        return 200, {
+            "ok": True, "responses": out,
+            "schema_version": RESPONSE_SCHEMA_VERSION,
+        }
+
+    def snapshot(self) -> dict:
+        snap = self.stats.snapshot()
+        snap["schema_version"] = RESPONSE_SCHEMA_VERSION
+        return snap
+
+    def close(self) -> None:
+        self.batcher.stop(drain=True)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "gossip-tpu-serve/1"
+    protocol_version = "HTTP/1.1"  # keep-alive: closed-loop clients reuse
+    # one connection per thread (benchmarks/loadgen.py)
+    app: ServingApp = None  # class attribute, set by make_server
+    quiet: bool = True
+
+    def _send(self, status: int, payload: dict) -> None:
+        data = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+        if self.path == "/healthz":
+            self._send(200, {"ok": True})
+        elif self.path == "/stats":
+            self._send(200, self.app.snapshot())
+        else:
+            self._send(404, {"ok": False, "error": "not-found",
+                             "detail": f"no such endpoint {self.path!r}"})
+
+    def do_POST(self):  # noqa: N802
+        if self.path not in ("/run", "/batch"):
+            self._send(404, {"ok": False, "error": "not-found",
+                             "detail": f"no such endpoint {self.path!r}"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(length) or b"{}")
+        except (ValueError, json.JSONDecodeError) as e:
+            self._send(400, {"ok": False, "error": "invalid-json",
+                             "detail": str(e)})
+            return
+        if self.path == "/batch":
+            status, payload = self.app.handle_batch(body)
+        else:
+            status, payload = self.app.handle_run(body)
+        self._send(status, payload)
+
+    def log_message(self, fmt, *args):  # noqa: A002
+        if not self.quiet:
+            super().log_message(fmt, *args)
+
+
+def make_server(app: ServingApp, host: str = "127.0.0.1",
+                port: int = 0, quiet: bool = True) -> ThreadingHTTPServer:
+    handler = type("BoundHandler", (_Handler,), {"app": app, "quiet": quiet})
+    return ThreadingHTTPServer((host, port), handler)
+
+
+class _JsonlHandler(socketserver.StreamRequestHandler):
+    """One connected JSONL client: request line in -> response line out,
+    until the client closes. The handler thread blocks inside
+    ``handle_run`` while the request waits for its batch — exactly one
+    in-flight request per connection (the closed-loop client shape)."""
+
+    app: ServingApp = None
+
+    def handle(self):
+        for line in self.rfile:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                body = json.loads(line)
+            except json.JSONDecodeError as e:
+                status, resp = 400, {
+                    "ok": False, "error": "invalid-json", "detail": str(e),
+                    "schema_version": RESPONSE_SCHEMA_VERSION,
+                }
+            else:
+                # A "requests" list is the multi-user envelope
+                # (ServingApp.handle_batch) — one line multiplexes many
+                # closed-loop users.
+                if isinstance(body, dict) and "requests" in body:
+                    status, resp = self.app.handle_batch(body)
+                else:
+                    status, resp = self.app.handle_run(body)
+            resp = dict(resp)
+            resp["status"] = status
+            try:
+                self.wfile.write(json.dumps(resp).encode() + b"\n")
+            except OSError:
+                return  # client went away mid-response
+
+
+class _JsonlServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def make_jsonl_server(app: ServingApp, host: str = "127.0.0.1",
+                      port: int = 0) -> _JsonlServer:
+    handler = type("BoundJsonlHandler", (_JsonlHandler,), {"app": app})
+    return _JsonlServer((host, port), handler)
+
+
+def main(argv=None) -> int:
+    import argparse
+    import signal
+
+    ap = argparse.ArgumentParser(
+        prog="gossip-tpu-serve", description=__doc__.splitlines()[0]
+    )
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8321,
+                    help="0 picks an ephemeral port (printed on the "
+                    "SERVING line)")
+    ap.add_argument("--jsonl-port", type=int, default=0,
+                    help="JSONL-socket transport port (0 = ephemeral, "
+                    "printed on the SERVING line; -1 disables)")
+    ap.add_argument("--window-ms", type=float, default=3.0,
+                    help="batching window: how long the micro-batcher "
+                    "holds the door open for co-bucket arrivals")
+    ap.add_argument("--max-lanes", type=int, default=64,
+                    help="max requests per vmapped batch (lane counts "
+                    "round up to powers of two)")
+    ap.add_argument("--min-lanes", type=int, default=8,
+                    help="lane-width floor: straggler batches pad up to "
+                    "this width so a bucket compiles few width variants")
+    ap.add_argument("--queue-limit", type=int, default=256,
+                    help="admission bound: requests waiting beyond this "
+                    "are rejected with 429")
+    ap.add_argument("--no-batching", action="store_true",
+                    help="control mode: every request runs as its own "
+                    "single-lane program (the loadgen ratio baseline)")
+    ap.add_argument("--request-timeout", type=float, default=300.0)
+    ap.add_argument("--max-n", type=int, default=None,
+                    help="per-request population cap (default "
+                    "GOSSIP_TPU_SERVE_MAX_N or 65536)")
+    ap.add_argument("--platform", choices=["auto", "cpu", "tpu"],
+                    default="auto")
+    ap.add_argument("--compile-cache", type=str, default=None, metavar="DIR",
+                    help="persistent XLA compilation cache ('auto' = the "
+                    "CLI default location)")
+    ap.add_argument("--events", type=str, default=None, metavar="FILE",
+                    help="append server lifecycle events (server-start, "
+                    "batch-retired, admission-rejected, server-stop) as "
+                    "schema-versioned JSONL (utils/events.py)")
+    ap.add_argument("--verbose", action="store_true",
+                    help="log every HTTP request to stderr")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    from ..utils.compat import ensure_partitionable_threefry
+
+    ensure_partitionable_threefry()
+    if args.platform != "auto":
+        jax.config.update("jax_platforms", args.platform)
+    if args.compile_cache is not None:
+        from ..utils.compat import enable_compilation_cache
+
+        enable_compilation_cache(
+            None if args.compile_cache == "auto" else args.compile_cache
+        )
+
+    event_log = None
+    if args.events:
+        from ..utils.events import RunEventLog
+
+        event_log = RunEventLog(args.events)
+
+    app = ServingApp(
+        window_s=args.window_ms / 1e3,
+        max_lanes=args.max_lanes,
+        queue_limit=args.queue_limit,
+        batching=not args.no_batching,
+        event_log=event_log,
+        request_timeout_s=args.request_timeout,
+        max_n=args.max_n,
+        min_lanes=args.min_lanes,
+    )
+    httpd = make_server(app, args.host, args.port, quiet=not args.verbose)
+    host, port = httpd.server_address[:2]
+    jsonld = None
+    jsonl_port = -1
+    if args.jsonl_port >= 0:
+        jsonld = make_jsonl_server(app, args.host, args.jsonl_port)
+        jsonl_port = jsonld.server_address[1]
+        threading.Thread(
+            target=jsonld.serve_forever, name="gossip-serve-jsonl",
+            daemon=True,
+        ).start()
+    if event_log is not None:
+        event_log.emit(
+            "server-start", host=host, port=port, jsonl_port=jsonl_port,
+            batching=not args.no_batching, max_lanes=args.max_lanes,
+            queue_limit=args.queue_limit, window_ms=args.window_ms,
+        )
+    # The machine-readable readiness line loadgen/CI parse — keep format.
+    print(f"SERVING {host} {port} {jsonl_port}", flush=True)
+
+    def _stop(signum, frame):
+        threading.Thread(target=httpd.shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGINT, _stop)
+    signal.signal(signal.SIGTERM, _stop)
+    try:
+        httpd.serve_forever()
+    finally:
+        if jsonld is not None:
+            jsonld.shutdown()
+            jsonld.server_close()
+        httpd.server_close()
+        app.close()
+        snap = app.snapshot()
+        if event_log is not None:
+            event_log.emit("server-stop", stats=snap)
+        print(json.dumps({"server-stats": snap}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -35,11 +35,25 @@ seconds since the log was opened):
   run-end                 outcome, rounds, wall/compile/dispatch/fetch
                           splits, once, last
 
+Serving-plane vocabulary (schema v3 — emitted by ``serve.py`` /
+serving/server.py into its ``--events`` log; the per-REQUEST lifecycle
+stream is demultiplexed into each HTTP response instead, see
+serving/batcher.ServeRequest.emit):
+
+  server-start            host/port + batching/window/lane/queue config
+  batch-retired           one micro-batch executed: bucket label,
+                          occupancy, lanes, warm-pool verdict, wall
+  admission-rejected      the bounded queue turned a request away
+                          (queue_depth, queue_limit)
+  server-stop             final /stats snapshot
+
 Consumers detect format drift via ``schema_version`` — bump EVENT_SCHEMA_
 VERSION whenever a field changes meaning, never reuse a name. History:
 1 — the PR 3 vocabulary; 2 — engine-degraded + sentinel-tripped event
 types, run-start gains ``warnings``, crash-schedule-applied gains the
-revive_rate/revive_schedule/rejoin recovery fields.
+revive_rate/revive_schedule/rejoin recovery fields; 3 — the serving-plane
+event types (server-start, batch-retired, admission-rejected,
+server-stop).
 """
 
 from __future__ import annotations
@@ -49,7 +63,7 @@ from pathlib import Path
 
 from . import metrics
 
-EVENT_SCHEMA_VERSION = 2
+EVENT_SCHEMA_VERSION = 3
 
 
 class RunEventLog:

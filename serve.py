@@ -1,0 +1,19 @@
+#!/usr/bin/env python
+"""gossip-as-a-service entry point — see cop5615_gossip_protocol_tpu/serving/.
+
+  python serve.py --port 8321 --window-ms 3 --max-lanes 64
+
+POST /run with {"schema_version": 1, "n": 256, "topology": "grid2d",
+"algorithm": "gossip", "seed": 7}; GET /stats, /healthz. Drive load with
+``python benchmarks/loadgen.py``.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+from cop5615_gossip_protocol_tpu.serving.server import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

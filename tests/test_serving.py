@@ -1,0 +1,674 @@
+"""Serving plane (ISSUE 6): canonical engine keys, the warm pool, the
+heterogeneous micro-batcher's bitwise parity with one-shot runs, admission
+control, the HTTP/JSONL fronts, the degradation availability story, and
+the pinned batching-ratio contract."""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from cop5615_gossip_protocol_tpu import SimConfig, build_topology
+from cop5615_gossip_protocol_tpu.config import MAX_REPLICAS
+from cop5615_gossip_protocol_tpu.models.runner import _LEADER_TAG, run
+from cop5615_gossip_protocol_tpu.models.sweep import (
+    LANE_FILLER_TAG0,
+    REPLICA_TAG0,
+    _host_key_data,
+    run_batched_keys,
+)
+from cop5615_gossip_protocol_tpu.serving import keys as keys_mod
+from cop5615_gossip_protocol_tpu.serving import pool as pool_mod
+from cop5615_gossip_protocol_tpu.serving.admission import (
+    AdmissionError,
+    ServingStats,
+)
+from cop5615_gossip_protocol_tpu.serving.batcher import (
+    MicroBatcher,
+    lane_bucket,
+)
+from cop5615_gossip_protocol_tpu.serving.server import (
+    ServingApp,
+    config_from_request,
+    make_jsonl_server,
+    make_server,
+)
+
+# ------------------------------------------------------------ canonical keys
+
+
+def _key_of(cfg, kind=None, n=None):
+    topo = keys_mod.get_topology(kind or cfg.topology, n or cfg.n,
+                                 seed=cfg.seed)
+    return keys_mod.canonical_key(cfg, topo)
+
+
+def test_canonical_key_seed_invariant_fault_free():
+    a = _key_of(SimConfig(n=64, topology="full", algorithm="gossip", seed=0))
+    b = _key_of(SimConfig(n=64, topology="full", algorithm="gossip", seed=9))
+    assert a == b
+
+
+def test_canonical_key_splits_on_compile_class():
+    base = SimConfig(n=64, topology="full", algorithm="gossip", seed=0)
+    assert _key_of(base) != _key_of(
+        SimConfig(n=64, topology="full", algorithm="push-sum", seed=0)
+    )
+    assert _key_of(base) != _key_of(
+        SimConfig(n=128, topology="full", algorithm="gossip", seed=0)
+    )
+    assert _key_of(base) != _key_of(
+        SimConfig(n=64, topology="full", algorithm="gossip", seed=0,
+                  telemetry=True)
+    )
+    assert _key_of(base) != _key_of(
+        SimConfig(n=64, topology="full", algorithm="gossip", seed=0,
+                  fault_rate=0.1)
+    )
+
+
+def test_canonical_key_crash_model_pins_seed():
+    # The churn planes derive from PRNGKey(seed) and are BAKED into the
+    # traced round body — crash-model engines must be per-seed.
+    mk = lambda s: _key_of(SimConfig(  # noqa: E731
+        n=64, topology="full", algorithm="gossip", seed=s,
+        crash_schedule="3:8", quorum=0.9,
+    ))
+    assert mk(0) == mk(0)
+    assert mk(0) != mk(1)
+
+
+def test_fault_class_normalization_collapses_unused_knobs():
+    # quorum/rejoin are only consulted under a crash model: fault-free
+    # configs spelled differently must share one engine. (quorum != 1
+    # without a crash model lints, so compare via the fault class.)
+    with pytest.warns(RuntimeWarning):
+        relaxed = SimConfig(n=64, topology="full", algorithm="gossip",
+                            seed=0, quorum=0.9)
+    strictq = SimConfig(n=64, topology="full", algorithm="gossip", seed=3)
+    topo = keys_mod.get_topology("full", 64)
+    assert keys_mod.fault_class(relaxed) == ("fault-free",)
+    assert (keys_mod.canonical_key(relaxed, topo)
+            == keys_mod.canonical_key(strictq, topo))
+    # Explicit delta equal to the resolved default is the same program.
+    a = SimConfig(n=64, topology="full", algorithm="push-sum", seed=0)
+    b = SimConfig(n=64, topology="full", algorithm="push-sum", seed=0,
+                  delta=a.resolved_delta)
+    assert (keys_mod.canonical_key(a, topo)
+            == keys_mod.canonical_key(b, topo))
+
+
+def test_padded_population_buckets_by_builder_rounding():
+    # grid2d rounds the request up to a square: 95 and 100 land in the
+    # same padded-N bucket (and thus the same engine/batch bucket).
+    assert keys_mod.padded_population("grid2d", 95) == 100
+    assert keys_mod.padded_population("grid2d", 100) == 100
+    cfg95 = SimConfig(n=95, topology="grid2d", algorithm="gossip", seed=0)
+    cfg100 = SimConfig(n=100, topology="grid2d", algorithm="gossip", seed=1)
+    t95 = keys_mod.get_topology("grid2d", 95)
+    t100 = keys_mod.get_topology("grid2d", 100)
+    assert (keys_mod.serve_bucket_key(cfg95, t95)
+            == keys_mod.serve_bucket_key(cfg100, t100))
+
+
+def test_host_key_data_matches_prngkey():
+    # The serving hot path builds threefry key data on the host; a silent
+    # upstream layout change must fail here, not corrupt streams.
+    for s in (0, 3, 12345, 2**31, 2**32 - 1, 2**40 + 17):
+        np.testing.assert_array_equal(
+            _host_key_data(s), np.asarray(jax.random.PRNGKey(s)),
+            err_msg=f"seed {s}",
+        )
+    with pytest.raises(ValueError, match="seeds"):
+        _host_key_data(-1)
+
+
+def test_lane_filler_tag_region_disjoint():
+    # TAG MAP contract (ops/faults.py): filler tags sit above the replica
+    # region and below the leader tag.
+    assert LANE_FILLER_TAG0 == REPLICA_TAG0 + MAX_REPLICAS
+    assert LANE_FILLER_TAG0 > 2**30  # above every round index
+    hi = LANE_FILLER_TAG0 + 4096
+    assert hi < _LEADER_TAG
+    assert hi < 2**31
+
+
+def test_seed_built_topology_values_split_the_engine_key():
+    # imp2d neighbor tensors depend on the build seed; the batch engine
+    # caches the DEVICE tensors alongside the compiled chunk, so two
+    # same-shape imp graphs from different seeds must never share a key
+    # (review finding: shape-only identity served the wrong graph).
+    cfg = SimConfig(n=64, topology="imp2d", algorithm="gossip", seed=0)
+    ta = build_topology("imp2d", 64, seed=0)
+    tb = build_topology("imp2d", 64, seed=1)
+    assert keys_mod.canonical_key(cfg, ta) != keys_mod.canonical_key(cfg, tb)
+    # Same seed -> same key (fingerprint is content, not identity).
+    ta2 = build_topology("imp2d", 64, seed=0)
+    assert keys_mod.canonical_key(cfg, ta) == keys_mod.canonical_key(cfg, ta2)
+
+
+def test_batched_imp2d_uses_each_calls_own_graph():
+    # End-to-end: batch on graph A, then batch on same-shape graph B —
+    # lane 0 of B's batch must match the one-shot run on B, not replay A.
+    for seed in (0, 1):
+        topo = build_topology("imp2d", 64, seed=seed)
+        cfg = SimConfig(n=64, topology="imp2d", algorithm="gossip",
+                        seed=seed)
+        batch = run_batched_keys(topo, cfg, [seed], lanes=1)
+        res = run(topo, cfg)
+        assert batch.rounds[0] == res.rounds, f"topo seed {seed}"
+
+
+# ------------------------------------------------------------------ the pool
+
+
+def test_pool_lru_and_counters():
+    p = pool_mod.WarmEnginePool(capacity=2)
+    a, hit = p.get_or_build("a", lambda: "A")
+    assert (a, hit) == ("A", False)
+    a, hit = p.get_or_build("a", lambda: "A2")
+    assert (a, hit) == ("A", True)  # cached build wins
+    p.get_or_build("b", lambda: "B")
+    p.get_or_build("a", lambda: "A3")  # refresh a's recency
+    p.get_or_build("c", lambda: "C")  # evicts b (LRU)
+    assert p.get_or_build("b", lambda: "B2") == ("B2", False)
+    s = p.stats()
+    assert s["evictions"] >= 2 and s["entries"] == 2
+    assert s["hits"] == 2 and s["misses"] == 4
+
+
+def test_batch_engine_reused_across_seeds():
+    cfg = SimConfig(n=48, topology="full", algorithm="gossip", seed=0)
+    topo = build_topology("full", 48)
+    first = run_batched_keys(topo, cfg, [101, 102], lanes=2)
+    again = run_batched_keys(
+        topo, SimConfig(n=48, topology="full", algorithm="gossip", seed=77),
+        [201, 202], lanes=2,
+    )
+    assert again.engine_cache == "hit"
+    assert first.lanes == again.lanes == 2
+    # Different lane width is a different engine variant.
+    wider = run_batched_keys(topo, cfg, [1, 2, 3], lanes=4)
+    assert wider.lanes == 4
+
+
+# -------------------------------------------- batcher correctness (bitwise)
+
+
+def _one_shot(cfg, topo):
+    cap = {}
+
+    def hook(rounds, state):
+        cap["state"] = jax.tree.map(np.asarray, state)
+
+    res = run(topo, cfg, on_chunk=hook)
+    return res, cap["state"]
+
+
+def test_batched_gossip_bitwise_matches_one_shot_with_filler_lanes():
+    # Satellite: a bucketed mixed-config batch's per-request results
+    # bitwise-match the same requests run one-shot through runner.run —
+    # including when lane-count bucketing pads the batch (filler lanes
+    # ride the LANE_FILLER_TAG0 region and are discarded).
+    seeds = [3, 11, 42]
+    topo = build_topology("full", 64, seed=3)
+    cfg0 = SimConfig(n=64, topology="full", algorithm="gossip", seed=seeds[0],
+                     telemetry=True)
+    batch = run_batched_keys(topo, cfg0, seeds, lanes=4)
+    assert batch.lanes == 4 and batch.replicas == 3
+    for i, s in enumerate(seeds):
+        cfg = SimConfig(n=64, topology="full", algorithm="gossip", seed=s,
+                        telemetry=True)
+        res, state = _one_shot(cfg, topo)
+        assert batch.rounds[i] == res.rounds
+        assert batch.converged[i] == res.converged
+        for f in state._fields:
+            np.testing.assert_array_equal(
+                getattr(batch.final_states[i], f), getattr(state, f),
+                err_msg=f"seed {s} field {f}",
+            )
+        # Telemetry demux: lane i's rows are the one-shot plane's rows.
+        np.testing.assert_array_equal(
+            batch.telemetry[i].data, res.telemetry.data,
+            err_msg=f"seed {s} telemetry",
+        )
+
+
+def test_batched_pushsum_bitwise_matches_one_shot():
+    seeds = [5, 6, 7]
+    topo = build_topology("full", 48)
+    cfg0 = SimConfig(n=48, topology="full", algorithm="push-sum",
+                     seed=seeds[0], delta=1e-3)
+    batch = run_batched_keys(topo, cfg0, seeds, lanes=4)
+    for i, s in enumerate(seeds):
+        cfg = SimConfig(n=48, topology="full", algorithm="push-sum", seed=s,
+                        delta=1e-3)
+        res, state = _one_shot(cfg, topo)
+        assert batch.rounds[i] == res.rounds
+        # STATE parity is bitwise; the derived MAE report is computed by
+        # numpy host-side in the sweep vs XLA in the runner — reduction
+        # order differs in the last float32 bits.
+        for f in state._fields:
+            np.testing.assert_array_equal(
+                getattr(batch.final_states[i], f), getattr(state, f),
+                err_msg=f"seed {s} field {f}",
+            )
+        assert batch.estimate_mae[i] == pytest.approx(res.estimate_mae,
+                                                      rel=1e-5)
+
+
+def test_run_batched_keys_validates_lanes():
+    topo = build_topology("full", 32)
+    cfg = SimConfig(n=32, topology="full", algorithm="gossip", seed=0)
+    with pytest.raises(ValueError, match="at least one"):
+        run_batched_keys(topo, cfg, [])
+    with pytest.raises(ValueError, match="lanes"):
+        run_batched_keys(topo, cfg, [1, 2, 3], lanes=2)
+
+
+def test_lane_bucket():
+    assert lane_bucket(1, 64, 1) == 1
+    assert lane_bucket(3, 64, 1) == 4
+    assert lane_bucket(3, 64, 8) == 8
+    assert lane_bucket(9, 64, 8) == 16
+    assert lane_bucket(100, 64, 8) == 64
+    assert lane_bucket(1, 4, 8) == 4  # min clamps to max
+
+
+# --------------------------------------------------------- app + admission
+
+
+def _mk_app(**kw):
+    kw.setdefault("window_s", 0.01)
+    kw.setdefault("max_lanes", 8)
+    kw.setdefault("min_lanes", 1)
+    return ServingApp(**kw)
+
+
+def test_serving_app_end_to_end_two_buckets():
+    # Generous window: the co-batching assertion below needs all three
+    # full-topology submissions inside one batching window even on a
+    # noisy CI scheduler.
+    app = _mk_app(window_s=0.25)
+    try:
+        bodies = [
+            {"schema_version": 1, "n": 64, "topology": "full",
+             "algorithm": "gossip", "seed": s, "telemetry": True}
+            for s in range(3)
+        ] + [
+            {"schema_version": 1, "n": 36, "topology": "grid2d",
+             "algorithm": "gossip", "seed": 9},
+        ]
+        results = {}
+
+        def go(i):
+            results[i] = app.handle_run(bodies[i])
+
+        threads = [threading.Thread(target=go, args=(i,))
+                   for i in range(len(bodies))]
+        # Poll /stats WHILE requests are in flight: snapshot() and
+        # submit() take the stats and queue locks in opposite orders, so
+        # a lock inversion would deadlock this test (review finding).
+        polling = {"stop": False}
+
+        def poll():
+            while not polling["stop"]:
+                app.snapshot()
+                time.sleep(0.005)
+
+        poller = threading.Thread(target=poll)
+        poller.start()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        polling["stop"] = True
+        poller.join()
+        for i, (status, resp) in results.items():
+            assert status == 200, resp
+            assert resp["ok"] and resp["result"]["outcome"] == "converged"
+            assert resp["serving"]["engine_degraded"] is None
+            assert resp["serving"]["batch_occupancy"] >= 1
+            assert any(e["event"] == "batch-dispatched"
+                       for e in resp["events"])
+        # The full-topology trio co-batched (same bucket, one window).
+        occ = [r["serving"]["batch_occupancy"]
+               for (st, r) in results.values()
+               if r["result"]["topology"] == "full"]
+        assert max(occ) == 3
+        # Telemetry demux: each full-bucket response carries ITS rows.
+        for i in range(3):
+            _, resp = results[i]
+            traj = resp["telemetry"]
+            assert len(traj) == resp["result"]["rounds"]
+            assert (traj[-1]["converged_count"]
+                    == resp["result"]["converged_count"])
+        snap = app.snapshot()
+        assert snap["received"] == snap["admitted"] == 4
+        assert snap["completed"] == 4 and snap["failed"] == 0
+        assert snap["batched_requests"] == 4
+        assert len(snap["buckets"]) == 2
+        assert snap["service_ms_p99"] is not None
+    finally:
+        app.close()
+
+
+def test_handle_batch_envelope_preserves_order_and_slots_errors():
+    app = _mk_app()
+    try:
+        status, resp = app.handle_batch({"requests": [
+            {"schema_version": 1, "n": 32, "topology": "full",
+             "algorithm": "gossip", "seed": 1},
+            {"schema_version": 1, "n": 32, "topology": "full",
+             "algorithm": "gossip", "seed": 2,
+             "params": {"n_devices": 2}},  # invalid: slot-level 400
+            {"schema_version": 1, "n": 32, "topology": "full",
+             "algorithm": "gossip", "seed": 3},
+        ]})
+        assert status == 200 and resp["ok"]
+        st = [m["status"] for m in resp["responses"]]
+        assert st == [200, 400, 200]
+        assert resp["responses"][1]["error"] == "invalid-config"
+        assert (resp["responses"][0]["result"]["rounds"] > 0)
+        status, resp = app.handle_batch({"requests": []})
+        assert status == 400
+        status, resp = app.handle_batch({"nope": 1})
+        assert status == 400
+    finally:
+        app.close()
+
+
+def test_admission_bounded_queue_rejects():
+    stats = ServingStats()
+    b = MicroBatcher(stats=stats, queue_limit=2, min_lanes=1)
+    # NOT started: submissions stay queued, so the bound is observable.
+    b.submit(SimConfig(n=32, topology="full", algorithm="gossip",
+                       seed=0, engine="chunked"), False)
+    b.submit(SimConfig(n=32, topology="full", algorithm="gossip",
+                       seed=1, engine="chunked"), False)
+    with pytest.raises(AdmissionError) as e:
+        b.submit(SimConfig(n=32, topology="full", algorithm="gossip",
+                           seed=2, engine="chunked"), False)
+    assert e.value.queue_depth == 2 and e.value.queue_limit == 2
+    assert stats._depth_fn() == 2
+    b.stop(drain=False)
+    assert stats.failed == 2  # undispatched requests failed structurally
+
+
+def test_invalid_configs_are_structured_400s():
+    app = _mk_app(max_n=1000)
+    try:
+        for body, marker in [
+            ({"n": 64, "topology": "full"}, "missing"),
+            ({"n": 64, "topology": "nope", "algorithm": "gossip"},
+             "unknown topology"),
+            ({"n": 64, "topology": "full", "algorithm": "gossip",
+              "params": {"stall_chunks": 2}}, "unsupported params"),
+            ({"n": 5000, "topology": "full", "algorithm": "gossip"},
+             "population cap"),
+            ({"n": 64, "topology": "full", "algorithm": "gossip",
+              "schema_version": 99}, "newer"),
+            ({"n": 64, "topology": "full", "algorithm": "gossip",
+              "params": {"quorum": 2.0}}, "quorum"),
+            # Wrong-TYPED param values raise TypeError inside SimConfig
+            # validation ("0.0 <= '0.1'") — still a structured 400, never
+            # a dropped connection (review finding).
+            ({"n": 64, "topology": "full", "algorithm": "gossip",
+              "params": {"fault_rate": "0.1"}}, None),
+        ]:
+            status, resp = app.handle_run(body)
+            assert status == 400, body
+            assert resp["error"] == "invalid-config"
+            if marker is not None:
+                assert marker in resp["detail"], (marker, resp["detail"])
+        snap = app.snapshot()
+        assert snap["invalid"] == 7
+        assert snap["received"] == (
+            snap["admitted"] + snap["rejected"] + snap["invalid"]
+        )
+    finally:
+        app.close()
+
+
+def test_degraded_batch_walks_to_one_shot_never_500(monkeypatch):
+    # Availability story: an environmental failure of the vmapped batch
+    # engine degrades to per-request one-shot runs with a structured
+    # engine_degraded field — never an opaque failure.
+    monkeypatch.setenv("GOSSIP_TPU_STRICT_ENGINE", "0")
+    from cop5615_gossip_protocol_tpu.models import sweep as sweep_mod
+
+    def boom(*a, **k):
+        raise RuntimeError("injected RESOURCE_EXHAUSTED: vmem")
+
+    monkeypatch.setattr(sweep_mod, "run_batched_keys", boom)
+    app = _mk_app()
+    try:
+        status, resp = app.handle_run(
+            {"schema_version": 1, "n": 32, "topology": "full",
+             "algorithm": "gossip", "seed": 4, "telemetry": True}
+        )
+        assert status == 200, resp
+        walk = resp["serving"]["engine_degraded"]
+        assert walk and walk[0]["from"] == "batched-vmap"
+        assert "injected" in walk[0]["reason"]
+        assert resp["result"]["outcome"] == "converged"
+        assert len(resp["telemetry"]) == resp["result"]["rounds"]
+        snap = app.snapshot()
+        assert snap["degraded"] == 1
+        # The occupancy identity must survive the degraded path (the
+        # one-shot walk counts its own single-lane batch — no double
+        # count from the failed vmapped attempt; review finding).
+        assert snap["batched_requests"] == snap["completed"] + snap["failed"]
+    finally:
+        app.close()
+
+
+def test_degraded_batch_strict_mode_is_structured_503(monkeypatch):
+    monkeypatch.setenv("GOSSIP_TPU_STRICT_ENGINE", "1")
+    from cop5615_gossip_protocol_tpu.models import sweep as sweep_mod
+
+    monkeypatch.setattr(
+        sweep_mod, "run_batched_keys",
+        lambda *a, **k: (_ for _ in ()).throw(RuntimeError("env down")),
+    )
+    app = _mk_app()
+    try:
+        status, resp = app.handle_run(
+            {"schema_version": 1, "n": 32, "topology": "full",
+             "algorithm": "gossip", "seed": 4}
+        )
+        assert status == 503
+        assert resp["error"] == "engine-unavailable"
+        assert "env down" in resp["detail"]
+        snap = app.snapshot()
+        assert snap["failed"] == 1 and snap["completed"] == 0
+    finally:
+        app.close()
+
+
+def test_executor_survives_unexpected_engine_exception(monkeypatch):
+    # A poison request whose execution raises OUTSIDE the degradation
+    # vocabulary must fail structurally and leave the executor alive for
+    # the next request (review finding: a dead executor thread is a
+    # one-request denial of service).
+    from cop5615_gossip_protocol_tpu.models import sweep as sweep_mod
+
+    real = sweep_mod.run_batched_keys
+    state = {"boom": True}
+
+    def flaky(*a, **k):
+        if state["boom"]:
+            state["boom"] = False
+            raise OverflowError("Python int too large to convert to C long")
+        return real(*a, **k)
+
+    monkeypatch.setattr(sweep_mod, "run_batched_keys", flaky)
+    app = _mk_app()
+    try:
+        status, resp = app.handle_run(
+            {"schema_version": 1, "n": 32, "topology": "full",
+             "algorithm": "gossip", "seed": 1}
+        )
+        assert status == 503 and resp["error"] == "internal-error"
+        assert "OverflowError" in resp["detail"]
+        status, resp = app.handle_run(
+            {"schema_version": 1, "n": 32, "topology": "full",
+             "algorithm": "gossip", "seed": 2}
+        )
+        assert status == 200 and resp["result"]["outcome"] == "converged"
+        snap = app.snapshot()
+        assert snap["batched_requests"] == snap["completed"] + snap["failed"]
+    finally:
+        app.close()
+
+
+def test_request_seed_bounded_at_validation():
+    app = _mk_app()
+    try:
+        for bad in (-1, 2**32, 2**80, "7"):
+            status, resp = app.handle_run(
+                {"schema_version": 1, "n": 32, "topology": "full",
+                 "algorithm": "gossip", "seed": bad}
+            )
+            assert status == 400 and "seed" in resp["detail"], bad
+    finally:
+        app.close()
+
+
+# ------------------------------------------------------- HTTP + JSONL fronts
+
+
+def test_http_and_jsonl_round_trip():
+    app = _mk_app()
+    httpd = make_server(app, "127.0.0.1", 0)
+    jsonld = make_jsonl_server(app, "127.0.0.1", 0)
+    for srv in (httpd, jsonld):
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        import http.client
+
+        host, port = httpd.server_address[:2]
+        conn = http.client.HTTPConnection(host, port, timeout=60)
+        conn.request("POST", "/run", json.dumps(
+            {"schema_version": 1, "n": 32, "topology": "full",
+             "algorithm": "push-sum", "seed": 2}
+        ), {"Content-Type": "application/json"})
+        r = conn.getresponse()
+        payload = json.loads(r.read())
+        assert r.status == 200 and payload["ok"]
+        assert payload["result"]["estimate_mae"] is not None
+        assert payload["schema_version"] == 1
+        conn.request("GET", "/healthz")
+        assert conn.getresponse().read() == b'{"ok": true}'
+        conn.request("GET", "/stats")
+        stats = json.loads(conn.getresponse().read())
+        assert stats["completed"] >= 1
+        conn.request("GET", "/nope")
+        assert conn.getresponse().status == 404
+        conn.close()
+
+        jhost, jport = jsonld.server_address[:2]
+        sock = socket.create_connection((jhost, jport), timeout=60)
+        rfile = sock.makefile("rb")
+        # Single request line, then a multi-user envelope line.
+        sock.sendall(json.dumps(
+            {"schema_version": 1, "n": 32, "topology": "full",
+             "algorithm": "gossip", "seed": 5}
+        ).encode() + b"\n")
+        one = json.loads(rfile.readline())
+        assert one["status"] == 200 and one["ok"]
+        sock.sendall(json.dumps({"requests": [
+            {"schema_version": 1, "n": 32, "topology": "full",
+             "algorithm": "gossip", "seed": s} for s in (6, 7)
+        ]}).encode() + b"\n")
+        env = json.loads(rfile.readline())
+        assert env["status"] == 200 and len(env["responses"]) == 2
+        assert all(m["status"] == 200 for m in env["responses"])
+        sock.sendall(b"not json\n")
+        bad = json.loads(rfile.readline())
+        assert bad["status"] == 400 and bad["error"] == "invalid-json"
+        rfile.close()
+        sock.close()
+    finally:
+        for srv in (httpd, jsonld):
+            srv.shutdown()
+            srv.server_close()
+        app.close()
+
+
+# ----------------------------------------------------- pinned batching ratio
+
+
+def test_batching_beats_batching_off_control_pinned():
+    """The micro-batcher's reason to exist, pinned: serving K same-bucket
+    requests as vmapped batches beats serving them one program at a time
+    (same warm pool both ways). Floor env-overridable:
+    GOSSIP_TPU_SERVE_BATCH_RATIO (default 1.3)."""
+    floor = float(os.environ.get("GOSSIP_TPU_SERVE_BATCH_RATIO", "") or 1.3)
+    K = 24
+    bodies = [
+        {"schema_version": 1, "n": 32, "topology": "full",
+         "algorithm": "gossip", "seed": 1000 + s, "params":
+         {"rumor_threshold": 5}}
+        for s in range(K)
+    ]
+
+    def serve_all(app):
+        results = [None] * K
+
+        def go(i):
+            results[i] = app.handle_run(dict(bodies[i], seed=bodies[i]["seed"]))
+
+        threads = [threading.Thread(target=go, args=(i,)) for i in range(K)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        assert all(st == 200 for st, _ in results)
+        return wall
+
+    # min_lanes == max_lanes pins ONE compiled width for the batched app,
+    # so occupancy jitter between passes can never trigger a mid-
+    # measurement compile.
+    batched_app = _mk_app(max_lanes=32, min_lanes=32, window_s=0.02)
+    control_app = ServingApp(window_s=0.02, max_lanes=32, min_lanes=1,
+                             batching=False)
+    try:
+        # Warm both paths (compile is process state, not steady state),
+        # then best-of-3 to shed scheduler noise.
+        serve_all(batched_app)
+        serve_all(control_app)
+        batched = min(serve_all(batched_app) for _ in range(3))
+        control = min(serve_all(control_app) for _ in range(3))
+    finally:
+        batched_app.close()
+        control_app.close()
+    ratio = control / batched
+    assert ratio >= floor, (
+        f"batching speedup {ratio:.2f}x under the floor {floor}x "
+        f"(batched {batched * 1e3:.0f} ms vs control {control * 1e3:.0f} ms "
+        f"for {K} requests)"
+    )
+
+
+# ---------------------------------------------------------- request parsing
+
+
+def test_config_from_request_forces_chunked_engine():
+    cfg, tele = config_from_request(
+        {"schema_version": 1, "n": 64, "topology": "2D",
+         "algorithm": "pushsum", "telemetry": True,
+         "params": {"quorum": 0.9, "crash_rate": 0.01}},
+        65536,
+    )
+    assert cfg.engine == "chunked"
+    assert cfg.topology == "grid2d" and cfg.algorithm == "push-sum"
+    assert tele is True and cfg.telemetry is True
+    assert cfg.crash_model

@@ -153,6 +153,42 @@ def test_sweep_rejects_unsupported_configs():
         run_replicas(topo, SimConfig(n=64, stall_chunks=2), 2)
 
 
+def test_replicas_contracts_fail_fast_at_config_time():
+    """ISSUE 6 satellite: --replicas + --engine fused used to raise only
+    AFTER topology build (models/sweep._reject_unsupported); the contract
+    now lives in SimConfig.__post_init__ — loud at construction, before
+    any build work, same style as the revive/crash checks."""
+    with pytest.raises(ValueError, match="fused"):
+        SimConfig(n=64, engine="fused", replicas=2)
+    with pytest.raises(ValueError, match="reference"):
+        SimConfig(n=64, semantics="reference", replicas=2)
+    with pytest.raises(ValueError, match="n_devices"):
+        SimConfig(n=64, n_devices=4, replicas=2)
+    with pytest.raises(ValueError, match="stall"):
+        SimConfig(n=64, stall_chunks=2, replicas=2)
+    with pytest.raises(ValueError, match="mass_tolerance|health sentinel"):
+        SimConfig(n=64, algorithm="push-sum", mass_tolerance=1e-3,
+                  replicas=2)
+    with pytest.raises(ValueError, match="replicas must be"):
+        SimConfig(n=64, replicas=0)
+    with pytest.raises(ValueError, match="replicas must be"):
+        SimConfig(n=64, replicas=MAX_REPLICAS + 1)
+    # replicas=1 is the plain run: no sweep contract applies.
+    SimConfig(n=64, engine="fused", replicas=1)
+
+
+def test_cli_replicas_fused_fails_fast(capsys):
+    """The CLI path: the error surfaces from SimConfig construction (exit
+    2, before topology build), not from deep inside the sweep engine."""
+    from cop5615_gossip_protocol_tpu.cli import main
+
+    rc = main(["64", "full", "gossip", "--replicas", "2",
+               "--engine", "fused"])
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "Invalid:" in err and "fused" in err
+
+
 # ------------------------------------------------------------------- CLI
 
 
