@@ -23,6 +23,19 @@ throughput + p50/p99 latency.
 Default mode runs the same phases with longer windows and no hard pins —
 the BENCH_TABLES.md "Serving plane" row generator
 (``python benchmarks/loadgen.py --md serving.md --json serving.json``).
+
+``--metrics-smoke`` is the CI metrics-smoke contract (ISSUE 7): the
+server runs with ``--events``, a scraper thread GETs ``/metrics`` WHILE
+the closed loop is driving (every scrape must parse as Prometheus text),
+and after the drive the job asserts (a) the serving series satisfy the
+same accounting identities ``/stats`` pins (received == admitted +
+rejected + invalid, etc. — checked at quiescence; a mid-validation scrape
+may transiently run one ahead), (b) every sampled response's span
+breakdown (queue_wait/batch_assemble/engine/demux) sums to within 5% of
+its measured service latency, and (c) one sampled response's trace_id
+joins request-admitted -> batch-retired -> request-completed in the
+server's event log (schema v4) — the request-lifecycle reconstruction the
+tracing plane promises.
 """
 
 from __future__ import annotations
@@ -351,6 +364,97 @@ def check_telemetry_responses(responses: list) -> int:
     return checked
 
 
+def scrape_metrics(server) -> dict:
+    """GET /metrics and parse the Prometheus exposition — a malformed
+    line fails here, loudly (utils/obs.parse_prometheus)."""
+    from cop5615_gossip_protocol_tpu.utils import obs
+
+    conn = http.client.HTTPConnection(server.host, server.port, timeout=30)
+    conn.request("GET", "/metrics")
+    resp = conn.getresponse()
+    text = resp.read().decode()
+    conn.close()
+    assert resp.status == 200, resp.status
+    return obs.parse_prometheus(text)
+
+
+def check_metrics_identities(parsed: dict) -> dict:
+    """The /stats accounting identities, re-asserted on the /metrics
+    series (at quiescence). Returns the counter values for the record."""
+    from cop5615_gossip_protocol_tpu.utils.obs import metric_value as mv
+
+    vals = {
+        name: mv(parsed, f"gossip_tpu_serving_{name}_total")
+        for name in ("received", "admitted", "rejected", "invalid",
+                     "completed", "failed", "batched_requests")
+    }
+    assert None not in vals.values(), vals
+    in_flight = mv(parsed, "gossip_tpu_serving_in_flight")
+    assert vals["received"] == (
+        vals["admitted"] + vals["rejected"] + vals["invalid"]
+    ), vals
+    assert vals["admitted"] == (
+        vals["completed"] + vals["failed"] + in_flight
+    ), (vals, in_flight)
+    assert vals["batched_requests"] == (
+        vals["completed"] + vals["failed"]
+    ), vals
+    # The histogram count must agree with the completion counter, and the
+    # service quantiles must exist once traffic flowed.
+    svc_count = mv(parsed, "gossip_tpu_serving_service_seconds_count")
+    assert svc_count == vals["completed"], (svc_count, vals)
+    vals["in_flight"] = in_flight
+    return vals
+
+
+def check_span_closure(responses: list, tol: float = 0.05) -> int:
+    """Every sampled response's span breakdown must sum to within ``tol``
+    of its measured service latency (the spans partition the service wall
+    by construction — serving/batcher.py). Returns the number checked."""
+    checked = 0
+    for r in responses:
+        sv = r.get("serving") or {}
+        spans = sv.get("spans")
+        assert spans is not None and sv.get("trace_id"), r
+        assert set(spans) == {"queue_wait_s", "batch_assemble_s",
+                              "engine_s", "demux_s"}, spans
+        total = sum(spans.values())
+        service_s = sv["service_ms"] / 1e3
+        assert abs(total - service_s) <= tol * max(service_s, 1e-6), (
+            total, service_s, spans
+        )
+        checked += 1
+    return checked
+
+
+def check_trace_join(response: dict, events_path: str) -> list:
+    """One trace_id joins admission -> batch-retired -> response events
+    (ISSUE 7 acceptance): the sampled response's id must appear on a
+    request-admitted event, inside a batch-retired event's trace_ids, and
+    on a request-completed event whose spans match the response's."""
+    from cop5615_gossip_protocol_tpu.utils.events import read_events
+
+    tid = response["serving"]["trace_id"]
+    joined = [
+        e for e in read_events(events_path)
+        if e.get("trace_id") == tid or tid in (e.get("trace_ids") or ())
+    ]
+    kinds = [e["event"] for e in joined]
+    assert kinds.count("request-admitted") == 1, kinds
+    assert kinds.count("batch-retired") == 1, kinds
+    assert kinds.count("request-completed") == 1, kinds
+    # File order: completion is emitted by the executor strictly after its
+    # batch-retired line. The admitted line is written by the submitter
+    # thread concurrently with the executor, so its position is asserted
+    # by presence, not order (the t_wall/t_req stamps give the timeline).
+    assert kinds.index("batch-retired") < kinds.index("request-completed"), (
+        kinds
+    )
+    done = next(e for e in joined if e["event"] == "request-completed")
+    assert done["spans"] == response["serving"]["spans"], (done, response)
+    return joined
+
+
 def check_stats(stats: dict, min_buckets: int = 2) -> None:
     """The /stats identities the admission counters promise."""
     assert stats["received"] == (
@@ -365,12 +469,135 @@ def check_stats(stats: dict, min_buckets: int = 2) -> None:
     assert len(stats["buckets"]) >= min_buckets, stats["buckets"]
 
 
+def warm_width_ladder(server: "ServerProc", clients: int, conns: int) -> int:
+    """Warm the engine pool for every lane WIDTH the measured phases can
+    hit (compiles are a property of process start, not steady-state
+    serving — without the ladder, a first-occupancy-of-this-width batch
+    mid-phase would eat a multi-second trace+compile and pollute p99).
+    Client counts land synchronized-bucket occupancy in each power-of-two
+    width between the server's min_lanes floor (8) and ``clients``.
+    Returns the number of warm requests served; raises on any error."""
+    ladder, w = [], 8
+    while w < clients:
+        ladder.append(w)
+        w *= 2
+    ladder.append(clients)
+    total = 0
+    for w in ladder:
+        warm = drive(server, clients=w, conns=min(conns, w),
+                     duration_s=120.0, max_requests_per_client=3)
+        total += warm["requests"]
+        if warm["errors"]:
+            raise AssertionError(f"warm phase errors: {warm['error_samples']}")
+    print(f"[loadgen] warm: {total} requests over user ladder {ladder}, "
+          "0 errors", flush=True)
+    return total
+
+
 def fmt_row(label: str, phase: dict, extra: str = "") -> str:
     return (
         f"| {label} | {phase['clients']} | {phase['requests']:,} "
         f"| {phase['rps']:,.0f} | {phase['p50_ms']:.1f} "
         f"| {phase['p99_ms']:.1f} | {extra} |"
     )
+
+
+def run_metrics_smoke(args) -> int:
+    """The metrics-smoke CI contract (module docstring): live /metrics
+    under traffic, accounting identities on the Prometheus series, span
+    closure on every sampled response, and the trace-id lifecycle join
+    through the server's event log."""
+    import tempfile
+
+    events_path = tempfile.mktemp(prefix="serve_events_", suffix=".jsonl")
+    print(f"[loadgen] metrics-smoke: spawning serve.py with --events "
+          f"{events_path}", flush=True)
+    server = ServerProc(
+        extra_args=("--events", events_path), platform=args.platform,
+        window_ms=args.window_ms, max_lanes=args.max_lanes,
+    )
+    record: dict = {}
+    try:
+        # Same width ladder as the smoke path, so the measured phase (and
+        # its event stream) reflects steady-state serving, not compiles.
+        warm_width_ladder(server, args.clients, args.conns)
+
+        # Live scraper: /metrics must stay parseable WHILE the closed loop
+        # drives (and cost no device syncs — the drive throughput itself
+        # is pinned by the separate serve-smoke job).
+        live = {"scrapes": 0, "error": None, "stop": False}
+
+        def scraper():
+            while not live["stop"]:
+                try:
+                    scrape_metrics(server)
+                    live["scrapes"] += 1
+                except Exception as e:  # noqa: BLE001 — reported below
+                    live["error"] = f"{type(e).__name__}: {e}"
+                    return
+                time.sleep(0.25)
+
+        th = threading.Thread(target=scraper)
+        th.start()
+        phase = drive(server, clients=args.clients, conns=args.conns,
+                      duration_s=min(args.duration, 8.0))
+        live["stop"] = True
+        th.join(timeout=10)
+        assert live["error"] is None, f"live scrape failed: {live['error']}"
+        assert live["scrapes"] >= 2, "scraper never ran under traffic"
+        assert phase["requests"] > 0 and not phase["errors"], (
+            phase["errors"], phase["error_samples"]
+        )
+        print(f"[loadgen] {live['scrapes']} live /metrics scrapes parsed "
+              f"under {phase['rps']:,.0f} req/s", flush=True)
+
+        # Quiesced: the hard identity asserts on the Prometheus series.
+        parsed = scrape_metrics(server)
+        vals = check_metrics_identities(parsed)
+        print(f"[loadgen] /metrics identities hold: {vals}", flush=True)
+
+        n_spans = check_span_closure(phase["responses"])
+        print(f"[loadgen] span closure (<=5%) on {n_spans} responses",
+              flush=True)
+
+        sample = phase["responses"][0]
+        joined = check_trace_join(sample, events_path)
+        print(f"[loadgen] trace {sample['serving']['trace_id']} joins "
+              f"{[e['event'] for e in joined]}", flush=True)
+
+        record = {
+            "live_scrapes": live["scrapes"],
+            "rps": phase["rps"],
+            "requests": phase["requests"],
+            "identities": vals,
+            "span_closure_checked": n_spans,
+            "trace_join": [e["event"] for e in joined],
+            "trace_id": sample["serving"]["trace_id"],
+        }
+        server.shutdown()
+    finally:
+        if server.proc.poll() is None:
+            server.proc.kill()
+        Path(events_path).unlink(missing_ok=True)
+
+    if args.json:
+        Path(args.json).write_text(json.dumps(record, indent=2))
+    if args.md:
+        Path(args.md).write_text("\n".join([
+            "## Metrics smoke (benchmarks/loadgen.py --metrics-smoke)",
+            "",
+            f"- {record['live_scrapes']} live /metrics scrapes parsed "
+            f"under {record['rps']:,.0f} req/s",
+            f"- accounting identities hold on the Prometheus series: "
+            f"{record['identities']}",
+            f"- span breakdown sums to service latency (<=5%) on "
+            f"{record['span_closure_checked']} responses",
+            f"- trace {record['trace_id']} joins "
+            f"{' -> '.join(record['trace_join'])}",
+            "",
+        ]) + "\n")
+    print("[loadgen] metrics-smoke passed", flush=True)
+    return 0
 
 
 def main(argv=None) -> int:
@@ -401,11 +628,19 @@ def main(argv=None) -> int:
     ap.add_argument("--smoke", action="store_true",
                     help="CI serve-smoke: shorter phases, HARD pins on "
                     "rps/p99/batching-ratio/stats (env-overridable)")
+    ap.add_argument("--metrics-smoke", action="store_true",
+                    help="CI metrics-smoke: live /metrics scrape under "
+                    "traffic, Prometheus identity checks, span-closure "
+                    "and trace-id-join asserts (module docstring); "
+                    "replaces the throughput/control phases")
     ap.add_argument("--md", type=str, default=None,
                     help="write the latency table as markdown here")
     ap.add_argument("--json", type=str, default=None,
                     help="write the raw phase records as JSON here")
     args = ap.parse_args(argv)
+
+    if args.metrics_smoke:
+        return run_metrics_smoke(args)
 
     if args.smoke:
         args.duration = min(args.duration, 8.0)
@@ -453,28 +688,8 @@ def main(argv=None) -> int:
         )
 
     # Phase 0 — warm: populate the warm-engine pool for every bucket and
-    # lane WIDTH the measured phases can hit (compiles are a property of
-    # process start, not steady-state serving — without the ladder, a
-    # first-occupancy-of-this-width batch mid-phase would eat a multi-
-    # second trace+compile and pollute p99). Client counts are chosen so
-    # synchronized-bucket occupancy lands in each power-of-two width
-    # between the server's min_lanes floor (8) and max_lanes.
-    ladder, w = [], 8
-    while w < args.clients:
-        ladder.append(w)
-        w *= 2
-    ladder.append(args.clients)
-    warm_total = 0
-    for w in ladder:
-        warm = drive(server, clients=w, conns=min(args.conns, w),
-                     duration_s=120.0, max_requests_per_client=3)
-        warm_total += warm["requests"]
-        if warm["errors"]:
-            raise AssertionError(
-                f"warm phase errors: {warm['error_samples']}"
-            )
-    print(f"[loadgen] warm: {warm_total} requests over user ladder "
-          f"{ladder}, 0 errors", flush=True)
+    # lane width the measured phases can hit (warm_width_ladder).
+    warm_width_ladder(server, args.clients, args.conns)
 
     # Phase 1 — correctness: telemetry demux on every response, over the
     # HTTP front (the throughput phases ride the JSONL socket — this

@@ -1,0 +1,186 @@
+"""Perf-trajectory table — the bench history without git archaeology.
+
+Every on-chip regen drops a ``BENCH_rNN.json`` (the flagship headline:
+1M-node full-topology push-sum rounds/s with the engine-only split) and a
+``MULTICHIP_rNN.json`` (the 8-device smoke verdict) at the repo root, but
+until now the TRAJECTORY across revisions was only reconstructable by
+walking git history. This tool rolls the committed snapshots into one
+table — headline rounds/s, engine µs/round, flagship wall, compile,
+multichip verdict, serving req/s — per revision, prints/writes it as
+markdown, and (``--apply``) maintains the "Perf trajectory" section of
+BENCH_TABLES.md idempotently. CI uploads the rendered table as an
+artifact (bench-smoke job), so every run carries the full history.
+
+Serving throughput has no ``SERVING_rNN.json`` convention (the loadgen
+record is a CI artifact, not a committed snapshot): revisions gain a
+serving column from ``--serving REV:RPS`` pins (the committed table
+carries PR 6's measured 1,778 req/s) or ``--loadgen FILE --rev N`` to
+read a ``benchmarks/loadgen.py --json`` record for the current revision.
+
+Usage::
+
+    python benchmarks/trend.py [--root .] [--md out.md]
+        [--serving 6:1778] [--loadgen loadgen.json --rev 7] [--apply]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+
+SECTION_HEADER = "## Perf trajectory (benchmarks/trend.py)"
+
+
+def load_snapshots(root: Path) -> dict:
+    """{revision: {"bench": parsed-record|None, "multichip": dict|None}}
+    from the committed BENCH_rNN.json / MULTICHIP_rNN.json snapshots."""
+    revs: dict = {}
+    for path in sorted(root.glob("BENCH_r*.json")):
+        m = re.fullmatch(r"BENCH_r(\d+)\.json", path.name)
+        if not m:
+            continue
+        rec = json.loads(path.read_text())
+        revs.setdefault(int(m.group(1)), {})["bench"] = rec.get("parsed")
+    for path in sorted(root.glob("MULTICHIP_r*.json")):
+        m = re.fullmatch(r"MULTICHIP_r(\d+)\.json", path.name)
+        if not m:
+            continue
+        revs.setdefault(int(m.group(1)), {})["multichip"] = json.loads(
+            path.read_text()
+        )
+    return revs
+
+
+def render(revs: dict, serving: dict) -> str:
+    """Markdown table over the revision snapshots; ``serving`` maps
+    revision -> req/s."""
+    lines = [
+        SECTION_HEADER,
+        "",
+        "Flagship = 1M-node full-topology push-sum on chip "
+        "(BENCH_rNN.json); serving = benchmarks/loadgen.py closed-loop "
+        "req/s on the CI-class CPU box. '—' = not measured at that "
+        "revision.",
+        "",
+        "| rev | flagship rounds/s | engine µs/round | flagship wall ms "
+        "| compile s | vs baseline | 8-dev smoke | serving req/s |",
+        "|---|---|---|---|---|---|---|---|",
+    ]
+    for rev in sorted(set(revs) | set(serving)):
+        b = revs.get(rev, {}).get("bench") or {}
+        mc = revs.get(rev, {}).get("multichip")
+
+        def num(key, fmt, rec=b):
+            v = rec.get(key)
+            return format(v, fmt) if isinstance(v, (int, float)) else "—"
+
+        mc_txt = "—"
+        if mc is not None:
+            mc_txt = (
+                "skipped" if mc.get("skipped")
+                else ("ok" if mc.get("ok") else "FAIL")
+            )
+        rps = serving.get(rev)
+        wall = b.get("wall_s")
+        lines.append(
+            "| r{:02d} | {} | {} | {} | {} | {} | {} | {} |".format(
+                rev,
+                num("value", ",.0f"),
+                num("engine_us_per_round", ".1f"),
+                format(1e3 * wall, ".1f") if isinstance(
+                    wall, (int, float)) else "—",
+                num("compile_s", ".2f"),
+                num("vs_baseline", ",.0f") + "x" if isinstance(
+                    b.get("vs_baseline"), (int, float)) else "—",
+                mc_txt,
+                format(rps, ",.0f") if rps is not None else "—",
+            )
+        )
+    lines.append("")
+    return "\n".join(lines)
+
+
+def apply_to_bench_tables(table_md: str, bench_tables: Path) -> None:
+    """Idempotently install/replace the trajectory section: everything
+    from SECTION_HEADER to the next '## ' heading (or EOF) is replaced."""
+    text = bench_tables.read_text()
+    if SECTION_HEADER in text:
+        start = text.index(SECTION_HEADER)
+        rest = text[start + len(SECTION_HEADER):]
+        nxt = rest.find("\n## ")
+        end = len(text) if nxt < 0 else start + len(SECTION_HEADER) + nxt + 1
+        text = text[:start] + table_md + text[end:]
+    else:
+        if not text.endswith("\n"):
+            text += "\n"
+        text += "\n" + table_md
+    bench_tables.write_text(text)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", type=Path, default=REPO,
+                    help="directory holding the BENCH_r*/MULTICHIP_r* "
+                    "snapshots (default: the repo root)")
+    ap.add_argument("--serving", action="append", default=[],
+                    metavar="REV:RPS",
+                    help="pin a serving req/s figure for a revision "
+                    "(repeatable), e.g. --serving 6:1778")
+    ap.add_argument("--loadgen", type=Path, default=None,
+                    help="read the serving req/s for --rev from a "
+                    "benchmarks/loadgen.py --json record")
+    ap.add_argument("--rev", type=int, default=None,
+                    help="revision number the --loadgen record belongs to")
+    ap.add_argument("--md", type=Path, default=None,
+                    help="write the markdown table here")
+    ap.add_argument("--apply", action="store_true",
+                    help="install/replace the 'Perf trajectory' section "
+                    "in BENCH_TABLES.md (idempotent)")
+    args = ap.parse_args(argv)
+
+    revs = load_snapshots(args.root)
+    if not revs:
+        print(f"no BENCH_r*/MULTICHIP_r* snapshots under {args.root}",
+              file=sys.stderr)
+        return 1
+
+    serving: dict = {}
+    for pin in args.serving:
+        try:
+            rev_s, rps_s = pin.split(":", 1)
+            serving[int(rev_s)] = float(rps_s)
+        except ValueError:
+            print(f"bad --serving pin {pin!r} (want REV:RPS)",
+                  file=sys.stderr)
+            return 2
+    if args.loadgen is not None:
+        if args.rev is None:
+            print("--loadgen needs --rev (the revision the record "
+                  "belongs to)", file=sys.stderr)
+            return 2
+        rec = json.loads(args.loadgen.read_text())
+        rps = (rec.get("batched") or {}).get("rps")
+        if rps is None:
+            print(f"{args.loadgen} has no batched.rps field",
+                  file=sys.stderr)
+            return 2
+        serving[args.rev] = float(rps)
+
+    table = render(revs, serving)
+    print(table)
+    if args.md:
+        args.md.write_text(table + "\n")
+    if args.apply:
+        apply_to_bench_tables(table, args.root / "BENCH_TABLES.md")
+        print(f"[trend] applied to {args.root / 'BENCH_TABLES.md'}",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -193,6 +193,14 @@ def build_parser() -> argparse.ArgumentParser:
                    "annotations from the pipelined driver)")
     p.add_argument("--jsonl", type=str, default=None,
                    help="append the structured run record to this JSONL file")
+    p.add_argument("--metrics-dump", type=str, default=None, metavar="FILE",
+                   help="after the run, write the process metrics registry "
+                   "(utils/obs.py) as Prometheus text exposition to FILE "
+                   "('-' = stdout): run outcome/rounds counters, the full "
+                   "wall budget (build/compile/dispatch/fetch/hook/"
+                   "residual), per-chunk dispatch/fetch histograms, and "
+                   "the warm-engine pool counters — the same vocabulary "
+                   "the serving plane serves at GET /metrics")
     p.add_argument("--telemetry", action="store_true",
                    help="enable the in-program telemetry plane "
                    "(ops/telemetry.py): per-ROUND counters accumulated on "
@@ -288,6 +296,7 @@ def _main_refsim(args, parser) -> int:
         "--trace-convergence": changed("trace_convergence"),
         "--telemetry": changed("telemetry"),
         "--events": changed("events"),
+        "--metrics-dump": changed("metrics_dump"),
     }
     bad = [flag for flag, set_ in inapplicable.items() if set_]
     if bad:
@@ -496,6 +505,10 @@ def main(argv: Optional[list[str]] = None) -> int:
             # serializer — accepting the flag would pay the collection
             # cost and silently discard the data.
             ("--telemetry", args.telemetry),
+            # The run-budget series a metrics dump exposes are per-RUN
+            # fields (run_record schema v4); the sweep record has no
+            # chunk_log/budget split to stamp.
+            ("--metrics-dump", args.metrics_dump),
         ):
             if set_:
                 print(
@@ -767,6 +780,15 @@ def main(argv: Optional[list[str]] = None) -> int:
     if jax.process_index() == 0:
         print(metrics.reference_format(result))
     record = metrics.run_record(cfg, topo, result)
+    if args.metrics_dump and jax.process_index() == 0:
+        # One scrape surface for one-shot runs (ISSUE 7): stamp the run
+        # record + per-chunk splits into the process registry — which
+        # already holds the warm-engine pool counters from this run — and
+        # render the Prometheus text. Host-side post-processing only.
+        from .utils import obs
+
+        obs.observe_run_record(record, chunk_log=result.chunk_log)
+        obs.dump(args.metrics_dump)
     if not args.quiet:
         print(json.dumps(record))
     if args.jsonl and jax.process_index() == 0:

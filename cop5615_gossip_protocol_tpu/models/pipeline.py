@@ -71,6 +71,27 @@ predicate readback + aux collection) — into ``ChunkLoopResult.chunk_log``
 for the structured run-event log, and tags dispatch/fetch/retire with
 ``jax.profiler`` trace annotations so chunk boundaries are legible in a
 Perfetto/TensorBoard capture (``--profile DIR``).
+
+Full run budget (ISSUE 7): beyond the dispatch/fetch totals the loop also
+attributes
+
+- ``first_dispatch_s`` — the FIRST chunk's enqueue time alone. The warmup
+  dispatch in the engines eats the trace+compile cost, but any residual
+  first-execution work (donation rewiring, transfer warm-up, a cold axon
+  tunnel) lands here, split out from the steady-state dispatch floor;
+- ``hook_s`` — host time inside the chunk-boundary callbacks
+  (``on_retire`` — the checkpoint/IO hook — and ``should_stop``, the
+  watchdog's converged-count sync);
+- ``aux_s`` — host time collecting telemetry aux buffers inside the fetch
+  block (a SUBSET of ``fetch_s``: fetch minus aux is the true
+  device-wait).
+
+Together with the loop's own wall these close the non-engine budget:
+``residual = run_s − dispatch_s − fetch_s − hook_s`` is pure Python
+bookkeeping (deque ops, logging) and benchmarks/wallwalk.py pins that the
+named buckets cover >= 90% of the non-engine wall. All measurements are
+``perf_counter`` brackets around code that already ran — zero extra host
+syncs, donation and speculation untouched.
 """
 
 from __future__ import annotations
@@ -117,6 +138,12 @@ class ChunkLoopResult:
     chunks_speculative: int  # dispatched-then-discarded chunks (stall exits)
     dispatch_s: float = 0.0  # total host time enqueueing chunks
     fetch_s: float = 0.0  # total host time blocked on predicate/aux readback
+    # Run-budget attribution (module docstring): the first chunk's enqueue
+    # time alone; host time in the on_retire/should_stop callbacks; host
+    # time collecting telemetry aux buffers (subset of fetch_s).
+    first_dispatch_s: float = 0.0
+    hook_s: float = 0.0
+    aux_s: float = 0.0
     # Per RETIRED chunk, in order: {"rounds", "dispatch_s", "fetch_s"} —
     # the structured run-event log's chunk-retired events (utils/events.py).
     chunk_log: list = dataclasses.field(default_factory=list)
@@ -188,8 +215,12 @@ def run_chunks(
     head = (state0, rnd0, done0, health0, None)
     last_end = start_round
     retired_count = 0
+    dispatched_count = 0
     dispatch_total = 0.0
     fetch_total = 0.0
+    first_dispatch = 0.0
+    hook_total = 0.0
+    aux_total = 0.0
     chunk_log: list = []
 
     def fill() -> None:
@@ -197,7 +228,8 @@ def run_chunks(
         past max_rounds are guaranteed no-ops and are never dispatched —
         except the very first chunk, which the serial loops also issue
         (a resume at max_rounds still observes one boundary)."""
-        nonlocal head, last_end, dispatch_total
+        nonlocal head, last_end, dispatch_total, dispatched_count
+        nonlocal first_dispatch
         while len(inflight) < depth and (
             last_end < max_rounds or (not inflight and retired_count == 0)
         ):
@@ -210,6 +242,9 @@ def run_chunks(
                     out = dispatch(head[0], head[1], head[2], last_end)
             disp_s = time.perf_counter() - t0
             dispatch_total += disp_s
+            if dispatched_count == 0:
+                first_dispatch = disp_s
+            dispatched_count += 1
             health = out[3] if has_health else None
             aux = out[aux_i] if len(out) > aux_i else None
             _prefetch(out[1])
@@ -231,6 +266,8 @@ def run_chunks(
             state=carry[0], rounds=rounds, done=done_b,
             chunks_retired=retired_count, chunks_speculative=spec,
             dispatch_s=dispatch_total, fetch_s=fetch_total,
+            first_dispatch_s=first_dispatch, hook_s=hook_total,
+            aux_s=aux_total,
             chunk_log=chunk_log,
             health=int(carry[3]) if has_health else None,
         )
@@ -245,7 +282,9 @@ def run_chunks(
             if on_aux is not None and cur[4] is not None:
                 # The aux copy was prefetched at dispatch; by retire time it
                 # is usually resident — this is a collection, not a sync.
+                t_aux = time.perf_counter()
                 on_aux(prev_rounds, rounds, cur[4])
+                aux_total += time.perf_counter() - t_aux
         fetch_s = time.perf_counter() - t0
         fetch_total += fetch_s
         retired_count += 1
@@ -254,18 +293,25 @@ def run_chunks(
         )
         if on_retire is not None:
             with _TraceAnnotation("chunkloop.retire"):
+                t_hook = time.perf_counter()
                 on_retire(rounds, cur[0])
+                hook_total += time.perf_counter() - t_hook
         if done_b or rounds >= max_rounds:
             # Overshoot chunks are bitwise no-ops, so the newest carry IS
             # this one — and under donation it is the one with live buffers.
             final = head if donate else cur
             inflight.clear()
             break
-        if should_stop is not None and should_stop(rounds, cur[0]):
-            # Serial semantics: the run ends AT this boundary. In-flight
-            # speculative chunks executed real rounds past the stall —
-            # discard them unobserved (donate=False here by construction).
-            return result(cur, len(inflight))
+        if should_stop is not None:
+            t_hook = time.perf_counter()
+            stop = should_stop(rounds, cur[0])
+            hook_total += time.perf_counter() - t_hook
+            if stop:
+                # Serial semantics: the run ends AT this boundary.
+                # In-flight speculative chunks executed real rounds past
+                # the stall — discard them unobserved (donate=False here
+                # by construction).
+                return result(cur, len(inflight))
         final = cur
         fill()
     return result(final, 0)

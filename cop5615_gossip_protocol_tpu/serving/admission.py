@@ -1,41 +1,69 @@
-"""Admission control + serving counters (the ``/stats`` endpoint's data).
+"""Admission control + serving counters (the ``/stats`` and ``/metrics``
+endpoints' data).
 
 The admission front is a bounded queue: a request is ADMITTED when the
 number of requests waiting for a batch is below ``queue_limit``, else
 REJECTED with a structured payload (HTTP 429 — never an unbounded queue
 that converts overload into unbounded latency). The counters follow the
-closed-loop accounting identity the serve-smoke CI job asserts:
+closed-loop accounting identity the serve-smoke and metrics-smoke CI jobs
+assert:
 
     received  == admitted + rejected + invalid
     admitted  == completed + failed + in_flight
     batched_requests (Σ batch occupancy) == completed + failed
 
-Latency percentiles are computed over a bounded reservoir of the most
-recent completions (classic sliding window, not a full history — the
-serving plane must not grow memory with traffic).
+Every counter and latency distribution lives in a metrics registry
+(utils/obs.py) owned by this object — one instance per ServingApp, so two
+in-process apps never double-count one series — and is exposed two ways:
+the legacy ``/stats`` JSON snapshot (field names unchanged) and the
+Prometheus text exposition on ``GET /metrics``. Latency percentiles come
+from the registry's bounded streaming log-bucket histograms: O(1) per
+completion and O(buckets) memory, replacing the old bounded reservoir
+whose every ``/stats`` call paid an O(n log n) ``sorted(deque)`` copy.
+``service_ms_p50``/``service_ms_p99`` keep their shape (float ms or None);
+the value is now quantile-from-buckets with a documented relative error
+bound of at most ``growth - 1`` (~19% at the default 2**0.25 geometry,
+exact at small-sample tails — utils/obs.Histogram.quantile).
+
+Request lifecycle spans (ISSUE 7): each completion also observes its span
+breakdown — ``queue_wait_s`` (admission -> executor pickup),
+``batch_assemble_s`` (pickup -> engine dispatch), ``engine_s`` (the
+batched program), ``demux_s`` (engine done -> this response ready) — into
+per-span histograms, so the wall of a served request is attributable from
+one scrape.
 """
 
 from __future__ import annotations
 
-import collections
 import threading
+from typing import Optional
+
+from ..utils import obs
+
+SPAN_NAMES = ("queue_wait_s", "batch_assemble_s", "engine_s", "demux_s")
 
 
 class AdmissionError(Exception):
     """Request rejected at the admission front (bounded queue full)."""
 
-    def __init__(self, queue_depth: int, queue_limit: int):
+    def __init__(self, queue_depth: int, queue_limit: int,
+                 trace_id: Optional[str] = None):
         super().__init__(
             f"admission rejected: queue depth {queue_depth} at limit "
             f"{queue_limit}"
         )
         self.queue_depth = queue_depth
         self.queue_limit = queue_limit
+        # Minted BEFORE the capacity check (serving/batcher.submit): a
+        # rejected request still has a joinable identity in the event log.
+        self.trace_id = trace_id
 
 
 def percentile(sorted_vals, q: float):
-    """Nearest-rank percentile over an already-sorted list (no numpy on
-    the serving hot path). None on empty input."""
+    """Nearest-rank percentile over an already-sorted list. Still used by
+    client-side consumers holding real sample lists (benchmarks/loadgen.py
+    latencies); the serving plane itself now reads quantiles from the
+    registry's streaming histograms. None on empty input."""
     if not sorted_vals:
         return None
     idx = min(len(sorted_vals) - 1, max(0, round(q * (len(sorted_vals) - 1))))
@@ -43,122 +71,213 @@ def percentile(sorted_vals, q: float):
 
 
 class ServingStats:
-    """Thread-safe serving counters. One instance per server; the batcher
-    and HTTP handlers both write it."""
+    """Thread-safe serving counters over a per-app metrics registry. One
+    instance per server; the batcher and HTTP handlers both write it."""
 
-    RESERVOIR = 4096
-
-    def __init__(self):
-        self._lock = threading.Lock()
-        self.received = 0
-        self.admitted = 0
-        self.rejected = 0
-        self.invalid = 0
-        self.completed = 0
-        self.failed = 0
-        self.degraded = 0
-        self.batches = 0
-        self.batched_requests = 0  # Σ occupancy over executed batches
-        self.batch_lanes_sum = 0   # Σ lanes (padding included)
-        self.buckets: collections.Counter = collections.Counter()
-        self.wait_s_sum = 0.0      # admission → batch-dispatch
-        self.service_s_sum = 0.0   # admission → response ready
-        self._latency: collections.deque = collections.deque(
-            maxlen=self.RESERVOIR
-        )
+    def __init__(self, registry: Optional[obs.Registry] = None):
+        self.registry = registry if registry is not None else obs.Registry()
+        r = self.registry
+        self._c_received = r.counter(
+            "gossip_tpu_serving_received_total",
+            "requests seen by the front (admitted + rejected + invalid)")
+        self._c_admitted = r.counter(
+            "gossip_tpu_serving_admitted_total",
+            "requests admitted into the batching queue")
+        self._c_rejected = r.counter(
+            "gossip_tpu_serving_rejected_total",
+            "requests rejected by the bounded admission queue (429)")
+        self._c_invalid = r.counter(
+            "gossip_tpu_serving_invalid_total",
+            "requests rejected at validation (400)")
+        self._c_completed = r.counter(
+            "gossip_tpu_serving_completed_total",
+            "requests answered with a result")
+        self._c_failed = r.counter(
+            "gossip_tpu_serving_failed_total",
+            "admitted requests that ended in a structured failure")
+        self._c_degraded = r.counter(
+            "gossip_tpu_serving_degraded_total",
+            "completed requests that walked an engine-degradation rung")
+        self._c_batches = r.counter(
+            "gossip_tpu_serving_batches_total", "micro-batches executed")
+        self._c_batched_requests = r.counter(
+            "gossip_tpu_serving_batched_requests_total",
+            "sum of batch occupancy over executed batches")
+        self._c_batch_lanes = r.counter(
+            "gossip_tpu_serving_batch_lanes_total",
+            "sum of lane counts over executed batches (padding included)")
+        self._c_bucket = r.counter(
+            "gossip_tpu_serving_bucket_batches_total",
+            "micro-batches executed per key bucket", ("bucket",))
+        self._h_service = r.histogram(
+            "gossip_tpu_serving_service_seconds",
+            "admission -> response-ready latency")
+        self._h_spans = {
+            name: r.histogram(
+                f"gossip_tpu_serving_{name.replace('_s', '_seconds')}",
+                f"request lifecycle span: {name}")
+            for name in SPAN_NAMES
+        }
+        self._g_depth = r.gauge(
+            "gossip_tpu_serving_queue_depth",
+            "requests waiting for a batch (live)")
+        self._g_inflight = r.gauge(
+            "gossip_tpu_serving_in_flight",
+            "admitted requests not yet completed or failed")
+        self._lock = threading.Lock()  # bucket-dict consistency in snapshot
+        self._bucket_counts: dict = {}
         self._depth_fn = None  # wired by the batcher (live queue depth)
+        r.add_collect(self._collect)
 
     def wire_depth(self, fn) -> None:
         self._depth_fn = fn
 
+    def _collect(self) -> None:
+        """Pre-scrape gauge refresh. Runs OUTSIDE the registry lock
+        (utils/obs.Registry.add_collect): the depth fn takes the batcher's
+        queue lock, and the submit path takes queue lock -> stats writes —
+        the opposite order — so this must never run under a lock a writer
+        holds (the ABBA rule snapshot() documents)."""
+        self._g_depth.set(self._depth_fn() if self._depth_fn else 0)
+        done = self._c_completed.value() + self._c_failed.value()
+        self._g_inflight.set(self._c_admitted.value() - done)
+
+    # -- readers the tests/batcher use as plain attributes -----------------
+
+    @property
+    def received(self) -> int:
+        return int(self._c_received.value())
+
+    @property
+    def admitted(self) -> int:
+        return int(self._c_admitted.value())
+
+    @property
+    def rejected(self) -> int:
+        return int(self._c_rejected.value())
+
+    @property
+    def invalid(self) -> int:
+        return int(self._c_invalid.value())
+
+    @property
+    def completed(self) -> int:
+        return int(self._c_completed.value())
+
+    @property
+    def failed(self) -> int:
+        return int(self._c_failed.value())
+
+    @property
+    def degraded(self) -> int:
+        return int(self._c_degraded.value())
+
+    @property
+    def batches(self) -> int:
+        return int(self._c_batches.value())
+
+    @property
+    def batched_requests(self) -> int:
+        return int(self._c_batched_requests.value())
+
     # -- writers -----------------------------------------------------------
 
     def on_received(self) -> None:
-        with self._lock:
-            self.received += 1
+        self._c_received.inc()
 
     def on_admitted(self) -> None:
-        with self._lock:
-            self.admitted += 1
+        self._c_admitted.inc()
 
     def on_rejected(self) -> None:
-        with self._lock:
-            self.rejected += 1
+        self._c_rejected.inc()
 
     def on_invalid(self) -> None:
-        with self._lock:
-            self.invalid += 1
+        self._c_invalid.inc()
 
     def on_batch(self, bucket: str, occupancy: int, lanes: int) -> None:
+        self._c_batches.inc()
+        self._c_batched_requests.inc(occupancy)
+        self._c_batch_lanes.inc(lanes)
+        self._c_bucket.inc(bucket=bucket)
         with self._lock:
-            self.batches += 1
-            self.batched_requests += occupancy
-            self.batch_lanes_sum += lanes
-            self.buckets[bucket] += 1
+            self._bucket_counts[bucket] = (
+                self._bucket_counts.get(bucket, 0) + 1
+            )
 
     def on_completed(self, wait_s: float, service_s: float,
-                     degraded: bool = False) -> None:
-        with self._lock:
-            self.completed += 1
-            if degraded:
-                self.degraded += 1
-            self.wait_s_sum += wait_s
-            self.service_s_sum += service_s
-            self._latency.append(service_s)
+                     degraded: bool = False, spans: Optional[dict] = None,
+                     ) -> None:
+        self._c_completed.inc()
+        if degraded:
+            self._c_degraded.inc()
+        self._h_service.observe(service_s)
+        if spans is None:
+            spans = {"queue_wait_s": wait_s}
+        for name, hist in self._h_spans.items():
+            if name in spans:
+                hist.observe(spans[name])
 
     def on_failed(self) -> None:
-        with self._lock:
-            self.failed += 1
+        self._c_failed.inc()
 
     # -- readers -----------------------------------------------------------
 
-    def snapshot(self) -> dict:
-        """The /stats payload. Derived fields are computed here so every
-        consumer reads one consistent view.
+    def render_metrics(self) -> str:
+        """This app's Prometheus exposition text, with the process-wide
+        series (warm-engine pool, one-shot run series) appended — one
+        scrape covers the serving plane AND the engine substrate."""
+        return self.registry.render() + obs.default_registry().render()
 
-        The live queue depth is read BEFORE taking the stats lock: the
-        depth fn acquires the batcher's queue lock, and the batcher's
-        submit path takes these locks in the opposite order (queue lock →
-        stats lock via on_admitted) — holding the stats lock across the
-        depth call would be an ABBA deadlock with live traffic."""
+    def snapshot(self) -> dict:
+        """The /stats payload — legacy field names, registry-backed.
+
+        The live queue depth is read BEFORE any derived-field reads for
+        the same ABBA reason _collect documents. Counter reads are
+        individually consistent; the accounting identities hold exactly at
+        quiescence (writers bump received before the admit/reject/invalid
+        verdict exists, so a mid-validation scrape can transiently read
+        received one ahead — the CI identity checks run post-drive)."""
         depth = self._depth_fn() if self._depth_fn else 0
+        completed = self.completed
+        failed = self.failed
+        done = completed + failed
+        svc = self._h_service
+        wait_h = self._h_spans["queue_wait_s"]
+        p50 = svc.quantile(0.50)
+        p99 = svc.quantile(0.99)
         with self._lock:
-            lat = sorted(self._latency)
-            done = self.completed + self.failed
-            snap = {
-                "received": self.received,
-                "admitted": self.admitted,
-                "rejected": self.rejected,
-                "invalid": self.invalid,
-                "completed": self.completed,
-                "failed": self.failed,
-                "degraded": self.degraded,
-                "in_flight": self.admitted - done,
-                "queue_depth": depth,
-                "batches": self.batches,
-                "batched_requests": self.batched_requests,
-                "batch_occupancy_mean": (
-                    self.batched_requests / self.batches
-                    if self.batches else None
-                ),
-                "batch_fill": (
-                    self.batched_requests / self.batch_lanes_sum
-                    if self.batch_lanes_sum else None
-                ),
-                "buckets": dict(self.buckets),
-                "wait_ms_mean": (
-                    1e3 * self.wait_s_sum / done if done else None
-                ),
-                "service_ms_mean": (
-                    1e3 * self.service_s_sum / done if done else None
-                ),
-                "service_ms_p50": (
-                    1e3 * percentile(lat, 0.50) if lat else None
-                ),
-                "service_ms_p99": (
-                    1e3 * percentile(lat, 0.99) if lat else None
-                ),
-            }
+            buckets = dict(self._bucket_counts)
+        batches = self.batches
+        batched_requests = self.batched_requests
+        lanes_sum = int(self._c_batch_lanes.value())
+        snap = {
+            "received": self.received,
+            "admitted": self.admitted,
+            "rejected": self.rejected,
+            "invalid": self.invalid,
+            "completed": completed,
+            "failed": failed,
+            "degraded": self.degraded,
+            "in_flight": self.admitted - done,
+            "queue_depth": depth,
+            "batches": batches,
+            "batched_requests": batched_requests,
+            "batch_occupancy_mean": (
+                batched_requests / batches if batches else None
+            ),
+            "batch_fill": (
+                batched_requests / lanes_sum if lanes_sum else None
+            ),
+            "buckets": buckets,
+            "wait_ms_mean": (
+                1e3 * wait_h.sum / done if done else None
+            ),
+            "service_ms_mean": (
+                1e3 * svc.sum / done if done else None
+            ),
+            "service_ms_p50": 1e3 * p50 if p50 is not None else None,
+            "service_ms_p99": 1e3 * p99 if p99 is not None else None,
+        }
         from . import pool as pool_mod
 
         snap["engine_pool"] = pool_mod.default_pool().stats()

@@ -23,6 +23,15 @@ Threading: HTTP handler threads ``submit()`` into the bounded admission
 queue and block on the request's event; ONE executor thread drains the
 queue per window, groups by bucket, and runs each group. JAX dispatch
 happens only on the executor thread.
+
+Request tracing (ISSUE 7): every request gets a ``trace_id`` minted at
+admission, carried through the queue, the micro-batch lane, the engine
+dispatch, and the response demux. The executor clocks the four lifecycle
+spans — ``queue_wait_s`` / ``batch_assemble_s`` / ``engine_s`` /
+``demux_s`` — which partition the service wall exactly; they ride the
+response (``serving.spans``), the per-request event stream, the server
+event log (schema v4), and the admission histograms, so one id joins a
+request across every surface.
 """
 
 from __future__ import annotations
@@ -58,9 +67,14 @@ def lane_bucket(occupancy: int, max_lanes: int, min_lanes: int = 1) -> int:
 @dataclasses.dataclass
 class ServeRequest:
     """One admitted request in flight. ``ready`` is set by the executor
-    once ``status``/``response`` hold the final verdict."""
+    once ``status``/``response`` hold the final verdict. ``trace_id`` is
+    minted at admission and propagated through queue -> micro-batch lane
+    -> engine dispatch -> demux: every lifecycle event (per-request stream
+    AND the server's --events log) and the response itself carry it, so
+    one JSONL join reconstructs the request's full lifecycle (ISSUE 7)."""
 
     request_id: str
+    trace_id: str
     cfg: SimConfig
     topo: object
     bucket: tuple
@@ -76,9 +90,12 @@ class ServeRequest:
 
     def emit(self, event: str, **fields) -> None:
         """Per-request lifecycle stream, demultiplexed into the response —
-        the request-scoped analog of the run-event log (utils/events.py)."""
+        the request-scoped analog of the run-event log (utils/events.py).
+        Every record carries the trace_id so response events join the
+        server event log without positional guessing."""
         self.events.append({
             "event": event,
+            "trace_id": self.trace_id,
             "t_req": time.monotonic() - self.t_received,
             **fields,
         })
@@ -135,8 +152,8 @@ class MicroBatcher:
                         r, "server-stopping", "server shut down before "
                         "this request was dispatched"
                     )
-                    r.ready.set()
                     self.stats.on_failed()
+                    r.ready.set()
                 self._queue.clear()
             self._cv.notify_all()
         if self._thread is not None:
@@ -157,6 +174,9 @@ class MicroBatcher:
         # edge); keying the cache on it for every kind would make each
         # distinct-seed request a cache miss + O(n·deg) rebuild in the
         # hot path.
+        # Trace identity is minted BEFORE the capacity verdict: a rejected
+        # request's admission-rejected event still carries a joinable id.
+        trace_id = uuid.uuid4().hex[:16]
         topo_seed = (
             cfg.seed if cfg.topology in keys_mod.SEED_BUILT_KINDS else 0
         )
@@ -165,6 +185,7 @@ class MicroBatcher:
         )
         req = ServeRequest(
             request_id=f"r{next(_REQ_COUNTER)}-{uuid.uuid4().hex[:8]}",
+            trace_id=trace_id,
             cfg=cfg,
             topo=topo,
             bucket=keys_mod.serve_bucket_key(cfg, topo),
@@ -174,9 +195,11 @@ class MicroBatcher:
         )
         with self._cv:
             if self._stop:
-                raise AdmissionError(len(self._queue), self.queue_limit)
+                raise AdmissionError(len(self._queue), self.queue_limit,
+                                     trace_id)
             if len(self._queue) >= self.queue_limit:
-                raise AdmissionError(len(self._queue), self.queue_limit)
+                raise AdmissionError(len(self._queue), self.queue_limit,
+                                     trace_id)
             # Count the admission BEFORE the worker can see (and finish)
             # the request — a /stats snapshot must never read
             # completed > admitted.
@@ -184,6 +207,14 @@ class MicroBatcher:
             self._queue.append(req)
             self._cv.notify_all()
         req.emit("request-admitted", bucket=req.bucket_label)
+        if self.event_log is not None:
+            # The server-log half of the trace join (schema v4). Only when
+            # --events is on: the fsync-per-line durability contract makes
+            # per-request events a deliberate opt-in cost.
+            self.event_log.emit(
+                "request-admitted", trace_id=trace_id,
+                bucket=req.bucket_label,
+            )
         return req
 
     # -- executor ----------------------------------------------------------
@@ -242,14 +273,21 @@ class MicroBatcher:
                 r.response = _error_body(
                     r, "internal-error", f"{type(e).__name__}: {e}"[:500]
                 )
-                r.ready.set()
                 self.stats.on_failed()
+                r.ready.set()
 
     def _execute(self, group: list) -> None:
         from ..models import runner as runner_mod
         from ..models import sweep as sweep_mod
 
-        t_dispatch = time.monotonic()
+        # Span clock (ISSUE 7): t_group (executor pickup) closes each
+        # request's queue_wait_s; t_eng0/t_eng1 bracket the batched engine
+        # program (batch_assemble_s is the gap between pickup and engine
+        # dispatch); demux_s is closed per request in _finish. The four
+        # spans partition [t_received, response-ready], so the response's
+        # breakdown sums to its measured service latency by construction
+        # (the metrics-smoke CI job asserts it within 5%).
+        t_group = time.monotonic()
         req0 = group[0]
         cfg = req0.cfg
         topo = req0.topo
@@ -266,6 +304,7 @@ class MicroBatcher:
             )
         sres = None
         error: Optional[BaseException] = None
+        t_eng0 = time.monotonic()
         try:
             # Seeds, not PRNGKeys: run_batched_keys assembles raw key data
             # on the host (no per-request device dispatch) — lane i is
@@ -281,14 +320,17 @@ class MicroBatcher:
         except ValueError as e:
             error = e
 
-        t_done = time.monotonic()
+        t_eng1 = time.monotonic()
         if self.event_log is not None:
             self.event_log.emit(
                 "batch-retired", bucket=req0.bucket_label,
                 occupancy=len(group), lanes=lanes,
                 ok=sres is not None,
                 engine_cache=None if sres is None else sres.engine_cache,
-                batch_ms=1e3 * (t_done - t_dispatch),
+                batch_ms=1e3 * (t_eng1 - t_group),
+                assemble_s=t_eng0 - t_group,
+                engine_s=t_eng1 - t_eng0,
+                trace_ids=[r.trace_id for r in group],
             )
 
         if sres is not None:
@@ -296,7 +338,11 @@ class MicroBatcher:
             for i, r in enumerate(group):
                 self._finish(
                     r, self._lane_body(r, i, sres, len(group), lanes),
-                    t_dispatch,
+                    spans={
+                        "queue_wait_s": t_group - r.t_received,
+                        "batch_assemble_s": t_eng0 - t_group,
+                        "engine_s": t_eng1 - t_eng0,
+                    },
                 )
             return
 
@@ -317,16 +363,20 @@ class MicroBatcher:
                     "engine-unavailable" if degradable else "invalid-config",
                     f"{type(error).__name__}: {error}",
                 )
-                r.ready.set()
                 self.stats.on_failed()
+                r.ready.set()
             return
         for r in group:
-            self._one_shot(r, error, t_dispatch)
+            self._one_shot(r, error, t_group)
 
-    def _one_shot(self, r: ServeRequest, reason, t_dispatch: float) -> None:
+    def _one_shot(self, r: ServeRequest, reason, t_group: float) -> None:
         """Degraded path: run this request alone through models.runner.run
         (which walks its own engine ladder) and stamp the full rung walk
-        into the response."""
+        into the response. Span accounting follows the path taken: the
+        failed vmapped attempt's wall lands in batch_assemble_s (it
+        preceded THIS request's engine run), engine_s brackets the
+        one-shot ladder run — the spans still partition the service
+        wall."""
         from ..models import runner as runner_mod
 
         walk = [{
@@ -341,6 +391,7 @@ class MicroBatcher:
                 walk.append(fields)
 
         self.stats.on_batch(r.bucket_label, 1, 1)
+        t_eng0 = time.monotonic()
         try:
             res = runner_mod.run(r.topo, r.cfg, on_event=on_event)
         except Exception as e:  # noqa: BLE001 — bottom of every ladder:
@@ -350,9 +401,10 @@ class MicroBatcher:
                 r, "engine-unavailable", f"{type(e).__name__}: {e}",
                 engine_degraded=walk,
             )
-            r.ready.set()
             self.stats.on_failed()
+            r.ready.set()
             return
+        t_eng1 = time.monotonic()
         if res.degradations:
             walk.extend(res.degradations)
         body = {
@@ -382,7 +434,11 @@ class MicroBatcher:
             body["telemetry"] = res.telemetry.to_trace_records(
                 r.cfg.algorithm
             )
-        self._finish(r, body, t_dispatch, degraded=True)
+        self._finish(r, body, spans={
+            "queue_wait_s": t_group - r.t_received,
+            "batch_assemble_s": t_eng0 - t_group,
+            "engine_s": t_eng1 - t_eng0,
+        }, degraded=True)
 
     def _lane_body(self, r: ServeRequest, lane: int, sres, occupancy: int,
                   lanes: int) -> dict:
@@ -418,27 +474,54 @@ class MicroBatcher:
             )
         return body
 
-    def _finish(self, r: ServeRequest, body: dict, t_dispatch: float,
+    def _finish(self, r: ServeRequest, body: dict, spans: dict,
                 degraded: bool = False) -> None:
         t_now = time.monotonic()
-        wait_s = t_dispatch - r.t_received
+        wait_s = spans["queue_wait_s"]
         service_s = t_now - r.t_received
+        # demux_s closes the span partition EXACTLY: the four spans sum to
+        # the measured service latency by construction (clamped at 0 for
+        # clock-granularity jitter), which is the contract the response
+        # breakdown and the metrics-smoke CI check rest on.
+        spans = dict(spans)
+        spans["demux_s"] = max(
+            service_s - sum(spans[k] for k in
+                            ("queue_wait_s", "batch_assemble_s", "engine_s")),
+            0.0,
+        )
         r.emit("request-completed", outcome=body["result"]["outcome"])
+        body["serving"]["trace_id"] = r.trace_id
+        body["serving"]["spans"] = spans
         body["serving"]["queue_wait_ms"] = 1e3 * wait_s
         body["serving"]["service_ms"] = 1e3 * service_s
         body["request_id"] = r.request_id
         body["ok"] = True
         body["events"] = r.events
+        # Accounting and the event-log line land BEFORE the client is
+        # released: once a caller holds its response, the completion is
+        # visible to /stats and /metrics and the request-completed event
+        # is durable — the identity checks and the trace join would
+        # otherwise race the executor by one request.
+        self.stats.on_completed(wait_s, service_s, degraded=degraded,
+                                spans=spans)
+        if self.event_log is not None:
+            # The response half of the trace join (schema v4) — same
+            # opt-in economics as the admission event.
+            self.event_log.emit(
+                "request-completed", trace_id=r.trace_id,
+                outcome=body["result"]["outcome"], spans=spans,
+                service_s=service_s, degraded=degraded,
+            )
         r.status = 200
         r.response = body
         r.ready.set()
-        self.stats.on_completed(wait_s, service_s, degraded=degraded)
 
 
 def _error_body(r: ServeRequest, error: str, detail: str, **extra) -> dict:
     return {
         "ok": False,
         "request_id": r.request_id,
+        "trace_id": r.trace_id,
         "error": error,
         "detail": detail,
         "events": r.events,

@@ -13,6 +13,13 @@ Entries are whole jitted callables; eviction drops the wrapper (and with
 it the executable) once the LRU capacity (``GOSSIP_TPU_ENGINE_POOL_CAP``,
 default 64) is exceeded. Thread-safe: the serving plane's HTTP threads and
 batch executor share the default pool.
+
+Accounting (ISSUE 7): hit/miss/eviction counts also land in a metrics
+registry (utils/obs.py — ``gossip_tpu_engine_pool_*``), so the warm/cold
+economics are scrapeable from ``GET /metrics`` and ``--metrics-dump``
+next to the serving and run series. The default pool reports into the
+process-wide default registry; tests pin exact eviction sequences against
+a private one.
 """
 
 from __future__ import annotations
@@ -22,6 +29,8 @@ import os
 import threading
 from typing import Callable, Tuple
 
+from ..utils import obs
+
 DEFAULT_CAPACITY = 64
 
 
@@ -30,7 +39,8 @@ class WarmEnginePool:
     build product). ``get_or_build`` returns ``(engine, hit)`` so callers
     can report warm/cold per dispatch (the serving stats do)."""
 
-    def __init__(self, capacity: int | None = None):
+    def __init__(self, capacity: int | None = None,
+                 registry: obs.Registry | None = None):
         if capacity is None:
             capacity = int(
                 os.environ.get("GOSSIP_TPU_ENGINE_POOL_CAP", "")
@@ -44,6 +54,21 @@ class WarmEnginePool:
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        reg = registry if registry is not None else obs.default_registry()
+        self._c_hits = reg.counter(
+            "gossip_tpu_engine_pool_hits_total",
+            "warm-engine pool lookups served from a live executable")
+        self._c_misses = reg.counter(
+            "gossip_tpu_engine_pool_misses_total",
+            "warm-engine pool lookups that built a fresh engine")
+        self._c_evictions = reg.counter(
+            "gossip_tpu_engine_pool_evictions_total",
+            "engines dropped by the LRU capacity bound")
+        self._g_entries = reg.gauge(
+            "gossip_tpu_engine_pool_entries", "live pool entries")
+        self._g_capacity = reg.gauge(
+            "gossip_tpu_engine_pool_capacity", "LRU capacity bound")
+        self._g_capacity.set(capacity)
 
     def get_or_build(self, key, build: Callable[[], object]) -> Tuple[object, bool]:
         """Return ``(engine, True)`` on a warm hit, else build, insert, and
@@ -54,13 +79,17 @@ class WarmEnginePool:
             if key in self._entries:
                 self._entries.move_to_end(key)
                 self.hits += 1
+                self._c_hits.inc()
                 return self._entries[key], True
             engine = build()
             self._entries[key] = engine
             self.misses += 1
+            self._c_misses.inc()
             while len(self._entries) > self.capacity:
                 self._entries.popitem(last=False)
                 self.evictions += 1
+                self._c_evictions.inc()
+            self._g_entries.set(len(self._entries))
             return engine, False
 
     def __len__(self) -> int:
@@ -70,6 +99,7 @@ class WarmEnginePool:
     def clear(self) -> None:
         with self._lock:
             self._entries.clear()
+            self._g_entries.set(0)
 
     def stats(self) -> dict:
         with self._lock:

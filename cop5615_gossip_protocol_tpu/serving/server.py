@@ -13,6 +13,12 @@ HTTP endpoints:
                  never a 500.
   GET /stats     serving counters (admission/queue/batch-occupancy/latency
                  percentiles + warm-pool stats; serving/admission.py).
+  GET /metrics   Prometheus text exposition of the same counters plus the
+                 span histograms and the process-wide series (warm-engine
+                 pool, one-shot run series) — the single scrape surface
+                 the observability plane promises (utils/obs.py). Pure
+                 host-side registry reads: scraping under live traffic
+                 costs no device syncs.
   GET /healthz   liveness probe.
 
 JSONL socket (the high-throughput transport — ``--jsonl-port``, on by
@@ -178,11 +184,12 @@ class ServingApp:
             if self.event_log is not None:
                 self.event_log.emit(
                     "admission-rejected", queue_depth=e.queue_depth,
-                    queue_limit=e.queue_limit,
+                    queue_limit=e.queue_limit, trace_id=e.trace_id,
                 )
             return 429, {
                 "ok": False, "error": "admission-rejected",
                 "detail": str(e),
+                "trace_id": e.trace_id,
                 "queue_depth": e.queue_depth,
                 "queue_limit": e.queue_limit,
                 "schema_version": RESPONSE_SCHEMA_VERSION,
@@ -256,6 +263,11 @@ class ServingApp:
         snap["schema_version"] = RESPONSE_SCHEMA_VERSION
         return snap
 
+    def metrics_text(self) -> str:
+        """GET /metrics body (serving/admission.ServingStats
+        .render_metrics): this app's registry + the process-wide one."""
+        return self.stats.render_metrics()
+
     def close(self) -> None:
         self.batcher.stop(drain=True)
 
@@ -268,9 +280,13 @@ class _Handler(BaseHTTPRequestHandler):
     quiet: bool = True
 
     def _send(self, status: int, payload: dict) -> None:
-        data = json.dumps(payload).encode()
+        self._send_text(status, json.dumps(payload), "application/json")
+
+    def _send_text(self, status: int, text: str,
+                   content_type: str) -> None:
+        data = text.encode()
         self.send_response(status)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(data)))
         self.end_headers()
         self.wfile.write(data)
@@ -280,6 +296,11 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(200, {"ok": True})
         elif self.path == "/stats":
             self._send(200, self.app.snapshot())
+        elif self.path == "/metrics":
+            self._send_text(
+                200, self.app.metrics_text(),
+                "text/plain; version=0.0.4; charset=utf-8",
+            )
         else:
             self._send(404, {"ok": False, "error": "not-found",
                              "detail": f"no such endpoint {self.path!r}"})

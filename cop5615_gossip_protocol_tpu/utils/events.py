@@ -35,17 +35,33 @@ seconds since the log was opened):
   run-end                 outcome, rounds, wall/compile/dispatch/fetch
                           splits, once, last
 
-Serving-plane vocabulary (schema v3 — emitted by ``serve.py`` /
+Serving-plane vocabulary (schema v3/v4 — emitted by ``serve.py`` /
 serving/server.py into its ``--events`` log; the per-REQUEST lifecycle
-stream is demultiplexed into each HTTP response instead, see
+stream is demultiplexed into each HTTP response as well, see
 serving/batcher.ServeRequest.emit):
 
   server-start            host/port + batching/window/lane/queue config
+  request-admitted        one request entered the batching queue:
+                          trace_id + bucket (v4; per-request — emitted
+                          only when the event log is configured, the
+                          fsync-per-line durability cost is opt-in)
   batch-retired           one micro-batch executed: bucket label,
-                          occupancy, lanes, warm-pool verdict, wall
+                          occupancy, lanes, warm-pool verdict, wall;
+                          v4 adds trace_ids (the member requests) and the
+                          assemble_s/engine_s span split
+  request-completed       one response became ready: trace_id, outcome,
+                          the full span breakdown (queue_wait_s /
+                          batch_assemble_s / engine_s / demux_s — they
+                          partition service_s), degraded flag (v4)
   admission-rejected      the bounded queue turned a request away
-                          (queue_depth, queue_limit)
+                          (queue_depth, queue_limit; v4 adds trace_id —
+                          identity is minted BEFORE the capacity verdict)
   server-stop             final /stats snapshot
+
+The v4 trace join (ISSUE 7): one ``trace_id`` links request-admitted ->
+batch-retired -> request-completed in this log AND the response's own
+event stream/span breakdown, so one JSONL join reconstructs any request's
+lifecycle from admission to response.
 
 Consumers detect format drift via ``schema_version`` — bump EVENT_SCHEMA_
 VERSION whenever a field changes meaning, never reuse a name. History:
@@ -53,7 +69,9 @@ VERSION whenever a field changes meaning, never reuse a name. History:
 types, run-start gains ``warnings``, crash-schedule-applied gains the
 revive_rate/revive_schedule/rejoin recovery fields; 3 — the serving-plane
 event types (server-start, batch-retired, admission-rejected,
-server-stop).
+server-stop); 4 — request tracing: request-admitted/request-completed
+events, trace_id stamped on every serving event, span timings on
+batch-retired/request-completed.
 """
 
 from __future__ import annotations
@@ -63,7 +81,7 @@ from pathlib import Path
 
 from . import metrics
 
-EVENT_SCHEMA_VERSION = 3
+EVENT_SCHEMA_VERSION = 4
 
 
 class RunEventLog:

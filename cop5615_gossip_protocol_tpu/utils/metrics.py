@@ -31,7 +31,12 @@ if TYPE_CHECKING:  # type-only: keeps this module importable without JAX
 #   3 — recovery plane: outcome gains "unhealthy", records gain
 #       unhealthy_round (health sentinel) and degradations (the engine
 #       fallback ladder's rung walk)
-RUN_RECORD_SCHEMA_VERSION = 3
+#   4 — full run budget (ISSUE 7): first_dispatch_s / hook_s / aux_s from
+#       the pipelined driver, setup_s / finalize_s bracketing the
+#       single-device engines' build/assembly phases, plus the derived
+#       residual_s, so the record names the whole non-engine wall
+#       (benchmarks/wallwalk.py reads it)
+RUN_RECORD_SCHEMA_VERSION = 4
 
 
 def banner(cfg: SimConfig) -> str:
