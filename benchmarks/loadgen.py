@@ -87,7 +87,8 @@ class ServerProc:
     cleanly (SIGINT -> exit 0)."""
 
     def __init__(self, extra_args=(), platform: str = "cpu",
-                 window_ms: float = 3.0, max_lanes: int = 64):
+                 window_ms: float = 3.0, max_lanes: int = 64,
+                 env_extra: dict | None = None):
         self.port = pick_port()
         cmd = [
             sys.executable, str(REPO / "serve.py"),
@@ -98,6 +99,7 @@ class ServerProc:
             *extra_args,
         ]
         env = dict(os.environ)
+        env.update(env_extra or {})
         env.setdefault("JAX_PLATFORMS", platform if platform != "auto" else "")
         self.proc = subprocess.Popen(
             cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
@@ -158,11 +160,12 @@ class ServerProc:
         conn.close()
         return out
 
-    def shutdown(self) -> dict:
-        """SIGINT, await exit, assert rc == 0, return the final stats
-        record the server prints on the way out."""
-        self.proc.send_signal(signal.SIGINT)
-        rc = self.proc.wait(timeout=60)
+    def shutdown(self, sig=signal.SIGINT, timeout_s: float = 60) -> dict:
+        """Signal (SIGINT = fast stop, SIGTERM = graceful drain), await
+        exit, assert rc == 0, return the final stats record the server
+        prints on the way out."""
+        self.proc.send_signal(sig)
+        rc = self.proc.wait(timeout=timeout_s)
         if self._drain is not None:
             self._drain.join(timeout=10)
         if rc != 0:
@@ -176,17 +179,45 @@ class ServerProc:
                     return rec["server-stats"]
         raise RuntimeError("server printed no final stats line")
 
+    def drain_shutdown(self, timeout_s: float = 120) -> dict:
+        """SIGTERM: graceful drain (lame-duck healthz, structured
+        shutting_down admissions, bounded drain window) then exit 0 with
+        the final stats line — the ISSUE 8 drain contract."""
+        return self.shutdown(sig=signal.SIGTERM, timeout_s=timeout_s)
+
+
+_MAX_RETRIES = 6
+
 
 class ClosedLoopClient(threading.Thread):
     """One closed-loop client: request -> wait -> next, over a persistent
     connection. ``transport`` picks the wire: "jsonl" (the socket
     transport — the throughput phases) or "http" (POST /run keep-alive —
     the correctness phase exercises the HTTP front too). Latencies are
-    per-request wall seconds."""
+    per-request wall seconds.
+
+    Honest retry behavior (ISSUE 8 satellite): a 429 is retried with
+    jittered exponential backoff honoring the server's ``Retry-After`` /
+    ``retry_after_s`` hint, and retries are counted SEPARATELY
+    (``self.retries``) from fresh sends — throughput comparisons stay
+    apples-to-apples (a retried request is one request, not two).
+
+    ``chaos`` mode sends mixed-priority, mixed-deadline traffic and
+    treats every structured verdict (200 / 429 / shed / deadline /
+    shutting_down / timeout) as a TERMINAL response tallied in
+    ``self.terminal`` — only transport failures and unstructured bodies
+    count as errors. ``self.sent``/``self.answered`` pin the
+    exactly-one-terminal-response guarantee."""
+
+    CHAOS_PRIORITIES = ("interactive", "batch", "best_effort")
+    # ms; None = no deadline. The 60 ms cell is tight enough to shed
+    # under backlog while a warm uncontended run still beats it.
+    CHAOS_DEADLINES = (None, 10_000, 60)
 
     def __init__(self, host, port, trace, seed0: int, deadline: float,
                  max_requests: int | None = None, telemetry: bool = False,
-                 transport: str = "jsonl", users: int = 1):
+                 transport: str = "jsonl", users: int = 1,
+                 chaos: bool = False):
         super().__init__(daemon=True)
         self.host, self.port = host, port
         self.trace = trace
@@ -201,9 +232,14 @@ class ClosedLoopClient(threading.Thread):
         # request — the client shape that keeps transport overhead off the
         # serving plane's ledger.
         self.users = users
+        self.chaos = chaos
         self.latencies: list = []
         self.responses: list = []
         self.errors: list = []
+        self.retries = 0
+        self.sent = 0       # distinct requests sent (retries excluded)
+        self.answered = 0   # distinct requests that got a terminal verdict
+        self.terminal: dict = {}  # terminal-verdict tally, chaos mode
 
     def _body(self, i: int, user: int = 0) -> dict:
         # Each user walks the trace at its own offset so one wave spans
@@ -213,15 +249,40 @@ class ClosedLoopClient(threading.Thread):
         body["seed"] = self.seed0 + 10_000 * user + i
         if self.telemetry:
             body["telemetry"] = True
+        if self.chaos:
+            body["schema_version"] = 2
+            body["priority"] = self.CHAOS_PRIORITIES[
+                (i + user) % len(self.CHAOS_PRIORITIES)
+            ]
+            dl = self.CHAOS_DEADLINES[
+                (i + 2 * user) % len(self.CHAOS_DEADLINES)
+            ]
+            if dl is not None:
+                body["deadline_ms"] = dl
         return body
+
+    def _backoff_s(self, payload: dict, attempt: int) -> float:
+        """Jittered exponential backoff floor-bounded by the server's
+        Retry-After hint — scaled down in chaos mode (the chaos drive is
+        seconds long; honesty there means honoring ORDER and jitter, not
+        parking for 30 s)."""
+        import random
+
+        hint = payload.get("retry_after_s") or 0.5
+        if self.chaos:
+            hint = min(hint, 0.25)
+        return hint * (2 ** attempt) * (0.75 + 0.5 * random.random())
 
     def _run_http(self) -> None:
         conn = http.client.HTTPConnection(self.host, self.port, timeout=120)
         i = 0
+        attempt = 0
         while time.monotonic() < self.deadline:
             if self.max_requests is not None and i >= self.max_requests:
                 break
             body = self._body(i)
+            if attempt == 0:
+                self.sent += 1
             t0 = time.monotonic()
             try:
                 conn.request(
@@ -232,19 +293,42 @@ class ClosedLoopClient(threading.Thread):
                 payload = json.loads(resp.read())
                 status = resp.status
             except OSError as e:
+                if self.chaos:
+                    # A connection torn down before the SEND completed is
+                    # not a dropped response; mid-retry, the last 429 WAS
+                    # this request's terminal verdict (same rule as the
+                    # loop-exit path below).
+                    if attempt == 0:
+                        self.sent -= 1
+                    else:
+                        self._classify(429, {"error": "admission-rejected"})
+                    return
                 self.errors.append(f"{type(e).__name__}: {e}")
                 conn.close()
                 conn = http.client.HTTPConnection(self.host, self.port,
                                                   timeout=120)
                 continue
+            if status == 429 and attempt < _MAX_RETRIES:
+                self.retries += 1
+                time.sleep(min(self._backoff_s(payload, attempt), 5.0))
+                attempt += 1
+                continue
             self._record(t0, status, payload)
+            attempt = 0
             i += 1
+            if payload.get("error") == "shutting_down":
+                break  # honest client: the server is draining — stop
+        if attempt > 0:
+            # The loop ended mid-retry: the last 429 WAS this request's
+            # terminal response.
+            self._classify(429, {"error": "admission-rejected"})
         conn.close()
 
     def _run_jsonl(self) -> None:
         sock = socket.create_connection((self.host, self.port), timeout=120)
         rfile = sock.makefile("rb")
         i = 0
+        attempt = 0
         try:
             while time.monotonic() < self.deadline:
                 if self.max_requests is not None and i >= self.max_requests:
@@ -255,10 +339,24 @@ class ClosedLoopClient(threading.Thread):
                     ]}
                 else:
                     wave = self._body(i)
+                if attempt == 0:
+                    self.sent += self.users if self.users > 1 else 1
                 t0 = time.monotonic()
-                sock.sendall(json.dumps(wave).encode() + b"\n")
+                try:
+                    sock.sendall(json.dumps(wave).encode() + b"\n")
+                except OSError:
+                    self.sent -= self.users if self.users > 1 else 1
+                    if not self.chaos:
+                        self.errors.append("jsonl send failed")
+                    return
                 line = rfile.readline()
                 if not line:
+                    if self.chaos:
+                        # Drained server closed after answering — but THIS
+                        # wave's requests never got a verdict: that IS a
+                        # dropped response (sent stays counted; the
+                        # sent == answered pin catches it).
+                        return
                     self.errors.append("jsonl connection closed")
                     break
                 payload = json.loads(line)
@@ -270,32 +368,64 @@ class ClosedLoopClient(threading.Thread):
                     else:
                         for m in members:
                             self.latencies.append(lat)
-                            if m.get("status") != 200 or not m.get("ok"):
-                                self.errors.append(
-                                    f"status {m.get('status')}: "
-                                    f"{str(m)[:200]}"
-                                )
-                            elif self.telemetry or len(self.responses) < 64:
-                                self.responses.append(m)
-                else:
-                    self._record(t0, payload.get("status", 0), payload)
+                            self._classify(m.get("status"), m)
+                    i += 1
+                    continue
+                status = payload.get("status", 0)
+                if status == 429 and attempt < _MAX_RETRIES:
+                    self.retries += 1
+                    time.sleep(min(self._backoff_s(payload, attempt), 5.0))
+                    attempt += 1
+                    continue
+                self._record(t0, status, payload)
+                attempt = 0
                 i += 1
+                if payload.get("error") == "shutting_down":
+                    break  # honest client: the server is draining
+            if attempt > 0:
+                # Ended mid-retry: the last 429 was the terminal verdict.
+                self._classify(429, {"error": "admission-rejected"})
         finally:
             rfile.close()
             sock.close()
 
-    def _record(self, t0: float, status: int, payload: dict) -> None:
-        self.latencies.append(time.monotonic() - t0)
+    def _classify(self, status, payload: dict) -> None:
+        """Terminal-verdict bookkeeping shared by both transports."""
+        self.answered += 1
+        if self.chaos:
+            if status == 200:
+                key = f"200:{payload.get('result', {}).get('outcome')}"
+            else:
+                key = f"{status}:{payload.get('error')}"
+            self.terminal[key] = self.terminal.get(key, 0) + 1
+            structured = status == 200 or (
+                isinstance(payload.get("error"), str)
+                and 400 <= (status or 0) < 600 and status != 500
+            )
+            if not structured:
+                self.errors.append(f"status {status}: {str(payload)[:200]}")
+            elif status == 200 and (self.telemetry
+                                    or len(self.responses) < 64):
+                self.responses.append(payload)
+            return
         if status != 200 or not payload.get("ok"):
             self.errors.append(f"status {status}: {str(payload)[:200]}")
         elif self.telemetry or len(self.responses) < 64:
             self.responses.append(payload)
 
+    def _record(self, t0: float, status: int, payload: dict) -> None:
+        self.latencies.append(time.monotonic() - t0)
+        self._classify(status, payload)
+
     def run(self) -> None:
-        if self.transport == "jsonl":
-            self._run_jsonl()
-        else:
-            self._run_http()
+        try:
+            if self.transport == "jsonl":
+                self._run_jsonl()
+            else:
+                self._run_http()
+        except Exception as e:  # noqa: BLE001 — a client crash must be
+            # visible as an error, not a silently shorter phase
+            self.errors.append(f"client crash {type(e).__name__}: {e}")
 
 
 def drive(server: ServerProc, clients: int, duration_s: float,
@@ -386,7 +516,8 @@ def check_metrics_identities(parsed: dict) -> dict:
     vals = {
         name: mv(parsed, f"gossip_tpu_serving_{name}_total")
         for name in ("received", "admitted", "rejected", "invalid",
-                     "completed", "failed", "batched_requests")
+                     "completed", "failed", "batched_requests",
+                     "shed", "timed_out", "timed_out_dispatched")
     }
     assert None not in vals.values(), vals
     in_flight = mv(parsed, "gossip_tpu_serving_in_flight")
@@ -394,10 +525,11 @@ def check_metrics_identities(parsed: dict) -> dict:
         vals["admitted"] + vals["rejected"] + vals["invalid"]
     ), vals
     assert vals["admitted"] == (
-        vals["completed"] + vals["failed"] + in_flight
+        vals["completed"] + vals["failed"] + vals["shed"]
+        + vals["timed_out"] + in_flight
     ), (vals, in_flight)
     assert vals["batched_requests"] == (
-        vals["completed"] + vals["failed"]
+        vals["completed"] + vals["failed"] + vals["timed_out_dispatched"]
     ), vals
     # The histogram count must agree with the completion counter, and the
     # service quantiles must exist once traffic flowed.
@@ -456,15 +588,24 @@ def check_trace_join(response: dict, events_path: str) -> list:
 
 
 def check_stats(stats: dict, min_buckets: int = 2) -> None:
-    """The /stats identities the admission counters promise."""
+    """The /stats identities the admission counters promise (ISSUE 8:
+    the admitted partition gains shed + timed_out, the occupancy identity
+    gains timed_out_dispatched — serving/admission.py)."""
     assert stats["received"] == (
         stats["admitted"] + stats["rejected"] + stats["invalid"]
     ), stats
     assert stats["admitted"] == (
-        stats["completed"] + stats["failed"] + stats["in_flight"]
+        stats["completed"] + stats["failed"] + stats["shed"]
+        + stats["timed_out"] + stats["in_flight"]
     ), stats
     assert stats["batched_requests"] == (
-        stats["completed"] + stats["failed"]
+        stats["completed"] + stats["failed"] + stats["timed_out_dispatched"]
+    ), stats
+    # The ISSUE 8 headline identity, exact at quiescence.
+    assert stats["received"] == (
+        stats["completed"] + stats["failed"] + stats["rejected"]
+        + stats["invalid"] + stats["timed_out"] + stats["shed"]
+        + stats["in_flight"]
     ), stats
     assert len(stats["buckets"]) >= min_buckets, stats["buckets"]
 
@@ -600,6 +741,160 @@ def run_metrics_smoke(args) -> int:
     return 0
 
 
+def run_chaos_serve(args) -> int:
+    """The chaos-serve CI contract (ISSUE 8): drive mixed-priority,
+    mixed-deadline traffic against a live server while the env-gated
+    fault injector wedges one bucket's dispatch and a mid-load SIGTERM
+    drains the server — then assert
+
+      1. every submitted request received exactly ONE structured terminal
+         response (Σ client sent == Σ client answered; 200 / 429 / shed /
+         deadline_exceeded / shutting_down / timeout vocabulary only),
+      2. zero HTTP 500s / unstructured failures,
+      3. the /stats + Prometheus accounting identities hold exactly on
+         the final drained stats (in_flight == 0),
+      4. the quarantine cycle — executor-stuck -> engine-quarantined ->
+         quarantine-half-open -> quarantine-recovered — and the
+         server-drain event appear in the event log.
+    """
+    import tempfile
+
+    from cop5615_gossip_protocol_tpu.utils.events import read_events
+
+    events_path = tempfile.mktemp(prefix="chaos_serve_", suffix=".jsonl")
+    arm_s = _env_float("GOSSIP_TPU_CHAOS_ARM_S", 45.0)
+    wedge_s = 8.0
+    env = {
+        # Wedge the full-topology gossip bucket once, armed only after
+        # the warm phase (arm_s is measured from batcher start).
+        "GOSSIP_TPU_SERVE_WEDGE": f"gossip/full:{wedge_s}:1:{arm_s}",
+        "GOSSIP_TPU_SERVE_STUCK_MIN_S": "2.5",
+        # mult 0 pins the warm budget at exactly stuck_min_s: the wedge
+        # detection latency is deterministic, independent of the warm
+        # bucket's (compile-inflated) p99.
+        "GOSSIP_TPU_SERVE_STUCK_MULT": "0",
+        "GOSSIP_TPU_SERVE_QUARANTINE_S": "2.5",
+        "GOSSIP_TPU_STRICT_ENGINE": "0",
+    }
+    print(f"[loadgen] chaos-serve: spawning serve.py (wedge armed at "
+          f"t={arm_s:.0f}s, {wedge_s:.0f}s wedge, budget 2.5s, "
+          f"quarantine 2.5s)", flush=True)
+    t_spawn = time.monotonic()
+    server = ServerProc(
+        extra_args=("--events", events_path, "--drain-window", "30",
+                    "--request-timeout", "90"),
+        platform=args.platform, window_ms=args.window_ms,
+        max_lanes=args.max_lanes, env_extra=env,
+    )
+    clients = min(args.clients, 12)
+    try:
+        warm_width_ladder(server, clients, conns=clients)
+        # The injector arms on the server's clock; wait it out so the
+        # wedge lands mid-drive, not mid-warmup.
+        wait = arm_s + 1.0 - (time.monotonic() - t_spawn)
+        if wait > 0:
+            print(f"[loadgen] chaos: waiting {wait:.0f}s for the "
+                  "injector to arm", flush=True)
+            time.sleep(wait)
+
+        # The chaos drive: mixed-priority, mixed-deadline closed-loop
+        # traffic; SIGTERM fires mid-drive, clients keep sending ~3s into
+        # the drain (collecting shutting_down verdicts), then stop.
+        sigterm_after = 9.0
+        deadline = time.monotonic() + sigterm_after + 3.0
+        pool = [
+            ClosedLoopClient(
+                server.host, server.jsonl_port, MIXED_SMALL_TRACE,
+                seed0=1_000_000 * (c + 1), deadline=deadline,
+                transport="jsonl", users=1, chaos=True,
+            )
+            for c in range(clients)
+        ]
+        for c in pool:
+            c.start()
+        time.sleep(sigterm_after)
+        print("[loadgen] chaos: SIGTERM (graceful drain) mid-load",
+              flush=True)
+        final_stats = server.drain_shutdown()
+        for c in pool:
+            c.join(timeout=120)
+
+        sent = sum(c.sent for c in pool)
+        answered = sum(c.answered for c in pool)
+        retries = sum(c.retries for c in pool)
+        errors = [e for c in pool for e in c.errors]
+        terminal: dict = {}
+        for c in pool:
+            for k, v in c.terminal.items():
+                terminal[k] = terminal.get(k, 0) + v
+        print(f"[loadgen] chaos: {sent} sent, {answered} answered, "
+              f"{retries} retries, verdicts {terminal}", flush=True)
+
+        # 1. exactly one structured terminal response per submitted
+        # request, 2. nothing unstructured / no 500s.
+        assert not errors, f"unstructured outcomes: {errors[:5]}"
+        assert sent == answered, (
+            f"dropped responses: sent {sent} != answered {answered}"
+        )
+        assert answered > 0, "chaos drive sent no traffic"
+        assert not any(k.startswith("500") for k in terminal), terminal
+
+        # 3. accounting identities, exact on the drained final stats.
+        check_stats(final_stats, min_buckets=2)
+        assert final_stats["in_flight"] == 0, final_stats
+        assert final_stats["received"] == (
+            final_stats["completed"] + final_stats["failed"]
+            + final_stats["rejected"] + final_stats["invalid"]
+            + final_stats["timed_out"] + final_stats["shed"]
+        ), final_stats
+        print(f"[loadgen] chaos: identities exact on final stats "
+              f"({ {k: final_stats[k] for k in ('received', 'completed', 'failed', 'rejected', 'shed', 'timed_out')} })",
+              flush=True)
+
+        # 4. the quarantine cycle + drain in the event log.
+        kinds = [e["event"] for e in read_events(events_path)]
+        cycle = [k for k in kinds if k in (
+            "executor-stuck", "engine-quarantined", "quarantine-half-open",
+            "quarantine-recovered",
+        )]
+        assert cycle[:2] == ["executor-stuck", "engine-quarantined"], cycle
+        assert "quarantine-half-open" in cycle, cycle
+        assert "quarantine-recovered" in cycle, cycle
+        assert "server-drain" in kinds, kinds[-10:]
+        print(f"[loadgen] chaos: quarantine cycle {cycle}; server-drain "
+              "logged", flush=True)
+
+        record = {
+            "sent": sent, "answered": answered, "retries": retries,
+            "terminal": terminal, "final_stats": final_stats,
+            "quarantine_cycle": cycle,
+        }
+    finally:
+        if server.proc.poll() is None:
+            server.proc.kill()
+        Path(events_path).unlink(missing_ok=True)
+
+    if args.json:
+        Path(args.json).write_text(json.dumps(record, indent=2))
+    if args.md:
+        Path(args.md).write_text("\n".join([
+            "## Chaos-serve (benchmarks/loadgen.py --chaos)",
+            "",
+            f"- {record['sent']} requests sent, {record['answered']} "
+            "answered — exactly one structured terminal response each, "
+            "zero 500s",
+            f"- {record['retries']} honest 429 retries (jittered backoff "
+            "honoring Retry-After), counted separately from fresh sends",
+            f"- terminal verdicts: {record['terminal']}",
+            f"- accounting identities exact on the drained final stats; "
+            f"in_flight == 0",
+            f"- quarantine cycle: {' -> '.join(record['quarantine_cycle'])}",
+            "",
+        ]) + "\n")
+    print("[loadgen] chaos-serve passed", flush=True)
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--url", default=None,
@@ -633,6 +928,13 @@ def main(argv=None) -> int:
                     "traffic, Prometheus identity checks, span-closure "
                     "and trace-id-join asserts (module docstring); "
                     "replaces the throughput/control phases")
+    ap.add_argument("--chaos", action="store_true",
+                    help="CI chaos-serve: mixed-priority mixed-deadline "
+                    "traffic while the env-gated injector wedges one "
+                    "bucket's dispatch and SIGTERM drains the server "
+                    "mid-load; asserts exactly-one-terminal-response, "
+                    "exact identities, zero 500s, and the quarantine -> "
+                    "half-open -> recovery cycle (run_chaos_serve)")
     ap.add_argument("--md", type=str, default=None,
                     help="write the latency table as markdown here")
     ap.add_argument("--json", type=str, default=None,
@@ -641,6 +943,8 @@ def main(argv=None) -> int:
 
     if args.metrics_smoke:
         return run_metrics_smoke(args)
+    if args.chaos:
+        return run_chaos_serve(args)
 
     if args.smoke:
         args.duration = min(args.duration, 8.0)

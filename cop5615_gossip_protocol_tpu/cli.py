@@ -71,6 +71,14 @@ def build_parser() -> argparse.ArgumentParser:
                    "= stop when every node's per-round relative ratio change "
                    "is <= delta (the honest global-residual criterion)")
     p.add_argument("--max-rounds", type=int, default=1_000_000)
+    p.add_argument("--deadline-ms", type=float, default=None,
+                   help="end-to-end run deadline: the chunk driver checks "
+                   "it at every retired boundary (models/pipeline.py "
+                   "cancellation hook — the same one the serving plane's "
+                   "per-request deadline_ms uses) and a fired deadline "
+                   "ends the run within one chunk as "
+                   "outcome='deadline_exceeded' with partial state/"
+                   "telemetry and exact rounds (run-record schema v5)")
     p.add_argument("--chunk-rounds", type=int, default=4096)
     p.add_argument("--pipeline-chunks", type=int, default=2,
                    help="speculative chunk pipelining depth: how many jit'd "
@@ -509,6 +517,11 @@ def main(argv: Optional[list[str]] = None) -> int:
             # fields (run_record schema v4); the sweep record has no
             # chunk_log/budget split to stamp.
             ("--metrics-dump", args.metrics_dump),
+            # A deadline is a per-run SLO; the sweep's serial chunk loop
+            # supports it via the API (run_batched_keys deadline=), but
+            # the CLI sweep record has no per-replica outcome channel for
+            # partial results — run deadline diagnostics unbatched.
+            ("--deadline-ms", args.deadline_ms),
         ):
             if set_:
                 print(
@@ -740,6 +753,19 @@ def main(argv: Optional[list[str]] = None) -> int:
         jax.profiler.trace(args.profile) if args.profile
         else contextlib.nullcontext()
     )
+    if args.deadline_ms is not None and args.deadline_ms <= 0:
+        print(
+            f"Invalid: --deadline-ms must be positive, got {args.deadline_ms}",
+            file=sys.stderr,
+        )
+        return 2
+    # The deadline clock starts at dispatch time, AFTER topology build and
+    # argument validation: --deadline-ms bounds the run (the quantity the
+    # serving deadline bounds too), not the process.
+    deadline = (
+        time.monotonic() + args.deadline_ms / 1e3
+        if args.deadline_ms is not None else None
+    )
     try:
         with trace_ctx:
             result = run(
@@ -749,6 +775,7 @@ def main(argv: Optional[list[str]] = None) -> int:
                 # engine-degraded events land in the log AT degradation
                 # time — a later crash still leaves the rung walk durable.
                 on_event=events.emit if events is not None else None,
+                deadline=deadline,
             )
     except (ValueError, NotImplementedError) as e:
         print(f"Invalid: {e}", file=sys.stderr)

@@ -56,6 +56,19 @@ dispatch at a terminal carry stays a no-op (the pending verdict is drained
 before the chunk returns, so the ``done`` scalar this driver prefetches is
 never stale across dispatches).
 
+Cancellation (ISSUE 8): ``should_cancel(rounds)`` — the serving plane's
+per-request deadline hook — is consulted at every RETIRED boundary, like
+the watchdog, but it reads only the clock (never device state), so it is
+legal under buffer donation. Because a cancel must take effect at the
+NEXT retired chunk (the deadline contract: deadline + one chunk + ε), a
+cancellable loop runs at pipeline depth 1 — speculative chunks dispatched
+past a deadline would push the cancel horizon out by the whole pipeline
+depth. A fired cancel ends the run AT that boundary with
+``ChunkLoopResult.cancelled=True``; the retired carry is the result
+(partial but exact — ``rounds`` is the retired counter), and the engines
+map it to ``outcome="deadline_exceeded"``. A loop without the hook is
+bitwise and schedule-identical to before.
+
 Telemetry rides the same machinery (ops/telemetry.py): a chunk may return a
 fourth element — an auxiliary on-device buffer (the per-round counter
 block) — which the driver prefetches with the predicate scalars and hands
@@ -152,6 +165,11 @@ class ChunkLoopResult:
     # healthy); None when the loop ran without a health carry (health0 not
     # given). The driver maps it to outcome="unhealthy".
     health: object = None
+    # The should_cancel hook ended the run at a retired boundary (the
+    # deadline contract, ISSUE 8). The engines map it to
+    # outcome="deadline_exceeded"; the carry is the retired (partial)
+    # state and ``rounds`` stays exact.
+    cancelled: bool = False
 
 
 def run_chunks(
@@ -169,6 +187,7 @@ def run_chunks(
     should_stop: Optional[Callable[[int, object], bool]] = None,
     on_aux: Optional[Callable[[int, int, object], None]] = None,
     health0=None,
+    should_cancel: Optional[Callable[[int], bool]] = None,
 ) -> ChunkLoopResult:
     """Drive ``dispatch(state, rnd, done, round_end) -> (state, rnd, done)``
     to termination with up to ``depth`` chunks in flight.
@@ -196,12 +215,24 @@ def run_chunks(
     engine's done flag (the loop itself never interprets health values, so
     termination stays the engine's decision).
 
+    ``should_cancel(rounds)`` (optional) is the deadline/cancellation
+    hook: consulted at every retired boundary, it reads the CLOCK, not
+    device state, so it composes with donation. When it returns True the
+    run ends at that boundary with ``cancelled=True`` (partial state,
+    exact ``rounds``). A cancellable loop runs at depth 1 — see the module
+    docstring — so cancellation latency is bounded by one chunk.
+
     ``stride`` is the engine's natural chunk length in rounds: a chunk
     dispatched at boundary k targets ``min(start + (k+1)*stride,
     max_rounds)`` — the identical schedule the serial loop produces,
     because a non-terminal chunk always runs to its round_end exactly.
     """
     depth = max(1, int(depth))
+    if should_cancel is not None:
+        # Speculation would push the cancel horizon out by the pipeline
+        # depth (in-flight chunks must drain or be wasted); a deadline-
+        # bounded run trades the overlap for a one-chunk cancel bound.
+        depth = 1
     if donate and (on_retire is not None or should_stop is not None):
         raise ValueError(
             "buffer donation recycles retired chunk state; chunk-boundary "
@@ -261,7 +292,7 @@ def run_chunks(
     rounds = start_round
     done_b = False
 
-    def result(carry, spec: int) -> ChunkLoopResult:
+    def result(carry, spec: int, cancelled: bool = False) -> ChunkLoopResult:
         return ChunkLoopResult(
             state=carry[0], rounds=rounds, done=done_b,
             chunks_retired=retired_count, chunks_speculative=spec,
@@ -270,6 +301,7 @@ def run_chunks(
             aux_s=aux_total,
             chunk_log=chunk_log,
             health=int(carry[3]) if has_health else None,
+            cancelled=cancelled,
         )
 
     while inflight:
@@ -302,6 +334,12 @@ def run_chunks(
             final = head if donate else cur
             inflight.clear()
             break
+        if should_cancel is not None and should_cancel(rounds):
+            # Deadline fired: the run ends AT this boundary with the
+            # retired (partial) carry. depth == 1 here by construction, so
+            # no speculative chunk is in flight and — donation included —
+            # this carry's buffers are the live ones (cur IS head).
+            return result(cur, len(inflight), cancelled=True)
         if should_stop is not None:
             t_hook = time.perf_counter()
             stop = should_stop(rounds, cur[0])

@@ -65,10 +65,12 @@ class RunResult:
     # Why the run ended: "converged" (target/quorum reached), "stalled"
     # (the cfg.stall_chunks watchdog saw no converged-count progress — the
     # reference's line-topology hang, program.fs:334, as a measured event),
-    # "max_rounds" (the round cap), or "unhealthy" (the cfg.mass_tolerance
+    # "max_rounds" (the round cap), "unhealthy" (the cfg.mass_tolerance
     # health sentinel tripped — non-finite state or mass divergence; the
-    # offending round is in unhealthy_round). Always present in the JSONL
-    # record.
+    # offending round is in unhealthy_round), or "deadline_exceeded" (the
+    # caller's deadline cancelled the run at a chunk boundary — partial
+    # state/telemetry, exact rounds; schema v5). Always present in the
+    # JSONL record.
     outcome: str = "converged"
     # First round the health sentinel tripped (outcome="unhealthy" only).
     unhealthy_round: Optional[int] = None
@@ -778,7 +780,7 @@ def _host_done(cfg, life_np, state, rounds: int, target: int) -> bool:
 def _finalize_result(
     topo, cfg, state, rounds, target, compile_s, run_s,
     done=None, stalled: bool = False, loop=None, collector=None,
-    unhealthy_round=None,
+    unhealthy_round=None, cancelled: bool = False,
 ) -> RunResult:
     converged_count = int(jnp.sum(state.conv))
     converged = (converged_count >= target) if done is None else bool(done)
@@ -802,6 +804,9 @@ def _finalize_result(
         outcome=(
             "unhealthy" if unhealthy_round is not None
             else "converged" if converged
+            # The cancel hook is only consulted while unconverged, so a
+            # cancelled run is by construction not a converged one.
+            else "deadline_exceeded" if cancelled
             else ("stalled" if stalled else "max_rounds")
         ),
         unhealthy_round=unhealthy_round,
@@ -831,6 +836,19 @@ def _finalize_result(
     return result
 
 
+def _cancel_fn(deadline: Optional[float]):
+    """The run_chunks cancellation hook for an absolute ``time.monotonic``
+    deadline (None = no deadline, no hook — the loop is schedule-identical
+    to before). Clock-only: legal under buffer donation."""
+    if deadline is None:
+        return None
+
+    def should_cancel(rounds: int) -> bool:
+        return time.monotonic() >= deadline
+
+    return should_cancel
+
+
 def _run_fused(
     topo: Topology,
     cfg: SimConfig,
@@ -842,6 +860,7 @@ def _run_fused(
     variant: str = "stencil",
     on_telemetry=None,
     t_enter: Optional[float] = None,
+    deadline: Optional[float] = None,
 ) -> RunResult:
     """Chunk loop over a Pallas multi-round engine: one kernel launch per
     cfg.chunk_rounds rounds. ``variant`` picks the kernel family:
@@ -1060,6 +1079,7 @@ def _run_fused(
         depth=cfg.pipeline_chunks, donate=donate,
         on_retire=on_retire, should_stop=should_stop,
         on_aux=collector.on_aux if collector else None,
+        should_cancel=_cancel_fn(deadline),
     )
     run_s = time.perf_counter() - t1
 
@@ -1069,7 +1089,7 @@ def _run_fused(
     result = _finalize_result(
         topo, cfg, final, loop.rounds, target, compile_s, run_s,
         done=done, stalled=watchdog.stalled, loop=loop,
-        collector=collector,
+        collector=collector, cancelled=loop.cancelled,
     )
     result.setup_s = setup_s
     result.finalize_s = time.perf_counter() - t_fin
@@ -1147,9 +1167,17 @@ def run(
     start_round: int = 0,
     on_telemetry: Optional[Callable[[int, object], None]] = None,
     on_event: Optional[Callable] = None,
+    deadline: Optional[float] = None,
 ) -> RunResult:
     """Run one simulation to convergence (or cfg.max_rounds) — the public
     entry every caller (CLI, suite, tests) goes through.
+
+    ``deadline`` (absolute ``time.monotonic`` seconds, ISSUE 8) bounds how
+    long the run may hold the engine: the chunk driver consults it at
+    every retired boundary and a fired deadline ends the run within one
+    chunk with ``outcome="deadline_exceeded"`` — partial state and
+    telemetry, exact ``rounds``, the engine free for the next caller. No
+    deadline (None) leaves the loop schedule-identical to before.
 
     Engine resilience: environmental failures (_DEGRADABLE_ERRORS — compile
     errors, OOM, missing runtime features, dropped device connections) walk
@@ -1177,7 +1205,7 @@ def run(
                 result = _run_resolved(
                     topo, rung, key=key, on_chunk=on_chunk,
                     start_state=start_state, start_round=start_round,
-                    on_telemetry=on_telemetry,
+                    on_telemetry=on_telemetry, deadline=deadline,
                 )
                 if degradations:
                     result.degradations = degradations
@@ -1226,6 +1254,7 @@ def _run_resolved(
     start_state=None,
     start_round: int = 0,
     on_telemetry: Optional[Callable[[int, object], None]] = None,
+    deadline: Optional[float] = None,
 ) -> RunResult:
     """One attempt at one ladder rung: dispatch to the engine cfg names and
     run to completion on it.
@@ -1281,6 +1310,7 @@ def _run_resolved(
                 return run_fused_pool_sharded(
                     topo, cfg, key=key, on_chunk=on_chunk,
                     start_state=start_state, start_round=start_round,
+                    deadline=deadline,
                 )
             # Fused x sharded lattice compositions, tiered like the
             # single-device engines: per-shard multi-round Pallas chunks
@@ -1307,12 +1337,14 @@ def _run_resolved(
                 return run_fused_sharded(
                     topo, cfg, key=key, on_chunk=on_chunk,
                     start_state=start_state, start_round=start_round,
+                    deadline=deadline,
                 )
             plan_hbm = plan_stencil_hbm_sharded(topo, cfg, cfg.n_devices)
             if not isinstance(plan_hbm, str):
                 return run_stencil_hbm_sharded(
                     topo, cfg, key=key, on_chunk=on_chunk,
                     start_state=start_state, start_round=start_round,
+                    deadline=deadline,
                 )
             raise ValueError(
                 f"engine='fused' with n_devices={cfg.n_devices} "
@@ -1327,7 +1359,7 @@ def _run_resolved(
         return run_sharded(
             topo, cfg, key=key, on_chunk=on_chunk,
             start_state=start_state, start_round=start_round,
-            on_telemetry=on_telemetry,
+            on_telemetry=on_telemetry, deadline=deadline,
         )
     target = cfg.resolved_target_count(topo.n, topo.target_count)
     if cfg.reference and cfg.algorithm == "push-sum":
@@ -1343,6 +1375,12 @@ def _run_resolved(
                 "push-sum — the single-walk simulator (one message in "
                 "flight) has no multi-round batched kernel; drop the "
                 "engine override or use batched semantics"
+            )
+        if deadline is not None:
+            raise ValueError(
+                "deadline cancellation runs at chunk boundaries; the "
+                "reference-semantics single-walk simulator has none — "
+                "drop the deadline or use batched semantics"
             )
         # Reference fidelity: single-walk push-sum (one message in flight,
         # SURVEY.md §3.3). Gossip has no such mode — the reference's gossip
@@ -1456,6 +1494,7 @@ def _run_resolved(
                 topo, cfg, key, on_chunk, start_state, start_round,
                 interpret=jax.default_backend() != "tpu", variant=variant,
                 on_telemetry=on_telemetry, t_enter=t_enter,
+                deadline=deadline,
             )
         # auto: compiled engines on TPU only — interpret mode would make CPU
         # runs slower, and the chunked XLA path is already fast there.
@@ -1464,6 +1503,7 @@ def _run_resolved(
                 topo, cfg, key, on_chunk, start_state, start_round,
                 interpret=False, variant=variant,
                 on_telemetry=on_telemetry, t_enter=t_enter,
+                deadline=deadline,
             )
 
     round_fn, state0, key_data, topo_args = make_round_fn(topo, cfg, key)
@@ -1657,6 +1697,7 @@ def _run_resolved(
         on_retire=on_retire, should_stop=should_stop,
         on_aux=collector.on_aux if collector else None,
         health0=health0,
+        should_cancel=_cancel_fn(deadline),
     )
     run_s = time.perf_counter() - t1
 
@@ -1669,6 +1710,7 @@ def _run_resolved(
         topo, cfg, proto_of(loop.state), loop.rounds, target,
         compile_s, run_s, done=loop.done, stalled=watchdog.stalled,
         loop=loop, collector=collector, unhealthy_round=unhealthy_round,
+        cancelled=loop.cancelled,
     )
     result.setup_s = setup_s
     result.finalize_s = time.perf_counter() - t_fin

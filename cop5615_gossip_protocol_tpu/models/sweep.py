@@ -170,6 +170,11 @@ class SweepResult:
     # Warm-engine pool verdict for this batch's compiled chunk
     # (serving/pool.py): "hit" (reused a live executable) or "miss".
     engine_cache: Optional[str] = None
+    # The caller's deadline cancelled the batch at a chunk boundary
+    # (ISSUE 8): lanes still unconverged at the cancel carry
+    # outcome="deadline_exceeded" with their partial state/telemetry;
+    # already-converged lanes keep their full results.
+    cancelled: bool = False
 
     @property
     def wall_ms(self) -> float:
@@ -253,6 +258,7 @@ def run_batched_keys(
     keys: list,
     lanes: Optional[int] = None,
     keep_states: bool = True,
+    deadline: Optional[float] = None,
 ) -> SweepResult:
     """Run ``len(keys)`` independent simulations of one compile class in
     ONE vmapped chunked program — lane ``i`` rides ``keys[i]`` as its base
@@ -265,7 +271,14 @@ def run_batched_keys(
     pre-converged at entry so they execute zero rounds — so a serving
     bucket compiles one engine per power-of-two width instead of one per
     occupancy. The compiled vmapped chunk comes from the warm-engine pool
-    (serving/pool.py) keyed by the canonical engine key + lane count."""
+    (serving/pool.py) keyed by the canonical engine key + lane count.
+
+    ``deadline`` (absolute ``time.monotonic`` seconds, ISSUE 8) bounds how
+    long the batch may hold the engine: the serial chunk loop checks it at
+    every retired chunk, and a fired deadline stops the batch there —
+    lanes still unconverged get ``outcome="deadline_exceeded"`` with their
+    partial state/telemetry (``SweepResult.cancelled``), lanes already
+    done keep their full results. No deadline leaves the loop unchanged."""
     _reject_unsupported(cfg)
     requests = len(keys)
     if requests < 1:
@@ -433,6 +446,7 @@ def run_batched_keys(
     # below slices the first ``requests`` lanes.
     trajs = [[] for _ in range(requests)] if telemetry else None
     rounds_end = 0
+    cancelled = False
     t1 = time.perf_counter()
     while True:
         rounds_end = min(rounds_end + cfg.chunk_rounds, cfg.max_rounds)
@@ -456,6 +470,12 @@ def run_batched_keys(
                     )
         if bool(jnp.all(done)) or rounds_end >= cfg.max_rounds:
             break
+        if deadline is not None and time.monotonic() >= deadline:
+            # Deadline fired at a retired chunk: the overshoot contract
+            # makes this a safe cancel point — the engine is free for the
+            # next batch, unconverged lanes report deadline_exceeded below.
+            cancelled = True
+            break
     run_s = time.perf_counter() - t1
 
     rounds_np = np.asarray(rnd)[:requests]
@@ -475,12 +495,15 @@ def run_batched_keys(
         rounds=[int(r) for r in rounds_np],
         converged=[bool(d) for d in done_np],
         outcome=[
-            "converged" if bool(d) else "max_rounds" for d in done_np
+            "converged" if bool(d)
+            else ("deadline_exceeded" if cancelled else "max_rounds")
+            for d in done_np
         ],
         compile_s=compile_s,
         run_s=run_s,
         lanes=lanes,
         engine_cache="hit" if cache_hit else "miss",
+        cancelled=cancelled,
     )
     result.rounds_mean, result.rounds_ci95 = _mean_ci95(result.rounds)
 

@@ -903,6 +903,7 @@ def run_stencil_hbm_sharded(
     start_state=None,
     start_round: int = 0,
     probe=None,
+    deadline=None,
 ):
     """Sharded HBM-streaming run — engine='fused', n_devices > 1, lattices
     past the VMEM composition's per-shard budget.
@@ -1124,7 +1125,7 @@ def run_stencil_hbm_sharded(
     compile_s = time.perf_counter() - t0
 
     from ..models import pipeline as pipeline_mod
-    from ..models.runner import StallWatchdog, _progress_gap
+    from ..models.runner import StallWatchdog, _cancel_fn, _progress_gap
 
     watchdog = StallWatchdog(cfg.stall_chunks)
 
@@ -1154,10 +1155,12 @@ def run_stencil_hbm_sharded(
         start_round=start_round, max_rounds=cfg.max_rounds,
         stride=CR * 8, depth=cfg.pipeline_chunks, donate=donate,
         on_retire=on_retire, should_stop=should_stop,
+        should_cancel=_cancel_fn(deadline),
     )
     run_s = time.perf_counter() - t1
 
     return _finalize_result(
         topo, cfg, to_canonical(loop.state), loop.rounds, target,
         compile_s, run_s, done=loop.done, stalled=watchdog.stalled,
+        cancelled=loop.cancelled,
     )

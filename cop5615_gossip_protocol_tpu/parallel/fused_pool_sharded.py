@@ -125,6 +125,7 @@ def run_fused_pool_sharded(
     start_state=None,
     start_round: int = 0,
     probe=None,
+    deadline=None,
 ):
     """Sharded fused pool run — engine='fused', n_devices > 1, implicit full
     topology with delivery='pool'. Same contract as run_sharded; rounds are
@@ -146,6 +147,7 @@ def run_fused_pool_sharded(
     from ..models import pushsum as pushsum_mod
     from ..models.runner import (
         StallWatchdog,
+        _cancel_fn,
         _check_dtype,
         _finalize_result,
         _host_done,
@@ -355,10 +357,12 @@ def run_fused_pool_sharded(
         start_round=start_round, max_rounds=cfg.max_rounds,
         stride=cfg.chunk_rounds, depth=cfg.pipeline_chunks, donate=donate,
         on_retire=on_retire, should_stop=should_stop,
+        should_cancel=_cancel_fn(deadline),
     )
     run_s = time.perf_counter() - t1
 
     return _finalize_result(
         topo, cfg, to_canonical(loop.state), loop.rounds, target,
         compile_s, run_s, done=loop.done, stalled=watchdog.stalled,
+        cancelled=loop.cancelled,
     )

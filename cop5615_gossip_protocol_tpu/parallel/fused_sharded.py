@@ -470,6 +470,7 @@ def run_fused_sharded(
     start_state=None,
     start_round: int = 0,
     probe=None,
+    deadline=None,
 ):
     """Sharded fused run — the engine='fused', n_devices > 1 path.
 
@@ -707,7 +708,12 @@ def run_fused_sharded(
     compile_s = time.perf_counter() - t0
 
     from ..models import pipeline as pipeline_mod
-    from ..models.runner import StallWatchdog, _finalize_result, _progress_gap
+    from ..models.runner import (
+        StallWatchdog,
+        _cancel_fn,
+        _finalize_result,
+        _progress_gap,
+    )
 
     watchdog = StallWatchdog(cfg.stall_chunks)
 
@@ -737,10 +743,12 @@ def run_fused_sharded(
         start_round=start_round, max_rounds=cfg.max_rounds,
         stride=cfg.chunk_rounds * 8, depth=cfg.pipeline_chunks,
         donate=donate, on_retire=on_retire, should_stop=should_stop,
+        should_cancel=_cancel_fn(deadline),
     )
     run_s = time.perf_counter() - t1
 
     return _finalize_result(
         topo, cfg, to_canonical(loop.state), loop.rounds, target,
         compile_s, run_s, done=loop.done, stalled=watchdog.stalled,
+        cancelled=loop.cancelled,
     )

@@ -78,6 +78,7 @@ from ..models import pushsum as pushsum_mod
 from ..models.runner import (
     RunResult,
     StallWatchdog,
+    _cancel_fn,
     _check_dtype,
     _finalize_result,
     _freeze_dead,
@@ -112,8 +113,12 @@ def run_sharded(
     start_round: int = 0,
     on_telemetry: Optional[Callable[[int, object], None]] = None,
     probe=None,
+    deadline: Optional[float] = None,
 ) -> RunResult:
     """Sharded analog of models.runner.run — same config, same result.
+    ``deadline`` (absolute monotonic seconds) threads the run_chunks
+    cancellation hook: a fired deadline ends the run at the next retired
+    chunk with outcome="deadline_exceeded" (models/pipeline.py).
     ``start_state`` (unpadded, from utils/checkpoint.py) resumes a run;
     round keys use absolute round indices, so a resumed sharded run follows
     the same stream as the uninterrupted one.
@@ -800,6 +805,7 @@ def run_sharded(
         on_retire=on_retire, should_stop=should_stop,
         on_aux=collector.on_aux if collector else None,
         health0=health0,
+        should_cancel=_cancel_fn(deadline),
     )
     run_s = time.perf_counter() - t1
 
@@ -817,4 +823,5 @@ def run_sharded(
         topo, cfg, loop.state, loop.rounds, target, compile_s, run_s,
         done=loop.done, stalled=watchdog.stalled, loop=loop,
         collector=collector, unhealthy_round=unhealthy_round,
+        cancelled=loop.cancelled,
     )

@@ -1,16 +1,36 @@
 """Admission control + serving counters (the ``/stats`` and ``/metrics``
 endpoints' data).
 
-The admission front is a bounded queue: a request is ADMITTED when the
-number of requests waiting for a batch is below ``queue_limit``, else
-REJECTED with a structured payload (HTTP 429 — never an unbounded queue
-that converts overload into unbounded latency). The counters follow the
-closed-loop accounting identity the serve-smoke and metrics-smoke CI jobs
-assert:
+The admission front is a bounded queue PER PRIORITY CLASS (ISSUE 8):
+``priority ∈ {interactive, batch, best_effort}`` — a request is ADMITTED
+when its class's queue has room, else REJECTED with a structured payload
+carrying ``retry_after_s`` (HTTP 429 + ``Retry-After`` — never an
+unbounded queue that converts overload into unbounded latency). The
+counters follow the closed-loop accounting identities the serve-smoke,
+metrics-smoke and chaos-serve CI jobs assert:
 
     received  == admitted + rejected + invalid
-    admitted  == completed + failed + in_flight
-    batched_requests (Σ batch occupancy) == completed + failed
+    admitted  == completed + failed + shed + timed_out + in_flight
+    batched_requests (Σ batch occupancy)
+              == completed + failed + timed_out_dispatched
+
+so at quiescence ``received == completed + failed + rejected + invalid +
+timed_out + shed`` holds EXACTLY (the ISSUE 8 pin). The resilience
+vocabulary:
+
+- ``shed`` — admitted requests resolved WITHOUT an engine run: the
+  deadline expired before dispatch (structured ``deadline_exceeded``
+  body) or the overload controller dropped them (lowest class first,
+  structured ``shed`` body with ``retry_after_s``);
+- ``timed_out`` — the front thread gave up waiting
+  (``request_timeout_s``) and CLAIMED the request, so a later executor
+  completion is dropped instead of double-counted (the PR 6
+  orphaned-timeout hole, ISSUE 8 satellite). ``timed_out_dispatched``
+  is the subset claimed after their batch already dispatched — those
+  occupy batch lanes, hence the occupancy identity's third term;
+- ``deadline_exceeded`` — terminal responses with that outcome (both
+  pre-dispatch sheds and in-flight cancellations); overlaps ``shed`` and
+  ``completed``, an outcome tally rather than a partition term.
 
 Every counter and latency distribution lives in a metrics registry
 (utils/obs.py) owned by this object — one instance per ServingApp, so two
@@ -42,21 +62,50 @@ from ..utils import obs
 
 SPAN_NAMES = ("queue_wait_s", "batch_assemble_s", "engine_s", "demux_s")
 
+# Priority classes, highest first — the executor serves them in this
+# order and the overload controller sheds from the BACK of the tuple
+# (lowest class first). Requests default to "batch": interactive is an
+# explicit claim on the tightest SLO, best_effort an explicit concession.
+PRIORITIES = ("interactive", "batch", "best_effort")
+
+# Default per-class queue-wait SLO targets (seconds): the overload
+# controller compares each class's streaming queue-wait p99 against its
+# target and sheds lower classes while a higher class is in breach.
+# Env-overridable (GOSSIP_TPU_SERVE_SLO_<CLASS>_MS).
+DEFAULT_SLO_S = {"interactive": 0.5, "batch": 5.0, "best_effort": 60.0}
+
+
+def slo_targets_from_env() -> dict:
+    import os
+
+    out = {}
+    for cls in PRIORITIES:
+        env = os.environ.get(f"GOSSIP_TPU_SERVE_SLO_{cls.upper()}_MS", "")
+        out[cls] = (float(env) / 1e3) if env else DEFAULT_SLO_S[cls]
+    return out
+
 
 class AdmissionError(Exception):
-    """Request rejected at the admission front (bounded queue full)."""
+    """Request rejected at the admission front (its class's bounded queue
+    is full). Carries ``retry_after_s`` — the structured 429's
+    ``Retry-After`` hint (honest clients back off at least this long,
+    benchmarks/loadgen.py)."""
 
     def __init__(self, queue_depth: int, queue_limit: int,
-                 trace_id: Optional[str] = None):
+                 trace_id: Optional[str] = None,
+                 retry_after_s: Optional[float] = None,
+                 priority: Optional[str] = None):
         super().__init__(
-            f"admission rejected: queue depth {queue_depth} at limit "
-            f"{queue_limit}"
+            f"admission rejected: {priority or 'request'} queue depth "
+            f"{queue_depth} at limit {queue_limit}"
         )
         self.queue_depth = queue_depth
         self.queue_limit = queue_limit
         # Minted BEFORE the capacity check (serving/batcher.submit): a
         # rejected request still has a joinable identity in the event log.
         self.trace_id = trace_id
+        self.retry_after_s = retry_after_s
+        self.priority = priority
 
 
 def percentile(sorted_vals, q: float):
@@ -98,6 +147,26 @@ class ServingStats:
         self._c_degraded = r.counter(
             "gossip_tpu_serving_degraded_total",
             "completed requests that walked an engine-degradation rung")
+        self._c_shed = r.counter(
+            "gossip_tpu_serving_shed_total",
+            "admitted requests resolved without an engine run (deadline "
+            "expired pre-dispatch, or overload-shed lowest class first)")
+        self._c_shed_reason = r.counter(
+            "gossip_tpu_serving_shed_reason_total",
+            "shed requests by reason", ("reason",))
+        self._c_timed_out = r.counter(
+            "gossip_tpu_serving_timed_out_total",
+            "admitted requests whose front thread gave up waiting "
+            "(request_timeout_s) — claimed, never double-counted")
+        self._c_timed_out_dispatched = r.counter(
+            "gossip_tpu_serving_timed_out_dispatched_total",
+            "timed-out requests that had already entered a dispatched "
+            "batch (they occupy lanes; the occupancy identity's third "
+            "term)")
+        self._c_deadline = r.counter(
+            "gossip_tpu_serving_deadline_exceeded_total",
+            "terminal responses with outcome=deadline_exceeded (pre-"
+            "dispatch sheds + in-flight cancellations)")
         self._c_batches = r.counter(
             "gossip_tpu_serving_batches_total", "micro-batches executed")
         self._c_batched_requests = r.counter(
@@ -118,6 +187,21 @@ class ServingStats:
                 f"request lifecycle span: {name}")
             for name in SPAN_NAMES
         }
+        # Per-priority-class queue-wait histograms (ISSUE 8): observed at
+        # executor PICKUP for every popped request (shed ones included),
+        # so the overload controller's per-class p99 reflects the queue,
+        # not just the completions.
+        self._h_class_wait = {
+            cls: r.histogram(
+                f"gossip_tpu_serving_class_queue_wait_seconds_{cls}",
+                f"queue wait at executor pickup, priority class {cls}")
+            for cls in PRIORITIES
+        }
+        # Per-bucket engine-time histograms — the stuck-executor
+        # watchdog's budget seed (budget = max(floor, mult * p99)).
+        # Bounded: past _MAX_BUCKET_SERIES distinct buckets, observations
+        # fold into one shared "other" series.
+        self._h_bucket_engine: dict = {}
         self._g_depth = r.gauge(
             "gossip_tpu_serving_queue_depth",
             "requests waiting for a batch (live)")
@@ -139,7 +223,8 @@ class ServingStats:
         the opposite order — so this must never run under a lock a writer
         holds (the ABBA rule snapshot() documents)."""
         self._g_depth.set(self._depth_fn() if self._depth_fn else 0)
-        done = self._c_completed.value() + self._c_failed.value()
+        done = (self._c_completed.value() + self._c_failed.value()
+                + self._c_shed.value() + self._c_timed_out.value())
         self._g_inflight.set(self._c_admitted.value() - done)
 
     # -- readers the tests/batcher use as plain attributes -----------------
@@ -173,6 +258,22 @@ class ServingStats:
         return int(self._c_degraded.value())
 
     @property
+    def shed(self) -> int:
+        return int(self._c_shed.value())
+
+    @property
+    def timed_out(self) -> int:
+        return int(self._c_timed_out.value())
+
+    @property
+    def timed_out_dispatched(self) -> int:
+        return int(self._c_timed_out_dispatched.value())
+
+    @property
+    def deadline_exceeded(self) -> int:
+        return int(self._c_deadline.value())
+
+    @property
     def batches(self) -> int:
         return int(self._c_batches.value())
 
@@ -194,15 +295,26 @@ class ServingStats:
     def on_invalid(self) -> None:
         self._c_invalid.inc()
 
-    def on_batch(self, bucket: str, occupancy: int, lanes: int) -> None:
+    def on_batch_meta(self, bucket: str, lanes: int) -> None:
+        """One engine dispatch happened for ``bucket`` with ``lanes``
+        compiled lanes — the batches/lanes/bucket tallies. The occupancy
+        counter is deliberately SEPARATE (``on_lane_counted``): it is
+        incremented once per request, idempotently, at dispatch or at a
+        dispatch-less terminal failure, which is what keeps
+        ``batched_requests == completed + failed + timed_out_dispatched``
+        exact under failover/timeout/shutdown races (serving/batcher.py
+        _count_lane)."""
         self._c_batches.inc()
-        self._c_batched_requests.inc(occupancy)
         self._c_batch_lanes.inc(lanes)
         self._c_bucket.inc(bucket=bucket)
         with self._lock:
             self._bucket_counts[bucket] = (
                 self._bucket_counts.get(bucket, 0) + 1
             )
+
+    def on_lane_counted(self) -> None:
+        """One request entered the occupancy ledger (see on_batch_meta)."""
+        self._c_batched_requests.inc()
 
     def on_completed(self, wait_s: float, service_s: float,
                      degraded: bool = False, spans: Optional[dict] = None,
@@ -219,6 +331,68 @@ class ServingStats:
 
     def on_failed(self) -> None:
         self._c_failed.inc()
+
+    def on_shed(self, reason: str) -> None:
+        """One admitted request resolved without an engine run. ``reason``
+        is "deadline_exceeded" or "overload"."""
+        self._c_shed.inc()
+        self._c_shed_reason.inc(reason=reason)
+        if reason == "deadline_exceeded":
+            self._c_deadline.inc()
+
+    def on_timed_out(self, dispatched: bool) -> None:
+        """The front thread claimed an admitted request after
+        request_timeout_s. ``dispatched``: the request had already entered
+        a dispatched batch (it occupies lanes — occupancy identity)."""
+        self._c_timed_out.inc()
+        if dispatched:
+            self._c_timed_out_dispatched.inc()
+
+    def on_deadline_exceeded_completion(self) -> None:
+        """A dispatched request finished with outcome=deadline_exceeded
+        (in-flight cancellation) — counted in ``completed`` by the normal
+        path; this tallies the outcome counter next to the pre-dispatch
+        sheds."""
+        self._c_deadline.inc()
+
+    def on_queue_wait(self, priority: str, wait_s: float) -> None:
+        """Queue wait at executor pickup, per priority class — the
+        overload controller's signal (and the ISSUE 8 overload pin)."""
+        h = self._h_class_wait.get(priority)
+        if h is not None:
+            h.observe(wait_s)
+
+    def class_wait_p99(self, priority: str) -> Optional[float]:
+        h = self._h_class_wait.get(priority)
+        return h.quantile(0.99) if h is not None else None
+
+    _MAX_BUCKET_SERIES = 64
+
+    def on_engine_time(self, bucket: str, engine_s: float) -> None:
+        """Per-bucket engine wall — the watchdog budget's seed."""
+        self._bucket_engine_hist(bucket).observe(engine_s)
+
+    def bucket_engine_p99(self, bucket: str) -> Optional[float]:
+        with self._lock:
+            h = self._h_bucket_engine.get(bucket)
+        return h.quantile(0.99) if h is not None else None
+
+    def _bucket_engine_hist(self, bucket: str):
+        with self._lock:
+            h = self._h_bucket_engine.get(bucket)
+            if h is None:
+                if len(self._h_bucket_engine) >= self._MAX_BUCKET_SERIES:
+                    bucket = "other"
+                    h = self._h_bucket_engine.get(bucket)
+                if h is None:
+                    import re
+
+                    safe = re.sub(r"[^A-Za-z0-9_]", "_", bucket)
+                    h = self.registry.histogram(
+                        f"gossip_tpu_serving_bucket_engine_seconds_{safe}",
+                        f"engine wall per dispatch, bucket {bucket}")
+                    self._h_bucket_engine[bucket] = h
+            return h
 
     # -- readers -----------------------------------------------------------
 
@@ -240,7 +414,9 @@ class ServingStats:
         depth = self._depth_fn() if self._depth_fn else 0
         completed = self.completed
         failed = self.failed
-        done = completed + failed
+        shed = self.shed
+        timed_out = self.timed_out
+        done = completed + failed + shed + timed_out
         svc = self._h_service
         wait_h = self._h_spans["queue_wait_s"]
         p50 = svc.quantile(0.50)
@@ -257,6 +433,10 @@ class ServingStats:
             "invalid": self.invalid,
             "completed": completed,
             "failed": failed,
+            "shed": shed,
+            "timed_out": timed_out,
+            "timed_out_dispatched": self.timed_out_dispatched,
+            "deadline_exceeded": self.deadline_exceeded,
             "degraded": self.degraded,
             "in_flight": self.admitted - done,
             "queue_depth": depth,
@@ -269,14 +449,22 @@ class ServingStats:
                 batched_requests / lanes_sum if lanes_sum else None
             ),
             "buckets": buckets,
+            # Means over the requests that OBSERVED the histograms (the
+            # completions) — shed/timed-out requests never record spans.
             "wait_ms_mean": (
-                1e3 * wait_h.sum / done if done else None
+                1e3 * wait_h.sum / wait_h.count if wait_h.count else None
             ),
             "service_ms_mean": (
-                1e3 * svc.sum / done if done else None
+                1e3 * svc.sum / svc.count if svc.count else None
             ),
             "service_ms_p50": 1e3 * p50 if p50 is not None else None,
             "service_ms_p99": 1e3 * p99 if p99 is not None else None,
+            "class_queue_wait_ms_p99": {
+                cls: (1e3 * q if q is not None else None)
+                for cls, q in (
+                    (c, self.class_wait_p99(c)) for c in PRIORITIES
+                )
+            },
         }
         from . import pool as pool_mod
 

@@ -19,10 +19,56 @@ structured ``engine_degraded`` field in the response, never a 500.
 ``GOSSIP_TPU_STRICT_ENGINE`` (models/runner._strict_engine) restores
 fail-fast, surfacing as a structured 503.
 
-Threading: HTTP handler threads ``submit()`` into the bounded admission
-queue and block on the request's event; ONE executor thread drains the
-queue per window, groups by bucket, and runs each group. JAX dispatch
-happens only on the executor thread.
+Threading: HTTP handler threads ``submit()`` into the per-priority bounded
+admission queues and block on the request's event; ONE live executor
+thread drains the queues per window (priority order), groups by bucket,
+and runs each group. JAX dispatch happens only on the executor thread.
+
+Resilience plane (ISSUE 8) — four mechanisms on top of the PR 6 batcher:
+
+- **Per-request deadlines.** ``deadline_ms`` is minted into an absolute
+  ``t_deadline`` at admission and checked at every hand-off: queue pop and
+  batch assembly shed expired requests BEFORE dispatch (structured
+  ``deadline_exceeded`` body, 504), and the group's max deadline rides
+  into ``run_batched_keys``'s cancellation hook so an in-flight run stops
+  at the next retired chunk — unconverged lanes return
+  ``outcome="deadline_exceeded"`` with partial telemetry (the overshoot
+  contract makes chunk boundaries safe cancel points).
+- **Priority classes + SLO-aware shedding.** ``priority ∈ {interactive,
+  batch, best_effort}`` (admission.PRIORITIES) with one bounded queue
+  each (full → structured 429 + ``Retry-After``). The executor serves
+  classes highest-first; the overload controller compares each class's
+  queue-wait against its SLO target (streaming per-class histograms,
+  admission.py — with a live-wave confirmation so a long-quiet server
+  never sheds on a stale p99) and sheds requests of every class STRICTLY
+  BELOW a breaching class (lowest first by construction — structured
+  ``shed`` body with ``retry_after_s``).
+- **Stuck-executor failover.** A watchdog thread clocks the active
+  dispatch against a per-bucket budget seeded from the bucket's
+  engine-time p99 (``max(GOSSIP_TPU_SERVE_STUCK_MIN_S, mult × p99)``).
+  On breach the executor GENERATION advances (the wedged thread,
+  unkillable mid-JAX-call, is abandoned: claims + the generation guard
+  make any late completion a silent no-op), the bucket's engine keys are
+  quarantined (serving/pool.Quarantine — circuit breaker with a timed
+  half-open re-probe; the pooled executables are invalidated so the probe
+  rebuilds), the group's unresolved requests re-queue at the FRONT of
+  their class queues (one failover each; a second wedge fails them
+  structurally), and a fresh executor thread takes over. While a circuit
+  is open, that bucket's requests run the per-request one-shot path
+  (stamped ``engine_degraded`` reason "quarantined") — degraded, never
+  hung.
+- **Graceful shutdown.** ``stop(drain=True)`` drains under a bounded
+  window (``drain_window_s``); expiry — or ``drain=False`` — resolves
+  every queued AND in-flight request with a structured ``shutting_down``
+  error, so every admitted request gets exactly one terminal response,
+  never a dropped socket (the server's SIGTERM path, serving/server.py).
+
+Exactly-once resolution: every path that answers a request must win its
+CLAIM first (``ServeRequest.try_claim``) — front-timeout, executor finish,
+watchdog failover, overload shed, and shutdown all race safely; the loser
+does nothing (no double response, no double count). The accounting
+follows the claim winner, which is what keeps the admission.py identities
+exact under chaos (the chaos-serve CI job pins them).
 
 Request tracing (ISSUE 7): every request gets a ``trace_id`` minted at
 admission, carried through the queue, the micro-batch lane, the engine
@@ -30,14 +76,17 @@ dispatch, and the response demux. The executor clocks the four lifecycle
 spans — ``queue_wait_s`` / ``batch_assemble_s`` / ``engine_s`` /
 ``demux_s`` — which partition the service wall exactly; they ride the
 response (``serving.spans``), the per-request event stream, the server
-event log (schema v4), and the admission histograms, so one id joins a
+event log (schema v5), and the admission histograms, so one id joins a
 request across every surface.
 """
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import itertools
+import math
+import os
 import threading
 import time
 import uuid
@@ -47,9 +96,20 @@ import numpy as np
 
 from ..config import SimConfig
 from . import keys as keys_mod
-from .admission import AdmissionError, ServingStats
+from . import pool as pool_mod
+from .admission import (
+    PRIORITIES,
+    AdmissionError,
+    ServingStats,
+    slo_targets_from_env,
+)
 
 _REQ_COUNTER = itertools.count()
+_PRIORITY_INDEX = {cls: i for i, cls in enumerate(PRIORITIES)}
+
+
+def _env_float(name: str, default: float) -> float:
+    return float(os.environ.get(name, "") or default)
 
 
 def lane_bucket(occupancy: int, max_lanes: int, min_lanes: int = 1) -> int:
@@ -66,12 +126,19 @@ def lane_bucket(occupancy: int, max_lanes: int, min_lanes: int = 1) -> int:
 
 @dataclasses.dataclass
 class ServeRequest:
-    """One admitted request in flight. ``ready`` is set by the executor
+    """One admitted request in flight. ``ready`` is set by the resolver
     once ``status``/``response`` hold the final verdict. ``trace_id`` is
     minted at admission and propagated through queue -> micro-batch lane
     -> engine dispatch -> demux: every lifecycle event (per-request stream
     AND the server's --events log) and the response itself carry it, so
-    one JSONL join reconstructs the request's full lifecycle (ISSUE 7)."""
+    one JSONL join reconstructs the request's full lifecycle (ISSUE 7).
+
+    Exactly-once terminal responses (ISSUE 8): resolution is a CLAIM —
+    ``try_claim`` hands ownership to exactly one of the racing resolvers
+    (executor finish, front timeout, watchdog failover, shed, shutdown);
+    everyone else backs off. ``dispatched`` marks entry into an engine
+    dispatch (set atomically with the claim check), which is what splits
+    ``timed_out`` into its pre/post-dispatch accounting halves."""
 
     request_id: str
     trace_id: str
@@ -81,12 +148,52 @@ class ServeRequest:
     bucket_label: str
     want_telemetry: bool
     t_received: float
+    priority: str = "batch"
+    # Absolute time.monotonic deadline (None = no deadline).
+    t_deadline: Optional[float] = None
+    failovers: int = 0
     ready: threading.Event = dataclasses.field(
         default_factory=threading.Event
     )
     status: int = 0
     response: Optional[dict] = None
     events: list = dataclasses.field(default_factory=list)
+    _claim_lock: threading.Lock = dataclasses.field(
+        default_factory=threading.Lock, repr=False
+    )
+    claimed: bool = False
+    dispatched: bool = False
+    # This request entered the batched_requests occupancy ledger
+    # (MicroBatcher._count_lane — idempotent, exactly once per request).
+    occupancy_counted: bool = False
+
+    def try_claim(self) -> bool:
+        """First resolver wins; losers must not touch status/response or
+        any counter."""
+        with self._claim_lock:
+            if self.claimed:
+                return False
+            self.claimed = True
+            return True
+
+    def mark_dispatched_if_unresolved(self) -> bool:
+        """Atomically enter engine dispatch: False when some resolver
+        already claimed the request (it must be dropped from the group
+        BEFORE occupancy is counted)."""
+        with self._claim_lock:
+            if self.claimed:
+                return False
+            self.dispatched = True
+            return True
+
+    def is_dispatched(self) -> bool:
+        with self._claim_lock:
+            return self.dispatched
+
+    def deadline_expired(self, now: Optional[float] = None) -> bool:
+        if self.t_deadline is None:
+            return False
+        return (time.monotonic() if now is None else now) >= self.t_deadline
 
     def emit(self, event: str, **fields) -> None:
         """Per-request lifecycle stream, demultiplexed into the response —
@@ -111,6 +218,11 @@ class MicroBatcher:
         batching: bool = True,
         event_log=None,
         min_lanes: int = 8,
+        slo_s: Optional[dict] = None,
+        stuck_min_s: Optional[float] = None,
+        stuck_mult: Optional[float] = None,
+        quarantine_s: Optional[float] = None,
+        drain_window_s: Optional[float] = None,
     ):
         if max_lanes < 1:
             raise ValueError("max_lanes must be >= 1")
@@ -121,55 +233,183 @@ class MicroBatcher:
         self.window_s = float(window_s)
         self.max_lanes = int(max_lanes)
         self.min_lanes = int(min_lanes)
+        # One bounded queue PER PRIORITY CLASS, each with the full limit:
+        # a flood of best_effort work can never consume interactive's
+        # admission headroom (the point of the split).
         self.queue_limit = int(queue_limit)
         self.batching = bool(batching)
         self.stats = stats if stats is not None else ServingStats()
         self.event_log = event_log
-        self._queue: list = []
+        self.slo_s = dict(slo_s) if slo_s is not None else slo_targets_from_env()
+        # Stuck-executor budget: max(floor, mult * bucket engine p99).
+        self.stuck_min_s = (
+            float(stuck_min_s) if stuck_min_s is not None
+            else _env_float("GOSSIP_TPU_SERVE_STUCK_MIN_S", 30.0)
+        )
+        self.stuck_mult = (
+            float(stuck_mult) if stuck_mult is not None
+            else _env_float("GOSSIP_TPU_SERVE_STUCK_MULT", 10.0)
+        )
+        # Cold-bucket budget: a bucket with no engine-time history (first
+        # dispatch) or a half-open probe rebuilding an invalidated engine
+        # legitimately pays a trace+compile, which can dwarf the warm
+        # budget — clocking those against the warm bound would fail over
+        # healthy compiles.
+        self.stuck_cold_s = max(
+            _env_float("GOSSIP_TPU_SERVE_STUCK_COLD_S", 120.0),
+            self.stuck_min_s,
+        )
+        self.drain_window_s = (
+            float(drain_window_s) if drain_window_s is not None
+            else _env_float("GOSSIP_TPU_SERVE_DRAIN_WINDOW_S", 30.0)
+        )
+        self.quarantine = pool_mod.Quarantine(
+            cooldown_s=(
+                float(quarantine_s) if quarantine_s is not None
+                else _env_float("GOSSIP_TPU_SERVE_QUARANTINE_S", 30.0)
+            ),
+            registry=self.stats.registry,
+        )
+        self._queues = {cls: collections.deque() for cls in PRIORITIES}
         self._cv = threading.Condition()
         self._stop = False
+        # Executor generation: the failover abandons a wedged thread by
+        # advancing this; a stale thread's completions are no-ops (claims
+        # + the _live guard).
+        self._gen = 0
         self._thread: Optional[threading.Thread] = None
+        # The watchdog's view of the active dispatch:
+        # {gen, bucket, bucket_label, t0, budget_s, group, probe}.
+        self._wd_lock = threading.Lock()
+        self._active: Optional[dict] = None
+        # The live worker's whole popped wave ({gen, requests}): requests
+        # out of the queues but not yet executed must stay reachable by
+        # failover re-queueing and shutdown resolution — otherwise a
+        # mid-wave failover would orphan every group behind the wedged
+        # one.
+        self._wave: Optional[dict] = None
+        self._wd_thread: Optional[threading.Thread] = None
+        # Chaos fault injector (env-gated, the chaos-serve CI hook):
+        # GOSSIP_TPU_SERVE_WEDGE="substr:seconds[:count[:arm_s]]" wedges
+        # the next ``count`` (default 1) dispatches of any bucket whose
+        # label contains ``substr`` by sleeping ``seconds`` inside the
+        # dispatch — but only once ``arm_s`` seconds (default 0) have
+        # passed since startup, so a chaos harness can warm the pools
+        # first and wedge mid-load.
+        self._wedge = None
+        self._t_init = time.monotonic()
+        spec = os.environ.get("GOSSIP_TPU_SERVE_WEDGE", "")
+        if spec:
+            parts = spec.split(":")
+            self._wedge = {
+                "substr": parts[0],
+                "seconds": float(parts[1]) if len(parts) > 1 else 60.0,
+                "count": int(parts[2]) if len(parts) > 2 else 1,
+                "arm_s": float(parts[3]) if len(parts) > 3 else 0.0,
+            }
         self.stats.wire_depth(self.queue_depth)
 
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> "MicroBatcher":
         self._thread = threading.Thread(
-            target=self._worker, name="gossip-serve-batcher", daemon=True
+            target=self._worker, args=(self._gen,),
+            name="gossip-serve-batcher", daemon=True,
         )
         self._thread.start()
+        self._wd_thread = threading.Thread(
+            target=self._watchdog, name="gossip-serve-watchdog", daemon=True
+        )
+        self._wd_thread.start()
         return self
 
-    def stop(self, drain: bool = True) -> None:
-        """Stop the executor; with ``drain`` (default) every already-
-        admitted request still completes before the thread exits."""
+    def stop(self, drain: bool = True,
+             drain_window_s: Optional[float] = None) -> None:
+        """Stop the executor. ``drain`` (default) lets already-admitted
+        requests complete, bounded by ``drain_window_s`` (ctor default /
+        GOSSIP_TPU_SERVE_DRAIN_WINDOW_S); window expiry — or
+        ``drain=False`` — resolves every queued and in-flight request with
+        a structured ``shutting_down`` error, so no admitted request ever
+        hangs a client (ISSUE 8 satellite: the terminal-response
+        guarantee)."""
+        window = (
+            self.drain_window_s if drain_window_s is None
+            else float(drain_window_s)
+        )
         with self._cv:
             self._stop = True
-            if not drain:
-                for r in self._queue:
-                    r.status = 503
-                    r.response = _error_body(
-                        r, "server-stopping", "server shut down before "
-                        "this request was dispatched"
-                    )
-                    self.stats.on_failed()
-                    r.ready.set()
-                self._queue.clear()
             self._cv.notify_all()
+        if drain and self._thread is not None:
+            self._thread.join(timeout=window)
+        # Whatever is left — nothing under a completed drain — gets the
+        # structured shutdown verdict now. Claims make this race-free
+        # against a still-running (or wedged) executor.
+        self._resolve_all_shutting_down()
         if self._thread is not None:
-            self._thread.join(timeout=30)
+            self._thread.join(timeout=1.0)
+
+    def _resolve_all_shutting_down(self) -> None:
+        with self._cv:
+            queued = [r for q in self._queues.values() for r in q]
+            for q in self._queues.values():
+                q.clear()
+            # Abandon any in-flight dispatch: its late completion must not
+            # double-resolve (the generation guard + claims).
+            self._gen += 1
+            self._cv.notify_all()
+        with self._wd_lock:
+            active = self._active
+            wave = self._wave
+            in_flight = list(active["group"]) if active else []
+            if wave is not None:
+                in_flight.extend(wave["requests"])
+        for r in itertools.chain(queued, in_flight):
+            if not r.try_claim():
+                continue
+            r.status = 503
+            r.response = _error_body(
+                r, "shutting_down", "server shut down before this request "
+                "completed; retry against a live replica"
+            )
+            # The occupancy identity survives shutdown: every FAILED
+            # request lands in the batched_requests ledger exactly once
+            # (idempotent — a dispatched one is already there).
+            self._count_lane(r)
+            self.stats.on_failed()
+            r.ready.set()
 
     def queue_depth(self) -> int:
         with self._cv:
-            return len(self._queue)
+            return sum(len(q) for q in self._queues.values())
+
+    def class_depth(self, priority: str) -> int:
+        with self._cv:
+            return len(self._queues[priority])
 
     # -- admission ---------------------------------------------------------
 
-    def submit(self, cfg: SimConfig, want_telemetry: bool) -> ServeRequest:
-        """Admit one request into the batching queue, or raise
-        AdmissionError (the bounded-queue front). Topology build/lookup is
-        cached (serving/keys.get_topology) and happens on the caller's
-        thread — the executor only runs programs."""
+    def retry_after_s(self, priority: str) -> float:
+        """The structured 429/shed ``Retry-After`` hint: a coarse estimate
+        of when this class's queue will have drained a batch — depth in
+        batches times recent median service time, clamped to [1, 30] s."""
+        depth = self.class_depth(priority)
+        svc = self.stats._h_service.quantile(0.5)  # noqa: SLF001 — own stats
+        est = (depth / max(self.max_lanes, 1) + 1.0) * (svc or 0.05)
+        return float(min(30.0, max(1.0, math.ceil(est))))
+
+    def submit(self, cfg: SimConfig, want_telemetry: bool,
+               priority: str = "batch",
+               deadline_ms: Optional[float] = None) -> ServeRequest:
+        """Admit one request into its priority class's bounded queue, or
+        raise AdmissionError (the bounded-queue front, with the
+        ``Retry-After`` hint). Topology build/lookup is cached
+        (serving/keys.get_topology) and happens on the caller's thread —
+        the executor only runs programs."""
+        if priority not in _PRIORITY_INDEX:
+            raise ValueError(
+                f"priority must be one of {list(PRIORITIES)}, "
+                f"got {priority!r}"
+            )
         # Only the imp kinds' builders consume the seed (the random extra
         # edge); keying the cache on it for every kind would make each
         # distinct-seed request a cache miss + O(n·deg) rebuild in the
@@ -183,6 +423,7 @@ class MicroBatcher:
         topo = keys_mod.get_topology(
             cfg.topology, cfg.n, seed=topo_seed, semantics=cfg.semantics
         )
+        now = time.monotonic()
         req = ServeRequest(
             request_id=f"r{next(_REQ_COUNTER)}-{uuid.uuid4().hex[:8]}",
             trace_id=trace_id,
@@ -191,40 +432,81 @@ class MicroBatcher:
             bucket=keys_mod.serve_bucket_key(cfg, topo),
             bucket_label=keys_mod.bucket_label(cfg, topo),
             want_telemetry=want_telemetry,
-            t_received=time.monotonic(),
+            t_received=now,
+            priority=priority,
+            t_deadline=(
+                now + float(deadline_ms) / 1e3
+                if deadline_ms is not None else None
+            ),
         )
         with self._cv:
-            if self._stop:
-                raise AdmissionError(len(self._queue), self.queue_limit,
-                                     trace_id)
-            if len(self._queue) >= self.queue_limit:
-                raise AdmissionError(len(self._queue), self.queue_limit,
-                                     trace_id)
+            queue = self._queues[priority]
+            if self._stop or len(queue) >= self.queue_limit:
+                raise AdmissionError(
+                    len(queue), self.queue_limit, trace_id,
+                    retry_after_s=self.retry_after_s(priority),
+                    priority=priority,
+                )
             # Count the admission BEFORE the worker can see (and finish)
             # the request — a /stats snapshot must never read
             # completed > admitted.
             self.stats.on_admitted()
-            self._queue.append(req)
+            queue.append(req)
             self._cv.notify_all()
-        req.emit("request-admitted", bucket=req.bucket_label)
+        req.emit("request-admitted", bucket=req.bucket_label,
+                 priority=priority)
         if self.event_log is not None:
             # The server-log half of the trace join (schema v4). Only when
             # --events is on: the fsync-per-line durability contract makes
             # per-request events a deliberate opt-in cost.
             self.event_log.emit(
                 "request-admitted", trace_id=trace_id,
-                bucket=req.bucket_label,
+                bucket=req.bucket_label, priority=priority,
             )
         return req
 
     # -- executor ----------------------------------------------------------
 
-    def _worker(self) -> None:
+    def _live(self, gen: int) -> bool:
+        with self._cv:
+            return gen == self._gen
+
+    def _count_lane(self, r: ServeRequest) -> None:
+        """Enter ``r`` into the batched_requests occupancy ledger exactly
+        once (idempotent under the claim lock): at dispatch for group
+        members, at terminal failure for requests that never dispatched —
+        which is what keeps ``batched_requests == completed + failed +
+        timed_out_dispatched`` exact under every failover/timeout/shutdown
+        interleaving (the chaos-serve pin)."""
+        with r._claim_lock:  # noqa: SLF001 — the batcher owns the request
+            if r.occupancy_counted:
+                return
+            r.occupancy_counted = True
+        self.stats.on_lane_counted()
+
+    def _total_queued_locked(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
+    def _pop_all_locked(self) -> list:
+        """Drain every queue, highest priority class first (within a
+        class, FIFO — failover re-queues appendleft to keep their place)."""
+        out: list = []
+        for cls in PRIORITIES:
+            q = self._queues[cls]
+            out.extend(q)
+            q.clear()
+        return out
+
+    def _worker(self, my_gen: int) -> None:
         while True:
             with self._cv:
-                while not self._queue and not self._stop:
+                if self._gen != my_gen:
+                    return  # failed over: a fresh executor owns the queues
+                while not self._total_queued_locked() and not self._stop:
                     self._cv.wait(timeout=0.1)
-                if not self._queue:
+                    if self._gen != my_gen:
+                        return
+                if not self._total_queued_locked():
                     if self._stop:
                         return
                     continue
@@ -233,52 +515,335 @@ class MicroBatcher:
                     # concurrent arrivals co-batch, close early once a
                     # full batch is waiting.
                     deadline = time.monotonic() + self.window_s
-                    while not self._stop and len(self._queue) < self.max_lanes:
+                    while (not self._stop and self._gen == my_gen
+                           and self._total_queued_locked() < self.max_lanes):
                         remaining = deadline - time.monotonic()
                         if remaining <= 0:
                             break
                         self._cv.wait(timeout=remaining)
-                batch = list(self._queue)
-                self._queue.clear()
+                if self._gen != my_gen:
+                    return
+                batch = self._pop_all_locked()
+                # Register the wave INSIDE the same _cv critical section
+                # as the pop (lock order _cv -> _wd_lock, shared with
+                # _failover): a stop()/failover between pop and
+                # registration would otherwise see empty queues AND no
+                # wave — orphaning every popped request.
+                with self._wd_lock:
+                    self._wave = {"gen": my_gen, "requests": batch}
+            batch = self._pre_dispatch(batch)
             if self.batching:
                 groups: dict = {}
                 for r in batch:
                     groups.setdefault(r.bucket, []).append(r)
-                for group in groups.values():
+                # Interactive buckets dispatch first: under backlog the
+                # executor is the bottleneck, so execution order IS the
+                # priority policy's second half (admission bounds are the
+                # first).
+                ordered = sorted(
+                    groups.values(),
+                    key=lambda g: min(
+                        _PRIORITY_INDEX[r.priority] for r in g
+                    ),
+                )
+                for group in ordered:
                     for i in range(0, len(group), self.max_lanes):
-                        self._execute_safe(group[i:i + self.max_lanes])
+                        self._execute_safe(group[i:i + self.max_lanes],
+                                           my_gen)
             else:
                 # Batching-off control (benchmarks/loadgen.py's ratio
                 # baseline): every request is its own single-lane program
                 # — same warm pool, no shared dispatch.
                 for r in batch:
-                    self._execute_safe([r])
+                    self._execute_safe([r], my_gen)
+            with self._wd_lock:
+                if self._wave is not None and self._wave["gen"] == my_gen:
+                    self._wave = None
 
-    def _execute_safe(self, group: list) -> None:
+    # -- pre-dispatch hand-off checks (ISSUE 8) ----------------------------
+
+    def _pre_dispatch(self, batch: list) -> list:
+        """The queue-pop hand-off: record per-class queue waits, drop
+        requests another resolver already claimed (front timeouts), shed
+        expired deadlines, and run the overload controller. Returns the
+        runnable remainder in the original (priority) order."""
+        now = time.monotonic()
+        live: list = []
+        for r in batch:
+            self.stats.on_queue_wait(r.priority, now - r.t_received)
+            if r.claimed:
+                continue  # front-timeout claimed it while queued
+            if r.deadline_expired(now):
+                self._shed(
+                    r, "deadline_exceeded",
+                    f"deadline expired {1e3 * (now - r.t_deadline):.0f} ms "
+                    "ago while queued", status=504,
+                )
+                continue
+            live.append(r)
+        return self._overload_shed(live, now)
+
+    def _overload_shed(self, batch: list, now: float) -> list:
+        """SLO-aware load shedding, lowest class first: a class whose
+        queue-wait p99 exceeds its SLO target — confirmed by a member of
+        that class in THIS wave waiting past the target, so a stale
+        all-time p99 alone never sheds a quiet server — sheds every
+        request of strictly lower classes (structured ``shed`` body with
+        ``retry_after_s``; honest clients back off and retry)."""
+        if not batch:
+            return batch
+        wave_wait = {cls: 0.0 for cls in PRIORITIES}
+        for r in batch:
+            wave_wait[r.priority] = max(
+                wave_wait[r.priority], now - r.t_received
+            )
+        breach_floor = None  # index of the highest breaching class
+        for cls in PRIORITIES:
+            slo = self.slo_s.get(cls)
+            if slo is None:
+                continue
+            p99 = self.stats.class_wait_p99(cls)
+            if (p99 is not None and p99 > slo
+                    and wave_wait[cls] > slo):
+                breach_floor = _PRIORITY_INDEX[cls]
+                break
+        if breach_floor is None:
+            return batch
+        keep: list = []
+        for r in batch:
+            if _PRIORITY_INDEX[r.priority] > breach_floor:
+                self._shed(
+                    r, "overload",
+                    f"shed under overload: {PRIORITIES[breach_floor]} "
+                    "queue-wait p99 over its SLO target; retry after "
+                    "backoff", status=503,
+                    retry_after_s=self.retry_after_s(r.priority),
+                )
+            else:
+                keep.append(r)
+        return keep
+
+    def _shed(self, r: ServeRequest, reason: str, detail: str,
+              status: int = 503,
+              retry_after_s: Optional[float] = None) -> None:
+        if not r.try_claim():
+            return
+        r.emit("request-shed", reason=reason)
+        if self.event_log is not None:
+            self.event_log.emit(
+                "request-shed", trace_id=r.trace_id, reason=reason,
+                priority=r.priority, bucket=r.bucket_label,
+            )
+        extra = {}
+        if retry_after_s is not None:
+            extra["retry_after_s"] = retry_after_s
+        r.status = status
+        r.response = _error_body(r, reason if reason != "overload"
+                                 else "shed", detail, **extra)
+        self.stats.on_shed(reason)
+        r.ready.set()
+
+    # -- stuck-executor watchdog (ISSUE 8) ---------------------------------
+
+    def _budget_s(self, bucket_label: str, cold: bool = False) -> float:
+        p99 = self.stats.bucket_engine_p99(bucket_label)
+        if cold or p99 is None:
+            return self.stuck_cold_s
+        return max(self.stuck_min_s, self.stuck_mult * p99)
+
+    def _watchdog(self) -> None:
+        """Clock the active dispatch against its bucket budget; on breach,
+        fail the group over to a fresh executor thread and quarantine the
+        bucket (module docstring)."""
+        while True:
+            with self._cv:
+                if self._stop:
+                    return
+            with self._wd_lock:
+                active = self._active
+            if active is not None and self._live(active["gen"]):
+                elapsed = time.monotonic() - active["t0"]
+                if elapsed > active["budget_s"]:
+                    self._failover(active, elapsed)
+            time.sleep(0.05)
+
+    def _failover(self, active: dict, elapsed: float) -> None:
+        with self._cv:
+            if self._gen != active["gen"]:
+                return  # already failed over (or shut down)
+            with self._wd_lock:
+                if self._active is not active:
+                    # TOCTOU guard: the clocked dispatch completed between
+                    # the watchdog's read and this call (a new, healthy
+                    # dispatch may already be in flight) — aborting a
+                    # finished dispatch would quarantine a bucket that
+                    # just succeeded and duplicate the new group's work.
+                    return
+                self._active = None
+                wave = self._wave
+                self._wave = None
+            self._gen += 1
+            new_gen = self._gen
+        label = active["bucket_label"]
+        if self.event_log is not None:
+            self.event_log.emit(
+                "executor-stuck", bucket=label, elapsed_s=elapsed,
+                budget_s=active["budget_s"], generation=active["gen"],
+            )
+            self.event_log.emit(
+                "engine-quarantined", bucket=label,
+                cooldown_s=self.quarantine.cooldown_s,
+            )
+        if active.get("probe"):
+            # The half-open probe itself wedged: re-open the circuit.
+            self.quarantine.record(active["bucket"], ok=False)
+        else:
+            self.quarantine.trip(active["bucket"])
+        # Drop the bucket's pooled executables so the eventual half-open
+        # probe rebuilds instead of re-entering the wedged program. Pool
+        # entries are ("batch-engine"|"run-chunk", canonical_key, ...);
+        # the serve bucket key extends canonical_key (serving/keys.py).
+        canonical = active["bucket"][:3]
+        pool_mod.default_pool().invalidate(
+            lambda k: isinstance(k, tuple) and len(k) >= 2
+            and k[1] == canonical
+        )
+        wedged = set(id(r) for r in active["group"])
+        candidates = list(active["group"])
+        if wave is not None and wave["gen"] == active["gen"]:
+            # The rest of the abandoned worker's popped wave (groups
+            # queued BEHIND the wedged one) re-queues too — they were
+            # never dispatched and must not be orphaned.
+            candidates.extend(
+                r for r in wave["requests"] if id(r) not in wedged
+            )
+        requeue: list = []
+        for r in candidates:
+            if r.claimed:
+                continue
+            if id(r) in wedged:
+                if r.failovers >= 1:
+                    if r.try_claim():
+                        r.status = 503
+                        r.response = _error_body(
+                            r, "executor-stuck",
+                            f"dispatch exceeded its "
+                            f"{active['budget_s']:.1f}s budget twice; "
+                            "giving up",
+                        )
+                        self._count_lane(r)
+                        self.stats.on_failed()
+                        r.ready.set()
+                    continue
+                # Only the wedged group burns its failover credit; the
+                # innocent rest of the wave re-queues free.
+                r.failovers += 1
+                r.emit("failover", bucket=label, elapsed_s=elapsed)
+            requeue.append(r)
+        with self._cv:
+            for r in reversed(requeue):
+                self._queues[r.priority].appendleft(r)
+            self._thread = threading.Thread(
+                target=self._worker, args=(new_gen,),
+                name=f"gossip-serve-batcher-g{new_gen}", daemon=True,
+            )
+            self._thread.start()
+            self._cv.notify_all()
+
+    def _dispatch_window(self, gen: int, group: list, probe: bool):
+        """Context manager marking the engine dispatch the watchdog
+        clocks."""
+        batcher = self
+
+        class _Window:
+            def __enter__(self):
+                req0 = group[0]
+                with batcher._wd_lock:
+                    batcher._active = {
+                        "gen": gen,
+                        "bucket": req0.bucket,
+                        "bucket_label": req0.bucket_label,
+                        "t0": time.monotonic(),
+                        # A probe rebuilds the invalidated engine, and a
+                        # quarantined bucket's one-shot detour compiles
+                        # fresh programs — clock those against the cold
+                        # budget, not the warm p99.
+                        "budget_s": batcher._budget_s(
+                            req0.bucket_label,
+                            cold=probe or batcher.quarantine.state(
+                                req0.bucket
+                            ) != "closed",
+                        ),
+                        "group": group,
+                        "probe": probe,
+                    }
+                return self
+
+            def __exit__(self, *exc):
+                with batcher._wd_lock:
+                    if (batcher._active is not None
+                            and batcher._active["gen"] == gen):
+                        batcher._active = None
+                return False
+
+        return _Window()
+
+    def _maybe_wedge(self, bucket_label: str) -> None:
+        """Env-gated chaos hook (ctor): sleep inside the dispatch so the
+        watchdog sees a wedge — the chaos-serve CI job's fault injector."""
+        w = self._wedge
+        if w is None or w["count"] <= 0 or w["substr"] not in bucket_label:
+            return
+        if time.monotonic() - self._t_init < w["arm_s"]:
+            return
+        w["count"] -= 1
+        time.sleep(w["seconds"])
+
+    # -- execution ---------------------------------------------------------
+
+    def _execute_safe(self, group: list, gen: int) -> None:
         """The executor is ONE thread serving every request: an exception
         escaping a batch must fail that batch structurally, never kill the
         thread (a dead executor hangs all in-flight and all future
         requests — a one-request denial of service). _execute handles the
         expected vocabularies; this guard catches everything else."""
         try:
-            self._execute(group)
+            self._execute(group, gen)
         except Exception as e:  # noqa: BLE001 — the whole point
-            unset = [r for r in group if not r.ready.is_set()]
-            if unset:
-                self.stats.on_batch(
-                    group[0].bucket_label, len(unset), len(unset)
-                )
+            if not self._live(gen):
+                return
+            # If this dispatch held the half-open probe token, report the
+            # probe failed — otherwise the circuit would stay half-open
+            # forever (check() returns "open" while a probe is out, and
+            # only record() can move it). A no-op on a closed circuit.
+            self.quarantine.record(group[0].bucket, ok=False)
+            unset = [r for r in group if r.try_claim()]
             for r in unset:
                 r.status = 503
                 r.response = _error_body(
                     r, "internal-error", f"{type(e).__name__}: {e}"[:500]
                 )
+                self._count_lane(r)
                 self.stats.on_failed()
                 r.ready.set()
 
-    def _execute(self, group: list) -> None:
+    def _execute(self, group: list, gen: int) -> None:
         from ..models import runner as runner_mod
         from ..models import sweep as sweep_mod
+
+        # Dispatch hand-off: a request claimed since the pre-dispatch pass
+        # (front timeout) leaves the group BEFORE occupancy is counted;
+        # the survivors are atomically marked dispatched, so a later
+        # timeout claim lands in timed_out_dispatched (the occupancy
+        # identity's third term, admission.py).
+        group = [r for r in group if r.mark_dispatched_if_unresolved()]
+        if not group:
+            return
+        # Dispatched requests enter the occupancy ledger NOW: whether they
+        # resolve as completed, failed, or timed_out_dispatched, the
+        # identity's left side already carries them (admission.py).
+        for r in group:
+            self._count_lane(r)
 
         # Span clock (ISSUE 7): t_group (executor pickup) closes each
         # request's queue_wait_s; t_eng0/t_eng1 bracket the batched engine
@@ -291,11 +856,38 @@ class MicroBatcher:
         req0 = group[0]
         cfg = req0.cfg
         topo = req0.topo
+
+        # Circuit breaker (ISSUE 8): an open circuit routes the bucket
+        # around its (quarantined) batched engine — per-request one-shot
+        # runs, stamped engine_degraded — until the half-open probe
+        # recovers it.
+        verdict = self.quarantine.check(req0.bucket)
+        if verdict == "open":
+            for r in group:
+                self._one_shot(
+                    r, _QuarantinedEngine(req0.bucket_label), t_group, gen,
+                )
+            return
+        probe = verdict == "probe"
+        if probe and self.event_log is not None:
+            self.event_log.emit(
+                "quarantine-half-open", bucket=req0.bucket_label,
+            )
+
         # Batching-off control mode runs honest single-lane programs (the
         # loadgen ratio baseline must not inherit filler-lane padding).
         lanes = (
             lane_bucket(len(group), self.max_lanes, self.min_lanes)
             if self.batching else 1
+        )
+        # The group's in-flight cancellation deadline: the MAX member
+        # deadline — the engine keeps running while any lane still has
+        # time; lanes whose own deadline lapsed mid-run still get their
+        # full result if the run finishes (completing beats discarding).
+        deadlines = [r.t_deadline for r in group]
+        group_deadline = (
+            max(deadlines) if all(d is not None for d in deadlines)
+            else None
         )
         for r in group:
             r.emit(
@@ -305,22 +897,38 @@ class MicroBatcher:
         sres = None
         error: Optional[BaseException] = None
         t_eng0 = time.monotonic()
-        try:
-            # Seeds, not PRNGKeys: run_batched_keys assembles raw key data
-            # on the host (no per-request device dispatch) — lane i is
-            # still bitwise runner.run with PRNGKey(seed_i).
-            sres = sweep_mod.run_batched_keys(
-                topo, cfg, [r.cfg.seed for r in group],
-                lanes=lanes, keep_states=True,
-            )
-        except runner_mod._DEGRADABLE_ERRORS as e:  # noqa: SLF001 — the
-            # PR 4 degradation vocabulary is the serving availability
-            # contract; config errors (ValueError) stay fail-fast below.
-            error = e
-        except ValueError as e:
-            error = e
+        with self._dispatch_window(gen, group, probe):
+            self._maybe_wedge(req0.bucket_label)
+            try:
+                # Seeds, not PRNGKeys: run_batched_keys assembles raw key
+                # data on the host (no per-request device dispatch) — lane
+                # i is still bitwise runner.run with PRNGKey(seed_i).
+                sres = sweep_mod.run_batched_keys(
+                    topo, cfg, [r.cfg.seed for r in group],
+                    lanes=lanes, keep_states=True,
+                    deadline=group_deadline,
+                )
+            except runner_mod._DEGRADABLE_ERRORS as e:  # noqa: SLF001 — the
+                # PR 4 degradation vocabulary is the serving availability
+                # contract; config errors (ValueError) stay fail-fast below.
+                error = e
+            except ValueError as e:
+                error = e
 
         t_eng1 = time.monotonic()
+        if not self._live(gen):
+            # Failed over while we ran: the watchdog already re-queued or
+            # resolved every member — this thread's results are discarded
+            # unobserved (claims would drop them anyway; skipping keeps
+            # the accounting single-writer).
+            return
+        self.stats.on_engine_time(req0.bucket_label, t_eng1 - t_eng0)
+        if probe:
+            self.quarantine.record(req0.bucket, ok=sres is not None)
+            if sres is not None and self.event_log is not None:
+                self.event_log.emit(
+                    "quarantine-recovered", bucket=req0.bucket_label,
+                )
         if self.event_log is not None:
             self.event_log.emit(
                 "batch-retired", bucket=req0.bucket_label,
@@ -334,7 +942,7 @@ class MicroBatcher:
             )
 
         if sres is not None:
-            self.stats.on_batch(req0.bucket_label, len(group), lanes)
+            self.stats.on_batch_meta(req0.bucket_label, lanes)
             for i, r in enumerate(group):
                 self._finish(
                     r, self._lane_body(r, i, sres, len(group), lanes),
@@ -343,6 +951,7 @@ class MicroBatcher:
                         "batch_assemble_s": t_eng0 - t_group,
                         "engine_s": t_eng1 - t_eng0,
                     },
+                    gen=gen,
                 )
             return
 
@@ -355,8 +964,10 @@ class MicroBatcher:
         strict = runner_mod._strict_engine(cfg)  # noqa: SLF001
         degradable = isinstance(error, runner_mod._DEGRADABLE_ERRORS)
         if not degradable or strict:
-            self.stats.on_batch(req0.bucket_label, len(group), lanes)
+            self.stats.on_batch_meta(req0.bucket_label, lanes)
             for r in group:
+                if not r.try_claim():
+                    continue  # front timeout mid-dispatch; ledger holds it
                 r.status = 503 if degradable else 400
                 r.response = _error_body(
                     r,
@@ -367,9 +978,10 @@ class MicroBatcher:
                 r.ready.set()
             return
         for r in group:
-            self._one_shot(r, error, t_group)
+            self._one_shot(r, error, t_group, gen)
 
-    def _one_shot(self, r: ServeRequest, reason, t_group: float) -> None:
+    def _one_shot(self, r: ServeRequest, reason, t_group: float,
+                  gen: int) -> None:
         """Degraded path: run this request alone through models.runner.run
         (which walks its own engine ladder) and stamp the full rung walk
         into the response. Span accounting follows the path taken: the
@@ -379,6 +991,8 @@ class MicroBatcher:
         wall."""
         from ..models import runner as runner_mod
 
+        if r.claimed:
+            return
         walk = [{
             "from": "batched-vmap",
             "to": "one-shot",
@@ -390,21 +1004,29 @@ class MicroBatcher:
             if name == "engine-degraded":
                 walk.append(fields)
 
-        self.stats.on_batch(r.bucket_label, 1, 1)
+        self.stats.on_batch_meta(r.bucket_label, 1)
         t_eng0 = time.monotonic()
-        try:
-            res = runner_mod.run(r.topo, r.cfg, on_event=on_event)
-        except Exception as e:  # noqa: BLE001 — bottom of every ladder:
-            # the availability contract still owes a structured verdict.
-            r.status = 503
-            r.response = _error_body(
-                r, "engine-unavailable", f"{type(e).__name__}: {e}",
-                engine_degraded=walk,
-            )
-            self.stats.on_failed()
-            r.ready.set()
-            return
+        with self._dispatch_window(gen, [r], probe=False):
+            try:
+                res = runner_mod.run(
+                    r.topo, r.cfg, on_event=on_event, deadline=r.t_deadline,
+                )
+            except Exception as e:  # noqa: BLE001 — bottom of every
+                # ladder: the availability contract still owes a
+                # structured verdict.
+                if not self._live(gen) or not r.try_claim():
+                    return
+                r.status = 503
+                r.response = _error_body(
+                    r, "engine-unavailable", f"{type(e).__name__}: {e}",
+                    engine_degraded=walk,
+                )
+                self.stats.on_failed()
+                r.ready.set()
+                return
         t_eng1 = time.monotonic()
+        if not self._live(gen):
+            return
         if res.degradations:
             walk.extend(res.degradations)
         body = {
@@ -438,7 +1060,7 @@ class MicroBatcher:
             "queue_wait_s": t_group - r.t_received,
             "batch_assemble_s": t_eng0 - t_group,
             "engine_s": t_eng1 - t_eng0,
-        }, degraded=True)
+        }, degraded=True, gen=gen)
 
     def _lane_body(self, r: ServeRequest, lane: int, sres, occupancy: int,
                   lanes: int) -> dict:
@@ -475,7 +1097,14 @@ class MicroBatcher:
         return body
 
     def _finish(self, r: ServeRequest, body: dict, spans: dict,
-                degraded: bool = False) -> None:
+                degraded: bool = False, gen: Optional[int] = None) -> None:
+        if gen is not None and not self._live(gen):
+            return
+        if not r.try_claim():
+            # Someone else answered first (front timeout mid-dispatch):
+            # the result is dropped, the timed_out_dispatched counter
+            # already carries the lane (admission.py occupancy identity).
+            return
         t_now = time.monotonic()
         wait_s = spans["queue_wait_s"]
         service_s = t_now - r.t_received
@@ -489,8 +1118,10 @@ class MicroBatcher:
                             ("queue_wait_s", "batch_assemble_s", "engine_s")),
             0.0,
         )
-        r.emit("request-completed", outcome=body["result"]["outcome"])
+        outcome = body["result"]["outcome"]
+        r.emit("request-completed", outcome=outcome)
         body["serving"]["trace_id"] = r.trace_id
+        body["serving"]["priority"] = r.priority
         body["serving"]["spans"] = spans
         body["serving"]["queue_wait_ms"] = 1e3 * wait_s
         body["serving"]["service_ms"] = 1e3 * service_s
@@ -504,17 +1135,34 @@ class MicroBatcher:
         # otherwise race the executor by one request.
         self.stats.on_completed(wait_s, service_s, degraded=degraded,
                                 spans=spans)
+        if outcome == "deadline_exceeded":
+            # An in-flight cancellation is a COMPLETION (partial result,
+            # 200) — this tallies the outcome counter next to the
+            # pre-dispatch sheds (admission.py).
+            self.stats.on_deadline_exceeded_completion()
         if self.event_log is not None:
             # The response half of the trace join (schema v4) — same
             # opt-in economics as the admission event.
             self.event_log.emit(
                 "request-completed", trace_id=r.trace_id,
-                outcome=body["result"]["outcome"], spans=spans,
+                outcome=outcome, spans=spans,
                 service_s=service_s, degraded=degraded,
             )
         r.status = 200
         r.response = body
         r.ready.set()
+
+
+class _QuarantinedEngine(Exception):
+    """The degraded-path 'reason' while a bucket's circuit is open: the
+    one-shot walk's first rung entry names it, so responses served around
+    a quarantined engine are visibly degraded."""
+
+    def __init__(self, bucket_label: str):
+        super().__init__(
+            f"bucket {bucket_label} quarantined (circuit open; half-open "
+            "re-probe pending)"
+        )
 
 
 def _error_body(r: ServeRequest, error: str, detail: str, **extra) -> dict:
@@ -524,6 +1172,7 @@ def _error_body(r: ServeRequest, error: str, detail: str, **extra) -> dict:
         "trace_id": r.trace_id,
         "error": error,
         "detail": detail,
+        "priority": r.priority,
         "events": r.events,
         **extra,
     }

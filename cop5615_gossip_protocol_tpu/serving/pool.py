@@ -27,6 +27,7 @@ from __future__ import annotations
 import collections
 import os
 import threading
+import time
 from typing import Callable, Tuple
 
 from ..utils import obs
@@ -54,6 +55,7 @@ class WarmEnginePool:
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        self.invalidations = 0
         reg = registry if registry is not None else obs.default_registry()
         self._c_hits = reg.counter(
             "gossip_tpu_engine_pool_hits_total",
@@ -64,6 +66,9 @@ class WarmEnginePool:
         self._c_evictions = reg.counter(
             "gossip_tpu_engine_pool_evictions_total",
             "engines dropped by the LRU capacity bound")
+        self._c_invalidations = reg.counter(
+            "gossip_tpu_engine_pool_invalidations_total",
+            "engines dropped by quarantine invalidation (circuit breaker)")
         self._g_entries = reg.gauge(
             "gossip_tpu_engine_pool_entries", "live pool entries")
         self._g_capacity = reg.gauge(
@@ -92,6 +97,22 @@ class WarmEnginePool:
             self._g_entries.set(len(self._entries))
             return engine, False
 
+    def invalidate(self, match: Callable[[object], bool]) -> int:
+        """Drop every entry whose key satisfies ``match`` — the quarantine
+        path (ISSUE 8): a wedged bucket's compiled engines are evicted so
+        the half-open re-probe rebuilds fresh instead of re-entering the
+        stuck executable. Returns the number dropped (also counted in the
+        ``gossip_tpu_engine_pool_invalidations_total`` series)."""
+        with self._lock:
+            doomed = [k for k in self._entries if match(k)]
+            for k in doomed:
+                del self._entries[k]
+            if doomed:
+                self.invalidations += len(doomed)
+                self._c_invalidations.inc(len(doomed))
+                self._g_entries.set(len(self._entries))
+            return len(doomed)
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._entries)
@@ -109,7 +130,95 @@ class WarmEnginePool:
                 "hits": self.hits,
                 "misses": self.misses,
                 "evictions": self.evictions,
+                "invalidations": self.invalidations,
             }
+
+
+class Quarantine:
+    """Circuit breaker over engine/bucket keys (ISSUE 8, the
+    stuck-executor failover). Per-key states:
+
+      CLOSED     (key absent) — healthy, the batched engine runs normally;
+      OPEN       — a wedged dispatch tripped the breaker: until the
+                   cooldown expires, callers must route AROUND the engine
+                   (the batcher takes the per-request one-shot path);
+      HALF-OPEN  — the cooldown expired: exactly ONE probe is handed out
+                   (``check`` returns "probe" once); ``record(ok=True)``
+                   closes the circuit, ``record(ok=False)`` re-opens it
+                   for another cooldown. Probes that never report (the
+                   probe itself wedged and was failed over) re-open via
+                   ``record(ok=False)`` from the watchdog.
+
+    Thread-safe; time injectable for tests via the ``now`` arguments."""
+
+    def __init__(self, cooldown_s: float = 30.0,
+                 registry: obs.Registry | None = None):
+        self.cooldown_s = float(cooldown_s)
+        self._lock = threading.Lock()
+        # key -> [state, t_open] with state in {"open", "half-open"}.
+        self._keys: dict = {}
+        reg = registry if registry is not None else obs.default_registry()
+        self._c_tripped = reg.counter(
+            "gossip_tpu_serving_quarantined_total",
+            "circuit-breaker trips (wedged dispatch -> bucket quarantined)")
+        self._c_recovered = reg.counter(
+            "gossip_tpu_serving_quarantine_recovered_total",
+            "half-open probes that closed a quarantined circuit")
+        self._g_open = reg.gauge(
+            "gossip_tpu_serving_quarantined_open",
+            "circuits currently open or half-open")
+
+    def trip(self, key, cooldown_s: float | None = None,
+             now: float | None = None) -> None:
+        now = time.monotonic() if now is None else now
+        cd = self.cooldown_s if cooldown_s is None else float(cooldown_s)
+        with self._lock:
+            self._keys[key] = ["open", now + cd]
+            self._c_tripped.inc()
+            self._g_open.set(len(self._keys))
+
+    def check(self, key, now: float | None = None) -> str:
+        """The routing verdict for one dispatch of ``key``: "closed"
+        (healthy — run the batched engine), "open" (route around it), or
+        "probe" (half-open — THIS caller may try the batched engine and
+        must ``record`` the outcome). "probe" is handed out once per
+        half-open window; concurrent callers see "open" until the probe
+        reports."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            ent = self._keys.get(key)
+            if ent is None:
+                return "closed"
+            state, t_open = ent
+            if state == "half-open":
+                return "open"  # a probe is already out
+            if now < t_open:
+                return "open"
+            ent[0] = "half-open"
+            return "probe"
+
+    def record(self, key, ok: bool, now: float | None = None) -> None:
+        """Report a half-open probe's outcome (also safe to call on an
+        open circuit — the failover path re-arms a probe that wedged)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            if key not in self._keys:
+                return
+            if ok:
+                del self._keys[key]
+                self._c_recovered.inc()
+            else:
+                self._keys[key] = ["open", now + self.cooldown_s]
+            self._g_open.set(len(self._keys))
+
+    def state(self, key) -> str:
+        with self._lock:
+            ent = self._keys.get(key)
+            return "closed" if ent is None else ent[0]
+
+    def open_count(self) -> int:
+        with self._lock:
+            return len(self._keys)
 
 
 _DEFAULT: WarmEnginePool | None = None

@@ -30,33 +30,51 @@ the >= 1k requests/s the load harness pins, that IS the budget — while a
 readline/JSON loop stays far under it. Ops endpoints (/stats, /healthz)
 stay HTTP-only.
 
-Request schema (v1)::
+Request schema (v2 — v1 requests remain valid)::
 
-    {"schema_version": 1, "n": 256, "topology": "grid2d",
+    {"schema_version": 2, "n": 256, "topology": "grid2d",
      "algorithm": "gossip", "seed": 7, "telemetry": false,
+     "priority": "interactive", "deadline_ms": 2000,
      "params": {"fault_rate": 0.01, "quorum": 0.9, ...}}
 
 ``params`` accepts the serving-compatible SimConfig knobs
 (_ALLOWED_PARAMS); anything else — sharding, watchdogs, reference
 semantics — is rejected loudly (400), matching the repo's loud-contract
-style. The entry points are ``serve.py`` at the repo root and
-``python -m cop5615_gossip_protocol_tpu.serving``.
+style. ``priority`` (default "batch") picks the admission class and SLO
+target (serving/admission.PRIORITIES); ``deadline_ms`` bounds the
+request end to end — expired in queue it is shed with a structured
+``deadline_exceeded`` body (504), expired in flight the engine stops at
+the next retired chunk and the 200 carries
+``outcome="deadline_exceeded"`` with partial telemetry (ISSUE 8).
+
+Resilience (ISSUE 8): a front thread that outwaits ``request_timeout_s``
+CLAIMS its request — the 503 it returns is the request's ONE terminal
+response; the executor's late completion is dropped, counted under
+``timed_out`` (never ``completed`` — the PR 6 orphaned-timeout hole).
+SIGTERM begins a graceful drain: /healthz flips to lame-duck (503 +
+``draining``), admission returns structured ``shutting_down`` 503s,
+in-flight work drains under ``--drain-window`` seconds, leftovers resolve
+as ``shutting_down`` — every accepted request gets exactly one terminal
+response, never a dropped socket. The entry points are ``serve.py`` at
+the repo root and ``python -m cop5615_gossip_protocol_tpu.serving``.
 """
 
 from __future__ import annotations
 
 import json
+import math
 import os
 import socketserver
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
 
 from ..config import SimConfig, normalize_algorithm, normalize_topology
-from .admission import AdmissionError, ServingStats
+from .admission import PRIORITIES, AdmissionError, ServingStats
 from .batcher import MicroBatcher
 
-REQUEST_SCHEMA_VERSION = 1
+REQUEST_SCHEMA_VERSION = 2
 RESPONSE_SCHEMA_VERSION = 1
 
 # SimConfig knobs a request's ``params`` may set. Everything here is
@@ -74,9 +92,12 @@ _ALLOWED_PARAMS = frozenset({
 })
 
 
-def config_from_request(body: dict, max_n: int) -> Tuple[SimConfig, bool]:
-    """Build the SimConfig for one request body, or raise ValueError with
-    the contract text a 400 response carries."""
+def config_from_request(
+    body: dict, max_n: int
+) -> Tuple[SimConfig, bool, str, Optional[float]]:
+    """Build ``(cfg, want_telemetry, priority, deadline_ms)`` for one
+    request body, or raise ValueError with the contract text a 400
+    response carries."""
     if not isinstance(body, dict):
         raise ValueError("request body must be a JSON object")
     version = body.get("schema_version", 1)
@@ -119,6 +140,19 @@ def config_from_request(body: dict, max_n: int) -> Tuple[SimConfig, bool]:
         raise ValueError(
             f"seed must be an int in [0, 2**32), got {seed!r}"
         )
+    priority = body.get("priority", "batch")
+    if priority not in PRIORITIES:
+        raise ValueError(
+            f"priority must be one of {list(PRIORITIES)}, got {priority!r}"
+        )
+    deadline_ms = body.get("deadline_ms")
+    if deadline_ms is not None:
+        if (not isinstance(deadline_ms, (int, float))
+                or isinstance(deadline_ms, bool) or deadline_ms <= 0):
+            raise ValueError(
+                f"deadline_ms must be a positive number, got {deadline_ms!r}"
+            )
+        deadline_ms = float(deadline_ms)
     cfg = SimConfig(
         n=n,
         topology=normalize_topology(str(body["topology"])),
@@ -128,7 +162,7 @@ def config_from_request(body: dict, max_n: int) -> Tuple[SimConfig, bool]:
         telemetry=want_telemetry,
         **params,
     )
-    return cfg, want_telemetry
+    return cfg, want_telemetry, priority, deadline_ms
 
 
 class ServingApp:
@@ -146,6 +180,11 @@ class ServingApp:
         request_timeout_s: float = 300.0,
         max_n: Optional[int] = None,
         min_lanes: int = 8,
+        slo_s: Optional[dict] = None,
+        stuck_min_s: Optional[float] = None,
+        stuck_mult: Optional[float] = None,
+        quarantine_s: Optional[float] = None,
+        drain_window_s: Optional[float] = None,
     ):
         self.stats = ServingStats()
         self.event_log = event_log
@@ -154,18 +193,46 @@ class ServingApp:
             max_n if max_n is not None
             else os.environ.get("GOSSIP_TPU_SERVE_MAX_N", "") or 65536
         )
+        # Lame-duck flag (ISSUE 8 drain): set by begin_drain — /healthz
+        # turns 503 + draining, admission returns structured
+        # shutting_down 503s (counted rejected, so the received identity
+        # holds), in-flight work keeps draining.
+        self.draining = False
+        # Front-connection accounting: requests whose response is not yet
+        # WRITTEN to the client socket. The drain path waits on this so a
+        # resolved request's bytes actually leave the process before exit
+        # (the terminal-response guarantee covers the wire, not just the
+        # batcher).
+        self._front_lock = threading.Lock()
+        self._front_active = 0
+        self._front_idle = threading.Condition(self._front_lock)
         self.batcher = MicroBatcher(
             stats=self.stats, window_s=window_s, max_lanes=max_lanes,
             queue_limit=queue_limit, batching=batching, event_log=event_log,
-            min_lanes=min_lanes,
+            min_lanes=min_lanes, slo_s=slo_s, stuck_min_s=stuck_min_s,
+            stuck_mult=stuck_mult, quarantine_s=quarantine_s,
+            drain_window_s=drain_window_s,
         ).start()
 
     def _submit(self, body) -> Tuple[int, object]:
         """Admit one request. Returns (0, ServeRequest) on admission, or
-        (status, error_body) on validation/admission failure."""
+        (status, error_body) on validation/admission/drain failure."""
         self.stats.on_received()
+        if self.draining:
+            # Lame-duck: new work is turned away with the structured
+            # shutdown verdict (counted rejected — the received identity
+            # holds through a drain).
+            self.stats.on_rejected()
+            return 503, {
+                "ok": False, "error": "shutting_down",
+                "detail": "server is draining; retry against a live "
+                "replica",
+                "schema_version": RESPONSE_SCHEMA_VERSION,
+            }
         try:
-            cfg, want_telemetry = config_from_request(body, self.max_n)
+            cfg, want_telemetry, priority, deadline_ms = (
+                config_from_request(body, self.max_n)
+            )
         except (ValueError, TypeError) as e:
             # TypeError too: SimConfig validation compares raw param
             # values (e.g. 0.0 <= "0.1" raises TypeError), and the
@@ -178,13 +245,17 @@ class ServingApp:
                 "schema_version": RESPONSE_SCHEMA_VERSION,
             }
         try:
-            return 0, self.batcher.submit(cfg, want_telemetry)
+            return 0, self.batcher.submit(
+                cfg, want_telemetry, priority=priority,
+                deadline_ms=deadline_ms,
+            )
         except AdmissionError as e:
             self.stats.on_rejected()
             if self.event_log is not None:
                 self.event_log.emit(
                     "admission-rejected", queue_depth=e.queue_depth,
                     queue_limit=e.queue_limit, trace_id=e.trace_id,
+                    retry_after_s=e.retry_after_s, priority=e.priority,
                 )
             return 429, {
                 "ok": False, "error": "admission-rejected",
@@ -192,15 +263,39 @@ class ServingApp:
                 "trace_id": e.trace_id,
                 "queue_depth": e.queue_depth,
                 "queue_limit": e.queue_limit,
+                "retry_after_s": e.retry_after_s,
+                "priority": e.priority,
                 "schema_version": RESPONSE_SCHEMA_VERSION,
             }
 
     def _await(self, req) -> Tuple[int, dict]:
         if not req.ready.wait(timeout=self.request_timeout_s):
-            return 503, {
-                "ok": False, "error": "timeout",
-                "detail": f"request {req.request_id} still queued/running "
-                f"after {self.request_timeout_s}s",
+            # The orphaned-timeout hole (ISSUE 8 satellite): claim the
+            # request so this 503 is its ONE terminal response — a late
+            # executor completion is dropped, not counted `completed`.
+            if req.try_claim():
+                self.stats.on_timed_out(req.is_dispatched())
+                if self.event_log is not None:
+                    self.event_log.emit(
+                        "request-timeout", trace_id=req.trace_id,
+                        timeout_s=self.request_timeout_s,
+                        dispatched=req.is_dispatched(),
+                    )
+                return 503, {
+                    "ok": False, "error": "timeout",
+                    "detail": f"request {req.request_id} still "
+                    f"queued/running after {self.request_timeout_s}s",
+                    "request_id": req.request_id,
+                    "trace_id": req.trace_id,
+                    "schema_version": RESPONSE_SCHEMA_VERSION,
+                }
+            # Lost the claim race: a resolver is finishing the response
+            # right now — collect it.
+            req.ready.wait(timeout=5.0)
+        if req.response is None:  # defensive; resolvers set response
+            return 503, {       # before ready, so this is unreachable
+                "ok": False, "error": "internal-error",
+                "detail": "request resolved without a response body",
                 "request_id": req.request_id,
                 "schema_version": RESPONSE_SCHEMA_VERSION,
             }
@@ -258,6 +353,62 @@ class ServingApp:
             "schema_version": RESPONSE_SCHEMA_VERSION,
         }
 
+    def front_request(self):
+        """Context manager bracketing one front request from parse to the
+        response WRITE — await_front_idle waits on it during drain, so
+        resolved responses reach the wire before the process exits."""
+        app = self
+
+        class _Front:
+            def __enter__(self):
+                with app._front_lock:
+                    app._front_active += 1
+                return self
+
+            def __exit__(self, *exc):
+                with app._front_lock:
+                    app._front_active -= 1
+                    if app._front_active == 0:
+                        app._front_idle.notify_all()
+                return False
+
+        return _Front()
+
+    def await_front_idle(self, timeout_s: float = 10.0) -> bool:
+        deadline = time.monotonic() + timeout_s
+        with self._front_lock:
+            while self._front_active > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._front_idle.wait(timeout=remaining)
+            return True
+
+    def begin_drain(self, drain_window_s: Optional[float] = None) -> None:
+        """Graceful drain (ISSUE 8): lame-duck /healthz, stop admission,
+        drain in-flight work under the bounded window (leftovers resolve
+        as structured ``shutting_down``), then wait for the front threads
+        to write their responses. Emits the ``server-drain`` event."""
+        if self.draining:
+            return
+        self.draining = True
+        if self.event_log is not None:
+            self.event_log.emit(
+                "server-drain",
+                drain_window_s=(
+                    drain_window_s if drain_window_s is not None
+                    else self.batcher.drain_window_s
+                ),
+                queue_depth=self.batcher.queue_depth(),
+            )
+        self.batcher.stop(drain=True, drain_window_s=drain_window_s)
+        self.await_front_idle()
+        # Grace cycle: a request line already in a socket buffer but not
+        # yet picked up by its handler thread still gets its structured
+        # shutting_down 503 before the listeners go down.
+        time.sleep(0.5)
+        self.await_front_idle()
+
     def snapshot(self) -> dict:
         snap = self.stats.snapshot()
         snap["schema_version"] = RESPONSE_SCHEMA_VERSION
@@ -269,6 +420,7 @@ class ServingApp:
         return self.stats.render_metrics()
 
     def close(self) -> None:
+        self.draining = True
         self.batcher.stop(drain=True)
 
 
@@ -280,20 +432,35 @@ class _Handler(BaseHTTPRequestHandler):
     quiet: bool = True
 
     def _send(self, status: int, payload: dict) -> None:
-        self._send_text(status, json.dumps(payload), "application/json")
+        extra = {}
+        if isinstance(payload, dict) and payload.get("retry_after_s"):
+            # The honest-backoff contract (ISSUE 8): structured 429/shed
+            # responses carry Retry-After on the wire too.
+            extra["Retry-After"] = str(int(math.ceil(
+                payload["retry_after_s"]
+            )))
+        self._send_text(status, json.dumps(payload), "application/json",
+                        extra_headers=extra)
 
-    def _send_text(self, status: int, text: str,
-                   content_type: str) -> None:
+    def _send_text(self, status: int, text: str, content_type: str,
+                   extra_headers: Optional[dict] = None) -> None:
         data = text.encode()
         self.send_response(status)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(data)))
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(data)
 
     def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
         if self.path == "/healthz":
-            self._send(200, {"ok": True})
+            if self.app.draining:
+                # Lame-duck: load balancers stop routing here while the
+                # drain finishes (ISSUE 8).
+                self._send(503, {"ok": False, "draining": True})
+            else:
+                self._send(200, {"ok": True})
         elif self.path == "/stats":
             self._send(200, self.app.snapshot())
         elif self.path == "/metrics":
@@ -310,18 +477,22 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(404, {"ok": False, "error": "not-found",
                              "detail": f"no such endpoint {self.path!r}"})
             return
-        try:
-            length = int(self.headers.get("Content-Length", 0))
-            body = json.loads(self.rfile.read(length) or b"{}")
-        except (ValueError, json.JSONDecodeError) as e:
-            self._send(400, {"ok": False, "error": "invalid-json",
-                             "detail": str(e)})
-            return
-        if self.path == "/batch":
-            status, payload = self.app.handle_batch(body)
-        else:
-            status, payload = self.app.handle_run(body)
-        self._send(status, payload)
+        # front_request brackets parse -> handle -> WRITE: the drain path
+        # waits for this to hit zero, so a resolved response's bytes
+        # reach the client socket before the process exits (ISSUE 8).
+        with self.app.front_request():
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+            except (ValueError, json.JSONDecodeError) as e:
+                self._send(400, {"ok": False, "error": "invalid-json",
+                                 "detail": str(e)})
+                return
+            if self.path == "/batch":
+                status, payload = self.app.handle_batch(body)
+            else:
+                status, payload = self.app.handle_run(body)
+            self._send(status, payload)
 
     def log_message(self, fmt, *args):  # noqa: A002
         if not self.quiet:
@@ -347,27 +518,31 @@ class _JsonlHandler(socketserver.StreamRequestHandler):
             line = line.strip()
             if not line:
                 continue
-            try:
-                body = json.loads(line)
-            except json.JSONDecodeError as e:
-                status, resp = 400, {
-                    "ok": False, "error": "invalid-json", "detail": str(e),
-                    "schema_version": RESPONSE_SCHEMA_VERSION,
-                }
-            else:
-                # A "requests" list is the multi-user envelope
-                # (ServingApp.handle_batch) — one line multiplexes many
-                # closed-loop users.
-                if isinstance(body, dict) and "requests" in body:
-                    status, resp = self.app.handle_batch(body)
+            # front_request brackets handle -> WRITE (see the HTTP
+            # handler): drain waits for the response line to be written.
+            with self.app.front_request():
+                try:
+                    body = json.loads(line)
+                except json.JSONDecodeError as e:
+                    status, resp = 400, {
+                        "ok": False, "error": "invalid-json",
+                        "detail": str(e),
+                        "schema_version": RESPONSE_SCHEMA_VERSION,
+                    }
                 else:
-                    status, resp = self.app.handle_run(body)
-            resp = dict(resp)
-            resp["status"] = status
-            try:
-                self.wfile.write(json.dumps(resp).encode() + b"\n")
-            except OSError:
-                return  # client went away mid-response
+                    # A "requests" list is the multi-user envelope
+                    # (ServingApp.handle_batch) — one line multiplexes
+                    # many closed-loop users.
+                    if isinstance(body, dict) and "requests" in body:
+                        status, resp = self.app.handle_batch(body)
+                    else:
+                        status, resp = self.app.handle_run(body)
+                resp = dict(resp)
+                resp["status"] = status
+                try:
+                    self.wfile.write(json.dumps(resp).encode() + b"\n")
+                except OSError:
+                    return  # client went away mid-response
 
 
 class _JsonlServer(socketserver.ThreadingTCPServer):
@@ -411,6 +586,11 @@ def main(argv=None) -> int:
                     help="control mode: every request runs as its own "
                     "single-lane program (the loadgen ratio baseline)")
     ap.add_argument("--request-timeout", type=float, default=300.0)
+    ap.add_argument("--drain-window", type=float, default=None,
+                    help="graceful-drain bound in seconds (SIGTERM): "
+                    "in-flight work past it resolves as structured "
+                    "shutting_down (default "
+                    "GOSSIP_TPU_SERVE_DRAIN_WINDOW_S or 30)")
     ap.add_argument("--max-n", type=int, default=None,
                     help="per-request population cap (default "
                     "GOSSIP_TPU_SERVE_MAX_N or 65536)")
@@ -456,6 +636,7 @@ def main(argv=None) -> int:
         request_timeout_s=args.request_timeout,
         max_n=args.max_n,
         min_lanes=args.min_lanes,
+        drain_window_s=args.drain_window,
     )
     httpd = make_server(app, args.host, args.port, quiet=not args.verbose)
     host, port = httpd.server_address[:2]
@@ -480,8 +661,19 @@ def main(argv=None) -> int:
     def _stop(signum, frame):
         threading.Thread(target=httpd.shutdown, daemon=True).start()
 
+    def _drain(signum, frame):
+        # Graceful drain (ISSUE 8): lame-duck /healthz + structured
+        # shutting_down admissions while in-flight work drains under the
+        # bounded window; every accepted request gets its one terminal
+        # response BEFORE the listener goes down.
+        def go():
+            app.begin_drain(args.drain_window)
+            httpd.shutdown()
+
+        threading.Thread(target=go, daemon=True).start()
+
     signal.signal(signal.SIGINT, _stop)
-    signal.signal(signal.SIGTERM, _stop)
+    signal.signal(signal.SIGTERM, _drain)
     try:
         httpd.serve_forever()
     finally:

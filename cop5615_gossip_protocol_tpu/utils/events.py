@@ -41,6 +41,29 @@ stream is demultiplexed into each HTTP response as well, see
 serving/batcher.ServeRequest.emit):
 
   server-start            host/port + batching/window/lane/queue config
+  server-drain            graceful drain began (schema v5): SIGTERM (or
+                          an explicit drain call) flipped /healthz to
+                          lame-duck, admission stopped, in-flight work
+                          drains under drain_window_s
+  request-timeout         a front thread gave up waiting (schema v5):
+                          trace_id + the timeout in force — the request
+                          is CLAIMED, so a later executor completion is
+                          dropped instead of double-counted
+  request-shed            an admitted request was resolved without an
+                          engine run (schema v5): trace_id, reason
+                          ("deadline_exceeded" — expired before dispatch
+                          — or "overload" — the SLO controller shed it,
+                          lowest priority class first), priority
+  executor-stuck          the batch watchdog saw a dispatch exceed its
+                          per-bucket budget (schema v5): bucket, elapsed,
+                          budget_s, generation — the group fails over to
+                          a fresh executor thread
+  engine-quarantined      a bucket's engine key entered the circuit
+                          breaker (schema v5): bucket, cooldown_s
+  quarantine-half-open    the cooldown expired; ONE probe batch is
+                          allowed through the batched engine (schema v5)
+  quarantine-recovered    the half-open probe succeeded; the bucket's
+                          circuit closed (schema v5)
   request-admitted        one request entered the batching queue:
                           trace_id + bucket (v4; per-request — emitted
                           only when the event log is configured, the
@@ -71,7 +94,10 @@ revive_rate/revive_schedule/rejoin recovery fields; 3 — the serving-plane
 event types (server-start, batch-retired, admission-rejected,
 server-stop); 4 — request tracing: request-admitted/request-completed
 events, trace_id stamped on every serving event, span timings on
-batch-retired/request-completed.
+batch-retired/request-completed; 5 — the serving resilience plane
+(ISSUE 8): server-drain, request-timeout, request-shed, executor-stuck,
+engine-quarantined, quarantine-half-open, quarantine-recovered event
+types; admission-rejected gains retry_after_s + priority.
 """
 
 from __future__ import annotations
@@ -81,7 +107,7 @@ from pathlib import Path
 
 from . import metrics
 
-EVENT_SCHEMA_VERSION = 4
+EVENT_SCHEMA_VERSION = 5
 
 
 class RunEventLog:

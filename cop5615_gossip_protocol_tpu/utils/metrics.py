@@ -36,7 +36,11 @@ if TYPE_CHECKING:  # type-only: keeps this module importable without JAX
 #       single-device engines' build/assembly phases, plus the derived
 #       residual_s, so the record names the whole non-engine wall
 #       (benchmarks/wallwalk.py reads it)
-RUN_RECORD_SCHEMA_VERSION = 4
+#   5 — resilience plane (ISSUE 8): outcome gains "deadline_exceeded"
+#       (the run_chunks cancellation hook fired — the CLI's --deadline-ms
+#       or a serving request's deadline_ms ended the run at a chunk
+#       boundary with partial state/telemetry and exact rounds)
+RUN_RECORD_SCHEMA_VERSION = 5
 
 
 def banner(cfg: SimConfig) -> str:

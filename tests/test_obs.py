@@ -166,7 +166,7 @@ def test_run_budget_fields_close_and_schema_v4():
                     chunk_rounds=8)
     res = run(topo, cfg)
     rec = metrics_mod.run_record(cfg, topo, res)
-    assert rec["schema_version"] == metrics_mod.RUN_RECORD_SCHEMA_VERSION == 4
+    assert rec["schema_version"] == metrics_mod.RUN_RECORD_SCHEMA_VERSION == 5
     # The budget identity: residual is exactly the unnamed remainder.
     assert rec["residual_s"] == pytest.approx(
         res.run_s - res.dispatch_s - res.fetch_s - res.hook_s

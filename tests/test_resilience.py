@@ -1,0 +1,513 @@
+"""Serving resilience plane (ISSUE 8): the run_chunks cancellation hook +
+end-to-end deadlines (engine, sweep, serving, CLI), priority classes with
+SLO-aware shedding, the stuck-executor watchdog -> failover -> quarantine
+-> half-open -> recovery cycle, graceful-shutdown edges, and the
+orphaned-timeout accounting identities."""
+
+import json
+import time
+
+import pytest
+
+from cop5615_gossip_protocol_tpu import SimConfig, build_topology
+from cop5615_gossip_protocol_tpu.models import pipeline as pipeline_mod
+from cop5615_gossip_protocol_tpu.models import sweep as sweep_mod
+from cop5615_gossip_protocol_tpu.models.runner import run
+from cop5615_gossip_protocol_tpu.models.sweep import run_batched_keys
+from cop5615_gossip_protocol_tpu.serving import pool as pool_mod
+from cop5615_gossip_protocol_tpu.serving.admission import (
+    AdmissionError,
+    ServingStats,
+)
+from cop5615_gossip_protocol_tpu.serving.batcher import MicroBatcher
+from cop5615_gossip_protocol_tpu.serving.server import ServingApp
+from cop5615_gossip_protocol_tpu.utils import obs
+from cop5615_gossip_protocol_tpu.utils.events import EVENT_SCHEMA_VERSION
+from cop5615_gossip_protocol_tpu.utils.metrics import (
+    RUN_RECORD_SCHEMA_VERSION,
+)
+
+# ------------------------------------------------- run_chunks cancellation
+
+
+def _fake_dispatch(calls):
+    """Host-int chunk: advances rnd to round_end, never terminates."""
+
+    def dispatch(state, rnd, done, round_end):
+        calls.append(int(round_end))
+        return state + 1, int(round_end), False
+
+    return dispatch
+
+
+def test_run_chunks_cancel_stops_at_retired_boundary():
+    calls = []
+    fired = []
+
+    def should_cancel(rounds):
+        fired.append(rounds)
+        return rounds >= 16
+
+    loop = pipeline_mod.run_chunks(
+        dispatch=_fake_dispatch(calls), state0=0, rnd0=0, done0=False,
+        start_round=0, max_rounds=80, stride=8, depth=3,
+        should_cancel=should_cancel,
+    )
+    assert loop.cancelled is True
+    assert loop.rounds == 16  # exact: the retired boundary's counter
+    # Cancellable loops run at depth 1 (the one-chunk cancel bound): no
+    # speculative chunk was dispatched past the cancel boundary.
+    assert calls == [8, 16]
+    assert fired == [8, 16]
+    assert loop.chunks_retired == 2
+
+
+def test_run_chunks_without_hook_keeps_depth_and_reports_uncancelled():
+    calls = []
+    loop = pipeline_mod.run_chunks(
+        dispatch=_fake_dispatch(calls), state0=0, rnd0=0, done0=False,
+        start_round=0, max_rounds=24, stride=8, depth=2,
+    )
+    assert loop.cancelled is False
+    assert loop.rounds == 24
+    # Depth 2 honored: speculation dispatched ahead of the retire loop.
+    assert calls[0:2] == [8, 16]
+
+
+# ------------------------------------------------ engine deadline (runner)
+
+
+def _slow_cfg(n=2048, **kw):
+    return SimConfig(n=n, topology="line", algorithm="gossip", seed=0,
+                     engine="chunked", chunk_rounds=8, max_rounds=6000,
+                     **kw)
+
+
+def test_deadline_exceeded_partial_telemetry_engine_free():
+    """The ISSUE 8 deadline pin: a deadline far below the run length
+    returns deadline_exceeded within deadline + one chunk + eps, with
+    partial telemetry, and the engine is free (and correct) for the next
+    run."""
+    topo = build_topology("line", 2048)
+    cfg = _slow_cfg(telemetry=True)
+    run(topo, cfg)  # warm (compile)
+    t0 = time.monotonic()
+    ctrl = run(topo, cfg)
+    t_warm = time.monotonic() - t0
+    assert ctrl.outcome == "converged"
+    budget = max(0.05, t_warm / 4)
+    t0 = time.monotonic()
+    res = run(topo, cfg, deadline=time.monotonic() + budget)
+    elapsed = time.monotonic() - t0
+    assert res.outcome == "deadline_exceeded"
+    assert res.converged is False
+    assert 0 < res.rounds < ctrl.rounds
+    # Partial telemetry: one row per executed round, nothing more.
+    assert res.telemetry.data.shape[0] == res.rounds
+    # deadline + one chunk + eps — the warm full run is several times the
+    # budget, so overshooting it would fail this bound.
+    assert elapsed < budget + 0.75 * t_warm, (elapsed, budget, t_warm)
+    # The engine is free and untainted: the next run is the control.
+    again = run(topo, cfg)
+    assert (again.rounds, again.outcome) == (ctrl.rounds, "converged")
+
+
+def test_deadline_far_future_is_neutral():
+    topo = build_topology("line", 512)
+    cfg = _slow_cfg(n=512)
+    ctrl = run(topo, cfg)
+    res = run(topo, cfg, deadline=time.monotonic() + 3600.0)
+    assert (res.rounds, res.outcome, res.converged_count) == (
+        ctrl.rounds, ctrl.outcome, ctrl.converged_count
+    )
+
+
+def test_run_record_schema_v5_and_outcome_vocabulary():
+    from cop5615_gossip_protocol_tpu.utils import metrics as metrics_mod
+
+    assert RUN_RECORD_SCHEMA_VERSION == 5
+    topo = build_topology("line", 512)
+    cfg = _slow_cfg(n=512)
+    run(topo, cfg)  # warm
+    res = run(topo, cfg, deadline=time.monotonic())  # expires immediately
+    rec = metrics_mod.run_record(cfg, topo, res)
+    assert rec["schema_version"] == 5
+    assert rec["outcome"] == "deadline_exceeded"
+
+
+def test_cli_deadline_ms(tmp_path):
+    from cop5615_gossip_protocol_tpu.cli import main
+
+    out = tmp_path / "run.jsonl"
+    rc = main([
+        "2048", "line", "gossip", "--platform", "cpu", "--quiet",
+        "--chunk-rounds", "8", "--max-rounds", "6000",
+        "--deadline-ms", "1", "--jsonl", str(out),
+    ])
+    assert rc == 1  # not converged
+    rec = json.loads(out.read_text().splitlines()[-1])
+    assert rec["outcome"] == "deadline_exceeded"
+    assert rec["schema_version"] == RUN_RECORD_SCHEMA_VERSION
+    assert main(["64", "full", "gossip", "--platform", "cpu", "--quiet",
+                 "--deadline-ms", "0"]) == 2
+    assert main(["64", "full", "gossip", "--platform", "cpu", "--quiet",
+                 "--replicas", "2", "--deadline-ms", "100"]) == 2
+
+
+def test_sweep_deadline_marks_unconverged_lanes():
+    topo = build_topology("line", 2048)
+    cfg = _slow_cfg()
+    sres = run_batched_keys(topo, cfg, [0, 1], lanes=2,
+                            deadline=time.monotonic())
+    assert sres.cancelled is True
+    assert all(o == "deadline_exceeded" for o in sres.outcome)
+    assert all(0 < r < cfg.max_rounds for r in sres.rounds)
+
+
+# ----------------------------------------------------- quarantine breaker
+
+
+def test_quarantine_circuit_state_machine():
+    q = pool_mod.Quarantine(cooldown_s=10.0, registry=obs.Registry())
+    assert q.check("k", now=0.0) == "closed"
+    q.trip("k", now=0.0)
+    assert q.check("k", now=5.0) == "open"
+    # Cooldown expired: exactly one probe is handed out.
+    assert q.check("k", now=11.0) == "probe"
+    assert q.check("k", now=11.0) == "open"
+    assert q.state("k") == "half-open"
+    # Failed probe re-opens for another cooldown.
+    q.record("k", ok=False, now=12.0)
+    assert q.check("k", now=15.0) == "open"
+    assert q.check("k", now=23.0) == "probe"
+    q.record("k", ok=True)
+    assert q.check("k") == "closed"
+    assert q.open_count() == 0
+
+
+def test_pool_invalidate_drops_matching_entries():
+    p = pool_mod.WarmEnginePool(capacity=8, registry=obs.Registry())
+    p.get_or_build(("batch-engine", "canonA", 4), lambda: "A")
+    p.get_or_build(("batch-engine", "canonB", 4), lambda: "B")
+    p.get_or_build(("run-chunk", "canonA", True), lambda: "C")
+    dropped = p.invalidate(lambda k: k[1] == "canonA")
+    assert dropped == 2 and len(p) == 1
+    assert p.stats()["invalidations"] == 2
+    # A rebuilt entry is a fresh miss.
+    eng, hit = p.get_or_build(("batch-engine", "canonA", 4), lambda: "A2")
+    assert (eng, hit) == ("A2", False)
+
+
+# ------------------------------------------- priorities, shedding, 429s
+
+
+def _cfg32(seed=0, **kw):
+    return SimConfig(n=32, topology="full", algorithm="gossip", seed=seed,
+                     engine="chunked", **kw)
+
+
+def test_priority_queues_bounded_per_class_with_retry_after():
+    stats = ServingStats()
+    b = MicroBatcher(stats=stats, queue_limit=2, min_lanes=1)
+    # NOT started: submissions stay queued, so the bounds are observable.
+    b.submit(_cfg32(0), False, priority="interactive")
+    b.submit(_cfg32(1), False, priority="interactive")
+    # A different class has its own headroom.
+    b.submit(_cfg32(2), False, priority="best_effort")
+    with pytest.raises(AdmissionError) as e:
+        b.submit(_cfg32(3), False, priority="interactive")
+    assert e.value.priority == "interactive"
+    assert e.value.queue_depth == 2 and e.value.queue_limit == 2
+    assert e.value.retry_after_s >= 1.0
+    assert b.queue_depth() == 3
+    assert b.class_depth("interactive") == 2
+    b.stop(drain=False)
+    assert stats.failed == 3  # every queued request got shutting_down
+
+
+def test_submit_rejects_unknown_priority():
+    b = MicroBatcher(stats=ServingStats(), min_lanes=1)
+    with pytest.raises(ValueError, match="priority"):
+        b.submit(_cfg32(0), False, priority="urgent")
+    b.stop(drain=False)
+
+
+def test_overload_sheds_lowest_class_first():
+    """The ISSUE 8 overload pin (unit form): with interactive's SLO in
+    breach, queued best_effort/batch requests are shed with structured
+    Retry-After bodies while interactive work executes."""
+    stats = ServingStats()
+    b = MicroBatcher(
+        stats=stats, min_lanes=1, window_s=0.001,
+        slo_s={"interactive": 1e-4, "batch": 60.0, "best_effort": 60.0},
+    )
+    ri = b.submit(_cfg32(1), False, priority="interactive")
+    rb = b.submit(_cfg32(2), False, priority="batch")
+    re_ = b.submit(_cfg32(3), False, priority="best_effort")
+    time.sleep(0.02)  # interactive's wave wait is now over its (tiny) SLO
+    b.start()
+    for r in (ri, rb, re_):
+        assert r.ready.wait(120)
+    assert ri.status == 200 and ri.response["result"]["outcome"] == "converged"
+    for r in (rb, re_):
+        assert r.status == 503, r.response
+        assert r.response["error"] == "shed"
+        assert r.response["retry_after_s"] >= 1.0
+        assert any(e["event"] == "request-shed" for e in r.response["events"])
+    snap = stats.snapshot()
+    assert snap["shed"] == 2 and snap["completed"] == 1
+    assert snap["class_queue_wait_ms_p99"]["interactive"] is not None
+    b.stop()
+
+
+def test_deadline_expired_in_queue_sheds_before_dispatch():
+    stats = ServingStats()
+    b = MicroBatcher(stats=stats, min_lanes=1)
+    r = b.submit(_cfg32(0), False, deadline_ms=1.0)
+    time.sleep(0.05)
+    b.start()
+    assert r.ready.wait(30)
+    assert r.status == 504
+    assert r.response["error"] == "deadline_exceeded"
+    snap = stats.snapshot()
+    assert snap["shed"] == 1 and snap["deadline_exceeded"] == 1
+    assert snap["batched_requests"] == 0  # never dispatched
+    b.stop()
+
+
+def test_serving_deadline_in_flight_partial_result():
+    """In-flight cancellation through the serving stack: the engine stops
+    at the next retired chunk and the 200 carries
+    outcome=deadline_exceeded with partial telemetry."""
+    app = ServingApp(window_s=0.005, max_lanes=4, min_lanes=1)
+    try:
+        status, resp = app.handle_run({
+            "schema_version": 2, "n": 2048, "topology": "line",
+            "algorithm": "gossip", "seed": 0, "telemetry": True,
+            "deadline_ms": 300,
+            "params": {"chunk_rounds": 8, "max_rounds": 6000},
+        })
+        assert status == 200, resp
+        assert resp["result"]["outcome"] == "deadline_exceeded"
+        assert resp["result"]["converged"] is False
+        assert len(resp["telemetry"]) == resp["result"]["rounds"] > 0
+        snap = app.snapshot()
+        assert snap["completed"] == 1
+        assert snap["deadline_exceeded"] == 1 and snap["shed"] == 0
+    finally:
+        app.close()
+
+
+# -------------------------------------- stuck executor -> quarantine cycle
+
+
+def test_stuck_executor_failover_quarantine_halfopen_recovery(
+    monkeypatch, tmp_path
+):
+    """The tentpole integration pin: a wedged dispatch fails over to a
+    fresh executor (the wedged request still gets a 200 via the one-shot
+    detour), the bucket's circuit opens, and the half-open probe recovers
+    it — the full cycle visible in the event log, identities exact."""
+    monkeypatch.setenv("GOSSIP_TPU_STRICT_ENGINE", "0")
+    from cop5615_gossip_protocol_tpu.utils.events import (
+        RunEventLog,
+        read_events,
+    )
+
+    ev_path = tmp_path / "events.jsonl"
+    app = ServingApp(
+        window_s=0.005, max_lanes=8, min_lanes=1,
+        stuck_min_s=1.0, stuck_mult=0.0, quarantine_s=4.0,
+        event_log=RunEventLog(ev_path),
+    )
+    body = {"schema_version": 2, "n": 32, "topology": "full",
+            "algorithm": "gossip"}
+    try:
+        # Warm the batched engine AND the one-shot engine (the failover
+        # detour) so budgets clock engine time, not compiles.
+        st, _ = app.handle_run(dict(body, seed=1))
+        assert st == 200
+        run(build_topology("full", 32), _cfg32(1))
+
+        real = sweep_mod.run_batched_keys
+        state = {"wedge": 1}
+
+        def flaky(*a, **k):
+            if state["wedge"] > 0:
+                state["wedge"] -= 1
+                time.sleep(4.0)  # > the 1.0s budget: a wedge
+            return real(*a, **k)
+
+        monkeypatch.setattr(sweep_mod, "run_batched_keys", flaky)
+
+        t0 = time.monotonic()
+        st, resp = app.handle_run(dict(body, seed=3))
+        elapsed = time.monotonic() - t0
+        # Failed over and answered BEFORE the wedge would have returned.
+        assert st == 200 and resp["result"]["outcome"] == "converged"
+        assert elapsed < 3.5, elapsed
+        assert "quarantined" in str(resp["serving"]["engine_degraded"])
+
+        # While the circuit is open, the bucket serves via one-shot.
+        st2, resp2 = app.handle_run(dict(body, seed=4))
+        assert st2 == 200
+        assert "quarantined" in str(resp2["serving"]["engine_degraded"])
+
+        # Cooldown expires -> the next request is the half-open probe.
+        time.sleep(4.2)
+        st3, resp3 = app.handle_run(dict(body, seed=5))
+        assert st3 == 200 and resp3["serving"]["engine_degraded"] is None
+
+        snap = app.snapshot()
+        kinds = [e["event"] for e in read_events(ev_path)]
+        cycle = [k for k in kinds if "quarant" in k or k == "executor-stuck"]
+        assert cycle == [
+            "executor-stuck", "engine-quarantined",
+            "quarantine-half-open", "quarantine-recovered",
+        ], cycle
+        assert snap["received"] == (
+            snap["completed"] + snap["failed"] + snap["rejected"]
+            + snap["invalid"] + snap["timed_out"] + snap["shed"]
+        ), snap
+        assert snap["batched_requests"] == (
+            snap["completed"] + snap["failed"] + snap["timed_out_dispatched"]
+        ), snap
+        assert snap["failed"] == 0
+    finally:
+        app.close()
+
+
+# -------------------------------------------------------- shutdown edges
+
+
+def test_stop_nodrain_resolves_in_flight_with_shutting_down(monkeypatch):
+    """ISSUE 8 satellite: stop(drain=False) must resolve queued AND
+    in-flight requests with a structured shutting_down error — today's
+    client never hangs until the front timeout."""
+    stats = ServingStats()
+    b = MicroBatcher(stats=stats, min_lanes=1, window_s=0.001)
+
+    real = sweep_mod.run_batched_keys
+
+    def wedged(*a, **k):
+        time.sleep(3.0)
+        return real(*a, **k)
+
+    monkeypatch.setattr(sweep_mod, "run_batched_keys", wedged)
+    b.start()
+    r = b.submit(_cfg32(0), False)
+    deadline = time.monotonic() + 5
+    while not r.is_dispatched() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert r.is_dispatched()
+    t0 = time.monotonic()
+    b.stop(drain=False)
+    assert r.ready.wait(2.0)
+    assert time.monotonic() - t0 < 2.0  # did NOT wait out the wedge
+    assert r.status == 503 and r.response["error"] == "shutting_down"
+    snap = stats.snapshot()
+    assert snap["failed"] == 1
+    assert snap["batched_requests"] == (
+        snap["completed"] + snap["failed"] + snap["timed_out_dispatched"]
+    ), snap
+
+
+def test_drain_window_expiry_resolves_leftovers(monkeypatch):
+    stats = ServingStats()
+    b = MicroBatcher(stats=stats, min_lanes=1, window_s=0.001)
+
+    real = sweep_mod.run_batched_keys
+
+    def wedged(*a, **k):
+        time.sleep(5.0)
+        return real(*a, **k)
+
+    monkeypatch.setattr(sweep_mod, "run_batched_keys", wedged)
+    b.start()
+    r = b.submit(_cfg32(0), False)
+    deadline = time.monotonic() + 5
+    while not r.is_dispatched() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    t0 = time.monotonic()
+    b.stop(drain=True, drain_window_s=0.4)
+    elapsed = time.monotonic() - t0
+    assert r.ready.wait(1.0)
+    assert 0.3 < elapsed < 3.0, elapsed  # bounded by the window
+    assert r.status == 503 and r.response["error"] == "shutting_down"
+
+
+# ------------------------------------------------ orphaned-timeout hole
+
+
+def test_front_timeout_claims_never_counts_completed(monkeypatch):
+    """The PR 6 accounting hole, closed: a request whose front thread
+    times out is CLAIMED — the executor's late completion is dropped, the
+    request lands in timed_out (not completed), and every identity stays
+    exact. The executor survives to serve the next request."""
+    real = sweep_mod.run_batched_keys
+    state = {"slow": 1}
+
+    def slow_once(*a, **k):
+        res = real(*a, **k)
+        if state["slow"] > 0:
+            state["slow"] -= 1
+            time.sleep(1.0)
+        return res
+
+    app = ServingApp(window_s=0.005, max_lanes=4, min_lanes=1,
+                     request_timeout_s=0.25)
+    try:
+        # Warm first so the slow path's sleep dominates, not the compile.
+        st, _ = app.handle_run({"schema_version": 1, "n": 32,
+                                "topology": "full", "algorithm": "gossip",
+                                "seed": 1})
+        assert st == 200
+        monkeypatch.setattr(sweep_mod, "run_batched_keys", slow_once)
+        t0 = time.monotonic()
+        st, resp = app.handle_run({"schema_version": 1, "n": 32,
+                                   "topology": "full",
+                                   "algorithm": "gossip", "seed": 2})
+        assert st == 503 and resp["error"] == "timeout"
+        assert time.monotonic() - t0 < 0.9  # front released at timeout
+        time.sleep(1.2)  # let the executor finish (and drop) the orphan
+        snap = app.snapshot()
+        assert snap["timed_out"] == 1
+        assert snap["timed_out_dispatched"] == 1
+        assert snap["completed"] == 1  # the warm request only
+        assert snap["received"] == (
+            snap["completed"] + snap["failed"] + snap["rejected"]
+            + snap["invalid"] + snap["timed_out"] + snap["shed"]
+        ), snap
+        assert snap["batched_requests"] == (
+            snap["completed"] + snap["failed"]
+            + snap["timed_out_dispatched"]
+        ), snap
+        # Executor alive: next request completes normally.
+        st, resp = app.handle_run({"schema_version": 1, "n": 32,
+                                   "topology": "full",
+                                   "algorithm": "gossip", "seed": 3})
+        assert st == 200 and resp["result"]["outcome"] == "converged"
+    finally:
+        app.close()
+
+
+# ---------------------------------------------------------- schema pins
+
+
+def test_event_schema_v5():
+    assert EVENT_SCHEMA_VERSION == 5
+
+
+def test_healthz_lame_duck_and_drain_rejections():
+    app = ServingApp(window_s=0.005, max_lanes=4, min_lanes=1)
+    try:
+        app.draining = True
+        st, resp = app.handle_run({"schema_version": 1, "n": 32,
+                                   "topology": "full",
+                                   "algorithm": "gossip", "seed": 0})
+        assert st == 503 and resp["error"] == "shutting_down"
+        snap = app.snapshot()
+        assert snap["rejected"] == 1 and snap["received"] == 1
+    finally:
+        app.draining = False
+        app.close()

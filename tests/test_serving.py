@@ -662,7 +662,7 @@ def test_batching_beats_batching_off_control_pinned():
 
 
 def test_config_from_request_forces_chunked_engine():
-    cfg, tele = config_from_request(
+    cfg, tele, priority, deadline_ms = config_from_request(
         {"schema_version": 1, "n": 64, "topology": "2D",
          "algorithm": "pushsum", "telemetry": True,
          "params": {"quorum": 0.9, "crash_rate": 0.01}},
@@ -672,3 +672,28 @@ def test_config_from_request_forces_chunked_engine():
     assert cfg.topology == "grid2d" and cfg.algorithm == "push-sum"
     assert tele is True and cfg.telemetry is True
     assert cfg.crash_model
+    # v1 requests carry no resilience fields: defaults apply.
+    assert priority == "batch" and deadline_ms is None
+
+
+def test_config_from_request_resilience_fields():
+    cfg, _, priority, deadline_ms = config_from_request(
+        {"schema_version": 2, "n": 32, "topology": "full",
+         "algorithm": "gossip", "priority": "interactive",
+         "deadline_ms": 1500},
+        65536,
+    )
+    assert priority == "interactive" and deadline_ms == 1500.0
+    for bad in (
+        {"priority": "urgent"},
+        {"deadline_ms": 0},
+        {"deadline_ms": -5},
+        {"deadline_ms": "soon"},
+        {"deadline_ms": True},
+    ):
+        with pytest.raises(ValueError):
+            config_from_request(
+                {"schema_version": 2, "n": 32, "topology": "full",
+                 "algorithm": "gossip", **bad},
+                65536,
+            )
