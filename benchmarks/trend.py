@@ -56,6 +56,30 @@ def load_snapshots(root: Path) -> dict:
     return revs
 
 
+def parse_existing_serving(bench_tables: Path) -> dict:
+    """Serving pins already applied to BENCH_TABLES.md's trajectory
+    section: {revision: req/s}. Re-running ``--apply`` without repeating
+    every historical ``--serving REV:RPS`` pin must not silently drop a
+    measured figure from the table (the committed 1,778 req/s of r06 is a
+    record, not a flag default) — explicit pins passed on the command
+    line still win over parsed ones."""
+    if not bench_tables.exists():
+        return {}
+    text = bench_tables.read_text()
+    if SECTION_HEADER not in text:
+        return {}
+    section = text[text.index(SECTION_HEADER):]
+    nxt = section.find("\n## ")
+    if nxt > 0:
+        section = section[:nxt]
+    out: dict = {}
+    for m in re.finditer(
+        r"^\| r(\d+) \|.*\| ([\d,]+) \|\s*$", section, re.MULTILINE
+    ):
+        out[int(m.group(1))] = float(m.group(2).replace(",", ""))
+    return out
+
+
 def render(revs: dict, serving: dict) -> str:
     """Markdown table over the revision snapshots; ``serving`` maps
     revision -> req/s."""
@@ -149,7 +173,9 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 1
 
-    serving: dict = {}
+    # Pins already in the committed table survive a bare re-apply;
+    # command-line pins override them.
+    serving: dict = parse_existing_serving(args.root / "BENCH_TABLES.md")
     for pin in args.serving:
         try:
             rev_s, rps_s = pin.split(":", 1)

@@ -95,6 +95,16 @@ def build_parser() -> argparse.ArgumentParser:
                    "kernel; off = the serial per-plane/per-class schedule. "
                    "Bitwise-identical trajectories either way (pure "
                    "scheduling; tests/test_overlap.py)")
+    p.add_argument("--halo-dma", choices=["auto", "on", "off"],
+                   default="auto",
+                   help="in-kernel halo delivery for the HBM-streaming x "
+                   "sharded composition: auto (default) = Pallas "
+                   "async-remote-copy neighbor DMA on TPU (zero XLA "
+                   "collectives on the halo path, boundary-tile DMA "
+                   "overlapped with interior tile streaming), batched "
+                   "ppermute wire on CPU/interpret; on = force the DMA "
+                   "kernel (TPU execution only); off = pin the XLA wire. "
+                   "Bitwise transport-invariant trajectories")
     p.add_argument("--replicas", type=int, default=1,
                    help="run this many replicas (distinct per-replica key "
                    "streams, replica 0 = the unbatched run) of the "
@@ -272,6 +282,7 @@ def _main_refsim(args, parser) -> int:
         "--chunk-rounds": changed("chunk_rounds"),
         "--pipeline-chunks": changed("pipeline_chunks"),
         "--overlap-collectives": changed("overlap_collectives"),
+        "--halo-dma": changed("halo_dma"),
         "--replicas": changed("replicas"),
         "--compile-cache": changed("compile_cache"),
         "--target-frac": changed("target_frac"),
@@ -454,6 +465,7 @@ def main(argv: Optional[list[str]] = None) -> int:
             chunk_rounds=args.chunk_rounds,
             pipeline_chunks=args.pipeline_chunks,
             overlap_collectives=args.overlap_collectives == "on",
+            halo_dma=args.halo_dma,
             target_frac=args.target_frac,
             suppress_converged=None if args.suppress == "auto" else args.suppress == "on",
             fault_rate=args.fault_rate,
@@ -692,6 +704,7 @@ def main(argv: Optional[list[str]] = None) -> int:
                       "n_devices": cfg.n_devices,
                       "pipeline_chunks": cfg.pipeline_chunks,
                       "overlap_collectives": cfg.overlap_collectives,
+                      "halo_dma": cfg.halo_dma,
                       "telemetry": cfg.telemetry,
                       "mass_tolerance": cfg.mass_tolerance,
                       "strict_engine": cfg.strict_engine}

@@ -147,6 +147,22 @@ class SimConfig:
     # per-super-step collective counts both ways.
     overlap_collectives: bool = True
 
+    # In-kernel halo delivery for the HBM-streaming x sharded composition
+    # (parallel/fused_hbm_sharded.py): "auto" (default) moves the
+    # super-step halo exchange INTO the Pallas kernel as
+    # pltpu.make_async_remote_copy neighbor DMA on TPU backends — zero XLA
+    # collectives on the halo path, boundary-tile DMA overlapped with
+    # interior tile streaming — and keeps the batched-ppermute wire
+    # (parallel/halo.py) on CPU/interpret backends, where Pallas remote
+    # DMA cannot execute. "on" forces the DMA kernel (TPU execution only;
+    # CPU builds may still TRACE it — benchmarks/comm_audit.py audits the
+    # DMA program hardware-free that way); "off" pins the XLA wire
+    # everywhere. Both transports feed the kernels identical halo bytes,
+    # so trajectories are bitwise transport-invariant; the knob changes
+    # the traced program (it is part of the serving compile class), but
+    # resume accepts a changed value like the other scheduling knobs.
+    halo_dma: str = "auto"
+
     # Fraction of population that must converge. None → 1.0 in batched mode;
     # in reference semantics the builder's target_count (N of N+1, Q1) rules.
     target_frac: float | None = None
@@ -365,6 +381,10 @@ class SimConfig:
             import warnings
 
             warnings.warn(lint, RuntimeWarning, stacklevel=2)
+        if self.halo_dma not in ("auto", "on", "off"):
+            raise ValueError(
+                f"unknown halo_dma {self.halo_dma!r}; expected auto|on|off"
+            )
         if self.stall_chunks < 0:
             raise ValueError("stall_chunks must be >= 0")
         if self.mass_tolerance is not None:

@@ -19,6 +19,7 @@ Stopwatch numbers.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import os
 import sys
 import time
@@ -202,13 +203,35 @@ def _check_dtype(cfg: SimConfig) -> jnp.dtype:
     return dtype
 
 
+@functools.lru_cache(maxsize=None)
+def _leader_program(upper: int):
+    """One fused fold_in+randint program per distinct bound (the key rides
+    as an argument). Module-level cache, NOT the serving warm-engine pool:
+    leader draws are a models-layer concern, and a pool entry per
+    population would both occupy warm-ENGINE LRU slots and skew the
+    gossip_tpu_engine_pool_* metrics serving dashboards read. Distinct
+    bounds per process are bounded by distinct populations — tiny scalar
+    programs, no eviction needed."""
+    return jax.jit(
+        lambda k: jax.random.randint(
+            jax.random.fold_in(k, _LEADER_TAG), (), 0, upper,
+            dtype=jnp.int32,
+        )
+    )
+
+
 def draw_leader(base_key: jax.Array, topo: Topology, cfg: SimConfig) -> jax.Array:
     """Leader ∈ [0, nodes) — the reference draws Random().Next(0, nodes)
-    where `nodes` excludes the Q1 extra actor (program.fs:173)."""
-    upper = topo.target_count if cfg.reference else topo.n
-    return jax.random.randint(
-        jax.random.fold_in(base_key, _LEADER_TAG), (), 0, upper, dtype=jnp.int32
-    )
+    where `nodes` excludes the Q1 extra actor (program.fs:173).
+
+    Jitted, cached per bound: eagerly, fold_in + randint compile TWO
+    one-off XLA programs per process (~0.7 s of every cold run's setup
+    bucket — the largest single item wallwalk attributed there, ISSUE 9
+    satellite); cached, one fused program compiles once and every
+    same-population run (suite cells, serving buckets, sweeps) reuses it.
+    Same ops, same stream — the drawn leader is bitwise unchanged."""
+    upper = int(topo.target_count if cfg.reference else topo.n)
+    return _leader_program(upper)(base_key)
 
 
 def _life_dev(cfg: SimConfig, n: int):
@@ -782,7 +805,17 @@ def _finalize_result(
     done=None, stalled: bool = False, loop=None, collector=None,
     unhealthy_round=None, cancelled: bool = False,
 ) -> RunResult:
-    converged_count = int(jnp.sum(state.conv))
+    # Host-side numpy from here down: the run is over, so the single
+    # np.asarray fetch per plane costs one device sync the old eager-jnp
+    # reductions paid anyway — but zero XLA programs. Eagerly, this block
+    # compiled ~2 (gossip) to ~8 (push-sum) one-off programs per cold
+    # process, the whole `finalize` bucket wallwalk named (~149 ms on the
+    # CPU stand-in — ISSUE 9 satellite); the reported numbers are
+    # diagnostics (never trajectory state), computed in float64 now.
+    import numpy as np
+
+    conv_np = np.asarray(state.conv)
+    converged_count = int(conv_np.sum())
     converged = (converged_count >= target) if done is None else bool(done)
     if unhealthy_round is not None:
         # A tripped sentinel overrides everything: the state is corrupt (or
@@ -815,12 +848,14 @@ def _finalize_result(
         # w == 0 is reachable under rejoin='fresh' (revived nodes restart
         # weightless) and in unhealthy states — guard the ratio so the MAE
         # report never manufactures inf/NaN of its own.
-        w_safe = jnp.where(state.w != 0, state.w, 1)
-        ratio = jnp.where(state.w != 0, state.s / w_safe, 0.0)
+        s_np = np.asarray(state.s, dtype=np.float64)
+        w_np = np.asarray(state.w, dtype=np.float64)
+        w_safe = np.where(w_np != 0, w_np, 1.0)
+        ratio = np.where(w_np != 0, s_np / w_safe, 0.0)
         true_mean = (topo.n - 1) / 2.0
-        err = jnp.where(state.conv, jnp.abs(ratio - true_mean), 0.0)
+        err = np.where(conv_np, np.abs(ratio - true_mean), 0.0)
         result.true_mean = true_mean
-        mae = float(jnp.sum(err) / jnp.maximum(converged_count, 1))
+        mae = float(err.sum() / max(converged_count, 1))
         import math
 
         result.estimate_mae = mae if math.isfinite(mae) else None

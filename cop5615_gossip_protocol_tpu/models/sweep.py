@@ -525,8 +525,10 @@ def run_batched_keys(
         ]
     if cfg.algorithm == "push-sum":
         true_mean = (topo.n - 1) / 2.0
-        s = protos.s[:requests]
-        w = protos.w[:requests]
+        # float64 like runner._finalize_result (the diagnostics home) —
+        # replica 0's MAE stays approx-equal to the unbatched run's.
+        s = np.asarray(protos.s[:requests], dtype=np.float64)
+        w = np.asarray(protos.w[:requests], dtype=np.float64)
         conv = protos.conv[:requests]
         w_safe = np.where(w != 0, w, 1)
         err = np.where(conv, np.abs(s / w_safe - true_mean), 0.0)

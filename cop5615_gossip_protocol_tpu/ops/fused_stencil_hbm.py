@@ -268,8 +268,30 @@ def _window_marked(wm_ref, off, pt, rlane, lane, interpret):
     )
 
 
+def _window_counted(wa_ref, wm_ref, off, pt, rlane, d_c, lane, interpret):
+    """Gossip receipt count for one class: 1 where the regenerated mark
+    equals d_c AND the raw active window is set, lane-rotated. One ``off``
+    for both refs — the value window and its regen plane are generated at
+    the same group start. Shared by the single-device streamed engine and
+    the sharded composition (parallel/fused_hbm_sharded.py)."""
+    pa = (
+        (wm_ref[pl.ds(off + 1, pt), :] == d_c)
+        & (wa_ref[pl.ds(off + 1, pt), :] != 0)
+    ).astype(jnp.int32)
+    pb = (
+        (wm_ref[pl.ds(off, pt), :] == d_c)
+        & (wa_ref[pl.ds(off, pt), :] != 0)
+    ).astype(jnp.int32)
+    return jnp.where(
+        lane >= rlane,
+        _lane_roll(pa, rlane, interpret),
+        _lane_roll(pb, rlane, interpret),
+    )
+
+
 def _regen_marked_plane(dst, rows: int, base_row, k1, k2, R: int, N: int,
-                        dirs_builder, wrap: bool):
+                        dirs_builder, wrap: bool, *, ring_rows=None,
+                        row0=None):
     """Sampled-displacement plane regenerated at (mirror-wrapped) global
     rows [base_row, base_row+rows) — the sender's draw, bitwise the
     chunked engine's stream (threefry is position-wise, dirs arithmetic).
@@ -280,6 +302,13 @@ def _regen_marked_plane(dst, rows: int, base_row, k1, k2, R: int, N: int,
     sequence) instead of the general vector-divisor emulation — the same
     slot every targets_explicit draw takes.
 
+    ``ring_rows``/``row0`` re-base the row map for the SHARDED streaming
+    composition (parallel/fused_hbm_sharded.py): ``base_row`` then indexes
+    the device's halo-extended ring of ``ring_rows`` rows (mirror margin
+    wraps back to row 0), and global row = (row0 + ext_row) mod R — the
+    same sender draws the single-device engine regenerates, re-indexed to
+    this shard's window positions.
+
     Computed in 512-row chunks: the threefry + direction-select live set
     over a whole multi-thousand-row union window blows Mosaic's scoped
     VMEM stack (measured 109 MB at 8M); per-chunk temporaries are a few
@@ -289,7 +318,10 @@ def _regen_marked_plane(dst, rows: int, base_row, k1, k2, R: int, N: int,
     def chunk(o: int, ln: int):
         rl = lax.broadcasted_iota(jnp.int32, (ln, LANES), 0)
         ll = lax.broadcasted_iota(jnp.int32, (ln, LANES), 1)
-        grow = lax.rem(base_row + o + rl, jnp.int32(R))
+        pos = base_row + o + rl
+        if ring_rows is not None:
+            pos = row0 + lax.rem(pos, jnp.int32(ring_rows))
+        grow = lax.rem(pos, jnp.int32(R))
         jflat = grow * LANES + ll
         bits = threefry2x32_hash(k1, k2, jflat.astype(jnp.uint32))
         pairs = dirs_builder(jflat)
@@ -334,6 +366,74 @@ def _streaming_layout(n: int):
     )
 
 
+def _centered_sq(e: int, rows: int) -> int:
+    """Centered row shift of a forward roll by ``e`` on a ``rows``-row
+    ring: the signed tile-relative window displacement both planners
+    cluster on."""
+    q = e // LANES
+    return q - rows if q > rows // 2 else q
+
+
+def _plan_from_needs(needs, class_ds, PT: int, with_liveness: bool):
+    """Greedy window-grouping core shared by the single-device plan
+    (below) and the sharded plan (parallel/fused_hbm_sharded.
+    _shard_delivery_plan) — ONE home for the clustering loop, the
+    ``m_rows = PT + 16 + round8(span)`` margin formula, and the
+    alignment slacks the budgets and boundary split depend on.
+
+    ``needs``: (ci, d, e, sq, take1) rows — class index, class offset,
+    forward roll, centered row shift, blend side (None = serves every
+    row). Needs whose ``sq`` lie within one processing tile share one
+    fetched window. ``with_liveness`` keeps per-group member conditions
+    for predicated fetches (the single-device Z-displaced clusters);
+    False pins ``live = None`` (the sharded plan: fully static geometry).
+
+    Returns (classes, groups, M) in the shapes _delivery_plan documents:
+    classes[ci] = (class_ds[ci], ((group_idx, e, sq, take1), ...)),
+    groups[gi] = (sq_hi, m_rows, live), M = max margin rows.
+    """
+    order = sorted(range(len(needs)), key=lambda i: needs[i][3])
+    raw_groups = []
+    cur, lo, hi = [], 0, 0
+    for i in order:
+        sq = needs[i][3]
+        if cur and max(hi, sq) - min(lo, sq) <= PT:
+            cur.append(i)
+            lo, hi = min(lo, sq), max(hi, sq)
+        else:
+            if cur:
+                raw_groups.append((cur, lo, hi))
+            cur, lo, hi = [i], sq, sq
+    raw_groups.append((cur, lo, hi))
+
+    need_group = {}
+    groups = []
+    for gi, (members, lo, hi) in enumerate(raw_groups):
+        span = hi - lo
+        # off ranges over [0, span + 7] (8-aligned start remainder); the
+        # off+1 slice reads PT more rows; round the margin to 8.
+        m_rows = PT + 16 + ((span + 7) // 8) * 8
+        conds = []
+        for i in members:
+            need_group[i] = gi
+            _ci, d_c, _e, _sq, take1 = needs[i]
+            conds.append((d_c, take1))
+        live = None
+        if with_liveness and not any(t is None for _, t in conds):
+            live = conds
+        groups.append((hi, m_rows, live))
+    classes = []
+    for ci, d in enumerate(class_ds):
+        reads = tuple(
+            (need_group[i], needs[i][2], needs[i][3], needs[i][4])
+            for i in range(len(needs))
+            if needs[i][0] == ci
+        )
+        classes.append((d, reads))
+    M = max(m for _, m, _l in groups)
+    return classes, groups, M
+
+
 def _delivery_plan(topo: Topology, layout, PT: int):
     """Static delivery plan for the one-sweep consumer-regen design.
 
@@ -367,60 +467,22 @@ def _delivery_plan(topo: Topology, layout, PT: int):
     blend = wrap and Z != 0
     offsets = [int(d) for d in stencil_offsets(topo)]
 
-    def sq_of(e):
-        q = e // LANES
-        return q - R if q > R // 2 else q
-
     # (ci, d_c, e, sq, take1): take1 True = the gflat >= d variant,
     # False = the wrap variant, None = serves every row.
     needs = []
     for ci, d in enumerate(offsets):
         if not wrap:
             e = _signed_pad_shift(d, N, n_pad)
-            needs.append((ci, d, e, sq_of(e), None))
+            needs.append((ci, d, e, _centered_sq(e, R), None))
         elif Z == 0:
-            needs.append((ci, d, d, sq_of(d), None))
+            needs.append((ci, d, d, _centered_sq(d, R), None))
         else:
-            needs.append((ci, d, d, sq_of(d), True))
-            needs.append((ci, d, d + Z, sq_of(d + Z), False))
+            needs.append((ci, d, d, _centered_sq(d, R), True))
+            needs.append((ci, d, d + Z, _centered_sq(d + Z, R), False))
 
-    order = sorted(range(len(needs)), key=lambda i: needs[i][3])
-    raw_groups = []
-    cur, lo, hi = [], 0, 0
-    for i in order:
-        sq = needs[i][3]
-        if cur and max(hi, sq) - min(lo, sq) <= PT:
-            cur.append(i)
-            lo, hi = min(lo, sq), max(hi, sq)
-        else:
-            if cur:
-                raw_groups.append((cur, lo, hi))
-            cur, lo, hi = [i], sq, sq
-    raw_groups.append((cur, lo, hi))
-
-    need_group = {}
-    groups = []
-    for gi, (members, lo, hi) in enumerate(raw_groups):
-        span = hi - lo
-        # off ranges over [0, span + 7] (8-aligned start remainder); the
-        # off+1 slice reads PT more rows; round the margin to 8.
-        m_rows = PT + 16 + ((span + 7) // 8) * 8
-        conds = []
-        for i in members:
-            need_group[i] = gi
-            _ci, d_c, _e, _sq, take1 = needs[i]
-            conds.append((d_c, take1))
-        live = None if any(t is None for _, t in conds) else conds
-        groups.append((hi, m_rows, live))
-    classes = []
-    for ci, d in enumerate(offsets):
-        reads = tuple(
-            (need_group[i], needs[i][2], needs[i][3], needs[i][4])
-            for i in range(len(needs))
-            if needs[i][0] == ci
-        )
-        classes.append((d, reads))
-    M = max(m for _, m, _l in groups)
+    classes, groups, M = _plan_from_needs(
+        needs, offsets, PT, with_liveness=True
+    )
     return classes, groups, M, blend
 
 
@@ -1017,20 +1079,8 @@ def make_gossip_stencil_hbm_chunk(
                 mirror_op(t, b, "wait", write_planes)
 
             def counted_window(wa_ref, mk_ref, off, rl, d_c):
-                # One off for both refs: the value window and its regen
-                # plane are generated at the same group start.
-                pa = (
-                    (mk_ref[pl.ds(off + 1, PT), :] == d_c)
-                    & (wa_ref[pl.ds(off + 1, PT), :] != 0)
-                ).astype(jnp.int32)
-                pb = (
-                    (mk_ref[pl.ds(off, PT), :] == d_c)
-                    & (wa_ref[pl.ds(off, PT), :] != 0)
-                ).astype(jnp.int32)
-                return jnp.where(
-                    lane >= rl,
-                    _lane_roll(pa, rl, interpret),
-                    _lane_roll(pb, rl, interpret),
+                return _window_counted(
+                    wa_ref, mk_ref, off, PT, rl, d_c, lane, interpret
                 )
 
             def compute_tile(t, b, acc):

@@ -6,54 +6,65 @@ sharding, which caps the PER-SHARD population at the VMEM plane budget
 through HBM (ops/fused_stencil_hbm.py) — so sharding used to SHRINK the
 reachable population instead of multiplying it (VERDICT r4 missing #1).
 This module runs the HBM-streaming stencil engine inside the same
-halo-amortized shard_map skeleton:
+halo-amortized shard_map skeleton, with the r5 ONE-SWEEP round body
+(ROADMAP item 3 — until ISSUE 9 this composition still ran the OLD
+delivery-plane architecture: a p1 sweep writing halved-send + marked
+planes to HBM, then a p2 sweep reading them back):
 
 - each device holds its shard of the global [R_glob, 128] padded node
   layout plus an H-row halo per side, ALL IN HBM (that is the point);
-- one super-step = ONE batched ppermute pair carrying every plane's halo
-  slices (parallel/halo.exchange_rows_batched; one pair per plane under
-  --overlap-collectives off), then ONE per-shard `pallas_call` that streams
-  PT-row processing tiles through VMEM for CR whole rounds — ping/pong
-  parity planes, mirrored-margin delivery windows, in-consumer threefry at
-  GLOBAL positions: the single-device streamed architecture of
-  ops/fused_stencil_hbm.py re-indexed so that extended row r is global row
-  (row0 + r) mod R_glob;
-- under the default overlap schedule (parallel/overlap.py) the super-steps
-  are double-buffered: the exchange for super-step k+1 writes the inactive
-  ring copy right after super-step k's kernel, and the termination psum for
-  super-step k reduces under super-step k+1's kernel (one-super-step
-  verdict lag; `rounds` stays exact — a fired deferred verdict discards
-  the in-flight speculative super-step and returns the retired copy);
+- the round is ONE tile sweep with NO delivery planes at all — state lives
+  in two HBM plane sets (ping/pong parities, allocated as kernel outputs);
+  the windowed planes (push-sum s/w, gossip active) carry mirrored margins
+  so delivery windows read the RAW current-parity state directly; the
+  halve commutes into the inbox (exact power-of-two scaling — the
+  fused_pool_sharded lemma), and the sampled displacement is REGENERATED
+  inside the window consumer at GLOBAL positions (threefry is
+  position-wise, the direction pairs arithmetic), so the marked plane
+  never exists in memory. Every class's window NEED is clustered with its
+  neighbors exactly like the single-device engine (_shard_delivery_plan):
+  over the extended ring ALL of a torus's classes — both mod-n blend
+  variants included, since signed(-d) and signed(n-d) are both within the
+  halo width — typically collapse to ONE fetched window and ONE regen per
+  tile. HBM traffic per node per round drops from ~5 plane r/w + 3C
+  delivery windows to ~4 plane r/w + ~2 raw windows;
+- blend classes read both variants' plans out of the (shared) group
+  window and select elementwise at global flat >= d — exactly the chunked
+  mod-n blend, with no runtime straddle predicates left: window geometry
+  is static per tile, only the regen's global-row map carries row0;
 - halo regions are recomputed redundantly and stay valid for exactly CR
-  rounds: delivery is exact in slot space (out[j] reads in[j - e]), so
-  contamination from the buffer edges advances at most w slots per round
-  (w = the largest in-buffer window shift) and H >= ceil(CR*w/128) + 1
-  rows keeps the middle shard exact — the parallel/fused_sharded.py
-  invariant, unchanged by streaming;
-- convergence composes at super-step boundaries: local termination psums
-  the last round's middle-region converged count (CR-granular, exact at
-  chunk_rounds=1); termination='global' psums the kernel's PER-ROUND
-  middle unstable-lane counts and, when an interior round's global count
-  hits zero, REruns the chunk capped at that round — the stop round and
-  final state are exactly the sharded chunked global path's
-  (parallel/sharded.py + models/pushsum.absorb global_termination).
-
-Delivery windows ride the extended ring: per class d, the in-buffer
-circular roll pair (e1 for receivers at global flat >= d, e2 below — the
-fused_sharded blend); non-wrap lattices need only the signed single window
-(boundary live-masks already kill every would-be wrapping sender, the
-ops/fused_stencil_hbm._signed_pad_shift argument), and wrap lattices at
-Z = 0 have e1 == e2. When the blend is live (wrap, Z > 0), a tile fetches
-ONE window at the variant it actually uses; only tiles whose global slot
-interval contains a blend crossing (at most ~2 per class per device) fetch
-the second, predicated — the streamed engines' straddle-tile scheme with
-the tile->global map made runtime (row0-dependent).
+  rounds: delivery is exact in slot space, so contamination from the
+  buffer edges advances at most w slots per round and H >= ceil(CR*w/128)
+  + 1 rows keeps the middle shard exact — unchanged by the one-sweep port;
+- the halo wire itself is IN-KERNEL on TPU (cfg.halo_dma, default auto):
+  at super-step entry each device pushes its H-row mid boundary slices
+  straight into its ring neighbors' parity-A planes with
+  `pltpu.make_async_remote_copy` — zero XLA collectives on the halo path —
+  and round 0 of the super-step runs its tiles INTERIOR-FIRST
+  (_visit_order: tiles whose window reads cannot touch halo or mirror
+  rows stream while the neighbor DMA is in flight; the recv-semaphore
+  wait lands immediately before the first boundary tile). CPU/interpret
+  backends keep the PR 5 batched-ppermute wire behind the capability
+  check (parallel/halo.resolve_halo_transport) — both transports feed the
+  kernels identical halo bytes, so trajectories are bitwise
+  transport-invariant, and benchmarks/comm_audit.py pins the mechanism
+  (in-kernel-dma vs xla-ppermute) from the traced programs;
+- under the overlap schedule (parallel/overlap.py) the super-steps are
+  double-buffered and the termination psum for super-step k reduces under
+  super-step k+1's kernel (one-super-step verdict lag; `rounds` stays
+  exact). With in-kernel DMA the schedule hands the HALO SLOT to the
+  kernel: the XLA-side exchange is the identity and the kernel owns the
+  wire — the "documented next step" of the ISSUE 5 tile-order note, done;
+- convergence composes at super-step boundaries exactly as before: local
+  termination psums the last round's middle-region converged count;
+  termination='global' psums per-round middle unstable counts and reruns
+  the chunk capped at the verdict round (parallel/fused_sharded.py).
 
 The aggregate population ceiling is therefore n_dev * (single-chip HBM
-budget): 8 x 2^27 = 2^30 nodes on the BASELINE.json v4-8 shape — sharding
-now multiplies the ceiling. Trajectories match the chunked sharded path
-bit-for-bit for integer state (gossip) and up to compiler reassociation
-for push-sum (tests/test_fused_hbm_sharded.py; tests_tpu/ on hardware).
+budget): 8 x 2^27 = 2^30 nodes on the BASELINE.json v4-8 shape.
+Trajectories match the chunked sharded path bit-for-bit for integer state
+(gossip) and up to compiler reassociation for push-sum
+(tests/test_fused_hbm_sharded.py; tests_tpu/ on hardware).
 
 Reference mapping: C15's recast of the reference's whole runtime — the
 lattice hot loop (program.fs:89-105, 110-143) over Imp3D-family wirings
@@ -73,14 +84,17 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..config import SimConfig
-from ..ops.fused import clamp_cap_and_pad, threefry2x32_hash
+from ..ops.fused import clamp_cap_and_pad
 from ..ops.fused_pool import LANES, build_pool_layout
-from ..ops.fused_pool2 import _copy_all, _win_plan
+from ..ops.fused_pool2 import _copy_all
 from ..ops.fused_stencil_hbm import (
     _HBM_KINDS,
+    _centered_sq,
+    _group_window_starts,
     _lattice_params,
-    _sample_disp_dirs,
-    _window_marked,
+    _plan_from_needs,
+    _regen_marked_plane,
+    _window_counted,
     _window_vals,
 )
 from ..ops.topology import Topology, stencil_offsets
@@ -89,7 +103,7 @@ from .fused_sharded import _signed_pad
 
 _PT_CANDIDATES = (2048, 1024, 512, 256)
 # Per-device HBM for the kernel's resident planes (state parities +
-# delivery). The v5e chip has 16 GB; leave room for the XLA-side extended
+# margins). The v5e chip has 16 GB; leave room for the XLA-side extended
 # inputs and collective buffers.
 _HBM_PLANE_BUDGET = 12 * 2**30
 _VMEM_SCRATCH_BUDGET = 80 * 2**20
@@ -97,8 +111,8 @@ _VMEM_SCRATCH_BUDGET = 80 * 2**20
 
 def _class_sigmas(topo: Topology, layout):
     """Per class d: (d, sigma1, sigma2) signed in-buffer sender offsets —
-    the ONE home for the wrap/non-wrap case analysis that both the window
-    rolls (_class_windows) and the halo-sufficiency width
+    the ONE home for the wrap/non-wrap case analysis that the delivery
+    plan (_shard_delivery_plan) and the halo-sufficiency width
     (_halo_width_slots) derive from, so the two can never drift. sigma1
     serves receivers at global flat >= d, sigma2 those below (the
     fused_sharded mod-n blend pair); sigma2 is None when one window is
@@ -130,13 +144,109 @@ def _halo_width_slots(topo: Topology, layout) -> int:
     )
 
 
+def _shard_delivery_plan(topo: Topology, layout, rows_ext: int, PT: int):
+    """Static one-sweep delivery plan over the halo-extended ring — the
+    ops/fused_stencil_hbm._delivery_plan architecture re-based from the
+    global padded ring to this shard's rows_ext-row extended buffer.
+
+    Every class variant is one window NEED: the forward in-buffer roll
+    e = (-sigma) mod n_ext from _class_sigmas (a forward roll by e
+    delivers out[j] = in[j - e]). Blend classes contribute BOTH variants
+    unconditionally — signed(-d) and signed(n-d) are both within the halo
+    width, so unlike the single-device engine's Z-displaced clusters the
+    two variants land rows apart and (typically) inside the SAME group
+    window; no per-tile liveness predicates are needed, and window
+    geometry is fully static per tile. Needs whose centered row shifts lie
+    within one processing tile share one fetched window and one regen.
+
+    Returns (classes, groups, M, blend):
+      classes[ci] = (d_c, ((group_idx, e, sq, take1), ...)) — one or two
+        reads; ``take1`` marks the gflat >= d side of the blend (None for
+        single-need classes; the second read is always the wrap side);
+      groups[gi]  = (sq_hi, m_rows, None) — window start r0 - sq_hi - 1
+        and margin rows, in the (sq_hi, m, live) shape
+        _group_window_starts consumes (liveness always None here);
+      M           = max margin rows any window can read past rows_ext;
+      blend       = whether any class carries the two-variant pair.
+    """
+    n_ext = rows_ext * LANES
+    sigmas = _class_sigmas(topo, layout)
+    blend = any(s2 is not None for _, _, s2 in sigmas)
+
+    def sq_of(e):
+        return _centered_sq(e, rows_ext)
+
+    needs = []  # (ci, d, e, sq, take1)
+    for ci, (d, s1, s2) in enumerate(sigmas):
+        e1 = (-s1) % n_ext
+        if s2 is None:
+            needs.append((ci, d, e1, sq_of(e1), None))
+        else:
+            needs.append((ci, d, e1, sq_of(e1), True))
+            needs.append((ci, d, (-s2) % n_ext, sq_of((-s2) % n_ext), False))
+
+    classes, groups, M = _plan_from_needs(
+        needs, [d for d, _s1, _s2 in sigmas], PT, with_liveness=False
+    )
+    return classes, groups, M, blend
+
+
+def _boundary_split(H: int, PT: int, T: int, S: int) -> tuple[int, int]:
+    """(b_lo, b_hi): how many leading/trailing tiles of the extended
+    buffer can read halo rows [0, H) / [rows_ext - H, rows_ext) or the
+    mirror margin (whose contents replicate rows [0, M) — halo included),
+    through their own-state tile or any delivery window. ``S`` is the
+    plan's largest |window row shift| (max |sq| over every class variant);
+    the slack terms cover the -1 centering, 8-alignment, and the off+1
+    row of the window read. Conservative by construction (a spare
+    boundary tile costs overlap, never correctness); in-kernel halo DMA
+    streams the [b_lo, T - b_hi) interior tiles while the neighbor copies
+    are in flight and waits immediately before the first boundary tile."""
+    b_lo = min(T, max(1, -(-(H + S + 16) // PT)))
+    b_hi = min(T - b_lo, max(1 if T > b_lo else 0, -(-(H + S + 24) // PT)))
+    return b_lo, b_hi
+
+
+def _visit_order(T: int, b_lo: int, b_hi: int) -> list[int]:
+    """Interior-first tile permutation: [b_lo, T - b_hi) stream first
+    (their reads cannot touch halo or mirror rows), then the b_lo leading
+    and b_hi trailing boundary tiles. A permutation of range(T); per-tile
+    work is independent (each tile reads the immutable current parity and
+    writes its own next-parity rows, and the round metric is an integer
+    sum), so any visit order is bitwise-neutral — pinned by
+    tests/test_hbm_inkernel_halo.py."""
+    return (
+        list(range(b_lo, T - b_hi))
+        + list(range(b_lo))
+        + list(range(T - b_hi, T))
+    )
+
+
+def _visit_tile(u, T: int, b_lo: int, b_hi: int):
+    """Traced form of _visit_order: the tile index visited at loop step
+    ``u``."""
+    n_int = T - b_lo - b_hi
+    v = u - jnp.int32(n_int)
+    return jnp.where(
+        u < n_int,
+        u + jnp.int32(b_lo),
+        jnp.where(v < b_lo, v, jnp.int32(T - b_hi - b_lo) + v),
+    )
+
+
 def plan_stencil_hbm_sharded(topo: Topology, cfg: SimConfig, n_dev: int):
     """(H, rows_loc, CR, PT, layout) or a string reason why not.
 
     Mirrors plan_fused_sharded's gates; the budgets differ: state lives in
     HBM, so the population check is the per-device HBM plane budget (the
     single-chip tier's 2^27-class ceiling, times the mesh), and VMEM only
-    bounds the PT-row streaming scratch."""
+    bounds the PT-row streaming scratch. The plan is deliberately
+    invariant to BOTH scheduling knobs (overlap_collectives, halo_dma):
+    the overlapped schedule's extended-ring carry is budgeted
+    unconditionally, so geometry (H, CR, PT) can never differ across a
+    knob — a budget-edge population picking a smaller CR on one schedule
+    would break super-step-granular `rounds` interchangeability and the
+    resume contracts for a few spare rows of headroom."""
     if topo.implicit:
         return (
             "implicit (full) topology has no displacement structure for "
@@ -181,28 +291,10 @@ def plan_stencil_hbm_sharded(topo: Topology, cfg: SimConfig, n_dev: int):
             "do not divide it"
         )
     rows_loc = R // n_dev
-    Z = layout.n_pad - layout.n
-    _, wrap = _lattice_params(topo)
-    blend = wrap and Z != 0
     w = _halo_width_slots(topo, layout)
     pushsum = cfg.algorithm == "push-sum"
-    hbm_planes = 11 if pushsum else 7  # 2 parities x state + delivery
-    # The overlapped super-step schedule (parallel/overlap.py) carries the
-    # halo-extended ring AND a retired mid copy per plane in the XLA-side
-    # loop carry (the double buffer the deferred verdict rolls back to);
-    # those rows live in HBM next to the kernel's resident planes, so the
-    # plan budgets them UNCONDITIONALLY — even for the serial schedule
-    # (--overlap-collectives off, or termination='global', which keeps the
-    # serial loop), which never allocates them. Deliberate conservatism:
-    # the plan's geometry (H, CR, PT) must be identical across the overlap
-    # knob, or a budget-edge population would pick a smaller CR only on
-    # one schedule and super-step-granular `rounds` would differ — breaking
-    # the knob's bitwise-interchangeability and resume contracts for a few
-    # spare rows of headroom.
     n_state = 4 if pushsum else 3
     CR0 = max(1, min(int(cfg.chunk_rounds), 64))
-    win_per_class = (3 if pushsum else 1) * (2 if blend else 1)
-    n_win = len(offsets) * win_per_class
 
     def fit(cr):
         h_min = -(-(cr * w) // LANES) + 1
@@ -215,15 +307,28 @@ def plan_stencil_hbm_sharded(topo: Topology, cfg: SimConfig, n_dev: int):
             rows_ext = rows_loc + 2 * h
             if rows_ext // pt < 2 or h > rows_loc:
                 continue
-            vmem = (
-                (7 if pushsum else 4) * pt * LANES * 4
-                + n_win * (pt + 16) * LANES * 4
+            _cls, grp, m_max, _bl = _shard_delivery_plan(
+                topo, layout, rows_ext, pt
             )
+            sum_m = sum(m for _, m, _l in grp)
+            # Streaming scratch: own-state tiles + one window set per
+            # group (raw value planes + the regen mark plane).
+            vmem = (
+                (4 if pushsum else 3) * pt
+                + sum_m * (3 if pushsum else 2)
+            ) * LANES * 4
             if vmem > _VMEM_SCRATCH_BUDGET:
                 continue
+            # Resident planes: two margined parities per windowed plane,
+            # two plain parities per i32 plane, the XLA-side extended
+            # inputs, and the overlap schedule's double-buffer carry
+            # (budgeted unconditionally — see the docstring).
             carry_rows = n_state * (rows_ext + rows_loc)
             hbm = (
-                hbm_planes * (rows_ext + pt + 16) + carry_rows
+                (4 if pushsum else 2) * (rows_ext + m_max)
+                + 4 * rows_ext
+                + n_state * rows_ext
+                + carry_rows
             ) * LANES * 4
             if hbm > _HBM_PLANE_BUDGET:
                 continue
@@ -252,164 +357,190 @@ def plan_stencil_hbm_sharded(topo: Topology, cfg: SimConfig, n_dev: int):
     return (H, rows_loc, CR, PT, layout)
 
 
-def _class_windows(topo: Topology, layout, rows_ext: int):
-    """Per class d: (d, e1, e2) in-buffer forward roll amounts over the
-    extended ring (n_ext = rows_ext * 128) — a forward roll by e delivers
-    out[j] = in[j - e], so e = (-sigma) mod n_ext for each of
-    _class_sigmas' sender offsets. e2 is None whenever sigma2 is."""
-    n_ext = rows_ext * LANES
-    return [
-        (d, (-s1) % n_ext, None if s2 is None else (-s2) % n_ext)
-        for d, s1, s2 in _class_sigmas(topo, layout)
-    ]
+def _halo_rdmas(mid_ins, planesA, H: int, rows_loc: int, ssems, rsems,
+                left, right):
+    """The in-kernel halo wire: per state plane, one async remote copy of
+    my LAST H mid rows into the right neighbor's left-halo rows [0, H) and
+    one of my FIRST H mid rows into the left neighbor's right-halo rows
+    [H + rows_loc, rows_ext) — exactly the bytes
+    parallel/halo.exchange_rows_batched ships per plane, with no XLA
+    collective. SPMD-symmetric slots: my send on slot i and my neighbor's
+    send INTO me on slot i share semaphores, so ``.wait()`` on each
+    descriptor drains both the outbound send and the inbound receive. A
+    pure function of its refs — the start site and the wait site recreate
+    identical descriptor lists."""
+    cps = []
+    for p, (src, dst) in enumerate(zip(mid_ins, planesA)):
+        cps.append(pltpu.make_async_remote_copy(
+            src_ref=src.at[pl.ds(rows_loc - H, H), :],
+            dst_ref=dst.at[pl.ds(0, H), :],
+            send_sem=ssems.at[2 * p], recv_sem=rsems.at[2 * p],
+            device_id=(right,),
+            device_id_type=pltpu.DeviceIdType.MESH,
+        ))
+        cps.append(pltpu.make_async_remote_copy(
+            src_ref=src.at[pl.ds(0, H), :],
+            dst_ref=dst.at[pl.ds(H + rows_loc, H), :],
+            send_sem=ssems.at[2 * p + 1], recv_sem=rsems.at[2 * p + 1],
+            device_id=(left,),
+            device_id_type=pltpu.DeviceIdType.MESH,
+        ))
+    return cps
 
 
-def _tile_blend_plan(row0, r0, d: int, R_glob: int, n_pad: int, PT: int):
-    """Scalar blend facts for one (tile, class): the tile's global slot
-    interval is [lo, lo + PT*128) mod n_pad; the blend select
-    (take = gflat >= d) changes value only at crossings d and 0, so a tile
-    containing neither is UNIFORM and needs one window — the variant of its
-    first slot. Conservative at the lo == crossing edge (marks nonuniform,
-    costing one spare fetch, never correctness). Returns
-    (nonuniform, take_lo) traced booleans."""
-    lo = lax.rem(row0 + r0, jnp.int32(R_glob)) * jnp.int32(LANES)
-    PTL = jnp.int32(PT * LANES)
-    npj = jnp.int32(n_pad)
-    c_d = lax.rem(jnp.int32(d) - lo + 2 * npj, npj) < PTL
-    c_0 = lax.rem(npj - lo, npj) < PTL
-    return c_d | c_0, lo >= jnp.int32(d)
-
-
-def _start_class_volley(windows, r0, row0, pairs, wsems, stride: int,
-                        R_glob: int, n_pad: int, PT: int, M: int,
-                        rows_ext: int):
-    """Start every class's PRIMARY window DMA before waiting on any (the
-    stencil_hbm gossip lesson — serialized start/wait pairs leave each ~MB
-    transfer's latency exposed), at the blend variant this tile actually
-    uses; tiles containing a blend crossing (at most ~2 per class per
-    device) fetch the second variant predicated, start+wait inside the
-    pl.when. ``pairs`` is [(hbm_plane, window_stack), ...] — one pair for
-    the gossip marked plane, three (ds, dw, dm) for push-sum. Returns
-    (plans, wrap_plans, nonunis, cps); callers wait on ``cps`` and consume
-    through the (rl, off) plans. The ONE home for the composition's
-    subtlest predicate, shared by both kernels."""
-    n_pairs = len(pairs)
-    plans, cps, nonunis = [], [], []
-    for ci, (d_c, e1, e2) in enumerate(windows):
-        if e2 is None:
-            e_sel = jnp.int32(e1)
-            nonunis.append(None)
-        else:
-            nonuni, take_lo = _tile_blend_plan(
-                row0, r0, d_c, R_glob, n_pad, PT
-            )
-            nonunis.append(nonuni)
-            e_sel = jnp.where(
-                nonuni | take_lo, jnp.int32(e1), jnp.int32(e2)
-            )
-        ws8, rl, off = _win_plan(r0, e_sel, rows_ext)
-        slot = ci * stride
-        for si, (pln, wref) in enumerate(pairs):
-            cp = pltpu.make_async_copy(
-                pln.at[pl.ds(ws8, M), :], wref.at[slot],
-                wsems.at[slot * n_pairs + si],
-            )
-            cp.start()
-            cps.append(cp)
-        plans.append((rl, off))
-    wrap_plans = []
-    for ci, (d_c, e1, e2) in enumerate(windows):
-        if e2 is None:
-            wrap_plans.append(None)
-            continue
-        ws8_2, rl2, off2 = _win_plan(r0, jnp.int32(e2), rows_ext)
-        wrap_plans.append((rl2, off2))
-        slot2 = ci * stride + 1
-
-        @pl.when(nonunis[ci])
-        def _fetch_wrap(ws8_2=ws8_2, slot2=slot2):
-            cps2 = [
-                pltpu.make_async_copy(
-                    pln.at[pl.ds(ws8_2, M), :], wref.at[slot2],
-                    wsems.at[slot2 * n_pairs + si],
-                )
-                for si, (pln, wref) in enumerate(pairs)
-            ]
-            for cp2 in cps2:
-                cp2.start()
-            for cp2 in cps2:
-                cp2.wait()
-
-    return plans, wrap_plans, nonunis, cps
+def _neighbor_barrier(left, right):
+    """Block until both ring neighbors have entered this kernel: a remote
+    DMA writes straight into the neighbor's output planes, so the write
+    must not land before the neighbor's invocation owns those buffers.
+    Uses the global barrier semaphore (collective_id in the compiler
+    params)."""
+    bar = pltpu.get_barrier_semaphore()
+    for nb in (left, right):
+        pltpu.semaphore_signal(
+            bar, inc=1, device_id=(nb,),
+            device_id_type=pltpu.DeviceIdType.MESH,
+        )
+    pltpu.semaphore_wait(bar, 2)
 
 
 def make_pushsum_stencil_hbm_shard_chunk(
     topo: Topology, cfg: SimConfig, H: int, rows_loc: int, PT: int,
-    layout, *, interpret: bool = False
+    layout, *, dma: bool = False, interpret: bool = False
 ):
-    """Per-device chunk kernel: ``chunk_fn(ext_state, keys, row0, start,
-    cap) -> (mid_state4, executed, u)`` runs up to K = keys.shape[0]
-    push-sum rounds on one device's halo-extended planes, HBM-streamed.
-    ``row0`` is the extended buffer's first GLOBAL row (pre-wrapped);
-    ``u[k]`` is round k's middle-region metric — unstable valid lanes
-    under termination='global', converged count otherwise; -1 on rounds
-    not executed."""
+    """Per-device one-sweep chunk kernel: ``chunk_fn(state, keys, row0,
+    dev, start, cap) -> (mid_state4, executed, u)`` runs up to
+    K = keys.shape[0] push-sum rounds on one device's planes, HBM-streamed
+    with the delivery-plane-free round body. ``state`` is the
+    halo-EXTENDED planes (rows_ext) under the XLA wire, or the MID planes
+    (rows_loc) under in-kernel DMA (``dma=True`` — the kernel performs the
+    halo exchange itself at super-step entry, interior-first). ``row0`` is
+    the extended buffer's first GLOBAL row (pre-wrapped); ``u[k]`` is
+    round k's middle-region metric — unstable valid lanes under
+    termination='global', converged count otherwise; -1 on rounds not
+    executed."""
     R_glob = layout.rows
     N = layout.n
-    n_pad = layout.n_pad
-    Z = n_pad - N
     rows_ext = rows_loc + 2 * H
     T = rows_ext // PT
-    M = PT + 16
+    n_dev = R_glob // rows_loc
+    classes, groups, M, _blend = _shard_delivery_plan(
+        topo, layout, rows_ext, PT
+    )
+    G = len(groups)
+    mt = -(-M // PT)  # mirror tiles replicating rows [0, M)
     dirs_builder, wrap = _lattice_params(topo)
-    blend = wrap and Z != 0
-    windows = _class_windows(topo, layout, rows_ext)
-    C = len(windows)
-    stride = 2 if blend else 1
+    S = max(
+        abs(sq) for _d, reads in classes for _gi, _e, sq, _t1 in reads
+    )
+    b_lo, b_hi = _boundary_split(H, PT, T, S)
+    n_int = T - b_lo - b_hi
     delta = np.float32(cfg.resolved_delta)
     term_rounds = np.int32(cfg.term_rounds)
     global_term = cfg.termination == "global"
+    in_rows = rows_loc if dma else rows_ext
 
-    def kernel(
-        scal_ref, keys_ref, s_in, w_in, t_in, c_in,
-        sA, wA, tA, cA, sB, wB, tB, cB, ds_p, dw_p, dm_p, meta_o, u_o,
-        scr_s, scr_w, scr_t, scr_c, scr_ds, scr_dw, scr_dm,
-        win_s, win_w, win_m, flags, sems, wsems,
-    ):
+    def kernel(*refs):
+        (scal_ref, keys_ref, s_in, w_in, t_in, c_in,
+         sA, wA, tA, cA, sB, wB, tB, cB, meta_o, u_o) = refs[:16]
+        scratch = refs[16:]
+        win_s = scratch[0:G]
+        win_w = scratch[G:2 * G]
+        mk = scratch[2 * G:3 * G]
+        (scr_s, scr_w, scr_t, scr_c, flags, sems, wsems) = scratch[
+            3 * G:3 * G + 7
+        ]
+        dma_sems = scratch[3 * G + 7:]
         k = pl.program_id(0)
         K = pl.num_programs(0)
         row_l = lax.broadcasted_iota(jnp.int32, (PT, LANES), 0)
         lane = lax.broadcasted_iota(jnp.int32, (PT, LANES), 1)
         row0 = scal_ref[0]
+        dev = scal_ref[3]
+        if dma:
+            ssems, rsems = dma_sems
+            left = lax.rem(dev + jnp.int32(n_dev - 1), jnp.int32(n_dev))
+            right = lax.rem(dev + jnp.int32(1), jnp.int32(n_dev))
 
         def tile_globals(r0):
             grow = lax.rem(row0 + r0 + row_l, jnp.int32(R_glob))
             gflat = grow * LANES + lane
             return grow, gflat
 
+        def rdmas():
+            return _halo_rdmas(
+                (s_in, w_in, t_in, c_in), (sA, wA, tA, cA),
+                H, rows_loc, ssems, rsems, left, right,
+            )
+
+        def drain_halo():
+            """Wait the neighbor copies, then mirror parity A's first M
+            rows (left halo included — hence after the wait) into the
+            window margin."""
+            for cp in rdmas():
+                cp.wait()
+            _copy_all([
+                (sA.at[pl.ds(0, M), :], sA.at[pl.ds(rows_ext, M), :]),
+                (wA.at[pl.ds(0, M), :], wA.at[pl.ds(rows_ext, M), :]),
+            ], sems)
+
         @pl.when(k == 0)
         def _init():
-            def cp(t, _):
-                r0 = t * PT
+            if dma:
+                # Hand the halo slot to the kernel: barrier with the ring
+                # neighbors, push my boundary slices into their parity-A
+                # halos, and land my own mid rows — the halo recv drains
+                # under round 0's interior tiles (drain_halo at the first
+                # boundary tile).
+                _neighbor_barrier(left, right)
+                for cp in rdmas():
+                    cp.start()
                 _copy_all([
-                    (s_in.at[pl.ds(r0, PT), :], scr_s),
-                    (w_in.at[pl.ds(r0, PT), :], scr_w),
-                    (t_in.at[pl.ds(r0, PT), :], scr_t),
-                    (c_in.at[pl.ds(r0, PT), :], scr_c),
+                    (s_in, sA.at[pl.ds(H, rows_loc), :]),
+                    (w_in, wA.at[pl.ds(H, rows_loc), :]),
+                    (t_in, tA.at[pl.ds(H, rows_loc), :]),
+                    (c_in, cA.at[pl.ds(H, rows_loc), :]),
                 ], sems)
-                _copy_all([
-                    (scr_s, sA.at[pl.ds(r0, PT), :]),
-                    (scr_w, wA.at[pl.ds(r0, PT), :]),
-                    (scr_t, tA.at[pl.ds(r0, PT), :]),
-                    (scr_c, cA.at[pl.ds(r0, PT), :]),
-                ], sems)
-                return 0
+            else:
+                def cp(t, _):
+                    r0 = t * PT
+                    _copy_all([
+                        (s_in.at[pl.ds(r0, PT), :], scr_s),
+                        (w_in.at[pl.ds(r0, PT), :], scr_w),
+                        (t_in.at[pl.ds(r0, PT), :], scr_t),
+                        (c_in.at[pl.ds(r0, PT), :], scr_c),
+                    ], sems)
+                    _copy_all([
+                        (scr_s, sA.at[pl.ds(r0, PT), :]),
+                        (scr_w, wA.at[pl.ds(r0, PT), :]),
+                        (scr_t, tA.at[pl.ds(r0, PT), :]),
+                        (scr_c, cA.at[pl.ds(r0, PT), :]),
+                    ], sems)
+                    for i in range(mt):
+                        rows_i = min(PT, M - i * PT)
 
-            lax.fori_loop(0, T, cp, 0, unroll=False)
+                        @pl.when(t == i)
+                        def _m(i=i, rows_i=rows_i):
+                            _copy_all([
+                                (scr_s.at[pl.ds(0, rows_i), :],
+                                 sA.at[pl.ds(rows_ext + i * PT, rows_i), :]),
+                                (scr_w.at[pl.ds(0, rows_i), :],
+                                 wA.at[pl.ds(rows_ext + i * PT, rows_i), :]),
+                            ], sems)
+                    return 0
+
+                lax.fori_loop(0, T, cp, 0, unroll=False)
             flags[0] = jnp.int32(0)  # rounds executed
 
         u_o[k] = jnp.int32(-1)
         active = scal_ref[1] + k < scal_ref[2]
+
+        if dma:
+            # A zero-round chunk (overshoot dispatch past termination)
+            # still started the neighbor copies — drain them so the kernel
+            # never exits with an in-flight DMA.
+            @pl.when((k == 0) & ~active)
+            def _drain_idle():
+                drain_halo()
 
         def round_body(cur, nxt):
             (s_c, w_c, t_c, c_c) = cur
@@ -418,54 +549,7 @@ def make_pushsum_stencil_hbm_shard_chunk(
             k1 = keys_ref[kk, 0]
             k2 = keys_ref[kk, 1]
 
-            def p1(t, _):
-                r0 = t * PT
-                _copy_all([
-                    (s_c.at[pl.ds(r0, PT), :], scr_s),
-                    (w_c.at[pl.ds(r0, PT), :], scr_w),
-                ], sems)
-                grow, gflat = tile_globals(r0)
-                padm = gflat >= N
-                bits = threefry2x32_hash(
-                    k1, k2,
-                    grow.astype(jnp.uint32) * jnp.uint32(LANES)
-                    + lane.astype(jnp.uint32),
-                )
-                d, deg_t = _sample_disp_dirs(bits, dirs_builder(gflat))
-                send_ok = (deg_t > 0) & ~padm
-                scr_ds[:] = jnp.where(send_ok, scr_s[:] * 0.5, 0.0)
-                scr_dw[:] = jnp.where(send_ok, scr_w[:] * 0.5, 0.0)
-                scr_dm[:] = jnp.where(send_ok, d, jnp.int32(-1))
-                _copy_all([
-                    (scr_ds, ds_p.at[pl.ds(r0, PT), :]),
-                    (scr_dw, dw_p.at[pl.ds(r0, PT), :]),
-                    (scr_dm, dm_p.at[pl.ds(r0, PT), :]),
-                ], sems)
-
-                @pl.when(t == 0)
-                def _mirror0():
-                    _copy_all([
-                        (scr_ds, ds_p.at[pl.ds(rows_ext, PT), :]),
-                        (scr_dw, dw_p.at[pl.ds(rows_ext, PT), :]),
-                        (scr_dm, dm_p.at[pl.ds(rows_ext, PT), :]),
-                    ], sems)
-
-                @pl.when(t == 1)
-                def _mirror1():
-                    _copy_all([
-                        (scr_ds.at[pl.ds(0, 16), :],
-                         ds_p.at[pl.ds(rows_ext + PT, 16), :]),
-                        (scr_dw.at[pl.ds(0, 16), :],
-                         dw_p.at[pl.ds(rows_ext + PT, 16), :]),
-                        (scr_dm.at[pl.ds(0, 16), :],
-                         dm_p.at[pl.ds(rows_ext + PT, 16), :]),
-                    ], sems)
-
-                return 0
-
-            lax.fori_loop(0, T, p1, 0, unroll=False)
-
-            def p2(t, acc):
+            def tile(t, acc):
                 r0 = t * PT
                 _copy_all([
                     (s_c.at[pl.ds(r0, PT), :], scr_s),
@@ -473,55 +557,73 @@ def make_pushsum_stencil_hbm_shard_chunk(
                     (t_c.at[pl.ds(r0, PT), :], scr_t),
                     (c_c.at[pl.ds(r0, PT), :], scr_c),
                 ], sems)
+                starts = _group_window_starts(groups, r0, rows_ext)
+                cps = []
+                for gi, (_ws8u, dma0, _live) in enumerate(starts):
+                    m = groups[gi][1]
+                    for j, (pln, wref) in enumerate(
+                        [(s_c, win_s[gi]), (w_c, win_w[gi])]
+                    ):
+                        cp = pltpu.make_async_copy(
+                            pln.at[pl.ds(dma0, m), :], wref,
+                            wsems.at[2 * gi + j],
+                        )
+                        cp.start()
+                        cps.append(cp)
+                # Regenerate each group's marked plane (the sender draws at
+                # the window's mirror-wrapped rows, re-based to GLOBAL
+                # positions) while the raw windows stream.
+                for gi, (ws8u, _dma0, _live) in enumerate(starts):
+                    _regen_marked_plane(
+                        mk[gi], groups[gi][1], ws8u, k1, k2, R_glob, N,
+                        dirs_builder, wrap, ring_rows=rows_ext, row0=row0,
+                    )
+                for cp in cps:
+                    cp.wait()
                 _, gflat = tile_globals(r0)
                 padm = gflat >= N
                 mid = (row_l + r0 >= H) & (row_l + r0 < H + rows_loc)
-
-                plans, wrap_plans, nonunis, cps = _start_class_volley(
-                    windows, r0, row0,
-                    [(ds_p, win_s), (dw_p, win_w), (dm_p, win_m)],
-                    wsems, stride, R_glob, n_pad, PT, M, rows_ext,
-                )
-                for cp in cps:
-                    cp.wait()
-
                 inbox_s = jnp.zeros((PT, LANES), jnp.float32)
                 inbox_w = jnp.zeros((PT, LANES), jnp.float32)
-                for ci, (d_c, e1, e2) in enumerate(windows):
-                    rl, off = plans[ci]
-                    s1 = ci * stride
-                    cs = _window_vals(
-                        win_s.at[s1], win_m.at[s1], off, PT, rl, d_c,
-                        lane, interpret,
-                    )
-                    cw = _window_vals(
-                        win_w.at[s1], win_m.at[s1], off, PT, rl, d_c,
-                        lane, interpret,
-                    )
-                    if e2 is not None:
-                        rl2, off2 = wrap_plans[ci]
-                        s2 = s1 + 1
-                        use2 = nonunis[ci] & (gflat < d_c)
-                        cs = jnp.where(
-                            use2,
-                            _window_vals(win_s.at[s2], win_m.at[s2], off2,
-                                         PT, rl2, d_c, lane, interpret),
-                            cs,
+                # Accumulate in sorted-offsets order — the chunked path's
+                # association tree; groups only choose the buffer. Blend
+                # classes read both variants and select elementwise at
+                # global flat >= d (the mod-n blend).
+                for d_c, reads in classes:
+                    cs = cw = None
+                    for gi, e, sq, _take1 in reads:
+                        ws8u = starts[gi][0]
+                        off = jnp.asarray(
+                            r0 - sq - 1 + 2 * rows_ext, jnp.int32
+                        ) - ws8u
+                        rl = e % LANES
+                        vs = _window_vals(
+                            win_s[gi], mk[gi], off, PT, rl, d_c, lane,
+                            interpret,
                         )
-                        cw = jnp.where(
-                            use2,
-                            _window_vals(win_w.at[s2], win_m.at[s2], off2,
-                                         PT, rl2, d_c, lane, interpret),
-                            cw,
+                        vw = _window_vals(
+                            win_w[gi], mk[gi], off, PT, rl, d_c, lane,
+                            interpret,
                         )
+                        if cs is None:
+                            cs, cw = vs, vw
+                        else:
+                            # second read is always the wrap (take1=False)
+                            # side: select it below d_c.
+                            cs = jnp.where(gflat >= d_c, cs, vs)
+                            cw = jnp.where(gflat >= d_c, cw, vw)
                     inbox_s = inbox_s + cs
                     inbox_w = inbox_w + cw
-                inbox_s = jnp.where(padm, 0.0, inbox_s)
-                inbox_w = jnp.where(padm, 0.0, inbox_w)
+                # Halve AFTER the masked sums — bitwise the pre-halved-send
+                # delivery (exact power-of-two scaling commutes with every
+                # rounding in the sum).
+                half = jnp.float32(0.5)
+                inbox_s = jnp.where(padm, 0.0, inbox_s * half)
+                inbox_w = jnp.where(padm, 0.0, inbox_w * half)
                 s_t = scr_s[:]
                 w_t = scr_w[:]
-                s_send = jnp.where(padm, 0.0, s_t * 0.5)
-                w_send = jnp.where(padm, 0.0, w_t * 0.5)
+                s_send = jnp.where(padm, 0.0, s_t * half)
+                w_send = jnp.where(padm, 0.0, w_t * half)
                 s_new = (s_t - s_send) + inbox_s
                 w_new = (w_t - w_send) + inbox_w
                 if global_term:
@@ -571,9 +673,36 @@ def make_pushsum_stencil_hbm_shard_chunk(
                     (scr_t, t_n.at[pl.ds(r0, PT), :]),
                     (scr_c, c_n.at[pl.ds(r0, PT), :]),
                 ], sems)
+                # Margin mirrors for the NEXT round's windows: rows
+                # [rows_ext, rows_ext + M) replicate [0, M).
+                for i in range(mt):
+                    rows_i = min(PT, M - i * PT)
+
+                    @pl.when(t == i)
+                    def _m(i=i, rows_i=rows_i):
+                        _copy_all([
+                            (scr_s.at[pl.ds(0, rows_i), :],
+                             s_n.at[pl.ds(rows_ext + i * PT, rows_i), :]),
+                            (scr_w.at[pl.ds(0, rows_i), :],
+                             w_n.at[pl.ds(rows_ext + i * PT, rows_i), :]),
+                        ], sems)
                 return acc + tile_metric
 
-            total = lax.fori_loop(0, T, p2, jnp.int32(0), unroll=False)
+            def step(u, acc):
+                if dma:
+                    # Interior-first: boundary tiles run last, behind the
+                    # halo drain (a per-tile-independent reordering —
+                    # bitwise-neutral, the metric is an integer sum).
+                    t = _visit_tile(u, T, b_lo, b_hi)
+
+                    @pl.when((k == 0) & (u == n_int))
+                    def _wait_halo():
+                        drain_halo()
+                else:
+                    t = u
+                return tile(t, acc)
+
+            total = lax.fori_loop(0, T, step, jnp.int32(0), unroll=False)
             flags[0] = flags[0] + 1
             u_o[k] = total
 
@@ -594,21 +723,39 @@ def make_pushsum_stencil_hbm_shard_chunk(
             meta_o[0] = flags[0]
             meta_o[1] = flags[0] % 2
 
-    def chunk_fn(ext_state, keys, row0, start, cap):
-        s, w, t, c = ext_state
+    def chunk_fn(state, keys, row0, dev, start, cap):
+        s, w, t, c = state
         cap, keys = clamp_cap_and_pad(start, cap, keys)
         K = keys.shape[0]
-        f32 = jax.ShapeDtypeStruct((rows_ext, LANES), jnp.float32)
-        i32 = jax.ShapeDtypeStruct((rows_ext, LANES), jnp.int32)
         f32m = jax.ShapeDtypeStruct((rows_ext + M, LANES), jnp.float32)
-        i32m = jax.ShapeDtypeStruct((rows_ext + M, LANES), jnp.int32)
+        i32 = jax.ShapeDtypeStruct((rows_ext, LANES), jnp.int32)
+        scratch = (
+            [pltpu.VMEM((m, LANES), jnp.float32) for _, m, _l in groups]
+            + [pltpu.VMEM((m, LANES), jnp.float32) for _, m, _l in groups]
+            + [pltpu.VMEM((m, LANES), jnp.int32) for _, m, _l in groups]
+            + [
+                pltpu.VMEM((PT, LANES), jnp.float32),
+                pltpu.VMEM((PT, LANES), jnp.float32),
+                pltpu.VMEM((PT, LANES), jnp.int32),
+                pltpu.VMEM((PT, LANES), jnp.int32),
+                pltpu.SMEM((1,), jnp.int32),
+                pltpu.SemaphoreType.DMA((4,)),
+                pltpu.SemaphoreType.DMA((2 * G,)),
+            ]
+        )
+        params = dict(vmem_limit_bytes=96 * 1024 * 1024)
+        if dma:
+            scratch += [
+                pltpu.SemaphoreType.DMA((8,)),
+                pltpu.SemaphoreType.DMA((8,)),
+            ]
+            params["collective_id"] = 0
         outs = pl.pallas_call(
             kernel,
             grid=(K,),
             out_shape=(
-                f32, f32, i32, i32,
-                f32, f32, i32, i32,
-                f32m, f32m, i32m,
+                f32m, f32m, i32, i32,
+                f32m, f32m, i32, i32,
                 jax.ShapeDtypeStruct((2,), jnp.int32),
                 jax.ShapeDtypeStruct((K,), jnp.int32),
             ),
@@ -618,34 +765,19 @@ def make_pushsum_stencil_hbm_shard_chunk(
                              memory_space=pltpu.SMEM),
             ] + [pl.BlockSpec(memory_space=pl.ANY)] * 4,
             out_specs=tuple(
-                [pl.BlockSpec(memory_space=pl.ANY)] * 11
+                [pl.BlockSpec(memory_space=pl.ANY)] * 8
                 + [pl.BlockSpec(memory_space=pltpu.SMEM)] * 2
             ),
-            scratch_shapes=[
-                pltpu.VMEM((PT, LANES), jnp.float32),
-                pltpu.VMEM((PT, LANES), jnp.float32),
-                pltpu.VMEM((PT, LANES), jnp.int32),
-                pltpu.VMEM((PT, LANES), jnp.int32),
-                pltpu.VMEM((PT, LANES), jnp.float32),
-                pltpu.VMEM((PT, LANES), jnp.float32),
-                pltpu.VMEM((PT, LANES), jnp.int32),
-                pltpu.VMEM((C * stride, M, LANES), jnp.float32),
-                pltpu.VMEM((C * stride, M, LANES), jnp.float32),
-                pltpu.VMEM((C * stride, M, LANES), jnp.int32),
-                pltpu.SMEM((1,), jnp.int32),
-                pltpu.SemaphoreType.DMA((4,)),
-                pltpu.SemaphoreType.DMA((C * stride * 3,)),
-            ],
-            compiler_params=compat.pallas_tpu_compiler_params(
-                vmem_limit_bytes=96 * 1024 * 1024
-            ),
+            scratch_shapes=scratch,
+            compiler_params=compat.pallas_tpu_compiler_params(**params),
             interpret=interpret,
         )(
-            jnp.stack([jnp.int32(row0), jnp.int32(start), jnp.int32(cap)]),
+            jnp.stack([jnp.int32(row0), jnp.int32(start), jnp.int32(cap),
+                       jnp.int32(dev)]),
             keys,
             s, w, t, c,
         )
-        meta = outs[11]
+        meta = outs[8]
         parity = meta[1]
 
         def sel(a, b):
@@ -654,70 +786,122 @@ def make_pushsum_stencil_hbm_shard_chunk(
             )
 
         mid_state = tuple(sel(outs[i], outs[4 + i]) for i in range(4))
-        return mid_state, meta[0], outs[12]
+        return mid_state, meta[0], outs[9]
 
-    return chunk_fn, rows_ext
+    return chunk_fn, in_rows
 
 
 def make_gossip_stencil_hbm_shard_chunk(
     topo: Topology, cfg: SimConfig, H: int, rows_loc: int, PT: int,
-    layout, *, interpret: bool = False
+    layout, *, dma: bool = False, interpret: bool = False
 ):
-    """Gossip analog: one marked-displacement delivery plane; receiver-side
-    suppression on the streamed conv tile; ``u[k]`` is round k's
-    middle-region converged count (-1 when not executed)."""
+    """Gossip analog of the one-sweep port: windows read the raw ACTIVE
+    plane and the regenerated marked plane gates per-class counting
+    (ops/fused_stencil_hbm._window_counted); receiver-side suppression on
+    the streamed conv tile; ``u[k]`` is round k's middle-region converged
+    count (-1 when not executed)."""
     R_glob = layout.rows
     N = layout.n
-    n_pad = layout.n_pad
-    Z = n_pad - N
     rows_ext = rows_loc + 2 * H
     T = rows_ext // PT
-    M = PT + 16
+    n_dev = R_glob // rows_loc
+    classes, groups, M, _blend = _shard_delivery_plan(
+        topo, layout, rows_ext, PT
+    )
+    G = len(groups)
+    mt = -(-M // PT)
     dirs_builder, wrap = _lattice_params(topo)
-    blend = wrap and Z != 0
-    windows = _class_windows(topo, layout, rows_ext)
-    C = len(windows)
-    stride = 2 if blend else 1
+    S = max(
+        abs(sq) for _d, reads in classes for _gi, _e, sq, _t1 in reads
+    )
+    b_lo, b_hi = _boundary_split(H, PT, T, S)
+    n_int = T - b_lo - b_hi
     rumor_target = np.int32(cfg.resolved_rumor_target)
     suppress = cfg.resolved_suppress
+    in_rows = rows_loc if dma else rows_ext
 
-    def kernel(
-        scal_ref, keys_ref, n_in, a_in, c_in,
-        nA, aA, cA, nB, aB, cB, dm_p, meta_o, u_o,
-        scr_n, scr_a, scr_c, scr_m, win_m, flags, sems, wsems,
-    ):
+    def kernel(*refs):
+        (scal_ref, keys_ref, n_in, a_in, c_in,
+         nA, aA, cA, nB, aB, cB, meta_o, u_o) = refs[:13]
+        scratch = refs[13:]
+        win_a = scratch[0:G]
+        mk = scratch[G:2 * G]
+        (scr_n, scr_a, scr_c, flags, sems, wsems) = scratch[2 * G:2 * G + 6]
+        dma_sems = scratch[2 * G + 6:]
         k = pl.program_id(0)
         K = pl.num_programs(0)
         row_l = lax.broadcasted_iota(jnp.int32, (PT, LANES), 0)
         lane = lax.broadcasted_iota(jnp.int32, (PT, LANES), 1)
         row0 = scal_ref[0]
+        dev = scal_ref[3]
+        if dma:
+            ssems, rsems = dma_sems
+            left = lax.rem(dev + jnp.int32(n_dev - 1), jnp.int32(n_dev))
+            right = lax.rem(dev + jnp.int32(1), jnp.int32(n_dev))
 
         def tile_globals(r0):
             grow = lax.rem(row0 + r0 + row_l, jnp.int32(R_glob))
             gflat = grow * LANES + lane
             return grow, gflat
 
+        def rdmas():
+            return _halo_rdmas(
+                (n_in, a_in, c_in), (nA, aA, cA),
+                H, rows_loc, ssems, rsems, left, right,
+            )
+
+        def drain_halo():
+            for cp in rdmas():
+                cp.wait()
+            _copy_all([
+                (aA.at[pl.ds(0, M), :], aA.at[pl.ds(rows_ext, M), :]),
+            ], sems)
+
         @pl.when(k == 0)
         def _init():
-            def cp(t, _):
-                r0 = t * PT
+            if dma:
+                _neighbor_barrier(left, right)
+                for cp in rdmas():
+                    cp.start()
                 _copy_all([
-                    (n_in.at[pl.ds(r0, PT), :], scr_n),
-                    (a_in.at[pl.ds(r0, PT), :], scr_a),
-                    (c_in.at[pl.ds(r0, PT), :], scr_c),
+                    (n_in, nA.at[pl.ds(H, rows_loc), :]),
+                    (a_in, aA.at[pl.ds(H, rows_loc), :]),
+                    (c_in, cA.at[pl.ds(H, rows_loc), :]),
                 ], sems)
-                _copy_all([
-                    (scr_n, nA.at[pl.ds(r0, PT), :]),
-                    (scr_a, aA.at[pl.ds(r0, PT), :]),
-                    (scr_c, cA.at[pl.ds(r0, PT), :]),
-                ], sems)
-                return 0
+            else:
+                def cp(t, _):
+                    r0 = t * PT
+                    _copy_all([
+                        (n_in.at[pl.ds(r0, PT), :], scr_n),
+                        (a_in.at[pl.ds(r0, PT), :], scr_a),
+                        (c_in.at[pl.ds(r0, PT), :], scr_c),
+                    ], sems)
+                    _copy_all([
+                        (scr_n, nA.at[pl.ds(r0, PT), :]),
+                        (scr_a, aA.at[pl.ds(r0, PT), :]),
+                        (scr_c, cA.at[pl.ds(r0, PT), :]),
+                    ], sems)
+                    for i in range(mt):
+                        rows_i = min(PT, M - i * PT)
 
-            lax.fori_loop(0, T, cp, 0, unroll=False)
+                        @pl.when(t == i)
+                        def _m(i=i, rows_i=rows_i):
+                            _copy_all([
+                                (scr_a.at[pl.ds(0, rows_i), :],
+                                 aA.at[pl.ds(rows_ext + i * PT, rows_i), :]),
+                            ], sems)
+                    return 0
+
+                lax.fori_loop(0, T, cp, 0, unroll=False)
             flags[0] = jnp.int32(0)
 
         u_o[k] = jnp.int32(-1)
         active = scal_ref[1] + k < scal_ref[2]
+
+        if dma:
+            @pl.when((k == 0) & ~active)
+            def _drain_idle():
+                drain_halo()
 
         def round_body(cur, nxt):
             (n_c, a_c, c_c) = cur
@@ -726,80 +910,59 @@ def make_gossip_stencil_hbm_shard_chunk(
             k1 = keys_ref[kk, 0]
             k2 = keys_ref[kk, 1]
 
-            def p1(t, _):
-                r0 = t * PT
-                _copy_all([(a_c.at[pl.ds(r0, PT), :], scr_a)], sems)
-                grow, gflat = tile_globals(r0)
-                padm = gflat >= N
-                bits = threefry2x32_hash(
-                    k1, k2,
-                    grow.astype(jnp.uint32) * jnp.uint32(LANES)
-                    + lane.astype(jnp.uint32),
-                )
-                d, deg_t = _sample_disp_dirs(bits, dirs_builder(gflat))
-                sending = (scr_a[:] != 0) & (deg_t > 0) & ~padm
-                scr_m[:] = jnp.where(sending, d, jnp.int32(-1))
-                _copy_all([(scr_m, dm_p.at[pl.ds(r0, PT), :])], sems)
-
-                @pl.when(t == 0)
-                def _mirror0():
-                    _copy_all(
-                        [(scr_m, dm_p.at[pl.ds(rows_ext, PT), :])], sems
-                    )
-
-                @pl.when(t == 1)
-                def _mirror1():
-                    _copy_all([
-                        (scr_m.at[pl.ds(0, 16), :],
-                         dm_p.at[pl.ds(rows_ext + PT, 16), :]),
-                    ], sems)
-
-                return 0
-
-            lax.fori_loop(0, T, p1, 0, unroll=False)
-
-            def p2(t, acc):
+            def tile(t, acc):
                 r0 = t * PT
                 _copy_all([
                     (n_c.at[pl.ds(r0, PT), :], scr_n),
                     (a_c.at[pl.ds(r0, PT), :], scr_a),
                     (c_c.at[pl.ds(r0, PT), :], scr_c),
                 ], sems)
+                starts = _group_window_starts(groups, r0, rows_ext)
+                cps = []
+                for gi, (_ws8u, dma0, _live) in enumerate(starts):
+                    m = groups[gi][1]
+                    cp = pltpu.make_async_copy(
+                        a_c.at[pl.ds(dma0, m), :], win_a[gi],
+                        wsems.at[gi],
+                    )
+                    cp.start()
+                    cps.append(cp)
+                for gi, (ws8u, _dma0, _live) in enumerate(starts):
+                    _regen_marked_plane(
+                        mk[gi], groups[gi][1], ws8u, k1, k2, R_glob, N,
+                        dirs_builder, wrap, ring_rows=rows_ext, row0=row0,
+                    )
+                for cp in cps:
+                    cp.wait()
                 _, gflat = tile_globals(r0)
                 padm = gflat >= N
                 mid = (row_l + r0 >= H) & (row_l + r0 < H + rows_loc)
-
-                plans, wrap_plans, nonunis, cps = _start_class_volley(
-                    windows, r0, row0, [(dm_p, win_m)],
-                    wsems, stride, R_glob, n_pad, PT, M, rows_ext,
-                )
-                for cp in cps:
-                    cp.wait()
-
                 inbox = jnp.zeros((PT, LANES), jnp.int32)
-                for ci, (d_c, e1, e2) in enumerate(windows):
-                    rl, off = plans[ci]
-                    s1 = ci * stride
-                    g = _window_marked(
-                        win_m.at[s1], off, PT, rl, lane, interpret
-                    )
-                    if e2 is not None:
-                        rl2, off2 = wrap_plans[ci]
-                        g = jnp.where(
-                            nonunis[ci] & (gflat < d_c),
-                            _window_marked(win_m.at[s1 + 1], off2, PT, rl2,
-                                           lane, interpret),
-                            g,
+                for d_c, reads in classes:
+                    g = None
+                    for gi, e, sq, _take1 in reads:
+                        ws8u = starts[gi][0]
+                        off = jnp.asarray(
+                            r0 - sq - 1 + 2 * rows_ext, jnp.int32
+                        ) - ws8u
+                        rl = e % LANES
+                        v = _window_counted(
+                            win_a[gi], mk[gi], off, PT, rl, d_c, lane,
+                            interpret,
                         )
-                    inbox = inbox + jnp.where(
-                        g == d_c, jnp.int32(1), jnp.int32(0)
-                    )
+                        if g is None:
+                            g = v
+                        else:
+                            # second read is the wrap (take1=False) side.
+                            g = jnp.where(gflat >= d_c, g, v)
+                    inbox = inbox + g
                 inbox = jnp.where(padm, jnp.int32(0), inbox)
                 if suppress:
                     inbox = jnp.where(scr_c[:] != 0, jnp.int32(0), inbox)
                 count_new = scr_n[:] + inbox
                 active_new = jnp.where(
-                    (scr_a[:] != 0) | (inbox > 0), jnp.int32(1), jnp.int32(0)
+                    (scr_a[:] != 0) | (inbox > 0), jnp.int32(1),
+                    jnp.int32(0),
                 )
                 conv_new = jnp.where(
                     (count_new >= rumor_target) & ~padm,
@@ -813,11 +976,31 @@ def make_gossip_stencil_hbm_shard_chunk(
                     (scr_a, a_n.at[pl.ds(r0, PT), :]),
                     (scr_c, c_n.at[pl.ds(r0, PT), :]),
                 ], sems)
+                for i in range(mt):
+                    rows_i = min(PT, M - i * PT)
+
+                    @pl.when(t == i)
+                    def _m(i=i, rows_i=rows_i):
+                        _copy_all([
+                            (scr_a.at[pl.ds(0, rows_i), :],
+                             a_n.at[pl.ds(rows_ext + i * PT, rows_i), :]),
+                        ], sems)
                 return acc + jnp.sum(
                     jnp.where(mid, conv_new, jnp.int32(0)), dtype=jnp.int32
                 )
 
-            total = lax.fori_loop(0, T, p2, jnp.int32(0), unroll=False)
+            def step(u, acc):
+                if dma:
+                    t = _visit_tile(u, T, b_lo, b_hi)
+
+                    @pl.when((k == 0) & (u == n_int))
+                    def _wait_halo():
+                        drain_halo()
+                else:
+                    t = u
+                return tile(t, acc)
+
+            total = lax.fori_loop(0, T, step, jnp.int32(0), unroll=False)
             flags[0] = flags[0] + 1
             u_o[k] = total
 
@@ -838,17 +1021,36 @@ def make_gossip_stencil_hbm_shard_chunk(
             meta_o[0] = flags[0]
             meta_o[1] = flags[0] % 2
 
-    def chunk_fn(ext_state, keys, row0, start, cap):
-        cnt, act, cv = ext_state
+    def chunk_fn(state, keys, row0, dev, start, cap):
+        cnt, act, cv = state
         cap, keys = clamp_cap_and_pad(start, cap, keys)
         K = keys.shape[0]
         i32 = jax.ShapeDtypeStruct((rows_ext, LANES), jnp.int32)
         i32m = jax.ShapeDtypeStruct((rows_ext + M, LANES), jnp.int32)
+        scratch = (
+            [pltpu.VMEM((m, LANES), jnp.int32) for _, m, _l in groups]
+            + [pltpu.VMEM((m, LANES), jnp.int32) for _, m, _l in groups]
+            + [
+                pltpu.VMEM((PT, LANES), jnp.int32),
+                pltpu.VMEM((PT, LANES), jnp.int32),
+                pltpu.VMEM((PT, LANES), jnp.int32),
+                pltpu.SMEM((1,), jnp.int32),
+                pltpu.SemaphoreType.DMA((3,)),
+                pltpu.SemaphoreType.DMA((G,)),
+            ]
+        )
+        params = dict(vmem_limit_bytes=96 * 1024 * 1024)
+        if dma:
+            scratch += [
+                pltpu.SemaphoreType.DMA((6,)),
+                pltpu.SemaphoreType.DMA((6,)),
+            ]
+            params["collective_id"] = 0
         outs = pl.pallas_call(
             kernel,
             grid=(K,),
             out_shape=(
-                i32, i32, i32, i32, i32, i32, i32m,
+                i32, i32m, i32, i32, i32m, i32,
                 jax.ShapeDtypeStruct((2,), jnp.int32),
                 jax.ShapeDtypeStruct((K,), jnp.int32),
             ),
@@ -858,29 +1060,19 @@ def make_gossip_stencil_hbm_shard_chunk(
                              memory_space=pltpu.SMEM),
             ] + [pl.BlockSpec(memory_space=pl.ANY)] * 3,
             out_specs=tuple(
-                [pl.BlockSpec(memory_space=pl.ANY)] * 7
+                [pl.BlockSpec(memory_space=pl.ANY)] * 6
                 + [pl.BlockSpec(memory_space=pltpu.SMEM)] * 2
             ),
-            scratch_shapes=[
-                pltpu.VMEM((PT, LANES), jnp.int32),
-                pltpu.VMEM((PT, LANES), jnp.int32),
-                pltpu.VMEM((PT, LANES), jnp.int32),
-                pltpu.VMEM((PT, LANES), jnp.int32),
-                pltpu.VMEM((C * stride, M, LANES), jnp.int32),
-                pltpu.SMEM((1,), jnp.int32),
-                pltpu.SemaphoreType.DMA((3,)),
-                pltpu.SemaphoreType.DMA((C * stride,)),
-            ],
-            compiler_params=compat.pallas_tpu_compiler_params(
-                vmem_limit_bytes=96 * 1024 * 1024
-            ),
+            scratch_shapes=scratch,
+            compiler_params=compat.pallas_tpu_compiler_params(**params),
             interpret=interpret,
         )(
-            jnp.stack([jnp.int32(row0), jnp.int32(start), jnp.int32(cap)]),
+            jnp.stack([jnp.int32(row0), jnp.int32(start), jnp.int32(cap),
+                       jnp.int32(dev)]),
             keys,
             cnt, act, cv,
         )
-        meta = outs[7]
+        meta = outs[6]
         parity = meta[1]
 
         def sel(a, b):
@@ -889,9 +1081,9 @@ def make_gossip_stencil_hbm_shard_chunk(
             )
 
         mid_state = tuple(sel(outs[i], outs[3 + i]) for i in range(3))
-        return mid_state, meta[0], outs[8]
+        return mid_state, meta[0], outs[7]
 
-    return chunk_fn, rows_ext
+    return chunk_fn, in_rows
 
 
 def run_stencil_hbm_sharded(
@@ -922,10 +1114,18 @@ def run_stencil_hbm_sharded(
     super-step's kernel. Off = the serial schedule; both are
     bitwise-identical (pure scheduling). termination='global' keeps the
     serial loop (its verdict can demand a capped chunk rerun) but still
-    rides the batched wires. ``probe(chunk_sharded, args)``, when given,
-    receives the jitted chunk program and example arguments and its return
-    value replaces the run (benchmarks/comm_audit.py's trace hook — no
-    execution happens)."""
+    rides the batched wires.
+
+    cfg.halo_dma (default auto) selects the halo TRANSPORT
+    (parallel/halo.resolve_halo_transport): on TPU the exchange moves
+    INTO the kernel as async-remote-copy neighbor DMA and the XLA-side
+    exchange degenerates to the identity (zero XLA collectives on the
+    halo path — benchmarks/comm_audit.py pins it); CPU/interpret backends
+    keep the batched-ppermute wire. Bitwise transport-invariant.
+
+    ``probe(chunk_sharded, args)``, when given, receives the jitted chunk
+    program and example arguments and its return value replaces the run
+    (benchmarks/comm_audit.py's trace hook — no execution happens)."""
     import time
 
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -952,7 +1152,13 @@ def run_stencil_hbm_sharded(
     _check_dtype(cfg)
     if key is None:
         key = jax.random.PRNGKey(cfg.seed)
-    interpret = jax.default_backend() != "tpu"
+    backend = jax.default_backend()
+    transport = halo_mod.resolve_halo_transport(cfg, backend)
+    dma = transport == "dma"
+    # The remote-copy kernel never runs under the Pallas interpreter (no
+    # inter-device DMA engine there): on TPU it compiles, elsewhere it can
+    # only be TRACED (the comm-audit probe) — execution is gated below.
+    interpret = backend != "tpu" and not dma
     pushsum = cfg.algorithm == "push-sum"
     global_term = cfg.termination == "global"
     make = (
@@ -960,8 +1166,8 @@ def run_stencil_hbm_sharded(
         if pushsum
         else make_gossip_stencil_hbm_shard_chunk
     )
-    chunk_fn, rows_ext = make(
-        topo, cfg, H, rows_loc, PT, layout, interpret=interpret
+    chunk_fn, _in_rows = make(
+        topo, cfg, H, rows_loc, PT, layout, dma=dma, interpret=interpret
     )
     R_glob = layout.rows
     n = topo.n
@@ -1005,9 +1211,14 @@ def run_stencil_hbm_sharded(
     overlap = cfg.overlap_collectives
 
     def exchange(planes):
-        """Halo-extend the mid planes: the batched wire (one ppermute pair
-        for all planes, parallel/halo.py) under the overlap schedule, one
-        pair per plane on the serial one — identical received bytes."""
+        """Halo-extend the mid planes — or, under in-kernel DMA, hand the
+        halo slot to the kernel: the exchange is the identity and the
+        kernel performs the neighbor copies itself (zero XLA collectives
+        on the halo path). The XLA wire is the batched single-pair volley
+        (parallel/halo.py) under the overlap schedule, one pair per plane
+        on the serial one — identical received bytes all three ways."""
+        if dma:
+            return planes
         if overlap:
             return halo_mod.exchange_rows_batched(
                 planes, H, NODE_AXIS, n_dev
@@ -1036,7 +1247,9 @@ def run_stencil_hbm_sharded(
             # retired double-buffer copy — rounds stay exact.
             def compute(ext_state, rnd, cap):
                 keys = round_keys(base, rnd, CR)
-                out, executed, u = chunk_fn(ext_state, keys, row0, rnd, cap)
+                out, executed, u = chunk_fn(
+                    ext_state, keys, row0, dev, rnd, cap
+                )
                 conv_last = lax.dynamic_index_in_dim(
                     u, jnp.maximum(executed - 1, 0), keepdims=False
                 )
@@ -1057,10 +1270,12 @@ def run_stencil_hbm_sharded(
             planes, rnd, _ = c
             ext_state = exchange(planes)
             keys = round_keys(base, rnd, CR)
-            out, executed, u = chunk_fn(ext_state, keys, row0, rnd, round_end)
+            out, executed, u = chunk_fn(
+                ext_state, keys, row0, dev, rnd, round_end
+            )
             if pushsum and global_term:
                 def run_capped(cap):
-                    return chunk_fn(ext_state, keys, row0, rnd, cap)[0]
+                    return chunk_fn(ext_state, keys, row0, dev, rnd, cap)[0]
 
                 return global_verdict_step(
                     run_capped, out, executed, u, rnd, rows_loc, n,
@@ -1112,6 +1327,15 @@ def run_stencil_hbm_sharded(
             rep_put(np.int32(min(start_round + CR, cfg.max_rounds))),
             kd_dev,
         ))
+
+    if dma and backend != "tpu":
+        raise ValueError(
+            "halo_dma='on' builds the in-kernel async-remote-copy halo "
+            "program, which only EXECUTES on TPU backends (the Pallas "
+            "interpreter has no inter-device DMA); use halo_dma='auto' "
+            "for the batched-ppermute wire here, or trace the DMA program "
+            "hardware-free through the probe hook (benchmarks/comm_audit)"
+        )
 
     t0 = time.perf_counter()
     warm = chunk_sharded(

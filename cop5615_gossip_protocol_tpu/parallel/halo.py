@@ -129,6 +129,33 @@ def plan_halo(topo: Topology, n_dev: int) -> HaloPlan | None:
     )
 
 
+def resolve_halo_transport(cfg, backend: str | None = None) -> str:
+    """Capability check for the halo wire of the HBM-streaming x sharded
+    composition: ``"dma"`` = in-kernel ``pltpu.make_async_remote_copy``
+    neighbor DMA (zero XLA collectives on the halo path), ``"ppermute"`` =
+    the batched XLA wire (``exchange_rows_batched`` / per-plane ppermutes).
+
+    ``cfg.halo_dma``: "auto" selects per backend — DMA on TPU, where the
+    Mosaic remote-copy path exists; the XLA wire on CPU/interpret backends,
+    where Pallas remote DMA cannot execute (the interpreter has no
+    inter-device DMA engine). "on" forces the DMA program (execution needs
+    a TPU; CPU callers may still TRACE it — benchmarks/comm_audit.py's
+    probe hook audits the DMA kernel hardware-free this way). "off" pins
+    the XLA wire everywhere. Both transports deliver identical halo bytes
+    into identical kernel operands, so trajectories are bitwise
+    transport-invariant."""
+    mode = getattr(cfg, "halo_dma", "auto")
+    if mode == "off":
+        return "ppermute"
+    if mode == "on":
+        return "dma"
+    if backend is None:
+        import jax
+
+        backend = jax.default_backend()
+    return "dma" if backend == "tpu" else "ppermute"
+
+
 def _ring_perm(n_dev: int, step: int) -> list[tuple[int, int]]:
     return [(k, (k + step) % n_dev) for k in range(n_dev)]
 

@@ -43,16 +43,23 @@ single-device engines (tests/test_overlap.py pins the loop against the
 serial schedule; the existing parity suites pin the compositions against
 the single-device engines with the overlap schedule ON).
 
-A note on tile order: the ideal schedule would also start the halo wires
-as soon as the kernel's BOUNDARY tiles retire (interior-first tile order,
-so only the next super-step's boundary tiles wait on the in-flight halo).
-At the XLA graph boundary a `pallas_call` is one atomic op — a consumer
-cannot observe partial outputs — so within-kernel tile reordering cannot
-release the wires early; issuing the batched exchange ADJACENT to the
-kernel output (this module) is the implementable form of that idea, and
-moving the wires into the kernels themselves (Pallas remote DMA between
-boundary tiles) is the documented next step if the on-chip ratio still
-shows wire latency after this schedule.
+A note on tile order: the ideal schedule also overlaps the halo wire with
+the kernel's INTERIOR tiles (interior-first tile order, so only the
+boundary tiles wait on the in-flight halo). At the XLA graph boundary a
+`pallas_call` is one atomic op — a consumer cannot observe partial
+outputs — so within-kernel tile reordering cannot release an XLA wire
+early; issuing the batched exchange ADJACENT to the kernel output (this
+module) is the implementable form of that idea for the XLA transport.
+ISSUE 9 lands the full form for the HBM-streaming composition: the wires
+move INTO the kernel as `pltpu.make_async_remote_copy` neighbor DMA
+(parallel/fused_hbm_sharded.py, cfg.halo_dma), the super-step schedule
+hands the halo slot to the kernel — ``exchange`` degenerates to the
+identity below, the kernel owns the transfer — and round 0 of each
+super-step streams its interior tiles in _visit_order while the neighbor
+copies are in flight, waiting only before the first boundary tile. Zero
+XLA collectives remain on that halo path (benchmarks/comm_audit.py pins
+the mechanism per composition); the deferred verdict psum of this module
+is unchanged and still rides under the next super-step's kernel.
 
 Cost: one speculative super-step of kernel work is wasted per converged
 run; the carry holds one extra copy of the mid planes; and each DISPATCH

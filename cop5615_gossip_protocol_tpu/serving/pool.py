@@ -51,7 +51,12 @@ class WarmEnginePool:
             raise ValueError(f"pool capacity must be >= 1, got {capacity}")
         self.capacity = capacity
         self._entries: collections.OrderedDict = collections.OrderedDict()
-        self._lock = threading.Lock()
+        # Reentrant, defensively: a builder (or a jitted program whose
+        # first trace runs under a pool entry) that consults the pool
+        # again must not wedge the executor thread against itself — under
+        # a plain Lock that nesting is a silent deadlock, not an error.
+        # Cross-thread builds stay serialized exactly as before.
+        self._lock = threading.RLock()
         self.hits = 0
         self.misses = 0
         self.evictions = 0

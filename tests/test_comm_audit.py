@@ -76,7 +76,9 @@ def test_fused_sharded_batched_wire_counts():
 
 def test_hbm_sharded_batched_wire_counts():
     # The 2.30x offender (ISSUE 5): the HBM-streaming composition's
-    # super-step must issue exactly ONE batched ppermute pair.
+    # super-step must issue exactly ONE batched ppermute pair on the
+    # XLA-wire fallback path (halo_dma resolves to 'ppermute' on CPU —
+    # these counts ARE the fallback-path pins).
     cfg = {"engine": "fused", "chunk_rounds": 8}
     on = audit_engine(
         "hbm-sharded", "torus3d", "push-sum", 125000, 2, True, cfg
@@ -84,11 +86,46 @@ def test_hbm_sharded_batched_wire_counts():
     off = audit_engine(
         "hbm-sharded", "torus3d", "push-sum", 125000, 2, False, cfg
     )
+    assert on.halo_mechanism() == off.halo_mechanism() == "xla-ppermute"
     assert on.body_count("ppermute") == 2, on.counts
     assert off.body_count("ppermute") == 8, off.counts
+    assert on.body_count("remote_dma") == off.body_count("remote_dma") == 0
     assert on.body_count("psum") == off.body_count("psum") == 1
     assert on.setup_count("ppermute") == 2  # pre-loop exchange only
     assert on.setup_count("psum") == 1  # the drain
+
+
+def test_hbm_sharded_inkernel_dma_zero_xla_halo_collectives():
+    # ISSUE 9 tentpole pin: with halo_dma='on' the halo wire moves INTO
+    # the Pallas kernel — the traced program carries ZERO XLA collectives
+    # on the halo path (the one remaining psum is the deferred termination
+    # verdict), one async remote copy per state plane per ring direction,
+    # and the remote copies ship EXACTLY the bytes the batched ppermute
+    # wire shipped (same payload, different transport). The probe hook
+    # traces the DMA program hardware-free, so this pins the TPU path's
+    # comm structure on CPU CI.
+    base = {"engine": "fused", "chunk_rounds": 8}
+    for algo, n_planes in (("gossip", 3), ("push-sum", 4)):
+        wire = audit_engine(
+            "hbm-sharded", "torus3d", algo, 125000, 2, True, base
+        )
+        dma = audit_engine(
+            "hbm-sharded", "torus3d", algo, 125000, 2, True,
+            {**base, "halo_dma": "on"},
+        )
+        assert dma.halo_mechanism() == "in-kernel-dma"
+        assert dma.body_count("ppermute") == 0, dma.counts
+        assert dma.setup_count("ppermute") == 0, dma.counts
+        assert dma.body_count("all_gather") == 0
+        assert dma.body_count("reduce_scatter") == 0
+        # One copy per plane per ring direction, fired at super-step entry.
+        assert dma.body_count("remote_dma") == 2 * n_planes, dma.counts
+        # Same halo payload as the XLA wire — transport changes, bytes
+        # do not.
+        assert dma.body_bytes("remote_dma") == wire.body_bytes("ppermute")
+        # Termination verdict: one deferred psum in the body + the drain.
+        assert dma.body_count("psum") == 1
+        assert dma.setup_count("psum") == 1
 
 
 def test_fused_pool_sharded_batched_gather_counts():

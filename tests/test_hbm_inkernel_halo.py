@@ -1,0 +1,223 @@
+"""Tier-1 unit pins for the in-kernel halo delivery of the HBM-streaming
+x sharded composition (ISSUE 9): interior-first tile ordering, the
+boundary-tile split, the one-sweep delivery plan over the extended ring,
+and the DMA/fallback capability selection — all host-side or trace-level,
+no Pallas execution (the interpret-mode parity oracles live in
+tests/test_fused_hbm_sharded.py, slow-marked).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cop5615_gossip_protocol_tpu import SimConfig, build_topology
+from cop5615_gossip_protocol_tpu.ops.fused_pool import build_pool_layout
+from cop5615_gossip_protocol_tpu.parallel import halo
+from cop5615_gossip_protocol_tpu.parallel.fused_hbm_sharded import (
+    _boundary_split,
+    _class_sigmas,
+    _halo_width_slots,
+    _shard_delivery_plan,
+    _visit_order,
+    _visit_tile,
+    run_stencil_hbm_sharded,
+)
+from cop5615_gossip_protocol_tpu.parallel.mesh import make_mesh
+
+
+def test_visit_order_is_interior_first_permutation():
+    for T, b_lo, b_hi in [(8, 1, 1), (8, 2, 3), (4, 1, 2), (4, 2, 2),
+                          (16, 3, 1), (2, 1, 1)]:
+        order = _visit_order(T, b_lo, b_hi)
+        assert sorted(order) == list(range(T)), (T, b_lo, b_hi)
+        n_int = T - b_lo - b_hi
+        interior = set(range(b_lo, T - b_hi))
+        boundary = set(range(b_lo)) | set(range(T - b_hi, T))
+        assert set(order[:n_int]) == interior
+        assert set(order[n_int:]) == boundary
+        # Every interior tile streams BEFORE any boundary tile — the halo
+        # drain can sit at position n_int and cover exactly the tiles
+        # that can read halo/mirror rows.
+        assert all(t in interior for t in order[:n_int])
+
+
+def test_visit_tile_matches_visit_order_traced():
+    for T, b_lo, b_hi in [(8, 1, 1), (8, 2, 3), (4, 2, 2), (16, 3, 1)]:
+        want = _visit_order(T, b_lo, b_hi)
+        got = [
+            int(_visit_tile(jnp.int32(u), T, b_lo, b_hi)) for u in range(T)
+        ]
+        assert got == want, (T, b_lo, b_hi)
+
+
+def test_boundary_split_covers_halo_and_mirror_reads():
+    for H, PT, T, S in [(128, 256, 8, 21), (192, 512, 4, 21),
+                        (96, 2048, 2, 3), (1024, 512, 16, 40),
+                        (4096, 256, 8, 10)]:
+        b_lo, b_hi = _boundary_split(H, PT, T, S)
+        assert 1 <= b_lo <= T
+        assert 0 <= b_hi <= T - b_lo
+        # Tiles below b_lo / above T - b_hi are the only ones whose reads
+        # (own tile +/- the window reach S with alignment slack) can touch
+        # the H halo rows at either end — unless the whole shard is
+        # boundary (b_lo + b_hi == T).
+        if b_lo + b_hi < T:
+            assert b_lo * PT >= H + S + 16
+            assert b_hi * PT >= H + S + 24
+
+
+def test_shard_delivery_plan_torus_collapses_to_one_group():
+    # torus3d at the interpret-suite population: 10 offset classes, the
+    # Z > 0 blend live — over the extended ring BOTH blend variants'
+    # window shifts are within the halo width, so the one-sweep plan
+    # collapses every need into ONE group window (one fetch + one regen
+    # per tile, the single-device engine's economy carried across shards).
+    topo = build_topology("torus3d", 125000)
+    layout = build_pool_layout(topo.n)
+    rows_ext = 512 + 2 * 128
+    classes, groups, M, blend = _shard_delivery_plan(
+        topo, layout, rows_ext, 256
+    )
+    assert blend
+    assert len(groups) == 1, groups
+    assert M == groups[0][1]
+    # Every wrap class carries the two-variant blend pair; reads point at
+    # the single group.
+    for d, reads in classes:
+        assert len(reads) == 2
+        assert all(gi == 0 for gi, _e, _sq, _t1 in reads)
+    # The group margin covers each read's offset: off <= span + 7 and the
+    # off+1 window of PT rows stays inside m_rows.
+    sqs = [sq for _d, reads in classes for _gi, _e, sq, _t1 in reads]
+    span = max(sqs) - min(sqs)
+    assert groups[0][1] >= 256 + span + 16
+    # The plan's widest shift agrees with the halo-width home
+    # (_class_sigmas) — the two can never drift.
+    assert max(abs(s) for s in sqs) <= -(-_halo_width_slots(topo, layout)
+                                         // 128) + 1
+
+
+def test_shard_delivery_plan_nonwrap_single_windows():
+    topo = build_topology("grid2d", 131044)
+    layout = build_pool_layout(topo.n)
+    classes, groups, _M, blend = _shard_delivery_plan(
+        topo, layout, 512 + 2 * 128, 256
+    )
+    assert not blend
+    for _d, reads in classes:
+        assert len(reads) == 1
+        assert reads[0][3] is None  # take1: single-window classes
+
+
+def test_resolve_halo_transport_capability_matrix():
+    auto = SimConfig(n=1000, topology="ring")
+    assert auto.halo_dma == "auto"
+    assert halo.resolve_halo_transport(auto, "cpu") == "ppermute"
+    assert halo.resolve_halo_transport(auto, "tpu") == "dma"
+    on = SimConfig(n=1000, topology="ring", halo_dma="on")
+    assert halo.resolve_halo_transport(on, "cpu") == "dma"
+    off = SimConfig(n=1000, topology="ring", halo_dma="off")
+    assert halo.resolve_halo_transport(off, "tpu") == "ppermute"
+
+
+def test_halo_dma_validated_at_config_time():
+    with pytest.raises(ValueError, match="halo_dma"):
+        SimConfig(n=1000, topology="ring", halo_dma="bogus")
+
+
+def test_halo_dma_forced_on_cpu_fails_loudly_at_execution():
+    # halo_dma='on' builds the remote-copy kernel, which cannot EXECUTE
+    # off-TPU; the run must refuse with a pointer at auto/probe instead of
+    # dying inside Mosaic. (The probe hook on the same config is the legal
+    # CPU use — tests/test_comm_audit.py exercises it.)
+    n = 65536
+    topo = build_topology("ring", n)
+    cfg = SimConfig(n=n, topology="ring", algorithm="gossip",
+                    engine="fused", n_devices=2, chunk_rounds=1,
+                    max_rounds=8, halo_dma="on")
+    with pytest.raises(ValueError, match="TPU"):
+        run_stencil_hbm_sharded(topo, cfg, mesh=make_mesh(2))
+
+
+def test_halo_dma_probe_traces_on_cpu():
+    # The capability gate must NOT block the trace-only probe path — the
+    # comm audit's hardware-free DMA audit depends on it.
+    n = 65536
+    topo = build_topology("ring", n)
+    cfg = SimConfig(n=n, topology="ring", algorithm="gossip",
+                    engine="fused", n_devices=2, chunk_rounds=1,
+                    max_rounds=8, halo_dma="on")
+    seen = {}
+
+    def probe(fn, args):
+        seen["jaxpr"] = jax.make_jaxpr(fn)(*args)
+        return "probed"
+
+    assert run_stencil_hbm_sharded(
+        topo, cfg, mesh=make_mesh(2), probe=probe
+    ) == "probed"
+    assert "ppermute" not in str(seen["jaxpr"])
+
+
+def test_transport_knob_keeps_plan_geometry_identical():
+    # The plan must be invariant to BOTH scheduling knobs — a geometry
+    # (H, CR, PT) that differed across halo_dma or overlap_collectives
+    # would break super-step-granular `rounds` interchangeability.
+    from cop5615_gossip_protocol_tpu.parallel.fused_hbm_sharded import (
+        plan_stencil_hbm_sharded,
+    )
+
+    topo = build_topology("torus3d", 125000)
+    plans = []
+    for hd in ("auto", "on", "off"):
+        for ov in (True, False):
+            cfg = SimConfig(n=125000, topology="torus3d",
+                            algorithm="push-sum", engine="fused",
+                            n_devices=2, chunk_rounds=8, halo_dma=hd,
+                            overlap_collectives=ov)
+            plans.append(plan_stencil_hbm_sharded(topo, cfg, 2)[:4])
+    assert all(p == plans[0] for p in plans), plans
+
+
+def test_class_sigmas_blend_pairs_within_halo_width():
+    # The reason ONE group serves both blend variants: signed(-d) and
+    # signed(n-d) are both bounded by the halo width for every class.
+    topo = build_topology("torus3d", 125000)
+    layout = build_pool_layout(topo.n)
+    w = _halo_width_slots(topo, layout)
+    for _d, s1, s2 in _class_sigmas(topo, layout):
+        assert abs(s1) <= w
+        if s2 is not None:
+            assert abs(s2) <= w
+
+
+def test_mid_state_noop_on_converged_dispatch():
+    # Overshoot contract on the fallback transport: a dispatch at an
+    # already-converged state executes zero rounds and returns the planes
+    # bitwise (the pipelined driver relies on it). Cheap: ring layout,
+    # zero executed rounds, no Pallas round body runs.
+    from cop5615_gossip_protocol_tpu.models.gossip import GossipState
+
+    n = 65536
+    topo = build_topology("ring", n)
+    cfg = SimConfig(n=n, topology="ring", algorithm="gossip",
+                    engine="fused", n_devices=2, chunk_rounds=2,
+                    max_rounds=100)
+    counts = np.full(n, 10, np.int32)
+    done_state = GossipState(
+        count=jnp.asarray(counts),
+        active=jnp.zeros(n, bool),
+        conv=jnp.ones(n, bool),
+    )
+    grab = {}
+    r = run_stencil_hbm_sharded(
+        topo, cfg, mesh=make_mesh(2), start_state=done_state,
+        start_round=7, on_chunk=lambda rr, s: grab.update(s=s),
+    )
+    assert r.rounds == 7
+    assert r.converged
+    assert r.converged_count == n
+    if "s" in grab:
+        assert (np.asarray(grab["s"].count) == counts).all()

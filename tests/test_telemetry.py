@@ -82,8 +82,14 @@ def test_chunked_pushsum_pool_on_off_bitwise():
     )
     _assert_bitwise(res_on, res_off, b_on, b_off)
     t = res_on.telemetry
-    # Final row's MAE equals the result's (same reduction, same state).
-    assert t.data[-1][COL_MAE] == pytest.approx(res_on.estimate_mae, rel=1e-6)
+    # Final row's MAE matches the result's over the same state. The
+    # telemetry column reduces in float32 in-trace; the result diagnostic
+    # computes in float64 on the host (runner._finalize_result, ISSUE 9 —
+    # zero finalize-time XLA compiles), so at a converged MAE sitting at
+    # f32 quantization scale (~eps * true_mean per term) the two agree to
+    # f32 reduction accuracy, not bit-for-bit.
+    assert t.data[-1][COL_MAE] == pytest.approx(res_on.estimate_mae, rel=0.1)
+    assert t.data[-1][COL_MAE] > 0
     # Fault-free run conserves mass: residual stays ~0.
     assert np.abs(t.data[:, telemetry_mod.COL_MASS]).max() < 1e-2
 
