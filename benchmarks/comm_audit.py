@@ -244,6 +244,18 @@ def audit_engine(engine: str, topology: str, algorithm: str, n: int,
         )
 
         run_stencil_hbm_sharded(topo, cfg, mesh=mesh, probe=probe)
+    elif engine == "imp-hbm-sharded":
+        from cop5615_gossip_protocol_tpu.parallel.fused_imp_hbm_sharded import (
+            run_imp_hbm_sharded,
+        )
+
+        run_imp_hbm_sharded(topo, cfg, mesh=mesh, probe=probe)
+    elif engine == "pool2-sharded":
+        from cop5615_gossip_protocol_tpu.parallel.pool2_sharded import (
+            run_pool2_sharded,
+        )
+
+        run_pool2_sharded(topo, cfg, mesh=mesh, probe=probe)
     else:
         raise ValueError(f"unknown engine {engine!r}")
     return AuditReport(
@@ -285,6 +297,24 @@ AUDIT_GRID = (
      {"engine": "fused", "chunk_rounds": 8, "halo_dma": "on"}),
     ("hbm-sharded", "torus3d", "push-sum", 125000, 2,
      {"engine": "fused", "chunk_rounds": 8, "halo_dma": "on"}),
+    # imp x HBM x sharded (ISSUE 10): the lattice classes ride the halo
+    # wire (ppermute pair / in-kernel DMA), the pooled long-range classes
+    # ONE all_gather of the windowed send summaries per super-step.
+    ("imp-hbm-sharded", "imp3d", "gossip", 27000, 2,
+     {"engine": "fused", "delivery": "pool"}),
+    ("imp-hbm-sharded", "imp3d", "push-sum", 27000, 2,
+     {"engine": "fused", "delivery": "pool"}),
+    ("imp-hbm-sharded", "imp3d", "gossip", 27000, 2,
+     {"engine": "fused", "delivery": "pool", "halo_dma": "on"}),
+    ("imp-hbm-sharded", "imp3d", "push-sum", 27000, 2,
+     {"engine": "fused", "delivery": "pool", "halo_dma": "on"}),
+    # Replicated-pool2 (ISSUE 10): the full topology past one chip's HBM —
+    # the ONLY wire is the all_gather of the compact send summaries (plus
+    # the termination psum); zero ppermutes, zero stragglers.
+    ("pool2-sharded", "full", "gossip", 262144, 2,
+     {"engine": "fused", "delivery": "pool"}),
+    ("pool2-sharded", "full", "push-sum", 262144, 2,
+     {"engine": "fused", "delivery": "pool"}),
 )
 
 

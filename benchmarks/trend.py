@@ -129,6 +129,73 @@ def render(revs: dict, serving: dict) -> str:
     return "\n".join(lines)
 
 
+def render_ceilings(n_dev: int = 8) -> str:
+    """The ISSUE 10 'topology ceilings' rows, RECOMPUTED from the plan
+    functions instead of hand-typed: plan_imp_hbm_sharded_shape and
+    plan_pool2_sharded are pure in (kind, n, cfg, n_dev) — no adjacency
+    arrays, no device — so the admitted aggregate populations are
+    verifiable on any box. The ms/round cells stay 'pending' until an
+    on-chip regen (the BENCH_TABLES protocol)."""
+    sys.path.insert(0, str(REPO))
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_threefry_partitionable", True)
+    from cop5615_gossip_protocol_tpu import SimConfig
+    from cop5615_gossip_protocol_tpu.ops.topology import build_full
+    from cop5615_gossip_protocol_tpu.parallel.fused_imp_hbm_sharded import (
+        plan_imp_hbm_sharded_shape,
+    )
+    from cop5615_gossip_protocol_tpu.parallel.pool2_sharded import (
+        plan_pool2_sharded,
+    )
+
+    def cfg(n, alg):
+        return SimConfig(n=n, topology="full", algorithm=alg,
+                         engine="fused", delivery="pool", n_devices=n_dev)
+
+    rows = []
+    for alg in ("gossip", "push-sum"):
+        best = None
+        for g in range(600, 1200, 8):  # cubes bracketing 2^28..2^30
+            n = g ** 3
+            plan = plan_imp_hbm_sharded_shape(
+                "imp3d", n, cfg(n, alg), n_dev
+            )
+            if not isinstance(plan, str):
+                best = (g, n)
+        rows.append((
+            "imp × HBM × sharded", "imp3d", alg,
+            "none admitted in the swept range" if best is None else
+            f"{best[0]}³ = {best[1]:,} ({best[1] / (1 << 28):.2f} × 2^28)",
+        ))
+    for alg in ("gossip", "push-sum"):
+        hi = None
+        for p in range(27, 33):
+            n = 1 << p
+            plan = plan_pool2_sharded(build_full(n, False), cfg(n, alg),
+                                      n_dev)
+            if not isinstance(plan, str):
+                hi = p
+        rows.append((
+            "replicated-pool2", "full", alg,
+            "none admitted in the swept range" if hi is None else
+            f"2^{hi} = {1 << hi:,}",
+        ))
+    lines = [
+        f"## Topology ceilings (plan-level, {n_dev} devices — "
+        "benchmarks/trend.py --ceilings)",
+        "",
+        "| composition | topology | algorithm "
+        "| aggregate plan ceiling | ms/round on chip |",
+        "|---|---|---|---|---|",
+    ]
+    for comp, topo, alg, ceil in rows:
+        lines.append(f"| {comp} | {topo} | {alg} | {ceil} | pending |")
+    lines.append("")
+    return "\n".join(lines)
+
+
 def apply_to_bench_tables(table_md: str, bench_tables: Path) -> None:
     """Idempotently install/replace the trajectory section: everything
     from SECTION_HEADER to the next '## ' heading (or EOF) is replaced."""
@@ -165,6 +232,9 @@ def main(argv=None) -> int:
     ap.add_argument("--apply", action="store_true",
                     help="install/replace the 'Perf trajectory' section "
                     "in BENCH_TABLES.md (idempotent)")
+    ap.add_argument("--ceilings", action="store_true",
+                    help="append the plan-level topology-ceilings table "
+                    "(ISSUE 10), recomputed from the pure plan functions")
     args = ap.parse_args(argv)
 
     revs = load_snapshots(args.root)
@@ -198,9 +268,15 @@ def main(argv=None) -> int:
         serving[args.rev] = float(rps)
 
     table = render(revs, serving)
-    print(table)
+    # The ceilings section rides the printed/--md output only: --apply
+    # replaces BENCH_TABLES.md's trajectory section up to the next "## "
+    # heading, so appending another "## " section to its input would
+    # break the replace's idempotency (BENCH_TABLES keeps its own
+    # hand-annotated ceilings section).
+    out = table + "\n" + render_ceilings() if args.ceilings else table
+    print(out)
     if args.md:
-        args.md.write_text(table + "\n")
+        args.md.write_text(out + "\n")
     if args.apply:
         apply_to_bench_tables(table, args.root / "BENCH_TABLES.md")
         print(f"[trend] applied to {args.root / 'BENCH_TABLES.md'}",

@@ -1333,16 +1333,56 @@ def _run_resolved(
                     "override"
                 )
             if topo.implicit and cfg.delivery == "pool":
-                # Implicit-full pool composition (VERDICT r3 #1): local
-                # halve, one all_gather of the send planes per round, then
-                # the single-device pool kernel's delivery+absorb per shard
-                # — bitwise the single-device fused pool trajectory.
-                # Supports termination='global' (scalar psum verdict).
+                # Implicit-full pool compositions, tiered like the
+                # single-device engines: the VMEM replicated composition
+                # (VERDICT r3 #1 — one all_gather of the state planes per
+                # super-step, the single-device pool kernel per shard)
+                # while the population fits its kernel's residency cap,
+                # the replicated-pool2 composition past it (ROADMAP item
+                # 1 — the pool2 zero-send-plane HBM pipeline per shard,
+                # ONE all_gather of the compact windowed send summaries
+                # per round, aggregate ceiling >= 2^28). Both bitwise the
+                # engine they shard.
                 from ..parallel.fused_pool_sharded import (
+                    plan_fused_pool_sharded,
                     run_fused_pool_sharded,
                 )
+                from ..parallel.pool2_sharded import (
+                    plan_pool2_sharded,
+                    run_pool2_sharded,
+                )
 
-                return run_fused_pool_sharded(
+                plan_vmem = plan_fused_pool_sharded(topo, cfg, cfg.n_devices)
+                if not isinstance(plan_vmem, str):
+                    return run_fused_pool_sharded(
+                        topo, cfg, key=key, on_chunk=on_chunk,
+                        start_state=start_state, start_round=start_round,
+                        deadline=deadline,
+                    )
+                plan_p2 = plan_pool2_sharded(topo, cfg, cfg.n_devices)
+                if not isinstance(plan_p2, str):
+                    return run_pool2_sharded(
+                        topo, cfg, key=key, on_chunk=on_chunk,
+                        start_state=start_state, start_round=start_round,
+                        deadline=deadline,
+                    )
+                raise ValueError(
+                    f"engine='fused' with n_devices={cfg.n_devices} "
+                    f"unavailable: VMEM pool composition: {plan_vmem}; "
+                    f"replicated-pool2 composition: {plan_p2}"
+                )
+            if topo.kind in ("imp2d", "imp3d") and cfg.delivery == "pool":
+                # imp x HBM x sharded (ROADMAP item 1): lattice classes by
+                # halo windows (batched ppermute / in-kernel DMA), the
+                # pooled long-range classes from one all_gather of the
+                # windowed send summaries per round — bitwise the
+                # single-device fused_imp_hbm engine. Raises with the plan
+                # reason when the composition cannot serve the config.
+                from ..parallel.fused_imp_hbm_sharded import (
+                    run_imp_hbm_sharded,
+                )
+
+                return run_imp_hbm_sharded(
                     topo, cfg, key=key, on_chunk=on_chunk,
                     start_state=start_state, start_round=start_round,
                     deadline=deadline,

@@ -90,7 +90,12 @@ def imp_hbm_support(topo: Topology, cfg: SimConfig) -> Optional[str]:
         # ops/fused_pool2.py) run drop+crash in-kernel.
         return "failure models not supported in this fused kernel"
     if cfg.n_devices is not None and cfg.n_devices > 1:
-        return "fused engine is single-device"
+        return (
+            "this streaming engine is single-device; n_devices > 1 runs "
+            "the imp x HBM x sharded composition "
+            "(parallel/fused_imp_hbm_sharded.py — lattice halos + one "
+            "all_gather of the windowed planes per round)"
+        )
     if cfg.pool_size > 1 << POOL_CHOICE_BITS:
         return (
             f"pool_size {cfg.pool_size} exceeds the packed-choice limit "
@@ -98,8 +103,9 @@ def imp_hbm_support(topo: Topology, cfg: SimConfig) -> Optional[str]:
         )
     if topo.n > MAX_STENCIL_HBM_NODES:
         return (
-            f"population {topo.n} exceeds the HBM-plane budget "
-            f"({MAX_STENCIL_HBM_NODES} nodes)"
+            f"population {topo.n} exceeds the single-device HBM-plane "
+            f"budget ({MAX_STENCIL_HBM_NODES} nodes); n_devices > 1 "
+            "shards past it (parallel/fused_imp_hbm_sharded.py)"
         )
     return None
 

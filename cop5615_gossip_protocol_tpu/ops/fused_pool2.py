@@ -146,7 +146,12 @@ def pool2_support(topo: Topology, cfg: SimConfig) -> Optional[str]:
             "VMEM fused stencil/pool engines only"
         )
     if cfg.n_devices is not None and cfg.n_devices > 1:
-        return "fused engine is single-device"
+        return (
+            "this streaming engine is single-device; n_devices > 1 runs "
+            "the replicated-pool2 composition "
+            "(parallel/pool2_sharded.py — one all_gather of the compact "
+            "windowed send summaries per round)"
+        )
     if cfg.pool_size > 1 << POOL_CHOICE_BITS:
         return (
             f"pool_size {cfg.pool_size} exceeds the packed-choice limit "
@@ -155,7 +160,8 @@ def pool2_support(topo: Topology, cfg: SimConfig) -> Optional[str]:
     if topo.n > MAX_POOL2_NODES:
         return (
             f"population {topo.n} exceeds the HBM-plane budget "
-            f"({MAX_POOL2_NODES} nodes)"
+            f"({MAX_POOL2_NODES} nodes); n_devices > 1 shards the "
+            "aggregate past it (parallel/pool2_sharded.py)"
         )
     return None
 

@@ -253,6 +253,18 @@ def plan_stencil_hbm_sharded(topo: Topology, cfg: SimConfig, n_dev: int):
             "the halo composition; use delivery='pool' (the fused pool x "
             "sharded composition)"
         )
+    if topo.kind in ("imp2d", "imp3d"):
+        # Not "no displacement columns" — the imp kinds HAVE a full
+        # lattice; their random long-range edge is what this composition
+        # cannot halo. The imp x HBM x sharded composition serves them
+        # under pooled long-range sampling (the runner routes
+        # delivery='pool' there before consulting this plan).
+        return (
+            f"topology {topo.kind!r} carries a random long-range edge the "
+            "halo composition cannot serve; use delivery='pool' (the "
+            "imp x HBM x sharded composition, "
+            "parallel/fused_imp_hbm_sharded.py)"
+        )
     if topo.kind not in _HBM_KINDS:
         return (
             f"topology {topo.kind!r} has no arithmetic displacement "

@@ -1,0 +1,1268 @@
+"""imp2d/imp3d x HBM x sharded: the marquee kind past one chip's HBM.
+
+The reference caps Imp3D — its hardest configuration — at 2,000 actors on
+one machine's threads (report.pdf p.3 SS4). The single-device HBM tier
+(ops/fused_imp_hbm.py) streams it at 2^27 nodes on one chip, but until
+this module the imp kinds were the ONLY lattice family with no
+HBM x sharded composition (ROADMAP item 1): n_devices > 1 fell through to
+a ValueError. This module composes the imp class-id delivery under the
+one-sweep shard_map skeleton of parallel/fused_hbm_sharded.py, with the
+long-range pool classes riding the replicated-window wire of the pool
+compositions:
+
+- state planes are row-sharded ([rows_loc, 128] per device: push-sum
+  s/w/term/conv, gossip count/active/conv) and one super-step is ONE
+  round — the pooled long-range classes are uniform over the whole ring,
+  so nothing coarser admits an exact shard;
+- the LATTICE classes (the full grid2d/grid3d lattice of the honest imp
+  kinds — non-wrap, boundary live-masks, signed displacements) deliver
+  from a halo-EXTENDED buffer exactly like the stencil composition: their
+  window needs feed through the shared grouping core
+  (ops/fused_stencil_hbm._plan_from_needs) over the extended ring, so
+  neighboring classes collapse to one fetched window and one mark regen
+  per tile. The halo transport resolves through
+  parallel/halo.resolve_halo_transport: ONE batched ppermute pair per
+  super-step on CPU (per-plane pairs with --overlap-collectives off), and
+  on TPU the in-kernel `pltpu.make_async_remote_copy` neighbor DMA of the
+  stencil composition (--halo-dma; zero XLA collectives on the lattice
+  halo path, round 0 interior-first via _visit_order so the copies fly
+  under the interior tiles);
+- the POOL classes (the re-drawn long-range edge: P shared per-round
+  displacements, uniform mod n) read their windows from ONE batched
+  all_gather of the compact windowed send summaries per super-step
+  (parallel/halo.gather_rows_batched — raw s/w for push-sum, the active
+  plane for gossip, margin-extended for the kernel's 8-aligned window
+  DMAs), with the d / d+Z mod-n blend pair fetched per slot exactly like
+  the single-device engine;
+- the marked class plane NEVER exists in memory: the sampled class
+  (lattice class q in sorted-offset order, L + packed pool choice for the
+  long-range slot, -1 for non-senders) is REGENERATED inside the window
+  consumer at GLOBAL positions — threefry is position-wise, the boundary
+  live-masks arithmetic, and the packed choice words re-derive from the
+  global row (ops/fused_imp_hbm._sample_class_imp, re-based through the
+  extended ring / the gathered mirror margin) — so each output row is
+  computed from identical inputs by identical ops and trajectories are
+  BITWISE the single-device fused_imp_hbm engine's (gossip ints exactly;
+  push-sum via the power-of-two halve lemma: raw windows summed in the
+  single-device accumulation order, halved after);
+- termination composes by psum (deferred one super-step under
+  cfg.overlap_collectives, parallel/overlap.py — rounds stay exact at the
+  one-round super-step granularity); termination='global' uses the
+  device-0 metric shift of the replicated-pool2 composition and latches
+  the all-or-nothing conv plane at the fired verdict round.
+
+Per-device residency is the gathered windowed planes plus the
+halo-extended shard, so the aggregate population the plan admits is
+~2^28+ for imp3d push-sum at the 12 GB plane budget — the BENCH_TABLES
+"topology ceilings" imp row, hardware-free at plan level through
+plan_imp_hbm_sharded_shape.
+
+Reference mapping: the reference's Imp3D wiring (program.fs:295-313) and
+lattice hot loop (program.fs:89-105, 110-143), actor-per-node capped at
+~2,000 nodes — here at 2^28 nodes across a mesh.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..config import SimConfig
+from ..ops.fused import threefry2x32_hash
+from ..ops.fused_imp_hbm import _imp_dirs, _sample_class_imp
+from ..ops.fused_pool import LANES, build_pool_layout
+from ..ops.fused_pool2 import _copy_all, _win_plan
+from ..ops.fused_stencil_hbm import (
+    _centered_sq,
+    _group_window_starts,
+    _plan_from_needs,
+    _window_counted,
+    _window_vals,
+)
+from ..ops.sampling import POOL_CHOICE_BITS, POOL_PACK
+from ..ops.topology import Topology, imp_split
+from ..utils import compat
+from .fused_hbm_sharded import (
+    _HBM_PLANE_BUDGET,
+    _VMEM_SCRATCH_BUDGET,
+    _boundary_split,
+    _halo_rdmas,
+    _neighbor_barrier,
+    _visit_tile,
+)
+
+# The budget constants are the sibling composition's (imported above from
+# the ONE home, fused_hbm_sharded, so a chip-class retune cannot drift
+# the compositions' plan ceilings apart): per-device HBM for the resident
+# planes (gathered windowed copies + extended shard + overlap carry),
+# VMEM only for the PT-row streaming scratch.
+
+# The sibling compositions' tile candidates plus two small tail entries:
+# a shard here is rows_loc = R/n_dev rows and every margin must fit one
+# ring revolution (m <= rows_ext), so small test shards need tiles the
+# pool engines never shrink to. Multiples of 8 (the DMA alignment); real
+# populations always take the large end.
+_PT_CANDIDATES = (2048, 1024, 512, 256, 128, 64)
+
+
+def _imp_lattice_offsets(kind: str, n: int):
+    """Sorted mod-n lattice displacement classes of an honest imp kind —
+    arithmetic in (kind, n) alone (ops/topology.build_imp2d/_imp3d append
+    the one long-range edge AFTER the full-grid lattice columns), so the
+    shape-level plan needs no adjacency arrays. None when n is not a
+    perfect square/cube (no honest lattice exists)."""
+    if kind == "imp2d":
+        s = round(n ** 0.5)
+        if s * s != n:
+            return None
+        return sorted({n - 1, 1, n - s, s})
+    g = round(n ** (1 / 3))
+    if g * g * g != n:
+        return None
+    g2 = g * g
+    return sorted({n - 1, 1, n - g, g, n - g2, g2})
+
+
+def _imp_lat_plan(kind: str, layout, rows_ext: int, PT: int):
+    """Lattice-class window needs over the halo-extended ring, fed through
+    the shared grouping core (ops/fused_stencil_hbm._plan_from_needs) —
+    the imp displacement classes ARE the "needs" the planner abstracts.
+    Non-wrap lattice: one signed need per class (boundary live-masks kill
+    every would-be wrapping sender), keyed by CLASS ID q in sorted-offset
+    order (the id the regenerated mark plane carries — the imp engines
+    mask on class ids, not displacements).
+
+    Returns (classes, groups, M) in the _shard_delivery_plan shapes:
+    classes[q] = (q, ((group_idx, e, sq, None),)); groups[gi] =
+    (sq_hi, m_rows, None); M = max margin rows past rows_ext."""
+    n_ext = rows_ext * LANES
+    N = layout.n
+    offs = _imp_lattice_offsets(kind, N)
+    assert offs is not None
+    needs = []
+    for q, d in enumerate(offs):
+        signed = d if d <= N // 2 else d - N
+        e = signed % n_ext
+        needs.append((q, d, e, _centered_sq(e, rows_ext), None))
+    classes, groups, M = _plan_from_needs(
+        needs, list(range(len(offs))), PT, with_liveness=False
+    )
+    return classes, groups, M
+
+
+def plan_imp_hbm_sharded_shape(kind: str, n: int, cfg: SimConfig,
+                               n_dev: int):
+    """(H, rows_loc, PT, layout) or a string reason — a pure function of
+    (kind, n, cfg, n_dev), no adjacency arrays, so it also serves the
+    plan-level BENCH_TABLES "topology ceilings" imp rows hardware-free."""
+    if kind not in ("imp2d", "imp3d"):
+        return f"topology {kind!r} is not an imp (lattice+extra) kind"
+    if cfg.delivery != "pool":
+        return (
+            "the imp x HBM x sharded composition serves the pooled "
+            "long-range recast only (delivery='pool' — the same gate as "
+            "the single-device imp engine dispatch)"
+        )
+    if cfg.reference:
+        return (
+            "pooled long-range sampling cannot reproduce the reference's "
+            "static extra edge (Q9); reference semantics use scatter"
+        )
+    if cfg.dtype != "float32":
+        return "fused engine supports float32 only"
+    if not jax.config.jax_threefry_partitionable:
+        return "requires jax_threefry_partitionable=True"
+    if cfg.faulted:
+        return "failure models not supported in this fused kernel"
+    if cfg.telemetry:
+        return (
+            "telemetry counters run in the single-device fused kernels and "
+            "the chunked/sharded XLA engines; this composition does not "
+            "carry the counter block"
+        )
+    if cfg.mass_tolerance is not None:
+        return (
+            "the health sentinel (--mass-tolerance) runs in the chunked "
+            "and sharded XLA round bodies only"
+        )
+    if cfg.pool_size > 1 << POOL_CHOICE_BITS:
+        return (
+            f"pool_size {cfg.pool_size} exceeds the packed-choice limit "
+            f"{1 << POOL_CHOICE_BITS}"
+        )
+    offs = _imp_lattice_offsets(kind, n)
+    if offs is None:
+        return (
+            f"honest {kind} lattices need a perfect "
+            f"{'square' if kind == 'imp2d' else 'cube'} population; "
+            f"{n} is not one"
+        )
+    layout = build_pool_layout(n)
+    R = layout.rows
+    if R % n_dev != 0:
+        return (
+            f"padded layout ({R} rows) must split evenly; {n_dev} devices "
+            "do not divide it"
+        )
+    rows_loc = R // n_dev
+    N = layout.n
+    Z = layout.n_pad - layout.n
+    w = max(abs(d if d <= N // 2 else d - N) for d in offs)
+    P = cfg.pool_size
+    n_pw = P * (1 if Z == 0 else 2)
+    pushsum = cfg.algorithm == "push-sum"
+    n_state = 4 if pushsum else 3
+    n_wp = 2 if pushsum else 1
+    h_min = -(-w // LANES) + 1
+    cands = []
+    for pt in _PT_CANDIDATES:
+        r = (-rows_loc) % pt
+        if r % 2:
+            continue  # 2H cannot hit an odd residue mod an even PT
+        h = h_min + ((r // 2 - h_min) % (pt // 2))
+        rows_ext = rows_loc + 2 * h
+        if rows_ext % pt or rows_ext // pt < 1 or h > rows_loc:
+            continue
+        _cls, grp, m_lat = _imp_lat_plan(kind, layout, rows_ext, pt)
+        sum_m = sum(m for _, m, _l in grp)
+        MP = pt + 16
+        # The mirror margins replicate ring rows [0, M) past the ring's
+        # end in ONE copy (`p[:M]`, and in-kernel the non-overlapping
+        # drain_halo self-copy), so each margin must fit inside one ring
+        # revolution: a clipped margin silently clamps the window DMAs
+        # and corrupts boundary deliveries.
+        if m_lat > rows_ext or MP > R:
+            continue
+        # VMEM streaming scratch: own-state tiles + lattice group windows
+        # (value planes + the regen mark plane) + the per-slot pool
+        # windows off the gathered copy (both blend variants).
+        vmem = (
+            n_state * pt
+            + sum_m * (n_wp + 1)
+            + n_pw * MP * (n_wp + 1)
+        ) * LANES * 4
+        if vmem > _VMEM_SCRATCH_BUDGET:
+            continue
+        # Per-device HBM: the gathered margined windowed copies, the
+        # halo-extended input planes, the in-kernel-DMA assembly planes
+        # (margined windowed + plain), the output planes, and the overlap
+        # schedule's double-buffer carry — ALL budgeted unconditionally so
+        # geometry (H, PT) is invariant to the scheduling knobs.
+        gathered = n_wp * (R + MP)
+        ext_in = n_state * rows_ext
+        ext_asm = n_wp * (rows_ext + m_lat) + (n_state - n_wp) * rows_ext
+        outp = n_state * rows_ext
+        carry = gathered + ext_in + n_state * rows_loc
+        if (gathered + ext_in + ext_asm + outp + carry) * LANES * 4 > (
+            _HBM_PLANE_BUDGET
+        ):
+            continue
+        cands.append((rows_ext, pt, h))
+    if not cands:
+        return (
+            f"no processing-tile split fits: the lattice halo ({w} slots) "
+            f"at a {rows_loc}-row shard exceeds the shard, the VMEM "
+            "streaming scratch, or the per-device HBM plane budget (the "
+            "gathered windowed copy is the floor); use the chunked "
+            "collective engine"
+        )
+    # Largest PT whose halo waste stays near the leanest candidate —
+    # fewer, larger DMA volleys beat a few percent of redundant halo rows.
+    lean = min(c[0] for c in cands)
+    ok = [c for c in cands if c[0] <= lean + max(lean // 8, 1)]
+    _, PT, H = max(ok, key=lambda c: c[1])
+    return (H, rows_loc, PT, layout)
+
+
+def plan_imp_hbm_sharded(topo: Topology, cfg: SimConfig, n_dev: int):
+    """(H, rows_loc, PT, layout) or a string reason why the composition
+    can't run this instance. The topo-level gate additionally requires the
+    built instance's lattice slots to be offset-structured (imp_split) —
+    the shape-level core (plan_imp_hbm_sharded_shape) carries every other
+    check and the budget fit."""
+    if topo.kind not in ("imp2d", "imp3d"):
+        return f"topology {topo.kind!r} is not an imp (lattice+extra) kind"
+    if imp_split(topo) is None:
+        return "lattice slots are not offset-structured for this instance"
+    return plan_imp_hbm_sharded_shape(topo.kind, topo.n, cfg, n_dev)
+
+
+def _regen_imp_marks(dst, rows: int, base_row, k1, k2, ck1, ck2, R: int,
+                     N: int, dirs, cls_of, L: int, P: int, *,
+                     ring_rows=None, row0=None):
+    """Sampled-CLASS plane regenerated at (wrapped) global rows
+    [base_row, base_row+rows) — the sender's draw of the single-device imp
+    engines, bit for bit: slot = untagged threefry word % degree over
+    [lattice dirs..., extra], lattice slots map to their sorted-offset
+    class id, the extra slot to L + the packed pool choice
+    (ops/fused_imp_hbm._sample_class_imp). Non-senders mark -1.
+
+    ``ring_rows``/``row0`` re-base the row map for the halo-extended
+    buffer (window rows index the rows_ext ring, global row =
+    (row0 + ext_row mod ring_rows) mod R — the fused_hbm_sharded
+    _regen_marked_plane convention); without them ``base_row`` indexes the
+    gathered copy's mirrored global ring (rows >= R wrap to rows - R).
+
+    The packed choice re-derives elementwise from the global row (word =
+    hash at (grow // POOL_PACK) * LANES + lane, sliced at
+    4 * (grow % POOL_PACK)) — the same words _choice_tile_pt expands,
+    valid at ARBITRARY window alignment. Computed in 512-row chunks (the
+    whole-window live set blows Mosaic's scoped VMEM stack)."""
+    RC = 512
+
+    def chunk(o: int, ln: int):
+        rl = lax.broadcasted_iota(jnp.int32, (ln, LANES), 0)
+        ll = lax.broadcasted_iota(jnp.int32, (ln, LANES), 1)
+        pos = base_row + o + rl
+        if ring_rows is not None:
+            pos = row0 + lax.rem(pos, jnp.int32(ring_rows))
+        grow = lax.rem(pos, jnp.int32(R))
+        jflat = grow * LANES + ll
+        padm = jflat >= N
+        bits = threefry2x32_hash(k1, k2, jflat.astype(jnp.uint32))
+        word = threefry2x32_hash(
+            ck1, ck2,
+            ((grow // POOL_PACK) * LANES + ll).astype(jnp.uint32),
+        )
+        shift = (
+            jnp.uint32(POOL_CHOICE_BITS)
+            * (grow % POOL_PACK).astype(jnp.uint32)
+        )
+        choice = ((word >> shift) & jnp.uint32(P - 1)).astype(jnp.int32)
+        cls, send_ok = _sample_class_imp(
+            bits, choice, jflat, padm, dirs, cls_of, L
+        )
+        dst[pl.ds(o, ln), :] = jnp.where(send_ok, cls, jnp.int32(-1))
+
+    for o in range(0, rows, RC):
+        chunk(o, min(RC, rows - o))
+
+
+def make_pushsum_imp_hbm_shard_chunk(
+    topo: Topology, cfg: SimConfig, H: int, rows_loc: int, PT: int,
+    layout, *, dma: bool = False, interpret: bool = False
+):
+    """Per-device ONE-ROUND kernel: ``chunk_fn(state4, gathered2, keys,
+    offs, ckeys, row0, dev) -> (mid_state4, u)`` advances this shard's
+    (s, w, term, conv) planes by one round. ``state4`` is the halo-EXTENDED
+    margined planes under the XLA wire (rows_ext + M_lat windowed,
+    rows_ext plain), or the MID planes under in-kernel DMA (``dma=True`` —
+    the kernel performs the lattice halo exchange itself, interior-first).
+    ``gathered2`` is the margined full (s, w) copy the pool windows read.
+    ``u`` is the round's middle-region metric: unstable valid lanes under
+    termination='global', converged count otherwise."""
+    R_glob = layout.rows
+    N = layout.n
+    Z = layout.n_pad - layout.n
+    rows_ext = rows_loc + 2 * H
+    T = rows_ext // PT
+    n_dev = R_glob // rows_loc
+    dirs, lat_offs, L = _imp_dirs(topo)
+    cls_of = {d: q for q, d in enumerate(lat_offs)}
+    classes, groups, M_lat = _imp_lat_plan(topo.kind, layout, rows_ext, PT)
+    G = len(groups)
+    P = cfg.pool_size
+    stride = 1 if Z == 0 else 2
+    n_pw = P * stride
+    MP = PT + 16
+    S = max(abs(sq) for _q, reads in classes for _gi, _e, sq, _t1 in reads)
+    b_lo, b_hi = _boundary_split(H, PT, T, S)
+    n_int = T - b_lo - b_hi
+    delta = np.float32(cfg.resolved_delta)
+    term_rounds = np.int32(cfg.term_rounds)
+    global_term = cfg.termination == "global"
+    in_rows = rows_loc if dma else rows_ext
+    n_fetch = 2 * G + 2 * n_pw + 4
+
+    def kernel(*refs):
+        it = iter(refs)
+        scal_ref, keys_ref, ckeys_ref, offs_ref = (
+            next(it), next(it), next(it), next(it)
+        )
+        s_in, w_in, t_in, c_in = next(it), next(it), next(it), next(it)
+        gs, gw = next(it), next(it)
+        if dma:
+            sA, wA, tA, cA = next(it), next(it), next(it), next(it)
+        s_o, w_o, t_o, c_o, u_o = (
+            next(it), next(it), next(it), next(it), next(it)
+        )
+        win_s = [next(it) for _ in range(G)]
+        win_w = [next(it) for _ in range(G)]
+        mk = [next(it) for _ in range(G)]
+        pwin_s = [next(it) for _ in range(n_pw)]
+        pwin_w = [next(it) for _ in range(n_pw)]
+        pmk = [next(it) for _ in range(n_pw)]
+        own_s, own_w, own_t, own_c = next(it), next(it), next(it), next(it)
+        sems, str_sems = next(it), next(it)
+        dma_sems = (next(it), next(it)) if dma else None
+        row_l = lax.broadcasted_iota(jnp.int32, (PT, LANES), 0)
+        lane = lax.broadcasted_iota(jnp.int32, (PT, LANES), 1)
+        row0 = scal_ref[0]
+        dev = scal_ref[1]
+        k1 = keys_ref[0]
+        k2 = keys_ref[1]
+        ck1 = ckeys_ref[0]
+        ck2 = ckeys_ref[1]
+
+        if dma:
+            cur = (sA, wA, tA, cA)
+            ssems, rsems = dma_sems
+            left = lax.rem(dev + jnp.int32(n_dev - 1), jnp.int32(n_dev))
+            right = lax.rem(dev + jnp.int32(1), jnp.int32(n_dev))
+
+            def rdmas():
+                return _halo_rdmas(
+                    (s_in, w_in, t_in, c_in), (sA, wA, tA, cA),
+                    H, rows_loc, ssems, rsems, left, right,
+                )
+
+            def drain_halo():
+                for cp in rdmas():
+                    cp.wait()
+                _copy_all([
+                    (sA.at[pl.ds(0, M_lat), :],
+                     sA.at[pl.ds(rows_ext, M_lat), :]),
+                    (wA.at[pl.ds(0, M_lat), :],
+                     wA.at[pl.ds(rows_ext, M_lat), :]),
+                ], str_sems)
+
+            # Hand the halo slot to the kernel: barrier with the ring
+            # neighbors, push my boundary slices into their assembly
+            # planes, land my own mid rows — the recv drains under the
+            # interior tiles (drain_halo before the first boundary tile).
+            _neighbor_barrier(left, right)
+            for cp in rdmas():
+                cp.start()
+            _copy_all([
+                (s_in, sA.at[pl.ds(H, rows_loc), :]),
+                (w_in, wA.at[pl.ds(H, rows_loc), :]),
+                (t_in, tA.at[pl.ds(H, rows_loc), :]),
+                (c_in, cA.at[pl.ds(H, rows_loc), :]),
+            ], str_sems)
+        else:
+            cur = (s_in, w_in, t_in, c_in)
+
+        s_c, w_c, t_c, c_c = cur
+
+        def regen(dst, rows, base_row, *, ring):
+            _regen_imp_marks(
+                dst, rows, base_row, k1, k2, ck1, ck2, R_glob, N,
+                dirs, cls_of, L, P,
+                ring_rows=rows_ext if ring else None,
+                row0=row0 if ring else None,
+            )
+
+        def tile(t, acc):
+            r0 = t * PT
+            starts = _group_window_starts(groups, r0, rows_ext)
+            g0 = lax.rem(row0 + jnp.int32(r0), jnp.int32(R_glob))
+            pplans = []
+            pairs = []
+            for gi, (_ws8u, dma0, _live) in enumerate(starts):
+                m = groups[gi][1]
+                pairs.append((s_c.at[pl.ds(dma0, m), :], win_s[gi]))
+                pairs.append((w_c.at[pl.ds(dma0, m), :], win_w[gi]))
+            for slot in range(P):
+                d = offs_ref[slot]
+                for v in range(stride):
+                    e = d if v == 0 else d + jnp.int32(Z)
+                    ws8, rl, off = _win_plan(g0, e, R_glob)
+                    wi = slot * stride + v
+                    pplans.append((ws8, rl, off))
+                    pairs.append((gs.at[pl.ds(ws8, MP), :], pwin_s[wi]))
+                    pairs.append((gw.at[pl.ds(ws8, MP), :], pwin_w[wi]))
+            pairs.append((s_c.at[pl.ds(r0, PT), :], own_s))
+            pairs.append((w_c.at[pl.ds(r0, PT), :], own_w))
+            pairs.append((t_c.at[pl.ds(r0, PT), :], own_t))
+            pairs.append((c_c.at[pl.ds(r0, PT), :], own_c))
+            cps = [
+                pltpu.make_async_copy(src, dst, sems.at[i])
+                for i, (src, dst) in enumerate(pairs)
+            ]
+            for cp in cps:
+                cp.start()
+            # Regenerate every window's class plane while the raw windows
+            # stream: lattice groups at extended-ring rows, pool windows
+            # at the gathered copy's (mirror-wrapped) global rows.
+            for gi, (ws8u, _dma0, _live) in enumerate(starts):
+                regen(mk[gi], groups[gi][1], ws8u, ring=True)
+            for wi, (ws8, _rl, _off) in enumerate(pplans):
+                regen(pmk[wi], MP, ws8, ring=False)
+            for cp in cps:
+                cp.wait()
+            grow = lax.rem(row0 + r0 + row_l, jnp.int32(R_glob))
+            gflat = grow * LANES + lane
+            padm = gflat >= N
+            mid = (row_l + r0 >= H) & (row_l + r0 < H + rows_loc)
+            inbox_s = jnp.zeros((PT, LANES), jnp.float32)
+            inbox_w = jnp.zeros((PT, LANES), jnp.float32)
+            # Accumulate in the single-device order: lattice classes in
+            # sorted-offset order, then pool slots (the chunked path's
+            # association tree); groups only choose the buffer.
+            for q, reads in classes:
+                ((gi, e, sq, _t1),) = reads  # non-wrap: one read per class
+                ws8u = starts[gi][0]
+                off = jnp.asarray(
+                    r0 - sq - 1 + 2 * rows_ext, jnp.int32
+                ) - ws8u
+                rl = e % LANES
+                inbox_s = inbox_s + _window_vals(
+                    win_s[gi], mk[gi], off, PT, rl, q, lane, interpret
+                )
+                inbox_w = inbox_w + _window_vals(
+                    win_w[gi], mk[gi], off, PT, rl, q, lane, interpret
+                )
+            for slot in range(P):
+                wi = slot * stride
+                _ws8, rl, off = pplans[wi]
+                cs = _window_vals(
+                    pwin_s[wi], pmk[wi], off, PT, rl, L + slot, lane,
+                    interpret,
+                )
+                cw = _window_vals(
+                    pwin_w[wi], pmk[wi], off, PT, rl, L + slot, lane,
+                    interpret,
+                )
+                if Z != 0:
+                    _ws8b, rlb, offb = pplans[wi + 1]
+                    take = gflat >= offs_ref[slot]
+                    cs = jnp.where(take, cs, _window_vals(
+                        pwin_s[wi + 1], pmk[wi + 1], offb, PT, rlb,
+                        L + slot, lane, interpret,
+                    ))
+                    cw = jnp.where(take, cw, _window_vals(
+                        pwin_w[wi + 1], pmk[wi + 1], offb, PT, rlb,
+                        L + slot, lane, interpret,
+                    ))
+                inbox_s = inbox_s + cs
+                inbox_w = inbox_w + cw
+            # Halve AFTER the masked sums — bitwise the single-device
+            # engine's pre-halved delivery planes (exact power-of-two
+            # scaling commutes with every rounding in the sum).
+            half = jnp.float32(0.5)
+            inbox_s = jnp.where(padm, 0.0, inbox_s * half)
+            inbox_w = jnp.where(padm, 0.0, inbox_w * half)
+            s_t = own_s[:]
+            w_t = own_w[:]
+            # Every real imp node has the always-live extra slot, so the
+            # send gate is exactly ~padm (the single-device p2 formula).
+            s_send = jnp.where(padm, 0.0, s_t * half)
+            w_send = jnp.where(padm, 0.0, w_t * half)
+            s_new = (s_t - s_send) + inbox_s
+            w_new = (w_t - w_send) + inbox_w
+            if global_term:
+                ratio_old = s_t / w_t
+                tol = delta * jnp.maximum(jnp.abs(ratio_old), jnp.float32(1))
+                unstable = (
+                    jnp.abs(s_new / w_new - ratio_old) > tol
+                ) & ~padm & mid
+                term_new = own_t[:]
+                conv_new = own_c[:]
+                tile_metric = jnp.sum(
+                    unstable.astype(jnp.int32), dtype=jnp.int32
+                )
+            else:
+                received = inbox_w > 0
+                stable = jnp.abs(s_new / w_new - s_t / w_t) <= delta
+                term_new = jnp.where(
+                    received,
+                    jnp.where(stable, own_t[:] + 1, jnp.int32(0)),
+                    own_t[:],
+                )
+                conv_new = jnp.where(
+                    padm,
+                    jnp.int32(0),
+                    jnp.where(
+                        (own_c[:] != 0) | (term_new >= term_rounds),
+                        jnp.int32(1),
+                        jnp.int32(0),
+                    ),
+                )
+                tile_metric = jnp.sum(
+                    jnp.where(mid, conv_new, jnp.int32(0)), dtype=jnp.int32
+                )
+            own_s[:] = s_new
+            own_w[:] = w_new
+            own_t[:] = term_new
+            own_c[:] = conv_new
+            _copy_all([
+                (own_s, s_o.at[pl.ds(r0, PT), :]),
+                (own_w, w_o.at[pl.ds(r0, PT), :]),
+                (own_t, t_o.at[pl.ds(r0, PT), :]),
+                (own_c, c_o.at[pl.ds(r0, PT), :]),
+            ], str_sems)
+            return acc + tile_metric
+
+        def step(u, acc):
+            if dma:
+                # Interior-first: boundary tiles run last, behind the halo
+                # drain (per-tile-independent — bitwise-neutral).
+                t = _visit_tile(u, T, b_lo, b_hi)
+
+                @pl.when(u == n_int)
+                def _wait_halo():
+                    drain_halo()
+            else:
+                t = u
+            return tile(t, acc)
+
+        total = lax.fori_loop(0, T, step, jnp.int32(0), unroll=False)
+        u_o[0] = total
+
+    def chunk_fn(state4, gathered2, keys, offs, ckeys, row0, dev):
+        s, w, t, c = state4
+        gs, gw = gathered2
+        f32e = jax.ShapeDtypeStruct((rows_ext, LANES), jnp.float32)
+        i32e = jax.ShapeDtypeStruct((rows_ext, LANES), jnp.int32)
+        f32m = jax.ShapeDtypeStruct((rows_ext + M_lat, LANES), jnp.float32)
+        in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] * 4 + [
+            pl.BlockSpec(memory_space=pl.ANY)
+        ] * 6
+        out_shape = []
+        if dma:
+            out_shape += [f32m, f32m, i32e, i32e]  # assembly planes
+        out_shape += [
+            f32e, f32e, i32e, i32e,
+            jax.ShapeDtypeStruct((1,), jnp.int32),
+        ]
+        scratch = (
+            [pltpu.VMEM((m, LANES), jnp.float32) for _, m, _l in groups]
+            + [pltpu.VMEM((m, LANES), jnp.float32) for _, m, _l in groups]
+            + [pltpu.VMEM((m, LANES), jnp.int32) for _, m, _l in groups]
+            + [pltpu.VMEM((MP, LANES), jnp.float32)] * n_pw
+            + [pltpu.VMEM((MP, LANES), jnp.float32)] * n_pw
+            + [pltpu.VMEM((MP, LANES), jnp.int32)] * n_pw
+            + [
+                pltpu.VMEM((PT, LANES), jnp.float32),
+                pltpu.VMEM((PT, LANES), jnp.float32),
+                pltpu.VMEM((PT, LANES), jnp.int32),
+                pltpu.VMEM((PT, LANES), jnp.int32),
+                pltpu.SemaphoreType.DMA((n_fetch,)),
+                pltpu.SemaphoreType.DMA((4,)),
+            ]
+        )
+        params = dict(vmem_limit_bytes=96 * 1024 * 1024)
+        if dma:
+            scratch += [
+                pltpu.SemaphoreType.DMA((8,)),
+                pltpu.SemaphoreType.DMA((8,)),
+            ]
+            params["collective_id"] = 0
+        outs = pl.pallas_call(
+            kernel,
+            grid=(1,),
+            out_shape=tuple(out_shape),
+            in_specs=in_specs,
+            out_specs=tuple(
+                [pl.BlockSpec(memory_space=pl.ANY)] * (len(out_shape) - 1)
+                + [pl.BlockSpec(memory_space=pltpu.SMEM)]
+            ),
+            scratch_shapes=scratch,
+            compiler_params=compat.pallas_tpu_compiler_params(**params),
+            interpret=interpret,
+        )(
+            jnp.stack([jnp.int32(row0), jnp.int32(dev)]),
+            keys, ckeys, offs,
+            s, w, t, c, gs, gw,
+        )
+        base = 4 if dma else 0
+        mid = tuple(
+            outs[base + i][H:H + rows_loc] for i in range(4)
+        )
+        return mid, outs[base + 4][0]
+
+    return chunk_fn, in_rows, M_lat
+
+
+def make_gossip_imp_hbm_shard_chunk(
+    topo: Topology, cfg: SimConfig, H: int, rows_loc: int, PT: int,
+    layout, *, dma: bool = False, interpret: bool = False
+):
+    """Gossip analog: shard planes (count, active, conv); windows read the
+    raw ACTIVE plane (halo-extended for the lattice classes, gathered for
+    the pool slots) and the regenerated class plane gates per-class
+    counting (ops/fused_stencil_hbm._window_counted); receiver-side
+    suppression against the round-start conv tile. ``u`` is the round's
+    middle-region converged count."""
+    R_glob = layout.rows
+    N = layout.n
+    Z = layout.n_pad - layout.n
+    rows_ext = rows_loc + 2 * H
+    T = rows_ext // PT
+    n_dev = R_glob // rows_loc
+    dirs, lat_offs, L = _imp_dirs(topo)
+    cls_of = {d: q for q, d in enumerate(lat_offs)}
+    classes, groups, M_lat = _imp_lat_plan(topo.kind, layout, rows_ext, PT)
+    G = len(groups)
+    P = cfg.pool_size
+    stride = 1 if Z == 0 else 2
+    n_pw = P * stride
+    MP = PT + 16
+    S = max(abs(sq) for _q, reads in classes for _gi, _e, sq, _t1 in reads)
+    b_lo, b_hi = _boundary_split(H, PT, T, S)
+    n_int = T - b_lo - b_hi
+    rumor_target = np.int32(cfg.resolved_rumor_target)
+    suppress = cfg.resolved_suppress
+    in_rows = rows_loc if dma else rows_ext
+    n_fetch = G + n_pw + 3
+
+    def kernel(*refs):
+        it = iter(refs)
+        scal_ref, keys_ref, ckeys_ref, offs_ref = (
+            next(it), next(it), next(it), next(it)
+        )
+        n_in, a_in, c_in = next(it), next(it), next(it)
+        ga = next(it)
+        if dma:
+            nA, aA, cA = next(it), next(it), next(it)
+        n_o, a_o, c_o, u_o = next(it), next(it), next(it), next(it)
+        win_a = [next(it) for _ in range(G)]
+        mk = [next(it) for _ in range(G)]
+        pwin_a = [next(it) for _ in range(n_pw)]
+        pmk = [next(it) for _ in range(n_pw)]
+        own_n, own_a, own_c = next(it), next(it), next(it)
+        sems, str_sems = next(it), next(it)
+        dma_sems = (next(it), next(it)) if dma else None
+        row_l = lax.broadcasted_iota(jnp.int32, (PT, LANES), 0)
+        lane = lax.broadcasted_iota(jnp.int32, (PT, LANES), 1)
+        row0 = scal_ref[0]
+        dev = scal_ref[1]
+        k1 = keys_ref[0]
+        k2 = keys_ref[1]
+        ck1 = ckeys_ref[0]
+        ck2 = ckeys_ref[1]
+
+        if dma:
+            ssems, rsems = dma_sems
+            left = lax.rem(dev + jnp.int32(n_dev - 1), jnp.int32(n_dev))
+            right = lax.rem(dev + jnp.int32(1), jnp.int32(n_dev))
+
+            def rdmas():
+                return _halo_rdmas(
+                    (n_in, a_in, c_in), (nA, aA, cA),
+                    H, rows_loc, ssems, rsems, left, right,
+                )
+
+            def drain_halo():
+                for cp in rdmas():
+                    cp.wait()
+                _copy_all([
+                    (aA.at[pl.ds(0, M_lat), :],
+                     aA.at[pl.ds(rows_ext, M_lat), :]),
+                ], str_sems)
+
+            _neighbor_barrier(left, right)
+            for cp in rdmas():
+                cp.start()
+            _copy_all([
+                (n_in, nA.at[pl.ds(H, rows_loc), :]),
+                (a_in, aA.at[pl.ds(H, rows_loc), :]),
+                (c_in, cA.at[pl.ds(H, rows_loc), :]),
+            ], str_sems)
+            cur = (nA, aA, cA)
+        else:
+            cur = (n_in, a_in, c_in)
+
+        n_c, a_c, c_c = cur
+
+        def regen(dst, rows, base_row, *, ring):
+            _regen_imp_marks(
+                dst, rows, base_row, k1, k2, ck1, ck2, R_glob, N,
+                dirs, cls_of, L, P,
+                ring_rows=rows_ext if ring else None,
+                row0=row0 if ring else None,
+            )
+
+        def tile(t, acc):
+            r0 = t * PT
+            starts = _group_window_starts(groups, r0, rows_ext)
+            g0 = lax.rem(row0 + jnp.int32(r0), jnp.int32(R_glob))
+            pplans = []
+            pairs = []
+            for gi, (_ws8u, dma0, _live) in enumerate(starts):
+                m = groups[gi][1]
+                pairs.append((a_c.at[pl.ds(dma0, m), :], win_a[gi]))
+            for slot in range(P):
+                d = offs_ref[slot]
+                for v in range(stride):
+                    e = d if v == 0 else d + jnp.int32(Z)
+                    ws8, rl, off = _win_plan(g0, e, R_glob)
+                    wi = slot * stride + v
+                    pplans.append((ws8, rl, off))
+                    pairs.append((ga.at[pl.ds(ws8, MP), :], pwin_a[wi]))
+            pairs.append((n_c.at[pl.ds(r0, PT), :], own_n))
+            pairs.append((a_c.at[pl.ds(r0, PT), :], own_a))
+            pairs.append((c_c.at[pl.ds(r0, PT), :], own_c))
+            cps = [
+                pltpu.make_async_copy(src, dst, sems.at[i])
+                for i, (src, dst) in enumerate(pairs)
+            ]
+            for cp in cps:
+                cp.start()
+            for gi, (ws8u, _dma0, _live) in enumerate(starts):
+                regen(mk[gi], groups[gi][1], ws8u, ring=True)
+            for wi, (ws8, _rl, _off) in enumerate(pplans):
+                regen(pmk[wi], MP, ws8, ring=False)
+            for cp in cps:
+                cp.wait()
+            grow = lax.rem(row0 + r0 + row_l, jnp.int32(R_glob))
+            gflat = grow * LANES + lane
+            padm = gflat >= N
+            mid = (row_l + r0 >= H) & (row_l + r0 < H + rows_loc)
+            inbox = jnp.zeros((PT, LANES), jnp.int32)
+            for q, reads in classes:
+                ((gi, e, sq, _t1),) = reads
+                ws8u = starts[gi][0]
+                off = jnp.asarray(
+                    r0 - sq - 1 + 2 * rows_ext, jnp.int32
+                ) - ws8u
+                rl = e % LANES
+                inbox = inbox + _window_counted(
+                    win_a[gi], mk[gi], off, PT, rl, q, lane, interpret
+                )
+            for slot in range(P):
+                wi = slot * stride
+                _ws8, rl, off = pplans[wi]
+                g = _window_counted(
+                    pwin_a[wi], pmk[wi], off, PT, rl, L + slot, lane,
+                    interpret,
+                )
+                if Z != 0:
+                    _ws8b, rlb, offb = pplans[wi + 1]
+                    g = jnp.where(
+                        gflat >= offs_ref[slot],
+                        g,
+                        _window_counted(
+                            pwin_a[wi + 1], pmk[wi + 1], offb, PT, rlb,
+                            L + slot, lane, interpret,
+                        ),
+                    )
+                inbox = inbox + g
+            inbox = jnp.where(padm, jnp.int32(0), inbox)
+            if suppress:
+                inbox = jnp.where(own_c[:] != 0, jnp.int32(0), inbox)
+            count_new = own_n[:] + inbox
+            active_new = jnp.where(
+                (own_a[:] != 0) | (inbox > 0), jnp.int32(1), jnp.int32(0)
+            )
+            conv_new = jnp.where(
+                count_new >= rumor_target, jnp.int32(1), jnp.int32(0)
+            )
+            own_n[:] = count_new
+            own_a[:] = active_new
+            own_c[:] = conv_new
+            _copy_all([
+                (own_n, n_o.at[pl.ds(r0, PT), :]),
+                (own_a, a_o.at[pl.ds(r0, PT), :]),
+                (own_c, c_o.at[pl.ds(r0, PT), :]),
+            ], str_sems)
+            return acc + jnp.sum(
+                jnp.where(mid, conv_new, jnp.int32(0)), dtype=jnp.int32
+            )
+
+        def step(u, acc):
+            if dma:
+                t = _visit_tile(u, T, b_lo, b_hi)
+
+                @pl.when(u == n_int)
+                def _wait_halo():
+                    drain_halo()
+            else:
+                t = u
+            return tile(t, acc)
+
+        total = lax.fori_loop(0, T, step, jnp.int32(0), unroll=False)
+        u_o[0] = total
+
+    def chunk_fn(state3, gathered1, keys, offs, ckeys, row0, dev):
+        cnt, act, cv = state3
+        (ga,) = gathered1
+        i32e = jax.ShapeDtypeStruct((rows_ext, LANES), jnp.int32)
+        i32m = jax.ShapeDtypeStruct((rows_ext + M_lat, LANES), jnp.int32)
+        in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] * 4 + [
+            pl.BlockSpec(memory_space=pl.ANY)
+        ] * 4
+        out_shape = []
+        if dma:
+            out_shape += [i32e, i32m, i32e]  # assembly: count, active, conv
+        out_shape += [
+            i32e, i32e, i32e,
+            jax.ShapeDtypeStruct((1,), jnp.int32),
+        ]
+        scratch = (
+            [pltpu.VMEM((m, LANES), jnp.int32) for _, m, _l in groups]
+            + [pltpu.VMEM((m, LANES), jnp.int32) for _, m, _l in groups]
+            + [pltpu.VMEM((MP, LANES), jnp.int32)] * n_pw
+            + [pltpu.VMEM((MP, LANES), jnp.int32)] * n_pw
+            + [
+                pltpu.VMEM((PT, LANES), jnp.int32),
+                pltpu.VMEM((PT, LANES), jnp.int32),
+                pltpu.VMEM((PT, LANES), jnp.int32),
+                pltpu.SemaphoreType.DMA((n_fetch,)),
+                pltpu.SemaphoreType.DMA((3,)),
+            ]
+        )
+        params = dict(vmem_limit_bytes=96 * 1024 * 1024)
+        if dma:
+            scratch += [
+                pltpu.SemaphoreType.DMA((6,)),
+                pltpu.SemaphoreType.DMA((6,)),
+            ]
+            params["collective_id"] = 0
+        outs = pl.pallas_call(
+            kernel,
+            grid=(1,),
+            out_shape=tuple(out_shape),
+            in_specs=in_specs,
+            out_specs=tuple(
+                [pl.BlockSpec(memory_space=pl.ANY)] * (len(out_shape) - 1)
+                + [pl.BlockSpec(memory_space=pltpu.SMEM)]
+            ),
+            scratch_shapes=scratch,
+            compiler_params=compat.pallas_tpu_compiler_params(**params),
+            interpret=interpret,
+        )(
+            jnp.stack([jnp.int32(row0), jnp.int32(dev)]),
+            keys, ckeys, offs,
+            cnt, act, cv, ga,
+        )
+        base = 3 if dma else 0
+        mid = tuple(
+            outs[base + i][H:H + rows_loc] for i in range(3)
+        )
+        return mid, outs[base + 3][0]
+
+    return chunk_fn, in_rows, M_lat
+
+
+def run_imp_hbm_sharded(
+    topo: Topology,
+    cfg: SimConfig,
+    mesh=None,
+    key=None,
+    on_chunk=None,
+    start_state=None,
+    start_round: int = 0,
+    probe=None,
+    deadline=None,
+):
+    """Sharded imp x HBM run — engine='fused', n_devices > 1, imp2d/imp3d
+    under pooled long-range sampling (delivery='pool'), populations past
+    one chip's HBM plane budget.
+
+    One super-step = one round: the lattice halo wire (batched ppermute
+    pair on CPU; in-kernel async-remote-copy on TPU via --halo-dma) plus
+    ONE batched all_gather of the windowed send summaries for the pool
+    classes, then each device's one-round class-id sweep over its extended
+    buffer, then the psum'd termination verdict — deferred one super-step
+    under cfg.overlap_collectives (parallel/overlap.py). Trajectories are
+    bitwise the single-device fused_imp_hbm engine's
+    (tests/test_fused_imp_hbm_sharded.py). termination='global' latches
+    the all-or-nothing conv plane at the exact fired verdict round.
+
+    ``probe(chunk_sharded, args)`` short-circuits the run for
+    benchmarks/comm_audit.py (trace, never execute)."""
+    import time
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..models import gossip as gossip_mod
+    from ..models import pipeline as pipeline_mod
+    from ..models import pushsum as pushsum_mod
+    from ..models.runner import (
+        StallWatchdog,
+        _cancel_fn,
+        _check_dtype,
+        _finalize_result,
+        _progress_gap,
+        draw_leader,
+    )
+    from ..ops import sampling
+    from ..ops.fused import round_keys
+    from ..ops.fused_imp import choice_round_keys
+    from ..ops.fused_pool import round_offsets
+    from . import halo as halo_mod
+    from . import overlap as overlap_mod
+    from .mesh import NODE_AXIS, make_mesh
+
+    if mesh is None:
+        mesh = make_mesh(cfg.n_devices)
+    n_dev = mesh.devices.size
+    plan = plan_imp_hbm_sharded(topo, cfg, n_dev)
+    if isinstance(plan, str):
+        raise ValueError(
+            f"engine='fused' with n_devices={n_dev} unavailable: {plan}"
+        )
+    H, rows_loc, PT, layout = plan
+    _check_dtype(cfg)
+    if key is None:
+        key = jax.random.PRNGKey(cfg.seed)
+    backend = jax.default_backend()
+    transport = halo_mod.resolve_halo_transport(cfg, backend)
+    dma = transport == "dma"
+    # The remote-copy kernel only EXECUTES on TPU; elsewhere it can only
+    # be TRACED (the comm-audit probe) — execution is gated below.
+    interpret = backend != "tpu" and not dma
+    pushsum = cfg.algorithm == "push-sum"
+    global_term = pushsum and cfg.termination == "global"
+    make = (
+        make_pushsum_imp_hbm_shard_chunk if pushsum
+        else make_gossip_imp_hbm_shard_chunk
+    )
+    chunk_fn, _in_rows, M_lat = make(
+        topo, cfg, H, rows_loc, PT, layout, dma=dma, interpret=interpret
+    )
+    R_glob = layout.rows
+    rows_ext = rows_loc + 2 * H
+    MP = PT + 16
+    n = topo.n
+    Pool = cfg.pool_size
+    target = cfg.resolved_target_count(n, topo.target_count)
+    key_data_host, key_impl = sampling.key_split(key)
+
+    shard_rows = NamedSharding(mesh, P(NODE_AXIS, None))
+    repl = NamedSharding(mesh, P())
+
+    plane_fields = (
+        [("s", np.float32, 0.0), ("w", np.float32, 1.0),
+         ("term", np.int32, cfg.initial_term_round), ("conv", np.int32, 0)]
+        if pushsum
+        else [("count", np.int32, 0), ("active", np.int32, 0),
+              ("conv", np.int32, 0)]
+    )
+    # Indices of the windowed planes delivery actually reads — the planes
+    # the all_gather ships and the margin extension covers.
+    win_idx = (0, 1) if pushsum else (1,)
+
+    def to_planes(state):
+        outs = []
+        for f, dt, fill in plane_fields:
+            x = np.asarray(getattr(state, f)).astype(dt)
+            full = np.full(layout.n_pad, fill, dtype=dt)
+            full[: x.shape[0]] = x
+            outs.append(full.reshape(R_glob, LANES))
+        return tuple(outs)
+
+    if start_state is not None:
+        st0 = jax.tree.map(np.asarray, start_state)
+    elif pushsum:
+        st0 = pushsum_mod.init_state(n, jnp.float32, cfg.initial_term_round)
+    else:
+        # reference semantics are plan-rejected, so no counts receipt.
+        st0 = gossip_mod.init_state(
+            n, draw_leader(key, topo, cfg), leader_counts_receipt=False
+        )
+    planes0 = tuple(jax.device_put(p, shard_rows) for p in to_planes(st0))
+    done0 = bool(np.asarray(st0.conv).sum() >= target)
+
+    perm_fwd = [(d, (d + 1) % n_dev) for d in range(n_dev)]
+    perm_bwd = [(d, (d - 1) % n_dev) for d in range(n_dev)]
+    overlap = cfg.overlap_collectives
+    rumor_target = cfg.resolved_rumor_target
+
+    def windowed(planes):
+        return tuple(planes[i] for i in win_idx)
+
+    def exchange(planes):
+        """The super-step wires: ONE batched all_gather of the windowed
+        send summaries (margin-extended for the pool windows' 8-aligned
+        DMAs) + the lattice halo transport — batched ppermute pair on the
+        XLA wire, or the identity under in-kernel DMA (the kernel owns the
+        lattice wire). The windowed ext planes additionally carry the
+        M_lat mirror margin the group windows read."""
+        wp = windowed(planes)
+        if overlap:
+            full = halo_mod.gather_rows_batched(wp, NODE_AXIS)
+        else:
+            full = tuple(
+                lax.all_gather(p, NODE_AXIS, axis=0, tiled=True)
+                for p in wp
+            )
+        full = tuple(jnp.concatenate([p, p[:MP]], axis=0) for p in full)
+        if dma:
+            return (planes, full)
+        if overlap:
+            ext = halo_mod.exchange_rows_batched(planes, H, NODE_AXIS, n_dev)
+        else:
+            def ext_rows(x):
+                left = lax.ppermute(x[-H:], NODE_AXIS, perm_fwd)
+                right = lax.ppermute(x[:H], NODE_AXIS, perm_bwd)
+                return jnp.concatenate([left, x, right], axis=0)
+
+            ext = tuple(ext_rows(p) for p in planes)
+        ext = tuple(
+            jnp.concatenate([p, p[:M_lat]], axis=0) if i in win_idx else p
+            for i, p in enumerate(ext)
+        )
+        return (ext, full)
+
+    def chunk_local(planes_in, rnd_in, done_in, round_end, key_data):
+        base = sampling.key_join(key_data, key_impl)
+        dev = lax.axis_index(NODE_AXIS)
+        row0 = lax.rem(
+            dev.astype(jnp.int32) * rows_loc - H + 2 * R_glob,
+            jnp.int32(R_glob),
+        )
+
+        def metric_shift(u):
+            """Global-residual verdict through the fixed-target loop: the
+            shifted metric fires psum(metric) >= target iff the summed
+            unstable count is zero (the replicated-pool2 trick — the
+            shift rides device 0 so psum adds it exactly once)."""
+            if global_term:
+                return jnp.where(
+                    dev == 0, jnp.int32(target), jnp.int32(0)
+                ) - u
+            return u
+
+        def compute(ext_pack, rnd, cap):
+            ext_planes, full = ext_pack
+            keys = round_keys(base, rnd, 1)
+            offs = round_offsets(base, rnd, 1, Pool, n)
+            ckeys = choice_round_keys(base, rnd, 1)
+            out, u = chunk_fn(
+                ext_planes, full, keys[0], offs[0], ckeys[0], row0, dev
+            )
+            return out, jnp.int32(1), metric_shift(u)
+
+        if overlap:
+            planes_f, rnd_f, done_f = overlap_mod.overlapped_superstep_loop(
+                planes_in, rnd_in, done_in, round_end,
+                exchange=exchange, compute=compute,
+                psum_metric=lambda m: lax.psum(m, NODE_AXIS),
+                target=target,
+            )
+        else:
+            def cond(c):
+                _, rnd, done = c
+                return jnp.logical_and(~done, rnd < round_end)
+
+            def body(c):
+                planes, rnd, _ = c
+                out, executed, metric = compute(
+                    exchange(planes), rnd, round_end
+                )
+                total = lax.psum(metric, NODE_AXIS)
+                return (out, rnd + executed, total >= target)
+
+            planes_f, rnd_f, done_f = lax.while_loop(
+                cond, body, (planes_in, rnd_in, done_in)
+            )
+
+        if global_term:
+            # All-or-nothing latch at the fired verdict — the sharded form
+            # of the single-device engine's latch_conv_global_streamed.
+            pos = (
+                (dev.astype(jnp.int32) * rows_loc + lax.broadcasted_iota(
+                    jnp.int32, (rows_loc, LANES), 0)) * LANES
+                + lax.broadcasted_iota(jnp.int32, (rows_loc, LANES), 1)
+            )
+            cv = jnp.where(
+                done_f & (pos < n), jnp.int32(1), planes_f[3]
+            )
+            planes_f = (planes_f[0], planes_f[1], planes_f[2], cv)
+        return planes_f, rnd_f, done_f
+
+    plane_specs = tuple(P(NODE_AXIS, None) for _ in planes0)
+    donate = on_chunk is None and not cfg.stall_chunks
+    chunk_sharded = jax.jit(
+        compat.shard_map(
+            chunk_local,
+            mesh=mesh,
+            in_specs=(plane_specs, P(), P(), P(), P()),
+            out_specs=(plane_specs, P(), P()),
+            check_vma=False,
+        ),
+        donate_argnums=(0,) if donate else (),
+    )
+
+    def rep_put(x):
+        return jax.device_put(x, repl)
+
+    kd_dev = rep_put(np.asarray(key_data_host))
+    rnd0 = rep_put(np.int32(start_round))
+    done0_dev = rep_put(np.bool_(done0))
+
+    def to_canonical(planes):
+        flats = [p.reshape(-1)[:n] for p in planes]
+        if pushsum:
+            return pushsum_mod.PushSumState(
+                s=flats[0], w=flats[1], term=flats[2], conv=flats[3] != 0
+            )
+        return gossip_mod.GossipState(
+            count=flats[0], active=flats[1] != 0, conv=flats[2] != 0
+        )
+
+    if probe is not None:
+        return probe(chunk_sharded, (
+            planes0, rnd0, done0_dev,
+            rep_put(np.int32(min(start_round + 1, cfg.max_rounds))),
+            kd_dev,
+        ))
+
+    if dma and backend != "tpu":
+        raise ValueError(
+            "halo_dma='on' builds the in-kernel async-remote-copy halo "
+            "program, which only EXECUTES on TPU backends (the Pallas "
+            "interpreter has no inter-device DMA); use halo_dma='auto' "
+            "for the batched-ppermute wire here, or trace the DMA program "
+            "hardware-free through the probe hook (benchmarks/comm_audit)"
+        )
+
+    t0 = time.perf_counter()
+    warm = chunk_sharded(
+        tuple(jnp.copy(p) for p in planes0) if donate else planes0,
+        rnd0, done0_dev,
+        rep_put(np.int32(min(start_round + 1, cfg.max_rounds))),
+        kd_dev,
+    )
+    int(warm[1])
+    del warm
+    compile_s = time.perf_counter() - t0
+
+    watchdog = StallWatchdog(cfg.stall_chunks)
+
+    def dispatch(planes, rnd, done, round_end):
+        return chunk_sharded(
+            planes, rnd, done, rep_put(np.int32(round_end)), kd_dev
+        )
+
+    on_retire = None
+    if on_chunk is not None:
+        def on_retire(rounds, planes):
+            on_chunk(rounds, to_canonical(planes))
+
+    should_stop = None
+    if cfg.stall_chunks:
+        # This composition rejects failure models (plan gate), so the
+        # progress gap is the plain target − conv-count distance; gossip
+        # conv is stored (plane 2), push-sum conv is plane 3.
+        def should_stop(rounds, planes):
+            if pushsum:
+                conv = planes[3]
+            else:
+                conv = (planes[0] >= rumor_target).astype(jnp.int32)
+            return watchdog.no_progress(
+                _progress_gap(None, cfg.quorum, target, conv, rounds)
+            )
+
+    t1 = time.perf_counter()
+    loop = pipeline_mod.run_chunks(
+        dispatch=dispatch, state0=planes0, rnd0=rnd0, done0=done0_dev,
+        start_round=start_round, max_rounds=cfg.max_rounds,
+        stride=8, depth=cfg.pipeline_chunks, donate=donate,
+        on_retire=on_retire, should_stop=should_stop,
+        should_cancel=_cancel_fn(deadline),
+    )
+    run_s = time.perf_counter() - t1
+
+    return _finalize_result(
+        topo, cfg, to_canonical(loop.state), loop.rounds, target,
+        compile_s, run_s, done=loop.done, stalled=watchdog.stalled,
+        cancelled=loop.cancelled,
+    )

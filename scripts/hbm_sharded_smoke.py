@@ -1,10 +1,18 @@
-"""CI smoke of the one-sweep HBM-streaming x sharded composition
-(ISSUE 9): a short interpret-mode run on a 2-virtual-CPU-device mesh must
-match the single-device chunked engine bitwise, and the in-kernel-DMA
-transport must trace with zero XLA collectives on the halo path. Small on
-purpose (ring at 2^16, a handful of rounds) — the exhaustive oracles are
-the slow suite (tests/test_fused_hbm_sharded.py); this keeps the
-composition path executing end-to-end on every push.
+"""CI smoke of the HBM-streaming x sharded compositions: short
+interpret-mode runs on a 2-virtual-CPU-device mesh must match the
+single-device chunked engine bitwise, and the in-kernel-DMA transport
+must trace with zero XLA collectives on the halo path. Small on purpose
+(a handful of rounds each) — the exhaustive oracles are the slow suite;
+this keeps the composition paths executing end-to-end on every push.
+
+- one-sweep stencil composition (ISSUE 9): ring at 2^16, bitwise counts
+  + the DMA-transport trace (tests/test_fused_hbm_sharded.py);
+- imp x HBM x sharded (ISSUE 10): imp3d at 30^3 — lattice halo windows +
+  the pooled long-range all_gather, bitwise counts vs the chunked
+  engine + the DMA trace (tests/test_fused_imp_hbm_sharded.py);
+- replicated-pool2 (ISSUE 10): the full topology at 2^18, ONE all_gather
+  of the send summaries per round, bitwise counts vs the chunked pool
+  path (tests/test_pool2_sharded.py).
 
 Usage: python scripts/hbm_sharded_smoke.py
 """
@@ -72,6 +80,82 @@ def main() -> int:
     assert "ppermute" not in probed["txt"], "DMA path still carries ppermute"
     assert "dma_start" in probed["txt"]
     print("[hbm-sharded-smoke] in-kernel-dma trace OK (no ppermute)")
+
+    # --- imp x HBM x sharded (ISSUE 10) --------------------------------
+    from cop5615_gossip_protocol_tpu.parallel.fused_imp_hbm_sharded import (
+        run_imp_hbm_sharded,
+    )
+
+    n_imp, rounds_imp = 27_000, 10
+    topo_imp = build_topology("imp3d", n_imp)
+    grab = {}
+    r1 = run(
+        topo_imp,
+        SimConfig(n=n_imp, topology="imp3d", algorithm="gossip",
+                  delivery="pool", engine="chunked",
+                  max_rounds=rounds_imp, chunk_rounds=rounds_imp),
+        on_chunk=lambda r, s: grab.update(a=s),
+    )
+    cfg_imp = SimConfig(n=n_imp, topology="imp3d", algorithm="gossip",
+                        delivery="pool", engine="fused", n_devices=2,
+                        chunk_rounds=1, max_rounds=rounds_imp)
+    r2 = run_imp_hbm_sharded(
+        topo_imp, cfg_imp, mesh=make_mesh(2),
+        on_chunk=lambda r, s: grab.update(b=s),
+    )
+    assert r1.rounds == r2.rounds == rounds_imp, (r1.rounds, r2.rounds)
+    for f in ("count", "active", "conv"):
+        a = np.asarray(getattr(grab["a"], f))
+        b = np.asarray(getattr(grab["b"], f))[:n_imp]
+        assert (a == b).all(), f"imp {f} diverged"
+    print(f"[hbm-sharded-smoke] imp3d x HBM x sharded bitwise OK "
+          f"({rounds_imp} rounds, informed {int(np.asarray(grab['b'].count).astype(bool).sum())})")
+
+    # imp DMA-transport trace: the lattice halo moves in-kernel, the
+    # pooled long-range classes keep their ONE all_gather.
+    probed.clear()
+    run_imp_hbm_sharded(
+        topo_imp,
+        SimConfig(n=n_imp, topology="imp3d", algorithm="gossip",
+                  delivery="pool", engine="fused", n_devices=2,
+                  chunk_rounds=1, max_rounds=rounds_imp, halo_dma="on"),
+        mesh=make_mesh(2), probe=probe,
+    )
+    assert "ppermute" not in probed["txt"], "imp DMA path carries ppermute"
+    assert "dma_start" in probed["txt"]
+    assert "all-gather" in probed["txt"] or "all_gather" in probed["txt"]
+    print("[hbm-sharded-smoke] imp in-kernel-dma trace OK "
+          "(no ppermute, pool all_gather kept)")
+
+    # --- replicated-pool2 (ISSUE 10) -----------------------------------
+    from cop5615_gossip_protocol_tpu.parallel.pool2_sharded import (
+        run_pool2_sharded,
+    )
+
+    n_full, rounds_full = 262_144, 8
+    topo_full = build_topology("full", n_full)
+    grab = {}
+    r1 = run(
+        topo_full,
+        SimConfig(n=n_full, topology="full", algorithm="gossip",
+                  delivery="pool", engine="chunked",
+                  max_rounds=rounds_full, chunk_rounds=rounds_full),
+        on_chunk=lambda r, s: grab.update(a=s),
+    )
+    r2 = run_pool2_sharded(
+        topo_full,
+        SimConfig(n=n_full, topology="full", algorithm="gossip",
+                  delivery="pool", engine="fused", n_devices=2,
+                  chunk_rounds=1, max_rounds=rounds_full),
+        mesh=make_mesh(2), on_chunk=lambda r, s: grab.update(b=s),
+    )
+    assert r1.rounds == r2.rounds == rounds_full, (r1.rounds, r2.rounds)
+    for f in ("count", "active", "conv"):
+        a = np.asarray(getattr(grab["a"], f))
+        b = np.asarray(getattr(grab["b"], f))[:n_full]
+        assert (a == b).all(), f"pool2 {f} diverged"
+    print(f"[hbm-sharded-smoke] replicated-pool2 full bitwise OK "
+          f"({rounds_full} rounds, informed {int(np.asarray(grab['b'].count).astype(bool).sum())})")
     return 0
 
 

@@ -248,10 +248,14 @@ def test_plan_gating_and_runner_tiering(monkeypatch):
     assert "displacement" in plan_stencil_hbm_sharded(
         build_topology("full", 1024), cfg, 2
     )
-    # imp kinds have no arithmetic columns
-    assert "arithmetic" in plan_stencil_hbm_sharded(
+    # imp kinds route to the imp x HBM x sharded composition (ISSUE 10):
+    # the refusal names the serving engine and its knob, not a bogus
+    # "no displacement columns" claim (imp kinds have a full lattice).
+    imp_reason = plan_stencil_hbm_sharded(
         build_topology("imp3d", 27000), cfg, 2
     )
+    assert "imp x HBM x sharded" in imp_reason
+    assert "delivery='pool'" in imp_reason
     # indivisible layout
     assert "split evenly" in plan_stencil_hbm_sharded(
         build_topology("torus3d", N), cfg, 3

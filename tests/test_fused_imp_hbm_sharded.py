@@ -1,0 +1,243 @@
+"""imp x HBM x sharded composition (parallel/fused_imp_hbm_sharded.py).
+
+The marquee kind across chips (ISSUE 10): lattice classes delivered from
+the halo-extended buffer (the one-sweep stencil machinery keyed by class
+id), the pooled long-range classes from ONE all_gather of the windowed
+send summaries per round. The design claim is BITWISE equality with the
+single-device fused_imp_hbm engine at every device count — and
+transitively with the chunked paths (the single-device engine is pinned
+against them in tests/test_fused_imp_hbm.py); the chunked SHARDED engine
+is pinned directly here too (the dual-oracle pattern of ISSUE 9).
+
+Fast plan/gating/capability pins run in tier-1; interpret-mode kernel
+oracles carry the slow mark (the ROADMAP tier-1 wall budget).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cop5615_gossip_protocol_tpu import SimConfig, build_topology
+from cop5615_gossip_protocol_tpu.models.runner import run
+from cop5615_gossip_protocol_tpu.ops import fused_imp, fused_imp_hbm
+from cop5615_gossip_protocol_tpu.parallel.fused_imp_hbm_sharded import (
+    plan_imp_hbm_sharded,
+    plan_imp_hbm_sharded_shape,
+    run_imp_hbm_sharded,
+)
+
+# 30^3 — the interpret-suite imp3d cube (padded layout 512 rows -> two
+# 256-row shards; Z > 0 so the mod-n blend pair is live on the pool
+# windows).
+N3 = 27_000
+# 256^2 — Z = 0, the single-window pool path.
+N2 = 65_536
+
+
+def _cfg(n, kind="imp3d", algorithm="gossip", **kw):
+    kw.setdefault("delivery", "pool")
+    kw.setdefault("engine", "fused")
+    kw.setdefault("max_rounds", 300)
+    if kw.get("n_devices"):
+        kw.setdefault("chunk_rounds", 1)
+    else:
+        kw.setdefault("chunk_rounds", 16)
+    return SimConfig(n=n, topology=kind, algorithm=algorithm, **kw)
+
+
+@pytest.fixture
+def force_hbm(monkeypatch):
+    # Collapse the VMEM imp engine's budget so the single-device oracle
+    # is the HBM-streaming tier this composition shards.
+    monkeypatch.setattr(fused_imp, "_VMEM_BUDGET", 1000)
+
+
+def _grab(final, tag):
+    def f(rounds, state):
+        final[tag] = state
+    return f
+
+
+# --- fast plan / gating / capability pins (tier-1) -------------------------
+
+
+def test_plan_accepts_and_geometry_fits():
+    for kind, n, nd in [("imp3d", N3, 2), ("imp3d", N3, 4),
+                        ("imp2d", N2, 2), ("imp2d", N2, 4)]:
+        plan = plan_imp_hbm_sharded(build_topology(kind, n),
+                                    _cfg(n, kind, n_devices=nd), nd)
+        assert not isinstance(plan, str), (kind, n, nd, plan)
+        H, rows_loc, PT, layout = plan
+        rows_ext = rows_loc + 2 * H
+        assert rows_loc * nd == layout.rows
+        assert rows_ext % PT == 0
+        # Mirror margins must fit one ring revolution (the round-3
+        # boundary-corruption regression: a clipped margin clamps the
+        # window DMAs silently).
+        from cop5615_gossip_protocol_tpu.parallel.fused_imp_hbm_sharded \
+            import _imp_lat_plan
+        _cls, _grp, m_lat = _imp_lat_plan(kind, layout, rows_ext, PT)
+        assert m_lat <= rows_ext
+        assert PT + 16 <= layout.rows
+
+
+def test_plan_level_ceiling_past_2_28():
+    # The BENCH_TABLES "topology ceilings" imp row, hardware-free: the
+    # plan (a pure function of shape) admits an imp3d population past
+    # 2^28 aggregate on an 8-device mesh — vs the reference's 2,000-actor
+    # cap and the single-device engine's 2^27 HBM budget.
+    n = 648 ** 3  # 272,097,792 > 2^28
+    assert n >= 1 << 28
+    for algorithm in ("push-sum", "gossip"):
+        plan = plan_imp_hbm_sharded_shape(
+            "imp3d", n, _cfg(n, algorithm=algorithm, n_devices=8), 8
+        )
+        assert not isinstance(plan, str), plan
+    # and refuses honestly when one device's gathered copy cannot fit
+    big = 4096 ** 3
+    reason = plan_imp_hbm_sharded_shape(
+        "imp3d", big, _cfg(big, n_devices=8), 8
+    )
+    assert isinstance(reason, str)
+
+
+def test_plan_gating_reasons():
+    cfg = _cfg(N3, n_devices=2)
+    topo = build_topology("imp3d", N3)
+    assert "not an imp" in plan_imp_hbm_sharded(
+        build_topology("torus3d", 4096), cfg, 2
+    )
+    assert "delivery='pool'" in plan_imp_hbm_sharded(
+        topo, _cfg(N3, delivery="auto", n_devices=2), 2
+    )
+    assert "perfect cube" in plan_imp_hbm_sharded_shape(
+        "imp3d", 27_001, cfg, 2
+    )
+    assert "perfect square" in plan_imp_hbm_sharded_shape(
+        "imp2d", 27_001, cfg, 2
+    )
+    assert "failure models" in plan_imp_hbm_sharded(
+        topo, _cfg(N3, n_devices=2, fault_rate=0.1), 2
+    )
+    assert "telemetry" in plan_imp_hbm_sharded(
+        topo, _cfg(N3, n_devices=2, telemetry=True), 2
+    )
+    assert "float32" in plan_imp_hbm_sharded(
+        topo, _cfg(N3, n_devices=2, dtype="bfloat16"), 2
+    )
+    assert "static extra edge" in plan_imp_hbm_sharded(
+        build_topology("imp3d", N3, semantics="reference"),
+        _cfg(N3, n_devices=2, semantics="reference"), 2
+    )
+
+
+def test_capability_messages_name_the_sharded_composition():
+    # Capability-matrix honesty (ISSUE 10): the single-device support
+    # messages must tell the caller the sharded composition exists
+    # instead of a dead-end "single-device" shrug.
+    topo = build_topology("imp3d", N3)
+    msg = fused_imp_hbm.imp_hbm_support(topo, _cfg(N3, n_devices=2))
+    assert "single-device" in msg and "fused_imp_hbm_sharded" in msg
+    # the stencil sharded plan routes imp kinds to this composition
+    from cop5615_gossip_protocol_tpu.parallel.fused_hbm_sharded import (
+        plan_stencil_hbm_sharded,
+    )
+    reason = plan_stencil_hbm_sharded(topo, _cfg(N3, n_devices=2,
+                                                 delivery="auto"), 2)
+    assert "imp x HBM x sharded" in reason
+
+
+def test_halo_dma_on_is_trace_only_off_tpu():
+    # halo_dma='on' builds the in-kernel async-remote-copy program, which
+    # EXECUTES only on TPU; a CPU execution attempt must refuse with the
+    # knob guidance (the comm-audit probe traces it hardware-free —
+    # tests/test_comm_audit.py pins those counts).
+    topo = build_topology("imp3d", N3)
+    with pytest.raises(ValueError, match="halo_dma"):
+        run_imp_hbm_sharded(topo, _cfg(N3, n_devices=2, halo_dma="on"))
+
+
+def test_loud_refusal_and_auto_demotion():
+    # engine='fused' with an unserveable config refuses loudly with the
+    # plan reason...
+    topo = build_topology("imp3d", N3)
+    with pytest.raises(ValueError, match="engine='fused'"):
+        run_imp_hbm_sharded(topo, _cfg(N3, n_devices=2, telemetry=False,
+                                       fault_rate=0.1))
+    # ...while engine='auto' (the default) never reaches the fused
+    # compositions under sharding: the run demotes to the sharded XLA
+    # engine without any ValueError escaping to the user.
+    n = 1024  # 32^2 — small enough for a real XLA run in tier-1
+    r = run(build_topology("imp2d", n),
+            SimConfig(n=n, topology="imp2d", algorithm="gossip",
+                      delivery="pool", n_devices=2, max_rounds=200))
+    assert r.rounds > 0
+
+
+# --- interpret-mode kernel oracles (slow suite) ----------------------------
+
+
+@pytest.mark.slow
+def test_gossip_bitwise_vs_single_device_and_chunked_sharded(force_hbm):
+    # Dual oracle (the ISSUE 9 pattern): the composition must match the
+    # single-device HBM engine it shards AND the chunked sharded engine.
+    topo = build_topology("imp3d", N3)
+    r_hbm = run(topo, _cfg(N3))
+    r_chk = run(topo, _cfg(N3, engine="chunked", n_devices=2,
+                           chunk_rounds=8))
+    for ov in (True, False):
+        r_sh = run(topo, _cfg(N3, n_devices=2, overlap_collectives=ov))
+        assert r_sh.rounds == r_hbm.rounds == r_chk.rounds
+        assert (r_sh.converged_count == r_hbm.converged_count
+                == r_chk.converged_count)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kind,n", [("imp3d", N3), ("imp2d", N2)])
+def test_pushsum_state_bitwise(kind, n, force_hbm):
+    topo = build_topology(kind, n)
+    final = {}
+    r = run(topo, _cfg(n, kind, algorithm="push-sum", max_rounds=48,
+                       chunk_rounds=48),
+            on_chunk=_grab(final, "single"))
+    assert r.rounds == 48
+    for nd in (2, 4):
+        r = run(topo, _cfg(n, kind, algorithm="push-sum", n_devices=nd,
+                           max_rounds=48),
+                on_chunk=_grab(final, "sh"))
+        assert r.rounds == 48
+        for f in ("s", "w", "term", "conv"):
+            a = np.asarray(getattr(final["single"], f))[:n]
+            b = np.asarray(getattr(final["sh"], f))[:n]
+            assert (a != b).sum() == 0, (kind, nd, f)
+
+
+@pytest.mark.slow
+def test_pushsum_global_termination_exact(force_hbm):
+    topo = build_topology("imp3d", N3)
+    r1 = run(topo, _cfg(N3, algorithm="push-sum", termination="global",
+                        delta=1e-1, max_rounds=500, chunk_rounds=16))
+    r2 = run(topo, _cfg(N3, algorithm="push-sum", termination="global",
+                        delta=1e-1, max_rounds=500, n_devices=2))
+    assert r1.rounds == r2.rounds
+    assert r1.converged_count == r2.converged_count
+
+
+@pytest.mark.slow
+def test_resume_midway(force_hbm):
+    topo = build_topology("imp3d", N3)
+    snap = {}
+
+    def keep(rounds, state):
+        snap.setdefault("s0", (rounds, state))
+
+    full = run(topo, _cfg(N3, n_devices=2), on_chunk=keep)
+    rounds0, s0 = snap["s0"]
+    assert 0 < rounds0 < full.rounds
+    resumed = run(topo, _cfg(N3, n_devices=2),
+                  start_state=jax.tree.map(jnp.asarray, s0),
+                  start_round=rounds0)
+    assert resumed.rounds == full.rounds
+    assert resumed.converged_count == full.converged_count
