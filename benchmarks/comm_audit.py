@@ -3,30 +3,22 @@
 A comm-volume regression (an engine quietly re-growing per-plane wires, a
 collective slipping inside the hot loop) historically only surfaced as an
 on-chip ms/round drift — which needs a TPU session to even notice. This
-tool walks the jitted chunk program of each sharded engine (the engines
-expose it through their ``probe`` hook — the program is TRACED, never
-executed, so the audit runs in seconds on CPU) and reports, per engine x
-topology x overlap schedule:
+tool reports, per engine x topology x overlap schedule, the collectives
+inside the chunk's while body (per round / super-step), the per-dispatch
+setup collectives, the in-kernel remote-DMA counts, the payload bytes,
+and the halo-delivery MECHANISM column (in-kernel-dma vs xla-ppermute vs
+all-gather vs scatter) — all from TRACED programs, never executed.
 
-- collectives INSIDE the chunk's while body — the per-round (chunked
-  engine) / per-super-step (fused compositions) steady-state cost;
-- collectives OUTSIDE the body — per-dispatch setup (the overlap
-  schedule's pre-loop exchange and drain psum live here);
-- IN-KERNEL remote DMAs (``pltpu.make_async_remote_copy`` starts inside
-  Pallas kernels — the walker descends into pallas_call jaxprs and
-  classifies ``dma_start`` by its device_id operand), so the ISSUE 9
-  "zero XLA collectives on the halo path" claim is a counted fact: the
-  halo-delivery MECHANISM column reports in-kernel-dma vs xla-ppermute
-  vs all-gather vs scatter per composition;
-- payload bytes per collective class (operand aval sizes; remote DMAs
-  report the sliced transfer size).
-
-tests/test_comm_audit.py pins the counts, so a regression fails tier-1 on
-CPU without needing a TPU — including the tentpole pins that the batched
-halo wire is exactly ONE ppermute pair per super-step and that the DMA
-transport keeps ZERO XLA collectives on the halo path (the remote-DMA
-kernel is traced hardware-free through the probe hook with
-halo_dma='on').
+Since ISSUE 11 this is a thin CLI over the static-auditor package: the
+region-aware jaxpr walker lives in
+``cop5615_gossip_protocol_tpu/analysis/jaxpr_walk.py`` (pallas_call
+descent + ``dma_start`` device-id classification included), the probe-hook
+tracing in ``analysis/trace.py``, and the audited grid in
+``analysis/matrix.AUDIT_GRID``. The expected counts are DECLARED by each
+composition as a ``WIRE_SPEC`` (analysis/wire_specs.py);
+tests/test_comm_audit.py pins declaration <-> trace agreement, and
+``python -m cop5615_gossip_protocol_tpu.analysis`` audits the whole
+matrix (wire counts + host-sync + dtype + donation + PRNG tags + lints).
 
 Usage:
   python benchmarks/comm_audit.py                # markdown table to stdout
@@ -39,282 +31,21 @@ Usage:
 from __future__ import annotations
 
 import argparse
-import dataclasses
 import json
 import sys
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
-COLLECTIVE_PRIMS = (
-    "ppermute", "psum", "all_gather", "reduce_scatter", "all_to_all",
+from cop5615_gossip_protocol_tpu.analysis.jaxpr_walk import (  # noqa: E402,F401
+    COLLECTIVE_PRIMS,
+    REMOTE_DMA,
+    count_collectives,
 )
-
-# Pseudo-collective: an in-kernel async remote copy (neighbor DMA). Not an
-# XLA collective — counted separately so the mechanism column can assert
-# the halo path carries NO XLA collective while still shipping bytes.
-REMOTE_DMA = "remote_dma"
-
-
-@dataclasses.dataclass
-class AuditReport:
-    """Collective counts for one engine x config x schedule."""
-
-    engine: str
-    topology: str
-    algorithm: str
-    n: int
-    n_devices: int
-    overlap: bool
-    # {"body": {prim: {"count": int, "bytes": int}}, "setup": {...}} —
-    # "body" is inside the chunk's while loop (per round / super-step),
-    # "setup" is the rest of the dispatch (paid once per chunk).
-    counts: dict
-
-    def body_count(self, prim: str) -> int:
-        return self.counts["body"].get(prim, {}).get("count", 0)
-
-    def setup_count(self, prim: str) -> int:
-        return self.counts["setup"].get(prim, {}).get("count", 0)
-
-    def body_bytes(self, prim: str) -> int:
-        return self.counts["body"].get(prim, {}).get("bytes", 0)
-
-    def halo_mechanism(self) -> str:
-        """How this composition's halo/delivery bytes move between
-        devices, decided from the counted program — never from config:
-        in-kernel-dma (Pallas async remote copies, zero XLA collectives
-        on the halo path), xla-ppermute (halo boundary wires),
-        all-gather (the pool composition's plane gather), scatter
-        (reduce_scatter fallback), or none (no inter-device delivery in
-        the body)."""
-        if self.body_count(REMOTE_DMA):
-            return "in-kernel-dma"
-        if self.body_count("ppermute"):
-            return "xla-ppermute"
-        if self.body_count("all_gather"):
-            return "all-gather"
-        if self.body_count("reduce_scatter"):
-            return "scatter"
-        return "none"
-
-    def to_record(self) -> dict:
-        rec = dataclasses.asdict(self)
-        rec["halo_mechanism"] = self.halo_mechanism()
-        return rec
-
-
-def _aval_bytes(aval) -> int:
-    try:
-        import numpy as np
-
-        return int(np.prod(aval.shape)) * aval.dtype.itemsize
-    except Exception:  # noqa: BLE001 — abstract tokens etc. carry no bytes
-        return 0
-
-
-def _sub_jaxprs(eqn):
-    """(jaxpr, enters_loop_body) for every sub-jaxpr of an eqn. A while
-    loop's cond and body both run once per iteration, so both count as
-    loop-body regions; everything else inherits the caller's region."""
-    for name, val in eqn.params.items():
-        vals = val if isinstance(val, (list, tuple)) else [val]
-        for v in vals:
-            jx = getattr(v, "jaxpr", None)
-            if jx is not None:
-                yield jx, eqn.primitive.name == "while"
-            elif hasattr(v, "eqns"):
-                yield v, eqn.primitive.name == "while"
-
-
-def _remote_dma_info(eqn):
-    """(is_remote, bytes) for a Pallas ``dma_start`` eqn. The primitive's
-    flat operands unflatten through its ``tree`` param into (src_ref,
-    src_transforms, dst_ref, dst_transforms, sems...); a REMOTE copy
-    carries a non-empty device_id leaf at the tail, a local HBM<->VMEM
-    copy carries None. Bytes = the sliced source shape (the NDIndexer's
-    static slice sizes) x itemsize; 0 when the indexer cannot be sized."""
-    import jax
-
-    try:
-        tup = jax.tree_util.tree_unflatten(eqn.params["tree"], eqn.invars)
-    except Exception:  # noqa: BLE001 — unfamiliar tree layout
-        return False, 0
-    dev = tup[-1]
-    if dev is None or dev == ():
-        return False, 0
-    size = 0
-    try:
-        src, src_transforms = tup[0], tup[1]
-        import numpy as np
-
-        shape = None
-        for tr in src_transforms or ():
-            get_shape = getattr(tr, "get_indexer_shape", None)
-            if get_shape is not None:
-                shape = tuple(get_shape())
-        if shape is None:
-            shape = tuple(src.aval.shape)
-        size = int(np.prod(shape)) * src.aval.dtype.itemsize
-    except Exception:  # noqa: BLE001 — bytes are best-effort
-        size = 0
-    return True, size
-
-
-def _walk(jaxpr, counts: dict, in_body: bool) -> None:
-    for eqn in jaxpr.eqns:
-        name = eqn.primitive.name
-        if name in COLLECTIVE_PRIMS:
-            region = counts["body" if in_body else "setup"]
-            slot = region.setdefault(name, {"count": 0, "bytes": 0})
-            slot["count"] += 1
-            slot["bytes"] += sum(_aval_bytes(v.aval) for v in eqn.invars)
-        elif name == "dma_start":
-            remote, size = _remote_dma_info(eqn)
-            if remote:
-                region = counts["body" if in_body else "setup"]
-                slot = region.setdefault(
-                    REMOTE_DMA, {"count": 0, "bytes": 0}
-                )
-                slot["count"] += 1
-                slot["bytes"] += size
-        for sub, enters_body in _sub_jaxprs(eqn):
-            _walk(sub, counts, in_body or enters_body)
-
-
-def count_collectives(fn, args) -> dict:
-    """Trace ``fn(*args)`` to a jaxpr and count collective primitives by
-    region (inside/outside while bodies). Never executes the program."""
-    import jax
-
-    jaxpr = jax.make_jaxpr(fn)(*args)
-    counts = {"body": {}, "setup": {}}
-    _walk(jaxpr.jaxpr, counts, False)
-    return counts
-
-
-# --- engine probes ---------------------------------------------------------
-
-
-def _probe(counts_sink):
-    def probe(chunk_fn, args):
-        counts_sink.update(count_collectives(chunk_fn, args))
-        return None
-
-    return probe
-
-
-def audit_engine(engine: str, topology: str, algorithm: str, n: int,
-                 n_devices: int, overlap: bool,
-                 cfg_overrides: dict | None = None) -> AuditReport:
-    """Build one sharded engine's jitted chunk through its run function's
-    ``probe`` hook and count its collectives. ``engine`` is one of
-    'sharded' (chunked XLA), 'fused-sharded' (VMEM lattice composition),
-    'fused-pool-sharded', 'hbm-sharded'."""
-    from cop5615_gossip_protocol_tpu import SimConfig, build_topology
-    from cop5615_gossip_protocol_tpu.parallel.mesh import make_mesh
-
-    cfg = SimConfig(
-        n=n, topology=topology, algorithm=algorithm,
-        overlap_collectives=overlap, **(cfg_overrides or {}),
-    )
-    topo = build_topology(topology, n)
-    mesh = make_mesh(n_devices)
-    counts: dict = {}
-    probe = _probe(counts)
-    if engine == "sharded":
-        from cop5615_gossip_protocol_tpu.parallel.sharded import run_sharded
-
-        run_sharded(topo, cfg, mesh=mesh, probe=probe)
-    elif engine == "fused-sharded":
-        from cop5615_gossip_protocol_tpu.parallel.fused_sharded import (
-            run_fused_sharded,
-        )
-
-        run_fused_sharded(topo, cfg, mesh=mesh, probe=probe)
-    elif engine == "fused-pool-sharded":
-        from cop5615_gossip_protocol_tpu.parallel.fused_pool_sharded import (
-            run_fused_pool_sharded,
-        )
-
-        run_fused_pool_sharded(topo, cfg, mesh=mesh, probe=probe)
-    elif engine == "hbm-sharded":
-        from cop5615_gossip_protocol_tpu.parallel.fused_hbm_sharded import (
-            run_stencil_hbm_sharded,
-        )
-
-        run_stencil_hbm_sharded(topo, cfg, mesh=mesh, probe=probe)
-    elif engine == "imp-hbm-sharded":
-        from cop5615_gossip_protocol_tpu.parallel.fused_imp_hbm_sharded import (
-            run_imp_hbm_sharded,
-        )
-
-        run_imp_hbm_sharded(topo, cfg, mesh=mesh, probe=probe)
-    elif engine == "pool2-sharded":
-        from cop5615_gossip_protocol_tpu.parallel.pool2_sharded import (
-            run_pool2_sharded,
-        )
-
-        run_pool2_sharded(topo, cfg, mesh=mesh, probe=probe)
-    else:
-        raise ValueError(f"unknown engine {engine!r}")
-    return AuditReport(
-        engine=engine, topology=topology, algorithm=algorithm, n=n,
-        n_devices=n_devices, overlap=overlap, counts=counts,
-    )
-
-
-# (engine, topology, algorithm, n, n_devices, extra cfg) — the audited
-# grid. Populations are the smallest each composition's plan accepts; the
-# counts are shape-independent (the jaxpr structure is), so small is right.
-AUDIT_GRID = (
-    ("sharded", "torus3d", "gossip", 4096, 8, {}),
-    ("sharded", "torus3d", "push-sum", 4096, 8, {}),
-    ("sharded", "full", "push-sum", 1024, 8, {"delivery": "pool"}),
-    # Non-divisible ring: no exact halo plan -> scatter + reduce-scatter
-    # fallback (wire batching does not apply; audited for the record).
-    ("sharded", "ring", "gossip", 1001, 8, {}),
-    ("fused-sharded", "torus3d", "gossip", 131072, 2,
-     {"engine": "fused", "chunk_rounds": 8}),
-    ("fused-sharded", "torus3d", "push-sum", 131072, 2,
-     {"engine": "fused", "chunk_rounds": 8}),
-    ("fused-pool-sharded", "full", "gossip", 131072, 2,
-     {"engine": "fused", "delivery": "pool"}),
-    ("fused-pool-sharded", "full", "push-sum", 131072, 2,
-     {"engine": "fused", "delivery": "pool"}),
-    # 125000 (the interpret-suite torus), not the 2^24 flagship: the jaxpr
-    # structure — and hence every count — is population-independent, and
-    # the smaller planes keep the CI trace in seconds.
-    ("hbm-sharded", "torus3d", "gossip", 125000, 2,
-     {"engine": "fused", "chunk_rounds": 8}),
-    ("hbm-sharded", "torus3d", "push-sum", 125000, 2,
-     {"engine": "fused", "chunk_rounds": 8}),
-    # The in-kernel-DMA halo transport (ISSUE 9): halo_dma='on' builds the
-    # async-remote-copy kernel, which the probe hook TRACES hardware-free
-    # — the audit pins zero XLA collectives on the halo path (the psum is
-    # the deferred termination verdict, not halo delivery).
-    ("hbm-sharded", "torus3d", "gossip", 125000, 2,
-     {"engine": "fused", "chunk_rounds": 8, "halo_dma": "on"}),
-    ("hbm-sharded", "torus3d", "push-sum", 125000, 2,
-     {"engine": "fused", "chunk_rounds": 8, "halo_dma": "on"}),
-    # imp x HBM x sharded (ISSUE 10): the lattice classes ride the halo
-    # wire (ppermute pair / in-kernel DMA), the pooled long-range classes
-    # ONE all_gather of the windowed send summaries per super-step.
-    ("imp-hbm-sharded", "imp3d", "gossip", 27000, 2,
-     {"engine": "fused", "delivery": "pool"}),
-    ("imp-hbm-sharded", "imp3d", "push-sum", 27000, 2,
-     {"engine": "fused", "delivery": "pool"}),
-    ("imp-hbm-sharded", "imp3d", "gossip", 27000, 2,
-     {"engine": "fused", "delivery": "pool", "halo_dma": "on"}),
-    ("imp-hbm-sharded", "imp3d", "push-sum", 27000, 2,
-     {"engine": "fused", "delivery": "pool", "halo_dma": "on"}),
-    # Replicated-pool2 (ISSUE 10): the full topology past one chip's HBM —
-    # the ONLY wire is the all_gather of the compact send summaries (plus
-    # the termination psum); zero ppermutes, zero stragglers.
-    ("pool2-sharded", "full", "gossip", 262144, 2,
-     {"engine": "fused", "delivery": "pool"}),
-    ("pool2-sharded", "full", "push-sum", 262144, 2,
-     {"engine": "fused", "delivery": "pool"}),
+from cop5615_gossip_protocol_tpu.analysis.matrix import AUDIT_GRID  # noqa: E402
+from cop5615_gossip_protocol_tpu.analysis.trace import (  # noqa: E402,F401
+    AuditReport,
+    audit_engine,
 )
 
 
@@ -365,17 +96,11 @@ def main(argv=None) -> int:
                     help="override the audited mesh sizes (XLA rows only)")
     args = ap.parse_args(argv)
 
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
-    from cop5615_gossip_protocol_tpu.utils import compat
-
-    jax.config.update("jax_threefry_partitionable", True)
-    need = max(
-        args.devices or 0,
-        max(g[4] for g in AUDIT_GRID),
+    from cop5615_gossip_protocol_tpu.analysis.matrix import (
+        setup_tracing_runtime,
     )
-    compat.set_host_device_count(need)
+
+    setup_tracing_runtime(extra_devices=args.devices or 0)
 
     reports = []
     for engine, topo, algo, n, n_dev, extra in AUDIT_GRID:

@@ -1,0 +1,46 @@
+"""Static program auditor (ISSUE 11): contract checks over TRACED programs.
+
+The engine matrix's correctness rests on structural invariants — collective
+wire counts, donation aliasing, host-sync freedom, dtype policy, PRNG tag
+disjointness, repo conventions — that were historically pinned dynamically
+(per-configuration golden tests) or by docstring. This package proves them
+statically, without executing a single program:
+
+- ``jaxpr_walk``   — the reusable jaxpr visitor (region-aware: inside vs
+                     outside while bodies; descends pallas_call; classifies
+                     in-kernel remote DMAs). benchmarks/comm_audit.py is a
+                     thin CLI over it.
+- ``trace``        — hardware-free tracing of every engine's jitted chunk
+                     through the run functions' ``probe`` hooks
+                     (single-device chunked/fused AND the six sharded
+                     compositions), returning AuditReports.
+- ``wire_specs``   — declarative per-composition collective contracts (the
+                     compositions each export WIRE_SPEC; the checker diffs
+                     declaration against trace). The first externalized
+                     fragment of the ROADMAP item-4 plan IR.
+- ``contracts``    — host-sync freedom, dtype policy (f64/weak-type
+                     promotion under an x64 trace), and donation
+                     (input-output aliasing must cover the state carry)
+                     checkers.
+- ``tags``         — the PRNG fold_in TAG MAP (ops/faults.py docstring),
+                     machine-verified: region registry + pairwise
+                     disjointness + repo-wide AST harvest of fold_in sites.
+- ``lint_rules``   — AST lints for repo conventions (no host conversions in
+                     traced bodies, schema-version lockstep, refusal
+                     messages name a real composition).
+- ``matrix``       — the audited grid (AUDIT_GRID — sharded cells — plus
+                     the single-device SINGLE_GRID) and ``audit_matrix``,
+                     which traces every cell once under x64 and runs the
+                     full checker set.
+- ``report``       — Finding records, the committed suppression baseline
+                     (baseline.json — empty: the tree audits clean),
+                     JSON + human table rendering.
+
+CLI: ``python -m cop5615_gossip_protocol_tpu.analysis`` (see __main__.py)
+exits non-zero on any non-baselined finding (and on stale suppressions, so
+the baseline only shrinks); the ``static-audit`` CI job runs it on every
+push. Each checker's fires direction is pinned against the seeded-bad
+fixtures in tests/fixtures/analysis/ (tests/test_static_audit.py).
+"""
+
+from .report import Finding, load_baseline, render_table  # noqa: F401

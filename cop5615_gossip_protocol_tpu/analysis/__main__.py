@@ -1,0 +1,102 @@
+"""CLI: ``python -m cop5615_gossip_protocol_tpu.analysis``.
+
+Audits the full engine matrix statically (see matrix.audit_matrix — every
+cell is TRACED, never executed, so the whole run is CPU-only and takes a
+few minutes; ``--quick`` audits the XLA rows + lints in seconds) and exits
+
+    0  every finding baselined (or none),
+    1  at least one non-baselined finding,
+    2  a baselined fingerprint no longer fires (stale suppression — the
+       baseline may only shrink; delete the entry). Only FULL runs judge
+       staleness: a --quick/--lint-only run audits a subset of the scope
+       the baseline was recorded against.
+
+``--json`` writes the CI artifact (all findings + baseline disposition);
+the ``static-audit`` job uploads it on every push. To baseline a finding,
+add ``{"fingerprint": ..., "reason": ...}`` to analysis/baseline.json —
+a suppression without a recorded justification is rejected at load.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m cop5615_gossip_protocol_tpu.analysis",
+        description=__doc__.splitlines()[0],
+    )
+    ap.add_argument("--json", type=str, default=None, metavar="FILE",
+                    help="write the findings report as JSON (CI artifact)")
+    ap.add_argument("--quick", action="store_true",
+                    help="XLA engine rows + tag/lint passes only (seconds)")
+    ap.add_argument("--lint-only", action="store_true",
+                    help="AST lints + PRNG tag map only — no programs "
+                    "traced (the tag registry still imports the engine "
+                    "modules to read the real constants)")
+    ap.add_argument("--baseline", type=str, default=None,
+                    help="suppression baseline path (default: the "
+                    "committed analysis/baseline.json)")
+    ap.add_argument("--quiet", action="store_true",
+                    help="suppress per-cell progress lines")
+    args = ap.parse_args(argv)
+
+    from . import report
+    from .report import apply_baseline, load_baseline, render_table
+
+    say = (lambda _m: None) if args.quiet else (
+        lambda m: print(f"[static-audit] {m}", file=sys.stderr, flush=True)
+    )
+
+    # CPU pin FIRST, on every mode: even --lint-only reaches jax (the tag
+    # registry imports the engine modules for the real constants), and on
+    # a TPU host an unpinned import would claim the chip.
+    from . import matrix
+
+    matrix.setup_tracing_runtime()
+
+    if args.lint_only:
+        from . import lint_rules, tags
+
+        findings = tags.check_tags() + lint_rules.run_lints()
+    else:
+        findings = matrix.audit_matrix(quick=args.quick, progress=say)
+
+    baseline = load_baseline(args.baseline)
+    new, suppressed, stale = apply_baseline(findings, baseline)
+    # The stale check is only sound against the scope the baseline was
+    # recorded for — the FULL matrix. A reduced run (--quick/--lint-only)
+    # never fires traced-cell findings, so their suppressions would be
+    # falsely reported stale (and deleted by a developer following the
+    # message).
+    full_scope = not (args.quick or args.lint_only)
+    if not full_scope:
+        stale = []
+
+    print("# Static audit")
+    print()
+    print("\n".join(render_table(new)))
+    if suppressed:
+        print(f"\n{len(suppressed)} baselined finding(s) suppressed.")
+    if stale:
+        print("\nSTALE suppressions (no longer fire — delete them):")
+        for fp in stale:
+            print(f"  - {fp}")
+    if args.json:
+        report.write_json(findings, new, suppressed, stale, args.json)
+        say(f"wrote {args.json}")
+
+    if new:
+        say(f"{len(new)} non-baselined finding(s)")
+        return 1
+    if stale:
+        say(f"{len(stale)} stale suppression(s)")
+        return 2
+    say("clean")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

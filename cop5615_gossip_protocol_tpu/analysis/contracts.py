@@ -1,0 +1,199 @@
+"""Jaxpr/lowering-level contract checkers over traced chunk programs.
+
+Each checker takes a ``trace.TracedCell`` (or a cell spec) and returns a
+list of ``report.Finding`` — empty when the contract holds. Nothing here
+executes a program: host-sync and dtype policy walk the traced jaxpr,
+donation inspects the LOWERED module's aliasing attributes (and, under
+``compile_check``, the compiled HLO's ``input_output_alias`` map — still
+trace/compile only, never dispatch).
+
+Host-sync freedom
+    No callback/infeed primitive (jaxpr_walk.HOST_SYNC_PRIMS) inside a
+    chunk-loop body: each would force a device<->host round-trip once per
+    ROUND — exactly the per-dispatch cost the chunked drivers amortize.
+
+Dtype policy
+    Traced under ``jax.experimental.enable_x64``: any float64 abstract
+    value inside the loop body is either a real f64 plane (banned outside
+    the verdict/mass-accumulator allowlist) or a weak-type promotion (a
+    Python/np.float64 scalar leaking into f32 arithmetic — the classic
+    "fine on CPU-without-x64, silently doubles HBM traffic under x64"
+    bug). The engines compute in float32 with f64 reserved for HOST-side
+    diagnostics, so a clean body is the expected state.
+
+Donation
+    Whenever a run function reports donate=True, the state carry (argument
+    0, every engine's chunk signature) must actually be covered by
+    input-output aliasing — an unaliased donated buffer silently costs a
+    full state copy per chunk. Single-device lowerings resolve aliasing at
+    lowering time (``tf.aliasing_output``); shard_map lowerings defer to
+    the compiler (``jax.buffer_donor``), which ``compile_check=True``
+    resolves through the compiled HLO's ``input_output_alias`` map.
+"""
+
+from __future__ import annotations
+
+import re
+
+from . import jaxpr_walk
+from .report import Finding
+
+
+def _cell_where(cell) -> str:
+    tags = [cell.engine, cell.topology, cell.algorithm,
+            "overlap" if cell.overlap else "serial"]
+    if cell.extras.get("halo_dma") == "on":
+        tags.append("dma")
+    if cell.extras.get("crash_rate") or cell.extras.get("crash_schedule"):
+        tags.append("crash")
+    if cell.extras.get("revive_rate") or cell.extras.get("revive_schedule"):
+        tags.append("revive")
+    return "/".join(tags)
+
+
+def check_host_sync(cell) -> list[Finding]:
+    """No host round-trip primitive inside the chunk-loop body."""
+    hits: dict[str, int] = {}
+    for eqn, in_body in jaxpr_walk.iter_eqns(cell.closed_jaxpr.jaxpr):
+        if in_body and eqn.primitive.name in jaxpr_walk.HOST_SYNC_PRIMS:
+            hits[eqn.primitive.name] = hits.get(eqn.primitive.name, 0) + 1
+    return [
+        Finding(
+            checker="host-sync",
+            where=_cell_where(cell),
+            rule=f"body-{prim}",
+            detail=(
+                f"{count}x {prim} inside the chunk-loop body — a "
+                "device<->host round-trip per round; hoist it to a chunk "
+                "boundary hook or the telemetry plane"
+            ),
+        )
+        for prim, count in sorted(hits.items())
+    ]
+
+
+# f64 reduction primitives that MAY carry float64 inside a body when the
+# value is a declared verdict/mass accumulator. Empty today: every engine
+# computes in float32 and keeps f64 on the host (models/runner.py
+# _finalize_result). Extend via the allowlist argument, not by widening
+# this set.
+_F64_ACCUMULATOR_PRIMS: frozenset = frozenset()
+
+
+def check_dtype_policy(cell, allowlist: frozenset = _F64_ACCUMULATOR_PRIMS,
+                       ) -> list[Finding]:
+    """No f64 avals (and hence no weak-type f64 promotions) in the body.
+
+    Meaningful only when ``cell`` was traced under
+    ``jax.experimental.enable_x64()`` — without x64 every float is forced
+    to f32 and the scan can never fire. ``matrix.audit_matrix`` traces the
+    dtype cells that way."""
+    hits: dict[str, int] = {}
+    for eqn, in_body in jaxpr_walk.iter_eqns(cell.closed_jaxpr.jaxpr):
+        if not in_body or eqn.primitive.name in allowlist:
+            continue
+        for v in eqn.outvars:
+            dt = getattr(getattr(v, "aval", None), "dtype", None)
+            if dt is not None and str(dt) == "float64":
+                hits[eqn.primitive.name] = hits.get(eqn.primitive.name, 0) + 1
+    return [
+        Finding(
+            checker="dtype-policy",
+            where=_cell_where(cell),
+            rule=f"body-f64-{prim}",
+            detail=(
+                f"{count}x {prim} produces float64 inside the loop body "
+                "under an x64 trace — a stray f64 plane or a weak-type "
+                "promotion (np.float64/Python-float scalar reaching f32 "
+                "arithmetic); pin the scalar's dtype"
+            ),
+        )
+        for prim, count in sorted(hits.items())
+    ]
+
+
+_MAIN_SIG = re.compile(r"@main\((.*?)\)\s*->", re.S)
+# One compiled-HLO alias entry: "{out...}: (param, {...}" — we only need
+# the source param number.
+_ALIAS_ENTRY = re.compile(r"\{[^{}]*\}:\s*\((\d+)\s*,")
+
+
+def _lowered(cell):
+    """Lower the cell's chunk with the donation the run reported. Sharded
+    cells captured an already-jitted fn (donate_argnums baked in); the
+    single-device paths hand the probe the plain jittable.
+
+    Returns None when the cell cannot LOWER on this backend: the
+    ``halo_dma='on'`` cells build TPU-style async-remote-copy kernels
+    (interpret=False) that trace hardware-free for the wire counts but
+    have no CPU lowering. Their donation contract is covered by the wire
+    sibling — same chunk skeleton, same carry, interpret-mode kernels."""
+    import jax
+
+    fn = cell.fn
+    if not hasattr(fn, "lower"):
+        fn = jax.jit(fn, donate_argnums=(0,) if cell.donate else ())
+    try:
+        return fn.lower(*cell.args)
+    except ValueError as e:
+        if "interpret mode" in str(e):
+            return None
+        raise
+
+
+def check_donation(cell, compile_check: bool = False) -> list[Finding]:
+    """Donation must cover the whole state carry when donate=True.
+
+    Lowering level: every state leaf (args 0..N-1 of the flat @main
+    signature) must carry ``tf.aliasing_output`` (alias resolved) or
+    ``jax.buffer_donor`` (deferred to the compiler). ``compile_check``
+    additionally compiles and requires every state-leaf param to appear as
+    a source in the HLO ``input_output_alias`` map — the proof that a
+    deferred donor actually aliased instead of silently copying."""
+    if not cell.donate:
+        return []
+    findings = []
+    where = _cell_where(cell)
+    lowered = _lowered(cell)
+    if lowered is None:  # no CPU lowering (dma cells) — see _lowered
+        return []
+    sig = _MAIN_SIG.search(lowered.as_text())
+    n_leaves = cell.state_leaves
+    if sig is None:
+        return [Finding(
+            checker="donation", where=where, rule="unparseable-lowering",
+            detail="no @main signature in the lowered module",
+        )]
+    params = re.split(r"%arg\d+", sig.group(1))[1:]
+    for i, param in enumerate(params[:n_leaves]):
+        if "tf.aliasing_output" not in param and (
+            "jax.buffer_donor" not in param
+        ):
+            findings.append(Finding(
+                checker="donation", where=where, rule=f"state-leaf-{i}",
+                detail=(
+                    f"state-carry leaf {i} of {n_leaves} is neither "
+                    "aliased nor marked donor in the lowering while the "
+                    "run reported donate=True — the donated buffer is "
+                    "silently copied every chunk"
+                ),
+            ))
+    if compile_check and not findings:
+        txt = lowered.compile().as_text()
+        m = re.search(r"input_output_alias=\{(.*?)\}[,\s]*entry", txt, re.S)
+        aliased = (
+            {int(p) for p in _ALIAS_ENTRY.findall(m.group(1))} if m else set()
+        )
+        for i in range(n_leaves):
+            if i not in aliased:
+                findings.append(Finding(
+                    checker="donation", where=where,
+                    rule=f"compiled-state-leaf-{i}",
+                    detail=(
+                        f"state-carry leaf {i} of {n_leaves} has no entry "
+                        "in the compiled input_output_alias map — donation "
+                        "was requested but the compiler could not alias it "
+                        "(shape/dtype mismatch between carry in and out?)"
+                    ),
+                ))
+    return findings

@@ -1,0 +1,152 @@
+"""Region-aware jaxpr visitor — the auditor's one program walker.
+
+Generalized from benchmarks/comm_audit.py's ad-hoc walk (which is now a
+thin client): a single recursive descent over a closed jaxpr that
+
+- tracks the REGION of every equation — ``body`` (inside a while loop's
+  cond or body, i.e. the per-round / per-super-step steady state) vs
+  ``setup`` (the rest of the dispatch, paid once per chunk);
+- descends into every sub-jaxpr a primitive carries (cond/body of while,
+  branches of cond, pjit/shard_map/custom_* calls, and pallas_call's
+  kernel jaxpr), so in-kernel structure is visible to the same visitor;
+- classifies Pallas ``dma_start`` equations as LOCAL (HBM<->VMEM copies)
+  vs REMOTE (``make_async_remote_copy`` neighbor DMAs, carrying a
+  device_id operand) and sizes the transfer.
+
+Primitive taxonomies live here so every checker names the same sets:
+COLLECTIVE_PRIMS (XLA cross-device collectives), REMOTE_DMA (the
+pseudo-collective), HOST_SYNC_PRIMS (host round-trips that must never
+appear inside a chunk-loop body).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator
+
+import numpy as np
+
+# XLA cross-device collectives (jaxpr primitive names).
+COLLECTIVE_PRIMS = (
+    "ppermute", "psum", "all_gather", "reduce_scatter", "all_to_all",
+)
+
+# Pseudo-collective: an in-kernel async remote copy (neighbor DMA). Not an
+# XLA collective — counted separately so the mechanism column can assert
+# the halo path carries NO XLA collective while still shipping bytes.
+REMOTE_DMA = "remote_dma"
+
+# Host round-trips: each of these forces a device->host sync (or a host
+# callback) every time it executes. Inside a chunk-loop body that is once
+# per ROUND — the exact per-dispatch cost the chunked drivers exist to
+# amortize away — so the host-sync checker forbids them there. Outside the
+# body they are merely discouraged (setup runs once per chunk).
+HOST_SYNC_PRIMS = (
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "infeed", "outfeed", "host_local_array_to_global_array",
+)
+
+
+def aval_bytes(aval) -> int:
+    """Payload bytes of one abstract value; 0 for tokens/abstract units."""
+    try:
+        return int(np.prod(aval.shape)) * aval.dtype.itemsize
+    except Exception:  # noqa: BLE001 — abstract tokens etc. carry no bytes
+        return 0
+
+
+def sub_jaxprs(eqn):
+    """(jaxpr, enters_loop_body) for every sub-jaxpr of an eqn. A while
+    loop's cond and body both run once per iteration, so both count as
+    loop-body regions; everything else (pjit/shard_map/cond branches/
+    pallas_call kernels) inherits the caller's region."""
+    for _name, val in eqn.params.items():
+        vals = val if isinstance(val, (list, tuple)) else [val]
+        for v in vals:
+            jx = getattr(v, "jaxpr", None)
+            if jx is not None:
+                yield jx, eqn.primitive.name == "while"
+            elif hasattr(v, "eqns"):
+                yield v, eqn.primitive.name == "while"
+
+
+def remote_dma_info(eqn):
+    """(is_remote, bytes) for a Pallas ``dma_start`` eqn. The primitive's
+    flat operands unflatten through its ``tree`` param into (src_ref,
+    src_transforms, dst_ref, dst_transforms, sems...); a REMOTE copy
+    carries a non-empty device_id leaf at the tail, a local HBM<->VMEM
+    copy carries None. Bytes = the sliced source shape (the NDIndexer's
+    static slice sizes) x itemsize; 0 when the indexer cannot be sized."""
+    import jax
+
+    try:
+        tup = jax.tree_util.tree_unflatten(eqn.params["tree"], eqn.invars)
+    except Exception:  # noqa: BLE001 — unfamiliar tree layout
+        return False, 0
+    dev = tup[-1]
+    if dev is None or dev == ():
+        return False, 0
+    size = 0
+    try:
+        src, src_transforms = tup[0], tup[1]
+        shape = None
+        for tr in src_transforms or ():
+            get_shape = getattr(tr, "get_indexer_shape", None)
+            if get_shape is not None:
+                shape = tuple(get_shape())
+        if shape is None:
+            shape = tuple(src.aval.shape)
+        size = int(np.prod(shape)) * src.aval.dtype.itemsize
+    except Exception:  # noqa: BLE001 — bytes are best-effort
+        size = 0
+    return True, size
+
+
+def walk(jaxpr, visit: Callable[[object, bool], None],
+         in_body: bool = False) -> None:
+    """Depth-first visit of every eqn: ``visit(eqn, in_body)`` with
+    ``in_body`` True inside any while loop's cond/body (transitively)."""
+    for eqn in jaxpr.eqns:
+        visit(eqn, in_body)
+        for sub, enters_body in sub_jaxprs(eqn):
+            walk(sub, visit, in_body or enters_body)
+
+
+def iter_eqns(jaxpr, in_body: bool = False) -> Iterator[tuple]:
+    """Generator form of ``walk``: yields (eqn, in_body) pairs."""
+    for eqn in jaxpr.eqns:
+        yield eqn, in_body
+        for sub, enters_body in sub_jaxprs(eqn):
+            yield from iter_eqns(sub, in_body or enters_body)
+
+
+def collect_collectives(jaxpr) -> dict:
+    """Count collective primitives (and remote DMAs) by region over one
+    closed/open jaxpr: {"body": {prim: {"count", "bytes"}}, "setup": ...}.
+    """
+    counts = {"body": {}, "setup": {}}
+
+    def visit(eqn, in_body):
+        name = eqn.primitive.name
+        if name in COLLECTIVE_PRIMS:
+            region = counts["body" if in_body else "setup"]
+            slot = region.setdefault(name, {"count": 0, "bytes": 0})
+            slot["count"] += 1
+            slot["bytes"] += sum(aval_bytes(v.aval) for v in eqn.invars)
+        elif name == "dma_start":
+            remote, size = remote_dma_info(eqn)
+            if remote:
+                region = counts["body" if in_body else "setup"]
+                slot = region.setdefault(REMOTE_DMA, {"count": 0, "bytes": 0})
+                slot["count"] += 1
+                slot["bytes"] += size
+
+    walk(jaxpr, visit)
+    return counts
+
+
+def count_collectives(fn, args) -> dict:
+    """Trace ``fn(*args)`` to a jaxpr and count collective primitives by
+    region (inside/outside while bodies). Never executes the program."""
+    import jax
+
+    return collect_collectives(jax.make_jaxpr(fn)(*args).jaxpr)

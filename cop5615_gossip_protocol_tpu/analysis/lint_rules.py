@@ -1,0 +1,352 @@
+"""AST lints for repo conventions — the rules a jaxpr trace cannot see.
+
+Three rule families, each an independent pass returning ``report.Finding``
+records (all take a ``root`` so the fixture tests can point them at a
+seeded-bad tree):
+
+host-conversion (``check_host_conversions``)
+    Inside TRACED scopes in the ``ops/``/``parallel/``/``models/`` hot
+    paths, forbid forcing a traced value to the host: ``.item()``,
+    ``np.asarray(...)``, and ``int()``/``float()``/``bool()`` applied to a
+    traced-scope parameter. A traced scope is a function passed (by name)
+    into a tracing entry point — ``lax.while_loop``/``fori_loop``/
+    ``scan``/``cond``/``switch``, ``pl.pallas_call``, ``jax.jit``,
+    ``shard_map`` — plus everything nested inside one. Each of these
+    either crashes at trace time (wasting the dispatch) or, worse,
+    silently freezes a traced value at its tracer-constant. The check is
+    name-level dataflow (an expression mentioning a scope parameter), the
+    static approximation that catches the real bug class with no false
+    positives on static plan math.
+
+schema-lockstep (``check_schema_lockstep``)
+    Every row/record builder that emits a ``"schema_version"`` key must
+    source the value from a ``*SCHEMA_VERSION`` module constant — never an
+    int literal — and every ``*SCHEMA_VERSION`` constant must actually be
+    read somewhere in its module. Together these pin the repo's
+    version-bump discipline: you cannot widen a row format without the
+    constant moving with it (utils/events.py, utils/metrics.py,
+    ops/telemetry.py, serving/server.py all carry one).
+
+refusal-names-composition (``check_refusals``)
+    The PR 10 rule, enforced: every STATIC engine-refusal message in the
+    models/runner.py ladder (a ``raise ValueError`` whose text names an
+    engine override) must name a real serving composition or route
+    (tokens derived from analysis/wire_specs.SPEC_HOMES plus the
+    single-device engines) instead of dead-ending. Messages built from
+    interpolated call results (e.g. a ``*_support`` reason) are dynamic
+    and skipped — the static text around them is still checked when it
+    carries the refusal.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from .report import Finding
+
+PACKAGE_ROOT = Path(__file__).resolve().parents[1]
+
+# Call targets whose function-valued arguments are traced. Matching is on
+# the callee's final attribute/name, so jax.lax.while_loop, lax.while_loop
+# and a bare while_loop all hit.
+_TRACING_ENTRY_POINTS = frozenset({
+    "while_loop", "fori_loop", "scan", "cond", "switch", "pallas_call",
+    "jit", "shard_map", "run_scoped", "custom_vjp", "custom_jvp", "vmap",
+    "pmap", "checkpoint", "remat",
+})
+
+# Hot-path directories for the host-conversion lint (relative to the
+# package root).
+_HOT_DIRS = ("ops", "parallel", "models")
+
+
+def _callee_name(func: ast.expr) -> str:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _iter_py(root: Path, subdirs=None):
+    dirs = [root / d for d in subdirs] if subdirs else [root]
+    for d in dirs:
+        if not d.exists():
+            continue
+        for path in sorted(d.rglob("*.py")):
+            yield path
+
+
+def _traced_functions(tree: ast.AST) -> list:
+    """FunctionDef/Lambda nodes handed (by name or inline) to a tracing
+    entry point anywhere in the module, plus every def nested inside one.
+    """
+    defs: dict[str, list] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+    traced: list = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and _callee_name(node.func) in _TRACING_ENTRY_POINTS):
+            continue
+        for arg in list(node.args) + [k.value for k in node.keywords]:
+            if isinstance(arg, ast.Name) and arg.id in defs:
+                traced.extend(defs[arg.id])
+            elif isinstance(arg, (ast.Lambda, ast.FunctionDef)):
+                traced.append(arg)
+    # Everything nested inside a traced def is traced too.
+    out = []
+    for fn in traced:
+        out.append(fn)
+        for sub in ast.walk(fn):
+            if sub is not fn and isinstance(
+                sub, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                out.append(sub)
+    return out
+
+
+def _fn_params(fn) -> set:
+    a = fn.args
+    return {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs} | (
+        {a.vararg.arg} if a.vararg else set()
+    ) | ({a.kwarg.arg} if a.kwarg else set())
+
+
+def check_host_conversions(root: Path | None = None) -> list[Finding]:
+    """No host-forcing conversions inside traced scopes (hot paths)."""
+    root = root or PACKAGE_ROOT
+    subdirs = _HOT_DIRS if root == PACKAGE_ROOT else None
+    findings = []
+    for path in _iter_py(root, subdirs):
+        rel = str(path.relative_to(root.parent if subdirs else root))
+        tree = ast.parse(path.read_text(), filename=rel)
+        for fn in _traced_functions(tree):
+            params = _fn_params(fn)
+            name = getattr(fn, "name", "<lambda>")
+            body = fn.body if isinstance(fn.body, list) else [fn.body]
+            for stmt in body:
+                for node in ast.walk(stmt):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    callee = node.func
+                    if (isinstance(callee, ast.Attribute)
+                            and callee.attr == "item" and not node.args):
+                        findings.append(Finding(
+                            checker="lint", where=f"{rel}::{name}",
+                            rule="traced-item",
+                            detail=(
+                                f".item() at line {node.lineno} inside the "
+                                f"traced scope {name!r} — forces a device->"
+                                "host sync (or crashes at trace time); "
+                                "return the value and read it at a chunk "
+                                "boundary"
+                            ),
+                        ))
+                    elif (isinstance(callee, ast.Attribute)
+                            and callee.attr == "asarray"
+                            and isinstance(callee.value, ast.Name)
+                            and callee.value.id in ("np", "numpy")):
+                        findings.append(Finding(
+                            checker="lint", where=f"{rel}::{name}",
+                            rule="traced-np-asarray",
+                            detail=(
+                                f"np.asarray at line {node.lineno} inside "
+                                f"the traced scope {name!r} — materializes "
+                                "a traced value on the host; use jnp"
+                            ),
+                        ))
+                    elif (isinstance(callee, ast.Name)
+                            and callee.id in ("int", "float", "bool")
+                            and node.args and any(
+                                isinstance(sub, ast.Name)
+                                and sub.id in params
+                                for sub in ast.walk(node.args[0]))):
+                        findings.append(Finding(
+                            checker="lint", where=f"{rel}::{name}",
+                            rule=f"traced-{callee.id}",
+                            detail=(
+                                f"{callee.id}() on a traced-scope "
+                                f"parameter at line {node.lineno} in "
+                                f"{name!r} — freezes the tracer to a "
+                                "Python scalar; keep it a jnp value"
+                            ),
+                        ))
+    return findings
+
+
+def check_schema_lockstep(root: Path | None = None) -> list[Finding]:
+    """schema_version values come from constants; constants are used."""
+    root = root or PACKAGE_ROOT
+    findings = []
+    for path in _iter_py(root):
+        rel = str(path.relative_to(root.parent))
+        tree = ast.parse(path.read_text(), filename=rel)
+        constants: set[str] = set()
+        loads: set[str] = set()
+        for node in ast.walk(tree):
+            targets = (
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target]
+                if isinstance(node, ast.AnnAssign) and node.value is not None
+                else []
+            )
+            for tgt in targets:
+                if isinstance(tgt, ast.Name) and tgt.id.endswith(
+                    "SCHEMA_VERSION"
+                ):
+                    constants.add(tgt.id)
+            if isinstance(node, ast.Name) and isinstance(
+                node.ctx, ast.Load
+            ) and node.id.endswith("SCHEMA_VERSION"):
+                loads.add(node.id)
+            if isinstance(node, ast.Dict):
+                for k, v in zip(node.keys, node.values):
+                    if not (isinstance(k, ast.Constant)
+                            and k.value == "schema_version"):
+                        continue
+                    ok = (isinstance(v, ast.Name)
+                          and v.id.endswith("SCHEMA_VERSION")) or (
+                        isinstance(v, ast.Attribute)
+                        and v.attr.endswith("SCHEMA_VERSION"))
+                    if not ok:
+                        findings.append(Finding(
+                            checker="lint", where=f"{rel}:schema_version",
+                            rule="schema-literal",
+                            detail=(
+                                f"row builder at line {k.lineno} writes "
+                                "schema_version from "
+                                f"{ast.unparse(v)!r} — source it from the "
+                                "module's *SCHEMA_VERSION constant so the "
+                                "format cannot move without the version"
+                            ),
+                        ))
+        for const in sorted(constants - loads):
+            findings.append(Finding(
+                checker="lint", where=f"{rel}::{const}",
+                rule="schema-constant-unused",
+                detail=(
+                    f"{const} is defined but never read in {rel} — its row "
+                    "builder is versioning some other way; wire the "
+                    "constant through or delete it"
+                ),
+            ))
+    return findings
+
+
+def _composition_tokens() -> tuple:
+    """Tokens that count as naming a real serving composition/route,
+    derived from the wire-spec registry (so the lint can never accept a
+    name the engine matrix does not actually serve)."""
+    from .wire_specs import SPEC_HOMES
+
+    toks = {"chunked", "composition", "batched semantics"}
+    toks.update(SPEC_HOMES)
+    return tuple(sorted(toks))
+
+
+def _static_text(node: ast.expr, str_locals: dict,
+                 call_locals: set) -> tuple[str, bool]:
+    """(joined static text, delegates_to_computed_reason) of a message
+    expr. Interpolated NAMES resolve through same-function string-literal
+    assignments. ONLY an interpolated call result — a direct call, or a
+    name assigned from one (a ``*_support`` reason) — counts as
+    delegating the refusal text to another surface; interpolated DATA
+    (``{cfg.topology}``, subscripts, parameters) does not exempt the
+    static text around it from naming a composition."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value, False
+    if isinstance(node, ast.JoinedStr):
+        text, dyn = "", False
+        for part in node.values:
+            t, d = _static_text(part, str_locals, call_locals)
+            text += t
+            dyn = dyn or d
+        return text, dyn
+    if isinstance(node, ast.FormattedValue):
+        return _static_text(node.value, str_locals, call_locals)
+    if isinstance(node, ast.Name) and node.id in str_locals:
+        return " ".join(str_locals[node.id]), False
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        lt, ld = _static_text(node.left, str_locals, call_locals)
+        rt, rd = _static_text(node.right, str_locals, call_locals)
+        return lt + rt, ld or rd
+    if isinstance(node, ast.Call):
+        return "", True
+    if isinstance(node, ast.Name) and node.id in call_locals:
+        return "", True
+    return "", False
+
+
+# The runner-ladder functions whose ValueError raises are engine refusals.
+_LADDER_FUNCS = ("run", "_run_resolved", "_run_fused", "_strict_engine",
+                 "_engine_ladder")
+
+
+def check_refusals(runner_path: Path | None = None) -> list[Finding]:
+    """Every static engine-refusal in the runner ladder names a real
+    composition (see module docstring)."""
+    path = runner_path or (PACKAGE_ROOT / "models" / "runner.py")
+    rel = str(path.relative_to(path.parents[2]))
+    tree = ast.parse(path.read_text(), filename=rel)
+    tokens = _composition_tokens()
+    findings = []
+    for fn in tree.body:
+        if not (isinstance(fn, ast.FunctionDef)
+                and fn.name in _LADDER_FUNCS):
+            continue
+        # Local names assigned string literals (static refusal `reason`s)
+        # vs assigned from calls (computed reasons — a *_support result).
+        str_locals: dict[str, list] = {}
+        call_locals: set = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 and (
+                isinstance(node.targets[0], ast.Name)
+            ):
+                text, dyn = _static_text(node.value, {}, set())
+                if text and not dyn:
+                    str_locals.setdefault(
+                        node.targets[0].id, []
+                    ).append(text)
+                elif isinstance(node.value, ast.Call):
+                    call_locals.add(node.targets[0].id)
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Raise)
+                    and isinstance(node.exc, ast.Call)
+                    and _callee_name(node.exc.func) == "ValueError"
+                    and node.exc.args):
+                continue
+            text, delegated = _static_text(
+                node.exc.args[0], str_locals, call_locals
+            )
+            is_refusal = "engine='" in text or "engine override" in text
+            if not is_refusal:
+                continue
+            if delegated and not any(t in text for t in tokens):
+                # Message interpolates a computed reason (a *_support
+                # result) — judged by that surface, not here.
+                continue
+            if not any(t in text for t in tokens):
+                findings.append(Finding(
+                    checker="lint", where=f"{rel}::{fn.name}:{node.lineno}",
+                    rule="refusal-dead-end",
+                    detail=(
+                        f"engine refusal at line {node.lineno} names no "
+                        "real serving composition — tell the caller which "
+                        "engine/composition serves this config (tokens: "
+                        "chunked, sharded, ..., 'composition') instead of "
+                        "dead-ending"
+                    ),
+                ))
+    return findings
+
+
+def run_lints(root: Path | None = None) -> list[Finding]:
+    """All three lint families over the real tree."""
+    out = check_host_conversions(root)
+    out += check_schema_lockstep(root)
+    if root is None:
+        out += check_refusals()
+    return out

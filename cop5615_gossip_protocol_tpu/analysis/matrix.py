@@ -1,0 +1,226 @@
+"""The audited engine matrix: every cell, every checker, one driver.
+
+``AUDIT_GRID`` is the canonical list of sharded engine x topology x
+algorithm x transport cells (benchmarks/comm_audit.py renders the same
+grid as a table; tests/test_comm_audit.py pins declaration <-> trace
+agreement over the tier-1 subset). ``SINGLE_GRID`` adds the single-device
+chunked/fused cells reachable through models.runner.run's probe hook on
+CPU.
+
+``audit_matrix`` traces every cell ONCE under ``jax.experimental
+.enable_x64`` (so the dtype-policy scan can see weak-type f64 promotions
+— counts and region structure are dtype-independent) and runs the full
+checker set:
+
+- wire-spec declaration diff + cross-schedule byte equality + (for the
+  dma transports) cross-transport byte equality (sharded cells);
+- host-sync freedom and dtype policy (every cell);
+- donation aliasing — lowering-level everywhere; compiled
+  ``input_output_alias`` proof on the cheap XLA engines ('sharded',
+  'chunked'), where a deferred ``jax.buffer_donor`` could silently not
+  alias;
+- the PRNG TAG MAP audit and the AST lint families (once per run, not
+  per cell).
+
+Populations are the smallest each composition's plan accepts; the audited
+structure (the jaxpr) is population-independent, so small is right.
+"""
+
+from __future__ import annotations
+
+from . import contracts, lint_rules, tags, trace, wire_specs
+from .report import Finding
+
+# (engine, topology, algorithm, n, n_devices, extra cfg) — the sharded
+# grid. halo_dma='on' rows trace the in-kernel async-remote-copy kernel
+# hardware-free; their wire siblings double as the transport-pair byte
+# oracle.
+AUDIT_GRID = (
+    ("sharded", "torus3d", "gossip", 4096, 8, {}),
+    ("sharded", "torus3d", "push-sum", 4096, 8, {}),
+    ("sharded", "full", "push-sum", 1024, 8, {"delivery": "pool"}),
+    # Non-divisible ring: no exact halo plan -> scatter + reduce-scatter
+    # fallback (wire batching does not apply; audited for the record).
+    ("sharded", "ring", "gossip", 1001, 8, {}),
+    ("fused-sharded", "torus3d", "gossip", 131072, 2,
+     {"engine": "fused", "chunk_rounds": 8}),
+    ("fused-sharded", "torus3d", "push-sum", 131072, 2,
+     {"engine": "fused", "chunk_rounds": 8}),
+    ("fused-pool-sharded", "full", "gossip", 131072, 2,
+     {"engine": "fused", "delivery": "pool"}),
+    ("fused-pool-sharded", "full", "push-sum", 131072, 2,
+     {"engine": "fused", "delivery": "pool"}),
+    ("hbm-sharded", "torus3d", "gossip", 125000, 2,
+     {"engine": "fused", "chunk_rounds": 8}),
+    ("hbm-sharded", "torus3d", "push-sum", 125000, 2,
+     {"engine": "fused", "chunk_rounds": 8}),
+    ("hbm-sharded", "torus3d", "gossip", 125000, 2,
+     {"engine": "fused", "chunk_rounds": 8, "halo_dma": "on"}),
+    ("hbm-sharded", "torus3d", "push-sum", 125000, 2,
+     {"engine": "fused", "chunk_rounds": 8, "halo_dma": "on"}),
+    ("imp-hbm-sharded", "imp3d", "gossip", 27000, 2,
+     {"engine": "fused", "delivery": "pool"}),
+    ("imp-hbm-sharded", "imp3d", "push-sum", 27000, 2,
+     {"engine": "fused", "delivery": "pool"}),
+    ("imp-hbm-sharded", "imp3d", "gossip", 27000, 2,
+     {"engine": "fused", "delivery": "pool", "halo_dma": "on"}),
+    ("imp-hbm-sharded", "imp3d", "push-sum", 27000, 2,
+     {"engine": "fused", "delivery": "pool", "halo_dma": "on"}),
+    ("pool2-sharded", "full", "gossip", 262144, 2,
+     {"engine": "fused", "delivery": "pool"}),
+    ("pool2-sharded", "full", "push-sum", 262144, 2,
+     {"engine": "fused", "delivery": "pool"}),
+)
+
+# Single-device cells through models.runner.run (n_devices=1): the chunked
+# XLA engine and each fused tier the dispatch resolves on CPU (interpret
+# mode — the probe fires before execution, so tracing stays hardware-free).
+SINGLE_GRID = (
+    ("chunked", "full", "gossip", 256, 1, {}),
+    ("chunked", "torus3d", "push-sum", 4096, 1, {}),
+    ("chunked", "ring", "gossip", 1001, 1, {}),
+    ("fused", "full", "gossip", 4096, 1,
+     {"engine": "fused", "delivery": "pool"}),
+    ("fused", "torus3d", "push-sum", 4096, 1,
+     {"engine": "fused", "chunk_rounds": 8}),
+)
+
+# Engines whose donation check also compiles and proves the HLO
+# input_output_alias map (cheap XLA programs; the Pallas compositions'
+# interpret-mode compiles are left to the execution suites).
+_COMPILE_DONATION_ENGINES = frozenset({"sharded", "chunked"})
+
+
+def setup_tracing_runtime(extra_devices: int = 0) -> None:
+    """The one jax bootstrap every tracing CLI shares: CPU platform pin
+    (this container's sitecustomize force-registers a TPU plugin — the
+    env var alone does not stick), the partitionable threefry the
+    cross-engine stream contract is defined over, and enough virtual host
+    devices for the widest AUDIT_GRID mesh. Divergence here between entry
+    points would silently audit different runtime configs."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from cop5615_gossip_protocol_tpu.utils import compat
+
+    jax.config.update("jax_threefry_partitionable", True)
+    need = max(extra_devices, max(g[4] for g in AUDIT_GRID))
+    compat.set_host_device_count(need)
+
+
+def _x64():
+    import jax
+
+    return jax.experimental.enable_x64()
+
+
+def _trace_cell_x64(engine, topo, algo, n, n_dev, overlap, extra):
+    with _x64():
+        cell = trace.trace_cell(engine, topo, algo, n, n_dev, overlap, extra)
+        cell.closed_jaxpr  # force the trace inside the x64 context
+    return cell
+
+
+def _report_of(cell) -> trace.AuditReport:
+    return trace.AuditReport(
+        engine=cell.engine, topology=cell.topology,
+        algorithm=cell.algorithm, n=cell.n, n_devices=cell.n_devices,
+        overlap=cell.overlap, counts=cell.counts,
+    )
+
+
+def _cell_contracts(cell, compile_check: bool) -> list[Finding]:
+    out = contracts.check_host_sync(cell)
+    out += contracts.check_dtype_policy(cell)
+    with _x64():
+        out += contracts.check_donation(cell, compile_check=compile_check)
+    return out
+
+
+def audit_matrix(grid=None, single_grid=None, quick: bool = False,
+                 progress=None) -> list[Finding]:
+    """Run every checker over every cell; returns the combined findings.
+
+    ``quick`` audits the XLA 'sharded'/'chunked' rows only (seconds).
+    ``progress`` is an optional callable(str) for CLI status lines."""
+    from cop5615_gossip_protocol_tpu import SimConfig, build_topology
+
+    say = progress or (lambda _msg: None)
+    findings: list[Finding] = []
+    grid = AUDIT_GRID if grid is None else grid
+    single_grid = SINGLE_GRID if single_grid is None else single_grid
+
+    # Sharded cells, paired by schedule (and by transport for dma rows).
+    wire_reports: dict[tuple, trace.AuditReport] = {}
+    for engine, topo_name, algo, n, n_dev, extra in grid:
+        if quick and engine != "sharded":
+            continue
+        spec = wire_specs.get_spec(engine)
+        topo = build_topology(topo_name, n)
+        pair = {}
+        for overlap in (True, False):
+            say(f"trace {engine}/{topo_name}/{algo}"
+                f"{'/dma' if extra.get('halo_dma') == 'on' else ''}"
+                f" overlap={'on' if overlap else 'off'}")
+            cell = _trace_cell_x64(
+                engine, topo_name, algo, n, n_dev, overlap, extra
+            )
+            rep = _report_of(cell)
+            pair[overlap] = rep
+            cfg = SimConfig(
+                n=n, topology=topo_name, algorithm=algo,
+                overlap_collectives=overlap, **extra,
+            )
+            findings += wire_specs.check_report(rep, topo, cfg)
+            findings += _cell_contracts(
+                cell, compile_check=engine in _COMPILE_DONATION_ENGINES
+            )
+        findings += wire_specs.check_schedule_pair(
+            spec, pair[True], pair[False]
+        )
+        key = (engine, topo_name, algo, n, n_dev)
+        if extra.get("halo_dma") == "on":
+            wire = wire_reports.get(key)
+            if wire is None:
+                # A dma row with no traced wire sibling is a FINDING, not
+                # a silent skip — otherwise the dma-bytes-match guarantee
+                # would quietly depend on grid row ordering.
+                findings.append(Finding(
+                    checker="wire-spec",
+                    where=f"{engine}/{topo_name}/{algo}/dma",
+                    rule="no-wire-sibling",
+                    detail=(
+                        "halo_dma='on' grid row has no earlier wire-"
+                        "transport sibling with the same (engine, "
+                        "topology, algorithm, n, n_devices) — the cross-"
+                        "transport byte equality cannot be checked; add "
+                        "or reorder the wire row in AUDIT_GRID"
+                    ),
+                ))
+            else:
+                findings += wire_specs.check_transport_pair(
+                    spec, wire, pair[True]
+                )
+        else:
+            wire_reports[key] = pair[True]
+
+    # Single-device cells: no WIRE_SPEC (nothing on the wire), contracts
+    # only.
+    for engine, topo_name, algo, n, n_dev, extra in single_grid:
+        if quick and engine != "chunked":
+            continue
+        for overlap in (True, False):
+            say(f"trace {engine}/{topo_name}/{algo}"
+                f" overlap={'on' if overlap else 'off'}")
+            cell = _trace_cell_x64(
+                engine, topo_name, algo, n, n_dev, overlap, extra
+            )
+            findings += _cell_contracts(
+                cell, compile_check=engine in _COMPILE_DONATION_ENGINES
+            )
+
+    say("prng-tag map")
+    findings += tags.check_tags()
+    say("ast lints")
+    findings += lint_rules.run_lints()
+    return findings

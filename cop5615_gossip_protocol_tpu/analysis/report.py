@@ -1,0 +1,105 @@
+"""Findings, the suppression baseline, and report rendering.
+
+A Finding is one contract violation with a STABLE fingerprint — checker +
+location + rule — so the committed baseline (analysis/baseline.json) can
+suppress known, justified findings without pinning line numbers or message
+wording. The CLI (analysis/__main__.py) exits non-zero on any finding whose
+fingerprint is not baselined, and reports baselined fingerprints that no
+longer fire (stale suppressions) so the baseline can only shrink.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from pathlib import Path
+
+# Default committed baseline, next to this module.
+BASELINE_PATH = Path(__file__).resolve().parent / "baseline.json"
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One contract violation.
+
+    checker   — which checker fired (wire-spec, host-sync, donation,
+                dtype-policy, prng-tags, lint).
+    where     — stable location: a file path, an engine/topology/algorithm
+                cell, or a symbol name. Never a line number.
+    rule      — short machine id of the violated rule within the checker.
+    detail    — human sentence; excluded from the fingerprint so wording
+                can improve without churning baselines.
+    """
+
+    checker: str
+    where: str
+    rule: str
+    detail: str
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.checker}::{self.where}::{self.rule}"
+
+    def to_record(self) -> dict:
+        rec = dataclasses.asdict(self)
+        rec["fingerprint"] = self.fingerprint
+        return rec
+
+
+def load_baseline(path: Path | str | None = None) -> dict:
+    """The committed suppression baseline: {"suppressions": [{fingerprint,
+    reason}, ...]}. Missing file = empty baseline."""
+    p = Path(path) if path is not None else BASELINE_PATH
+    if not p.exists():
+        return {"suppressions": []}
+    with open(p) as f:
+        data = json.load(f)
+    if not isinstance(data.get("suppressions"), list):
+        raise ValueError(f"baseline {p} must carry a 'suppressions' list")
+    for s in data["suppressions"]:
+        if "fingerprint" not in s or "reason" not in s:
+            raise ValueError(
+                f"baseline entry {s!r} needs 'fingerprint' and 'reason' "
+                "(a suppression without a recorded justification is just "
+                "a deleted finding)"
+            )
+    return data
+
+
+def apply_baseline(findings, baseline: dict):
+    """Split findings into (new, suppressed) and report stale suppressions.
+
+    Returns (new_findings, suppressed_findings, stale_fingerprints)."""
+    allowed = {s["fingerprint"] for s in baseline.get("suppressions", [])}
+    new = [f for f in findings if f.fingerprint not in allowed]
+    suppressed = [f for f in findings if f.fingerprint in allowed]
+    fired = {f.fingerprint for f in findings}
+    stale = sorted(allowed - fired)
+    return new, suppressed, stale
+
+
+def render_table(findings) -> list[str]:
+    """Markdown findings table (empty list for a clean tree)."""
+    if not findings:
+        return ["No findings."]
+    out = [
+        "| checker | where | rule | detail |",
+        "|---|---|---|---|",
+    ]
+    for f in sorted(findings, key=lambda x: x.fingerprint):
+        detail = f.detail.replace("|", "\\|").replace("\n", " ")
+        out.append(f"| {f.checker} | {f.where} | {f.rule} | {detail} |")
+    return out
+
+
+def write_json(findings, new, suppressed, stale, path: str) -> None:
+    """CI artifact: every finding plus the baseline disposition."""
+    rec = {
+        "total": len(findings),
+        "new": [f.to_record() for f in new],
+        "suppressed": [f.to_record() for f in suppressed],
+        "stale_suppressions": stale,
+    }
+    with open(path, "w") as f:
+        json.dump(rec, f, indent=2)
+        f.write("\n")

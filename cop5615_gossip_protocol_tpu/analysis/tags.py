@@ -1,0 +1,227 @@
+"""The PRNG fold_in TAG MAP, machine-verified.
+
+ops/faults.py's module docstring is the canonical human-readable TAG MAP:
+every stream that folds into ``PRNGKey(cfg.seed)`` (or the runner's base
+key) must occupy a region pairwise disjoint from every other, or two
+"independent" streams silently share bits. Historically that disjointness
+was proved by prose; this module proves it mechanically:
+
+1. ``REGISTRY`` rebuilds the map from the REAL constants (imported from
+   ops/faults, ops/sampling, models/sweep, models/runner — the values can
+   never drift from what the engines fold), at both stream levels:
+   base-key regions and the per-ROUND-key tags.
+2. ``check_disjoint`` asserts the base-key regions are pairwise disjoint
+   (and the round-key tags pairwise distinct) by interval arithmetic.
+3. ``harvest_fold_ins`` walks every module's AST for ``fold_in`` call
+   sites and classifies the tag operand: a registered tag name, a
+   registered region base (+ offset), or a round-index fold. Any fold
+   whose tag it cannot classify — and any ``*_TAG*`` constant assigned
+   anywhere in the package but absent from the registry — is a finding,
+   so a new stream CANNOT be added without extending the map.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from .report import Finding
+
+# Package root (the scanned tree).
+PACKAGE_ROOT = Path(__file__).resolve().parents[1]
+
+# Round indices fold directly into the base key; SimConfig caps max_rounds
+# at 2**30 exactly to keep this region closed (config.py validation).
+ROUND_REGION_END = 2**30
+
+
+def registry() -> dict:
+    """The TAG MAP as data, rebuilt from the engine constants.
+
+    ``base``: {name: (start, end)} half-open intervals folded into the
+    base key. ``round``: {name: tag} singletons folded into per-round keys
+    (a separate stream level — they need only be distinct from each
+    other)."""
+    from ..models import runner, sweep
+    from ..ops import faults, sampling
+
+    base = {
+        "round-indices": (0, ROUND_REGION_END),
+        "CRASH_TAG": (faults.CRASH_TAG, faults.CRASH_TAG + 1),
+        "REVIVE_TAG": (faults.REVIVE_TAG, faults.REVIVE_TAG + 1),
+        "REPLICA_TAG0": (
+            sweep.REPLICA_TAG0, sweep.REPLICA_TAG0 + sweep.MAX_REPLICAS,
+        ),
+        # Batch filler lanes are capped at MAX_REPLICAS total
+        # (models/sweep.run_batched_keys validates lanes <= MAX_REPLICAS).
+        "LANE_FILLER_TAG0": (
+            sweep.LANE_FILLER_TAG0,
+            sweep.LANE_FILLER_TAG0 + sweep.MAX_REPLICAS,
+        ),
+        "_LEADER_TAG": (runner._LEADER_TAG, runner._LEADER_TAG + 1),
+    }
+    round_level = {
+        "_POOL_TAG": sampling._POOL_TAG,
+        "IMP_CHOICE_TAG": sampling.IMP_CHOICE_TAG,
+        "GATE_TAG": sampling.GATE_TAG,
+        "DUP_TAG": sampling.DUP_TAG,
+    }
+    return {"base": base, "round": round_level}
+
+
+def check_disjoint(reg: dict | None = None) -> list[Finding]:
+    """Pairwise disjointness of the base-key regions; distinctness of the
+    round-key tags; every tag within uint32 fold_in range."""
+    reg = reg or registry()
+    findings = []
+    base = sorted(reg["base"].items(), key=lambda kv: kv[1])
+    for (na, (sa, ea)), (nb, (sb, eb)) in zip(base, base[1:]):
+        if ea > sb:
+            findings.append(Finding(
+                checker="prng-tags", where=f"{na}+{nb}",
+                rule="base-region-overlap",
+                detail=(
+                    f"base-key regions overlap: {na}=[{sa}, {ea}) and "
+                    f"{nb}=[{sb}, {eb}) — two 'independent' streams share "
+                    "fold_in values"
+                ),
+            ))
+    for name, (start, end) in reg["base"].items():
+        if not (0 <= start < end <= 2**32):
+            findings.append(Finding(
+                checker="prng-tags", where=name, rule="base-region-range",
+                detail=f"region [{start}, {end}) escapes uint32 fold_in "
+                       "range",
+            ))
+    seen: dict[int, str] = {}
+    for name, tag in reg["round"].items():
+        if tag in seen:
+            findings.append(Finding(
+                checker="prng-tags", where=f"{seen[tag]}+{name}",
+                rule="round-tag-collision",
+                detail=f"round-key tags {seen[tag]} and {name} share value "
+                       f"{tag:#x}",
+            ))
+        seen[tag] = name
+        if not (0 <= tag < 2**32):
+            findings.append(Finding(
+                checker="prng-tags", where=name, rule="round-tag-range",
+                detail=f"tag {tag:#x} escapes uint32 fold_in range",
+            ))
+    return findings
+
+
+def _tag_operand_names(node: ast.expr) -> list[str]:
+    """Identifier names appearing in a fold_in tag expression."""
+    names = []
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            names.append(sub.id)
+        elif isinstance(sub, ast.Attribute):
+            names.append(sub.attr)
+    return names
+
+
+def _fold_in_tag(node: ast.AST):
+    """The tag operand of a ``fold_in`` call in EITHER callee form —
+    ``key.fold_in(...)`` / ``jax.random.fold_in(key, tag)`` (attribute)
+    or a bare ``fold_in(key, tag)`` from-import (name) — positional or
+    ``data=`` keyword. None when ``node`` is not a fold_in call."""
+    if not isinstance(node, ast.Call):
+        return None
+    fn = node.func
+    callee = fn.attr if isinstance(fn, ast.Attribute) else (
+        fn.id if isinstance(fn, ast.Name) else None
+    )
+    if callee != "fold_in":
+        return None
+    if len(node.args) >= 2:
+        return node.args[1]
+    for kw in node.keywords:
+        if kw.arg == "data":
+            return kw.value
+    return None
+
+
+def _const_targets(node: ast.AST):
+    """Assignment target names of a plain or annotated assignment."""
+    if isinstance(node, ast.Assign):
+        return [t for t in node.targets if isinstance(t, ast.Name)]
+    if isinstance(node, ast.AnnAssign) and isinstance(
+        node.target, ast.Name
+    ) and node.value is not None:
+        return [node.target]
+    return []
+
+
+def harvest_fold_ins(root: Path | None = None,
+                     reg: dict | None = None) -> list[Finding]:
+    """AST-harvest every ``fold_in(key, tag)`` call under ``root`` and
+    flag (a) tag expressions naming no registered tag and no plausible
+    round-index variable shape, (b) integer-constant tags outside every
+    registered region, and (c) ``*_TAG``/``*_TAG0`` module constants not
+    present in the registry."""
+    root = root or PACKAGE_ROOT
+    reg = reg or registry()
+    known_names = set(reg["base"]) | set(reg["round"])
+    findings = []
+    region_list = list(reg["base"].values())
+    for path in sorted(root.rglob("*.py")):
+        rel = str(path.relative_to(root.parent))
+        tree = ast.parse(path.read_text(), filename=rel)
+        for node in ast.walk(tree):
+            # (c) unregistered *_TAG constants (plain or annotated
+            # assignments at any level).
+            for tgt in _const_targets(node):
+                if (tgt.id.endswith("_TAG") or tgt.id.endswith("_TAG0")
+                        ) and tgt.id not in known_names:
+                    findings.append(Finding(
+                        checker="prng-tags", where=f"{rel}::{tgt.id}",
+                        rule="unregistered-tag-constant",
+                        detail=(
+                            f"{tgt.id} is assigned in {rel} but absent "
+                            "from the analysis/tags.py registry — "
+                            "register it (and the ops/faults.py TAG "
+                            "MAP) before folding it"
+                        ),
+                    ))
+            tag = _fold_in_tag(node)
+            if tag is None:
+                continue
+            if isinstance(tag, ast.Constant) and isinstance(tag.value, int):
+                # (b) a literal tag must land in a registered region (the
+                # round region admits literal round indices like 0).
+                if not any(s <= tag.value < e for s, e in region_list):
+                    findings.append(Finding(
+                        checker="prng-tags",
+                        where=f"{rel}:{tag.value:#x}",
+                        rule="literal-tag-outside-map",
+                        detail=(
+                            f"fold_in literal {tag.value:#x} in {rel} lies "
+                            "in no registered TAG MAP region"
+                        ),
+                    ))
+                continue
+            names = _tag_operand_names(tag)
+            if any(n in known_names for n in names):
+                continue  # registered tag / region base (+ offset)
+            if any(n.endswith("_TAG") or n.endswith("_TAG0") for n in names):
+                findings.append(Finding(
+                    checker="prng-tags",
+                    where=f"{rel}:{ast.unparse(tag)}",
+                    rule="unregistered-tag-fold",
+                    detail=(
+                        f"fold_in tag expression {ast.unparse(tag)!r} in "
+                        f"{rel} names a *_TAG constant the registry does "
+                        "not know"
+                    ),
+                ))
+            # Otherwise: a round-index-class fold (a traced round variable
+            # or derived key) — the round region covers it by construction.
+    return findings
+
+
+def check_tags() -> list[Finding]:
+    """The full PRNG tag audit: registry disjointness + AST harvest."""
+    reg = registry()
+    return check_disjoint(reg) + harvest_fold_ins(reg=reg)

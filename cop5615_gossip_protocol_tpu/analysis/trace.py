@@ -1,0 +1,198 @@
+"""Hardware-free tracing of every engine's chunk program.
+
+One entry — ``trace_cell`` — builds the named engine's jitted chunk through
+its run function's ``probe`` hook (models/runner.run for the single-device
+chunked/fused paths, the parallel/ run functions for the six sharded
+compositions). The program is TRACED, never executed, so a full matrix
+audit runs in seconds on CPU with virtual devices; the captured
+``TracedCell`` carries the chunk callable, ready-to-trace arguments, the
+run's donation decision, and a cached closed jaxpr every checker shares.
+
+``audit_engine`` (the benchmarks/comm_audit.py entry, kept under its
+historical name) reduces a cell to an ``AuditReport`` of collective counts
+by region — the record the wire-spec checker diffs declarations against.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import importlib
+
+from . import jaxpr_walk
+from .wire_specs import SPEC_HOMES
+
+REMOTE_DMA = jaxpr_walk.REMOTE_DMA
+
+# Engine name -> the run function (in its SPEC_HOMES module) that owns the
+# probe hook. Keyed off the same registry as the wire contracts, so a
+# composition cannot be traceable without a declared spec home.
+_SHARDED_RUN_FNS = {
+    "sharded": "run_sharded",
+    "fused-sharded": "run_fused_sharded",
+    "fused-pool-sharded": "run_fused_pool_sharded",
+    "hbm-sharded": "run_stencil_hbm_sharded",
+    "imp-hbm-sharded": "run_imp_hbm_sharded",
+    "pool2-sharded": "run_pool2_sharded",
+}
+SHARDED_ENGINES = tuple(_SHARDED_RUN_FNS)
+# Single-device cells go through models.runner.run, which dispatches on
+# cfg.engine (and picks the fused tier from topology/population).
+SINGLE_ENGINES = ("chunked", "fused")
+
+
+@dataclasses.dataclass
+class TracedCell:
+    """One engine x config cell's chunk program, captured pre-execution."""
+
+    engine: str
+    topology: str
+    algorithm: str
+    n: int
+    n_devices: int
+    overlap: bool
+    extras: dict
+    fn: object  # the chunk callable (jitted for sharded compositions)
+    args: tuple  # ready-to-trace arguments
+    donate: bool  # the donation decision the run reported
+    info: dict = dataclasses.field(default_factory=dict)  # extra probe
+    # kwargs, e.g. the fused tier the single-device dispatch resolved
+    # ("variant")
+
+    @functools.cached_property
+    def closed_jaxpr(self):
+        import jax
+
+        return jax.make_jaxpr(self.fn)(*self.args)
+
+    @functools.cached_property
+    def counts(self) -> dict:
+        return jaxpr_walk.collect_collectives(self.closed_jaxpr.jaxpr)
+
+    @property
+    def state_leaves(self) -> int:
+        """Leaf count of the state-carry argument (always argument 0 of
+        every engine's chunk signature — the donated one)."""
+        import jax
+
+        return len(jax.tree_util.tree_leaves(self.args[0]))
+
+
+@dataclasses.dataclass
+class AuditReport:
+    """Collective counts for one engine x config x schedule."""
+
+    engine: str
+    topology: str
+    algorithm: str
+    n: int
+    n_devices: int
+    overlap: bool
+    # {"body": {prim: {"count": int, "bytes": int}}, "setup": {...}} —
+    # "body" is inside the chunk's while loop (per round / super-step),
+    # "setup" is the rest of the dispatch (paid once per chunk).
+    counts: dict
+
+    def body_count(self, prim: str) -> int:
+        return self.counts["body"].get(prim, {}).get("count", 0)
+
+    def setup_count(self, prim: str) -> int:
+        return self.counts["setup"].get(prim, {}).get("count", 0)
+
+    def body_bytes(self, prim: str) -> int:
+        return self.counts["body"].get(prim, {}).get("bytes", 0)
+
+    def halo_mechanism(self) -> str:
+        """How this composition's halo/delivery bytes move between
+        devices, decided from the counted program — never from config:
+        in-kernel-dma (Pallas async remote copies, zero XLA collectives
+        on the halo path), xla-ppermute (halo boundary wires),
+        all-gather (the pool composition's plane gather), scatter
+        (reduce_scatter fallback), or none (no inter-device delivery in
+        the body)."""
+        if self.body_count(REMOTE_DMA):
+            return "in-kernel-dma"
+        if self.body_count("ppermute"):
+            return "xla-ppermute"
+        if self.body_count("all_gather"):
+            return "all-gather"
+        if self.body_count("reduce_scatter"):
+            return "scatter"
+        return "none"
+
+    def to_record(self) -> dict:
+        rec = dataclasses.asdict(self)
+        rec["halo_mechanism"] = self.halo_mechanism()
+        return rec
+
+
+def _capture_probe(sink: dict):
+    def probe(chunk_fn, args, donate=False, **info):
+        sink.update(fn=chunk_fn, args=args, donate=donate, info=info)
+        return None
+
+    return probe
+
+
+def trace_cell(engine: str, topology: str, algorithm: str, n: int,
+               n_devices: int, overlap: bool,
+               cfg_overrides: dict | None = None) -> TracedCell:
+    """Build one engine's jitted chunk through its run function's ``probe``
+    hook and capture it without executing. ``engine`` is one of
+    SHARDED_ENGINES ('sharded' = chunked XLA under shard_map,
+    'fused-sharded' = VMEM lattice composition, 'fused-pool-sharded',
+    'hbm-sharded', 'imp-hbm-sharded', 'pool2-sharded') or SINGLE_ENGINES
+    ('chunked' / 'fused' — models.runner dispatch picks the fused tier)."""
+    from cop5615_gossip_protocol_tpu import SimConfig, build_topology
+
+    overrides = dict(cfg_overrides or {})
+    if engine in SINGLE_ENGINES:
+        overrides.setdefault("engine", engine)
+    cfg = SimConfig(
+        n=n, topology=topology, algorithm=algorithm,
+        overlap_collectives=overlap, **overrides,
+    )
+    topo = build_topology(topology, n)
+    sink: dict = {}
+    probe = _capture_probe(sink)
+    if engine in SINGLE_ENGINES:
+        from cop5615_gossip_protocol_tpu.models import runner
+
+        runner.run(topo, cfg, probe=probe)
+    elif engine in _SHARDED_RUN_FNS:
+        from cop5615_gossip_protocol_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(n_devices)
+        mod = importlib.import_module(SPEC_HOMES[engine])
+        run_fn = getattr(mod, _SHARDED_RUN_FNS[engine])
+        run_fn(topo, cfg, mesh=mesh, probe=probe)
+    else:
+        raise ValueError(
+            f"unknown engine {engine!r}: expected one of "
+            f"{SINGLE_ENGINES + SHARDED_ENGINES}"
+        )
+    if "fn" not in sink:
+        raise RuntimeError(
+            f"probe hook never fired for engine {engine!r} — the run "
+            "function returned without building a chunk"
+        )
+    return TracedCell(
+        engine=engine, topology=topology, algorithm=algorithm, n=n,
+        n_devices=n_devices, overlap=overlap, extras=dict(cfg_overrides or {}),
+        fn=sink["fn"], args=sink["args"], donate=sink["donate"],
+        info=sink.get("info") or {},
+    )
+
+
+def audit_engine(engine: str, topology: str, algorithm: str, n: int,
+                 n_devices: int, overlap: bool,
+                 cfg_overrides: dict | None = None) -> AuditReport:
+    """Trace one cell and reduce it to collective counts by region — the
+    benchmarks/comm_audit.py entry, unchanged in name and signature."""
+    cell = trace_cell(
+        engine, topology, algorithm, n, n_devices, overlap, cfg_overrides
+    )
+    return AuditReport(
+        engine=engine, topology=topology, algorithm=algorithm, n=n,
+        n_devices=n_devices, overlap=overlap, counts=cell.counts,
+    )

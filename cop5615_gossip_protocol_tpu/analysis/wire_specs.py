@@ -1,0 +1,270 @@
+"""Declarative per-composition collective wire contracts.
+
+Each sharded composition exports a ``WIRE_SPEC`` — its expected per-round /
+per-super-step (body) and per-dispatch (setup) collectives AS DATA, in
+terms of a handful of named structural quantities (state planes, send
+windows, halo offset classes, pool-roll stages). The counts live ONCE, in
+the composition's own module; this checker diffs the declaration against
+the TRACED chunk program (analysis/trace.py), so
+
+- tests/test_comm_audit.py asserts declaration <-> trace agreement instead
+  of duplicating literals, and
+- a new composition cannot ship without declaring its wire contract (an
+  engine with no WIRE_SPEC is itself a finding).
+
+This is the first externalized fragment of the ROADMAP item-4 plan IR: the
+declaration says what the composition's delivery plan SHOULD put on the
+wire; the trace proves the lowered program does exactly that.
+
+Count term language — ``C`` is a linear form over the wire environment:
+
+    expected = fixed + per_plane*planes + per_window*windows
+             + per_class*classes + per_pair*disp_pairs + per_roll*rolls
+
+where ``planes`` = state planes (gossip 3: count/active/conv; push-sum 4:
+s/w/term/conv), ``windows`` = batched send-summary windows (gossip 1,
+push-sum 2), ``classes`` = halo offset classes of the topology's exact
+plan, ``disp_pairs`` = round-invariant disp/deg exchange pairs
+(max_deg + 1), ``rolls`` = pool-roll ppermute count
+(pool_size * (log2(n_devices) + 1)). ``wire_env`` computes the environment
+from the same plan functions the engines call — never from the trace.
+
+STRICTNESS: within a declared region, every collective class not named
+must count ZERO in the trace. "imp DMA mode keeps zero XLA collectives on
+the halo path" is therefore not a special assertion — it falls out of the
+dma variant declaring no ppermute.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import math
+from typing import Mapping, Optional
+
+from .jaxpr_walk import COLLECTIVE_PRIMS, REMOTE_DMA
+from .report import Finding
+
+ALL_WIRE_PRIMS = tuple(COLLECTIVE_PRIMS) + (REMOTE_DMA,)
+
+
+@dataclasses.dataclass(frozen=True)
+class C:
+    """One collective class's expected count as a linear form over the
+    wire environment (see module docstring)."""
+
+    fixed: int = 0
+    per_plane: int = 0
+    per_window: int = 0
+    per_class: int = 0
+    per_pair: int = 0
+    per_roll: int = 0
+
+    def expected(self, env: Mapping[str, int]) -> int:
+        return (
+            self.fixed
+            + self.per_plane * env.get("planes", 0)
+            + self.per_window * env.get("windows", 0)
+            + self.per_class * env.get("classes", 0)
+            + self.per_pair * env.get("disp_pairs", 0)
+            + self.per_roll * env.get("rolls", 0)
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class Regions:
+    """Expected counts by region; unnamed collective classes must be 0."""
+
+    body: Mapping[str, C]
+    setup: Mapping[str, C]
+
+
+@dataclasses.dataclass(frozen=True)
+class WireSpec:
+    """One composition's declared wire contract.
+
+    variants   — (schedule, mode) -> Regions, schedule in
+                 {"overlap", "serial"} (cfg.overlap_collectives), mode the
+                 delivery/transport the run resolves ("wire"/"dma" for the
+                 halo engines; "halo"/"pool"/"scatter" for the chunked
+                 sharded engine).
+    mechanism  — mode -> the AuditReport.halo_mechanism() string the
+                 traced program must classify as.
+    equal_bytes — body byte payloads that must be identical across the two
+                 schedules of the same mode (batching changes packaging,
+                 not payload).
+    dma_bytes_match — when set, the dma mode's remote_dma body bytes must
+                 equal this prim's body bytes in wire mode at the same
+                 schedule (same payload, different transport).
+    """
+
+    engine: str
+    variants: Mapping[tuple, Regions]
+    mechanism: Mapping[str, str]
+    equal_bytes: tuple = ()
+    dma_bytes_match: Optional[str] = None
+
+
+# Engine name -> module exporting its WIRE_SPEC (lazy: importing a spec
+# must not drag every composition in).
+SPEC_HOMES = {
+    "sharded": "cop5615_gossip_protocol_tpu.parallel.sharded",
+    "fused-sharded": "cop5615_gossip_protocol_tpu.parallel.fused_sharded",
+    "fused-pool-sharded":
+        "cop5615_gossip_protocol_tpu.parallel.fused_pool_sharded",
+    "hbm-sharded": "cop5615_gossip_protocol_tpu.parallel.fused_hbm_sharded",
+    "imp-hbm-sharded":
+        "cop5615_gossip_protocol_tpu.parallel.fused_imp_hbm_sharded",
+    "pool2-sharded": "cop5615_gossip_protocol_tpu.parallel.pool2_sharded",
+}
+
+
+def get_spec(engine: str) -> WireSpec:
+    if engine not in SPEC_HOMES:
+        raise KeyError(
+            f"engine {engine!r} declares no WIRE_SPEC home — every sharded "
+            "composition must declare its wire contract "
+            "(analysis/wire_specs.py SPEC_HOMES)"
+        )
+    return importlib.import_module(SPEC_HOMES[engine]).WIRE_SPEC
+
+
+def wire_env(engine: str, topo, cfg, n_devices: int) -> tuple[dict, str]:
+    """(environment, mode) for one cell, computed from the same plan
+    functions the engines dispatch on — never from the traced program."""
+    planes = 4 if cfg.algorithm == "push-sum" else 3
+    windows = 2 if cfg.algorithm == "push-sum" else 1
+    env = {"planes": planes, "windows": windows}
+    if engine == "sharded":
+        if cfg.delivery == "pool":
+            env["rolls"] = cfg.pool_size * (
+                int(math.log2(n_devices)) + 1
+            )
+            return env, "pool"
+        from ..parallel import halo as halo_mod
+
+        plan = halo_mod.plan_halo(topo, n_devices)
+        if plan is None:
+            return env, "scatter"
+        env["classes"] = int(plan.offsets_mod.shape[0])
+        return env, "halo"
+    if engine == "fused-sharded":
+        env["disp_pairs"] = int(topo.max_deg) + 1
+    if engine in ("hbm-sharded", "imp-hbm-sharded"):
+        return env, ("dma" if cfg.halo_dma == "on" else "wire")
+    return env, "wire"
+
+
+def expected_counts(spec: WireSpec, env: Mapping[str, int], schedule: str,
+                    mode: str) -> dict:
+    """{"body": {prim: n}, "setup": {prim: n}} over ALL wire prims (the
+    undeclared ones expected 0)."""
+    regions = spec.variants[(schedule, mode)]
+    out = {}
+    for region_name, declared in (
+        ("body", regions.body), ("setup", regions.setup)
+    ):
+        out[region_name] = {
+            prim: (declared[prim].expected(env) if prim in declared else 0)
+            for prim in ALL_WIRE_PRIMS
+        }
+    return out
+
+
+def check_report(report, topo, cfg) -> list[Finding]:
+    """Diff one traced cell's counts against its composition's declared
+    contract (counts and mechanism; byte equalities need the paired
+    schedule/transport — see check_cell_group)."""
+    schedule = "overlap" if report.overlap else "serial"
+    try:
+        spec = get_spec(report.engine)
+    except KeyError as e:
+        return [Finding(
+            checker="wire-spec", where=report.engine, rule="no-spec",
+            detail=str(e),
+        )]
+    env, mode = wire_env(report.engine, topo, cfg, report.n_devices)
+    where = (
+        f"{report.engine}/{report.topology}/{report.algorithm}/"
+        f"{schedule}/{mode}"
+    )
+    if (schedule, mode) not in spec.variants:
+        return [Finding(
+            checker="wire-spec", where=where, rule="no-variant",
+            detail=(
+                f"WIRE_SPEC for {report.engine} declares no "
+                f"({schedule}, {mode}) variant"
+            ),
+        )]
+    findings = []
+    want = expected_counts(spec, env, schedule, mode)
+    for region in ("body", "setup"):
+        for prim in ALL_WIRE_PRIMS:
+            got = report.counts[region].get(prim, {}).get("count", 0)
+            exp = want[region][prim]
+            if got != exp:
+                findings.append(Finding(
+                    checker="wire-spec", where=where,
+                    rule=f"{region}-{prim}",
+                    detail=(
+                        f"declared {exp} {prim} in {region}, traced {got} "
+                        f"(env {env})"
+                    ),
+                ))
+    mech_want = spec.mechanism.get(mode)
+    if mech_want is not None and report.halo_mechanism() != mech_want:
+        findings.append(Finding(
+            checker="wire-spec", where=where, rule="mechanism",
+            detail=(
+                f"declared halo mechanism {mech_want!r}, traced program "
+                f"classifies as {report.halo_mechanism()!r}"
+            ),
+        ))
+    return findings
+
+
+def check_schedule_pair(spec: WireSpec, on_report, off_report) -> list:
+    """Cross-schedule byte equality: batching changes packaging, never
+    payload. Both reports must be the same cell with overlap on/off."""
+    findings = []
+    for prim in spec.equal_bytes:
+        b_on, b_off = on_report.body_bytes(prim), off_report.body_bytes(prim)
+        if b_on != b_off:
+            findings.append(Finding(
+                checker="wire-spec",
+                where=(
+                    f"{on_report.engine}/{on_report.topology}/"
+                    f"{on_report.algorithm}"
+                ),
+                rule=f"bytes-{prim}",
+                detail=(
+                    f"body {prim} payload differs across schedules: "
+                    f"overlap {b_on} B vs serial {b_off} B — batching must "
+                    "repackage, not change, the wire payload"
+                ),
+            ))
+    return findings
+
+
+def check_transport_pair(spec: WireSpec, wire_report, dma_report) -> list:
+    """Cross-transport byte equality: the in-kernel DMA halo ships exactly
+    the bytes the XLA wire shipped (same payload, different transport)."""
+    if spec.dma_bytes_match is None:
+        return []
+    want = wire_report.body_bytes(spec.dma_bytes_match)
+    got = dma_report.body_bytes(REMOTE_DMA)
+    if want != got:
+        return [Finding(
+            checker="wire-spec",
+            where=(
+                f"{dma_report.engine}/{dma_report.topology}/"
+                f"{dma_report.algorithm}/dma"
+            ),
+            rule="bytes-transport",
+            detail=(
+                f"remote-DMA halo ships {got} B but the XLA "
+                f"{spec.dma_bytes_match} wire ships {want} B — transport "
+                "changed the payload"
+            ),
+        )]
+    return []

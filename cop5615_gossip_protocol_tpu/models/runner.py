@@ -896,6 +896,7 @@ def _run_fused(
     on_telemetry=None,
     t_enter: Optional[float] = None,
     deadline: Optional[float] = None,
+    probe=None,
 ) -> RunResult:
     """Chunk loop over a Pallas multi-round engine: one kernel launch per
     cfg.chunk_rounds rounds. ``variant`` picks the kernel family:
@@ -1055,6 +1056,21 @@ def _run_fused(
     # (zero steady-state copies) — legal only when nothing reads retired
     # state: chunk hooks and the watchdog do (models/pipeline.py).
     donate = on_chunk is None and not cfg.stall_chunks
+    if probe is not None:
+        # Trace-only short-circuit (see run()): the plain jittable chunk,
+        # ready to make_jaxpr/lower hardware-free (interpret flag already
+        # baked into the kernel builder above). ``variant`` reports which
+        # fused tier the dispatch resolved, so the auditor can assert tier
+        # coverage without duplicating the routing logic.
+        return probe(
+            chunk_call,
+            (
+                state_dev, jnp.int32(start_round), jnp.bool_(False),
+                jnp.int32(min(start_round + 1, cfg.max_rounds)),
+            ),
+            donate=donate,
+            variant=variant,
+        )
     chunk_j = jax.jit(chunk_call, donate_argnums=(0,) if donate else ())
 
     rnd0 = jnp.int32(start_round)
@@ -1203,6 +1219,7 @@ def run(
     on_telemetry: Optional[Callable[[int, object], None]] = None,
     on_event: Optional[Callable] = None,
     deadline: Optional[float] = None,
+    probe=None,
 ) -> RunResult:
     """Run one simulation to convergence (or cfg.max_rounds) — the public
     entry every caller (CLI, suite, tests) goes through.
@@ -1227,8 +1244,24 @@ def run(
     config-contract violations — always fails fast: a degraded answer to an
     invalid request would mask the bug.
 
+    ``probe(chunk_fn, args, donate=...)``, when given, short-circuits the
+    run with the probe's return value after engine construction but BEFORE
+    warmup/execution: the probe receives the chunk program (jitted for the
+    sharded compositions, the plain jittable for the single-device paths),
+    ready-to-trace arguments, and the donation decision the run would have
+    made — the static auditor (cop5615_gossip_protocol_tpu/analysis) walks
+    every engine cell hardware-free through this hook. The degradation
+    ladder does not apply under a probe (a probed rung failing is the
+    finding, not a condition to recover from).
+
     See _run_resolved for the hook/resume contracts.
     """
+    if probe is not None:
+        return _run_resolved(
+            topo, cfg, key=key, on_chunk=on_chunk,
+            start_state=start_state, start_round=start_round,
+            on_telemetry=on_telemetry, deadline=deadline, probe=probe,
+        )
     strict = _strict_engine(cfg)
     rungs = _engine_ladder(cfg)
     degradations: list = []
@@ -1290,6 +1323,7 @@ def _run_resolved(
     start_round: int = 0,
     on_telemetry: Optional[Callable[[int, object], None]] = None,
     deadline: Optional[float] = None,
+    probe=None,
 ) -> RunResult:
     """One attempt at one ladder rung: dispatch to the engine cfg names and
     run to completion on it.
@@ -1357,14 +1391,14 @@ def _run_resolved(
                     return run_fused_pool_sharded(
                         topo, cfg, key=key, on_chunk=on_chunk,
                         start_state=start_state, start_round=start_round,
-                        deadline=deadline,
+                        deadline=deadline, probe=probe,
                     )
                 plan_p2 = plan_pool2_sharded(topo, cfg, cfg.n_devices)
                 if not isinstance(plan_p2, str):
                     return run_pool2_sharded(
                         topo, cfg, key=key, on_chunk=on_chunk,
                         start_state=start_state, start_round=start_round,
-                        deadline=deadline,
+                        deadline=deadline, probe=probe,
                     )
                 raise ValueError(
                     f"engine='fused' with n_devices={cfg.n_devices} "
@@ -1385,7 +1419,7 @@ def _run_resolved(
                 return run_imp_hbm_sharded(
                     topo, cfg, key=key, on_chunk=on_chunk,
                     start_state=start_state, start_round=start_round,
-                    deadline=deadline,
+                    deadline=deadline, probe=probe,
                 )
             # Fused x sharded lattice compositions, tiered like the
             # single-device engines: per-shard multi-round Pallas chunks
@@ -1412,14 +1446,14 @@ def _run_resolved(
                 return run_fused_sharded(
                     topo, cfg, key=key, on_chunk=on_chunk,
                     start_state=start_state, start_round=start_round,
-                    deadline=deadline,
+                    deadline=deadline, probe=probe,
                 )
             plan_hbm = plan_stencil_hbm_sharded(topo, cfg, cfg.n_devices)
             if not isinstance(plan_hbm, str):
                 return run_stencil_hbm_sharded(
                     topo, cfg, key=key, on_chunk=on_chunk,
                     start_state=start_state, start_round=start_round,
-                    deadline=deadline,
+                    deadline=deadline, probe=probe,
                 )
             raise ValueError(
                 f"engine='fused' with n_devices={cfg.n_devices} "
@@ -1434,7 +1468,7 @@ def _run_resolved(
         return run_sharded(
             topo, cfg, key=key, on_chunk=on_chunk,
             start_state=start_state, start_round=start_round,
-            on_telemetry=on_telemetry, deadline=deadline,
+            on_telemetry=on_telemetry, deadline=deadline, probe=probe,
         )
     target = cfg.resolved_target_count(topo.n, topo.target_count)
     if cfg.reference and cfg.algorithm == "push-sum":
@@ -1456,6 +1490,11 @@ def _run_resolved(
                 "deadline cancellation runs at chunk boundaries; the "
                 "reference-semantics single-walk simulator has none — "
                 "drop the deadline or use batched semantics"
+            )
+        if probe is not None:
+            raise ValueError(
+                "reference-semantics push-sum has no chunk program to "
+                "probe; audit batched semantics instead"
             )
         # Reference fidelity: single-walk push-sum (one message in flight,
         # SURVEY.md §3.3). Gossip has no such mode — the reference's gossip
@@ -1569,7 +1608,7 @@ def _run_resolved(
                 topo, cfg, key, on_chunk, start_state, start_round,
                 interpret=jax.default_backend() != "tpu", variant=variant,
                 on_telemetry=on_telemetry, t_enter=t_enter,
-                deadline=deadline,
+                deadline=deadline, probe=probe,
             )
         # auto: compiled engines on TPU only — interpret mode would make CPU
         # runs slower, and the chunked XLA path is already fast there.
@@ -1578,7 +1617,7 @@ def _run_resolved(
                 topo, cfg, key, on_chunk, start_state, start_round,
                 interpret=False, variant=variant,
                 on_telemetry=on_telemetry, t_enter=t_enter,
-                deadline=deadline,
+                deadline=deadline, probe=probe,
             )
 
     round_fn, state0, key_data, topo_args = make_round_fn(topo, cfg, key)
@@ -1693,6 +1732,20 @@ def _run_resolved(
     # buffers (zero copies). Off when retired state must stay readable —
     # chunk hooks and the stall watchdog (models/pipeline.py docstring).
     donate = on_chunk is None and not cfg.stall_chunks
+    if probe is not None:
+        # Trace-only short-circuit (see run()): hands the probe the PLAIN
+        # jittable chunk — before the warm-engine pool build, so auditor
+        # traces never occupy pool LRU slots or skew its metrics.
+        h0 = never_i32 if sentinel else None
+        pre = (h0,) if sentinel else ()
+        return probe(
+            chunk,
+            (state0, jnp.int32(start_round), jnp.bool_(done0))
+            + pre
+            + (jnp.int32(min(start_round + 1, cfg.max_rounds)), key_data)
+            + topo_args,
+            donate=donate,
+        )
     # Warm-engine pool (serving/pool.py): the jitted chunk is cached under
     # the canonical engine key (serving/keys.py — seed excluded: key
     # material and topology tensors ride the chunk arguments; crash models

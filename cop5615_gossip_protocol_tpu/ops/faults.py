@@ -68,7 +68,13 @@ Message faults
     conservation holds over state + ring.
 
 Base-key fold_in TAG MAP (the canonical home — every other module's tag
-comment points here). All of these fold into ``PRNGKey(cfg.seed)`` (or the
+comment points here). MACHINE-VERIFIED since ISSUE 11: the static auditor
+rebuilds this map from the real constants and proves the regions pairwise
+disjoint, the round-level tags distinct, and every ``fold_in`` site in
+the package classified against it (``analysis/tags.py``; run
+``python -m cop5615_gossip_protocol_tpu.analysis --lint-only``) — a new
+stream cannot ship without extending both the registry there and this
+docstring. All of these fold into ``PRNGKey(cfg.seed)`` (or the
 runner's base key) and must stay pairwise disjoint; the tags that fold
 into per-ROUND keys (sampling._POOL_TAG, GATE_TAG, DUP_TAG,
 IMP_CHOICE_TAG) are a different stream level entirely:

@@ -730,7 +730,7 @@ def make_pushsum_stencil_hbm_chunk(
                 # dead-group reads are stale but fully masked out.
                 for d_c, reads in classes:
                     cs = cw = None
-                    for gi, e, sq, take1 in reads:
+                    for gi, e, sq, _take1 in reads:
                         ws8u = starts[gi][0]
                         off = jnp.asarray(
                             r0 - sq - 1 + 2 * R, jnp.int32
@@ -1100,7 +1100,7 @@ def make_gossip_stencil_hbm_chunk(
                 inbox = jnp.zeros((PT, LANES), jnp.int32)
                 for d_c, reads in classes:
                     g = None
-                    for gi, e, sq, take1 in reads:
+                    for gi, e, sq, _take1 in reads:
                         ws8u = starts[gi][0]
                         off = jnp.asarray(
                             r0 - sq - 1 + 2 * R, jnp.int32

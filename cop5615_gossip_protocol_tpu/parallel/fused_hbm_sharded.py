@@ -99,6 +99,7 @@ from ..ops.fused_stencil_hbm import (
 )
 from ..ops.topology import Topology, stencil_offsets
 from ..utils import compat
+from ..analysis.wire_specs import C, Regions, WireSpec
 from .fused_sharded import _signed_pad
 
 _PT_CANDIDATES = (2048, 1024, 512, 256)
@@ -1338,7 +1339,7 @@ def run_stencil_hbm_sharded(
             planes0, rnd0, done0_dev,
             rep_put(np.int32(min(start_round + CR, cfg.max_rounds))),
             kd_dev,
-        ))
+        ), donate=donate)
 
     if dma and backend != "tpu":
         raise ValueError(
@@ -1400,3 +1401,37 @@ def run_stencil_hbm_sharded(
         compile_s, run_s, done=loop.done, stalled=watchdog.stalled,
         cancelled=loop.cancelled,
     )
+
+
+# --- Declared wire contract (analysis/wire_specs.py) -----------------------
+# Per SUPER-STEP on the XLA wire: ONE batched halo ppermute pair (serial:
+# a pair per state plane) + the deferred verdict psum; batched setup is
+# the pre-loop exchange pair + the drain psum (serial pays neither). With
+# halo_dma='on' the halo moves INTO the kernel: one async remote copy per
+# plane per ring direction, ZERO XLA collectives on the halo path (the
+# psum is the verdict, not delivery), and the remote copies ship exactly
+# the bytes the ppermute wire shipped (dma_bytes_match).
+WIRE_SPEC = WireSpec(
+    engine="hbm-sharded",
+    variants={
+        ("overlap", "wire"): Regions(
+            body={"ppermute": C(fixed=2), "psum": C(fixed=1)},
+            setup={"ppermute": C(fixed=2), "psum": C(fixed=1)},
+        ),
+        ("serial", "wire"): Regions(
+            body={"ppermute": C(per_plane=2), "psum": C(fixed=1)},
+            setup={},
+        ),
+        ("overlap", "dma"): Regions(
+            body={"remote_dma": C(per_plane=2), "psum": C(fixed=1)},
+            setup={"psum": C(fixed=1)},
+        ),
+        ("serial", "dma"): Regions(
+            body={"remote_dma": C(per_plane=2), "psum": C(fixed=1)},
+            setup={},
+        ),
+    },
+    mechanism={"wire": "xla-ppermute", "dma": "in-kernel-dma"},
+    equal_bytes=("ppermute",),
+    dma_bytes_match="ppermute",
+)

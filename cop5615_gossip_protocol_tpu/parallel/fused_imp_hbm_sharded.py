@@ -87,6 +87,7 @@ from ..ops.fused_stencil_hbm import (
 from ..ops.sampling import POOL_CHOICE_BITS, POOL_PACK
 from ..ops.topology import Topology, imp_split
 from ..utils import compat
+from ..analysis.wire_specs import C, Regions, WireSpec
 from .fused_hbm_sharded import (
     _HBM_PLANE_BUDGET,
     _VMEM_SCRATCH_BUDGET,
@@ -1203,7 +1204,7 @@ def run_imp_hbm_sharded(
             planes0, rnd0, done0_dev,
             rep_put(np.int32(min(start_round + 1, cfg.max_rounds))),
             kd_dev,
-        ))
+        ), donate=donate)
 
     if dma and backend != "tpu":
         raise ValueError(
@@ -1266,3 +1267,53 @@ def run_imp_hbm_sharded(
         compile_s, run_s, done=loop.done, stalled=watchdog.stalled,
         cancelled=loop.cancelled,
     )
+
+
+# --- Declared wire contract (analysis/wire_specs.py) -----------------------
+# Per SUPER-STEP on the XLA wire: ONE batched halo pair for the lattice
+# classes + ONE all_gather of the pooled long-range classes' windowed send
+# summaries + the deferred verdict psum — zero stragglers. Serial pays a
+# pair per state plane and a gather per send window. Batched setup =
+# pre-loop exchange pair + pre-loop gather + drain psum. With
+# halo_dma='on' the lattice halo moves in-kernel (one async remote copy
+# per plane per ring direction, same bytes as the pair) while the pooled
+# long-range wire stays the ONE all_gather.
+WIRE_SPEC = WireSpec(
+    engine="imp-hbm-sharded",
+    variants={
+        ("overlap", "wire"): Regions(
+            body={
+                "ppermute": C(fixed=2), "all_gather": C(fixed=1),
+                "psum": C(fixed=1),
+            },
+            setup={
+                "ppermute": C(fixed=2), "all_gather": C(fixed=1),
+                "psum": C(fixed=1),
+            },
+        ),
+        ("serial", "wire"): Regions(
+            body={
+                "ppermute": C(per_plane=2), "all_gather": C(per_window=1),
+                "psum": C(fixed=1),
+            },
+            setup={},
+        ),
+        ("overlap", "dma"): Regions(
+            body={
+                "remote_dma": C(per_plane=2), "all_gather": C(fixed=1),
+                "psum": C(fixed=1),
+            },
+            setup={"all_gather": C(fixed=1), "psum": C(fixed=1)},
+        ),
+        ("serial", "dma"): Regions(
+            body={
+                "remote_dma": C(per_plane=2), "all_gather": C(per_window=1),
+                "psum": C(fixed=1),
+            },
+            setup={},
+        ),
+    },
+    mechanism={"wire": "xla-ppermute", "dma": "in-kernel-dma"},
+    equal_bytes=("ppermute", "all_gather"),
+    dma_bytes_match="ppermute",
+)

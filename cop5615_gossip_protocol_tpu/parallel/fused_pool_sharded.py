@@ -76,6 +76,7 @@ from ..ops.fused_pool import (
 )
 from ..ops.topology import Topology
 from ..utils import compat
+from ..analysis.wire_specs import C, Regions, WireSpec
 
 
 def plan_fused_pool_sharded(topo: Topology, cfg: SimConfig, n_dev: int):
@@ -311,7 +312,7 @@ def run_fused_pool_sharded(
         return probe(chunk_sharded, (
             planes0, rnd0, done0_dev,
             rep_put(np.int32(min(start_round + 1, cfg.max_rounds))), kd_dev,
-        ))
+        ), donate=donate)
 
     t0 = time.perf_counter()
     # One real round, discarded — the absolute-round key stream makes the
@@ -366,3 +367,23 @@ def run_fused_pool_sharded(
         compile_s, run_s, done=loop.done, stalled=watchdog.stalled,
         cancelled=loop.cancelled,
     )
+
+
+# --- Declared wire contract (analysis/wire_specs.py) -----------------------
+# Per SUPER-STEP: ONE all_gather of the replicated state planes (batched),
+# or one gather per plane serially. The composition's verdict is
+# replicated in-kernel — NO reduction collective exists on either
+# schedule, and no per-dispatch setup collectives at all.
+WIRE_SPEC = WireSpec(
+    engine="fused-pool-sharded",
+    variants={
+        ("overlap", "wire"): Regions(
+            body={"all_gather": C(fixed=1)}, setup={},
+        ),
+        ("serial", "wire"): Regions(
+            body={"all_gather": C(per_plane=1)}, setup={},
+        ),
+    },
+    mechanism={"wire": "all-gather"},
+    equal_bytes=("all_gather",),
+)

@@ -78,6 +78,7 @@ from ..ops.fused_pool import (
 from ..ops.fused_stencil import _build_disp_planes
 from ..ops.topology import Topology, stencil_offsets
 from ..utils import compat
+from ..analysis.wire_specs import C, Regions, WireSpec
 
 _VMEM_BUDGET = 100 * 1024 * 1024
 
@@ -694,7 +695,7 @@ def run_fused_sharded(
             planes0, rnd0, done0_dev,
             rep_put(np.int32(min(start_round + CR, cfg.max_rounds))),
             kd_dev, disp_dev, deg_dev,
-        ))
+        ), donate=donate)
 
     t0 = time.perf_counter()
     warm = chunk_sharded(
@@ -752,3 +753,27 @@ def run_fused_sharded(
         compile_s, run_s, done=loop.done, stalled=watchdog.stalled,
         cancelled=loop.cancelled,
     )
+
+
+# --- Declared wire contract (analysis/wire_specs.py) -----------------------
+# Per SUPER-STEP: the batched schedule packs every state plane's halo into
+# ONE ppermute pair + the deferred verdict psum; the serial schedule pays a
+# pair per plane. Per-dispatch setup: batched = pre-loop state-exchange
+# pair + round-invariant disp/deg pair (4 ppermutes) + the drain psum;
+# serial extends disp/deg per neighbor slot instead (max_deg + 1 pairs, no
+# pre-loop exchange, no drain).
+WIRE_SPEC = WireSpec(
+    engine="fused-sharded",
+    variants={
+        ("overlap", "wire"): Regions(
+            body={"ppermute": C(fixed=2), "psum": C(fixed=1)},
+            setup={"ppermute": C(fixed=4), "psum": C(fixed=1)},
+        ),
+        ("serial", "wire"): Regions(
+            body={"ppermute": C(per_plane=2), "psum": C(fixed=1)},
+            setup={"ppermute": C(per_pair=2)},
+        ),
+    },
+    mechanism={"wire": "xla-ppermute"},
+    equal_bytes=("ppermute",),
+)

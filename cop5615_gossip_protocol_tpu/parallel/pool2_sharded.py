@@ -85,6 +85,7 @@ from ..ops.fused_pool2 import (
 )
 from ..ops.sampling import POOL_CHOICE_BITS, gate_threshold
 from ..ops.topology import Topology
+from ..analysis.wire_specs import C, Regions, WireSpec
 
 # Per-device HBM for the resident planes: the gathered windowed copy (+
 # margin), this shard's in/out planes, and the overlap schedule's
@@ -940,7 +941,7 @@ def run_pool2_sharded(
             planes0, rnd0, done0_dev,
             rep_put(np.int32(min(start_round + 1, cfg.max_rounds))),
             kd_dev, *fault_dev,
-        ))
+        ), donate=donate)
 
     t0 = time.perf_counter()
     warm = chunk_sharded(
@@ -996,3 +997,27 @@ def run_pool2_sharded(
         compile_s, run_s, done=loop.done, stalled=watchdog.stalled,
         cancelled=loop.cancelled,
     )
+
+
+# --- Declared wire contract (analysis/wire_specs.py) -----------------------
+# Per SUPER-STEP: the ONLY delivery wire is ONE all_gather of the compact
+# windowed send summaries (the active plane for gossip; raw s/w windows
+# for push-sum — batched into one gather under the overlap schedule, one
+# per window serially) + the ONE deferred verdict psum. No ppermutes, no
+# scatters, no remote DMAs, zero stragglers. Batched setup = the pre-loop
+# gather + the drain psum.
+WIRE_SPEC = WireSpec(
+    engine="pool2-sharded",
+    variants={
+        ("overlap", "wire"): Regions(
+            body={"all_gather": C(fixed=1), "psum": C(fixed=1)},
+            setup={"all_gather": C(fixed=1), "psum": C(fixed=1)},
+        ),
+        ("serial", "wire"): Regions(
+            body={"all_gather": C(per_window=1), "psum": C(fixed=1)},
+            setup={},
+        ),
+    },
+    mechanism={"wire": "all-gather"},
+    equal_bytes=("all_gather",),
+)

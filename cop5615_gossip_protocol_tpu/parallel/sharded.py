@@ -93,6 +93,7 @@ from ..ops import telemetry as telemetry_mod
 from ..ops.topology import Topology, imp_split
 from ..utils import compat
 from . import halo as halo_mod
+from ..analysis.wire_specs import C, Regions, WireSpec
 from .mesh import NODE_AXIS, make_mesh
 
 
@@ -740,7 +741,7 @@ def run_sharded(
         return probe(chunk_sharded, (
             state0, rnd0, done0_dev,
             *_chunk_args(health0, min(start_round + 1, cfg.max_rounds)),
-        ))
+        ), donate=donate)
 
     t0 = time.perf_counter()
     # Warmup runs ONE real round and DISCARDS the result — the timed loop
@@ -825,3 +826,44 @@ def run_sharded(
         collector=collector, unhealthy_round=unhealthy_round,
         cancelled=loop.cancelled,
     )
+
+
+# --- Declared wire contract (analysis/wire_specs.py) -----------------------
+# The chunked XLA engine's collectives per ROUND, as data — the static
+# auditor diffs this declaration against the traced chunk program, and
+# tests/test_comm_audit.py asserts declaration <-> trace agreement (the
+# counts live here, nowhere else). Modes: "halo" = exact offset-class plan
+# (batched to ONE ppermute pair under the overlap schedule, one ppermute
+# per offset class serially), "pool" = dynamic pool rolls (pool_size x
+# (log2(n_dev) + 1) ppermute stages, schedule-invariant — dynamic rolls
+# cannot be statically packed), "scatter" = the psum_scatter fallback when
+# no exact halo plan exists. The psum is the termination verdict.
+WIRE_SPEC = WireSpec(
+    engine="sharded",
+    variants={
+        ("overlap", "halo"): Regions(
+            body={"ppermute": C(fixed=2), "psum": C(fixed=1)}, setup={},
+        ),
+        ("serial", "halo"): Regions(
+            body={"ppermute": C(per_class=1), "psum": C(fixed=1)}, setup={},
+        ),
+        ("overlap", "pool"): Regions(
+            body={"ppermute": C(per_roll=1), "psum": C(fixed=1)}, setup={},
+        ),
+        ("serial", "pool"): Regions(
+            body={"ppermute": C(per_roll=1), "psum": C(fixed=1)}, setup={},
+        ),
+        ("overlap", "scatter"): Regions(
+            body={"reduce_scatter": C(fixed=1), "psum": C(fixed=1)},
+            setup={},
+        ),
+        ("serial", "scatter"): Regions(
+            body={"reduce_scatter": C(fixed=1), "psum": C(fixed=1)},
+            setup={},
+        ),
+    },
+    mechanism={
+        "halo": "xla-ppermute", "pool": "xla-ppermute", "scatter": "scatter",
+    },
+    equal_bytes=("ppermute",),
+)

@@ -72,7 +72,7 @@ def main() -> int:
                         max_rounds=rounds, halo_dma="on")
     probed = {}
 
-    def probe(fn, args):
+    def probe(fn, args, **info):
         probed["txt"] = str(jax.make_jaxpr(fn)(*args))
         return None
 

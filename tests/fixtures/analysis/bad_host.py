@@ -1,0 +1,22 @@
+"""AST-scanned lint fixture: host conversions inside a traced scope.
+
+Never imported — jax/np here are names for the AST walker, not runtime
+dependencies. Each marked line must produce one lint/traced-* finding.
+"""
+
+import numpy as np
+
+from jax import lax
+
+
+def runner(n, plane):
+    def cond(carry):
+        return carry < n
+
+    def body(carry):
+        host = int(carry)           # lint: traced-int (param to host)
+        arr = np.asarray(carry)     # lint: traced-np-asarray
+        scalar = arr.item()         # lint: traced-item
+        return carry + host + scalar
+
+    return lax.while_loop(cond, body, plane)

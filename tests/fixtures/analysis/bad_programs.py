@@ -1,0 +1,115 @@
+"""Seeded-bad jax programs — each trips exactly one jaxpr-level checker.
+
+Imported by tests/test_static_audit.py; every builder returns ``(fn,
+args)`` ready for analysis.trace.TracedCell, plus the deliberately-wrong
+wire declaration for the wire-spec pin. See README.md in this directory.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from cop5615_gossip_protocol_tpu.analysis.wire_specs import (
+    C,
+    Regions,
+    WireSpec,
+)
+
+
+def host_sync_chunk():
+    """A debug print (-> debug_callback) inside the while body: one
+    device->host round-trip per round."""
+
+    def fn(x):
+        def body(c):
+            jax.debug.print("round {c}", c=c)
+            return c + 1
+
+        return lax.while_loop(lambda c: c < 8, body, x)
+
+    return fn, (jnp.int32(0),)
+
+
+def clean_chunk():
+    """The same loop without the callback — the negative pin."""
+
+    def fn(x):
+        return lax.while_loop(lambda c: c < 8, lambda c: c + 1, x)
+
+    return fn, (jnp.int32(0),)
+
+
+def f64_promotion_chunk():
+    """A strongly-typed np.float64 scalar reaching f32 arithmetic in the
+    body: under an x64 trace the carry promotes to float64 — the classic
+    'fine on CPU-without-x64, doubles HBM traffic under x64' bug."""
+
+    def fn(x):
+        def body(c):
+            return (c * np.float64(0.5)).astype(jnp.float32) + c
+
+        return lax.while_loop(lambda c: jnp.all(c < 8.0), body, x)
+
+    return fn, (jnp.zeros((4,), jnp.float32),)
+
+
+def clean_f32_chunk():
+    """Same body with the scalar pinned to f32 — the negative pin."""
+
+    def fn(x):
+        def body(c):
+            return c * jnp.float32(0.5) + c
+
+        return lax.while_loop(lambda c: jnp.all(c < 8.0), body, x)
+
+    return fn, (jnp.zeros((4,), jnp.float32),)
+
+
+def unaliased_donated_chunk():
+    """Jitted WITHOUT donate_argnums while the run reports donate=True:
+    the state carry has no aliasing attribute in the lowering — the
+    donated buffer would be silently copied every chunk."""
+    fn = jax.jit(lambda s, r: (s + 1.0, r + 1))
+    return fn, (jnp.zeros((8,), jnp.float32), jnp.int32(0))
+
+
+def donated_chunk():
+    """Properly donated carry — the negative pin (aliases through to the
+    compiled input_output_alias map)."""
+    fn = jax.jit(lambda s, r: (s + 1.0, r + 1), donate_argnums=(0,))
+    return fn, (jnp.zeros((8,), jnp.float32), jnp.int32(0))
+
+
+def double_psum_chunk(mesh, axis):
+    """TWO verdict psums per round where the declaration below says ONE —
+    the wire-spec diff must flag body-psum (and nothing else)."""
+    from cop5615_gossip_protocol_tpu.utils import compat
+    from jax.sharding import PartitionSpec as P
+
+    def chunk(x):
+        def body(c):
+            once = lax.psum(c, axis)
+            twice = lax.psum(once, axis)
+            return twice
+
+        return lax.while_loop(lambda c: jnp.all(c < 8.0), body, x)
+
+    fn = jax.jit(compat.shard_map(
+        chunk, mesh=mesh, in_specs=(P(axis),), out_specs=P(axis),
+    ))
+    return fn, (jnp.zeros((8,), jnp.float32),)
+
+
+# The declaration the double-psum program violates: one verdict psum per
+# round, nothing else on the wire.
+FIXTURE_WIRE_SPEC = WireSpec(
+    engine="fixture-engine",
+    variants={
+        ("overlap", "wire"): Regions(
+            body={"psum": C(fixed=1)}, setup={},
+        ),
+    },
+    mechanism={},
+)

@@ -1,0 +1,31 @@
+"""AST-scanned lint fixture: a runner-ladder refusal that dead-ends.
+
+Never imported. The refusal names an engine override but no real serving
+composition or alternative route — the PR 10 rule the refusal lint
+enforces.
+"""
+
+
+def _run_resolved(topo, cfg):
+    if cfg.engine == "fused":
+        raise ValueError(
+            "engine='fused' is unavailable for this request"
+            # lint: refusal-dead-end — no composition named
+        )
+    if cfg.engine == "other":
+        # Interpolated DATA does not exempt the static text around it:
+        # this must fire too (only a computed *_support reason delegates).
+        raise ValueError(
+            f"engine='other' is unsupported for topology {cfg.topology}"
+            # lint: refusal-dead-end
+        )
+    if cfg.engine == "auto":
+        reason = _support(topo)
+        # Delegated to a computed reason — judged by that surface, not
+        # here; must NOT fire.
+        raise ValueError(f"engine='auto' unavailable: {reason}")
+    return topo
+
+
+def _support(topo):
+    return f"population {topo.n} exceeds the budget"

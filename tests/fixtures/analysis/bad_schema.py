@@ -1,0 +1,16 @@
+"""AST-scanned lint fixture: schema-version discipline violations.
+
+Never imported. The row builder writes a literal version (must source the
+constant), and a second constant is defined but never read.
+"""
+
+ROW_SCHEMA_VERSION = 3
+ORPHAN_SCHEMA_VERSION = 9
+TYPED_SCHEMA_VERSION: int = 7  # annotated constants count too
+
+
+def build_row(payload):
+    return {
+        "schema_version": 3,  # lint: schema-literal (constant bypassed)
+        "payload": payload,
+    }

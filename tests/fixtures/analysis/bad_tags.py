@@ -1,0 +1,21 @@
+"""AST-scanned PRNG-tag fixture: three ways to break the TAG MAP.
+
+Never imported. An unregistered ``*_TAG`` constant, a literal fold
+outside every registered region, and a fold through the unregistered
+constant — each must produce one prng-tags finding.
+"""
+
+from jax import random
+from jax.random import fold_in
+
+ROGUE_TAG = 12345  # prng-tags: unregistered-tag-constant
+TYPED_TAG: int = 54321  # prng-tags: unregistered-tag-constant (annotated)
+
+
+def draw(key):
+    a = random.fold_in(key, 4294967295)  # prng-tags: literal-tag-outside-map
+    b = random.fold_in(key, ROGUE_TAG)   # prng-tags: unregistered-tag-fold
+    # Bare from-import call form — must be just as visible to the harvest.
+    c = fold_in(key, 4294967294)         # prng-tags: literal-tag-outside-map
+    d = fold_in(key, data=TYPED_TAG)     # prng-tags: unregistered-tag-fold
+    return a, b, c, d

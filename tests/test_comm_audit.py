@@ -1,15 +1,28 @@
-"""Comm-audit pins (benchmarks/comm_audit.py): collectives per round /
-super-step, counted from the TRACED chunk program — a comm-volume
-regression fails here on CPU without needing a TPU.
+"""Comm-audit pins: declaration <-> trace agreement per composition.
 
-The tentpole pin: with the overlap schedule on (the default), the batched
-halo wire is exactly ONE ppermute pair per super-step — down from one pair
-per plane (compositions) / one ppermute per offset class (chunked halo
-delivery) — and the verdict psum stays exactly one per super-step (it is
-deferred, not duplicated). The engines' probe hook traces the real jitted
-chunk, so these counts cannot drift from what runs.
+Since ISSUE 11 the expected collective counts live ONCE, as data, in each
+composition's ``WIRE_SPEC`` declaration (the module that builds the chunk
+also declares what it puts on the wire — analysis/wire_specs.py); these
+tests trace the real jitted chunk through the probe hook
+(analysis/trace.py) and assert the traced program matches the
+declaration EXACTLY — every undeclared collective class must count zero,
+the mechanism column must classify as declared, batching must repackage
+(never change) the wire payload, and the in-kernel DMA transport must
+ship exactly the bytes the XLA wire shipped.
+
+So the historical tentpole pins still hold, but from the spec: the
+batched halo wire is ONE ppermute pair per super-step, imp DMA mode
+keeps ZERO XLA collectives on the halo path, replicated-pool2's only
+delivery wire is ONE all_gather + the deferred verdict psum. What this
+file pins with literals instead is the WIRE ENVIRONMENT — the structural
+quantities (offset classes, pool rolls, disp pairs, planes, windows) the
+linear declarations are evaluated over — so a broken env computation
+cannot conspire with a broken declaration to cancel out.
+
+A comm-volume regression still fails here on CPU without needing a TPU.
 """
 
+import functools
 import sys
 from pathlib import Path
 
@@ -17,212 +30,208 @@ sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
 from benchmarks.comm_audit import audit_engine  # noqa: E402
 
-
-def test_chunked_halo_wire_counts():
-    # torus3d has 10 offset classes (lattice +/-1, +/-g, +/-g^2 and their
-    # wrap variants): per-class = 10 ppermutes per round, batched = 1 pair.
-    for algo in ("gossip", "push-sum"):
-        on = audit_engine("sharded", "torus3d", algo, 4096, 8, True)
-        off = audit_engine("sharded", "torus3d", algo, 4096, 8, False)
-        assert on.body_count("ppermute") == 2, on.counts
-        assert off.body_count("ppermute") == 10, off.counts
-        assert on.body_count("psum") == off.body_count("psum") == 1
-        # Same bytes on the wire — batching changes packaging, not payload.
-        assert on.body_bytes("ppermute") == off.body_bytes("ppermute")
+from cop5615_gossip_protocol_tpu import (  # noqa: E402
+    SimConfig,
+    build_topology,
+)
+from cop5615_gossip_protocol_tpu.analysis import wire_specs  # noqa: E402
 
 
-def test_chunked_scatter_fallback_counts():
-    # Non-divisible ring: no halo plan -> scatter + ONE reduce-scatter per
-    # round on either schedule (wire batching does not apply).
-    for ov in (True, False):
-        r = audit_engine("sharded", "ring", "gossip", 1001, 8, ov)
-        assert r.body_count("reduce_scatter") == 1, r.counts
-        assert r.body_count("ppermute") == 0
+@functools.lru_cache(maxsize=None)
+def _report(engine, topo_name, algo, n, n_dev, overlap, extra_items):
+    return audit_engine(
+        engine, topo_name, algo, n, n_dev, overlap, dict(extra_items)
+    )
 
 
-def test_chunked_pool_roll_counts():
-    # Pool-roll delivery: K=4 dynamic rolls x log2(8)+1 ppermute stages,
-    # schedule-invariant (dynamic rolls cannot be statically packed) —
-    # audited so a regression in the roll decomposition is visible.
-    for ov in (True, False):
-        r = audit_engine(
-            "sharded", "full", "push-sum", 1024, 8, ov,
-            {"delivery": "pool"},
+def _cell(engine, topo_name, algo, n, n_dev, overlap, extra=None):
+    """(report, topo, cfg, env, mode) for one cell — traces are cached, so
+    the transport/schedule pair tests reuse the single-schedule traces."""
+    extra = dict(extra or {})
+    rep = _report(
+        engine, topo_name, algo, n, n_dev, overlap,
+        tuple(sorted(extra.items())),
+    )
+    cfg = SimConfig(
+        n=n, topology=topo_name, algorithm=algo,
+        overlap_collectives=overlap, **extra,
+    )
+    topo = build_topology(topo_name, n)
+    env, mode = wire_specs.wire_env(engine, topo, cfg, n_dev)
+    return rep, topo, cfg, env, mode
+
+
+def _assert_agrees(engine, topo_name, algo, n, n_dev, extra=None):
+    """Both schedules match the declaration; batching preserves payload.
+    Returns {overlap: report} plus the serial env for extra pins."""
+    spec = wire_specs.get_spec(engine)
+    pair = {}
+    env = mode = None
+    for overlap in (True, False):
+        rep, topo, cfg, env, mode = _cell(
+            engine, topo_name, algo, n, n_dev, overlap, extra
         )
-        assert r.body_count("ppermute") == 16, r.counts
-        assert r.body_count("psum") == 1
+        findings = wire_specs.check_report(rep, topo, cfg)
+        assert not findings, [f.detail for f in findings]
+        pair[overlap] = rep
+    byte_findings = wire_specs.check_schedule_pair(
+        spec, pair[True], pair[False]
+    )
+    assert not byte_findings, [f.detail for f in byte_findings]
+    return pair, env, mode
 
 
-def test_fused_sharded_batched_wire_counts():
+def test_every_audited_engine_declares_a_spec():
+    # A composition cannot ship without a wire contract: every engine in
+    # the audited matrix resolves to a WIRE_SPEC whose variant table is
+    # non-empty and whose mechanism strings are the classifier's alphabet.
+    from cop5615_gossip_protocol_tpu.analysis.matrix import AUDIT_GRID
+
+    mechs = {"xla-ppermute", "in-kernel-dma", "all-gather", "scatter",
+             "none"}
+    for engine in {g[0] for g in AUDIT_GRID}:
+        spec = wire_specs.get_spec(engine)
+        assert spec.engine == engine
+        assert spec.variants
+        for (schedule, _mode), regions in spec.variants.items():
+            assert schedule in ("overlap", "serial")
+            assert set(regions.body) | set(regions.setup) <= set(
+                wire_specs.ALL_WIRE_PRIMS
+            )
+        assert set(spec.mechanism.values()) <= mechs
+
+
+def test_chunked_halo_declaration_agreement():
+    # torus3d has 10 offset classes (lattice +/-1, +/-g, +/-g^2 and their
+    # wrap variants) — the env literal pinned HERE; the per-class/batched
+    # wire counts come from the declaration.
+    for algo in ("gossip", "push-sum"):
+        _pair, env, mode = _assert_agrees(
+            "sharded", "torus3d", algo, 4096, 8
+        )
+        assert mode == "halo"
+        assert env["classes"] == 10
+
+
+def test_chunked_scatter_fallback_agreement():
+    # Non-divisible ring: no exact halo plan -> the scatter fallback mode
+    # (wire batching does not apply; the declaration says so).
+    _pair, _env, mode = _assert_agrees("sharded", "ring", "gossip", 1001, 8)
+    assert mode == "scatter"
+
+
+def test_chunked_pool_roll_agreement():
+    # Pool-roll delivery: K=4 dynamic rolls x log2(8)+1 ppermute stages,
+    # schedule-invariant. The roll count is the env literal pinned here.
+    _pair, env, mode = _assert_agrees(
+        "sharded", "full", "push-sum", 1024, 8, {"delivery": "pool"}
+    )
+    assert mode == "pool"
+    assert env["rolls"] == 16
+
+
+def test_fused_sharded_declaration_agreement():
+    # Env pins: push-sum carries 4 state planes; torus3d max_deg+1 = 7
+    # round-invariant disp/deg exchange pairs (the serial setup wires).
+    _pair, env, _mode = _assert_agrees(
+        "fused-sharded", "torus3d", "push-sum", 131072, 2,
+        {"engine": "fused", "chunk_rounds": 8},
+    )
+    assert env["planes"] == 4
+    assert env["disp_pairs"] == 7
+
+
+def test_hbm_sharded_wire_declaration_agreement():
+    # The 2.30x offender (ISSUE 5): the declaration says ONE batched
+    # ppermute pair per super-step on the XLA-wire path; halo_dma
+    # resolves to 'wire' on CPU, so these ARE the fallback-path pins.
     cfg = {"engine": "fused", "chunk_rounds": 8}
-    on = audit_engine(
-        "fused-sharded", "torus3d", "push-sum", 131072, 2, True, cfg
+    _pair, env, mode = _assert_agrees(
+        "hbm-sharded", "torus3d", "push-sum", 125000, 2, cfg
     )
-    off = audit_engine(
-        "fused-sharded", "torus3d", "push-sum", 131072, 2, False, cfg
-    )
-    # Batched: one pair for all 4 push-sum planes; serial: a pair per plane.
-    assert on.body_count("ppermute") == 2, on.counts
-    assert off.body_count("ppermute") == 8, off.counts
-    # Verdict psum: one per super-step either way (deferred, not removed).
-    assert on.body_count("psum") == off.body_count("psum") == 1
-    # Per-dispatch setup: batched = one pre-loop state exchange pair + one
-    # drain psum + one pair for the round-invariant disp/deg planes;
-    # serial extends disp/deg per plane (max_deg+1 pairs, no drain).
-    assert on.setup_count("ppermute") == 4
-    assert on.setup_count("psum") == 1
-    assert off.setup_count("ppermute") == 14
+    assert mode == "wire"
+    assert env["planes"] == 4
 
 
-def test_hbm_sharded_batched_wire_counts():
-    # The 2.30x offender (ISSUE 5): the HBM-streaming composition's
-    # super-step must issue exactly ONE batched ppermute pair on the
-    # XLA-wire fallback path (halo_dma resolves to 'ppermute' on CPU —
-    # these counts ARE the fallback-path pins).
-    cfg = {"engine": "fused", "chunk_rounds": 8}
-    on = audit_engine(
-        "hbm-sharded", "torus3d", "push-sum", 125000, 2, True, cfg
-    )
-    off = audit_engine(
-        "hbm-sharded", "torus3d", "push-sum", 125000, 2, False, cfg
-    )
-    assert on.halo_mechanism() == off.halo_mechanism() == "xla-ppermute"
-    assert on.body_count("ppermute") == 2, on.counts
-    assert off.body_count("ppermute") == 8, off.counts
-    assert on.body_count("remote_dma") == off.body_count("remote_dma") == 0
-    assert on.body_count("psum") == off.body_count("psum") == 1
-    assert on.setup_count("ppermute") == 2  # pre-loop exchange only
-    assert on.setup_count("psum") == 1  # the drain
-
-
-def test_hbm_sharded_inkernel_dma_zero_xla_halo_collectives():
-    # ISSUE 9 tentpole pin: with halo_dma='on' the halo wire moves INTO
-    # the Pallas kernel — the traced program carries ZERO XLA collectives
-    # on the halo path (the one remaining psum is the deferred termination
-    # verdict), one async remote copy per state plane per ring direction,
-    # and the remote copies ship EXACTLY the bytes the batched ppermute
-    # wire shipped (same payload, different transport). The probe hook
-    # traces the DMA program hardware-free, so this pins the TPU path's
-    # comm structure on CPU CI.
+def test_hbm_sharded_inkernel_dma_transport_pair():
+    # ISSUE 9 tentpole, from the spec: the dma variants declare remote_dma
+    # wires and NO ppermute class, so "zero XLA collectives on the halo
+    # path" is the strictness rule firing, not a hand literal; and the
+    # remote copies ship EXACTLY the bytes the batched ppermute wire
+    # shipped (dma_bytes_match). Traced hardware-free through the probe.
     base = {"engine": "fused", "chunk_rounds": 8}
-    for algo, n_planes in (("gossip", 3), ("push-sum", 4)):
-        wire = audit_engine(
+    spec = wire_specs.get_spec("hbm-sharded")
+    for algo in ("gossip", "push-sum"):
+        wire, *_ = _cell(
             "hbm-sharded", "torus3d", algo, 125000, 2, True, base
         )
-        dma = audit_engine(
-            "hbm-sharded", "torus3d", algo, 125000, 2, True,
+        dma_pair, _env, mode = _assert_agrees(
+            "hbm-sharded", "torus3d", algo, 125000, 2,
             {**base, "halo_dma": "on"},
         )
-        assert dma.halo_mechanism() == "in-kernel-dma"
-        assert dma.body_count("ppermute") == 0, dma.counts
-        assert dma.setup_count("ppermute") == 0, dma.counts
-        assert dma.body_count("all_gather") == 0
-        assert dma.body_count("reduce_scatter") == 0
-        # One copy per plane per ring direction, fired at super-step entry.
-        assert dma.body_count("remote_dma") == 2 * n_planes, dma.counts
-        # Same halo payload as the XLA wire — transport changes, bytes
-        # do not.
-        assert dma.body_bytes("remote_dma") == wire.body_bytes("ppermute")
-        # Termination verdict: one deferred psum in the body + the drain.
-        assert dma.body_count("psum") == 1
-        assert dma.setup_count("psum") == 1
-
-
-def test_imp_hbm_sharded_wire_counts():
-    # ISSUE 10 tentpole pin: the imp x HBM x sharded super-step is ONE
-    # batched halo pair (lattice classes) + ONE all_gather (the pooled
-    # long-range classes' windowed send summaries) + ONE deferred verdict
-    # psum — zero stragglers. The serial schedule pays per-plane wires
-    # (the documented fallback), same payload bytes.
-    cfg = {"engine": "fused", "delivery": "pool"}
-    for algo, n_planes, n_win in (("gossip", 3, 1), ("push-sum", 4, 2)):
-        on = audit_engine(
-            "imp-hbm-sharded", "imp3d", algo, 27000, 2, True, cfg
+        assert mode == "dma"
+        transport = wire_specs.check_transport_pair(
+            spec, wire, dma_pair[True]
         )
-        off = audit_engine(
-            "imp-hbm-sharded", "imp3d", algo, 27000, 2, False, cfg
-        )
-        assert on.halo_mechanism() == off.halo_mechanism() == "xla-ppermute"
-        assert on.body_count("ppermute") == 2, on.counts
-        assert off.body_count("ppermute") == 2 * n_planes, off.counts
-        assert on.body_count("all_gather") == 1, on.counts
-        assert off.body_count("all_gather") == n_win, off.counts
-        assert on.body_count("psum") == off.body_count("psum") == 1
-        assert on.body_count("remote_dma") == 0
-        # Batching changes packaging, not payload.
-        assert on.body_bytes("ppermute") == off.body_bytes("ppermute")
-        assert on.body_bytes("all_gather") == off.body_bytes("all_gather")
-        # Per-dispatch setup: pre-loop exchange pair + pre-loop gather +
-        # drain psum.
-        assert on.setup_count("ppermute") == 2
-        assert on.setup_count("all_gather") == 1
-        assert on.setup_count("psum") == 1
+        assert not transport, [f.detail for f in transport]
 
 
-def test_imp_hbm_sharded_inkernel_dma_zero_xla_halo_collectives():
-    # With halo_dma='on' the lattice halo moves INTO the kernel (one async
-    # remote copy per state plane per ring direction, same bytes as the
-    # XLA pair) while the pooled long-range wire stays the ONE all_gather
-    # — the only XLA collectives left are the gather and the deferred
-    # verdict psum. Traced hardware-free through the probe hook.
-    cfg = {"engine": "fused", "delivery": "pool"}
-    for algo, n_planes in (("gossip", 3), ("push-sum", 4)):
-        wire = audit_engine(
-            "imp-hbm-sharded", "imp3d", algo, 27000, 2, True, cfg
-        )
-        dma = audit_engine(
-            "imp-hbm-sharded", "imp3d", algo, 27000, 2, True,
-            {**cfg, "halo_dma": "on"},
-        )
-        assert dma.halo_mechanism() == "in-kernel-dma"
-        assert dma.body_count("ppermute") == 0, dma.counts
-        assert dma.setup_count("ppermute") == 0, dma.counts
-        assert dma.body_count("remote_dma") == 2 * n_planes, dma.counts
-        assert dma.body_bytes("remote_dma") == wire.body_bytes("ppermute")
-        assert dma.body_count("all_gather") == 1
-        assert dma.body_count("psum") == 1
-
-
-def test_pool2_sharded_single_gather_counts():
-    # ISSUE 10 acceptance pin: the replicated-pool2 super-step's ONLY
-    # delivery wire is ONE all_gather of the compact windowed send
-    # summaries (the active plane for gossip; raw s/w for push-sum,
-    # batched under the overlap schedule) plus the ONE deferred verdict
-    # psum — no ppermutes, no scatters, no remote DMAs, zero stragglers.
+def test_imp_hbm_sharded_declaration_agreement():
+    # ISSUE 10 tentpole, from the spec: ONE batched halo pair + ONE
+    # all_gather of the windowed send summaries + ONE deferred verdict
+    # psum — zero stragglers (strictness covers the rest). Env pins: the
+    # push-sum cell gathers 2 send windows, gossip 1.
     cfg = {"engine": "fused", "delivery": "pool"}
     for algo, n_win in (("gossip", 1), ("push-sum", 2)):
-        on = audit_engine(
-            "pool2-sharded", "full", algo, 262144, 2, True, cfg
+        _pair, env, mode = _assert_agrees(
+            "imp-hbm-sharded", "imp3d", algo, 27000, 2, cfg
         )
-        off = audit_engine(
-            "pool2-sharded", "full", algo, 262144, 2, False, cfg
-        )
-        assert on.halo_mechanism() == off.halo_mechanism() == "all-gather"
-        assert on.body_count("all_gather") == 1, on.counts
-        assert off.body_count("all_gather") == n_win, off.counts
-        assert on.body_count("psum") == off.body_count("psum") == 1
-        for r in (on, off):
-            assert r.body_count("ppermute") == 0
-            assert r.body_count("reduce_scatter") == 0
-            assert r.body_count("remote_dma") == 0
-        assert on.body_bytes("all_gather") == off.body_bytes("all_gather")
-        # Per-dispatch setup: the pre-loop gather + the drain psum.
-        assert on.setup_count("all_gather") == 1
-        assert on.setup_count("psum") == 1
+        assert mode == "wire"
+        assert env["windows"] == n_win
 
 
-def test_fused_pool_sharded_batched_gather_counts():
+def test_imp_hbm_sharded_inkernel_dma_transport_pair():
+    # DMA transport: the lattice halo moves in-kernel with the same bytes
+    # as the XLA pair, while the pooled long-range wire stays the ONE
+    # all_gather — all from the (schedule, 'dma') declaration.
     cfg = {"engine": "fused", "delivery": "pool"}
-    for algo, per_plane in (("gossip", 3), ("push-sum", 4)):
-        on = audit_engine(
-            "fused-pool-sharded", "full", algo, 131072, 2, True, cfg
+    spec = wire_specs.get_spec("imp-hbm-sharded")
+    for algo in ("gossip", "push-sum"):
+        wire, *_ = _cell(
+            "imp-hbm-sharded", "imp3d", algo, 27000, 2, True, cfg
         )
-        off = audit_engine(
-            "fused-pool-sharded", "full", algo, 131072, 2, False, cfg
+        dma_pair, _env, mode = _assert_agrees(
+            "imp-hbm-sharded", "imp3d", algo, 27000, 2,
+            {**cfg, "halo_dma": "on"},
         )
-        assert on.body_count("all_gather") == 1, on.counts
-        assert off.body_count("all_gather") == per_plane, off.counts
-        # The composition's verdict is replicated in-kernel: no reduction
-        # collective exists on either schedule.
-        assert on.body_count("psum") == off.body_count("psum") == 0
-        assert on.body_bytes("all_gather") == off.body_bytes("all_gather")
+        assert mode == "dma"
+        transport = wire_specs.check_transport_pair(
+            spec, wire, dma_pair[True]
+        )
+        assert not transport, [f.detail for f in transport]
+
+
+def test_pool2_sharded_declaration_agreement():
+    # ISSUE 10 acceptance pin, from the spec: replicated-pool2's ONLY
+    # delivery wire is ONE all_gather of the compact windowed send
+    # summaries + the ONE deferred verdict psum; no ppermutes, no
+    # scatters, no remote DMAs (strictness).
+    cfg = {"engine": "fused", "delivery": "pool"}
+    for algo, n_win in (("gossip", 1), ("push-sum", 2)):
+        _pair, env, _mode = _assert_agrees(
+            "pool2-sharded", "full", algo, 262144, 2, cfg
+        )
+        assert env["windows"] == n_win
+
+
+def test_fused_pool_sharded_declaration_agreement():
+    # The VMEM pool composition: one batched gather of the replicated
+    # state planes (serial: one per plane), and NO reduction collective on
+    # either schedule — the declaration names no psum, strictness pins it
+    # to zero.
+    cfg = {"engine": "fused", "delivery": "pool"}
+    for algo, planes in (("gossip", 3), ("push-sum", 4)):
+        _pair, env, _mode = _assert_agrees(
+            "fused-pool-sharded", "full", algo, 131072, 2, cfg
+        )
+        assert env["planes"] == planes

@@ -85,7 +85,7 @@ def test_shard_delivery_plan_torus_collapses_to_one_group():
     assert M == groups[0][1]
     # Every wrap class carries the two-variant blend pair; reads point at
     # the single group.
-    for d, reads in classes:
+    for _d, reads in classes:
         assert len(reads) == 2
         assert all(gi == 0 for gi, _e, _sq, _t1 in reads)
     # The group margin covers each read's offset: off <= span + 7 and the
@@ -151,7 +151,7 @@ def test_halo_dma_probe_traces_on_cpu():
                     max_rounds=8, halo_dma="on")
     seen = {}
 
-    def probe(fn, args):
+    def probe(fn, args, **info):
         seen["jaxpr"] = jax.make_jaxpr(fn)(*args)
         return "probed"
 

@@ -97,7 +97,7 @@ def test_two_process_sharded_matches_single_process(tmp_path):
     assert "Convergence Time" not in logs[1]
 
 
-def _run_pair(tmp_path, port, cli_args, expect_rc={0}, timeout=300):
+def _run_pair(tmp_path, port, cli_args, expect_rc=(0,), timeout=300):
     outs = [tmp_path / f"rec{pid}.jsonl" for pid in range(2)]
     procs = [_spawn(pid, port, cli_args, outs[pid]) for pid in range(2)]
     logs = []

@@ -329,7 +329,7 @@ def test_serving_app_end_to_end_two_buckets():
             t.join()
         polling["stop"] = True
         poller.join()
-        for i, (status, resp) in results.items():
+        for _i, (status, resp) in results.items():
             assert status == 200, resp
             assert resp["ok"] and resp["result"]["outcome"] == "converged"
             assert resp["serving"]["engine_degraded"] is None

@@ -1,0 +1,306 @@
+"""The auditor audited: every checker has a fires (seeded-bad fixture)
+and a clean (negative) pin, plus the baseline/report plumbing and the
+full-matrix smoke (slow suite).
+
+The ISSUE 11 tree audits clean — `python -m cop5615_gossip_protocol_tpu
+.analysis` exits 0 on an EMPTY baseline (pinned here in the slow smoke) —
+so the fires direction of each checker is proved against the seeded-bad
+programs in tests/fixtures/analysis/ instead: a checker that silently
+stops firing is a tier-1 failure, not a latent hole in CI.
+"""
+
+import importlib.util
+import sys
+import types
+from pathlib import Path
+
+import pytest
+
+import jax
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from cop5615_gossip_protocol_tpu.analysis import (  # noqa: E402
+    contracts,
+    lint_rules,
+    report,
+    tags,
+    trace,
+    wire_specs,
+)
+
+FIXTURES = Path(__file__).resolve().parent / "fixtures" / "analysis"
+
+
+def _bad_programs():
+    spec = importlib.util.spec_from_file_location(
+        "analysis_bad_programs", FIXTURES / "bad_programs.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _cell(fn, args, donate=False, engine="fixture-engine"):
+    return trace.TracedCell(
+        engine=engine, topology="full", algorithm="gossip", n=8,
+        n_devices=1, overlap=True, extras={}, fn=fn, args=args,
+        donate=donate,
+    )
+
+
+# --- host-sync -------------------------------------------------------------
+
+
+def test_host_sync_fires_on_body_callback():
+    bad = _bad_programs()
+    findings = contracts.check_host_sync(_cell(*bad.host_sync_chunk()))
+    assert [f.rule for f in findings] == ["body-debug_callback"]
+    assert findings[0].checker == "host-sync"
+
+
+def test_host_sync_clean_on_plain_loop():
+    bad = _bad_programs()
+    assert contracts.check_host_sync(_cell(*bad.clean_chunk())) == []
+
+
+# --- dtype policy ----------------------------------------------------------
+
+
+def test_dtype_policy_fires_on_f64_promotion():
+    bad = _bad_programs()
+    with jax.experimental.enable_x64():
+        cell = _cell(*bad.f64_promotion_chunk())
+        cell.closed_jaxpr  # trace inside the x64 context
+    findings = contracts.check_dtype_policy(cell)
+    assert findings, "np.float64 promotion in the body must be flagged"
+    assert all(f.rule.startswith("body-f64-") for f in findings)
+
+
+def test_dtype_policy_clean_on_pinned_f32():
+    bad = _bad_programs()
+    with jax.experimental.enable_x64():
+        cell = _cell(*bad.clean_f32_chunk())
+        cell.closed_jaxpr
+    assert contracts.check_dtype_policy(cell) == []
+
+
+# --- donation --------------------------------------------------------------
+
+
+def test_donation_fires_on_unaliased_carry():
+    bad = _bad_programs()
+    cell = _cell(*bad.unaliased_donated_chunk(), donate=True)
+    findings = contracts.check_donation(cell)
+    assert [f.rule for f in findings] == ["state-leaf-0"]
+
+
+def test_donation_clean_on_donated_carry_through_compile():
+    bad = _bad_programs()
+    cell = _cell(*bad.donated_chunk(), donate=True)
+    assert contracts.check_donation(cell, compile_check=True) == []
+
+
+def test_donation_skips_when_not_donated():
+    bad = _bad_programs()
+    cell = _cell(*bad.unaliased_donated_chunk(), donate=False)
+    assert contracts.check_donation(cell) == []
+
+
+# --- wire-spec -------------------------------------------------------------
+
+
+def test_wire_spec_fires_on_double_psum(monkeypatch):
+    from cop5615_gossip_protocol_tpu import SimConfig, build_topology
+    from cop5615_gossip_protocol_tpu.parallel.mesh import NODE_AXIS, make_mesh
+
+    bad = _bad_programs()
+    mod = types.ModuleType("analysis_fixture_wire_spec")
+    mod.WIRE_SPEC = bad.FIXTURE_WIRE_SPEC
+    monkeypatch.setitem(
+        sys.modules, "analysis_fixture_wire_spec", mod
+    )
+    monkeypatch.setitem(
+        wire_specs.SPEC_HOMES, "fixture-engine",
+        "analysis_fixture_wire_spec",
+    )
+    mesh = make_mesh(2)
+    cell = _cell(*bad.double_psum_chunk(mesh, NODE_AXIS))
+    rep = trace.AuditReport(
+        engine="fixture-engine", topology="full", algorithm="gossip",
+        n=8, n_devices=2, overlap=True, counts=cell.counts,
+    )
+    cfg = SimConfig(n=8, topology="full", algorithm="gossip")
+    findings = wire_specs.check_report(rep, build_topology("full", 8), cfg)
+    assert [f.rule for f in findings] == ["body-psum"], findings
+    assert "declared 1 psum in body, traced 2" in findings[0].detail
+
+
+def test_wire_spec_clean_when_counts_match_declaration():
+    # Synthetic counts built to exactly match the pool2 declaration — the
+    # diff (including the strictness zeros and the mechanism column) must
+    # come back empty without tracing anything.
+    from cop5615_gossip_protocol_tpu import SimConfig, build_topology
+
+    spec = wire_specs.get_spec("pool2-sharded")
+    cfg = SimConfig(n=1024, topology="full", algorithm="push-sum",
+                    engine="fused", delivery="pool",
+                    overlap_collectives=True)
+    topo = build_topology("full", 1024)
+    env, mode = wire_specs.wire_env("pool2-sharded", topo, cfg, 2)
+    want = wire_specs.expected_counts(spec, env, "overlap", mode)
+    counts = {
+        region: {
+            prim: {"count": n, "bytes": 64 * n}
+            for prim, n in want[region].items() if n
+        }
+        for region in ("body", "setup")
+    }
+    rep = trace.AuditReport(
+        engine="pool2-sharded", topology="full", algorithm="push-sum",
+        n=1024, n_devices=2, overlap=True, counts=counts,
+    )
+    assert wire_specs.check_report(rep, topo, cfg) == []
+
+
+def test_wire_spec_missing_declaration_is_a_finding():
+    rep = trace.AuditReport(
+        engine="undeclared-engine", topology="full", algorithm="gossip",
+        n=8, n_devices=2, overlap=True,
+        counts={"body": {}, "setup": {}},
+    )
+    findings = wire_specs.check_report(rep, None, None)
+    assert [f.rule for f in findings] == ["no-spec"]
+
+
+# --- prng tags -------------------------------------------------------------
+
+
+def test_tags_fire_on_overlapping_registry():
+    reg = {
+        "base": {"a": (0, 100), "b": (50, 150)},
+        "round": {"x": 7, "y": 7},
+    }
+    rules = {f.rule for f in tags.check_disjoint(reg)}
+    assert rules == {"base-region-overlap", "round-tag-collision"}
+
+
+def test_tags_fire_on_fixture_harvest():
+    # Both callee forms (attribute and bare from-import, incl. data=
+    # keyword) and both constant forms (plain and annotated) are visible.
+    rules = [f.rule for f in tags.harvest_fold_ins(root=FIXTURES)]
+    assert sorted(rules) == [
+        "literal-tag-outside-map", "literal-tag-outside-map",
+        "unregistered-tag-constant", "unregistered-tag-constant",
+        "unregistered-tag-fold", "unregistered-tag-fold",
+    ]
+
+
+def test_tags_clean_on_real_tree():
+    # The machine-verified TAG MAP (ops/faults.py docstring): pairwise
+    # disjoint regions, every fold_in site classified.
+    assert tags.check_tags() == []
+
+
+# --- lints -----------------------------------------------------------------
+
+
+def test_lint_host_conversions_fire_on_fixture():
+    rules = sorted(
+        f.rule for f in lint_rules.check_host_conversions(FIXTURES)
+        if "bad_host" in f.where
+    )
+    assert rules == ["traced-int", "traced-item", "traced-np-asarray"]
+
+
+def test_lint_schema_lockstep_fires_on_fixture():
+    rules = sorted(
+        f.rule for f in lint_rules.check_schema_lockstep(FIXTURES)
+        if "bad_schema" in f.where
+    )
+    assert rules == [
+        "schema-constant-unused", "schema-constant-unused",
+        "schema-constant-unused", "schema-literal",
+    ]
+
+
+def test_lint_refusal_fires_on_fixture():
+    # Two dead-ends fire (a static one and one whose f-string interpolates
+    # DATA — data does not exempt the text around it); the third refusal
+    # delegates to a computed *_support reason and must NOT fire.
+    findings = lint_rules.check_refusals(FIXTURES / "bad_runner.py")
+    assert [f.rule for f in findings] == [
+        "refusal-dead-end", "refusal-dead-end",
+    ]
+
+
+def test_lints_clean_on_real_tree():
+    assert lint_rules.run_lints() == []
+
+
+# --- report / baseline -----------------------------------------------------
+
+
+def test_baseline_split_and_stale_detection():
+    f1 = report.Finding("c", "w", "r", "detail one")
+    f2 = report.Finding("c", "w2", "r", "detail two")
+    baseline = {"suppressions": [
+        {"fingerprint": f1.fingerprint, "reason": "known"},
+        {"fingerprint": "c::gone::r", "reason": "stale"},
+    ]}
+    new, suppressed, stale = report.apply_baseline([f1, f2], baseline)
+    assert new == [f2]
+    assert suppressed == [f1]
+    assert stale == ["c::gone::r"]
+    # Wording changes must not churn fingerprints.
+    assert report.Finding("c", "w", "r", "reworded").fingerprint == (
+        f1.fingerprint
+    )
+
+
+def test_committed_baseline_is_empty_and_loads():
+    baseline = report.load_baseline()
+    assert baseline["suppressions"] == []
+
+
+def test_baseline_rejects_unjustified_suppression(tmp_path):
+    p = tmp_path / "baseline.json"
+    p.write_text('{"suppressions": [{"fingerprint": "a::b::c"}]}')
+    with pytest.raises(ValueError, match="reason"):
+        report.load_baseline(p)
+
+
+def test_cli_lint_only_clean():
+    from cop5615_gossip_protocol_tpu.analysis.__main__ import main
+
+    assert main(["--lint-only", "--quiet"]) == 0
+
+
+def test_cli_reduced_scope_does_not_judge_staleness(tmp_path):
+    # A baselined traced-cell finding never fires in a --lint-only run;
+    # that must NOT read as stale (exit 2) — only FULL runs audit the
+    # scope the baseline was recorded against.
+    from cop5615_gossip_protocol_tpu.analysis.__main__ import main
+
+    p = tmp_path / "baseline.json"
+    p.write_text(
+        '{"suppressions": [{"fingerprint": '
+        '"wire-spec::some/traced/cell::body-psum", '
+        '"reason": "traced-cell suppression outside lint scope"}]}'
+    )
+    assert main(["--lint-only", "--quiet", "--baseline", str(p)]) == 0
+
+
+# --- full matrix (slow) ----------------------------------------------------
+
+
+@pytest.mark.slow
+def test_full_matrix_audits_clean():
+    # Every runner-ladder cell reachable on CPU, traced (never executed)
+    # under x64, against an EMPTY baseline: wire-spec declarations,
+    # host-sync freedom, dtype policy, donation aliasing, the TAG MAP and
+    # the AST lints all hold on the committed tree.
+    from cop5615_gossip_protocol_tpu.analysis import matrix
+
+    findings = matrix.audit_matrix()
+    assert findings == [], [f.fingerprint for f in findings]
