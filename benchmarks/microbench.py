@@ -20,6 +20,15 @@ markdown section:
 - **dynamic-address floor** (r4-#5) — per-element cost of scatter-add /
   gather vs a circular roll, size-differenced so the dispatch floor
   cancels: the measured gap between random-access and streaming delivery.
+- **delivery floor** (ISSUE 12) — the r4 dynamic-address floor extended to
+  the MXU tier: per-DELIVERED-element cost of the three delivery
+  formulations over identical sampled targets — scatter-add, the pool
+  masked-roll form, and the blocked one-hot `dot_general`
+  (ops/delivery.deliver_matmul). The matmul form does O(n/128) MACs per
+  delivered element (the one-hot is dense per 128-column block), so its
+  per-element cost scales with n and is reported AT each size rather than
+  size-differenced; on CPU there is no MXU, so these numbers are the
+  formulation overheads only — the on-chip re-measure is pending.
 - **compile cache** — compile time of a fresh probe program with the
   persistent cache enabled; on a second process run the same probe is a
   cache hit, so the reported number collapses (the suite-level effect is
@@ -215,6 +224,71 @@ def addressing_floor(n1: int, n2: int, reps: int) -> dict:
     return out
 
 
+def delivery_forms(n: int, pool_size: int) -> dict:
+    """The three delivery formulations over IDENTICAL pool-sampled targets
+    (the matmul tier's stream): {name: (jitted fn, args)}. The ONE home
+    for the op-level comparison surface — `delivery_floor` below and
+    benchmarks/trend.py's matmul-tier section both time these forms, so
+    the two tables cannot drift in what they measure."""
+    import jax
+    import jax.numpy as jnp
+
+    from cop5615_gossip_protocol_tpu.ops import delivery, sampling
+
+    kr = sampling.round_key(jax.random.PRNGKey(0), 3)
+    offs = sampling.pool_offsets(kr, pool_size, n)
+    choice = sampling.pool_choice_packed(kr, n, pool_size)
+    ids = jnp.arange(n, dtype=jnp.int32)
+    targets = sampling.targets_pool(choice, offs, ids, n)
+    vals = jnp.ones((n,), jnp.float32)
+    return {
+        "scatter_add": (
+            jax.jit(lambda v, t: delivery.deliver(v, t, n)),
+            (vals, targets),
+        ),
+        "pool_rolls": (
+            jax.jit(lambda v, c, o: delivery.deliver_pool(v[None], c, o)[0]),
+            (vals, choice, offs),
+        ),
+        "onehot_dot_general": (
+            jax.jit(lambda v, t: delivery.deliver_matmul(v, t, n)),
+            (vals, targets),
+        ),
+    }
+
+
+def time_delivery_form(form, reps: int) -> float:
+    """Median µs of one (jitted fn, args) pair from `delivery_forms`
+    (compile excluded)."""
+    f, a = form
+    f(*a).block_until_ready()
+    return _timed(lambda: f(*a).block_until_ready(), reps)["median_us"]
+
+
+def delivery_floor(n1: int, n2: int, pool_size: int, reps: int) -> dict:
+    """Per-delivered-element cost of scatter-add vs pool masked rolls vs
+    the blocked one-hot dot_general, over IDENTICAL pool-sampled targets
+    (the matmul tier's stream). Scatter/roll report both the per-size
+    medians and the size-differenced floor (dispatch cancels); the matmul
+    form is O(n/128) MACs per element, so differencing would mix sizes of
+    different work — it reports per-element at each size with the scaling
+    documented. CPU numbers are formulation overheads (no MXU);
+    BENCH_TABLES notes the on-chip re-measure as pending."""
+    out = {"n1": n1, "n2": n2, "pool_size": pool_size}
+    per_size: dict = {}
+    for n in (n1, n2):
+        per_size[n] = {}
+        for name, form in delivery_forms(n, pool_size).items():
+            us = time_delivery_form(form, reps)
+            per_size[n][name] = us
+            out[f"{name}_ns_per_elem_n{n}"] = us / n * 1e3
+    for name in ("scatter_add", "pool_rolls"):
+        out[f"{name}_ns_per_elem_diff"] = (
+            (per_size[n2][name] - per_size[n1][name]) / (n2 - n1) * 1e3
+        )
+    return out
+
+
 def compile_cache_probe(n: int, cache_dir: str) -> dict:
     """Compile a probe chunk with the persistent cache enabled (the caller
     enabled it BEFORE the process's first compile — the cache initializes
@@ -275,6 +349,11 @@ def collect(quick: bool = False, n: int | None = None) -> dict:
             1 << 16 if quick else 1 << 20,
             reps,
         ),
+        "delivery_floor": delivery_floor(
+            1 << 10 if quick else 1 << 12,
+            1 << 12 if quick else 1 << 14,
+            4, reps,
+        ),
         "compile_cache": compile_cache_probe(n_chunk, cache_dir),
     }
     floor_us = stats["dispatch_floor"]["median_us"]
@@ -298,6 +377,7 @@ def section(stats: dict) -> list[str]:
     ad = stats["addressing"]
     cc = stats["compile_cache"]
     te = stats["telemetry"]
+    dl = stats["delivery_floor"]
     hidden = cs.get("boundary_us_hidden_depth4")
     return [
         "## Dispatch floor (benchmarks/microbench.py)",
@@ -332,6 +412,25 @@ def section(stats: dict) -> list[str]:
         f"| circular roll (stencil class) | "
         f"{ad['roll_ns_per_elem']:.2f} ns/elem | streaming delivery for "
         "comparison |",
+        f"| delivery floor: scatter-add | "
+        f"{dl['scatter_add_ns_per_elem_diff']:.2f} ns/elem | "
+        f"same pool-sampled targets, sizes {dl['n1']:,}/{dl['n2']:,}, "
+        "size-differenced (ISSUE 12) |",
+        f"| delivery floor: pool masked rolls | "
+        f"{dl['pool_rolls_ns_per_elem_diff']:.2f} ns/elem | "
+        f"K={dl['pool_size']} rolls over the same targets, "
+        "size-differenced |",
+        (
+            "| delivery floor: blocked one-hot dot_general | "
+            "{:.2f} / {:.2f} ns/elem at n={:,}/{:,} | matmul tier "
+            "(deliver_matmul): O(n/128) MACs per delivered element, so "
+            "per-element cost scales with n — CPU formulation overhead "
+            "only; on-chip (MXU) re-measure pending |"
+        ).format(
+            dl["onehot_dot_general_ns_per_elem_n%d" % dl["n1"]],
+            dl["onehot_dot_general_ns_per_elem_n%d" % dl["n2"]],
+            dl["n1"], dl["n2"],
+        ),
         f"| probe compile (persistent cache) | {cc['probe_compile_s']:.2f} "
         f"s | cache at `{cc['cache_dir']}` ({cc['cache_entries']} "
         "entries); re-runs hit disk instead of recompiling |",

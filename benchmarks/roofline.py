@@ -54,61 +54,79 @@ HBM_ROOF_GBS = 819.0  # v5e chip HBM bandwidth
 # estimate whose job is classifying rows as issue-bound vs
 # latency/slice-bound, not precision.
 VPU_ROOF_OPS = 3.85e12
+# v5e MXU roof for the matmul tier's f32-accumulate one-hot contractions:
+# the chip's 197 TFLOPs is the bf16 systolic peak; f32-accumulate one-hot
+# work lands near a quarter of it. Like VPU_ROOF_OPS this is a
+# CLASSIFICATION constant (which unit a row roofs against), not a
+# precision claim.
+MXU_ROOF_FLOPS = 4.9e13
 
 # (label, kind, algorithm, n, cfg overrides, bound class,
 #  model bytes/node/round or None, model VPU ops/node/round or None,
-#  justification)
+#  model MXU FLOPs/node/round or None, justification)
 POINTS = (
     ("chunked scatter", "imp3d", "push-sum", 1_000_000,
      dict(delivery="scatter", engine="chunked"), "addressing-bound",
-     None, None,
+     None, None, None,
      "sort-based scatter over n random static edges; the chip's "
      "~8-12 ns/element dynamic-address floor (measured across every "
      "gather/scatter formulation) x 2 channels bounds the round, not HBM"),
     ("chunked stencil", "torus3d", "push-sum", 1_000_000,
      dict(delivery="stencil", engine="chunked"), "HBM-streaming",
-     32 + 8 * 12, None,
+     32 + 8 * 12, None, None,
      "12 displacement classes; XLA materializes each masked roll as its "
      "own HBM pass instead of fusing into one sweep"),
     ("chunked pool", "full", "push-sum", 1_048_576,
      dict(delivery="pool", engine="chunked", pool_size=4), "HBM-streaming",
-     32 + 8 * 4 + 1, None,
+     32 + 8 * 4 + 1, None, None,
      "K=4 masked dynamic rolls; same XLA materialization overhead"),
     ("fused stencil2", "torus3d", "push-sum", 1_000_000,
      dict(delivery="stencil", engine="fused"), "VMEM-resident",
-     None, 390,
+     None, 390, None,
      "state resident across the whole chunk; ops model: full-width "
      "sampling word ~100 + 12-column select ~25 + 12 classes x ~20 "
      "(2-plane masked tile gathers + lane roll) + absorb ~25"),
     ("fused pool", "full", "push-sum", 1_000_000,
      dict(delivery="pool", engine="fused", pool_size=2), "VMEM-resident",
-     None, 86,
+     None, 86, None,
      "state resident across the whole chunk; ops model: packed choice "
      "~13 + sends ~8 + 2 slots x ~20 gather + absorb ~25. n = 1,000,000 "
      "— bench.py's EXACT flagship config, so this row and the bench "
      "headline are the same measurement (the r4 tables' 2^20 row was a "
      "silently different config, VERDICT r4 Weak #1)"),
+    ("fused pool (matmul)", "full", "push-sum", 1_000_000,
+     dict(delivery="matmul", engine="fused", pool_size=2), "MXU-matmul",
+     None, 70, 2048,
+     "ISSUE 12: the fused pool round with the lane-rotation blend moved "
+     "onto the MXU as 128x128 one-hot tiles (bitwise the roll blend); "
+     "MXU model: 2 slots x 2 planes x 2 one-hot dots x 128 MACs x 2 "
+     "FLOPs/MAC = 2048 FLOPs/node/round, leaving the VPU sampling + "
+     "absorb + the per-slot one-hot mask regen (~70 ops). The column "
+     "answers 'which unit does this row roof against' — the dense tier "
+     "is the first engine whose round has a non-zero MXU column at all"),
     ("fused imp", "imp3d", "push-sum", 1_000_000,
      dict(delivery="pool", engine="fused", pool_size=4), "VMEM-resident",
-     None, 360,
+     None, 360, None,
      "lattice + pooled long-range classes, state resident; ops model: "
      "word ~100 + choice ~13 + class select ~20 + 10 classes x ~20 + "
      "absorb ~25"),
     ("pool2 (HBM stream)", "full", "push-sum", 16_777_216,
      dict(delivery="pool", engine="fused", pool_size=2), "HBM-streaming",
-     44, None,
+     44, None, None,
      "r4 zero-send-plane design: raw-window reads + in-consumer choice "
      "regen + packed term/conv; the remaining gap to the roof is the "
-     "synchronous per-tile write volley (RUNLOG r4)"),
+     "synchronous per-tile write volley (RUNLOG r4) — see the MXU column "
+     "note below for the r6 per-unit attribution"),
     ("stencil hbm", "torus3d", "push-sum", 16_777_216,
      dict(delivery="stencil", engine="fused"), "HBM-streaming",
-     45, None,
+     45, None, None,
      "r5 one-sweep redesign (VERDICT r4 #4): raw-state cluster windows + "
      "in-consumer sampling regen — own 32 B r/w + 2 value planes through "
      "ONE shared cluster window (~12 B) + mirrors. A sub-100% row here is "
      "VPU time, not bandwidth: the ~100-op threefry regen and the "
-     "10-class masked reads exceed the shrunk byte model's DMA time, so "
-     "the byte model no longer binds the round"),
+     "10-class masked reads exceed the shrunk byte model's DMA time (the "
+     "MXU FLOPs / arithmetic-intensity column makes the per-unit "
+     "attribution explicit), so the byte model no longer binds the round"),
 )
 
 
@@ -133,18 +151,27 @@ def section() -> list[str]:
         "— their tiled gathers are dynamic-slice/roll sequences whose "
         "dependency chains and sub-tile moves cap issue, the same class "
         "of floor the r3 microbenchmarks measured for every "
-        "dynamic-addressing formulation.",
+        "dynamic-addressing formulation. The MXU FLOPs / "
+        "arithmetic-intensity columns (ISSUE 12) say which UNIT each row "
+        "roofs against: every pre-matmul engine carries a zero MXU "
+        "column (the chip's dominant FLOPs source idle — ROADMAP 5a); "
+        "the dense matmul tier moves the delivery blend onto 128x128 "
+        "one-hot MXU tiles "
+        f"(% of a ~{MXU_ROOF_FLOPS/1e12:.0f} T FLOPs f32-accumulate "
+        "roof), and intensity = MXU FLOPs / HBM byte for the streaming "
+        "rows.",
         "",
         "| engine tier | config | µs/round | model B/node/round | "
         "implied GB/s | % HBM roof | model ops/node/round | % VPU issue "
-        "| bound class |",
-        "|---|---|---|---|---|---|---|---|---|",
+        "| model MXU FLOPs/node/round | % MXU roof | arith intensity "
+        "(FLOP/B) | bound class |",
+        "|---|---|---|---|---|---|---|---|---|---|---|---|",
     ]
     from benchmarks.compare import ENGINE_US_NOISE
 
     notes = []
-    for label, kind, _algo, n, overrides, klass, model_b, model_ops, why \
-            in POINTS:
+    for (label, kind, _algo, n, overrides, klass, model_b, model_ops,
+         model_mxu, why) in POINTS:
         # Spread policy lives in benchmarks.compare.default_round_spread —
         # the same widths bench.py quotes, so the rows are comparable.
         us = engine_us_per_round(kind, "push-sum", n, **overrides)
@@ -165,10 +192,29 @@ def section() -> list[str]:
             ops_s = f"~{model_ops}"
         else:
             vpu_s, ops_s = "—", "—"
+        if model_mxu is not None:
+            mxu_s = f"~{model_mxu:,}"
+            mxu_pct = (
+                "—" if below_noise
+                else f"{100 * n * model_mxu / (us * 1e-6) / MXU_ROOF_FLOPS:.0f}%"
+            )
+        else:
+            # Zero, not '—': the idle MXU is the finding the column exists
+            # to make visible (ROADMAP 5a).
+            mxu_s, mxu_pct = "0", "0%"
+        # Intensity is MXU FLOPs per algorithmic HBM byte — defined for
+        # every row with a byte model (streaming tiers), where a 0.0 is
+        # the idle-MXU finding made quantitative; VMEM-resident rows move
+        # ~no HBM bytes, so the ratio is undefined there ('—').
+        ai_s = (
+            f"{(model_mxu or 0) / model_b:.1f}"
+            if model_b is not None else "—"
+        )
         us_s = f"<{ENGINE_US_NOISE}" if below_noise else f"{us:,.1f}"
         out.append(
             f"| {label} | {kind} {n:,} | {us_s} | {model_s} "
-            f"| {gbs_s} | {pct} | {ops_s} | {vpu_s} | {klass} |"
+            f"| {gbs_s} | {pct} | {ops_s} | {vpu_s} | {mxu_s} | {mxu_pct} "
+            f"| {ai_s} | {klass} |"
         )
         notes.append(f"- **{label}**: {why}.")
         print(f"[roofline] {label}: {us:.1f} us/round", flush=True)

@@ -34,6 +34,10 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parents[1]
 
 SECTION_HEADER = "## Perf trajectory (benchmarks/trend.py)"
+MATMUL_HEADER = (
+    "## Delivery-tier trajectory — MXU matmul "
+    "(benchmarks/trend.py --matmul-tier)"
+)
 
 
 def load_snapshots(root: Path) -> dict:
@@ -196,15 +200,88 @@ def render_ceilings(n_dev: int = 8) -> str:
     return "\n".join(lines)
 
 
-def apply_to_bench_tables(table_md: str, bench_tables: Path) -> None:
-    """Idempotently install/replace the trajectory section: everything
-    from SECTION_HEADER to the next '## ' heading (or EOF) is replaced."""
+def render_matmul_tier() -> str:
+    """The ISSUE 12 delivery-tier row, measured on THIS box's CPU: the
+    chunked matmul tier vs the chunked pool tier at full n=1024 (fixed
+    identical rounds via an unreachable rumor threshold — the
+    microbench/chunk_sync methodology, so both cells execute the same
+    chunks x chunk_rounds and the comparison is batching-comparable to
+    the trajectory table's fixed-round cells) plus the op-level pool
+    aggregation pair, timed through benchmarks/microbench.delivery_forms
+    — the ONE home of the deliver_pool-vs-deliver_matmul comparison
+    surface, so this section and the Dispatch-floor rows cannot drift in
+    methodology. On
+    CPU there is no MXU, so the matmul column measures formulation
+    overhead only; the on-chip regen fills the real rows (the BENCH
+    protocol — same as the topology-ceilings ms/round cells)."""
+    sys.path.insert(0, str(REPO))
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_threefry_partitionable", True)
+
+    from benchmarks.microbench import delivery_forms, time_delivery_form
+
+    from cop5615_gossip_protocol_tpu import SimConfig, build_topology, run
+
+    n, chunks, chunk_rounds = 1024, 16, 8
+    topo = build_topology("full", n)
+    per_round_us = {}
+    for d in ("pool", "matmul"):
+        cfg = SimConfig(
+            n=n, topology="full", algorithm="gossip", seed=0, delivery=d,
+            rumor_threshold=10**6, engine="chunked",
+            chunk_rounds=chunk_rounds, max_rounds=chunks * chunk_rounds,
+        )
+        best = None
+        for _ in range(3):
+            res = run(topo, cfg)
+            assert res.rounds == chunks * chunk_rounds
+            best = res.run_s if best is None else min(best, res.run_s)
+        per_round_us[d] = best / (chunks * chunk_rounds) * 1e6
+
+    forms = delivery_forms(n, 4)
+    agg_us = {
+        "pool rolls": time_delivery_form(forms["pool_rolls"], 40),
+        "one-hot dot_general": time_delivery_form(
+            forms["onehot_dot_general"], 40
+        ),
+    }
+
+    return "\n".join([
+        MATMUL_HEADER,
+        "",
+        "MXU delivery tier (ISSUE 12) vs the pool tier it is "
+        "stream-identical to, measured on this box's CPU (fixed "
+        f"{chunks} x {chunk_rounds} rounds, min-of-3 — the fixed-round "
+        "methodology of the trajectory cells above, so the columns are "
+        "batching-comparable). Gossip trajectories are bitwise-identical "
+        "across the two tiers (tests/test_delivery_matmul.py); on CPU "
+        "the one-hot contraction has no MXU to land on, so its column is "
+        "formulation overhead — the on-chip regen (MXU) is pending.",
+        "",
+        "| cell | chunked pool | chunked matmul | on-chip (MXU) |",
+        "|---|---|---|---|",
+        "| full n=1,024 gossip, µs/round | "
+        f"{per_round_us['pool']:,.0f} | {per_round_us['matmul']:,.0f} "
+        "| pending |",
+        "| pool aggregation op (n=1,024, K=4), µs | "
+        f"{agg_us['pool rolls']:,.0f} | "
+        f"{agg_us['one-hot dot_general']:,.0f} | pending |",
+        "",
+    ])
+
+
+def apply_to_bench_tables(table_md: str, bench_tables: Path,
+                          header: str = SECTION_HEADER) -> None:
+    """Idempotently install/replace one generated section: everything
+    from ``header`` to the next '## ' heading (or EOF) is replaced."""
     text = bench_tables.read_text()
-    if SECTION_HEADER in text:
-        start = text.index(SECTION_HEADER)
-        rest = text[start + len(SECTION_HEADER):]
+    if header in text:
+        start = text.index(header)
+        rest = text[start + len(header):]
         nxt = rest.find("\n## ")
-        end = len(text) if nxt < 0 else start + len(SECTION_HEADER) + nxt + 1
+        end = len(text) if nxt < 0 else start + len(header) + nxt + 1
         text = text[:start] + table_md + text[end:]
     else:
         if not text.endswith("\n"):
@@ -235,6 +312,12 @@ def main(argv=None) -> int:
     ap.add_argument("--ceilings", action="store_true",
                     help="append the plan-level topology-ceilings table "
                     "(ISSUE 10), recomputed from the pure plan functions")
+    ap.add_argument("--matmul-tier", action="store_true",
+                    help="measure and append the MXU-matmul delivery-tier "
+                    "row (ISSUE 12): chunked matmul vs pool at full "
+                    "n=1024 plus the pool-aggregation op pair, on this "
+                    "box's CPU (on-chip regen pending); with --apply the "
+                    "section installs into BENCH_TABLES.md idempotently")
     args = ap.parse_args(argv)
 
     revs = load_snapshots(args.root)
@@ -268,17 +351,28 @@ def main(argv=None) -> int:
         serving[args.rev] = float(rps)
 
     table = render(revs, serving)
+    matmul_md = render_matmul_tier() if args.matmul_tier else None
     # The ceilings section rides the printed/--md output only: --apply
     # replaces BENCH_TABLES.md's trajectory section up to the next "## "
     # heading, so appending another "## " section to its input would
     # break the replace's idempotency (BENCH_TABLES keeps its own
-    # hand-annotated ceilings section).
-    out = table + "\n" + render_ceilings() if args.ceilings else table
+    # hand-annotated ceilings section). The matmul-tier section has its
+    # OWN header and its own idempotent apply, so it composes.
+    out = table
+    if args.ceilings:
+        out = out + "\n" + render_ceilings()
+    if matmul_md is not None:
+        out = out + "\n" + matmul_md
     print(out)
     if args.md:
         args.md.write_text(out + "\n")
     if args.apply:
         apply_to_bench_tables(table, args.root / "BENCH_TABLES.md")
+        if matmul_md is not None:
+            apply_to_bench_tables(
+                matmul_md, args.root / "BENCH_TABLES.md",
+                header=MATMUL_HEADER,
+            )
         print(f"[trend] applied to {args.root / 'BENCH_TABLES.md'}",
               file=sys.stderr)
     return 0

@@ -29,6 +29,15 @@ Donation
     lowering time (``tf.aliasing_output``); shard_map lowerings defer to
     the compiler (``jax.buffer_donor``), which ``compile_check=True``
     resolves through the compiled HLO's ``input_output_alias`` map.
+
+MXU matmul delivery
+    A ``delivery='matmul'`` cell's traced chunk must aggregate on the MXU:
+    at least one ``dot_general`` in the program (the blocked one-hot
+    delivery, or the fused kernels' 128x128 one-hot lane blend) and ZERO
+    scatter-family primitives anywhere in it — a scatter reappearing would
+    mean the tier silently fell back to the dynamic-address path whose
+    ~8-12 ns/element floor the tier exists to escape. Fires direction
+    pinned by the seeded-bad fixture (tests/fixtures/analysis).
 """
 
 from __future__ import annotations
@@ -70,6 +79,50 @@ def check_host_sync(cell) -> list[Finding]:
         )
         for prim, count in sorted(hits.items())
     ]
+
+
+def check_matmul_delivery(cell) -> list[Finding]:
+    """delivery='matmul' cells aggregate on the MXU: >= 1 dot_general in
+    the traced chunk, zero scatter-family primitives anywhere in it.
+
+    Scans the WHOLE program, not just the while body: the fused tiers'
+    round loop is the pallas_call grid (no XLA while wraps the kernel), so
+    a body-only scan would miss them — and a scatter anywhere in a matmul
+    chunk is a fallback onto the dynamic-address path either way. No-op
+    for cells that did not resolve the matmul rung."""
+    if cell.extras.get("delivery") != "matmul":
+        return []
+    where = _cell_where(cell)
+    dots = 0
+    scatters: dict[str, int] = {}
+    for eqn, _in_body in jaxpr_walk.iter_eqns(cell.closed_jaxpr.jaxpr):
+        name = eqn.primitive.name
+        if name == "dot_general":
+            dots += 1
+        elif name.startswith("scatter"):
+            scatters[name] = scatters.get(name, 0) + 1
+    findings = []
+    if dots == 0:
+        findings.append(Finding(
+            checker="matmul-delivery", where=where, rule="no-dot-general",
+            detail=(
+                "delivery='matmul' resolved but the traced chunk contains "
+                "no dot_general — the round is not aggregating on the MXU "
+                "(the one-hot delivery silently fell back to a VPU "
+                "formulation)"
+            ),
+        ))
+    for prim, count in sorted(scatters.items()):
+        findings.append(Finding(
+            checker="matmul-delivery", where=where, rule=f"scatter-{prim}",
+            detail=(
+                f"{count}x {prim} in a delivery='matmul' chunk — the MXU "
+                "tier must carry zero scatter primitives (a scatter is the "
+                "~8-12 ns/element dynamic-address fallback the tier "
+                "exists to escape)"
+            ),
+        ))
+    return findings
 
 
 # f64 reduction primitives that MAY carry float64 inside a body when the

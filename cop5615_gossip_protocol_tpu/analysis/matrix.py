@@ -19,6 +19,8 @@ checker set:
   ``input_output_alias`` proof on the cheap XLA engines ('sharded',
   'chunked'), where a deferred ``jax.buffer_donor`` could silently not
   alias;
+- the MXU matmul contract on ``delivery='matmul'`` cells (dot_general
+  present, zero scatter primitives — contracts.check_matmul_delivery);
 - the PRNG TAG MAP audit and the AST lint families (once per run, not
   per cell).
 
@@ -70,6 +72,14 @@ AUDIT_GRID = (
      {"engine": "fused", "delivery": "pool"}),
     ("pool2-sharded", "full", "push-sum", 262144, 2,
      {"engine": "fused", "delivery": "pool"}),
+    # MXU matmul tier (ISSUE 12): the per-shard one-hot blend after the
+    # one all_gather — the SAME WIRE_SPEC as the pool rows must hold
+    # (the matmul rung moves compute units, never wire structure), plus
+    # the matmul contract (dot_general present, scatter absent).
+    ("pool2-sharded", "full", "gossip", 262144, 2,
+     {"engine": "fused", "delivery": "matmul"}),
+    ("pool2-sharded", "full", "push-sum", 262144, 2,
+     {"engine": "fused", "delivery": "matmul"}),
 )
 
 # Single-device cells through models.runner.run (n_devices=1): the chunked
@@ -83,6 +93,14 @@ SINGLE_GRID = (
      {"engine": "fused", "delivery": "pool"}),
     ("fused", "torus3d", "push-sum", 4096, 1,
      {"engine": "fused", "chunk_rounds": 8}),
+    # MXU matmul tier (ISSUE 12): the chunked blocked one-hot dot_general
+    # round and the fused pool kernel's in-kernel one-hot lane blend —
+    # both must satisfy the matmul contract (dot_general present, zero
+    # scatter primitives).
+    ("chunked", "full", "gossip", 256, 1, {"delivery": "matmul"}),
+    ("chunked", "full", "push-sum", 1024, 1, {"delivery": "matmul"}),
+    ("fused", "full", "gossip", 4096, 1,
+     {"engine": "fused", "delivery": "matmul"}),
 )
 
 # Engines whose donation check also compiles and proves the HLO
@@ -132,6 +150,7 @@ def _report_of(cell) -> trace.AuditReport:
 def _cell_contracts(cell, compile_check: bool) -> list[Finding]:
     out = contracts.check_host_sync(cell)
     out += contracts.check_dtype_policy(cell)
+    out += contracts.check_matmul_delivery(cell)
     with _x64():
         out += contracts.check_donation(cell, compile_check=compile_check)
     return out

@@ -168,16 +168,20 @@ def build_parser() -> argparse.ArgumentParser:
                    "many consecutive chunks without converged-count "
                    "progress (0 disables) — the reference's line-topology "
                    "hang as a measured event")
-    p.add_argument("--delivery", choices=["auto", "scatter", "stencil", "pool"],
+    p.add_argument("--delivery",
+                   choices=["auto", "scatter", "stencil", "pool", "matmul"],
                    default="auto",
                    help="message delivery: stencil (shift-based, offset-structured "
                    "topologies) vs scatter-add vs pool (per-round shared "
                    "displacement pool, delivery as masked rolls — on the full "
                    "topology as offset-pool sampling, on imp2d/imp3d as pooled "
-                   "long-range edges over the lattice stencil); auto picks "
-                   "stencil where legal")
+                   "long-range edges over the lattice stencil) vs matmul (the "
+                   "MXU tier: the same pooled sampling stream delivered as a "
+                   "blocked one-hot dot_general — gossip bitwise the pool "
+                   "path); auto picks stencil where legal")
     p.add_argument("--pool-size", type=int, default=4,
-                   help="displacement-pool width for --delivery pool (power of two)")
+                   help="displacement-pool width for --delivery pool/matmul "
+                   "(power of two)")
     p.add_argument("--engine", choices=["auto", "chunked", "fused"], default="auto",
                    help="round engine: chunked (XLA while_loop) vs fused (Pallas "
                    "multi-round kernel, VMEM-resident state); auto fuses on TPU "

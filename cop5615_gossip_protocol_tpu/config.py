@@ -273,8 +273,14 @@ class SimConfig:
     # sampling on the implicit full topology (each round draws pool_size
     # shared uniform displacements; delivery is pool_size masked rolls — no
     # scatter/sort; partner marginals stay uniform, draws within a round are
-    # correlated: ops/sampling.pool_offsets), "auto" = stencil where the
-    # topology supports it, else scatter.
+    # correlated: ops/sampling.pool_offsets), "matmul" = the MXU tier: the
+    # SAME pooled sampling stream as "pool" (identical choices/offsets, so
+    # trajectories are stream-identical) with delivery recast as a blocked
+    # one-hot dot_general (ops/delivery.deliver_matmul; the fused pool
+    # kernels execute the lane blend as 128x128 one-hot MXU tiles) —
+    # gossip inboxes are bitwise the pool path's (integer-exact sums),
+    # push-sum reassociates within the documented float contract; "auto" =
+    # stencil where the topology supports it, else scatter.
     delivery: str = "auto"
 
     # Offset-pool width for delivery="pool". Power of two so the per-node
@@ -460,10 +466,10 @@ class SimConfig:
                 "round state; depth beyond a few buys nothing past the "
                 "dispatch floor)"
             )
-        if self.delivery not in ("auto", "scatter", "stencil", "pool"):
+        if self.delivery not in ("auto", "scatter", "stencil", "pool", "matmul"):
             raise ValueError(
                 f"unknown delivery {self.delivery!r}; "
-                "expected auto|scatter|stencil|pool"
+                "expected auto|scatter|stencil|pool|matmul"
             )
         if self.delivery == "pool" and self.topology not in (
             "full", "imp2d", "imp3d"
@@ -472,6 +478,17 @@ class SimConfig:
                 "delivery='pool' applies to the implicit full topology "
                 "(offset-pool sampling) and to imp2d/imp3d (pooled "
                 "long-range edges over the lattice stencil); "
+                f"got topology={self.topology!r}"
+            )
+        if self.delivery == "matmul" and self.topology not in (
+            "full", "imp2d", "imp3d"
+        ):
+            raise ValueError(
+                "delivery='matmul' recasts the pooled delivery as a "
+                "blocked one-hot dot_general (the MXU tier) and applies "
+                "where pooled sampling applies: the implicit full topology "
+                "and imp2d/imp3d; offset-structured kinds keep their "
+                "stencil/scatter plans — "
                 f"got topology={self.topology!r}"
             )
         if not (2 <= self.pool_size <= 1024) or self.pool_size & (self.pool_size - 1):

@@ -372,23 +372,32 @@ def make_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array):
     dtype = _check_dtype(cfg)
     n = topo.n
 
-    if cfg.delivery == "pool" and (cfg.dup_rate > 0 or cfg.delay_rounds > 0):
+    if cfg.delivery in ("pool", "matmul") and (
+        cfg.dup_rate > 0 or cfg.delay_rounds > 0
+    ):
         raise ValueError(
             "dup/delay fault models run on the scatter/stencil chunked "
-            "paths only; pool delivery supports the drop gate "
+            f"paths only; {cfg.delivery} delivery supports the drop gate "
             "(--fault-rate) and crash models"
         )
 
-    if cfg.delivery == "pool":
+    if cfg.delivery in ("pool", "matmul"):
+        # delivery='matmul' is the MXU execution of the SAME pooled
+        # sampling stream: identical choices/offsets per round, delivery
+        # recast as a blocked one-hot dot_general (ops/delivery.
+        # deliver_matmul) instead of masked rolls — gossip inboxes are
+        # bitwise the pool path's, push-sum reassociates within the float
+        # contract (tests/test_delivery_matmul.py).
         if topo.implicit:
             return _make_pool_round_fn(topo, cfg, base_key, dtype)
         if topo.kind in ("imp2d", "imp3d"):
             if cfg.reference:
                 raise ValueError(
-                    "delivery='pool' on imp topologies re-draws the random "
-                    "long-range edge per round and cannot reproduce the "
-                    "reference's static extra edge (Q9, program.fs:308-310); "
-                    "use batched semantics or delivery='scatter'"
+                    f"delivery={cfg.delivery!r} on imp topologies re-draws "
+                    "the random long-range edge per round and cannot "
+                    "reproduce the reference's static extra edge (Q9, "
+                    "program.fs:308-310); use batched semantics or "
+                    "delivery='scatter'"
                 )
             split = imp_split(topo)
             if split is None:
@@ -398,9 +407,10 @@ def make_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array):
                 )
             return _make_imp_pool_round_fn(topo, cfg, base_key, dtype, split)
         raise ValueError(
-            "delivery='pool' applies to the implicit full topology and the "
-            f"imp2d/imp3d random-extra-edge topologies; {topo.kind!r} has "
-            "neither an implicit nor a lattice+extra structure"
+            f"delivery={cfg.delivery!r} applies to the implicit full "
+            f"topology and the imp2d/imp3d random-extra-edge topologies; "
+            f"{topo.kind!r} has neither an implicit nor a lattice+extra "
+            "structure"
         )
 
     key_data, key_impl = sampling.key_split(base_key)
@@ -567,6 +577,18 @@ def _make_pool_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array, dty
     key_data, key_impl = sampling.key_split(base_key)
     life = _life_dev(cfg, n)
     revive_fn = make_revive_fn(cfg, n, life)
+    matmul = cfg.delivery == "matmul"
+
+    def deliver_channels(channels, choice, offs):
+        """The round's delivery mechanism: masked rolls (pool) or the
+        blocked one-hot dot_general over the SAME implied targets
+        (matmul — the MXU tier). Integer channels are bitwise-identical
+        either way; floats differ only by summation order."""
+        if matmul:
+            ids = jnp.arange(n, dtype=jnp.int32)
+            targets = sampling.targets_pool(choice, offs, ids, n)
+            return delivery_mod.deliver_matmul(channels, targets, n)
+        return delivery_mod.deliver_pool(channels, choice, offs)
 
     def _rejoin(state, round_idx):
         if revive_fn is None:
@@ -602,7 +624,7 @@ def _make_pool_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array, dty
                     state.s, state.w, send_ok
                 )
             with jax.named_scope("pushsum_deliver"):
-                inbox = delivery_mod.deliver_pool(
+                inbox = deliver_channels(
                     jnp.stack([s_send, w_send]), choice, offs
                 )
             with jax.named_scope("pushsum_absorb"):
@@ -626,7 +648,7 @@ def _make_pool_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array, dty
             with jax.named_scope("gossip_send"):
                 vals = gossip_mod.send_values(state, send_ok)
             with jax.named_scope("gossip_deliver"):
-                inbox = delivery_mod.deliver_pool(vals[None], choice, offs)[0]
+                inbox = deliver_channels(vals[None], choice, offs)[0]
             with jax.named_scope("gossip_absorb"):
                 # Suppression is receiver-side (models/gossip.absorb): no
                 # pool_lookup backward rolls needed.
@@ -688,6 +710,24 @@ def _make_imp_pool_round_fn(
     lattice_offsets = tuple(int(q) for q in split.lattice_offsets)
     life = _life_dev(cfg, n)
     revive_fn = make_revive_fn(cfg, n, life)
+    matmul = cfg.delivery == "matmul"
+
+    def deliver_channels(channels, d, is_extra, choice, offs):
+        """Lattice + pooled long-range delivery: class/pool masked rolls
+        (pool) or the blocked one-hot dot_general over the materialized
+        per-node targets (matmul). Each sent value lands in exactly one
+        slot in both forms, so integer channels are bitwise-identical;
+        floats differ only by summation order. Non-senders' displacement
+        (d = -1 on the extra slot) resolves to a harmless target — their
+        channel values are already zeroed by the send gate."""
+        if matmul:
+            ids = jnp.arange(n, dtype=jnp.int32)
+            disp = jnp.where(is_extra, offs[choice], d)
+            targets = jnp.remainder(ids + disp, n)
+            return delivery_mod.deliver_matmul(channels, targets, n)
+        return delivery_mod.deliver_imp_pool(
+            channels, d, is_extra, choice, lattice_offsets, offs
+        )
 
     def _rejoin(state, round_idx):
         if revive_fn is None:
@@ -721,9 +761,8 @@ def _make_imp_pool_round_fn(
                     state.s, state.w, send_ok
                 )
             with jax.named_scope("pushsum_deliver"):
-                inbox = delivery_mod.deliver_imp_pool(
-                    jnp.stack([s_send, w_send]), d, is_extra, choice,
-                    lattice_offsets, offs,
+                inbox = deliver_channels(
+                    jnp.stack([s_send, w_send]), d, is_extra, choice, offs
                 )
             with jax.named_scope("pushsum_absorb"):
                 new = pushsum_mod.absorb(
@@ -744,8 +783,8 @@ def _make_imp_pool_round_fn(
             with jax.named_scope("gossip_send"):
                 vals = gossip_mod.send_values(state, send_ok)
             with jax.named_scope("gossip_deliver"):
-                inbox = delivery_mod.deliver_imp_pool(
-                    vals[None], d, is_extra, choice, lattice_offsets, offs
+                inbox = deliver_channels(
+                    vals[None], d, is_extra, choice, offs
                 )[0]
             with jax.named_scope("gossip_absorb"):
                 new = gossip_mod.absorb(state, inbox, rumor_target, suppress)
@@ -1366,7 +1405,15 @@ def _run_resolved(
                     "fused compositions do not carry it — drop the engine "
                     "override"
                 )
-            if topo.implicit and cfg.delivery == "pool":
+            if topo.kind in ("imp2d", "imp3d") and cfg.delivery == "matmul":
+                raise ValueError(
+                    "engine='fused' with delivery='matmul' on imp kinds "
+                    "is not served: the imp x HBM x sharded composition "
+                    "delivers by lattice/pool class rolls — use "
+                    "delivery='pool' for that composition, or the "
+                    "single-device chunked engine for the matmul tier"
+                )
+            if topo.implicit and cfg.delivery in ("pool", "matmul"):
                 # Implicit-full pool compositions, tiered like the
                 # single-device engines: the VMEM replicated composition
                 # (VERDICT r3 #1 — one all_gather of the state planes per
@@ -1386,7 +1433,20 @@ def _run_resolved(
                     run_pool2_sharded,
                 )
 
-                plan_vmem = plan_fused_pool_sharded(topo, cfg, cfg.n_devices)
+                if cfg.delivery == "matmul":
+                    # The matmul tier's sharded home is the replicated-
+                    # pool2 composition (per-shard one-hot MXU blend after
+                    # its one all_gather); the VMEM replicated composition
+                    # keeps the roll formulation.
+                    plan_vmem = (
+                        "the VMEM replicated pool composition serves "
+                        "delivery='pool'; the matmul tier's sharded home "
+                        "is the replicated-pool2 composition"
+                    )
+                else:
+                    plan_vmem = plan_fused_pool_sharded(
+                        topo, cfg, cfg.n_devices
+                    )
                 if not isinstance(plan_vmem, str):
                     return run_fused_pool_sharded(
                         topo, cfg, key=key, on_chunk=on_chunk,
@@ -1460,6 +1520,16 @@ def _run_resolved(
                 f"unavailable: VMEM composition: {plan_vmem}; "
                 f"HBM-streaming composition: {plan_hbm}"
             )
+        if cfg.delivery == "matmul":
+            raise ValueError(
+                "delivery='matmul' has no sharded XLA path (the chunked "
+                "sharded engine delivers pool rounds by global rolls / "
+                "scatter, which would break the matmul tier's zero-scatter "
+                "contract); the MXU tier runs on the single-device chunked "
+                "engine, the fused pool kernels, and the replicated-pool2 "
+                "composition (engine='fused') — drop n_devices or use "
+                "delivery='pool'"
+            )
         # delivery='stencil' is legal under sharding: the halo-exchange plan
         # (parallel/halo.py) implements it as local shifts + boundary
         # ppermutes; run_sharded raises if no exact plan exists.
@@ -1510,14 +1580,18 @@ def _run_resolved(
         # rides the same dispatch: every push-sum kernel implements the
         # global-residual criterion in-kernel (VERDICT r3 #5); gossip can
         # never reach here with it (SimConfig rejects the combination).
-        if cfg.delivery == "pool":
+        if cfg.delivery in ("pool", "matmul"):
             if topo.implicit:
                 from ..ops import fused_pool
 
                 # VMEM-resident engine up to its cap; the HBM-streaming
                 # tier (ops/fused_pool2.py) past it — per-node round cost
                 # stays in the fused class instead of cliffing onto the
-                # chunked XLA path (VERDICT r2 #2).
+                # chunked XLA path (VERDICT r2 #2). Both kernels serve
+                # delivery='matmul' too: the lane-rotation blend lowers to
+                # one-hot 128x128 MXU tiles (ops/fused_pool._lane_blend_mm)
+                # while sampling and trajectories stay bitwise the pool
+                # formulation's.
                 if topo.n <= fused_pool.MAX_POOL_NODES:
                     variant = "pool"
                     reason = fused_pool.pool_fused_support(topo, cfg)
@@ -1526,6 +1600,19 @@ def _run_resolved(
 
                     variant = "pool2"
                     reason = fused_pool2.pool2_support(topo, cfg)
+            elif cfg.delivery == "matmul":
+                # The imp kernels deliver by lattice/pool class rolls; the
+                # matmul tier's fused home is the implicit-full pool
+                # kernels. auto demotes to the chunked engine (which runs
+                # the one-hot dot_general round); engine='fused' fails
+                # loudly below.
+                variant = "imp"
+                reason = (
+                    "the fused imp tiers deliver by lattice/pool class "
+                    "rolls; delivery='matmul' runs the chunked engine on "
+                    "imp kinds (the MXU tier's fused home is the "
+                    "implicit-full pool kernels)"
+                )
             else:
                 from ..ops import fused_imp
 

@@ -1,4 +1,4 @@
-"""Message delivery as scatter-add.
+"""Message delivery: scatter-add, masked rolls, and the MXU matmul tier.
 
 The reference's "message delivery" is an Akka mailbox enqueue per message
 (`<!`, program.fs:93 etc.), drained one at a time by dispatcher threads. In
@@ -11,7 +11,12 @@ reference's unsynchronized shared dictionary hazard (C6, program.fs:71).
 
 from __future__ import annotations
 
+import dataclasses
+
+import numpy as np
+
 import jax.numpy as jnp
+from jax import lax
 
 
 def deliver(values, targets, n: int):
@@ -90,6 +95,189 @@ def deliver_imp_pool(channels, d_sampled, is_extra, choice,
         m = is_extra & (choice == k)
         inbox = inbox + jnp.roll(jnp.where(m[None, :], channels, zero), pool_offs[k], axis=1)
     return inbox
+
+
+# --- MXU matmul delivery tier (delivery='matmul') --------------------------
+#
+# Every delivery above runs on the VPU (scatter/sort units or masked
+# rolls); the MXU — the chip's dominant FLOPs source — sits idle in every
+# engine (ROADMAP item 5a). The ops below recast delivery as dot_general:
+# the round's delivery relation "value i lands in slot targets[i]" IS a
+# matrix–vector product with the one-hot matrix D[i, j] = [targets[i] == j],
+# and neighbor aggregation over a static graph is an SpMV with the
+# adjacency. Blocking both index axes at MM_BLOCK = 128 keeps every
+# materialized one-hot tile MXU-shaped (128x128 — one VMEM tile) and the
+# live adjacency O(n x 128) per step, never N^2.
+
+MM_BLOCK = 128  # MXU systolic array edge; also the VMEM lane width
+
+
+def _acc_dtype(dtype):
+    """Accumulation dtype of the matmul tier: float64 stays float64; every
+    narrower input (float32, bfloat16, integer counts) accumulates in
+    float32 via ``preferred_element_type`` — the bf16 state planes upcast
+    for the contraction and cast back, and integer-valued planes round-trip
+    exactly below 2^24 (gossip counts are bounded by receipts, orders of
+    magnitude under that)."""
+    return jnp.float64 if dtype == jnp.float64 else jnp.float32
+
+
+def deliver_matmul(values, targets, n: int):
+    """Blocked one-hot delivery on the MXU: ``inbox[..., j] = sum over i of
+    values[..., i] * [targets[i] == j]`` as dot_general.
+
+    ``values`` is [n] or [C, n] (push-sum stacks s and w so both channels
+    contract against the same one-hot tiles); ``targets`` the per-node
+    delivery slots. The receiver axis is processed in MM_BLOCK-column
+    blocks by a scanned loop, and within each step the sender axis is
+    blocked too: the one-hot operand is an [nb, 128, 128] batch of tiles
+    (tile (s, j-block) holds [targets == j] for sender block s) contracted
+    in ONE dot_general — so no materialized adjacency tile exceeds a
+    128x128 VMEM tile and the live one-hot footprint is n x 128, never N^2.
+
+    Semantics match `deliver` (scatter-add) and `deliver_pool` (masked
+    rolls) over the same targets up to float summation order: integer-
+    valued channels are EXACT (bitwise — every partial sum is an exact
+    integer in the f32/f64 accumulator), floats reassociate like the other
+    delivery orders do. Pad slots carry target -1 and match no column.
+    Non-finite values poison whole tiles (x*0 = NaN for inf/NaN) — the
+    matmul tier, like the fused kernels, does not carry the health
+    sentinel; tests/test_delivery_matmul.py pins the finite-path parity.
+    """
+    squeeze = values.ndim == 1
+    ch = values[None, :] if squeeze else values
+    B = MM_BLOCK
+    nb = -(-n // B)
+    n_pad = nb * B
+    acc_t = _acc_dtype(ch.dtype)
+    ch_p = jnp.pad(ch.astype(acc_t), ((0, 0), (0, n_pad - n)))
+    t_p = jnp.pad(
+        targets.astype(jnp.int32), (0, n_pad - n), constant_values=-1
+    )
+    vb = ch_p.reshape(ch.shape[0], nb, B)
+    tb = t_p.reshape(nb, B)
+
+    def rec_block(jblk):
+        jids = jblk * B + jnp.arange(B, dtype=jnp.int32)
+        tiles = (tb[:, :, None] == jids[None, None, :]).astype(acc_t)
+        # out[c, j] = sum over (s, i) of vb[c, s, i] * tiles[s, i, j]
+        return lax.dot_general(
+            vb, tiles, (((1, 2), (0, 1)), ((), ())),
+            preferred_element_type=acc_t,
+        )
+
+    blocks = lax.map(rec_block, jnp.arange(nb, dtype=jnp.int32))  # [nb, C, B]
+    inbox = (
+        jnp.moveaxis(blocks, 0, 1)
+        .reshape(ch.shape[0], n_pad)[:, :n]
+        .astype(values.dtype)
+    )
+    return inbox[0] if squeeze else inbox
+
+
+def aggregate_full(values):
+    """Adjacency–vector product with the complete graph, closed form.
+
+    The full topology's adjacency is A = J - I (all-ones minus identity),
+    so the all-neighbor aggregate ``inbox[j] = sum over i != j of
+    values[i]`` is ``sum(values) - values`` — the matmul tier's full-
+    topology closed form, never materializing the N^2 one-hot. This is the
+    aggregation primitive the item-3 scenario protocols (push-pull,
+    anti-entropy) consume; the per-round sampled delivery above keeps its
+    one-hot form (a sampled round's relation is not J - I).
+    """
+    return jnp.sum(values, axis=-1, keepdims=values.ndim > 1) - values
+
+
+@dataclasses.dataclass(frozen=True)
+class SpmvPlan:
+    """Blocked-SpMV plan over a CSR neighbor tensor (BSR form).
+
+    Built host-side once per static graph (`build_spmv_plan`): the CSR
+    in-edge lists are regrouped into dense MM_BLOCK x MM_BLOCK adjacency
+    tiles — tile (s, r) holds A[i, j] for senders i in block s, receivers
+    j in block r — stored packed ([tiles, 128, 128], slot 0 all-zero) with
+    per-receiver-block padded tile lists. `deliver_spmv` then aggregates
+    over ALL in-edges with one batched dot_general per receiver block:
+    the delivery substrate ROADMAP item 3's scale-free/CSR graphs plug
+    into (degree-bounded graphs give O(deg) tiles per block row).
+    """
+
+    n: int
+    nb: int
+    tiles: np.ndarray  # [T, 128, 128] float32, tiles[0] == 0
+    tile_ids: np.ndarray  # [nb, max_t] int32 indices into tiles (0 = pad)
+    src_blocks: np.ndarray  # [nb, max_t] int32 sender-block per tile
+
+
+def build_spmv_plan(indptr, indices, n: int) -> SpmvPlan:
+    """BSR plan from a CSR of IN-edges: ``indices[indptr[j]:indptr[j+1]]``
+    lists the senders delivering into receiver j. Multi-edges accumulate
+    (tile entries count parallel edges)."""
+    B = MM_BLOCK
+    nb = -(-n // B)
+    indptr = np.asarray(indptr, np.int64)
+    indices = np.asarray(indices, np.int64)
+    tile_map: dict = {}
+    for j in range(n):
+        for i in indices[indptr[j]:indptr[j + 1]]:
+            key = (int(i) // B, j // B)
+            t = tile_map.get(key)
+            if t is None:
+                t = tile_map[key] = np.zeros((B, B), np.float32)
+            t[int(i) % B, j % B] += 1.0
+    tiles = [np.zeros((B, B), np.float32)]
+    per_row: list = [[] for _ in range(nb)]
+    for (sb, rb), tile in sorted(tile_map.items(), key=lambda kv: kv[0][::-1]):
+        per_row[rb].append((len(tiles), sb))
+        tiles.append(tile)
+    max_t = max(1, max(len(row) for row in per_row))
+    tile_ids = np.zeros((nb, max_t), np.int32)
+    src_blocks = np.zeros((nb, max_t), np.int32)
+    for rb, row in enumerate(per_row):
+        for k, (tid, sb) in enumerate(row):
+            tile_ids[rb, k] = tid
+            src_blocks[rb, k] = sb
+    return SpmvPlan(
+        n=n, nb=nb, tiles=np.stack(tiles), tile_ids=tile_ids,
+        src_blocks=src_blocks,
+    )
+
+
+def deliver_spmv(values, plan: SpmvPlan):
+    """All-in-edge aggregation over a static CSR graph as blocked SpMV:
+    ``inbox[..., j] = sum over in-neighbors i of j of values[..., i]``.
+    ``values`` is [n] or [C, n]. Per receiver block, the stored adjacency
+    tiles and their sender value blocks contract in one batched
+    dot_general (pad slots hit the all-zero tile 0). Accumulation follows
+    `_acc_dtype` (f32, f64 for f64 inputs)."""
+    squeeze = values.ndim == 1
+    ch = values[None, :] if squeeze else values
+    B = MM_BLOCK
+    n, nb = plan.n, plan.nb
+    acc_t = _acc_dtype(ch.dtype)
+    ch_p = jnp.pad(ch.astype(acc_t), ((0, 0), (0, nb * B - n)))
+    vb = ch_p.reshape(ch.shape[0], nb, B)
+    tiles = jnp.asarray(plan.tiles, acc_t)
+    tile_ids = jnp.asarray(plan.tile_ids)
+    src_blocks = jnp.asarray(plan.src_blocks)
+
+    def rec_block(args):
+        tids, sbs = args
+        vt = jnp.take(vb, sbs, axis=1)  # [C, max_t, B]
+        tt = jnp.take(tiles, tids, axis=0)  # [max_t, B, B]
+        return lax.dot_general(
+            vt, tt, (((1, 2), (0, 1)), ((), ())),
+            preferred_element_type=acc_t,
+        )
+
+    blocks = lax.map(rec_block, (tile_ids, src_blocks))  # [nb, C, B]
+    inbox = (
+        jnp.moveaxis(blocks, 0, 1)
+        .reshape(ch.shape[0], nb * B)[:, :n]
+        .astype(values.dtype)
+    )
+    return inbox[0] if squeeze else inbox
 
 
 def deliver_pool(channels, choice, offsets):

@@ -169,6 +169,57 @@ def _lane_roll(x, r, interpret: bool):
     return pltpu.roll(x, r, 1)
 
 
+def _lane_masks_mm(r):
+    """(U_r, L_r) 128x128 one-hot rotation tiles for `_lane_blend_mm`: the
+    lane-rotation matrix S_r (S[i, j] = [j == (i + r) mod 128]) split by
+    the blend predicate (j >= r keeps the main rotation, j < r the
+    wrapped one). A pure function of the rotation ``r`` alone — callers
+    blending several value planes at the same ``r`` (push-sum's s/w pair)
+    build the masks ONCE and pass them through; the residual per-tile
+    rebuild at an unchanged r is loop-invariant VPU work Mosaic may hoist
+    (~1/4 of an elementwise tile pass per build — counted in the roofline
+    row's VPU model either way)."""
+    i = lax.broadcasted_iota(jnp.int32, (LANES, LANES), 0)
+    j = lax.broadcasted_iota(jnp.int32, (LANES, LANES), 1)
+    hit = j == lax.rem(i + r, jnp.int32(LANES))
+    upper = (hit & (j >= r)).astype(jnp.float32)
+    lower = (hit & (j < r)).astype(jnp.float32)
+    return upper, lower
+
+
+def _lane_blend_mm(pa, pb, r, masks=None):
+    """The delivery lane blend as ONE pair of 128x128 one-hot MXU tiles
+    (delivery='matmul' — the MXU tier, ROADMAP item 5a).
+
+    The roll-based blend computes ``out[:, j] = pa[:, (j - r) mod 128]``
+    for lanes ``j >= r`` and ``pb[:, (j - r) mod 128]`` below — two
+    dynamic lane rotations plus a select, all VPU work. Here the rotation
+    matrix is split into `_lane_masks_mm`'s upper/lower one-hot tiles and
+    the blend becomes
+
+        out = pa @ U_r + pb @ L_r        (jnp.dot on the MXU)
+
+    Each output lane has exactly ONE unit coefficient across (U | L), so
+    the contraction selects a single input value: results are BITWISE the
+    roll blend for finite inputs (x*1 = x; accumulating exact zeros
+    preserves the value), and integer planes round-trip exactly through
+    the float32 accumulator (values far below 2^24).
+    ``preferred_element_type`` pins the f32 accumulate so bf16-class
+    inputs can never narrow the contraction. Non-finite values poison
+    whole tiles (inf*0 = NaN) — the fused tiers already exclude the
+    health sentinel, same contract as the XLA-level deliver_matmul.
+    ``masks`` reuses a precomputed `_lane_masks_mm(r)` pair across the
+    value planes sharing one rotation.
+    """
+    upper, lower = _lane_masks_mm(r) if masks is None else masks
+    out = jnp.dot(
+        pa.astype(jnp.float32), upper, preferred_element_type=jnp.float32
+    ) + jnp.dot(
+        pb.astype(jnp.float32), lower, preferred_element_type=jnp.float32
+    )
+    return out.astype(pa.dtype)
+
+
 def _iota2(shape, axis):
     return jax.lax.broadcasted_iota(jnp.int32, shape, axis)
 
@@ -188,7 +239,7 @@ def _choice_tile(k1, k2, t, pool_size: int):
     return ((expanded >> shift) & jnp.uint32(pool_size - 1)).astype(jnp.int32)
 
 
-def _make_gather(layout: PoolLayout, interpret: bool):
+def _make_gather(layout: PoolLayout, interpret: bool, matmul: bool = False):
     """Tiled circular roll readers over doubled planes.
 
     ``gather(choice_plane, value_planes, e, t, slot)`` returns, for each
@@ -197,10 +248,21 @@ def _make_gather(layout: PoolLayout, interpret: bool):
     plane[j - e (mod n_pad)] — masked at the source to positions whose
     choice equals ``slot`` (masking commutes with the rotation since choice
     and value tiles move identically). ``gather_plain(plane, e, t)`` is the
-    unmasked form.
+    unmasked form. ``matmul`` executes the lane-rotation blend as one-hot
+    128x128 MXU tiles (_lane_blend_mm) instead of roll + select —
+    bitwise-identical, delivery='matmul'.
     """
     R2 = jnp.int32(layout.rows)
     lane = _iota2((TILE, LANES), 1)
+
+    def blend(pa, pb, r, masks=None):
+        if matmul:
+            return _lane_blend_mm(pa, pb, r, masks)
+        return jnp.where(
+            lane >= r,
+            _lane_roll(pa, r, interpret),
+            _lane_roll(pb, r, interpret),
+        )
 
     def gather(choice_plane, value_planes, e, t, slot):
         q = e // LANES
@@ -211,17 +273,14 @@ def _make_gather(layout: PoolLayout, interpret: bool):
         cb = choice_plane[pl.ds(sb, TILE), :]
         ma = ca == slot
         mb = cb == slot
+        # One mask pair per rotation, shared by every value plane (the
+        # push-sum s/w pair halves the mask-build VPU cost).
+        masks = _lane_masks_mm(r) if matmul else None
         outs = []
         for plane, zero in value_planes:
             pa = jnp.where(ma, plane[pl.ds(sa, TILE), :], zero)
             pb = jnp.where(mb, plane[pl.ds(sb, TILE), :], zero)
-            outs.append(
-                jnp.where(
-                    lane >= r,
-                    _lane_roll(pa, r, interpret),
-                    _lane_roll(pb, r, interpret),
-                )
-            )
+            outs.append(blend(pa, pb, r, masks))
         return outs
 
     def gather_plain(plane, e, t):
@@ -231,16 +290,13 @@ def _make_gather(layout: PoolLayout, interpret: bool):
         sb = lax.rem(sa - 1 + R2, R2)
         a = plane[pl.ds(sa, TILE), :]
         b = plane[pl.ds(sb, TILE), :]
-        return jnp.where(
-            lane >= r,
-            _lane_roll(a, r, interpret),
-            _lane_roll(b, r, interpret),
-        )
+        return blend(a, b, r)
 
     return gather, gather_plain
 
 
-def _make_gather_modn(layout: PoolLayout, interpret: bool):
+def _make_gather_modn(layout: PoolLayout, interpret: bool,
+                      matmul: bool = False):
     """Mod-n roll readers with the wraparound blend *predicated away*.
 
     A mod-n roll by ``d`` blends the padded-space roll by d (flat j >= d)
@@ -254,7 +310,7 @@ def _make_gather_modn(layout: PoolLayout, interpret: bool):
     the always-blend form — the skipped gather's values were fully masked
     out by the blend select.
     """
-    gather, gather_plain = _make_gather(layout, interpret)
+    gather, gather_plain = _make_gather(layout, interpret, matmul)
     Z = layout.n_pad - layout.n
     TL = TILE * LANES
 
@@ -449,6 +505,10 @@ def make_pushsum_pool_chunk(
     term_rounds = np.int32(cfg.term_rounds)
     target = np.int32(cfg.resolved_target_count(topo.n, topo.target_count))
     global_term = cfg.termination == "global"
+    # delivery='matmul': the lane-rotation blend runs as one-hot 128x128
+    # MXU tiles (_lane_blend_mm) — bitwise the roll blend, so trajectories
+    # are unchanged; only the unit doing the aggregation moves.
+    matmul = cfg.delivery == "matmul"
     # Failure model (ops/faults.py): drop gate regenerated in-kernel tile
     # by tile from the per-round gate subkeys; crash plane as an extra
     # input. Python-level flags — a fault-free config traces the IDENTICAL
@@ -487,7 +547,7 @@ def make_pushsum_pool_chunk(
         trow = next(it) if telemetry else None
         k = pl.program_id(0)
         K = pl.num_programs(0)
-        gather_modn, _ = _make_gather_modn(layout, interpret)
+        gather_modn, _ = _make_gather_modn(layout, interpret, matmul)
         row_l = _iota2((TILE, LANES), 0)
         lane = _iota2((TILE, LANES), 1)
 
@@ -768,6 +828,7 @@ def make_gossip_pool_chunk(
     rumor_target = np.int32(cfg.resolved_rumor_target)
     suppress = cfg.resolved_suppress
     target = np.int32(cfg.resolved_target_count(topo.n, topo.target_count))
+    matmul = cfg.delivery == "matmul"  # see make_pushsum_pool_chunk
     # Failure model — same wiring as make_pushsum_pool_chunk.
     use_gate = cfg.fault_rate > 0
     thresh = np.uint32(gate_threshold(cfg.fault_rate)) if use_gate else None
@@ -794,7 +855,7 @@ def make_gossip_pool_chunk(
         trow = next(it) if telemetry else None
         k = pl.program_id(0)
         K = pl.num_programs(0)
-        _, gather_plain_modn = _make_gather_modn(layout, interpret)
+        _, gather_plain_modn = _make_gather_modn(layout, interpret, matmul)
         row_l = _iota2((TILE, LANES), 0)
         lane = _iota2((TILE, LANES), 1)
 

@@ -86,6 +86,8 @@ from .fused_pool import (
     MAX_POOL_NODES,
     TC_CONV_BIT,
     TC_TERM_MASK,
+    _lane_blend_mm,
+    _lane_masks_mm,
     _lane_roll,
     build_pool_layout,
 )
@@ -319,11 +321,16 @@ def latch_conv_global_streamed(c_n, scr_c, sem_d, T, PT, N, row_l, lane):
 
 
 def _masked_window_roll(win_ref, ch_ref, slot, off, pt, rlane, lane,
-                        interpret, zero):
+                        interpret, zero, matmul: bool = False,
+                        mm_masks=None):
     """Rolled window contribution: the two sub-8 row slices of the window
     REF and the parked choice-window scratch REF (dynamic ref slices —
     Mosaic cannot dynamic-slice register arrays), source-masked on the
-    slot, then the lane-rotation blend."""
+    slot, then the lane-rotation blend. ``matmul`` executes the blend as
+    one-hot 128x128 MXU tiles (ops/fused_pool._lane_blend_mm,
+    delivery='matmul') — bitwise the roll blend; ``mm_masks`` reuses one
+    precomputed `_lane_masks_mm(rlane)` pair across the value planes
+    sharing this rotation (push-sum's s/w window pair)."""
     pa = jnp.where(
         ch_ref[pl.ds(off + 1, pt), :] == slot,
         win_ref[pl.ds(off + 1, pt), :], zero,
@@ -332,6 +339,8 @@ def _masked_window_roll(win_ref, ch_ref, slot, off, pt, rlane, lane,
         ch_ref[pl.ds(off, pt), :] == slot,
         win_ref[pl.ds(off, pt), :], zero,
     )
+    if matmul:
+        return _lane_blend_mm(pa, pb, rlane, mm_masks)
     return jnp.where(
         lane >= rlane,
         _lane_roll(pa, rlane, interpret),
@@ -340,9 +349,11 @@ def _masked_window_roll(win_ref, ch_ref, slot, off, pt, rlane, lane,
 
 
 def _counted_window_roll(act_ref, ch_ref, slot, off, pt, rlane, lane,
-                         interpret):
+                         interpret, matmul: bool = False):
     """Gossip variant: counts 1 per source whose choice matches AND whose
-    active flag (read from the raw window ref slices) is set."""
+    active flag (read from the raw window ref slices) is set. ``matmul``
+    moves the blend onto the MXU like _masked_window_roll (the 0/1 counts
+    round-trip the f32 accumulator exactly)."""
     pa = (
         (ch_ref[pl.ds(off + 1, pt), :] == slot)
         & (act_ref[pl.ds(off + 1, pt), :] != 0)
@@ -351,6 +362,8 @@ def _counted_window_roll(act_ref, ch_ref, slot, off, pt, rlane, lane,
         (ch_ref[pl.ds(off, pt), :] == slot)
         & (act_ref[pl.ds(off, pt), :] != 0)
     ).astype(jnp.int32)
+    if matmul:
+        return _lane_blend_mm(pa, pb, rlane)
     return jnp.where(
         lane >= rlane,
         _lane_roll(pa, rlane, interpret),
@@ -399,6 +412,9 @@ def make_pushsum_pool2_chunk(
     term_rounds = np.int32(cfg.term_rounds)
     target = np.int32(cfg.resolved_target_count(topo.n, topo.target_count))
     global_term = cfg.termination == "global"
+    # delivery='matmul': the window blend runs as one-hot 128x128 MXU
+    # tiles — bitwise the roll blend (ops/fused_pool._lane_blend_mm).
+    matmul = cfg.delivery == "matmul"
     # Failure model (ops/faults.py): the drop gate is REGENERATED at window
     # positions (like the choice windows — the plane never exists in
     # memory); the crash plane cannot be regenerated (the schedule path is
@@ -633,13 +649,15 @@ def make_pushsum_pool2_chunk(
                     scr_ch[:] = masked_choice(
                         ws8, win_d[b, slot] if crashed else None
                     )
+                    # One mask pair per slot rotation, shared by s and w.
+                    mm = _lane_masks_mm(rl) if matmul else None
                     cs = _masked_window_roll(
                         win_s.at[b, slot], scr_ch, slot, off, PT, rl,
-                        lane, interpret, 0.0,
+                        lane, interpret, 0.0, matmul, mm,
                     )
                     cw = _masked_window_roll(
                         win_w.at[b, slot], scr_ch, slot, off, PT, rl,
-                        lane, interpret, 0.0,
+                        lane, interpret, 0.0, matmul, mm,
                     )
                     if Z != 0:
                         # Wrap variant only on the straddle tile (at most
@@ -667,18 +685,21 @@ def make_pushsum_pool2_chunk(
                                 ws8_2, win_d2[:] if crashed else None
                             )
                         use2 = straddle & (jflat < d)
+                        mm2 = _lane_masks_mm(rl2) if matmul else None
                         cs = jnp.where(
                             use2,
                             _masked_window_roll(win_s2, scr_ch2, slot,
                                                 off2, PT, rl2, lane,
-                                                interpret, 0.0),
+                                                interpret, 0.0, matmul,
+                                                mm2),
                             cs,
                         )
                         cw = jnp.where(
                             use2,
                             _masked_window_roll(win_w2, scr_ch2, slot,
                                                 off2, PT, rl2, lane,
-                                                interpret, 0.0),
+                                                interpret, 0.0, matmul,
+                                                mm2),
                             cw,
                         )
                     raw_s = raw_s + cs
@@ -984,6 +1005,7 @@ def make_gossip_pool2_chunk(
     rumor_target = np.int32(cfg.resolved_rumor_target)
     suppress = cfg.resolved_suppress
     target = np.int32(cfg.resolved_target_count(topo.n, topo.target_count))
+    matmul = cfg.delivery == "matmul"  # see make_pushsum_pool2_chunk
     # Failure model — same wiring as make_pushsum_pool2_chunk.
     use_gate = cfg.fault_rate > 0
     thresh = np.uint32(gate_threshold(cfg.fault_rate)) if use_gate else None
@@ -1180,7 +1202,7 @@ def make_gossip_pool2_chunk(
                     )
                     g = _counted_window_roll(
                         win_a.at[b, slot], scr_ch, slot, off, PT, rl,
-                        lane, interpret,
+                        lane, interpret, matmul,
                     )
                     if Z != 0:
                         ws8_2, rl2, off2 = _win_plan(
@@ -1205,7 +1227,7 @@ def make_gossip_pool2_chunk(
                             use2,
                             _counted_window_roll(
                                 win_a2, scr_ch2, slot, off2, PT, rl2,
-                                lane, interpret,
+                                lane, interpret, matmul,
                             ),
                             g,
                         )

@@ -73,6 +73,7 @@ from ..ops.fused_pool import (
     TC_TERM_MASK,
     build_pool_layout,
 )
+from ..ops.fused_pool import _lane_masks_mm
 from ..ops.fused_pool2 import (
     _PT_CANDIDATES,
     _choice_window,
@@ -105,10 +106,12 @@ def plan_pool2_sharded(topo: Topology, cfg: SimConfig, n_dev: int):
             "the replicated-pool2 composition serves the implicit full "
             "topology only"
         )
-    if cfg.delivery != "pool":
+    if cfg.delivery not in ("pool", "matmul"):
         return (
-            "the replicated-pool2 composition requires delivery='pool' "
-            "(the same gate as the single-device pool engine dispatch)"
+            "the replicated-pool2 composition requires delivery='pool' or "
+            "delivery='matmul' (the same gate as the single-device pool "
+            "engine dispatch; matmul runs the per-shard one-hot MXU blend "
+            "after the one all_gather — the wire is unchanged)"
         )
     if cfg.dtype != "float32":
         return "fused engine supports float32 only"
@@ -195,6 +198,11 @@ def make_pushsum_pool2_shard_chunk(
     delta = np.float32(cfg.resolved_delta)
     term_rounds = np.int32(cfg.term_rounds)
     global_term = cfg.termination == "global"
+    # delivery='matmul': the per-shard window blend after the one
+    # all_gather runs as one-hot 128x128 MXU tiles — bitwise the roll
+    # blend, and the WIRE is unchanged (the static auditor proves the
+    # WIRE_SPEC holds for both deliveries).
+    matmul = cfg.delivery == "matmul"
     use_gate = cfg.fault_rate > 0
     thresh = np.uint32(gate_threshold(cfg.fault_rate)) if use_gate else None
     crashed = build_death2d(cfg, topo.n, layout.n_pad) is not None
@@ -273,13 +281,15 @@ def make_pushsum_pool2_shard_chunk(
                 scr_ch[:] = masked_choice(
                     ws8, win_d[slot] if crashed else None
                 )
+                # One mask pair per slot rotation, shared by s and w.
+                mm = _lane_masks_mm(rl) if matmul else None
                 cs = _masked_window_roll(
                     win_s.at[slot], scr_ch, slot, off, PT, rl, lane,
-                    interpret, 0.0,
+                    interpret, 0.0, matmul, mm,
                 )
                 cw = _masked_window_roll(
                     win_w.at[slot], scr_ch, slot, off, PT, rl, lane,
-                    interpret, 0.0,
+                    interpret, 0.0, matmul, mm,
                 )
                 if Z != 0:
                     ws8_2, rl2, off2 = _win_plan(g0, d + jnp.int32(Z), R)
@@ -299,16 +309,19 @@ def make_pushsum_pool2_shard_chunk(
                             ws8_2, win_d2[:] if crashed else None
                         )
                     use2 = straddle & (jflat < d)
+                    mm2 = _lane_masks_mm(rl2) if matmul else None
                     cs = jnp.where(
                         use2,
                         _masked_window_roll(win_s2, scr_ch2, slot, off2,
-                                            PT, rl2, lane, interpret, 0.0),
+                                            PT, rl2, lane, interpret, 0.0,
+                                            matmul, mm2),
                         cs,
                     )
                     cw = jnp.where(
                         use2,
                         _masked_window_roll(win_w2, scr_ch2, slot, off2,
-                                            PT, rl2, lane, interpret, 0.0),
+                                            PT, rl2, lane, interpret, 0.0,
+                                            matmul, mm2),
                         cw,
                     )
                 raw_s = raw_s + cs
@@ -471,6 +484,7 @@ def make_gossip_pool2_shard_chunk(
     P = cfg.pool_size
     rumor_target = np.int32(cfg.resolved_rumor_target)
     suppress = cfg.resolved_suppress
+    matmul = cfg.delivery == "matmul"  # see make_pushsum_pool2_shard_chunk
     use_gate = cfg.fault_rate > 0
     thresh = np.uint32(gate_threshold(cfg.fault_rate)) if use_gate else None
     crashed = build_death2d(cfg, topo.n, layout.n_pad) is not None
@@ -544,7 +558,7 @@ def make_gossip_pool2_shard_chunk(
                 )
                 g = _counted_window_roll(
                     win_a.at[slot], scr_ch, slot, off, PT, rl, lane,
-                    interpret,
+                    interpret, matmul,
                 )
                 if Z != 0:
                     ws8_2, rl2, off2 = _win_plan(g0, d + jnp.int32(Z), R)
@@ -564,7 +578,8 @@ def make_gossip_pool2_shard_chunk(
                     g = jnp.where(
                         use2,
                         _counted_window_roll(win_a2, scr_ch2, slot, off2,
-                                             PT, rl2, lane, interpret),
+                                             PT, rl2, lane, interpret,
+                                             matmul),
                         g,
                     )
                 inbox = inbox + g

@@ -122,7 +122,12 @@ def compile_class(cfg: SimConfig) -> tuple:
          if pushsum else None),
         ("rumor_target", None if pushsum else cfg.resolved_rumor_target),
         ("suppress", None if pushsum else cfg.resolved_suppress),
-        ("pool_size", cfg.pool_size if cfg.delivery == "pool" else None),
+        # The pooled-sampling tiers trace pool_size into the program; the
+        # matmul tier samples the identical pool stream, so it pins
+        # pool_size too — and `delivery` itself is a raw compile-class
+        # field, so a matmul-tier request always lands in its own bucket.
+        ("pool_size",
+         cfg.pool_size if cfg.delivery in ("pool", "matmul") else None),
     )
     return items + normalized + (("faults", fault_class(cfg)),)
 

@@ -80,8 +80,12 @@ def load(path: str | Path):
     # written before v4 cannot exist (the flags did not). Checkpoints from
     # a NEWER stream than this build reject on any sensitivity (their
     # derivations are unknown here).
+    # The matmul tier consumes the IDENTICAL packed pool-choice stream as
+    # the pool tier (only the delivery mechanism differs), so it is
+    # pool-stream-sensitive too.
     pool_sensitive = (
-        cfg.delivery == "pool" and cfg.pool_size <= 1 << POOL_CHOICE_BITS
+        cfg.delivery in ("pool", "matmul")
+        and cfg.pool_size <= 1 << POOL_CHOICE_BITS
     )
     gate_sensitive = cfg.fault_rate > 0 or cfg.dup_rate > 0
     revive_sensitive = cfg.revive_model

@@ -12,7 +12,11 @@ this keeps the composition paths executing end-to-end on every push.
   engine + the DMA trace (tests/test_fused_imp_hbm_sharded.py);
 - replicated-pool2 (ISSUE 10): the full topology at 2^18, ONE all_gather
   of the send summaries per round, bitwise counts vs the chunked pool
-  path (tests/test_pool2_sharded.py).
+  path (tests/test_pool2_sharded.py);
+- MXU matmul tier (ISSUE 12): the chunked one-hot dot_general round AND
+  the replicated-pool2 composition with the per-shard one-hot MXU blend,
+  both bitwise the chunked pool trajectory — CI drives the matmul tier
+  bitwise-vs-chunked on every push (tests/test_delivery_matmul.py).
 
 Usage: python scripts/hbm_sharded_smoke.py
 """
@@ -156,6 +160,50 @@ def main() -> int:
         assert (a == b).all(), f"pool2 {f} diverged"
     print(f"[hbm-sharded-smoke] replicated-pool2 full bitwise OK "
           f"({rounds_full} rounds, informed {int(np.asarray(grab['b'].count).astype(bool).sum())})")
+
+    # --- MXU matmul tier (ISSUE 12) ------------------------------------
+    # Same rounds, same stream: the pool2-sharded composition with the
+    # per-shard one-hot MXU blend must be bitwise the chunked pool
+    # trajectory captured above (gossip sums are integer-exact under any
+    # summation order) — the blend swap moves compute units, never bits.
+    r4 = run_pool2_sharded(
+        topo_full,
+        SimConfig(n=n_full, topology="full", algorithm="gossip",
+                  delivery="matmul", engine="fused", n_devices=2,
+                  chunk_rounds=1, max_rounds=rounds_full),
+        mesh=make_mesh(2), on_chunk=lambda r, s: grab.update(d=s),
+    )
+    assert r1.rounds == r4.rounds == rounds_full, (r1.rounds, r4.rounds)
+    for f in ("count", "active", "conv"):
+        a = np.asarray(getattr(grab["a"], f))
+        d = np.asarray(getattr(grab["d"], f))[:n_full]
+        assert (a == d).all(), f"pool2-sharded matmul {f} diverged"
+    print("[hbm-sharded-smoke] replicated-pool2 matmul blend bitwise OK")
+
+    # Chunked one-hot dot_general round vs the chunked pool round, to
+    # convergence, at a dense-tier-friendly size (the one-hot form does
+    # O(n/128) MACs per delivered element — n^2-class work that only the
+    # MXU makes free, so the CPU smoke stays small on purpose).
+    n_mm = 4096
+    topo_mm = build_topology("full", n_mm)
+    grab_mm = {}
+    runs = {}
+    for d in ("pool", "matmul"):
+        runs[d] = run(
+            topo_mm,
+            SimConfig(n=n_mm, topology="full", algorithm="gossip",
+                      delivery=d, max_rounds=5000),
+            on_chunk=lambda r, s, d=d: grab_mm.update({d: s}),
+        )
+    assert runs["pool"].rounds == runs["matmul"].rounds
+    assert runs["pool"].converged and runs["matmul"].converged
+    for f in ("count", "active", "conv"):
+        a = np.asarray(getattr(grab_mm["pool"], f))
+        b = np.asarray(getattr(grab_mm["matmul"], f))
+        assert (a == b).all(), f"chunked matmul {f} diverged from pool"
+    print(f"[hbm-sharded-smoke] MXU matmul tier bitwise OK "
+          f"(chunked one-hot dot_general, n={n_mm}, "
+          f"{runs['matmul'].rounds} rounds to convergence)")
     return 0
 
 

@@ -25,5 +25,11 @@ print_dots() {
 }
 trap print_dots EXIT
 
-timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+# Budget 1200 s (was 870, set when the suite was ~450 tests): the suite
+# has grown to ~580 tier-1 tests across twelve PRs and a quiet run on the
+# 2-core CI-class box now takes ~740-880 s with ±15% host noise — the old
+# budget was killing CLEAN runs at 99%. The timeout exists to catch hangs
+# (the reference's line-topology freeze class), not to cap suite growth;
+# 1200 still fails a wedged run well inside the CI job limit.
+timeout -k 10 1200 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 exit ${PIPESTATUS[0]}

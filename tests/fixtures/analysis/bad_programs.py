@@ -82,6 +82,47 @@ def donated_chunk():
     return fn, (jnp.zeros((8,), jnp.float32), jnp.int32(0))
 
 
+def scatter_delivery_chunk():
+    """A 'matmul-tier' chunk whose round body delivers by scatter-add and
+    never touches the MXU: the matmul-delivery checker must flag BOTH the
+    missing dot_general and the scatter (the silent fallback onto the
+    dynamic-address path)."""
+
+    def fn(state, targets):
+        def body(c):
+            vals, r = c
+            inbox = jnp.zeros_like(vals).at[targets].add(vals)
+            return (inbox, r + 1)
+
+        return lax.while_loop(lambda c: c[1] < 8, body, (state, 0))
+
+    return fn, (jnp.ones((32,), jnp.float32),
+                jnp.arange(32, dtype=jnp.int32)[::-1])
+
+
+def matmul_delivery_chunk():
+    """The negative pin: the same delivery as a one-hot dot_general —
+    exactly one MXU contraction, zero scatters."""
+
+    def fn(state, targets):
+        onehot = (
+            targets[:, None] == jnp.arange(32, dtype=jnp.int32)[None, :]
+        ).astype(jnp.float32)
+
+        def body(c):
+            vals, r = c
+            inbox = lax.dot_general(
+                vals, onehot, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            return (inbox, r + 1)
+
+        return lax.while_loop(lambda c: c[1] < 8, body, (state, 0))
+
+    return fn, (jnp.ones((32,), jnp.float32),
+                jnp.arange(32, dtype=jnp.int32)[::-1])
+
+
 def double_psum_chunk(mesh, axis):
     """TWO verdict psums per round where the declaration below says ONE —
     the wire-spec diff must flag body-psum (and nothing else)."""

@@ -224,6 +224,17 @@ def test_pool2_sharded_declaration_agreement():
         assert env["windows"] == n_win
 
 
+def test_pool2_sharded_matmul_declaration_agreement():
+    # ISSUE 12 acceptance pin: the matmul tier moves the aggregation onto
+    # the MXU (per-shard one-hot blend after the one all_gather) but the
+    # WIRE is untouched — the SAME declaration, including the strictness
+    # zeros (no ppermutes, no scatters, no remote DMAs), must hold for
+    # delivery='matmul' cells.
+    cfg = {"engine": "fused", "delivery": "matmul"}
+    for algo in ("gossip", "push-sum"):
+        _assert_agrees("pool2-sharded", "full", algo, 262144, 2, cfg)
+
+
 def test_fused_pool_sharded_declaration_agreement():
     # The VMEM pool composition: one batched gather of the replicated
     # state planes (serial: one per plane), and NO reduction collective on

@@ -1,0 +1,394 @@
+"""The MXU matmul delivery tier (ISSUE 12).
+
+delivery='matmul' is the pool tier's sampling stream (identical per-round
+choices/offsets) with delivery recast onto the MXU: the chunked engine
+delivers by blocked one-hot dot_general (ops/delivery.deliver_matmul),
+the fused pool kernels execute the lane-rotation blend as 128x128 one-hot
+tiles (ops/fused_pool._lane_blend_mm). Oracles:
+
+- op-level: the one-hot delivery equals scatter-add and the pool masked
+  rolls over identical targets (int channels exact, floats to summation
+  order); the in-kernel lane blend is BITWISE the roll blend; the
+  full-topology closed form and the CSR blocked SpMV match brute force;
+- engine-level: gossip trajectories are bitwise the chunked pool path
+  across full/imp kinds at two sizes (integer-exact sums); push-sum
+  conserves mass to <= 1 ulp at float64 with dual-oracle rounds AND
+  converged-set parity, float32/bfloat16 hold the documented quality
+  envelopes (tests/test_bfloat16.py bounds);
+- the resolved policy: structured refusals off the supported kinds and
+  engines (the analysis lint checks the runner-ladder wording), and the
+  serving keys place a matmul-tier request in its own bucket.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cop5615_gossip_protocol_tpu import SimConfig, build_topology
+from cop5615_gossip_protocol_tpu.models.runner import run
+from cop5615_gossip_protocol_tpu.ops import delivery, sampling
+from cop5615_gossip_protocol_tpu.serving import keys as keys_mod
+
+
+def _pool_targets(seed, rnd, n, K):
+    kr = sampling.round_key(jax.random.PRNGKey(seed), rnd)
+    offs = sampling.pool_offsets(kr, K, n)
+    choice = sampling.pool_choice_packed(kr, n, K)
+    ids = jnp.arange(n, dtype=jnp.int32)
+    return sampling.targets_pool(choice, offs, ids, n), choice, offs
+
+
+# --- op level ---------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n", [37, 1000])  # 37: padded-tail/modulo edge;
+# 1000: multi-block — the 256 mid-size rides the engine-level pins below
+def test_deliver_matmul_matches_scatter_and_rolls(n):
+    targets, choice, offs = _pool_targets(1, 5, n, 4)
+    vals_i = jnp.arange(n, dtype=jnp.int32) % 7 + 1
+    vals_f = jnp.linspace(0.5, 2.0, n, dtype=jnp.float32)
+    inbox = delivery.deliver_matmul(
+        jnp.stack([vals_i.astype(jnp.float32), vals_f]), targets, n
+    )
+    want_i = delivery.deliver(vals_i, targets, n)
+    want_f = delivery.deliver(vals_f, targets, n)
+    # Integer-valued f32 channels: every partial sum is an exact integer
+    # in the accumulator — bitwise the scatter path.
+    assert (np.asarray(inbox[0]) == np.asarray(want_i)).all()
+    np.testing.assert_allclose(
+        np.asarray(inbox[1]), np.asarray(want_f), rtol=1e-6
+    )
+    roll_i = delivery.deliver_pool(
+        jnp.stack([vals_i.astype(jnp.float32)]), choice, offs
+    )[0]
+    assert (np.asarray(inbox[0]) == np.asarray(roll_i)).all()
+    # 1-D input form
+    one = delivery.deliver_matmul(vals_i.astype(jnp.float32), targets, n)
+    assert (np.asarray(one) == np.asarray(want_i)).all()
+
+
+def test_deliver_matmul_float64_accumulates_exactly_for_ints():
+    n = 512
+    targets, _, _ = _pool_targets(2, 0, n, 8)
+    vals = jnp.arange(n, dtype=jnp.float64)
+    inbox = delivery.deliver_matmul(vals, targets, n)
+    assert inbox.dtype == jnp.float64
+    want = delivery.deliver(vals, targets, n)
+    assert (np.asarray(inbox) == np.asarray(want)).all()
+
+
+def test_lane_blend_mm_bitwise_matches_roll_blend():
+    # The fused kernels' building block: one pair of 128x128 one-hot MXU
+    # tiles must reproduce the roll/select blend bit for bit (each output
+    # lane selects exactly one input value), for float and int planes.
+    from cop5615_gossip_protocol_tpu.ops.fused_pool import (
+        LANES,
+        _lane_blend_mm,
+    )
+
+    rng = np.random.default_rng(3)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (64, LANES), 1)
+    for r in (0, 1, 17, 127):
+        pa = jnp.asarray(rng.standard_normal((64, LANES)).astype(np.float32))
+        pb = jnp.asarray(rng.standard_normal((64, LANES)).astype(np.float32))
+        want = jnp.where(
+            lane >= r, jnp.roll(pa, r, axis=1), jnp.roll(pb, r, axis=1)
+        )
+        got = _lane_blend_mm(pa, pb, jnp.int32(r))
+        assert (np.asarray(got) == np.asarray(want)).all(), f"f32 r={r}"
+        pai = jnp.asarray(rng.integers(-1, 16, (64, LANES)).astype(np.int32))
+        pbi = jnp.asarray(rng.integers(-1, 16, (64, LANES)).astype(np.int32))
+        wanti = jnp.where(
+            lane >= r, jnp.roll(pai, r, axis=1), jnp.roll(pbi, r, axis=1)
+        )
+        goti = _lane_blend_mm(pai, pbi, jnp.int32(r))
+        assert goti.dtype == jnp.int32
+        assert (np.asarray(goti) == np.asarray(wanti)).all(), f"i32 r={r}"
+
+
+def test_aggregate_full_closed_form():
+    # J - I adjacency product without materializing N^2.
+    n = 200
+    vals = jnp.linspace(-1.0, 3.0, n, dtype=jnp.float32)
+    got = np.asarray(delivery.aggregate_full(vals))
+    A = np.ones((n, n), np.float32) - np.eye(n, dtype=np.float32)
+    np.testing.assert_allclose(got, A.T @ np.asarray(vals), rtol=1e-5)
+    stacked = np.asarray(delivery.aggregate_full(jnp.stack([vals, vals * 2])))
+    np.testing.assert_allclose(stacked[1], A.T @ (2 * np.asarray(vals)),
+                               rtol=1e-5)
+
+
+def test_spmv_blocked_matches_brute_force():
+    # CSR in-edge groundwork (ROADMAP item 3 scale-free graphs): the BSR
+    # tiles + batched dot_general must equal a per-edge accumulate,
+    # including multi-edges.
+    rng = np.random.default_rng(0)
+    n = 300
+    indptr = [0]
+    indices: list = []
+    for _ in range(n):
+        deg = int(rng.integers(1, 6))
+        indices.extend(rng.integers(0, n, deg).tolist())
+        indptr.append(len(indices))
+    plan = delivery.build_spmv_plan(np.array(indptr), np.array(indices), n)
+    vals = jnp.arange(n, dtype=jnp.float32)
+    got = np.asarray(delivery.deliver_spmv(vals, plan))
+    want = np.zeros(n, np.float64)
+    for j in range(n):
+        for i in indices[indptr[j]:indptr[j + 1]]:
+            want[j] += float(i)
+    np.testing.assert_allclose(got, want.astype(np.float32), rtol=1e-6)
+
+
+# --- engine level: gossip bitwise across full/pool kinds --------------------
+
+
+def _states_and_result(cfg, topo):
+    grab = {}
+    r = run(topo, cfg, on_chunk=lambda rounds, s: grab.update(state=s))
+    return r, grab["state"]
+
+
+@pytest.mark.parametrize("n", [256, 1000])
+def test_matmul_gossip_full_bitwise_vs_chunked_pool(n):
+    results = {}
+    for d in ("pool", "matmul"):
+        cfg = SimConfig(n=n, topology="full", algorithm="gossip",
+                        delivery=d, max_rounds=5000)
+        results[d] = _states_and_result(cfg, build_topology("full", n))
+    (ra, sa), (rb, sb) = results["pool"], results["matmul"]
+    assert ra.converged and rb.converged
+    assert ra.rounds == rb.rounds
+    for f in ("count", "active", "conv"):
+        assert (np.asarray(getattr(sa, f)) == np.asarray(getattr(sb, f))).all(), f
+
+
+@pytest.mark.parametrize("kind,n", [("imp3d", 512), ("imp2d", 256)])
+def test_matmul_gossip_imp_bitwise_vs_chunked_pool(kind, n):
+    results = {}
+    for d in ("pool", "matmul"):
+        cfg = SimConfig(n=n, topology=kind, algorithm="gossip",
+                        delivery=d, max_rounds=5000)
+        results[d] = _states_and_result(cfg, build_topology(kind, n))
+    (ra, sa), (rb, sb) = results["pool"], results["matmul"]
+    assert ra.converged and rb.converged
+    assert ra.rounds == rb.rounds
+    for f in ("count", "active", "conv"):
+        assert (np.asarray(getattr(sa, f)) == np.asarray(getattr(sb, f))).all(), f
+
+
+@pytest.mark.slow  # tier-1 budget: the fault-free pins above already pin
+# the stream; the gate interaction rides the slow oracle set
+def test_matmul_gossip_drop_gate_bitwise():
+    # The failure-model gate rides the same stream: drop-gated rounds must
+    # stay bitwise across the two delivery mechanisms.
+    n = 512
+    results = {}
+    for d in ("pool", "matmul"):
+        cfg = SimConfig(n=n, topology="full", algorithm="gossip",
+                        delivery=d, fault_rate=0.3, max_rounds=8000)
+        results[d] = _states_and_result(cfg, build_topology("full", n))
+    (ra, sa), (rb, sb) = results["pool"], results["matmul"]
+    assert ra.rounds == rb.rounds
+    for f in ("count", "active", "conv"):
+        assert (np.asarray(getattr(sa, f)) == np.asarray(getattr(sb, f))).all(), f
+
+
+# --- push-sum: mass to ulp + dual oracle + dtype envelopes ------------------
+
+
+def test_matmul_pushsum_f64_mass_to_ulp_and_dual_oracle():
+    # ISSUE 12 acceptance: push-sum reassociates under the matmul sum
+    # order, so the pins are (a) mass conservation to <= 1 ulp of the
+    # initial totals at float64 and (b) dual-oracle parity — the matmul
+    # run and the chunked pool run agree on rounds AND the converged set.
+    n = 1024
+    caps = {}
+    res = {}
+    for d in ("pool", "matmul"):
+        cfg = SimConfig(n=n, topology="full", algorithm="push-sum",
+                        delivery=d, dtype="float64", max_rounds=8000)
+        res[d], caps[d] = _states_and_result(cfg, build_topology("full", n))
+    assert res["pool"].converged and res["matmul"].converged
+    assert res["pool"].rounds == res["matmul"].rounds
+    assert (
+        np.asarray(caps["pool"].conv) == np.asarray(caps["matmul"].conv)
+    ).all(), "converged-set parity"
+    st = caps["matmul"]
+    s0, w0 = n * (n - 1) / 2.0, float(n)
+    assert abs(np.asarray(st.s, np.float64).sum() - s0) <= np.spacing(s0)
+    assert abs(np.asarray(st.w, np.float64).sum() - w0) <= np.spacing(w0)
+
+
+@pytest.mark.slow  # tier-1 budget: f32 quality is bracketed by the fast
+# f64 dual-oracle (exact) and bf16 (coarse) pins
+def test_matmul_pushsum_f32_quality():
+    n = 1024
+    cfg = SimConfig(n=n, topology="full", algorithm="push-sum",
+                    delivery="matmul", max_rounds=8000)
+    r = run(build_topology("full", n), cfg)
+    assert r.converged and r.converged_count == n
+    assert r.estimate_mae < 1e-2
+
+
+def test_matmul_pushsum_bf16_upcast_quality():
+    # The bf16 path upcasts the contraction to f32 accumulation
+    # (ops/delivery._acc_dtype via preferred_element_type) and must hold
+    # tests/test_bfloat16.py's expander-class envelope: <0.5% rel MAE on
+    # full.
+    n = 1024
+    cfg = SimConfig(n=n, topology="full", algorithm="push-sum",
+                    delivery="matmul", dtype="bfloat16", max_rounds=8000)
+    r = run(build_topology("full", n), cfg)
+    assert r.converged
+    rel = r.estimate_mae / r.true_mean
+    assert rel < 0.005, f"bf16 matmul estimate degraded: rel MAE {rel:.4%}"
+
+
+# --- fused tier (interpret mode — slow suite) -------------------------------
+
+
+@pytest.mark.slow  # interpret-mode run pair; see tier-1 budget note in test_fused.py
+@pytest.mark.parametrize("n", [1000, 16384])  # the chunked one-hot leg is
+# n^2-class work on CPU (no MXU), so the slow pair stays mid-sized
+def test_fused_pool_matmul_gossip_bitwise(n):
+    # The VMEM pool kernel with the one-hot MXU lane blend vs the chunked
+    # matmul round: gossip integer trajectories identical.
+    results = {}
+    for engine in ("chunked", "fused"):
+        cfg = SimConfig(n=n, topology="full", algorithm="gossip",
+                        delivery="matmul", engine=engine,
+                        max_rounds=60000, chunk_rounds=32)
+        results[engine] = run(build_topology("full", n), cfg)
+    a, b = results["chunked"], results["fused"]
+    assert a.converged and b.converged
+    assert a.rounds == b.rounds
+    assert a.converged_count == b.converged_count
+
+
+@pytest.mark.slow  # interpret-mode Pallas pair on the 2-device mesh
+def test_pool2_sharded_matmul_bitwise_vs_chunked():
+    # The replicated-pool2 composition with the per-shard one-hot blend
+    # after its one all_gather: bitwise the chunked pool path (and hence
+    # the chunked matmul path) for gossip; its WIRE_SPEC is unchanged —
+    # the static auditor proves that (analysis matrix matmul rows).
+    from cop5615_gossip_protocol_tpu.parallel.mesh import make_mesh
+    from cop5615_gossip_protocol_tpu.parallel.pool2_sharded import (
+        run_pool2_sharded,
+    )
+
+    n, rounds = 65536, 8
+    topo = build_topology("full", n)
+    grab = {}
+    r1 = run(
+        topo,
+        SimConfig(n=n, topology="full", algorithm="gossip",
+                  delivery="matmul", engine="chunked",
+                  max_rounds=rounds, chunk_rounds=rounds),
+        on_chunk=lambda r, s: grab.update(a=s),
+    )
+    r2 = run_pool2_sharded(
+        topo,
+        SimConfig(n=n, topology="full", algorithm="gossip",
+                  delivery="matmul", engine="fused", n_devices=2,
+                  chunk_rounds=1, max_rounds=rounds),
+        mesh=make_mesh(2), on_chunk=lambda r, s: grab.update(b=s),
+    )
+    assert r1.rounds == r2.rounds == rounds
+    for f in ("count", "active", "conv"):
+        a = np.asarray(getattr(grab["a"], f))
+        b = np.asarray(getattr(grab["b"], f))[:n]
+        assert (a == b).all(), f
+
+
+# --- resolved policy: refusals + serving keys -------------------------------
+
+
+def test_matmul_config_rejected_off_pool_kinds():
+    with pytest.raises(ValueError, match="matmul"):
+        SimConfig(n=100, topology="line", delivery="matmul")
+    with pytest.raises(ValueError, match="matmul"):
+        SimConfig(n=100, topology="torus3d", delivery="matmul")
+    with pytest.raises(ValueError, match="power of two"):
+        SimConfig(n=100, topology="full", delivery="matmul", pool_size=6)
+
+
+def test_matmul_refused_on_sharded_xla_engine():
+    cfg = SimConfig(n=1024, topology="full", algorithm="gossip",
+                    delivery="matmul", n_devices=8, engine="chunked",
+                    max_rounds=100)
+    with pytest.raises(ValueError, match="composition"):
+        run(build_topology("full", 1024), cfg)
+
+
+def test_matmul_fused_refused_on_imp_kinds():
+    # engine='auto' demotes imp matmul to the chunked engine (covered by
+    # the bitwise tests above); an explicit engine='fused' fails loudly.
+    cfg = SimConfig(n=512, topology="imp3d", algorithm="gossip",
+                    delivery="matmul", engine="fused", max_rounds=100)
+    with pytest.raises(ValueError, match="chunked"):
+        run(build_topology("imp3d", 512), cfg)
+
+
+def test_matmul_dup_delay_rejected():
+    cfg = SimConfig(n=256, topology="full", algorithm="gossip",
+                    delivery="matmul", dup_rate=0.1, max_rounds=100)
+    with pytest.raises(ValueError, match="dup/delay"):
+        run(build_topology("full", 256), cfg)
+
+
+def test_matmul_checkpoint_stream_guard(tmp_path):
+    # The matmul tier consumes the identical packed pool-choice stream as
+    # the pool tier, so a checkpoint written under the pre-packed-choice
+    # derivation (stream v1 / unversioned) must be REFUSED on resume —
+    # the same guard delivery='pool' gets (utils/checkpoint.load).
+    from cop5615_gossip_protocol_tpu.models.pushsum import PushSumState
+    from cop5615_gossip_protocol_tpu.utils import checkpoint as ckpt
+
+    st = PushSumState(
+        s=jnp.arange(16, dtype=jnp.float32), w=jnp.ones((16,), jnp.float32),
+        term=jnp.zeros((16,), jnp.int32), conv=jnp.zeros((16,), bool),
+    )
+    cfg = SimConfig(n=16, topology="full", algorithm="push-sum",
+                    delivery="matmul")
+    p = tmp_path / "ck.npz"
+    ckpt.save(p, st, 32, cfg)
+    _, rounds, _ = ckpt.load(p)  # current version round-trips
+    assert rounds == 32
+    with np.load(p) as z:
+        data = {k: z[k] for k in z.files}
+    data["__stream__"] = np.int64(1)
+    np.savez_compressed(p, **data)
+    with pytest.raises(ValueError, match="stream version"):
+        ckpt.load(p)
+
+
+def test_matmul_lands_in_its_own_serving_bucket():
+    # Resolved-policy round-trip through serving/keys.py: the matmul tier
+    # traces a different chunk program than the pool tier (and pins
+    # pool_size like it), so the canonical engine key, the batcher bucket
+    # key, and the /stats label must all separate.
+    topo = build_topology("full", 1024)
+    cfg_pool = SimConfig(n=1024, topology="full", delivery="pool")
+    cfg_mm = SimConfig(n=1024, topology="full", delivery="matmul")
+    assert keys_mod.canonical_key(cfg_pool, topo) != keys_mod.canonical_key(
+        cfg_mm, topo
+    )
+    assert keys_mod.serve_bucket_key(cfg_pool, topo) != (
+        keys_mod.serve_bucket_key(cfg_mm, topo)
+    )
+    # pool_size is part of the matmul compile class (same stream contract
+    # as the pool tier).
+    cfg_mm8 = SimConfig(n=1024, topology="full", delivery="matmul",
+                        pool_size=8)
+    assert keys_mod.canonical_key(cfg_mm, topo) != keys_mod.canonical_key(
+        cfg_mm8, topo
+    )
+    # ... and two identical matmul requests share one bucket (warm-pool
+    # reuse, not a per-request retrace).
+    assert keys_mod.canonical_key(cfg_mm, topo) == keys_mod.canonical_key(
+        SimConfig(n=1024, topology="full", delivery="matmul"), topo
+    )
+    assert keys_mod.bucket_label(cfg_mm, topo).startswith("gossip/full/")

@@ -138,10 +138,12 @@ def test_dispatch_routes_pool2_past_vmem_cap(monkeypatch, force_pool2):
     real = runner_mod._run_fused
 
     def spy(topo, cfg, key, on_chunk, start_state, start_round, interpret,
-            variant="stencil"):
+            variant="stencil", **kw):
+        # **kw forwards the dispatch's newer kwargs (on_telemetry, t_enter,
+        # deadline, probe) — the spy only records the resolved tier.
         seen["variant"] = variant
         return real(topo, cfg, key, on_chunk, start_state, start_round,
-                    interpret, variant=variant)
+                    interpret, variant=variant, **kw)
 
     monkeypatch.setattr(runner_mod, "_run_fused", spy)
     r = run(build_topology("full", 20000), _cfg(20000))

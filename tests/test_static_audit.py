@@ -107,6 +107,57 @@ def test_donation_skips_when_not_donated():
     assert contracts.check_donation(cell) == []
 
 
+# --- matmul delivery (ISSUE 12) --------------------------------------------
+
+
+def _matmul_cell(fn, args):
+    return trace.TracedCell(
+        engine="fixture-engine", topology="full", algorithm="gossip", n=32,
+        n_devices=1, overlap=True, extras={"delivery": "matmul"}, fn=fn,
+        args=args, donate=False,
+    )
+
+
+def test_matmul_contract_fires_on_scatter_fallback():
+    bad = _bad_programs()
+    findings = contracts.check_matmul_delivery(
+        _matmul_cell(*bad.scatter_delivery_chunk())
+    )
+    rules = sorted(f.rule for f in findings)
+    assert rules == ["no-dot-general", "scatter-scatter-add"], rules
+    assert all(f.checker == "matmul-delivery" for f in findings)
+
+
+def test_matmul_contract_clean_on_one_hot_dot_general():
+    bad = _bad_programs()
+    assert contracts.check_matmul_delivery(
+        _matmul_cell(*bad.matmul_delivery_chunk())
+    ) == []
+
+
+def test_matmul_contract_skips_non_matmul_cells():
+    # The scatter chunk is fine on any other rung — the contract only
+    # binds cells that resolved delivery='matmul'.
+    bad = _bad_programs()
+    assert contracts.check_matmul_delivery(
+        _cell(*bad.scatter_delivery_chunk())
+    ) == []
+
+
+def test_matmul_contract_clean_on_real_chunked_rung():
+    # The real engine cell, traced through the runner's probe hook: the
+    # chunked matmul round must carry dot_general and zero scatters.
+    cell = trace.trace_cell(
+        "chunked", "full", "gossip", 256, 1, True, {"delivery": "matmul"}
+    )
+    assert contracts.check_matmul_delivery(cell) == []
+    # ... and the pool sibling must NOT be judged by the matmul contract.
+    pool_cell = trace.trace_cell(
+        "chunked", "full", "gossip", 256, 1, True, {"delivery": "pool"}
+    )
+    assert contracts.check_matmul_delivery(pool_cell) == []
+
+
 # --- wire-spec -------------------------------------------------------------
 
 

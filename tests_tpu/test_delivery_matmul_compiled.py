@@ -1,0 +1,117 @@
+"""Compiled (interpret=False) MXU matmul delivery tier on a real chip.
+
+tests/test_delivery_matmul.py pins the tier in interpret mode on CPU;
+this suite is the hardware evidence (ISSUE 12): the compiled fused pool
+kernel with the one-hot 128x128 MXU lane blend must reproduce the
+chunked pool path's gossip trajectories bit for bit on the chip, the
+chunked blocked one-hot `dot_general` round must land on the MXU, and
+`engine='auto'` must route an eligible matmul config through the
+compiled pool kernel (the bench route). After this suite goes green on a
+chip, fill the pending cells: the BENCH_TABLES roofline `fused pool
+(matmul)` row (`python benchmarks/roofline.py`), the Dispatch-floor
+delivery rows (`python benchmarks/microbench.py --md`), and the
+delivery-tier trajectory section (`python benchmarks/trend.py
+--matmul-tier --apply`).
+
+Run on a chip: python -m pytest tests_tpu -q
+Latest recorded run: tests_tpu/RUNLOG.md
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from cop5615_gossip_protocol_tpu import SimConfig, build_topology
+from cop5615_gossip_protocol_tpu.models.runner import run
+
+
+def _cfg(n, algorithm="gossip", engine="fused", delivery="matmul", **kw):
+    kw.setdefault("max_rounds", 100_000)
+    kw.setdefault("chunk_rounds", 64)
+    return SimConfig(n=n, topology="full", algorithm=algorithm,
+                     delivery=delivery, engine=engine, **kw)
+
+
+def _run_with_final_state(topo, cfg):
+    snaps = []
+    res = run(topo, cfg, on_chunk=lambda r, s: snaps.append((r, s)))
+    assert snaps, "on_chunk must fire at least once"
+    return res, snaps[-1][1]
+
+
+def _assert_states_bitwise(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb) > 0
+    for av, bv in zip(la, lb):
+        assert (np.asarray(av) == np.asarray(bv)).all()
+
+
+@pytest.mark.parametrize("n", [1000, 65536, 1_000_000])
+def test_compiled_matmul_gossip_bitwise_vs_chunked_pool(n):
+    # The whole tier in one pin: chunked pool (roll delivery), chunked
+    # matmul (one-hot dot_general), and the compiled fused matmul kernel
+    # (MXU lane blend) must share one integer trajectory. The chunked
+    # matmul leg is n^2-class work — skip it at the flagship size (the
+    # MXU kernel is the production path there).
+    topo = build_topology("full", n)
+    r_pool, s_pool = _run_with_final_state(
+        topo, _cfg(n, engine="chunked", delivery="pool")
+    )
+    r_fused, s_fused = _run_with_final_state(topo, _cfg(n))
+    assert r_pool.rounds == r_fused.rounds
+    _assert_states_bitwise(s_pool, s_fused)
+    if n <= 65536:
+        r_mm, s_mm = _run_with_final_state(
+            topo, _cfg(n, engine="chunked")
+        )
+        assert r_pool.rounds == r_mm.rounds
+        _assert_states_bitwise(s_pool, s_mm)
+
+
+def test_compiled_matmul_pushsum_rounds_parity():
+    n = 65536
+    topo = build_topology("full", n)
+    r_pool = run(topo, _cfg(n, algorithm="push-sum", delivery="pool"))
+    r_mm = run(topo, _cfg(n, algorithm="push-sum"))
+    assert r_pool.converged and r_mm.converged
+    # The fused matmul blend is BITWISE the fused roll blend (one-hot
+    # selection), so rounds must agree exactly, not just statistically.
+    assert r_pool.rounds == r_mm.rounds
+    assert abs(r_pool.estimate_mae - r_mm.estimate_mae) < 1e-3
+
+
+def test_auto_routes_matmul_through_compiled_pool_kernel():
+    # engine='auto' on TPU must resolve the matmul tier onto the fused
+    # pool kernel (the dispatch the roofline/bench rows measure).
+    sink = {}
+
+    def probe(fn, args, donate=False, **info):
+        sink.update(info)
+        return None
+
+    topo = build_topology("full", 65536)
+    run(topo, _cfg(65536, engine="auto"), probe=probe)
+    assert sink.get("variant") == "pool", sink
+
+
+def test_chunked_matmul_lowering_carries_mxu_dot():
+    # The chunked one-hot round's HLO on the chip must contain a real
+    # dot (MXU work), and no scatter — the compiled form of the static
+    # auditor's jaxpr contract.
+    from cop5615_gossip_protocol_tpu.models.runner import make_round_fn
+
+    n = 4096
+    topo = build_topology("full", n)
+    cfg = _cfg(n, engine="chunked")
+    round_fn, state0, key_data, targs = make_round_fn(
+        topo, cfg, jax.random.PRNGKey(0)
+    )
+    import jax.numpy as jnp
+
+    lowered = jax.jit(round_fn).lower(
+        state0, jnp.int32(0), key_data, *targs
+    )
+    txt = lowered.compile().as_text()
+    assert "dot(" in txt or "dot_general" in txt
+    assert "scatter" not in txt
