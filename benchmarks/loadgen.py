@@ -1,11 +1,36 @@
-"""Closed-loop load harness for the serving plane (ISSUE 6 / ROADMAP item 2).
+"""Load harness for the serving plane (ISSUE 6 / 14, ROADMAP item 2).
 
-Spawns ``serve.py`` as a real OS process, drives it with N closed-loop
-HTTP clients (each client keeps exactly one request in flight over a
-persistent connection — classic closed-loop load, so offered load adapts
-to service capacity instead of queueing unboundedly), replays a mixed
-small-N request trace spanning several key buckets, and reports
+Spawns ``serve.py`` (or, with ``--fleet N``, the bucket-routed worker
+fleet front — serving/fleet.py) as real OS processes, drives it with N
+closed-loop clients (each client keeps exactly one request in flight over
+a persistent connection — classic closed-loop load, so offered load
+adapts to service capacity instead of queueing unboundedly), replays a
+mixed small-N request trace spanning several key buckets, and reports
 throughput + p50/p99 latency.
+
+ISSUE 14 modes:
+
+- ``--fleet N`` runs every phase against the fleet front (N workers,
+  consistent-hash bucket routing, continuous batching on) — the
+  configuration of the BENCH_TABLES serving row;
+- ``--no-continuous`` passes the wave-at-a-time control flag through to
+  the server(s) — the A/B for the continuous-batching win;
+- ``--open-loop R1,R2,...`` replaces the closed loop with POISSON
+  arrivals at each offered rate and reports latency vs offered load:
+  the closed loop adapts its offered rate to capacity, so it can only
+  ever show the ceiling it reached — the open loop shows the knee, and
+  the saturation rate is MEASURED (highest offered rate whose achieved
+  throughput stays within 5%) instead of inferred;
+- ``--buckets B`` pressure-tests the warm-engine LRU past its capacity
+  (ROADMAP flagged it unexamined beyond ~10 buckets): drives B distinct
+  key buckets (distinct full-topology populations), then revisits a
+  working set inside capacity, asserting the pool's miss/eviction/hit
+  accounting and recording cold-vs-warm latency;
+- ``--chaos-fleet`` SIGKILLs the worker that owns a driven bucket
+  mid-load under the fleet front and asserts zero lost/duplicated
+  terminal responses, the dead worker's buckets re-routing (front
+  reroutes/quarantine counters), and exact identities on the drained
+  fleet.
 
 ``--smoke`` is the CI serve-smoke contract (env-overridable pins):
 
@@ -70,6 +95,35 @@ MIXED_SMALL_TRACE = (
     {"n": 32, "topology": "full", "algorithm": "push-sum",
      "params": {"delta": 3e-3, "term_rounds": 1}},
 )
+
+# Mixed-DURATION trace (ISSUE 14, `--trace mixed-duration`): chunk_rounds
+# 8 makes the retire grain finer than every request's duration, and the
+# buckets span ~13-to-~76 rounds with real within-bucket seed variance
+# (ring gossip 55-76, push-sum 24-34, full gossip 13-22 measured) — the
+# convoy case the wave-at-a-time scheduler collapses on (finished lanes
+# idle until the slowest wave member) and continuous batching exists for.
+# The closed loop cannot expose the collapse (it adapts its offered rate);
+# drive this trace with --open-loop.
+MIXED_DURATION_TRACE = (
+    {"n": 32, "topology": "full", "algorithm": "gossip",
+     "params": {"rumor_threshold": 5, "chunk_rounds": 16}},
+    # max_rounds bounds the stall-prone tail: a suppressed ring rumor can
+    # die out on unlucky seeds (the reference's line-topology hang), and
+    # an unbounded lane would otherwise sit at max occupancy for its
+    # whole max_rounds (the serving lane budget caps residency by TIME;
+    # this trace caps it by rounds so stalled requests retire as honest
+    # outcome="max_rounds" results inside the measured phase).
+    {"n": 64, "topology": "ring", "algorithm": "gossip",
+     "params": {"rumor_threshold": 1, "chunk_rounds": 16,
+                "max_rounds": 512}},
+    {"n": 32, "topology": "full", "algorithm": "push-sum",
+     "params": {"delta": 1e-3, "term_rounds": 1, "chunk_rounds": 16}},
+)
+
+TRACES = {
+    "mixed-small": MIXED_SMALL_TRACE,
+    "mixed-duration": MIXED_DURATION_TRACE,
+}
 
 
 def _env_float(name: str, default: float) -> float:
@@ -184,6 +238,129 @@ class ServerProc:
         shutting_down admissions, bounded drain window) then exit 0 with
         the final stats line — the ISSUE 8 drain contract."""
         return self.shutdown(sig=signal.SIGTERM, timeout_s=timeout_s)
+
+
+class FleetProc(ServerProc):
+    """The fleet front as one OS process tree (ISSUE 14): N serve.py
+    workers behind the consistent-hash router
+    (cop5615_gossip_protocol_tpu/serving/fleet.py). Same drive interface
+    as ServerProc — host/port/jsonl_port point at the FRONT. The
+    worker pid map (the chaos harness's kill targets) is parsed from the
+    fleet-workers line printed before readiness."""
+
+    STATS_KEY = "fleet-stats"
+
+    def __init__(self, workers: int = 2, extra_args=(),
+                 platform: str = "cpu", window_ms: float = 3.0,
+                 max_lanes: int = 64, env_extra: dict | None = None):
+        self.workers = workers
+        cmd = [
+            sys.executable, "-m",
+            "cop5615_gossip_protocol_tpu.serving.fleet",
+            "--workers", str(workers),
+            # Everything unrecognized passes through to each worker.
+            "--platform", platform,
+            "--window-ms", str(window_ms),
+            "--max-lanes", str(max_lanes),
+            *extra_args,
+        ]
+        env = dict(os.environ)
+        env.update(env_extra or {})
+        env.setdefault("JAX_PLATFORMS", platform if platform != "auto" else "")
+        self.proc = subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, cwd=str(REPO), env=env,
+        )
+        self.host = "127.0.0.1"
+        self.port = -1
+        self.jsonl_port = -1
+        self.worker_pids: dict = {}
+        self._tail: list = []
+        self._await_ready()
+
+    def _await_ready(self, timeout_s: float = 300.0) -> None:
+        # Pump stdout from the start and read lines off a queue so the
+        # readiness deadline is REAL — a blocking readline on a
+        # wedged-silent fleet would hang the harness past any timeout.
+        import queue
+
+        lines: queue.Queue = queue.Queue()
+
+        def pump():
+            for line in self.proc.stdout:
+                self._tail.append(line)
+                if len(self._tail) > 200:
+                    del self._tail[:100]
+                lines.put(line)
+            lines.put(None)
+
+        self._drain = threading.Thread(target=pump, daemon=True)
+        self._drain.start()
+        deadline = time.monotonic() + timeout_s
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise RuntimeError(
+                    f"fleet never printed FLEET line within {timeout_s:.0f}s"
+                )
+            try:
+                line = lines.get(timeout=min(remaining, 1.0))
+            except queue.Empty:
+                continue
+            if line is None:
+                raise RuntimeError(
+                    "fleet exited before readiness: "
+                    + "".join(self._tail[-20:])
+                )
+            if line.startswith("{"):
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    rec = {}
+                if "fleet-workers" in rec:
+                    self.worker_pids = {
+                        wid: info["pid"]
+                        for wid, info in rec["fleet-workers"].items()
+                    }
+            if line.startswith("FLEET "):
+                parts = line.split()
+                self.port = int(parts[2])
+                self.jsonl_port = int(parts[3])
+                return
+
+    def shutdown(self, sig=signal.SIGTERM, timeout_s: float = 180) -> dict:
+        self.proc.send_signal(sig)
+        rc = self.proc.wait(timeout=timeout_s)
+        if self._drain is not None:
+            self._drain.join(timeout=10)
+        if rc != 0:
+            raise RuntimeError(
+                f"fleet exited rc={rc}: " + "".join(self._tail[-20:])
+            )
+        for line in reversed(self._tail):
+            if line.startswith("{"):
+                rec = json.loads(line)
+                if self.STATS_KEY in rec:
+                    return rec[self.STATS_KEY]
+        raise RuntimeError("fleet printed no final stats line")
+
+
+def check_fleet_stats(final: dict, live_identities: bool = True) -> None:
+    """The fleet accounting contract (ISSUE 14): the front answered every
+    request it received (exactly one terminal response each), and every
+    LIVE worker's drained /stats satisfies the full serving identities. A
+    SIGKILLed worker's counters die with it — its requests either
+    resolved before the kill or were rerouted and are accounted by the
+    worker that actually answered, so the front identity is the
+    fleet-wide exactly-once pin."""
+    front = final["front"]
+    assert front["received"] == front["responded"], front
+    assert front["in_flight"] == 0, front
+    if live_identities:
+        for wid, snap in final["workers"].items():
+            if not isinstance(snap, dict) or "received" not in snap:
+                continue  # killed worker: no drained stats
+            check_stats(snap, min_buckets=0)
 
 
 _MAX_RETRIES = 6
@@ -610,7 +787,8 @@ def check_stats(stats: dict, min_buckets: int = 2) -> None:
     assert len(stats["buckets"]) >= min_buckets, stats["buckets"]
 
 
-def warm_width_ladder(server: "ServerProc", clients: int, conns: int) -> int:
+def warm_width_ladder(server: "ServerProc", clients: int, conns: int,
+                      trace=MIXED_SMALL_TRACE) -> int:
     """Warm the engine pool for every lane WIDTH the measured phases can
     hit (compiles are a property of process start, not steady-state
     serving — without the ladder, a first-occupancy-of-this-width batch
@@ -626,7 +804,8 @@ def warm_width_ladder(server: "ServerProc", clients: int, conns: int) -> int:
     total = 0
     for w in ladder:
         warm = drive(server, clients=w, conns=min(conns, w),
-                     duration_s=120.0, max_requests_per_client=3)
+                     duration_s=120.0, max_requests_per_client=3,
+                     trace=trace)
         total += warm["requests"]
         if warm["errors"]:
             raise AssertionError(f"warm phase errors: {warm['error_samples']}")
@@ -895,6 +1074,486 @@ def run_chaos_serve(args) -> int:
     return 0
 
 
+def drive_open_loop(server, rate: float, duration_s: float,
+                    trace=MIXED_SMALL_TRACE, conns: int = 128,
+                    seed0: int = 0) -> dict:
+    """One open-loop phase: Poisson arrivals at ``rate`` req/s for
+    ``duration_s``. Latency is measured from the SCHEDULED arrival time,
+    so client-side queueing when the server (or client pool) saturates
+    shows up in the percentiles instead of silently throttling the
+    offered load — the property the closed loop cannot have."""
+    import queue
+    import random
+
+    jobs: queue.Queue = queue.Queue()
+    lock = threading.Lock()
+    lats: list = []
+    statuses: dict = {}
+    errors: list = []
+
+    def connect():
+        # Bounded retry: a pool-sized connect burst can transiently
+        # outrun even a deep accept backlog on a loaded 1-core box; a
+        # worker that gives up shrinks the measured capacity silently.
+        for attempt in range(20):
+            try:
+                s = socket.create_connection(
+                    (server.host, server.jsonl_port), timeout=120
+                )
+                return s, s.makefile("rb")
+            except OSError:
+                time.sleep(0.02 * (attempt + 1))
+        return None, None
+
+    def worker():
+        sock, rfile = connect()
+        if sock is None:
+            with lock:
+                errors.append("connect: retries exhausted")
+            return
+        try:
+            while True:
+                job = jobs.get()
+                if job is None:
+                    return
+                t_arr, body = job
+                try:
+                    sock.sendall(json.dumps(body).encode() + b"\n")
+                    payload = json.loads(rfile.readline())
+                except (OSError, json.JSONDecodeError, ValueError) as e:
+                    with lock:
+                        errors.append(f"{type(e).__name__}: {e}")
+                    # Reconnect instead of limping on a dead socket —
+                    # a lost worker biases the whole phase's capacity.
+                    rfile.close()
+                    sock.close()
+                    sock, rfile = connect()
+                    if sock is None:
+                        with lock:
+                            errors.append("reconnect: retries exhausted")
+                        return
+                    continue
+                lat = time.monotonic() - t_arr
+                status = payload.get("status", 0)
+                with lock:
+                    statuses[status] = statuses.get(status, 0) + 1
+                    if status == 200:
+                        lats.append(lat)
+        finally:
+            if sock is not None:
+                rfile.close()
+                sock.close()
+
+    pool = [threading.Thread(target=worker, daemon=True)
+            for _ in range(conns)]
+    for th in pool:
+        th.start()
+    rng = random.Random(0xA11CE + seed0)
+    t0 = time.monotonic()
+    t_end = t0 + duration_s
+    t_next = t0
+    offered = 0
+    i = 0
+    while t_next < t_end:
+        now = time.monotonic()
+        if now < t_next:
+            time.sleep(min(t_next - now, 0.005))
+            continue
+        body = dict(trace[i % len(trace)])
+        body["schema_version"] = 1
+        body["seed"] = seed0 + i
+        jobs.put((t_next, body))
+        offered += 1
+        i += 1
+        t_next += rng.expovariate(rate)
+    for _ in pool:
+        jobs.put(None)
+    for th in pool:
+        th.join(timeout=duration_s + 120)
+    elapsed = time.monotonic() - t0
+    lats.sort()
+    ok = statuses.get(200, 0)
+    from cop5615_gossip_protocol_tpu.serving.admission import percentile
+
+    return {
+        "offered_rps": rate,
+        "offered": offered,
+        "ok": ok,
+        "rejected": statuses.get(429, 0),
+        "other": {
+            str(s): c for s, c in statuses.items() if s not in (200, 429)
+        },
+        "errors": len(errors),
+        "error_samples": errors[:5],
+        "elapsed_s": elapsed,
+        "achieved_rps": ok / elapsed if elapsed > 0 else 0.0,
+        "p50_ms": 1e3 * percentile(lats, 0.50) if lats else None,
+        "p99_ms": 1e3 * percentile(lats, 0.99) if lats else None,
+    }
+
+
+def _spawn_server(args, extra_args=()):
+    """ServerProc or FleetProc per --fleet, with the --no-continuous
+    control flag passed through."""
+    extra = tuple(extra_args)
+    if args.no_continuous:
+        extra = ("--no-continuous",) + extra
+    if args.fleet:
+        print(f"[loadgen] spawning fleet front ({args.fleet} workers, "
+              f"window={args.window_ms}ms, lanes={args.max_lanes}, "
+              f"continuous={'off' if args.no_continuous else 'on'})",
+              flush=True)
+        return FleetProc(
+            workers=args.fleet, extra_args=extra, platform=args.platform,
+            window_ms=args.window_ms, max_lanes=args.max_lanes,
+        )
+    print(f"[loadgen] spawning serve.py (platform={args.platform}, "
+          f"window={args.window_ms}ms, lanes={args.max_lanes}, "
+          f"continuous={'off' if args.no_continuous else 'on'})",
+          flush=True)
+    return ServerProc(
+        extra_args=extra, platform=args.platform,
+        window_ms=args.window_ms, max_lanes=args.max_lanes,
+    )
+
+
+def run_open_loop(args) -> int:
+    """Latency vs offered load (ISSUE 14 satellite): Poisson arrivals at
+    each rate in ``--open-loop``, reporting achieved throughput and
+    latency percentiles per offered rate; the measured saturation rate is
+    the highest offered rate whose achieved throughput stays within 5%
+    (and whose arrivals were neither rejected nor errored)."""
+    rates = [float(r) for r in args.open_loop.split(",") if r]
+    trace = TRACES[args.trace]
+    server = _spawn_server(args)
+    rows: list = []
+    try:
+        warm_width_ladder(server, args.clients, args.conns, trace=trace)
+        for k, rate in enumerate(rates):
+            phase = drive_open_loop(
+                server, rate, duration_s=min(args.duration, 10.0),
+                trace=trace, conns=args.open_conns,
+                seed0=1_000_000 * (k + 1),
+            )
+            rows.append(phase)
+            print(
+                f"[loadgen] open-loop {rate:,.0f} req/s offered -> "
+                f"{phase['achieved_rps']:,.0f} achieved "
+                f"(p50 {phase['p50_ms'] or float('nan'):.1f} ms, "
+                f"p99 {phase['p99_ms'] or float('nan'):.1f} ms, "
+                f"{phase['rejected']} rejected, {phase['errors']} errors)",
+                flush=True,
+            )
+        final_stats = server.shutdown()
+    finally:
+        if server.proc.poll() is None:
+            server.proc.kill()
+
+    saturation = None
+    for phase in rows:
+        if (phase["achieved_rps"] >= 0.95 * phase["offered_rps"]
+                and phase["rejected"] == 0 and phase["errors"] == 0):
+            saturation = phase["offered_rps"]
+    lines = [
+        "## Serving plane — latency vs offered load "
+        "(benchmarks/loadgen.py --open-loop)",
+        "",
+        f"Poisson arrivals over the {args.trace} trace; "
+        f"{'fleet of ' + str(args.fleet) + ' workers' if args.fleet else 'single server'}, "
+        f"continuous batching {'off' if args.no_continuous else 'on'}. "
+        "Latency measured from scheduled arrival (client queueing "
+        "included). Saturation = highest offered rate achieved within "
+        "5%, zero rejects/errors: "
+        + (f"**{saturation:,.0f} req/s**." if saturation else "not reached "
+           "at the offered rates."),
+        "",
+        "| offered req/s | achieved req/s | p50 ms | p99 ms | rejected "
+        "| errors |",
+        "|---|---|---|---|---|---|",
+    ]
+    for p in rows:
+        lines.append(
+            f"| {p['offered_rps']:,.0f} | {p['achieved_rps']:,.0f} "
+            f"| {p['p50_ms']:.1f} | {p['p99_ms']:.1f} "
+            f"| {p['rejected']} | {p['errors']} |"
+            if p["p50_ms"] is not None else
+            f"| {p['offered_rps']:,.0f} | {p['achieved_rps']:,.0f} "
+            f"| — | — | {p['rejected']} | {p['errors']} |"
+        )
+    lines.append("")
+    record = {"open_loop": rows, "saturation_rps": saturation,
+              "fleet": args.fleet,
+              "continuous": not args.no_continuous,
+              "final_stats": final_stats}
+    if args.md:
+        Path(args.md).write_text("\n".join(lines) + "\n")
+    if args.json:
+        Path(args.json).write_text(json.dumps(record, indent=2))
+    print("\n".join(lines), flush=True)
+    return 0
+
+
+def run_bucket_pressure(args) -> int:
+    """Warm-engine LRU pressure test (ISSUE 14 satellite; ROADMAP item 2
+    flagged the pool unexamined past ~10 buckets). Drives ``--buckets``
+    DISTINCT key buckets (distinct full-topology populations — every one
+    compiles its own batch engine) through one server, then re-visits a
+    working set inside the pool capacity, asserting the pool accounting:
+
+      - cold pass: >= B pool misses; evictions start once B exceeds the
+        LRU capacity (GOSSIP_TPU_ENGINE_POOL_CAP, default 64);
+      - warm pass over the most-recent ``capacity/2`` buckets: ZERO new
+        misses (the working set stayed resident through the churn);
+      - an evicted early bucket re-misses (recompiles) on re-visit.
+
+    Reports cold-vs-warm latency and the measured capacity economics for
+    the BENCH_TABLES "Warm-engine LRU under bucket churn" row."""
+    B = args.buckets
+    server = _spawn_server(args)
+    try:
+        sock = socket.create_connection(
+            (server.host, server.jsonl_port), timeout=300
+        )
+        rfile = sock.makefile("rb")
+
+        def visit(i: int, seed: int) -> float:
+            body = {
+                "schema_version": 1, "n": 16 + i, "topology": "full",
+                "algorithm": "gossip", "seed": seed,
+                "params": {"rumor_threshold": 3},
+            }
+            t0 = time.monotonic()
+            sock.sendall(json.dumps(body).encode() + b"\n")
+            payload = json.loads(rfile.readline())
+            assert payload.get("status") == 200, payload
+            return time.monotonic() - t0
+
+        def pool_stats() -> dict:
+            return server.stats()["engine_pool"]
+
+        base = pool_stats()
+        cap = base["capacity"]
+        print(f"[loadgen] bucket pressure: {B} buckets vs pool capacity "
+              f"{cap}", flush=True)
+        t0 = time.monotonic()
+        cold = [visit(i, seed=i) for i in range(B)]
+        cold_s = time.monotonic() - t0
+        after_cold = pool_stats()
+        miss_cold = after_cold["misses"] - base["misses"]
+        evict_cold = after_cold["evictions"] - base["evictions"]
+        assert miss_cold >= B, (miss_cold, B)
+        if B > cap:
+            assert evict_cold >= B - cap, (evict_cold, B, cap)
+            assert after_cold["entries"] <= cap, after_cold
+
+        # Warm pass: the most recent cap/2 buckets must all be resident.
+        ws = min(cap // 2, B)
+        warm: list = []
+        for _ in range(2):
+            warm.extend(visit(i, seed=1000 + i)
+                        for i in range(B - ws, B))
+        after_warm = pool_stats()
+        miss_warm = after_warm["misses"] - after_cold["misses"]
+        hit_warm = after_warm["hits"] - after_cold["hits"]
+        assert miss_warm == 0, (
+            f"{miss_warm} misses re-visiting the {ws} most recent "
+            "buckets — the LRU evicted inside the working set"
+        )
+        assert hit_warm >= 2 * ws, (hit_warm, ws)
+
+        # An early (evicted) bucket re-misses on re-visit.
+        recompile = None
+        if B > cap:
+            t0 = time.monotonic()
+            visit(0, seed=2000)
+            recompile = time.monotonic() - t0
+            after_re = pool_stats()
+            assert after_re["misses"] - after_warm["misses"] == 1, (
+                after_re, after_warm
+            )
+
+        final_stats = server.shutdown()
+    finally:
+        if server.proc.poll() is None:
+            server.proc.kill()
+
+    from cop5615_gossip_protocol_tpu.serving.admission import percentile
+
+    cold_sorted = sorted(cold)
+    warm_sorted = sorted(warm)
+    record = {
+        "buckets": B, "capacity": cap,
+        "cold_pass_s": cold_s,
+        "cold_p50_ms": 1e3 * percentile(cold_sorted, 0.5),
+        "warm_p50_ms": 1e3 * percentile(warm_sorted, 0.5),
+        "misses_cold": miss_cold, "evictions_cold": evict_cold,
+        "warm_working_set": ws, "warm_hits": hit_warm,
+        "recompile_s": recompile,
+        "final_stats": final_stats,
+    }
+    lines = [
+        "## Warm-engine LRU under bucket churn "
+        "(benchmarks/loadgen.py --buckets)",
+        "",
+        f"{B} distinct key buckets (distinct full-topology populations) "
+        f"through one server, pool capacity {cap} "
+        "(GOSSIP_TPU_ENGINE_POOL_CAP).",
+        "",
+        f"- cold pass: {miss_cold} pool misses, {evict_cold} evictions, "
+        f"p50 {record['cold_p50_ms']:,.0f} ms/bucket (compile-bound), "
+        f"{cold_s:.1f} s total",
+        f"- warm working set (the {ws} most recent buckets, 2 passes): "
+        f"0 new misses, {hit_warm} hits, p50 "
+        f"{record['warm_p50_ms']:.1f} ms",
+        (f"- evicted bucket re-visit: 1 re-miss, {recompile:.2f} s "
+         "recompile" if recompile is not None else
+         "- no evictions at this bucket count"),
+        "",
+    ]
+    if args.md:
+        Path(args.md).write_text("\n".join(lines) + "\n")
+    if args.json:
+        Path(args.json).write_text(json.dumps(record, indent=2))
+    print("\n".join(lines), flush=True)
+    print("[loadgen] bucket-pressure checks passed", flush=True)
+    return 0
+
+
+def run_chaos_fleet(args) -> int:
+    """The ISSUE 14 worker-kill chaos contract: drive mixed-priority
+    mixed-deadline traffic against the fleet front, SIGKILL the worker
+    that OWNS a driven bucket mid-load, then gracefully drain — and
+    assert
+
+      1. every submitted request received exactly ONE structured
+         terminal response (Σ sent == Σ answered, zero unstructured
+         outcomes, zero 500s) — kills included;
+      2. the dead worker's buckets re-routed (front worker_failures +
+         reroutes observed, and post-kill requests keep succeeding);
+      3. the front identity (received == responded, in_flight == 0) and
+         every LIVE worker's /stats identities hold exactly on the
+         drained fleet.
+    """
+    workers = 3
+    print(f"[loadgen] chaos-fleet: spawning {workers}-worker fleet",
+          flush=True)
+    fleet = FleetProc(
+        workers=workers,
+        extra_args=("--request-timeout", "90"),
+        platform=args.platform, window_ms=args.window_ms,
+        max_lanes=args.max_lanes,
+    )
+    clients = min(args.clients, 12)
+    try:
+        warm_width_ladder(fleet, clients, conns=clients)
+
+        # Find the worker that owns the gossip/full bucket (trace[0]) —
+        # the kill must hit a bucket under live traffic to exercise
+        # re-routing, not a bystander.
+        sock = socket.create_connection(
+            (fleet.host, fleet.jsonl_port), timeout=60
+        )
+        rfile = sock.makefile("rb")
+        probe = dict(MIXED_SMALL_TRACE[0])
+        probe.update(schema_version=1, seed=987654)
+        sock.sendall(json.dumps(probe).encode() + b"\n")
+        resp = json.loads(rfile.readline())
+        victim = resp["fleet"]["worker"]
+        rfile.close()
+        sock.close()
+        victim_pid = fleet.worker_pids[victim]
+        print(f"[loadgen] chaos-fleet: victim {victim} (pid {victim_pid}) "
+              f"owns {probe['algorithm']}/{probe['topology']}", flush=True)
+
+        kill_after = 3.0
+        sigterm_after = 9.0
+        deadline = time.monotonic() + sigterm_after + 3.0
+        pool = [
+            ClosedLoopClient(
+                fleet.host, fleet.jsonl_port, MIXED_SMALL_TRACE,
+                seed0=1_000_000 * (c + 1), deadline=deadline,
+                transport="jsonl", users=1, chaos=True,
+            )
+            for c in range(clients)
+        ]
+        for c in pool:
+            c.start()
+        time.sleep(kill_after)
+        print(f"[loadgen] chaos-fleet: SIGKILL {victim} mid-load",
+              flush=True)
+        os.kill(victim_pid, signal.SIGKILL)
+        time.sleep(sigterm_after - kill_after)
+        print("[loadgen] chaos-fleet: SIGTERM (graceful fleet drain) "
+              "mid-load", flush=True)
+        final = fleet.shutdown(sig=signal.SIGTERM)
+        for c in pool:
+            c.join(timeout=120)
+
+        sent = sum(c.sent for c in pool)
+        answered = sum(c.answered for c in pool)
+        errors = [e for c in pool for e in c.errors]
+        terminal: dict = {}
+        for c in pool:
+            for k, v in c.terminal.items():
+                terminal[k] = terminal.get(k, 0) + v
+        print(f"[loadgen] chaos-fleet: {sent} sent, {answered} answered, "
+              f"verdicts {terminal}", flush=True)
+
+        assert not errors, f"unstructured outcomes: {errors[:5]}"
+        assert sent == answered, (
+            f"dropped responses: sent {sent} != answered {answered}"
+        )
+        assert answered > 0, "chaos-fleet drive sent no traffic"
+        assert not any(k.startswith("500") for k in terminal), terminal
+        ok_count = sum(v for k, v in terminal.items()
+                       if k.startswith("200"))
+        assert ok_count > 0, terminal
+
+        front = final["front"]
+        assert front["worker_failures"] >= 1, front
+        assert front["reroutes"] >= 1, front
+        check_fleet_stats(final)
+        live = [wid for wid, s in final["workers"].items()
+                if isinstance(s, dict) and "received" in s]
+        assert victim not in live, (victim, list(final["workers"]))
+        assert len(live) == workers - 1, final["workers"]
+        print(f"[loadgen] chaos-fleet: front identity exact "
+              f"({front}), {len(live)} live workers' identities exact",
+              flush=True)
+        record = {
+            "sent": sent, "answered": answered, "terminal": terminal,
+            "victim": victim, "front": front,
+            "live_workers": live,
+        }
+    finally:
+        if fleet.proc.poll() is None:
+            fleet.proc.kill()
+
+    if args.json:
+        Path(args.json).write_text(json.dumps(record, indent=2))
+    if args.md:
+        Path(args.md).write_text("\n".join([
+            "## Chaos-fleet (benchmarks/loadgen.py --chaos-fleet)",
+            "",
+            f"- {workers}-worker fleet; worker {record['victim']} "
+            "(owner of the driven gossip/full bucket) SIGKILLed "
+            "mid-load, fleet SIGTERM-drained mid-load",
+            f"- {record['sent']} requests sent, {record['answered']} "
+            "answered — exactly one structured terminal response each, "
+            "zero 500s",
+            f"- terminal verdicts: {record['terminal']}",
+            f"- dead worker's buckets re-routed: "
+            f"{record['front']['worker_failures']} worker failures, "
+            f"{record['front']['reroutes']} reroutes, front "
+            "received == responded exactly",
+            f"- {len(record['live_workers'])} surviving workers drained "
+            "with exact /stats identities",
+            "",
+        ]) + "\n")
+    print("[loadgen] chaos-fleet passed", flush=True)
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--url", default=None,
@@ -939,12 +1598,49 @@ def main(argv=None) -> int:
                     help="write the latency table as markdown here")
     ap.add_argument("--json", type=str, default=None,
                     help="write the raw phase records as JSON here")
+    ap.add_argument("--fleet", type=int, default=0, metavar="N",
+                    help="drive the bucket-routed worker fleet front "
+                    "(serving/fleet.py) with N serve.py workers instead "
+                    "of a single server (ISSUE 14)")
+    ap.add_argument("--no-continuous", action="store_true",
+                    help="pass the wave-at-a-time control flag to the "
+                    "server(s): continuous batching OFF (the A/B "
+                    "baseline for the ISSUE 14 win)")
+    ap.add_argument("--open-loop", type=str, default=None,
+                    metavar="R1,R2,...",
+                    help="open-loop mode: Poisson arrivals at each "
+                    "offered rate (req/s), latency-vs-offered-load "
+                    "table + measured saturation rate (run_open_loop)")
+    ap.add_argument("--open-conns", type=int, default=128,
+                    help="open-loop client pool size (threads, each "
+                    "with a persistent JSONL connection)")
+    ap.add_argument("--trace", choices=sorted(TRACES),
+                    default="mixed-small",
+                    help="request trace: mixed-small (the r06-comparable "
+                    "3-bucket trace) or mixed-duration (chunk_rounds 8, "
+                    "~13-76-round spread — the ISSUE 14 convoy case)")
+    ap.add_argument("--buckets", type=int, default=0, metavar="B",
+                    help="warm-engine LRU pressure mode: drive B "
+                    "distinct key buckets and assert the pool "
+                    "miss/eviction/hit accounting (run_bucket_pressure)")
+    ap.add_argument("--chaos-fleet", action="store_true",
+                    help="CI chaos-fleet: SIGKILL the worker owning a "
+                    "driven bucket mid-load under the fleet front; "
+                    "assert exactly-one-terminal-response, re-route, "
+                    "and exact identities on the drained fleet "
+                    "(run_chaos_fleet)")
     args = ap.parse_args(argv)
 
     if args.metrics_smoke:
         return run_metrics_smoke(args)
     if args.chaos:
         return run_chaos_serve(args)
+    if args.chaos_fleet:
+        return run_chaos_fleet(args)
+    if args.buckets:
+        return run_bucket_pressure(args)
+    if args.open_loop:
+        return run_open_loop(args)
 
     if args.smoke:
         args.duration = min(args.duration, 8.0)
@@ -954,15 +1650,16 @@ def main(argv=None) -> int:
     p99_ms_bound = _env_float("GOSSIP_TPU_SERVE_P99_MS", 250.0)
     ratio_floor = _env_float("GOSSIP_TPU_SERVE_BATCH_RATIO", 1.3)
 
-    record: dict = {"trace_buckets": len(MIXED_SMALL_TRACE)}
+    trace = TRACES[args.trace]
+    record: dict = {"trace_buckets": len(trace), "trace": args.trace}
     trace_desc = ", ".join(
         f"{t['algorithm']}/{t['topology']}/n{t['n']}"
-        for t in MIXED_SMALL_TRACE
+        for t in trace
     )
     lines = [
         "## Serving plane (benchmarks/loadgen.py closed loop)",
         "",
-        f"Mixed small-N trace, {len(MIXED_SMALL_TRACE)} key buckets "
+        f"{args.trace} trace, {len(trace)} key buckets "
         f"({trace_desc}); {args.clients} closed-loop users over "
         f"{args.conns} JSONL-socket connections (telemetry phase rides "
         "HTTP POST /run).",
@@ -983,24 +1680,18 @@ def main(argv=None) -> int:
         server = _Remote()
         server.host, server.port = host.replace("http://", ""), int(port)
     else:
-        print(f"[loadgen] spawning serve.py (platform={args.platform}, "
-              f"window={args.window_ms}ms, lanes={args.max_lanes})",
-              flush=True)
-        server = ServerProc(
-            platform=args.platform, window_ms=args.window_ms,
-            max_lanes=args.max_lanes,
-        )
+        server = _spawn_server(args)
 
     # Phase 0 — warm: populate the warm-engine pool for every bucket and
     # lane width the measured phases can hit (warm_width_ladder).
-    warm_width_ladder(server, args.clients, args.conns)
+    warm_width_ladder(server, args.clients, args.conns, trace=trace)
 
     # Phase 1 — correctness: telemetry demux on every response, over the
     # HTTP front (the throughput phases ride the JSONL socket — this
     # phase keeps POST /run honest too).
     tele = drive(server, clients=4, duration_s=120.0,
                  max_requests_per_client=6, telemetry=True,
-                 transport="http")
+                 transport="http", trace=trace)
     checked = check_telemetry_responses(tele["responses"])
     print(f"[loadgen] telemetry demux: {checked} responses valid",
           flush=True)
@@ -1011,7 +1702,7 @@ def main(argv=None) -> int:
     batched = None
     for trial in range(max(args.trials, 1)):
         t = drive(server, clients=args.clients, conns=args.conns,
-                  duration_s=args.duration)
+                  duration_s=args.duration, trace=trace)
         print(f"[loadgen] batched trial {trial + 1}: {t['rps']:,.0f} req/s "
               f"(p50 {t['p50_ms']:.1f} ms, p99 {t['p99_ms']:.1f} ms, "
               f"{t['errors']} errors)", flush=True)
@@ -1024,14 +1715,38 @@ def main(argv=None) -> int:
     lines.append(fmt_row("batched", batched, "micro-batcher on"))
 
     stats = server.stats()
-    check_stats(stats, min_buckets=2)
-    record["stats"] = stats
-    print(f"[loadgen] stats ok: {stats['batches']} batches, "
-          f"occupancy mean {stats['batch_occupancy_mean']:.1f}, "
-          f"buckets {list(stats['buckets'])}", flush=True)
+    if args.fleet:
+        front = stats["front"]
+        assert front["received"] == front["responded"], front
+        buckets = set()
+        for snap in stats["workers"].values():
+            if isinstance(snap, dict) and "buckets" in snap:
+                check_stats(snap, min_buckets=0)
+                buckets.update(snap["buckets"])
+        assert len(buckets) >= 2, buckets
+        record["stats"] = stats
+        print(f"[loadgen] fleet stats ok: front {front}, "
+              f"buckets {sorted(buckets)}", flush=True)
+    else:
+        check_stats(stats, min_buckets=2)
+        record["stats"] = stats
+        print(f"[loadgen] stats ok: {stats['batches']} batches, "
+              f"occupancy mean {stats['batch_occupancy_mean']:.1f}, "
+              f"refills {stats.get('refills')}, "
+              f"lane fill mean {stats.get('lane_fill_mean')}, "
+              f"buckets {list(stats['buckets'])}", flush=True)
 
     ratio = None
-    if not args.url:
+    if args.fleet and not args.url:
+        # Fleet mode: graceful drain + the fleet accounting contract;
+        # the batching-off control is a single-server concept — the
+        # fleet row's baseline is the committed single-server trend row.
+        final = server.shutdown()
+        check_fleet_stats(final)
+        record["fleet_final"] = final
+        print("[loadgen] clean fleet drain (rc=0, front + live-worker "
+              "identities exact)", flush=True)
+    elif not args.url:
         final_stats = server.shutdown()
         check_stats(final_stats, min_buckets=2)
         print("[loadgen] clean shutdown (rc=0, final stats consistent)",
@@ -1045,13 +1760,14 @@ def main(argv=None) -> int:
         )
         cwarm = drive(control_server, clients=args.clients,
                       conns=args.conns, duration_s=120.0,
-                      max_requests_per_client=2)
+                      max_requests_per_client=2, trace=trace)
         if cwarm["errors"]:
             raise AssertionError(
                 f"control warm errors: {cwarm['error_samples']}"
             )
         control = drive(control_server, clients=args.clients,
-                        conns=args.conns, duration_s=control_duration)
+                        conns=args.conns, duration_s=control_duration,
+                        trace=trace)
         control_server.shutdown()
         ratio = (batched["rps"] / control["rps"]) if control["rps"] else None
         print(f"[loadgen] control (batching off): {control['rps']:,.0f} "

@@ -81,6 +81,41 @@ def check_host_sync(cell) -> list[Finding]:
     ]
 
 
+def check_host_sync_whole(cell) -> list[Finding]:
+    """The refill-path lint (ISSUE 14): chunk-BOUNDARY programs — the
+    continuous-batching lane-refill and lane-init programs — must be pure
+    device programs with no callback primitive ANYWHERE, not just inside
+    a loop body (they have none): the refill decision is host-side and
+    clock-only by contract (models/sweep.serve_lanes), so a callback
+    appearing in the traced refill program would mean the decision leaked
+    INTO the trace — a device<->host round trip per refill, and a refill
+    schedule no longer replayable from the host alone. Fires direction
+    pinned on the seeded-bad ``host_callback_refill`` fixture."""
+    hits: dict[str, int] = {}
+    for eqn, _in_body in jaxpr_walk.iter_eqns(cell.closed_jaxpr.jaxpr):
+        if eqn.primitive.name in jaxpr_walk.HOST_SYNC_PRIMS:
+            hits[eqn.primitive.name] = hits.get(eqn.primitive.name, 0) + 1
+    where = _cell_where(cell)
+    variant = cell.info.get("variant")
+    if variant:
+        where = f"{where}/{variant}"
+    return [
+        Finding(
+            checker="host-sync",
+            where=where,
+            rule=f"refill-{prim}",
+            detail=(
+                f"{count}x {prim} in a chunk-boundary (refill/lane-init) "
+                "program — the continuous-batching refill path must stay "
+                "host-side and clock-only (pure selects over the batch "
+                "carry); a callback here is a device<->host round trip "
+                "per refill"
+            ),
+        )
+        for prim, count in sorted(hits.items())
+    ]
+
+
 def check_matmul_delivery(cell) -> list[Finding]:
     """delivery='matmul' cells aggregate on the MXU: >= 1 dot_general in
     the traced chunk, zero scatter-family primitives anywhere in it.

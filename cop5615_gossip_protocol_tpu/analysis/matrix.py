@@ -103,6 +103,16 @@ SINGLE_GRID = (
      {"engine": "fused", "delivery": "matmul"}),
 )
 
+# Serving batch-engine cells (ISSUE 14): the vmapped continuous chunk +
+# the lane-refill program, traced through models.sweep.probe_batch_programs.
+# The refill path's contract is the host-sync WHOLE-program check — the
+# refill decision is host-side/clock-only, so no callback primitive may
+# appear anywhere in the refill program (contracts.check_host_sync_whole).
+BATCH_GRID = (
+    ("full", "gossip", 64, 4, {}),
+    ("full", "push-sum", 64, 4, {"telemetry": True}),
+)
+
 # Engines whose donation check also compiles and proves the HLO
 # input_output_alias map (cheap XLA programs; the Pallas compositions'
 # interpret-mode compiles are left to the execution suites).
@@ -237,6 +247,30 @@ def audit_matrix(grid=None, single_grid=None, quick: bool = False,
             findings += _cell_contracts(
                 cell, compile_check=engine in _COMPILE_DONATION_ENGINES
             )
+
+    # Serving batch-engine cells (one trace covers chunk + refill): the
+    # continuous chunk gets the body host-sync/dtype/donation contracts;
+    # the refill program gets the WHOLE-program host-sync check (the
+    # ISSUE 14 refill-path lint) plus donation.
+    if not quick:
+        for topo_name, algo, n, lanes, extra in BATCH_GRID:
+            say(f"trace batch/{topo_name}/{algo} lanes={lanes}")
+            with _x64():
+                cells = trace.trace_batch_cells(
+                    topo_name, algo, n, lanes, extra
+                )
+                for cell in cells:
+                    cell.closed_jaxpr
+            for cell in cells:
+                if cell.info.get("variant") == "batch-refill":
+                    findings += contracts.check_host_sync_whole(cell)
+                else:
+                    findings += contracts.check_host_sync(cell)
+                    findings += contracts.check_dtype_policy(cell)
+                with _x64():
+                    findings += contracts.check_donation(
+                        cell, compile_check=True
+                    )
 
     say("prng-tag map")
     findings += tags.check_tags()

@@ -184,6 +184,40 @@ def trace_cell(engine: str, topology: str, algorithm: str, n: int,
     )
 
 
+def trace_batch_cells(topology: str, algorithm: str, n: int, lanes: int,
+                      cfg_overrides: dict | None = None) -> list:
+    """Capture the serving batch engine's programs (ISSUE 14) without
+    executing them: the vmapped continuous chunk (``variant:
+    'batch-chunk'``) and the lane-refill program (``'batch-refill'``),
+    through ``models.sweep.probe_batch_programs`` — state arguments are
+    eval_shape zeros, so this stays trace-only like every other cell."""
+    from cop5615_gossip_protocol_tpu import SimConfig, build_topology
+    from cop5615_gossip_protocol_tpu.models import sweep
+
+    overrides = dict(cfg_overrides or {})
+    overrides.setdefault("engine", "chunked")
+    cfg = SimConfig(
+        n=n, topology=topology, algorithm=algorithm, **overrides
+    )
+    topo = build_topology(topology, n)
+    cells: list = []
+
+    def probe(fn, args, donate=False, **info):
+        cells.append(TracedCell(
+            engine="batch", topology=topology, algorithm=algorithm, n=n,
+            n_devices=1, overlap=True, extras=dict(cfg_overrides or {}),
+            fn=fn, args=args, donate=donate, info=info,
+        ))
+
+    sweep.probe_batch_programs(topo, cfg, lanes, probe)
+    if not cells:
+        raise RuntimeError(
+            "probe_batch_programs handed back no programs — the batch "
+            "engine's probe path is broken"
+        )
+    return cells
+
+
 def audit_engine(engine: str, topology: str, algorithm: str, n: int,
                  n_devices: int, overlap: bool,
                  cfg_overrides: dict | None = None) -> AuditReport:

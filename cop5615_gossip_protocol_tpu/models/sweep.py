@@ -11,11 +11,26 @@ clusters, PAPERS.md). Two front ends share it:
 
 - ``run_replicas`` — the replica sweep: R seeds derived from one run's
   base key (suite grid cells; a cell's R seeds ARE its bucket);
-- ``run_batched_keys`` — the serving plane's micro-batcher
+- ``run_batched_keys`` — the serving plane's wave-at-a-time micro-batcher
   (serving/batcher.py): each lane carries an INDEPENDENT request's own
   base key (``PRNGKey(request.seed)``), so every lane's trajectory is
   bitwise the one-shot ``models.runner.run`` of that request — the
-  heterogeneous-batch parity contract pinned by tests/test_serving.py.
+  heterogeneous-batch parity contract pinned by tests/test_serving.py;
+- ``serve_lanes`` — CONTINUOUS batching (ISSUE 14): the same compiled
+  chunk run as a persistent lane server. At every chunk boundary, lanes
+  whose request terminated (converged / max_rounds / per-lane deadline)
+  are RETIRED — their result demuxed immediately through the source's
+  ``on_result`` — and REFILLED from the source with fresh same-bucket
+  requests via a masked lane-init program (``refill_b``), so a mixed-
+  duration batch is never gated on its slowest member. The overshoot
+  contract already makes a retired lane's continued execution a bitwise
+  no-op; refill just reclaims the lane for a fresh seed. Each lane's
+  per-round stream depends only on its own key data and ABSOLUTE round
+  index, so a refilled lane is bitwise the one-shot ``runner.run`` of its
+  request exactly like a wave lane (tests/test_continuous.py pins it
+  under forced churn). The refill decision is host-side and clock-only —
+  no callback primitive ever enters the traced chunk body (the static
+  auditor's refill-path lint, analysis/matrix.py).
 
 The compiled vmapped chunk is cached in the warm-engine pool
 (serving/pool.py) under the canonical key + lane count, so same-shape
@@ -252,6 +267,173 @@ def _host_key_data(key_or_seed) -> np.ndarray:
     return np.asarray(sampling.key_split(key_or_seed)[0])
 
 
+def _proto_of_factory(cfg: SimConfig):
+    has_ring = cfg.delay_rounds > 0
+
+    def proto_of(carry_state):
+        return carry_state[0] if has_ring else carry_state
+
+    return proto_of
+
+
+def _batch_engine(topo: Topology, cfg: SimConfig, lanes: int):
+    """Build (or fetch warm) the vmapped batch engine for one
+    (canonical engine key, lane count): EVERYTHING program-shaped — the
+    shared round function, the jitted vmapped chunk, the jitted lane-init
+    and lane-refill programs, the device topology tensors — is built once
+    and reused (serving/pool.py). A steady-state batch then costs host
+    key-data assembly plus a handful of dispatches: one lane-init,
+    one-plus chunk dispatches, one epilogue fetch — the serving plane's
+    throughput rests on this. Returns ``(engine_dict, cache_hit)``.
+
+    The chunk's round cap is PER LANE — ``min(rnd_in + chunk_rounds,
+    cap)`` off each lane's own entry round — so lanes at different round
+    offsets (continuous refill, ``serve_lanes``) each advance exactly one
+    stride per dispatch; a wave batch (all lanes entering at the same
+    round) traces the identical schedule the shared-round_end chunk did."""
+    target = cfg.resolved_target_count(topo.n, topo.target_count)
+    dtype = _check_dtype(cfg)
+    telemetry = cfg.telemetry
+    proto_of = _proto_of_factory(cfg)
+
+    def _build_engine():
+        base_key = jax.random.PRNGKey(cfg.seed)
+        round_fn, _, _, topo_args = make_round_fn(topo, cfg, base_key)
+        life_dev = _life_dev(cfg, topo.n)  # config-pure: shared by lanes
+        done_fn = _done_predicate(cfg, life_dev, target)
+        # One row_fn serves every lane (the crash plane is config-pure;
+        # per-lane key material rides the vmapped kd argument).
+        row_fn = (
+            telemetry_mod.make_row_fn(topo, cfg, base_key)
+            if telemetry else None
+        )
+        stride = cfg.chunk_rounds
+        impl = sampling.key_split(base_key)[1]
+        n = topo.n
+        D = cfg.delay_rounds
+
+        def chunk(state, rnd, done, cap, kd, *targs):
+            rnd_in = rnd  # per-lane loop-entry round (telemetry row base)
+            # Per-lane round end: one stride past THIS lane's entry round,
+            # clamped to the batch-wide cap (max_rounds). Under continuous
+            # refill lanes sit at different absolute rounds; each advances
+            # its own stride per dispatch, so the telemetry buffer bound
+            # and the retire cadence hold for every lane.
+            round_end = jnp.minimum(rnd_in + jnp.int32(stride), cap)
+
+            def cond(c):
+                return jnp.logical_and(~c[2], c[1] < round_end)
+
+            def body(c):
+                s, r = c[0], c[1]
+                s = round_fn(s, r, kd, *targs)
+                d = done_fn(proto_of(s), r)
+                out = (s, r + 1, d)
+                if telemetry:
+                    row = row_fn(proto_of(s), r, kd)
+                    out += (lax.dynamic_update_index_in_dim(
+                        c[3], row, r - rnd_in, 0
+                    ),)
+                return out
+
+            carry = (state, rnd, done)
+            if telemetry:
+                carry += (
+                    jnp.zeros((stride, telemetry_mod.N_COLS), jnp.float32),
+                )
+            return lax.while_loop(cond, body, carry)
+
+        def fresh_states(kd):
+            """Every lane's init state from its key data — the ONE home of
+            per-lane initialization, shared by lane_init (wave entry) and
+            lane_refill (continuous refill) so the two can never drift.
+            Gossip lanes draw their per-lane leader in-trace (bitwise the
+            eager draw_leader — same fold_in/randint off the same key
+            data)."""
+            if cfg.algorithm == "push-sum":
+                st = pushsum_mod.init_state(n, dtype, cfg.initial_term_round)
+                state0 = jax.tree.map(
+                    lambda x: jnp.broadcast_to(
+                        x[None], (lanes,) + x.shape
+                    ),
+                    st,
+                )
+            else:
+                # Reference semantics is rejected for batches, so the
+                # reference-only leader_counts_receipt quirk is off here.
+                state0 = jax.vmap(
+                    lambda k: gossip_mod.init_state(
+                        n,
+                        draw_leader(sampling.key_join(k, impl), topo, cfg),
+                        leader_counts_receipt=False,
+                    )
+                )(kd)
+            if D:
+                ring = (
+                    jnp.zeros((lanes, D, 2, n), dtype)
+                    if cfg.algorithm == "push-sum"
+                    else jnp.zeros((lanes, D, n), jnp.int32)
+                )
+                state0 = (state0, ring)
+            return state0
+
+        def lane_init(kd_padded, n_requests):
+            """All lanes' (state0, key_data) in ONE program: filler lanes
+            (index >= n_requests) swap in keys folded from the
+            LANE_FILLER_TAG0 region off lane 0's key."""
+            lane = jnp.arange(lanes, dtype=jnp.int32)
+            kd0 = sampling.key_join(kd_padded[0], impl)
+            filler = jax.vmap(
+                lambda t: jax.random.fold_in(kd0, LANE_FILLER_TAG0 + t)
+            )(lane)
+            kd = jnp.where(
+                (lane < n_requests)[:, None], kd_padded, filler
+            )
+            return fresh_states(kd), kd
+
+        def lane_refill(state, rnd, done, kd, kd_new, refill, kill):
+            """The continuous-batching refill program (ISSUE 14): slots
+            under ``refill`` are reclaimed for fresh requests — their
+            state swaps to ``fresh_states(kd_new)``'s row (bitwise the
+            lane_init draw for that key data), round counter back to 0,
+            done cleared, key data replaced. Slots under ``kill`` (a
+            deadline expired host-side) are frozen: done=True makes every
+            later chunk a bitwise no-op for them (the overshoot contract)
+            until a refill reclaims the slot. Everything else is
+            untouched bit for bit. Host-side/clock-only by construction —
+            the program is pure selects, no callbacks (the static
+            auditor's refill lint pins it)."""
+            fresh = fresh_states(kd_new)
+
+            def sel(new, old):
+                m = refill.reshape((lanes,) + (1,) * (old.ndim - 1))
+                return jnp.where(m, new, old)
+
+            state = jax.tree.map(sel, fresh, state)
+            rnd = jnp.where(refill, jnp.int32(0), rnd)
+            done = jnp.where(refill, False, jnp.logical_or(done, kill))
+            kd = jnp.where(refill[:, None], kd_new, kd)
+            return state, rnd, done, kd
+
+        return {
+            "chunk_b": jax.jit(
+                jax.vmap(
+                    chunk,
+                    in_axes=(0, 0, 0, None, 0) + (None,) * len(topo_args),
+                ),
+                donate_argnums=(0,),
+            ),
+            "lane_init_b": jax.jit(lane_init),
+            "refill_b": jax.jit(lane_refill, donate_argnums=(0,)),
+            "topo_args": topo_args,
+        }
+
+    return pool_mod.default_pool().get_or_build(
+        ("batch-engine", keys_mod.canonical_key(cfg, topo), lanes),
+        _build_engine,
+    )
+
+
 def run_batched_keys(
     topo: Topology,
     cfg: SimConfig,
@@ -291,118 +473,10 @@ def run_batched_keys(
             f"got {lanes}"
         )
     target = cfg.resolved_target_count(topo.n, topo.target_count)
-    dtype = _check_dtype(cfg)
     telemetry = cfg.telemetry
-    has_ring = cfg.delay_rounds > 0
+    proto_of = _proto_of_factory(cfg)
 
-    def proto_of(carry_state):
-        return carry_state[0] if has_ring else carry_state
-
-    # Warm-engine pool (serving/pool.py): EVERYTHING program-shaped — the
-    # shared round function, the jitted vmapped chunk, the jitted lane-init
-    # program, the device topology tensors — is built once per
-    # (canonical engine key, lane count) and reused. A steady-state batch
-    # then costs host key-data assembly plus a handful of dispatches: one
-    # lane-init, one-plus chunk dispatches, one epilogue fetch — the
-    # serving plane's throughput rests on this.
-    def _build_engine():
-        base_key = jax.random.PRNGKey(cfg.seed)
-        round_fn, _, _, topo_args = make_round_fn(topo, cfg, base_key)
-        life_dev = _life_dev(cfg, topo.n)  # config-pure: shared by lanes
-        done_fn = _done_predicate(cfg, life_dev, target)
-        # One row_fn serves every lane (the crash plane is config-pure;
-        # per-lane key material rides the vmapped kd argument).
-        row_fn = (
-            telemetry_mod.make_row_fn(topo, cfg, base_key)
-            if telemetry else None
-        )
-        stride = cfg.chunk_rounds
-        impl = sampling.key_split(base_key)[1]
-        n = topo.n
-        D = cfg.delay_rounds
-
-        def chunk(state, rnd, done, round_end, kd, *targs):
-            rnd_in = rnd  # per-lane loop-entry round (telemetry row base)
-
-            def cond(c):
-                return jnp.logical_and(~c[2], c[1] < round_end)
-
-            def body(c):
-                s, r = c[0], c[1]
-                s = round_fn(s, r, kd, *targs)
-                d = done_fn(proto_of(s), r)
-                out = (s, r + 1, d)
-                if telemetry:
-                    row = row_fn(proto_of(s), r, kd)
-                    out += (lax.dynamic_update_index_in_dim(
-                        c[3], row, r - rnd_in, 0
-                    ),)
-                return out
-
-            carry = (state, rnd, done)
-            if telemetry:
-                carry += (
-                    jnp.zeros((stride, telemetry_mod.N_COLS), jnp.float32),
-                )
-            return lax.while_loop(cond, body, carry)
-
-        def lane_init(kd_padded, n_requests):
-            """All lanes' (state0, key_data) in ONE program: filler lanes
-            (index >= n_requests) swap in keys folded from the
-            LANE_FILLER_TAG0 region off lane 0's key; gossip lanes draw
-            their per-lane leader in-trace (bitwise the eager
-            draw_leader — same fold_in/randint off the same key data)."""
-            lane = jnp.arange(lanes, dtype=jnp.int32)
-            kd0 = sampling.key_join(kd_padded[0], impl)
-            filler = jax.vmap(
-                lambda t: jax.random.fold_in(kd0, LANE_FILLER_TAG0 + t)
-            )(lane)
-            kd = jnp.where(
-                (lane < n_requests)[:, None], kd_padded, filler
-            )
-            if cfg.algorithm == "push-sum":
-                st = pushsum_mod.init_state(n, dtype, cfg.initial_term_round)
-                state0 = jax.tree.map(
-                    lambda x: jnp.broadcast_to(
-                        x[None], (lanes,) + x.shape
-                    ),
-                    st,
-                )
-            else:
-                # Reference semantics is rejected for batches, so the
-                # reference-only leader_counts_receipt quirk is off here.
-                state0 = jax.vmap(
-                    lambda k: gossip_mod.init_state(
-                        n,
-                        draw_leader(sampling.key_join(k, impl), topo, cfg),
-                        leader_counts_receipt=False,
-                    )
-                )(kd)
-            if D:
-                ring = (
-                    jnp.zeros((lanes, D, 2, n), dtype)
-                    if cfg.algorithm == "push-sum"
-                    else jnp.zeros((lanes, D, n), jnp.int32)
-                )
-                state0 = (state0, ring)
-            return state0, kd
-
-        return {
-            "chunk_b": jax.jit(
-                jax.vmap(
-                    chunk,
-                    in_axes=(0, 0, 0, None, 0) + (None,) * len(topo_args),
-                ),
-                donate_argnums=(0,),
-            ),
-            "lane_init_b": jax.jit(lane_init),
-            "topo_args": topo_args,
-        }
-
-    engine, cache_hit = pool_mod.default_pool().get_or_build(
-        ("batch-engine", keys_mod.canonical_key(cfg, topo), lanes),
-        _build_engine,
-    )
+    engine, cache_hit = _batch_engine(topo, cfg, lanes)
     chunk_b = engine["chunk_b"]
     topo_args = engine["topo_args"]
 
@@ -445,6 +519,11 @@ def run_batched_keys(
     # Filler lanes collect no telemetry and report no results — everything
     # below slices the first ``requests`` lanes.
     trajs = [[] for _ in range(requests)] if telemetry else None
+    # The cap is batch-wide and constant (max_rounds): every lane enters
+    # chunk k at round k*stride, so min(rnd_in + stride, cap) reproduces
+    # the old shared-round_end schedule exactly; rounds_end below is host
+    # bookkeeping for the loop exit only.
+    cap = jnp.int32(cfg.max_rounds)
     rounds_end = 0
     cancelled = False
     t1 = time.perf_counter()
@@ -453,7 +532,7 @@ def run_batched_keys(
         if telemetry:
             rnd_before = np.asarray(rnd)
         out = chunk_b(
-            state, rnd, done, jnp.int32(rounds_end), key_data, *topo_args
+            state, rnd, done, cap, key_data, *topo_args
         )
         state, rnd, done = out[:3]
         if telemetry:
@@ -541,6 +620,316 @@ def run_batched_keys(
             result.estimate_mae
         )
     return result
+
+
+@dataclasses.dataclass
+class LaneTicket:
+    """One request offered to the continuous lane server. ``key`` is a
+    seed (or PRNGKey) — the lane's base key, exactly as a
+    ``run_batched_keys`` lane. ``deadline`` is an absolute
+    ``time.monotonic`` bound checked host-side at every chunk boundary
+    (clock-only — it never enters the trace); an expired lane is retired
+    with ``outcome="deadline_exceeded"`` and its slot reclaimed. ``tag``
+    is caller-opaque (the serving plane parks its ServeRequest there)."""
+
+    key: object
+    tag: object = None
+    deadline: Optional[float] = None
+
+
+@dataclasses.dataclass
+class LaneResult:
+    """One retired lane's demuxed result — the continuous analog of one
+    ``SweepResult`` lane, delivered through ``source.on_result`` at the
+    chunk boundary the lane retired, not at wave end."""
+
+    slot: int
+    rounds: int
+    converged: bool
+    outcome: str  # converged | max_rounds | deadline_exceeded
+    state: Optional[object] = None  # numpy protocol-state slice
+    telemetry: Optional[object] = None  # TelemetryTrajectory
+    target_count: int = 0
+    estimate_mae: Optional[float] = None  # push-sum only
+    true_mean: Optional[float] = None
+    engine_cache: Optional[str] = None
+    t_fill: float = 0.0  # monotonic time the lane was seeded/refilled
+    lanes: int = 0
+    occupancy: int = 0  # occupied lanes at the retiring boundary
+
+
+@dataclasses.dataclass
+class LaneServeSummary:
+    """Aggregate of one ``serve_lanes`` acquisition."""
+
+    served: int = 0  # results delivered (initial fill + refills)
+    refills: int = 0  # lanes reclaimed mid-run for fresh requests
+    chunks: int = 0  # chunk dispatches
+    occupancy_sum: int = 0  # Σ occupied lanes over boundaries
+    lanes: int = 0
+    engine_cache: Optional[str] = None
+    abandoned: bool = False  # the source told the loop to stop observing
+    run_s: float = 0.0
+    compile_s: float = 0.0
+
+
+def _lane_result(slot, occupants, rnd_np, protos, outcome, cfg, topo,
+                 lanes, occupancy, engine_cache, target):
+    occ = occupants[slot]
+    state = jax.tree.map(lambda x, s=slot: np.asarray(x[s]), protos)
+    res = LaneResult(
+        slot=slot,
+        rounds=int(rnd_np[slot]),
+        converged=outcome == "converged",
+        outcome=outcome,
+        state=state,
+        target_count=target,
+        engine_cache=engine_cache,
+        t_fill=occ["t_fill"],
+        lanes=lanes,
+        occupancy=occupancy,
+    )
+    if occ["trajs"] is not None:
+        res.telemetry = telemetry_mod.TelemetryTrajectory(
+            start_round=0,
+            data=(
+                np.concatenate(occ["trajs"])
+                if occ["trajs"]
+                else np.zeros((0, telemetry_mod.N_COLS), np.float32)
+            ),
+        )
+    if cfg.algorithm == "push-sum":
+        # Same float64 numpy formula as SweepResult's epilogue.
+        true_mean = (topo.n - 1) / 2.0
+        s = np.asarray(state.s, dtype=np.float64)
+        w = np.asarray(state.w, dtype=np.float64)
+        conv = np.asarray(state.conv)
+        w_safe = np.where(w != 0, w, 1)
+        err = np.where(conv, np.abs(s / w_safe - true_mean), 0.0)
+        res.true_mean = true_mean
+        res.estimate_mae = float(err.sum() / max(int(conv.sum()), 1))
+    return res
+
+
+def serve_lanes(topo: Topology, cfg: SimConfig, source,
+                lanes: int) -> LaneServeSummary:
+    """Continuous batching (ISSUE 14): run the vmapped batch engine as a
+    persistently-fed lane server. ``source`` is the host-side admission
+    adapter (serving/batcher.py's queue source, or a scripted list in
+    tests):
+
+    - ``source.poll(slots) -> list[LaneTicket]`` — up to ``slots`` fresh
+      same-bucket requests; an empty list means "nothing to refill with
+      right now" (the loop keeps draining the occupied lanes);
+    - ``source.on_result(ticket, LaneResult)`` — a lane RETIRED at a
+      chunk boundary (converged, hit max_rounds, or its per-lane deadline
+      expired): the result is demuxed immediately, not held to wave end;
+    - ``source.on_boundary(active, lanes) -> bool`` — per-boundary
+      heartbeat (watchdog ticks, occupancy gauges); returning False
+      abandons the acquisition (a failed-over executor's loop must stop
+      observing — its unresolved occupants were already re-queued).
+
+    The loop exits when no lane is occupied and ``poll`` returns nothing.
+    Every decision in it is host-side and clock-only — the traced chunk
+    and refill programs carry no callback primitives (the static
+    auditor's refill-path lint). Per-request trajectories stay bitwise
+    the one-shot ``runner.run``: a lane's stream is a pure function of
+    its key data and absolute round index, so neither the boundary grain
+    nor its batch-mates' churn can perturb it (tests/test_continuous.py).
+    """
+    _reject_unsupported(cfg)
+    if not (1 <= lanes <= MAX_REPLICAS):
+        raise ValueError(
+            f"lanes must be in [1, {MAX_REPLICAS}], got {lanes}"
+        )
+    telemetry = cfg.telemetry
+    proto_of = _proto_of_factory(cfg)
+    target = cfg.resolved_target_count(topo.n, topo.target_count)
+    engine, cache_hit = _batch_engine(topo, cfg, lanes)
+    chunk_b = engine["chunk_b"]
+    refill_b = engine["refill_b"]
+    topo_args = engine["topo_args"]
+    engine_cache = "hit" if cache_hit else "miss"
+    summary = LaneServeSummary(lanes=lanes, engine_cache=engine_cache)
+
+    tickets = source.poll(lanes)
+    if not tickets:
+        return summary
+    if len(tickets) > lanes:
+        raise ValueError(
+            f"source.poll returned {len(tickets)} tickets for {lanes} "
+            "free lanes — excess tickets would be silently dropped"
+        )
+    t_now = time.monotonic()
+    occupants: list = [None] * lanes
+    for i, t in enumerate(tickets):
+        occupants[i] = {
+            "ticket": t,
+            "t_fill": t_now,
+            "trajs": [] if telemetry else None,
+        }
+    kd_np = np.stack(
+        [_host_key_data(t.key) for t in tickets]
+        + [_host_key_data(tickets[0].key)] * (lanes - len(tickets))
+    )
+    state, key_data = engine["lane_init_b"](
+        jnp.asarray(kd_np), jnp.int32(len(tickets))
+    )
+    rnd = jnp.zeros((lanes,), jnp.int32)
+    done = jnp.arange(lanes) >= len(tickets)
+
+    t0 = time.perf_counter()
+    false_mask = np.zeros(lanes, bool)
+    if not cache_hit:
+        # Same warmup rule as run_batched_keys: one real round on a copy,
+        # discarded (the timed loop recomputes round 0 off the
+        # absolute-round key stream).
+        warm = chunk_b(
+            jax.tree.map(jnp.copy, state), rnd, done,
+            jnp.int32(min(1, cfg.max_rounds)), key_data, *topo_args,
+        )
+        int(warm[1][0])
+        del warm
+    if not engine.get("refill_warm"):
+        # Warm the refill program too — tracked on the POOL ENTRY, not
+        # the cache verdict: the wave path (run_batched_keys) builds the
+        # same engine without ever touching refill_b, so a cache hit can
+        # still carry a cold refill. jit is lazy; without this the FIRST
+        # real refill pays its trace+compile as an executor stall
+        # mid-acquisition (measured ~0.4 s on this box). An
+        # all-false-mask refill is bitwise identity, so its outputs are
+        # adopted directly — zero wasted dispatch.
+        fm = jnp.asarray(false_mask)
+        state, rnd, done, key_data = refill_b(
+            state, rnd, done, key_data, key_data, fm, fm
+        )
+        engine["refill_warm"] = True
+    summary.compile_s = time.perf_counter() - t0
+
+    cap = jnp.int32(cfg.max_rounds)
+    t1 = time.perf_counter()
+    while True:
+        rnd_before = np.asarray(rnd) if telemetry else None
+        out = chunk_b(state, rnd, done, cap, key_data, *topo_args)
+        state, rnd, done = out[:3]
+        # The per-boundary host sync: the refill decision needs the lane
+        # verdicts (this is the continuous loop's cadence — one sync per
+        # stride, exactly what the wave loop paid).
+        rnd_np = np.asarray(rnd)
+        done_np = np.asarray(done)
+        summary.chunks += 1
+        if telemetry:
+            buf = np.asarray(out[3])
+            for slot, occ in enumerate(occupants):
+                if occ is None:
+                    continue
+                ex = int(rnd_np[slot] - rnd_before[slot])
+                if ex > 0:
+                    occ["trajs"].append(
+                        np.array(buf[slot, :ex], dtype=np.float32)
+                    )
+        now = time.monotonic()
+        retiring: list = []  # (slot, outcome)
+        for slot, occ in enumerate(occupants):
+            if occ is None:
+                continue
+            if done_np[slot]:
+                retiring.append((slot, "converged"))
+            elif rnd_np[slot] >= cfg.max_rounds:
+                retiring.append((slot, "max_rounds"))
+            elif (occ["ticket"].deadline is not None
+                  and now >= occ["ticket"].deadline):
+                # Clock-only, host-side: the lane is frozen via the kill
+                # mask below (done=True makes later chunks bitwise no-ops
+                # for it) and its partial-but-exact result demuxed now.
+                retiring.append((slot, "deadline_exceeded"))
+        killed = [s for s, o in retiring if o == "deadline_exceeded"]
+        if retiring:
+            occupancy = sum(o is not None for o in occupants)
+            # One host fetch per state plane for ALL retiring lanes (the
+            # per-lane results below slice host memory for free). Must
+            # happen BEFORE the refill dispatch — refill_b donates the
+            # state carry.
+            protos = jax.tree.map(np.asarray, proto_of(state))
+            for slot, outcome in retiring:
+                occ = occupants[slot]
+                res = _lane_result(
+                    slot, occupants, rnd_np, protos, outcome, cfg, topo,
+                    lanes, occupancy, engine_cache, target,
+                )
+                occupants[slot] = None
+                summary.served += 1
+                source.on_result(occ["ticket"], res)
+        free = [i for i in range(lanes) if occupants[i] is None]
+        fresh = source.poll(len(free)) if free else []
+        if len(fresh) > len(free):
+            raise ValueError(
+                f"source.poll returned {len(fresh)} tickets for "
+                f"{len(free)} free lanes — excess tickets would be "
+                "silently dropped"
+            )
+        if fresh or killed:
+            refill_mask = false_mask.copy()
+            kill_mask = false_mask.copy()
+            for s in killed:
+                kill_mask[s] = True
+            kd_new = np.array(key_data)  # writable host copy
+            t_now = time.monotonic()
+            for slot, t in zip(free, fresh):
+                refill_mask[slot] = True
+                kd_new[slot] = _host_key_data(t.key)
+                occupants[slot] = {
+                    "ticket": t,
+                    "t_fill": t_now,
+                    "trajs": [] if telemetry else None,
+                }
+            state, rnd, done, key_data = refill_b(
+                state, rnd, done, key_data, jnp.asarray(kd_new),
+                jnp.asarray(refill_mask), jnp.asarray(kill_mask),
+            )
+            summary.refills += len(fresh)
+        active = sum(o is not None for o in occupants)
+        summary.occupancy_sum += active
+        if not source.on_boundary(active, lanes):
+            summary.abandoned = True
+            break
+        if active == 0:
+            break
+    summary.run_s = time.perf_counter() - t1
+    return summary
+
+
+def probe_batch_programs(topo: Topology, cfg: SimConfig, lanes: int,
+                         probe) -> None:
+    """Static-auditor entry (ISSUE 14 satellite): hand the batch engine's
+    chunk and lane-refill programs to ``probe(fn, args, donate=...,
+    variant=...)`` WITHOUT executing anything — state arguments are zeros
+    built from ``jax.eval_shape`` of the lane-init program, so the audit
+    stays trace-only (analysis/trace.trace_batch_cells)."""
+    _reject_unsupported(cfg)
+    engine, _ = _batch_engine(topo, cfg, lanes)
+    kd_np = np.stack([_host_key_data(i) for i in range(lanes)])
+    state_shape, kd_shape = jax.eval_shape(
+        engine["lane_init_b"], jnp.asarray(kd_np), jnp.int32(lanes)
+    )
+    zeros = jax.tree.map(
+        lambda s: jnp.zeros(s.shape, s.dtype), state_shape
+    )
+    key_data = jnp.zeros(kd_shape.shape, kd_shape.dtype)
+    rnd = jnp.zeros((lanes,), jnp.int32)
+    done = jnp.zeros((lanes,), bool)
+    cap = jnp.int32(cfg.max_rounds)
+    probe(
+        engine["chunk_b"],
+        (zeros, rnd, done, cap, key_data) + tuple(engine["topo_args"]),
+        donate=True, variant="batch-chunk",
+    )
+    mask = jnp.zeros((lanes,), bool)
+    probe(
+        engine["refill_b"],
+        (zeros, rnd, done, key_data, key_data, mask, mask),
+        donate=True, variant="batch-refill",
+    )
 
 
 def run_replicas(

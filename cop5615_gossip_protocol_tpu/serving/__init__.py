@@ -14,15 +14,23 @@ the accelerator-offload-for-many-actor-workloads shape of the OpenCL-Actors
 - ``admission`` — bounded-queue admission control + the serving counters
                 behind the ``/stats`` endpoint.
 - ``batcher`` — the heterogeneous micro-batcher: requests landing in the
-                same key bucket within a batching window execute as ONE
-                vmapped program (models/sweep.run_batched_keys), with
-                per-request seeds as batch axes and per-request
-                telemetry/event streams demultiplexed into each response.
+                same key bucket execute as ONE vmapped program, and (ISSUE
+                14, default on) the executor runs each bucket acquisition
+                CONTINUOUSLY — lanes retire at chunk boundaries and
+                refill with freshly admitted same-bucket requests
+                (models/sweep.serve_lanes), per-request telemetry/event
+                streams demultiplexed into each response as it retires.
 - ``server``  — stdlib ``http.server`` front end (``serve.py`` /
                 ``python -m cop5615_gossip_protocol_tpu.serving``):
                 POST /run, GET /stats, GET /healthz. The PR 4 degradation
                 ladder is the availability story — a rung walk is a
                 structured ``engine_degraded`` response field, never a 500.
+- ``fleet``   — the worker fleet (ISSUE 14): N serve.py OS processes
+                behind a consistent-hash bucket-routed front
+                (``python -m cop5615_gossip_protocol_tpu.serving.fleet``),
+                with the PR 8 quarantine machinery reused as fleet
+                membership and exactly-one-terminal-response under
+                worker kill.
 
 Deliberately import-light: submodules import models/* lazily enough that
 ``models.runner``/``models.sweep`` can import ``serving.keys``/

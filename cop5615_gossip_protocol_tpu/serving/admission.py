@@ -168,7 +168,32 @@ class ServingStats:
             "terminal responses with outcome=deadline_exceeded (pre-"
             "dispatch sheds + in-flight cancellations)")
         self._c_batches = r.counter(
-            "gossip_tpu_serving_batches_total", "micro-batches executed")
+            "gossip_tpu_serving_batches_total",
+            "engine acquisitions executed (one wave, or one continuous-"
+            "batching acquisition serving many requests through refill)")
+        # Continuous batching (ISSUE 14): refilled lanes + per-boundary
+        # occupancy. Under refill one acquisition serves many requests, so
+        # batch_occupancy_mean above 1x lanes and batch_fill above 1.0 are
+        # the SIGNAL (lanes held full under churn), not an accounting bug —
+        # the occupancy identity stays Σ _count_lane == completed + failed
+        # + timed_out_dispatched regardless.
+        self._c_refills = r.counter(
+            "gossip_tpu_serving_refills_total",
+            "lanes reclaimed mid-acquisition for freshly admitted "
+            "requests (continuous batching)")
+        self._c_boundaries = r.counter(
+            "gossip_tpu_serving_continuous_boundaries_total",
+            "chunk boundaries observed by continuous acquisitions")
+        self._g_lane_occupancy = r.gauge(
+            "gossip_tpu_serving_lane_occupancy",
+            "occupied lanes at the last continuous chunk boundary")
+        self._g_lane_width = r.gauge(
+            "gossip_tpu_serving_lane_width",
+            "compiled lane width of the last continuous acquisition")
+        self._h_lane_fill = r.histogram(
+            "gossip_tpu_serving_lane_fill",
+            "occupied/width ratio per continuous chunk boundary — the "
+            "refill-holds-lanes-full gauge (ISSUE 14)")
         self._c_batched_requests = r.counter(
             "gossip_tpu_serving_batched_requests_total",
             "sum of batch occupancy over executed batches")
@@ -281,6 +306,10 @@ class ServingStats:
     def batched_requests(self) -> int:
         return int(self._c_batched_requests.value())
 
+    @property
+    def refills(self) -> int:
+        return int(self._c_refills.value())
+
     # -- writers -----------------------------------------------------------
 
     def on_received(self) -> None:
@@ -315,6 +344,21 @@ class ServingStats:
     def on_lane_counted(self) -> None:
         """One request entered the occupancy ledger (see on_batch_meta)."""
         self._c_batched_requests.inc()
+
+    def on_refill(self, count: int = 1) -> None:
+        """``count`` lanes were reclaimed mid-acquisition for freshly
+        admitted requests (continuous batching, ISSUE 14)."""
+        if count:
+            self._c_refills.inc(count)
+
+    def on_lane_occupancy(self, active: int, lanes: int) -> None:
+        """One continuous chunk boundary observed ``active`` occupied
+        lanes of ``lanes`` — the refill-holds-lanes-full signal."""
+        self._c_boundaries.inc()
+        self._g_lane_occupancy.set(active)
+        self._g_lane_width.set(lanes)
+        if lanes > 0:
+            self._h_lane_fill.observe(active / lanes)
 
     def on_completed(self, wait_s: float, service_s: float,
                      degraded: bool = False, spans: Optional[dict] = None,
@@ -447,6 +491,17 @@ class ServingStats:
             ),
             "batch_fill": (
                 batched_requests / lanes_sum if lanes_sum else None
+            ),
+            # Continuous batching (ISSUE 14): refilled-lane count and the
+            # mean per-boundary lane-fill ratio. Under continuous serving
+            # batch_occupancy_mean can exceed the lane width and
+            # batch_fill can exceed 1.0 — one acquisition serves many
+            # requests through refill; lane_fill_mean is the honest
+            # "lanes held full" gauge.
+            "refills": self.refills,
+            "lane_fill_mean": (
+                self._h_lane_fill.sum / self._h_lane_fill.count
+                if self._h_lane_fill.count else None
             ),
             "buckets": buckets,
             # Means over the requests that OBSERVED the histograms (the

@@ -2,14 +2,32 @@
 bucket.
 
 Requests landing in the same key bucket (serving/keys.serve_bucket_key)
-within a batching window execute as ONE vmapped chunked program
-(models/sweep.run_batched_keys): per-request seeds ride the batch axis as
-per-lane base keys, lane counts round up to the next power of two
-(lane-count bucketing — filler lanes draw from the LANE_FILLER_TAG0 region
-and are discarded), and per-request telemetry rows (ops/telemetry.py) and
-event streams are demultiplexed back into each response. Lane ``i`` of a
-batch is bitwise the one-shot ``models.runner.run`` of request ``i``
-(tests/test_serving.py pins it).
+within a batching window execute as ONE vmapped chunked program: per-
+request seeds ride the batch axis as per-lane base keys, lane counts round
+up to the next power of two (lane-count bucketing — filler lanes draw from
+the LANE_FILLER_TAG0 region and are discarded), and per-request telemetry
+rows (ops/telemetry.py) and event streams are demultiplexed back into each
+response. Lane ``i`` of a batch is bitwise the one-shot
+``models.runner.run`` of request ``i`` (tests/test_serving.py pins it).
+
+Continuous batching (ISSUE 14, default ON): instead of the PR 6
+wave-at-a-time schedule — form a batch, run it to completion, only then
+drain the queue again, every wave gated by its slowest member — the
+executor runs each bucket's acquisition through
+``models.sweep.serve_lanes``: at every chunk boundary, lanes whose request
+terminated are RETIRED (result demuxed and the client released
+immediately) and REFILLED with freshly admitted same-bucket requests
+popped straight from the priority queues (``_QueueSource``), so the
+compiled engine stays persistently fed under mixed-duration traffic. The
+refill decision is host-side and clock-only (the static auditor's
+refill-path lint pins it); per-request results stay bitwise the one-shot
+``runner.run`` — refill reclaims a lane for a fresh seed, it never
+perturbs its batch-mates (tests/test_continuous.py). Fairness: an
+acquisition stops refilling once it has run ``continuous_quota_chunks``
+boundaries while other buckets have work waiting, then drains its
+occupied lanes and yields the executor. ``continuous=False`` (or
+``GOSSIP_TPU_SERVE_CONTINUOUS=0``) restores the wave schedule — the
+loadgen A/B control.
 
 Availability: a batched execution failing ENVIRONMENTALLY (the PR 4
 ``_DEGRADABLE_ERRORS`` vocabulary) walks down to per-request one-shot runs
@@ -223,6 +241,8 @@ class MicroBatcher:
         stuck_mult: Optional[float] = None,
         quarantine_s: Optional[float] = None,
         drain_window_s: Optional[float] = None,
+        continuous: Optional[bool] = None,
+        continuous_quota_chunks: Optional[int] = None,
     ):
         if max_lanes < 1:
             raise ValueError("max_lanes must be >= 1")
@@ -238,6 +258,35 @@ class MicroBatcher:
         # admission headroom (the point of the split).
         self.queue_limit = int(queue_limit)
         self.batching = bool(batching)
+        # Continuous batching (ISSUE 14): default ON; env kill switch for
+        # A/B measurement (benchmarks/loadgen.py --no-continuous).
+        self.continuous = (
+            bool(continuous) if continuous is not None
+            else os.environ.get("GOSSIP_TPU_SERVE_CONTINUOUS", "1") != "0"
+        )
+        # Fairness bound: a continuously-fed bucket stops refilling after
+        # this many chunk boundaries WHILE other buckets have queued work,
+        # drains its lanes, and yields the executor.
+        self.continuous_quota_chunks = int(
+            continuous_quota_chunks if continuous_quota_chunks is not None
+            else _env_float("GOSSIP_TPU_SERVE_CONT_QUOTA_CHUNKS", 128)
+        )
+        # Lane residency budget: the continuous analog of the stuck-
+        # executor watchdog. A healthy acquisition heartbeats the
+        # watchdog at every boundary, so a single stall-prone request
+        # (e.g. a suppressed ring-gossip rumor dying out with
+        # max_rounds=1e6) could hold a lane — and eventually the whole
+        # executor — hostage for minutes while looking "live". Every
+        # lane therefore carries an implicit deadline of
+        # min(request deadline, fill + lane_budget_s); a lane that
+        # outlives it retires with the structured
+        # outcome="deadline_exceeded" partial result (exact rounds), and
+        # the slot is reclaimed. Requests that want longer residency set
+        # an explicit deadline_ms below the budget-breach horizon — or
+        # the operator raises GOSSIP_TPU_SERVE_LANE_BUDGET_S.
+        self.lane_budget_s = _env_float(
+            "GOSSIP_TPU_SERVE_LANE_BUDGET_S", 60.0
+        )
         self.stats = stats if stats is not None else ServingStats()
         self.event_log = event_log
         self.slo_s = dict(slo_s) if slo_s is not None else slo_targets_from_env()
@@ -547,9 +596,16 @@ class MicroBatcher:
                     ),
                 )
                 for group in ordered:
-                    for i in range(0, len(group), self.max_lanes):
-                        self._execute_safe(group[i:i + self.max_lanes],
-                                           my_gen)
+                    if self.continuous:
+                        # Continuous acquisitions feed oversize groups
+                        # through refill (the source's pending list), so
+                        # no max_lanes slicing: one acquisition serves
+                        # the whole group AND any same-bucket arrivals.
+                        self._execute_safe(group, my_gen)
+                    else:
+                        for i in range(0, len(group), self.max_lanes):
+                            self._execute_safe(group[i:i + self.max_lanes],
+                                               my_gen)
             else:
                 # Batching-off control (benchmarks/loadgen.py's ratio
                 # baseline): every request is its own single-lane program
@@ -828,9 +884,6 @@ class MicroBatcher:
                 r.ready.set()
 
     def _execute(self, group: list, gen: int) -> None:
-        from ..models import runner as runner_mod
-        from ..models import sweep as sweep_mod
-
         # Dispatch hand-off: a request claimed since the pre-dispatch pass
         # (front timeout) leaves the group BEFORE occupancy is counted;
         # the survivors are atomically marked dispatched, so a later
@@ -854,8 +907,6 @@ class MicroBatcher:
         # (the metrics-smoke CI job asserts it within 5%).
         t_group = time.monotonic()
         req0 = group[0]
-        cfg = req0.cfg
-        topo = req0.topo
 
         # Circuit breaker (ISSUE 8): an open circuit routes the bucket
         # around its (quarantined) batched engine — per-request one-shot
@@ -873,6 +924,42 @@ class MicroBatcher:
             self.event_log.emit(
                 "quarantine-half-open", bucket=req0.bucket_label,
             )
+
+        if self.batching and self.continuous and not probe:
+            # Continuous batching (ISSUE 14): retire-and-refill at chunk
+            # boundaries through models.sweep.serve_lanes. The half-open
+            # probe deliberately stays on the wave path below — one
+            # bounded dispatch is the right shape for a circuit probe.
+            self._execute_continuous(group, gen, t_group)
+            return
+
+        # Oversize groups reach the wave path only through the continuous
+        # executor's probe detour (the continuous _worker skips max_lanes
+        # slicing because refill absorbs the excess): the wave engine runs
+        # at most max_lanes keys per dispatch, so slice here — the probe
+        # slice runs FIRST, so its record() verdict (quarantine closed or
+        # re-opened) lands before the remaining slices dispatch.
+        rest = group[self.max_lanes:]
+        self._execute_wave(group[:self.max_lanes], gen, t_group, probe)
+        for i in range(0, len(rest), self.max_lanes):
+            self._execute_wave(
+                rest[i:i + self.max_lanes], gen, t_group, False,
+            )
+
+    def _execute_wave(self, group: list, gen: int, t_group: float,
+                      probe: bool) -> None:
+        """One wave-at-a-time dispatch (the PR 6 schedule): the whole
+        group as a single vmapped batch, results demuxed at wave end.
+        Group members are already marked dispatched + occupancy-counted
+        by ``_execute``."""
+        from ..models import runner as runner_mod
+        from ..models import sweep as sweep_mod
+
+        if not group:
+            return
+        req0 = group[0]
+        cfg = req0.cfg
+        topo = req0.topo
 
         # Batching-off control mode runs honest single-lane programs (the
         # loadgen ratio baseline must not inherit filler-lane padding).
@@ -979,6 +1066,190 @@ class MicroBatcher:
             return
         for r in group:
             self._one_shot(r, error, t_group, gen)
+
+    # -- continuous batching (ISSUE 14) ------------------------------------
+
+    def _pop_bucket_requests(self, bucket: tuple, k: int,
+                             gen: int) -> list:
+        """Pop up to ``k`` queued same-bucket requests (priority order,
+        FIFO within a class) for continuous refill, running the same
+        hand-off checks as ``_pre_dispatch``: record queue waits, skip
+        claimed requests, shed expired deadlines (504) — a deadline can
+        expire on a request that was ABOUT to be refilled; it is shed
+        here, never dispatched — and atomically mark the survivors
+        dispatched + occupancy-counted."""
+        if k <= 0:
+            return []
+        taken: list = []
+        with self._cv:
+            if self._stop or self._gen != gen:
+                return []
+            for cls in PRIORITIES:
+                q = self._queues[cls]
+                if not q:
+                    continue
+                keep: collections.deque = collections.deque()
+                while q:
+                    r = q.popleft()
+                    if len(taken) < k and r.bucket == bucket:
+                        taken.append(r)
+                    else:
+                        keep.append(r)
+                self._queues[cls] = keep
+                if len(taken) >= k:
+                    break
+        now = time.monotonic()
+        live: list = []
+        for r in taken:
+            self.stats.on_queue_wait(r.priority, now - r.t_received)
+            if r.claimed:
+                continue
+            if r.deadline_expired(now):
+                self._shed(
+                    r, "deadline_exceeded",
+                    f"deadline expired {1e3 * (now - r.t_deadline):.0f} ms "
+                    "ago while queued", status=504,
+                )
+                continue
+            if not r.mark_dispatched_if_unresolved():
+                continue
+            self._count_lane(r)
+            live.append(r)
+        return live
+
+    def _other_bucket_waiting(self, bucket: tuple) -> bool:
+        """Does any OTHER bucket have undispatched work (queued, or left
+        in the popped wave behind the running acquisition)? The fairness
+        signal that caps a continuously-fed bucket's hold on the
+        executor."""
+        with self._cv:
+            for q in self._queues.values():
+                for r in q:
+                    if r.bucket != bucket:
+                        return True
+        with self._wd_lock:
+            wave = self._wave
+            pending = list(wave["requests"]) if wave is not None else []
+        return any(
+            r.bucket != bucket and not r.claimed and not r.is_dispatched()
+            for r in pending
+        )
+
+    def _execute_continuous(self, group: list, gen: int,
+                            t_group: float) -> None:
+        """One continuous acquisition: seed the lanes with ``group``,
+        then retire-and-refill at every chunk boundary until the bucket's
+        supply dries up (or the fairness quota yields the executor). The
+        group members were already claimed-checked, marked dispatched and
+        occupancy-counted by ``_execute``."""
+        from ..models import runner as runner_mod
+        from ..models import sweep as sweep_mod
+
+        req0 = group[0]
+        lanes = lane_bucket(
+            min(len(group), self.max_lanes), self.max_lanes, self.min_lanes
+        )
+        for r in group:
+            r.emit(
+                "batch-dispatched", bucket=req0.bucket_label,
+                occupancy=min(len(group), lanes), lanes=lanes,
+                continuous=True,
+            )
+        # One acquisition = one "batch" in the meta tallies; occupancy
+        # (batched_requests) is per-request via _count_lane, so the
+        # occupancy identity is churn-proof while occupancy_mean/fill
+        # honestly exceed one wave's worth under refill.
+        self.stats.on_batch_meta(req0.bucket_label, lanes)
+        source = _QueueSource(self, group, gen, req0, lanes, t_group)
+        error: Optional[BaseException] = None
+        with self._dispatch_window(gen, group, probe=False):
+            self._maybe_wedge(req0.bucket_label)
+            try:
+                sweep_mod.serve_lanes(req0.topo, req0.cfg, source, lanes)
+            except runner_mod._DEGRADABLE_ERRORS as e:  # noqa: SLF001 — the
+                # PR 4 degradation vocabulary (serving availability
+                # contract); config errors stay fail-fast below.
+                error = e
+            except ValueError as e:
+                error = e
+        if not self._live(gen):
+            return  # failed over mid-acquisition: the watchdog owns them
+        if error is None:
+            leftovers = source.drain_unresolved()
+            # Normally empty: serve_lanes exits only when the source is
+            # dry. Defensive: an abandoned-but-live acquisition must not
+            # orphan its occupants.
+            for r in leftovers:
+                self._one_shot(r, error or RuntimeError(
+                    "continuous acquisition exited with unresolved lanes"
+                ), t_group, gen)
+            return
+        # The acquisition failed as a whole (trace/compile/env). Same
+        # verdict vocabulary as the wave path: environmental failures walk
+        # every unresolved occupant down to the one-shot ladder;
+        # config-contract errors and strict mode fail them structurally.
+        strict = runner_mod._strict_engine(req0.cfg)  # noqa: SLF001
+        degradable = isinstance(error, runner_mod._DEGRADABLE_ERRORS)
+        leftovers = source.drain_unresolved()
+        if not degradable or strict:
+            for r in leftovers:
+                if not r.try_claim():
+                    continue
+                r.status = 503 if degradable else 400
+                r.response = _error_body(
+                    r,
+                    "engine-unavailable" if degradable else "invalid-config",
+                    f"{type(error).__name__}: {error}",
+                )
+                self.stats.on_failed()
+                r.ready.set()
+            return
+        for r in leftovers:
+            self._one_shot(r, error, t_group, gen)
+
+    def _finish_lane(self, r: ServeRequest, res, t_group: float,
+                     gen: int) -> None:
+        """Demux one retired lane's result into its response — the
+        continuous analog of ``_lane_body`` + ``_finish``, called at the
+        chunk boundary the lane retired (not at wave end)."""
+        body = {
+            "result": {
+                "algorithm": r.cfg.algorithm,
+                "topology": r.topo.kind,
+                "population": r.topo.n,
+                "n_requested": r.topo.n_requested,
+                "target_count": res.target_count,
+                "rounds": res.rounds,
+                "converged": res.converged,
+                "outcome": res.outcome,
+                "converged_count": int(np.asarray(res.state.conv).sum()),
+            },
+            "serving": {
+                "bucket": r.bucket_label,
+                "batch_lanes": res.lanes,
+                "batch_occupancy": res.occupancy,
+                "engine_cache": res.engine_cache,
+                "engine_degraded": None,
+                "continuous": True,
+            },
+        }
+        if r.cfg.algorithm == "push-sum":
+            body["result"]["estimate_mae"] = res.estimate_mae
+            body["result"]["true_mean"] = res.true_mean
+        if r.want_telemetry and res.telemetry is not None:
+            body["telemetry"] = res.telemetry.to_trace_records(
+                r.cfg.algorithm
+            )
+        # Span partition under refill: queue_wait ends at lane fill,
+        # engine brackets fill -> retiring boundary, demux closes the
+        # partition in _finish (clamped >= 0) — the metrics-smoke 5%
+        # closure contract holds for refilled lanes too.
+        now = time.monotonic()
+        self._finish(r, body, spans={
+            "queue_wait_s": max(res.t_fill - r.t_received, 0.0),
+            "batch_assemble_s": 0.0,
+            "engine_s": max(now - res.t_fill, 0.0),
+        }, gen=gen)
 
     def _one_shot(self, r: ServeRequest, reason, t_group: float,
                   gen: int) -> None:
@@ -1151,6 +1422,141 @@ class MicroBatcher:
         r.status = 200
         r.response = body
         r.ready.set()
+
+
+class _QueueSource:
+    """The admission-queue adapter ``models.sweep.serve_lanes`` drives
+    (ISSUE 14). ``pending`` holds the popped wave group's members beyond
+    the lane width (they refill before the queues are consulted);
+    ``unresolved`` tracks every lane occupant until its result lands.
+
+    Resolution order per boundary: serve_lanes calls ``on_result`` per
+    retiring lane, then ``on_boundary``. Results are BUFFERED and flushed
+    in ``on_boundary`` — the batch-retired event line is written first,
+    then each request resolves — so the event-log order (batch-retired
+    before request-completed) the metrics-smoke trace join asserts
+    survives continuous serving. Every callback is generation-guarded: a
+    failed-over (abandoned) executor's source stops refilling, stops
+    resolving, and tells the loop to abandon via ``on_boundary -> False``
+    — its unresolved occupants were already re-queued by the watchdog."""
+
+    def __init__(self, batcher: MicroBatcher, group: list, gen: int,
+                 req0: ServeRequest, lanes: int, t_group: float):
+        self.b = batcher
+        self.gen = gen
+        self.bucket = req0.bucket
+        self.bucket_label = req0.bucket_label
+        self.lanes = lanes
+        self.t_group = t_group
+        self.pending = collections.deque(group)
+        self.unresolved: dict = {}
+        self.chunks = 0
+        self.last_tick = time.monotonic()
+        self.retired_buf: list = []
+        self._polled_once = False
+
+    def _ticket(self, r: ServeRequest):
+        from ..models import sweep as sweep_mod
+
+        self.unresolved[id(r)] = r
+        # The lane residency budget backstops requests without (or with
+        # distant) deadlines — see MicroBatcher.lane_budget_s. The
+        # request's own t_deadline (admission/shed accounting) is
+        # untouched.
+        budget = time.monotonic() + self.b.lane_budget_s
+        deadline = (
+            budget if r.t_deadline is None else min(r.t_deadline, budget)
+        )
+        return sweep_mod.LaneTicket(
+            key=r.cfg.seed, tag=r, deadline=deadline
+        )
+
+    def poll(self, k: int) -> list:
+        out: list = []
+        if k <= 0 or not self.b._live(self.gen):
+            return out
+        while self.pending and len(out) < k:
+            r = self.pending.popleft()
+            if r.claimed:
+                continue  # front-timeout/shutdown claimed it while pending
+            out.append(self._ticket(r))
+        want = k - len(out)
+        if want > 0:
+            if (self.chunks >= self.b.continuous_quota_chunks
+                    and self.b._other_bucket_waiting(self.bucket)):
+                # Fairness quota: stop refilling, drain the occupied
+                # lanes, yield the executor to the waiting buckets.
+                return out
+            for r in self.b._pop_bucket_requests(
+                self.bucket, want, self.gen
+            ):
+                out.append(self._ticket(r))
+        # Every ticket handed out past the initial fill reclaimed a lane
+        # mid-acquisition — the refill tally (pending wave members and
+        # queue pops alike), and the request's lifecycle stream records
+        # the reclaim (its dispatch analog).
+        if self._polled_once and out:
+            self.b.stats.on_refill(len(out))
+            for t in out:
+                t.tag.emit(
+                    "lane-refilled", bucket=self.bucket_label,
+                    lanes=self.lanes,
+                )
+        self._polled_once = True
+        return out
+
+    def on_result(self, ticket, res) -> None:
+        r = ticket.tag
+        self.unresolved.pop(id(r), None)
+        self.retired_buf.append((r, res))
+
+    def on_boundary(self, active: int, lanes: int) -> bool:
+        self.chunks += 1
+        now = time.monotonic()
+        b = self.b
+        # Per-boundary engine-time sample: the stuck-watchdog budget's
+        # p99 seed keeps per-CHUNK grain under long-lived acquisitions.
+        b.stats.on_engine_time(self.bucket_label, now - self.last_tick)
+        self.last_tick = now
+        b.stats.on_lane_occupancy(active, lanes)
+        live = b._live(self.gen)
+        if self.retired_buf:
+            buf, self.retired_buf = self.retired_buf, []
+            if live:
+                if b.event_log is not None:
+                    b.event_log.emit(
+                        "batch-retired", bucket=self.bucket_label,
+                        occupancy=len(buf), lanes=lanes, ok=True,
+                        continuous=True,
+                        engine_cache=buf[0][1].engine_cache,
+                        trace_ids=[r.trace_id for r, _ in buf],
+                    )
+                for r, res in buf:
+                    b._finish_lane(r, res, self.t_group, self.gen)
+        # Watchdog heartbeat + group-view refresh: a failover re-queues
+        # exactly the unresolved occupants and still-pending members.
+        with b._wd_lock:
+            a = b._active
+            if a is not None and a["gen"] == self.gen:
+                a["t0"] = now
+                a["budget_s"] = b._budget_s(self.bucket_label)
+                a["group"] = (
+                    list(self.unresolved.values()) + list(self.pending)
+                )
+        return live
+
+    def drain_unresolved(self) -> list:
+        """Every request this acquisition still owes a verdict — lane
+        occupants, pending wave members, and boundary results an error
+        preempted before their flush (re-run is safe: results are pure
+        functions of the seed)."""
+        out = [r for r in self.unresolved.values() if not r.claimed]
+        out.extend(r for r in self.pending if not r.claimed)
+        out.extend(r for r, _ in self.retired_buf if not r.claimed)
+        self.unresolved.clear()
+        self.pending.clear()
+        self.retired_buf.clear()
+        return out
 
 
 class _QuarantinedEngine(Exception):

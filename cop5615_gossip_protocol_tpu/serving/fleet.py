@@ -1,0 +1,811 @@
+"""Worker fleet — N OS-process serving workers behind a bucket-routed
+front (ISSUE 14, the "millions of users" leg of ROADMAP item 2).
+
+One serving process is GIL-bound: the executor thread, the HTTP/JSONL
+front threads and the response demux all contend one interpreter, so a
+single worker saturates ~one core of Python no matter how many cores the
+box has. The fleet runs N ``serve.py`` OS processes (each owning its own
+warm-engine pool and continuous-batching executor) behind a front that
+routes by CANONICAL BUCKET KEY via consistent hashing:
+
+- **Routing.** The front derives each request's serve-bucket key
+  (serving/keys.serve_bucket_key — the same key the micro-batcher groups
+  by) and hashes it onto a ring of virtual nodes. A bucket therefore
+  lands on exactly one worker (few, under churn), so each compiled
+  engine lives in few warm pools and pool hit rates survive the fan-out
+  — random spraying would multiply every bucket's compile count by N.
+- **Membership = the PR 8 quarantine machinery.** Worker health is a
+  ``serving/pool.Quarantine`` over worker ids: a connection failure
+  trips the worker's circuit (its ring arcs re-route to the next worker
+  — consistent hashing moves ONLY the dead worker's buckets), the timed
+  half-open window hands one probe request back to it, and a successful
+  probe rejoins it to the ring.
+- **Exactly one terminal response.** A request in flight on a worker
+  that dies (connection reset / EOF) is RETRIED on the next ring
+  candidate: simulations are pure functions of the request (seed
+  included), so a re-run is idempotent — the client still receives
+  exactly one structured response, and the front counts the reroute.
+  When every candidate is down the front answers a structured 503.
+- **Envelopes.** The JSONL ``{"requests": [...]}`` multi-user envelope
+  is SPLIT by routed worker, the sub-envelopes fan out concurrently, and
+  the responses reassemble in request order — one client wave can span
+  every worker.
+
+The front is deliberately thin: no engine work, no admission state —
+one JSON parse of the request to route it, and one parse of the worker's
+response line to stamp the ``fleet`` routing metadata (and to split/
+reassemble envelopes). That response-side parse is real per-request cost
+on the front's interpreter — measured as part of the ~30% single-core
+fleet overhead in BENCH_TABLES; splicing raw response bytes through
+(metadata in front counters only) is the known next shave if the front
+ever becomes the bottleneck on a multi-core box.
+
+Entry point::
+
+    python -m cop5615_gossip_protocol_tpu.serving.fleet --workers 2
+
+prints ``FLEET host port jsonl_port`` once every worker is healthy (the
+same readiness contract as serve.py's SERVING line; benchmarks/loadgen.py
+--fleet drives it). SIGTERM drains: the front lame-ducks, in-flight
+forwards finish, workers drain in turn (their own SIGTERM contract), and
+the final line carries the front's counters plus every live worker's
+drained /stats — each internally consistent, which is what the
+worker-kill chaos job asserts (a SIGKILLed worker's counters die with
+it; the front's received == responded identity still holds exactly).
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import json
+import os
+import queue
+import signal
+import socket
+import socketserver
+import subprocess
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+from typing import Optional
+
+from . import keys as keys_mod
+from . import pool as pool_mod
+from .server import RESPONSE_SCHEMA_VERSION, config_from_request
+
+REPO = Path(__file__).resolve().parents[2]
+
+
+class HashRing:
+    """Consistent-hash ring over worker ids. ``vnodes`` virtual points
+    per worker smooth the arc sizes; removing a worker moves ONLY its
+    arcs to their successors (the property that keeps every other
+    worker's warm buckets warm through membership churn)."""
+
+    def __init__(self, vnodes: int = 64):
+        self.vnodes = int(vnodes)
+        self._points: list = []  # sorted [(hash, worker_id)]
+        self._hashes: list = []
+        self._workers: set = set()
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _hash(s: str) -> int:
+        return int.from_bytes(
+            hashlib.sha1(s.encode()).digest()[:8], "big"
+        )
+
+    def add(self, worker_id: str) -> None:
+        with self._lock:
+            if worker_id in self._workers:
+                return
+            self._workers.add(worker_id)
+            for v in range(self.vnodes):
+                h = self._hash(f"{worker_id}#{v}")
+                i = bisect.bisect(self._hashes, h)
+                self._hashes.insert(i, h)
+                self._points.insert(i, (h, worker_id))
+
+    def remove(self, worker_id: str) -> None:
+        with self._lock:
+            if worker_id not in self._workers:
+                return
+            self._workers.discard(worker_id)
+            kept = [(h, w) for h, w in self._points if w != worker_id]
+            self._points = kept
+            self._hashes = [h for h, _ in kept]
+
+    def workers(self) -> set:
+        with self._lock:
+            return set(self._workers)
+
+    def candidates(self, key: str) -> list:
+        """Every worker in ring order starting at ``key``'s arc — the
+        retry walk (first = the bucket's home; each later entry is where
+        the bucket lands if every earlier one is excluded/dead)."""
+        with self._lock:
+            if not self._points:
+                return []
+            i = bisect.bisect(self._hashes, self._hash(key))
+            seen: list = []
+            n = len(self._points)
+            for k in range(n):
+                w = self._points[(i + k) % n][1]
+                if w not in seen:
+                    seen.append(w)
+            return seen
+
+
+class WorkerProc:
+    """One serve.py OS process owned by the fleet: spawn, parse the
+    SERVING readiness line, keep a JSONL connection pool, shut down."""
+
+    def __init__(self, worker_id: str, serve_args: list,
+                 env_extra: Optional[dict] = None, conn_cap: int = 64):
+        self.worker_id = worker_id
+        cmd = [
+            sys.executable, "-m", "cop5615_gossip_protocol_tpu.serving",
+            "--port", "0", "--jsonl-port", "0", *serve_args,
+        ]
+        env = dict(os.environ)
+        env.update(env_extra or {})
+        self.proc = subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, cwd=str(REPO), env=env,
+        )
+        self.host = "127.0.0.1"
+        self.port = -1
+        self.jsonl_port = -1
+        self.conn_cap = conn_cap
+        self._conns: list = []
+        self._conn_lock = threading.Lock()
+        self._tail: list = []
+        # Pump stdout from the start: readiness reads from the queue with
+        # a REAL deadline (a blocking readline would ignore timeout_s and
+        # hang the whole fleet on one wedged-silent worker), and the pipe
+        # can never fill up and block the worker.
+        self._lines: "queue.Queue" = queue.Queue()
+        self._drain = threading.Thread(target=self._pump, daemon=True)
+        self._drain.start()
+
+    def _pump(self) -> None:
+        for line in self.proc.stdout:
+            self._tail.append(line)
+            if len(self._tail) > 200:
+                del self._tail[:100]
+            self._lines.put(line)
+        self._lines.put(None)  # EOF sentinel
+
+    def await_ready(self, timeout_s: float = 120.0) -> None:
+        deadline = time.monotonic() + timeout_s
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise RuntimeError(
+                    f"worker {self.worker_id} never printed SERVING "
+                    f"within {timeout_s:.0f}s: " + "".join(self._tail[-20:])
+                )
+            try:
+                line = self._lines.get(timeout=min(remaining, 1.0))
+            except queue.Empty:
+                continue
+            if line is None:
+                raise RuntimeError(
+                    f"worker {self.worker_id} exited before readiness: "
+                    + "".join(self._tail[-20:])
+                )
+            if line.startswith("SERVING "):
+                parts = line.split()
+                self.port = int(parts[2])
+                self.jsonl_port = int(parts[3])
+                return
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    # -- JSONL connection pool --------------------------------------------
+
+    def _connect(self) -> socket.socket:
+        s = socket.create_connection(
+            (self.host, self.jsonl_port), timeout=330.0
+        )
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return s
+
+    def request_line(self, raw: bytes) -> bytes:
+        """One request line -> one response line over a pooled JSONL
+        connection. Raises OSError on any transport failure (the caller
+        trips the quarantine and walks the ring)."""
+        with self._conn_lock:
+            conn = self._conns.pop() if self._conns else None
+        if conn is None:
+            conn = self._connect()
+        try:
+            conn.sendall(raw + b"\n")
+            buf = bytearray()
+            while True:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    raise OSError("worker connection closed mid-response")
+                buf += chunk
+                if buf.endswith(b"\n"):
+                    break
+        except BaseException:
+            try:
+                conn.close()
+            finally:
+                raise
+        with self._conn_lock:
+            if len(self._conns) < self.conn_cap:
+                self._conns.append(conn)
+            else:
+                conn.close()
+        return bytes(buf[:-1])
+
+    def drop_conns(self) -> None:
+        with self._conn_lock:
+            conns, self._conns = self._conns, []
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    def stats(self) -> dict:
+        import http.client
+
+        conn = http.client.HTTPConnection(self.host, self.port, timeout=30)
+        conn.request("GET", "/stats")
+        out = json.loads(conn.getresponse().read())
+        conn.close()
+        return out
+
+    def shutdown(self, sig=signal.SIGTERM, timeout_s: float = 120.0) -> int:
+        self.drop_conns()
+        if self.proc.poll() is None:
+            self.proc.send_signal(sig)
+            try:
+                self.proc.wait(timeout=timeout_s)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(timeout=10)
+        if self._drain is not None:
+            self._drain.join(timeout=5)
+        return self.proc.returncode
+
+    def final_stats(self) -> Optional[dict]:
+        """The drained server-stats record from the worker's last stdout
+        line (serve.py prints it on the way out)."""
+        for line in reversed(self._tail):
+            if line.startswith("{"):
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if "server-stats" in rec:
+                    return rec["server-stats"]
+        return None
+
+
+class FleetFront:
+    """The routing front: bucket-key consistent hashing over live
+    workers, quarantine-as-membership, raw-line forwarding with ring-walk
+    retries. Transport handlers (HTTP + JSONL) are thin shims over
+    ``handle_line``/``handle_body``."""
+
+    def __init__(self, workers: list, max_n: Optional[int] = None,
+                 quarantine_s: float = 5.0):
+        self.workers = {w.worker_id: w for w in workers}
+        self.ring = HashRing()
+        for w in workers:
+            self.ring.add(w.worker_id)
+        self.max_n = int(
+            max_n if max_n is not None
+            else os.environ.get("GOSSIP_TPU_SERVE_MAX_N", "") or 65536
+        )
+        # Worker membership circuit (the PR 8 machinery re-used at fleet
+        # grain): open = routed around, half-open = one probe request.
+        self.quarantine = pool_mod.Quarantine(cooldown_s=quarantine_s)
+        self.draining = False
+        self._lock = threading.Lock()
+        self.counters = {
+            "received": 0, "responded": 0, "invalid": 0,
+            "forwards": 0, "reroutes": 0, "worker_failures": 0,
+            "unrouteable": 0,
+        }
+        self._in_flight = 0
+        self._idle = threading.Condition(self._lock)
+
+    def _count(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self.counters[key] += n
+
+    # -- routing -----------------------------------------------------------
+
+    def route_key(self, body: dict) -> str:
+        """The request's bucket identity as a stable hashable string —
+        ``serve_bucket_key`` of the validated config (the same grouping
+        key the workers batch by), so one bucket maps to one worker.
+        Raises ValueError on an invalid request (the front answers the
+        structured 400 itself — no worker round trip for garbage)."""
+        cfg, _tele, _prio, _dl = config_from_request(body, self.max_n)
+        topo_seed = (
+            cfg.seed if cfg.topology in keys_mod.SEED_BUILT_KINDS else 0
+        )
+        topo = keys_mod.get_topology(
+            cfg.topology, cfg.n, seed=topo_seed, semantics=cfg.semantics
+        )
+        return repr(keys_mod.serve_bucket_key(cfg, topo))
+
+    def _pick_workers(self, rkey: str) -> list:
+        """Ring candidates as ``(worker_id, probe)`` pairs: healthy
+        workers in ring order, open-circuit workers parked at the back
+        (last-resort retries). A quarantined worker whose cooldown
+        expired goes FIRST with ``probe=True`` — the half-open token is
+        consumed via ``check()`` only here, where THIS request will
+        actually attempt the worker and report the outcome. Consulting
+        ``check()`` for workers the request never forwards to would burn
+        the one probe token unexercised and the worker could never
+        rejoin (``state()`` is the non-consuming read)."""
+        cands = self.ring.candidates(rkey)
+        probe_first: list = []
+        healthy: list = []
+        parked: list = []
+        for wid in cands:
+            if self.quarantine.state(wid) == "closed":
+                healthy.append((wid, False))
+            elif (not probe_first
+                  and self.quarantine.check(wid) == "probe"):
+                probe_first.append((wid, True))
+            else:
+                parked.append((wid, False))
+        return probe_first + healthy + parked
+
+    def _forward(self, wid: str, probe: bool, raw: bytes) -> bytes:
+        w = self.workers[wid]
+        if not w.alive():
+            raise OSError(f"worker {wid} process is gone")
+        out = w.request_line(raw)
+        if probe:
+            self.quarantine.record(wid, ok=True)
+        return out
+
+    def _fail_worker(self, wid: str, probe: bool) -> None:
+        self._count("worker_failures")
+        w = self.workers.get(wid)
+        if w is not None:
+            w.drop_conns()
+        if probe:
+            self.quarantine.record(wid, ok=False)
+        else:
+            self.quarantine.trip(wid)
+
+    def handle_body(self, body: dict) -> dict:
+        """Route + forward one run-request body (counted received +
+        responded — exactly one response per request, the front
+        identity); returns the worker's response dict with ``status``
+        set (the JSONL wire shape)."""
+        self._count("received")
+        out = self._route_one(body)
+        self._count("responded")
+        return out
+
+    def _route_one(self, body: dict) -> dict:
+        if self.draining:
+            return {
+                "ok": False, "status": 503, "error": "shutting_down",
+                "detail": "fleet front is draining; retry against a live "
+                "replica", "schema_version": RESPONSE_SCHEMA_VERSION,
+            }
+        try:
+            rkey = self.route_key(body)
+        except (ValueError, TypeError) as e:
+            self._count("invalid")
+            return {
+                "ok": False, "status": 400, "error": "invalid-config",
+                "detail": str(e),
+                "schema_version": RESPONSE_SCHEMA_VERSION,
+            }
+        raw = json.dumps(body).encode()
+        attempts = 0
+        for wid, probe in self._pick_workers(rkey):
+            try:
+                self._count("forwards")
+                out = self._forward(wid, probe, raw)
+            except OSError:
+                self._fail_worker(wid, probe)
+                attempts += 1
+                self._count("reroutes")
+                continue
+            resp = json.loads(out)
+            resp.setdefault("status", 200)
+            resp["fleet"] = {"worker": wid, "reroutes": attempts}
+            return resp
+        self._count("unrouteable")
+        return {
+            "ok": False, "status": 503, "error": "fleet-unavailable",
+            "detail": "no live worker could serve this bucket "
+            f"(after {attempts} candidates)",
+            "schema_version": RESPONSE_SCHEMA_VERSION,
+        }
+
+    def handle_envelope(self, body: dict) -> dict:
+        """Split a ``{"requests": [...]}`` envelope by routed worker, fan
+        the sub-envelopes out concurrently, reassemble in order. Members
+        the front cannot route (invalid / draining) get slot-level
+        verdicts, mirroring ServingApp.handle_batch."""
+        members = body.get("requests")
+        if not isinstance(members, list) or not members:
+            return {
+                "ok": False, "status": 400, "error": "invalid-batch",
+                "detail": "body must be {\"requests\": [run-request, ...]}",
+                "schema_version": RESPONSE_SCHEMA_VERSION,
+            }
+        self._count("received", len(members))
+        slots: list = [None] * len(members)
+        by_worker: dict = {}
+        order: dict = {}
+        for i, m in enumerate(members):
+            if self.draining:
+                slots[i] = {
+                    "ok": False, "status": 503, "error": "shutting_down",
+                    "detail": "fleet front is draining",
+                    "schema_version": RESPONSE_SCHEMA_VERSION,
+                }
+                continue
+            try:
+                rkey = self.route_key(m)
+            except (ValueError, TypeError) as e:
+                self._count("invalid")
+                slots[i] = {
+                    "ok": False, "status": 400, "error": "invalid-config",
+                    "detail": str(e),
+                    "schema_version": RESPONSE_SCHEMA_VERSION,
+                }
+                continue
+            order.setdefault(rkey, []).append(i)
+        # Group routed members by their bucket's CURRENT home worker; the
+        # probe verdict is consumed HERE (check() hands "probe" out once
+        # per half-open window) and carried to the forwarding thread.
+        groups: dict = {}
+        for rkey, idxs in order.items():
+            cands = self._pick_workers(rkey)
+            wid, probe = cands[0] if cands else (None, False)
+            g = groups.setdefault(wid, {"probe": False, "idxs": []})
+            g["probe"] = g["probe"] or probe
+            g["idxs"].extend(idxs)
+
+        def run_group(wid, probe, idxs):
+            if wid is None:
+                out = {
+                    "ok": False, "status": 503,
+                    "error": "fleet-unavailable",
+                    "detail": "no live workers",
+                    "schema_version": RESPONSE_SCHEMA_VERSION,
+                }
+                for i in idxs:
+                    slots[i] = dict(out)
+                return
+            raw = json.dumps(
+                {"requests": [members[i] for i in idxs]}
+            ).encode()
+            try:
+                self._count("forwards")
+                resp = json.loads(self._forward(wid, probe, raw))
+                parts = resp.get("responses")
+                if not isinstance(parts, list) or len(parts) != len(idxs):
+                    raise OSError("malformed envelope from worker")
+                for i, part in zip(idxs, parts):
+                    part.setdefault("status", 200)
+                    part["fleet"] = {"worker": wid, "reroutes": 0}
+                    slots[i] = part
+            except OSError:
+                self._fail_worker(wid, probe)
+                self._count("reroutes", len(idxs))
+                # The group's members retry individually on the re-routed
+                # ring (pure/idempotent — re-running is safe); counting
+                # stays with the envelope.
+                for i in idxs:
+                    slots[i] = self._route_one(members[i])
+
+        items = list(groups.items())
+        if len(items) == 1:
+            wid, g = items[0]
+            run_group(wid, g["probe"], g["idxs"])
+        else:
+            threads = [
+                threading.Thread(
+                    target=run_group, args=(wid, g["probe"], g["idxs"])
+                )
+                for wid, g in items
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        self._count("responded", len(members))
+        return {
+            "ok": True, "status": 200, "responses": slots,
+            "schema_version": RESPONSE_SCHEMA_VERSION,
+        }
+
+    def handle_line(self, line: bytes) -> dict:
+        try:
+            body = json.loads(line)
+        except json.JSONDecodeError as e:
+            self._count("received")
+            out = {
+                "ok": False, "status": 400, "error": "invalid-json",
+                "detail": str(e),
+                "schema_version": RESPONSE_SCHEMA_VERSION,
+            }
+            self._count("responded")
+            return out
+        if isinstance(body, dict) and "requests" in body:
+            return self.handle_envelope(body)
+        return self.handle_body(body)
+
+    # -- lifecycle / stats -------------------------------------------------
+
+    def front_request(self):
+        front = self
+
+        class _F:
+            def __enter__(self):
+                with front._lock:
+                    front._in_flight += 1
+                return self
+
+            def __exit__(self, *exc):
+                with front._lock:
+                    front._in_flight -= 1
+                    if front._in_flight == 0:
+                        front._idle.notify_all()
+                return False
+
+        return _F()
+
+    def await_idle(self, timeout_s: float = 30.0) -> bool:
+        deadline = time.monotonic() + timeout_s
+        with self._lock:
+            while self._in_flight > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._idle.wait(timeout=remaining)
+            return True
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            front = dict(self.counters)
+            front["in_flight"] = self._in_flight
+        front["draining"] = self.draining
+        front["quarantined"] = sorted(
+            wid for wid in self.workers
+            if self.quarantine.state(wid) != "closed"
+        )
+        workers = {}
+        for wid, w in self.workers.items():
+            if not w.alive():
+                workers[wid] = {"alive": False}
+                continue
+            try:
+                snap = w.stats()
+                snap["alive"] = True
+                workers[wid] = snap
+            except OSError as e:
+                workers[wid] = {"alive": True, "stats_error": str(e)}
+        return {
+            "schema_version": RESPONSE_SCHEMA_VERSION,
+            "front": front,
+            "workers": workers,
+        }
+
+    def drain(self, timeout_s: float = 120.0) -> dict:
+        """Graceful fleet drain: lame-duck the front, let in-flight
+        forwards finish, drain every live worker (their SIGTERM
+        contract), return the final combined stats."""
+        self.draining = True
+        self.await_idle()
+        final_workers: dict = {}
+        for wid, w in self.workers.items():
+            if w.alive():
+                w.shutdown(sig=signal.SIGTERM, timeout_s=timeout_s)
+                final = w.final_stats()
+                final_workers[wid] = (
+                    final if final is not None
+                    else {"rc": w.proc.returncode}
+                )
+            else:
+                final_workers[wid] = {"alive": False}
+        with self._lock:
+            front = dict(self.counters)
+            front["in_flight"] = self._in_flight
+        return {"front": front, "workers": final_workers}
+
+
+# ---------------------------------------------------------------- transports
+
+
+class _FleetHttpHandler(BaseHTTPRequestHandler):
+    server_version = "gossip-tpu-fleet/1"
+    protocol_version = "HTTP/1.1"
+    front: FleetFront = None
+    quiet: bool = True
+
+    def _send(self, status: int, payload: dict) -> None:
+        data = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+        if self.path == "/healthz":
+            if self.front.draining:
+                self._send(503, {"ok": False, "draining": True})
+            else:
+                dead = [
+                    wid for wid, w in self.front.workers.items()
+                    if not w.alive()
+                ]
+                self._send(200, {"ok": True, "workers":
+                                 len(self.front.workers) - len(dead),
+                                 "dead": dead})
+        elif self.path == "/stats":
+            self._send(200, self.front.snapshot())
+        else:
+            self._send(404, {"ok": False, "error": "not-found",
+                             "detail": f"no such endpoint {self.path!r}"})
+
+    def do_POST(self):  # noqa: N802
+        if self.path not in ("/run", "/batch"):
+            self._send(404, {"ok": False, "error": "not-found",
+                             "detail": f"no such endpoint {self.path!r}"})
+            return
+        with self.front.front_request():
+            length = int(self.headers.get("Content-Length", 0))
+            resp = self.front.handle_line(self.rfile.read(length) or b"{}")
+            status = resp.get("status", 200)
+            self._send(status, resp)
+
+    def log_message(self, fmt, *args):  # noqa: A002
+        if not self.quiet:
+            super().log_message(fmt, *args)
+
+
+class _FleetJsonlHandler(socketserver.StreamRequestHandler):
+    front: FleetFront = None
+
+    def handle(self):
+        for line in self.rfile:
+            line = line.strip()
+            if not line:
+                continue
+            with self.front.front_request():
+                resp = self.front.handle_line(line)
+                try:
+                    self.wfile.write(json.dumps(resp).encode() + b"\n")
+                except OSError:
+                    return
+
+
+class _JsonlServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+    # Same backlog note as serving/server.py: ~100 simultaneous client
+    # connects must not RST against the stdlib default of 5.
+    request_queue_size = 256
+
+
+def make_front_servers(front: FleetFront, host: str, port: int,
+                       jsonl_port: int, quiet: bool = True):
+    http_handler = type(
+        "BoundFleetHttp", (_FleetHttpHandler,),
+        {"front": front, "quiet": quiet},
+    )
+    jsonl_handler = type(
+        "BoundFleetJsonl", (_FleetJsonlHandler,), {"front": front},
+    )
+    return (
+        ThreadingHTTPServer((host, port), http_handler),
+        _JsonlServer((host, jsonl_port), jsonl_handler),
+    )
+
+
+def spawn_workers(n: int, serve_args: list,
+                  env_extra: Optional[dict] = None) -> list:
+    workers = [
+        WorkerProc(f"w{i}", serve_args, env_extra=env_extra)
+        for i in range(n)
+    ]
+    try:
+        for w in workers:
+            w.await_ready()
+    except BaseException:
+        for w in workers:
+            if w.proc.poll() is None:
+                w.proc.kill()
+        raise
+    return workers
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="gossip-tpu-fleet", description=__doc__.splitlines()[0]
+    )
+    ap.add_argument("--workers", type=int, default=2,
+                    help="serving worker processes to spawn")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0,
+                    help="front HTTP port (0 = ephemeral)")
+    ap.add_argument("--jsonl-port", type=int, default=0,
+                    help="front JSONL port (0 = ephemeral)")
+    ap.add_argument("--worker-quarantine", type=float, default=5.0,
+                    help="seconds a failed worker's circuit stays open "
+                    "before a half-open probe request re-tries it")
+    ap.add_argument("--max-n", type=int, default=None)
+    ap.add_argument("--verbose", action="store_true")
+    # Unrecognized flags pass through to each worker's serve.py.
+    args, worker_args = ap.parse_known_args(argv)
+    worker_args = [a for a in worker_args if a != "--"]
+
+    workers = spawn_workers(args.workers, worker_args)
+    front = FleetFront(
+        workers, max_n=args.max_n, quarantine_s=args.worker_quarantine
+    )
+    httpd, jsonld = make_front_servers(
+        front, args.host, args.port, args.jsonl_port,
+        quiet=not args.verbose,
+    )
+    host, port = httpd.server_address[:2]
+    jsonl_port = jsonld.server_address[1]
+    threading.Thread(
+        target=jsonld.serve_forever, name="fleet-jsonl", daemon=True,
+    ).start()
+    # Worker pid map first (the chaos harness kills one mid-load), then
+    # the machine-readable readiness line loadgen/CI parse — keep format.
+    print(json.dumps({
+        "fleet-workers": {
+            w.worker_id: {"pid": w.proc.pid, "port": w.port,
+                          "jsonl_port": w.jsonl_port}
+            for w in workers
+        }
+    }), flush=True)
+    print(f"FLEET {host} {port} {jsonl_port}", flush=True)
+
+    def _stop(signum, frame):
+        threading.Thread(target=httpd.shutdown, daemon=True).start()
+
+    done = {"drained": None}
+
+    def _drain(signum, frame):
+        def go():
+            done["drained"] = front.drain()
+            httpd.shutdown()
+
+        threading.Thread(target=go, daemon=True).start()
+
+    signal.signal(signal.SIGINT, _stop)
+    signal.signal(signal.SIGTERM, _drain)
+    try:
+        httpd.serve_forever()
+    finally:
+        jsonld.shutdown()
+        jsonld.server_close()
+        httpd.server_close()
+        final = done["drained"]
+        if final is None:
+            final = front.drain()
+        print(json.dumps({"fleet-stats": final}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

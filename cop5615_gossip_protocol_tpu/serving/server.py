@@ -185,6 +185,7 @@ class ServingApp:
         stuck_mult: Optional[float] = None,
         quarantine_s: Optional[float] = None,
         drain_window_s: Optional[float] = None,
+        continuous: Optional[bool] = None,
     ):
         self.stats = ServingStats()
         self.event_log = event_log
@@ -211,7 +212,7 @@ class ServingApp:
             queue_limit=queue_limit, batching=batching, event_log=event_log,
             min_lanes=min_lanes, slo_s=slo_s, stuck_min_s=stuck_min_s,
             stuck_mult=stuck_mult, quarantine_s=quarantine_s,
-            drain_window_s=drain_window_s,
+            drain_window_s=drain_window_s, continuous=continuous,
         ).start()
 
     def _submit(self, body) -> Tuple[int, object]:
@@ -548,6 +549,11 @@ class _JsonlHandler(socketserver.StreamRequestHandler):
 class _JsonlServer(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
     daemon_threads = True
+    # The stdlib default listen backlog is 5: an open-loop client pool
+    # (or a fleet front) opening ~100 connections at once gets RSTs and
+    # the measured capacity collapses — a transport artifact, not a
+    # serving one.
+    request_queue_size = 256
 
 
 def make_jsonl_server(app: ServingApp, host: str = "127.0.0.1",
@@ -585,6 +591,10 @@ def main(argv=None) -> int:
     ap.add_argument("--no-batching", action="store_true",
                     help="control mode: every request runs as its own "
                     "single-lane program (the loadgen ratio baseline)")
+    ap.add_argument("--no-continuous", action="store_true",
+                    help="wave-at-a-time control mode: disable continuous "
+                    "batching (retire-and-refill at chunk boundaries, "
+                    "ISSUE 14) — the loadgen convoy baseline")
     ap.add_argument("--request-timeout", type=float, default=300.0)
     ap.add_argument("--drain-window", type=float, default=None,
                     help="graceful-drain bound in seconds (SIGTERM): "
@@ -637,6 +647,7 @@ def main(argv=None) -> int:
         max_n=args.max_n,
         min_lanes=args.min_lanes,
         drain_window_s=args.drain_window,
+        continuous=not args.no_continuous,
     )
     httpd = make_server(app, args.host, args.port, quiet=not args.verbose)
     host, port = httpd.server_address[:2]

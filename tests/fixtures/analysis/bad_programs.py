@@ -123,6 +123,36 @@ def matmul_delivery_chunk():
                 jnp.arange(32, dtype=jnp.int32)[::-1])
 
 
+def host_callback_refill():
+    """A lane-refill program that consults the host per refill (ISSUE 14):
+    the refill-path lint (contracts.check_host_sync_whole) must flag the
+    callback — the refill decision's contract is host-side/clock-only,
+    pure selects over the batch carry."""
+
+    def fn(state, mask, fresh):
+        jax.debug.callback(lambda m: None, mask)
+        return jnp.where(mask[:, None], fresh, state)
+
+    return fn, (
+        jnp.zeros((4, 8), jnp.float32),
+        jnp.zeros((4,), bool),
+        jnp.ones((4, 8), jnp.float32),
+    )
+
+
+def clean_refill():
+    """The same refill as pure selects — the negative pin."""
+
+    def fn(state, mask, fresh):
+        return jnp.where(mask[:, None], fresh, state)
+
+    return fn, (
+        jnp.zeros((4, 8), jnp.float32),
+        jnp.zeros((4,), bool),
+        jnp.ones((4, 8), jnp.float32),
+    )
+
+
 def double_psum_chunk(mesh, axis):
     """TWO verdict psums per round where the declaration below says ONE —
     the wire-spec diff must flag body-psum (and nothing else)."""

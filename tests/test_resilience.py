@@ -329,7 +329,11 @@ def test_stuck_executor_failover_quarantine_halfopen_recovery(
         assert st == 200
         run(build_topology("full", 32), _cfg32(1))
 
-        real = sweep_mod.run_batched_keys
+        # The continuous executor (ISSUE 14, default) dispatches through
+        # serve_lanes; the half-open probe deliberately rides the wave
+        # path (run_batched_keys), so the recovery request below runs the
+        # REAL engine while the wedge hits the continuous dispatch.
+        real = sweep_mod.serve_lanes
         state = {"wedge": 1}
 
         def flaky(*a, **k):
@@ -338,7 +342,7 @@ def test_stuck_executor_failover_quarantine_halfopen_recovery(
                 time.sleep(4.0)  # > the 1.0s budget: a wedge
             return real(*a, **k)
 
-        monkeypatch.setattr(sweep_mod, "run_batched_keys", flaky)
+        monkeypatch.setattr(sweep_mod, "serve_lanes", flaky)
 
         t0 = time.monotonic()
         st, resp = app.handle_run(dict(body, seed=3))
@@ -387,13 +391,13 @@ def test_stop_nodrain_resolves_in_flight_with_shutting_down(monkeypatch):
     stats = ServingStats()
     b = MicroBatcher(stats=stats, min_lanes=1, window_s=0.001)
 
-    real = sweep_mod.run_batched_keys
+    real = sweep_mod.serve_lanes
 
     def wedged(*a, **k):
         time.sleep(3.0)
         return real(*a, **k)
 
-    monkeypatch.setattr(sweep_mod, "run_batched_keys", wedged)
+    monkeypatch.setattr(sweep_mod, "serve_lanes", wedged)
     b.start()
     r = b.submit(_cfg32(0), False)
     deadline = time.monotonic() + 5
@@ -416,13 +420,13 @@ def test_drain_window_expiry_resolves_leftovers(monkeypatch):
     stats = ServingStats()
     b = MicroBatcher(stats=stats, min_lanes=1, window_s=0.001)
 
-    real = sweep_mod.run_batched_keys
+    real = sweep_mod.serve_lanes
 
     def wedged(*a, **k):
         time.sleep(5.0)
         return real(*a, **k)
 
-    monkeypatch.setattr(sweep_mod, "run_batched_keys", wedged)
+    monkeypatch.setattr(sweep_mod, "serve_lanes", wedged)
     b.start()
     r = b.submit(_cfg32(0), False)
     deadline = time.monotonic() + 5
@@ -444,25 +448,32 @@ def test_front_timeout_claims_never_counts_completed(monkeypatch):
     times out is CLAIMED — the executor's late completion is dropped, the
     request lands in timed_out (not completed), and every identity stays
     exact. The executor survives to serve the next request."""
-    real = sweep_mod.run_batched_keys
+    real = sweep_mod.serve_lanes
     state = {"slow": 1}
 
     def slow_once(*a, **k):
-        res = real(*a, **k)
+        # Sleep BEFORE the engine runs: under continuous batching the
+        # source resolves each lane at its retiring boundary, so a sleep
+        # after the real call would land after the response was already
+        # released.
         if state["slow"] > 0:
             state["slow"] -= 1
             time.sleep(1.0)
-        return res
+        return real(*a, **k)
 
-    app = ServingApp(window_s=0.005, max_lanes=4, min_lanes=1,
-                     request_timeout_s=0.25)
+    app = ServingApp(window_s=0.005, max_lanes=4, min_lanes=1)
     try:
-        # Warm first so the slow path's sleep dominates, not the compile.
-        st, _ = app.handle_run({"schema_version": 1, "n": 32,
-                                "topology": "full", "algorithm": "gossip",
-                                "seed": 1})
-        assert st == 200
-        monkeypatch.setattr(sweep_mod, "run_batched_keys", slow_once)
+        # Warm first so the slow path's sleep dominates, not the compile
+        # — under a generous timeout: a preceding test's failover can
+        # leave this bucket's engine wave-built (refill program cold),
+        # and the first continuous acquisition then pre-warms it, which
+        # must not race the aggressive timeout the MEASURED request gets.
+        st, warm_resp = app.handle_run({"schema_version": 1, "n": 32,
+                                        "topology": "full",
+                                        "algorithm": "gossip", "seed": 1})
+        assert st == 200, warm_resp
+        app.request_timeout_s = 0.25
+        monkeypatch.setattr(sweep_mod, "serve_lanes", slow_once)
         t0 = time.monotonic()
         st, resp = app.handle_run({"schema_version": 1, "n": 32,
                                    "topology": "full",

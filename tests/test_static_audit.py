@@ -67,6 +67,39 @@ def test_host_sync_clean_on_plain_loop():
 # --- dtype policy ----------------------------------------------------------
 
 
+def test_refill_host_sync_fires_on_callback():
+    """The ISSUE 14 refill-path lint: a callback ANYWHERE in a
+    chunk-boundary (refill) program fires, and the pure-select refill is
+    clean."""
+    bad = _bad_programs()
+    findings = contracts.check_host_sync_whole(
+        _cell(*bad.host_callback_refill())
+    )
+    assert [f.rule for f in findings] == ["refill-debug_callback"]
+    assert findings[0].checker == "host-sync"
+    assert contracts.check_host_sync_whole(_cell(*bad.clean_refill())) == []
+
+
+def test_batch_engine_cells_trace_and_audit_clean():
+    """The real continuous-batching programs (models/sweep): both
+    variants captured trace-only, donated, and clean under the body and
+    whole-program host-sync contracts."""
+    with jax.experimental.enable_x64():
+        cells = trace.trace_batch_cells("full", "gossip", 32, 2, {})
+        for cell in cells:
+            cell.closed_jaxpr
+    assert sorted(c.info.get("variant") for c in cells) == [
+        "batch-chunk", "batch-refill",
+    ]
+    for cell in cells:
+        assert cell.donate is True
+        if cell.info["variant"] == "batch-refill":
+            assert contracts.check_host_sync_whole(cell) == []
+        else:
+            assert contracts.check_host_sync(cell) == []
+            assert contracts.check_dtype_policy(cell) == []
+
+
 def test_dtype_policy_fires_on_f64_promotion():
     bad = _bad_programs()
     with jax.experimental.enable_x64():
