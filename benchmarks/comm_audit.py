@@ -58,19 +58,23 @@ def _fmt_bytes(b: int) -> str:
 
 
 def table(reports) -> list[str]:
+    # "wire bytes" sums the operand payloads each device FEEDS the
+    # collectives; "recv bytes" the result payloads each device RECEIVES
+    # (the output avals) — the honest column for asymmetric collectives:
+    # an all_gather receives the n_dev-wide copy, a reduce_scatter only
+    # the local shard. The replicated-pool2 O(N) -> O(N/P + margins)
+    # band-wire delta (ISSUE 15) shows up in recv bytes.
+    wire_prims = ("ppermute", "all_gather", "reduce_scatter", REMOTE_DMA)
     out = [
         "| engine | topology | algorithm | overlap | mechanism "
         "| ppermute/step | psum/step | all_gather/step "
         "| reduce_scatter/step | remote dma/step | wire bytes/step "
-        "| setup collectives |",
-        "|---|---|---|---|---|---|---|---|---|---|---|---|",
+        "| recv bytes/step | setup collectives |",
+        "|---|---|---|---|---|---|---|---|---|---|---|---|---|",
     ]
     for r in reports:
-        wire_bytes = sum(
-            r.body_bytes(p)
-            for p in ("ppermute", "all_gather", "reduce_scatter",
-                      REMOTE_DMA)
-        )
+        wire_bytes = sum(r.body_bytes(p) for p in wire_prims)
+        recv_bytes = sum(r.body_bytes_out(p) for p in wire_prims)
         setup = sum(r.setup_count(p) for p in COLLECTIVE_PRIMS)
         out.append(
             f"| {r.engine} | {r.topology} | {r.algorithm} "
@@ -80,7 +84,8 @@ def table(reports) -> list[str]:
             f"| {r.body_count('all_gather')} "
             f"| {r.body_count('reduce_scatter')} "
             f"| {r.body_count(REMOTE_DMA)} "
-            f"| {_fmt_bytes(wire_bytes)} | {setup} |"
+            f"| {_fmt_bytes(wire_bytes)} | {_fmt_bytes(recv_bytes)} "
+            f"| {setup} |"
         )
     return out
 

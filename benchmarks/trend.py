@@ -17,10 +17,19 @@ serving column from ``--serving REV:RPS`` pins (the committed table
 carries PR 6's measured 1,778 req/s) or ``--loadgen FILE --rev N`` to
 read a ``benchmarks/loadgen.py --json`` record for the current revision.
 
+``--ceilings`` recomputes the plan-level topology-ceilings section from
+the pure plan functions (ISSUE 15: the replicated-pool2 rows per
+delivery wire and mesh width, plus the host-sharded-construction
+bounds); with ``--apply`` it installs idempotently under its own header,
+like the matmul-tier section — and a bare ``--apply`` preserves every
+previously applied section it does not regenerate (the pin-preservation
+rule, tests/test_obs.py).
+
 Usage::
 
     python benchmarks/trend.py [--root .] [--md out.md]
-        [--serving 6:1778] [--loadgen loadgen.json --rev 7] [--apply]
+        [--serving 6:1778] [--loadgen loadgen.json --rev 7]
+        [--ceilings] [--matmul-tier] [--apply]
 """
 
 from __future__ import annotations
@@ -37,6 +46,10 @@ SECTION_HEADER = "## Perf trajectory (benchmarks/trend.py)"
 MATMUL_HEADER = (
     "## Delivery-tier trajectory — MXU matmul "
     "(benchmarks/trend.py --matmul-tier)"
+)
+CEILINGS_HEADER = (
+    "## Topology ceilings past one chip "
+    "(plan-level, benchmarks/trend.py --ceilings)"
 )
 
 
@@ -134,12 +147,19 @@ def render(revs: dict, serving: dict) -> str:
 
 
 def render_ceilings(n_dev: int = 8) -> str:
-    """The ISSUE 10 'topology ceilings' rows, RECOMPUTED from the plan
-    functions instead of hand-typed: plan_imp_hbm_sharded_shape and
+    """The topology-ceilings section, RECOMPUTED from the plan functions
+    instead of hand-typed: plan_imp_hbm_sharded_shape and
     plan_pool2_sharded are pure in (kind, n, cfg, n_dev) — no adjacency
     arrays, no device — so the admitted aggregate populations are
-    verifiable on any box. The ms/round cells stay 'pending' until an
-    on-chip regen (the BENCH_TABLES protocol)."""
+    verifiable on any box. ISSUE 15 adds the replicated-pool2 rows PER
+    WIRE (the banded reduce_scatter delivery vs the gather-bound
+    all_gather it replaces, at 8 and 16 devices — the gather rows go
+    FLAT with mesh width, the band rows keep growing) and the
+    host-sharded-construction rows (peak DRIVER-HOST build memory before
+    vs after mesh.put_rows / build_topology rows=). The ms/round cells
+    stay 'pending' until an on-chip regen (the BENCH_TABLES
+    measured-on-CPU caveat protocol); everything else in this section is
+    computed, not claimed."""
     sys.path.insert(0, str(REPO))
     import jax
 
@@ -154,9 +174,10 @@ def render_ceilings(n_dev: int = 8) -> str:
         plan_pool2_sharded,
     )
 
-    def cfg(n, alg):
+    def cfg(n, alg, nd, wire="auto"):
         return SimConfig(n=n, topology="full", algorithm=alg,
-                         engine="fused", delivery="pool", n_devices=n_dev)
+                         engine="fused", delivery="pool", n_devices=nd,
+                         pool2_wire=wire)
 
     rows = []
     for alg in ("gossip", "push-sum"):
@@ -164,40 +185,103 @@ def render_ceilings(n_dev: int = 8) -> str:
         for g in range(600, 1200, 8):  # cubes bracketing 2^28..2^30
             n = g ** 3
             plan = plan_imp_hbm_sharded_shape(
-                "imp3d", n, cfg(n, alg), n_dev
+                "imp3d", n, cfg(n, alg, n_dev), n_dev
             )
             if not isinstance(plan, str):
                 best = (g, n)
         rows.append((
-            "imp × HBM × sharded", "imp3d", alg,
+            "imp × HBM × sharded", "imp3d", alg, f"{n_dev} dev",
             "none admitted in the swept range" if best is None else
             f"{best[0]}³ = {best[1]:,} ({best[1] / (1 << 28):.2f} × 2^28)",
         ))
-    for alg in ("gossip", "push-sum"):
-        hi = None
-        for p in range(27, 33):
-            n = 1 << p
-            plan = plan_pool2_sharded(build_full(n, False), cfg(n, alg),
-                                      n_dev)
-            if not isinstance(plan, str):
-                hi = p
-        rows.append((
-            "replicated-pool2", "full", alg,
-            "none admitted in the swept range" if hi is None else
-            f"2^{hi} = {1 << hi:,}",
-        ))
+    for wire in ("all_gather", "reduce_scatter"):
+        for nd in (n_dev, 2 * n_dev):
+            for alg in ("gossip", "push-sum"):
+                hi = None
+                for p in range(27, 35):
+                    n = 1 << p
+                    plan = plan_pool2_sharded(
+                        build_full(n, False), cfg(n, alg, nd, wire), nd
+                    )
+                    if not isinstance(plan, str):
+                        hi = p
+                rows.append((
+                    f"replicated-pool2 ({wire})", "full", alg, f"{nd} dev",
+                    "none admitted in the swept range" if hi is None else
+                    f"2^{hi} = {1 << hi:,}",
+                ))
     lines = [
-        f"## Topology ceilings (plan-level, {n_dev} devices — "
-        "benchmarks/trend.py --ceilings)",
+        CEILINGS_HEADER,
         "",
-        "| composition | topology | algorithm "
+        f"Plan-level aggregate population ceilings (base mesh {n_dev} "
+        "devices; the replicated-pool2 rows sweep both delivery wires and "
+        "two mesh widths — the all_gather rows are GATHER-BOUND and go "
+        "flat, the ISSUE 15 banded reduce_scatter rows keep growing with "
+        "the mesh). Computed from the pure plan functions on this box "
+        "(hardware-free); ms/round cells are measured-on-chip only and "
+        "stay pending until a TPU regen.",
+        "",
+        "| composition | topology | algorithm | mesh "
         "| aggregate plan ceiling | ms/round on chip |",
-        "|---|---|---|---|---|",
+        "|---|---|---|---|---|---|",
     ]
-    for comp, topo, alg, ceil in rows:
-        lines.append(f"| {comp} | {topo} | {alg} | {ceil} | pending |")
+    for comp, topo, alg, mesh, ceil in rows:
+        lines.append(
+            f"| {comp} | {topo} | {alg} | {mesh} | {ceil} | pending |"
+        )
+    lines += _host_build_ceiling_lines(n_dev)
     lines.append("")
     return "\n".join(lines)
+
+
+def _host_build_ceiling_lines(n_dev: int) -> list:
+    """Host-sharded-construction ceiling rows (ISSUE 15): peak DRIVER-HOST
+    memory on the build path, before (global to_planes + init_state /
+    global adjacency) vs after (mesh.put_rows per-shard callbacks +
+    build_topology rows= slices), with the largest population a 16 GiB
+    driver host can even BUILD under each. Byte models are per-node build
+    peaks read off the code paths; the after-column is pinned by the
+    allocation tracker in tests/test_hostmem.py (no global-N intermediate
+    on the sharded build path)."""
+    host_gib = 16
+    budget = host_gib << 30
+    # (label, legacy peak bytes/node, sharded peak bytes/node-equivalent)
+    # Legacy peaks: canonical init_state + the padded to_planes copies
+    # both alive at hand-off (pool2 push-sum 13+12, gossip 6+8; hbm
+    # push-sum 13+16), torus3d adjacency = [n,6] i32 + stack transient +
+    # degree. Host-sharded peaks: one per-device shard block at a time
+    # (plane bytes / n_dev); the adjacency drops to ZERO (spec-only
+    # build, analytic offsets).
+    models = [
+        ("replicated-pool2 state planes (push-sum)", 25.0, 12.0 / n_dev),
+        ("replicated-pool2 state planes (gossip)", 14.0, 8.0 / n_dev),
+        ("HBM × sharded state planes (push-sum)", 29.0, 16.0 / n_dev),
+        ("torus3d adjacency build", 52.0, 0.0),
+    ]
+    lines = [
+        "",
+        f"Host-sharded construction (ISSUE 15): peak build memory on a "
+        f"{host_gib} GiB driver host, legacy global build vs "
+        "mesh.put_rows / build_topology rows= at "
+        f"{n_dev} shards (allocation-tracked in tests/test_hostmem.py).",
+        "",
+        "| build path | legacy peak (per node) | legacy host bound "
+        "| host-sharded peak (per node) | host-sharded bound |",
+        "|---|---|---|---|---|",
+    ]
+
+    def bound(bytes_per_node):
+        if bytes_per_node == 0.0:
+            return "unbounded (spec-only build)"
+        b = int(budget / bytes_per_node)
+        return f"~2^{b.bit_length() - 1} ({b / (1 << 30):.2f} × 2^30)"
+
+    for label, legacy, sharded in models:
+        lines.append(
+            f"| {label} | {legacy:.0f} B | {bound(legacy)} "
+            f"| {sharded:.1f} B | {bound(sharded)} |"
+        )
+    return lines
 
 
 def render_matmul_tier() -> str:
@@ -275,14 +359,20 @@ def render_matmul_tier() -> str:
 def apply_to_bench_tables(table_md: str, bench_tables: Path,
                           header: str = SECTION_HEADER) -> None:
     """Idempotently install/replace one generated section: everything
-    from ``header`` to the next '## ' heading (or EOF) is replaced."""
+    from ``header`` to the next '## ' heading (or EOF) is replaced, with
+    exactly one blank line left before the next heading — so repeated
+    applies are byte-stable (the ISSUE 15 idempotence pin caught the old
+    form eating the separator on every second apply)."""
     text = bench_tables.read_text()
     if header in text:
         start = text.index(header)
         rest = text[start + len(header):]
         nxt = rest.find("\n## ")
-        end = len(text) if nxt < 0 else start + len(header) + nxt + 1
-        text = text[:start] + table_md + text[end:]
+        if nxt < 0:
+            text = text[:start] + table_md
+        else:
+            end = start + len(header) + nxt + 1
+            text = text[:start] + table_md + "\n" + text[end:]
     else:
         if not text.endswith("\n"):
             text += "\n"
@@ -352,15 +442,17 @@ def main(argv=None) -> int:
 
     table = render(revs, serving)
     matmul_md = render_matmul_tier() if args.matmul_tier else None
-    # The ceilings section rides the printed/--md output only: --apply
-    # replaces BENCH_TABLES.md's trajectory section up to the next "## "
-    # heading, so appending another "## " section to its input would
-    # break the replace's idempotency (BENCH_TABLES keeps its own
-    # hand-annotated ceilings section). The matmul-tier section has its
-    # OWN header and its own idempotent apply, so it composes.
+    # Each generated section has its OWN "## " header and its own
+    # idempotent apply (everything from the header to the next "## "
+    # heading is replaced), so trajectory, ceilings and matmul-tier
+    # compose — and a bare --apply preserves every previously applied
+    # section it does not regenerate (the PR 9 pin-preservation rule,
+    # extended to the ceilings section by ISSUE 15;
+    # tests/test_obs.py pins the idempotence).
+    ceilings_md = render_ceilings() if args.ceilings else None
     out = table
-    if args.ceilings:
-        out = out + "\n" + render_ceilings()
+    if ceilings_md is not None:
+        out = out + "\n" + ceilings_md
     if matmul_md is not None:
         out = out + "\n" + matmul_md
     print(out)
@@ -368,6 +460,11 @@ def main(argv=None) -> int:
         args.md.write_text(out + "\n")
     if args.apply:
         apply_to_bench_tables(table, args.root / "BENCH_TABLES.md")
+        if ceilings_md is not None:
+            apply_to_bench_tables(
+                ceilings_md, args.root / "BENCH_TABLES.md",
+                header=CEILINGS_HEADER,
+            )
         if matmul_md is not None:
             apply_to_bench_tables(
                 matmul_md, args.root / "BENCH_TABLES.md",

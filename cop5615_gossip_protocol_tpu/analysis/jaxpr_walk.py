@@ -121,24 +121,42 @@ def iter_eqns(jaxpr, in_body: bool = False) -> Iterator[tuple]:
 
 def collect_collectives(jaxpr) -> dict:
     """Count collective primitives (and remote DMAs) by region over one
-    closed/open jaxpr: {"body": {prim: {"count", "bytes"}}, "setup": ...}.
-    """
+    closed/open jaxpr:
+    {"body": {prim: {"count", "bytes", "bytes_out"}}, "setup": ...}.
+
+    ``bytes`` sums the operand avals (what each device feeds the wire),
+    ``bytes_out`` the RESULT avals — the per-device received payload,
+    which is the honest measure for asymmetric collectives: an
+    all_gather's input is one shard but every device receives the full
+    n_dev-wide copy, while a reduce_scatter's input is the full-width
+    contribution buffer but each device receives only its own shard.
+    The replicated-pool2 O(N) -> O(N/P + margins) wire delta (ISSUE 15)
+    is a bytes_out delta; benchmarks/comm_audit.py reports the column."""
     counts = {"body": {}, "setup": {}}
 
     def visit(eqn, in_body):
         name = eqn.primitive.name
         if name in COLLECTIVE_PRIMS:
             region = counts["body" if in_body else "setup"]
-            slot = region.setdefault(name, {"count": 0, "bytes": 0})
+            slot = region.setdefault(
+                name, {"count": 0, "bytes": 0, "bytes_out": 0}
+            )
             slot["count"] += 1
             slot["bytes"] += sum(aval_bytes(v.aval) for v in eqn.invars)
+            slot["bytes_out"] += sum(
+                aval_bytes(v.aval) for v in eqn.outvars
+            )
         elif name == "dma_start":
             remote, size = remote_dma_info(eqn)
             if remote:
                 region = counts["body" if in_body else "setup"]
-                slot = region.setdefault(REMOTE_DMA, {"count": 0, "bytes": 0})
+                slot = region.setdefault(
+                    REMOTE_DMA, {"count": 0, "bytes": 0, "bytes_out": 0}
+                )
                 slot["count"] += 1
                 slot["bytes"] += size
+                # A remote copy's received payload is the copy itself.
+                slot["bytes_out"] += size
 
     walk(jaxpr, visit)
     return counts

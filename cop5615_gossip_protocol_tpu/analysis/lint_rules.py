@@ -343,10 +343,54 @@ def check_refusals(runner_path: Path | None = None) -> list[Finding]:
     return findings
 
 
+def check_multiprocess_refusals(parallel_dir: Path | None = None) -> list[Finding]:
+    """ISSUE 15 extension of the refusal rule to the multi-process
+    support matrix: a composition's plan/support function that refuses a
+    MULTI-PROCESS mesh (a returned static reason mentioning
+    'single-process' or 'multi-process') must name a real serving
+    composition — the runner's combined refusal interpolates these plan
+    reasons verbatim, so a dead-end here is a dead-end for the user
+    exactly like a runner-ladder one."""
+    pdir = parallel_dir or (PACKAGE_ROOT / "parallel")
+    tokens = _composition_tokens()
+    findings = []
+    for path in sorted(pdir.glob("*.py")):
+        rel = str(path.relative_to(path.parents[2]))
+        tree = ast.parse(path.read_text(), filename=rel)
+        for fn in ast.walk(tree):
+            if not (isinstance(fn, ast.FunctionDef) and (
+                fn.name.startswith("plan_") or fn.name.endswith("_support")
+            )):
+                continue
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Return)
+                        and node.value is not None):
+                    continue
+                text, _dyn = _static_text(node.value, {}, set())
+                if "single-process" not in text and (
+                    "multi-process" not in text
+                ):
+                    continue
+                if not any(t in text for t in tokens):
+                    findings.append(Finding(
+                        checker="lint",
+                        where=f"{rel}::{fn.name}:{node.lineno}",
+                        rule="refusal-dead-end",
+                        detail=(
+                            "multi-process plan refusal names no real "
+                            "serving composition — tell the caller which "
+                            "composition serves multi-process meshes "
+                            "instead of dead-ending"
+                        ),
+                    ))
+    return findings
+
+
 def run_lints(root: Path | None = None) -> list[Finding]:
-    """All three lint families over the real tree."""
+    """All four lint families over the real tree."""
     out = check_host_conversions(root)
     out += check_schema_lockstep(root)
     if root is None:
         out += check_refusals()
+        out += check_multiprocess_refusals()
     return out

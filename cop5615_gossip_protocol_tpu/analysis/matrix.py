@@ -68,9 +68,19 @@ AUDIT_GRID = (
      {"engine": "fused", "delivery": "pool", "halo_dma": "on"}),
     ("imp-hbm-sharded", "imp3d", "push-sum", 27000, 2,
      {"engine": "fused", "delivery": "pool", "halo_dma": "on"}),
+    # pool2_wire auto resolves per mesh width: the gather wire at 2
+    # devices (pool_size 4 >= mesh — every band would exceed the full
+    # copy), the banded reduce_scatter wire at 8 (ISSUE 15 — one banded
+    # collective per pool slot + one margin ppermute volley, O(N/P +
+    # margins) received bytes; the recv-bytes delta vs the gather rows
+    # is pinned in tests/test_comm_audit.py).
     ("pool2-sharded", "full", "gossip", 262144, 2,
      {"engine": "fused", "delivery": "pool"}),
     ("pool2-sharded", "full", "push-sum", 262144, 2,
+     {"engine": "fused", "delivery": "pool"}),
+    ("pool2-sharded", "full", "gossip", 262144, 8,
+     {"engine": "fused", "delivery": "pool"}),
+    ("pool2-sharded", "full", "push-sum", 262144, 8,
      {"engine": "fused", "delivery": "pool"}),
     # MXU matmul tier (ISSUE 12): the per-shard one-hot blend after the
     # one all_gather — the SAME WIRE_SPEC as the pool rows must hold

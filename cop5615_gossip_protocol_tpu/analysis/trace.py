@@ -102,16 +102,29 @@ class AuditReport:
     def body_bytes(self, prim: str) -> int:
         return self.counts["body"].get(prim, {}).get("bytes", 0)
 
+    def body_bytes_out(self, prim: str) -> int:
+        """Per-device RECEIVED payload bytes (the collective's output
+        avals — what actually lands in each device's memory per
+        round/super-step): an all_gather's output is the n_dev-wide full
+        copy, a reduce_scatter's only the local shard, which is exactly
+        the O(N) -> O(N/P + margins) delta the replicated-pool2 band wire
+        claims (ISSUE 15)."""
+        return self.counts["body"].get(prim, {}).get("bytes_out", 0)
+
     def halo_mechanism(self) -> str:
         """How this composition's halo/delivery bytes move between
         devices, decided from the counted program — never from config:
         in-kernel-dma (Pallas async remote copies, zero XLA collectives
-        on the halo path), xla-ppermute (halo boundary wires),
-        all-gather (the pool composition's plane gather), scatter
-        (reduce_scatter fallback), or none (no inter-device delivery in
-        the body)."""
+        on the halo path), reduce-scatter (the replicated-pool2 band
+        wire: banded reduce_scatters plus their margin ppermute volley),
+        xla-ppermute (halo boundary wires), all-gather (the pool
+        composition's plane gather), scatter (the chunked engine's
+        psum_scatter fallback — reduce_scatter with NO margin ppermute),
+        or none (no inter-device delivery in the body)."""
         if self.body_count(REMOTE_DMA):
             return "in-kernel-dma"
+        if self.body_count("reduce_scatter") and self.body_count("ppermute"):
+            return "reduce-scatter"
         if self.body_count("ppermute"):
             return "xla-ppermute"
         if self.body_count("all_gather"):

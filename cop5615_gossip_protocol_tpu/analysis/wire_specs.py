@@ -20,14 +20,18 @@ Count term language — ``C`` is a linear form over the wire environment:
 
     expected = fixed + per_plane*planes + per_window*windows
              + per_class*classes + per_pair*disp_pairs + per_roll*rolls
+             + per_slot*slots + per_wslot*wslots
 
 where ``planes`` = state planes (gossip 3: count/active/conv; push-sum 4:
 s/w/term/conv), ``windows`` = batched send-summary windows (gossip 1,
 push-sum 2), ``classes`` = halo offset classes of the topology's exact
 plan, ``disp_pairs`` = round-invariant disp/deg exchange pairs
 (max_deg + 1), ``rolls`` = pool-roll ppermute count
-(pool_size * (log2(n_devices) + 1)). ``wire_env`` computes the environment
-from the same plan functions the engines call — never from the trace.
+(pool_size * (log2(n_devices) + 1)), ``slots`` = pool slots (pool_size —
+the replicated-pool2 reduce_scatter wire issues one banded collective
+per slot), ``wslots`` = windows x slots (its serial schedule's
+per-window-per-slot wires). ``wire_env`` computes the environment from
+the same plan functions the engines call — never from the trace.
 
 STRICTNESS: within a declared region, every collective class not named
 must count ZERO in the trace. "imp DMA mode keeps zero XLA collectives on
@@ -59,6 +63,10 @@ class C:
     per_class: int = 0
     per_pair: int = 0
     per_roll: int = 0
+    per_slot: int = 0
+    per_wslot: int = 0
+    per_slot_seg: int = 0
+    per_wslot_seg: int = 0
 
     def expected(self, env: Mapping[str, int]) -> int:
         return (
@@ -68,6 +76,10 @@ class C:
             + self.per_class * env.get("classes", 0)
             + self.per_pair * env.get("disp_pairs", 0)
             + self.per_roll * env.get("rolls", 0)
+            + self.per_slot * env.get("slots", 0)
+            + self.per_wslot * env.get("wslots", 0)
+            + self.per_slot_seg * env.get("slot_segs", 0)
+            + self.per_wslot_seg * env.get("wslot_segs", 0)
         )
 
 
@@ -152,6 +164,26 @@ def wire_env(engine: str, topo, cfg, n_devices: int) -> tuple[dict, str]:
         env["disp_pairs"] = int(topo.max_deg) + 1
     if engine in ("hbm-sharded", "imp-hbm-sharded"):
         return env, ("dma" if cfg.halo_dma == "on" else "wire")
+    if engine == "pool2-sharded":
+        env["slots"] = cfg.pool_size
+        env["wslots"] = windows * cfg.pool_size
+        wire = cfg.resolved_pool2_wire(n_devices)
+        # The plan demotes auto to the gather wire when the band margin
+        # cannot fit one ring neighbor; mirror it from the same plan
+        # function so declaration and dispatch cannot drift. The banded
+        # wire's per-round reduce_scatter count is slots x its SEGMENT
+        # count (parallel/halo.band_segments — the O(N/P)-operand
+        # discipline), from the same plan geometry.
+        from ..parallel.halo import band_segments
+        from ..parallel.pool2_sharded import plan_pool2_sharded
+
+        plan = plan_pool2_sharded(topo, cfg, n_devices)
+        if not isinstance(plan, str):
+            wire = plan[3]
+            n_seg = band_segments(plan[0], n_devices)
+            env["slot_segs"] = cfg.pool_size * n_seg
+            env["wslot_segs"] = windows * cfg.pool_size * n_seg
+        return env, ("rs" if wire == "reduce_scatter" else "gather")
     return env, "wire"
 
 

@@ -105,6 +105,19 @@ def build_parser() -> argparse.ArgumentParser:
                    "ppermute wire on CPU/interpret; on = force the DMA "
                    "kernel (TPU execution only); off = pin the XLA wire. "
                    "Bitwise transport-invariant trajectories")
+    p.add_argument("--pool2-wire",
+                   choices=["auto", "reduce_scatter", "all_gather"],
+                   default="auto",
+                   help="delivery wire of the replicated-pool2 "
+                   "composition: reduce_scatter = each device receives "
+                   "only the O(N/P) summary band its windows consume plus "
+                   "the pooled margins (one banded reduce_scatter per "
+                   "pool slot + one margin ppermute volley); all_gather = "
+                   "the full O(N) summary copy per device per round. "
+                   "auto (default) picks reduce_scatter when the mesh is "
+                   "wider than the pool. Bitwise-identical trajectories "
+                   "either way (pure wire packaging; "
+                   "tests/test_pool2_sharded.py)")
     p.add_argument("--replicas", type=int, default=1,
                    help="run this many replicas (distinct per-replica key "
                    "streams, replica 0 = the unbatched run) of the "
@@ -287,6 +300,7 @@ def _main_refsim(args, parser) -> int:
         "--pipeline-chunks": changed("pipeline_chunks"),
         "--overlap-collectives": changed("overlap_collectives"),
         "--halo-dma": changed("halo_dma"),
+        "--pool2-wire": changed("pool2_wire"),
         "--replicas": changed("replicas"),
         "--compile-cache": changed("compile_cache"),
         "--target-frac": changed("target_frac"),
@@ -470,6 +484,7 @@ def main(argv: Optional[list[str]] = None) -> int:
             pipeline_chunks=args.pipeline_chunks,
             overlap_collectives=args.overlap_collectives == "on",
             halo_dma=args.halo_dma,
+            pool2_wire=args.pool2_wire,
             target_frac=args.target_frac,
             suppress_converged=None if args.suppress == "auto" else args.suppress == "on",
             fault_rate=args.fault_rate,
@@ -709,6 +724,7 @@ def main(argv: Optional[list[str]] = None) -> int:
                       "pipeline_chunks": cfg.pipeline_chunks,
                       "overlap_collectives": cfg.overlap_collectives,
                       "halo_dma": cfg.halo_dma,
+                      "pool2_wire": cfg.pool2_wire,
                       "telemetry": cfg.telemetry,
                       "mass_tolerance": cfg.mass_tolerance,
                       "strict_engine": cfg.strict_engine}

@@ -295,6 +295,22 @@ class SimConfig:
     # Sharding: number of mesh devices for the node dimension; None/1 → single device.
     n_devices: int | None = None
 
+    # Delivery wire of the replicated-pool2 composition
+    # (parallel/pool2_sharded.py): "all_gather" replicates the compact
+    # windowed send summaries on every device each round — O(N) received
+    # bytes and resident copy per device, the gather-bound wall; "
+    # "reduce_scatter" delivers each device only the O(N/P) summary band
+    # its own windows consume plus the pooled margins (one banded
+    # reduce_scatter per pool slot + one margin ppermute volley) — a pure
+    # reorganization of who holds which rows, so trajectories are BITWISE
+    # the gather wire's (tests/test_pool2_sharded.py pins it at 2 and 4
+    # devices). "auto" (default) picks reduce_scatter when the mesh is
+    # wider than the pool (n_devices > pool_size — each band is then
+    # strictly smaller than the gathered copy) and the gather wire
+    # otherwise. Part of the serving compile class like halo_dma; resume
+    # accepts a changed value (pure wire packaging).
+    pool2_wire: str = "auto"
+
     # Vmapped replica sweep (models/sweep.py, --replicas): run this many
     # seeds of the configuration as lanes of ONE chunked program. 1 = the
     # plain single run. A config-level field (not just a CLI flag) so the
@@ -390,6 +406,11 @@ class SimConfig:
         if self.halo_dma not in ("auto", "on", "off"):
             raise ValueError(
                 f"unknown halo_dma {self.halo_dma!r}; expected auto|on|off"
+            )
+        if self.pool2_wire not in ("auto", "reduce_scatter", "all_gather"):
+            raise ValueError(
+                f"unknown pool2_wire {self.pool2_wire!r}; expected "
+                "auto|reduce_scatter|all_gather"
             )
         if self.stall_chunks < 0:
             raise ValueError("stall_chunks must be >= 0")
@@ -666,6 +687,20 @@ class SimConfig:
         if self.suppress_converged is not None:
             return self.suppress_converged
         return self.reference
+
+    def resolved_pool2_wire(self, n_devices: int) -> str:
+        """Delivery wire the replicated-pool2 composition runs on THIS
+        mesh: "auto" picks the banded reduce_scatter exactly when every
+        band is smaller than the gathered copy (n_devices > pool_size —
+        each device then receives P bands of ~R/n_devices rows instead of
+        the full R-row summary); explicit values force either wire (the
+        gather wire is the bitwise oracle the band wire is pinned
+        against)."""
+        if self.pool2_wire != "auto":
+            return self.pool2_wire
+        return (
+            "reduce_scatter" if n_devices > self.pool_size else "all_gather"
+        )
 
     def resolved_target_count(self, population: int, builder_target: int) -> int:
         """Number of converged nodes that ends the run."""

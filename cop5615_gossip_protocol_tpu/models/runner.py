@@ -851,10 +851,22 @@ def _finalize_result(
     # process, the whole `finalize` bucket wallwalk named (~149 ms on the
     # CPU stand-in — ISSUE 9 satellite); the reported numbers are
     # diagnostics (never trajectory state), computed in float64 now.
+    #
+    # EXCEPT when the mesh spans OS processes (jax.distributed,
+    # parallel/mesh.initialize_distributed): the state arrays are then
+    # not host-addressable and np.asarray would raise — every process
+    # instead runs the same GLOBAL jnp reductions (replicated scalar
+    # out, readable on each process), the ISSUE 15 multi-process path.
     import numpy as np
 
-    conv_np = np.asarray(state.conv)
-    converged_count = int(conv_np.sum())
+    addressable = getattr(state.conv, "is_fully_addressable", True)
+    if addressable:
+        conv_np = np.asarray(state.conv)
+        converged_count = int(conv_np.sum())
+    else:
+        converged_count = int(
+            jnp.sum((jnp.asarray(state.conv) != 0).astype(jnp.int32))
+        )
     converged = (converged_count >= target) if done is None else bool(done)
     if unhealthy_round is not None:
         # A tripped sentinel overrides everything: the state is corrupt (or
@@ -887,14 +899,29 @@ def _finalize_result(
         # w == 0 is reachable under rejoin='fresh' (revived nodes restart
         # weightless) and in unhealthy states — guard the ratio so the MAE
         # report never manufactures inf/NaN of its own.
-        s_np = np.asarray(state.s, dtype=np.float64)
-        w_np = np.asarray(state.w, dtype=np.float64)
-        w_safe = np.where(w_np != 0, w_np, 1.0)
-        ratio = np.where(w_np != 0, s_np / w_safe, 0.0)
         true_mean = (topo.n - 1) / 2.0
-        err = np.where(conv_np, np.abs(ratio - true_mean), 0.0)
+        if addressable:
+            s_np = np.asarray(state.s, dtype=np.float64)
+            w_np = np.asarray(state.w, dtype=np.float64)
+            w_safe = np.where(w_np != 0, w_np, 1.0)
+            ratio = np.where(w_np != 0, s_np / w_safe, 0.0)
+            err = np.where(conv_np, np.abs(ratio - true_mean), 0.0)
+            mae = float(err.sum() / max(converged_count, 1))
+        else:
+            # Process-spanning state: the same formula as a global jnp
+            # reduction (float64 via a local x64 scope — diagnostics
+            # only, never trajectory state).
+            with jax.experimental.enable_x64():
+                s_g = jnp.asarray(state.s).astype(jnp.float64)
+                w_g = jnp.asarray(state.w).astype(jnp.float64)
+                w_safe = jnp.where(w_g != 0, w_g, 1.0)
+                ratio = jnp.where(w_g != 0, s_g / w_safe, 0.0)
+                err = jnp.where(
+                    jnp.asarray(state.conv) != 0,
+                    jnp.abs(ratio - true_mean), 0.0,
+                )
+                mae = float(jnp.sum(err)) / max(converged_count, 1)
         result.true_mean = true_mean
-        mae = float(err.sum() / max(converged_count, 1))
         import math
 
         result.estimate_mae = mae if math.isfinite(mae) else None
@@ -1382,6 +1409,20 @@ def _run_resolved(
     t_enter = time.perf_counter()  # setup_s bracket start (RunResult)
     if key is None:
         key = jax.random.PRNGKey(cfg.seed)
+    if topo.partial and not (
+        cfg.engine == "fused"
+        and cfg.n_devices is not None
+        and cfg.n_devices > 1
+    ):
+        raise ValueError(
+            "a host-sharded topology build (build_topology rows=...) "
+            "carries only its own adjacency row slice; it serves the "
+            "offset-structured fused sharded compositions only "
+            "(engine='fused', n_devices > 1 — they read the analytic "
+            "displacement classes, never a neighbor row). The chunked/"
+            "single-device engines gather whole neighbor tensors — build "
+            "the full adjacency (rows=None) for them"
+        )
     if cfg.n_devices is not None and cfg.n_devices > 1:
         if cfg.reference and cfg.algorithm == "push-sum":
             raise ValueError(

@@ -140,8 +140,12 @@ def _lattice_params(topo: Topology):
     """
     n = topo.n
     # The reference-mode extra node is always the last index, degree 0.
+    # A host-sharded (partial) build is batched-semantics by construction
+    # (ops/topology._build_rows rejects reference mode), so its row slice
+    # never carries the Q1 extra — and may not even include the last row.
     n_lat = n - 1 if (
-        topo.degree is not None and n > 0 and int(topo.degree[-1]) == 0
+        topo.degree is not None and not topo.partial
+        and topo.degree.size > 0 and int(topo.degree[-1]) == 0
     ) else n
     i32 = jnp.int32
 

@@ -54,24 +54,98 @@ class Topology:
     n_requested: int
     target_count: int
     max_deg: int
-    neighbors: Optional[np.ndarray]  # [n, max_deg] int32, rows padded with 0
-    degree: Optional[np.ndarray]  # [n] int32
+    neighbors: Optional[np.ndarray]  # [rows, max_deg] int32, padded with 0
+    degree: Optional[np.ndarray]  # [rows] int32
+    # Host-sharded construction (ISSUE 15): (lo, hi) when neighbors/degree
+    # cover only global rows [lo, hi) — build_topology(..., rows=(lo, hi))
+    # materializes just that slice, O(hi - lo) host memory, so a 2^30
+    # build never exists whole on one host. None = the full build. A
+    # rows=(0, 0) "spec-only" topology carries the kind/population/target
+    # and an empty adjacency slice — exactly what the offset-structured
+    # sharded compositions consume (they read stencil_offsets, never a
+    # neighbor row).
+    rows_built: Optional[tuple] = None
 
     @property
     def implicit(self) -> bool:
         return self.neighbors is None
 
+    @property
+    def partial(self) -> bool:
+        """True when the adjacency covers only a row slice (host-sharded
+        build); consumers that gather whole neighbor tensors must refuse
+        such a topology, offset-only consumers need not care."""
+        return self.rows_built is not None and self.rows_built != (0, self.n)
+
     def validate(self) -> None:
         if self.implicit:
             return
-        assert self.neighbors.shape == (self.n, self.max_deg)
-        assert self.degree.shape == (self.n,)
+        lo, hi = self.rows_built if self.rows_built is not None else (0, self.n)
+        assert 0 <= lo <= hi <= self.n
+        assert self.neighbors.shape == (hi - lo, self.max_deg)
+        assert self.degree.shape == (hi - lo,)
         assert self.neighbors.dtype == np.int32 and self.degree.dtype == np.int32
         assert (self.degree >= 0).all() and (self.degree <= self.max_deg).all()
-        # Every in-degree slot must index a real node.
+        # Every in-degree slot must index a real node (globally).
         cols = np.arange(self.max_deg)[None, :]
         live = cols < self.degree[:, None]
         assert (self.neighbors[live] >= 0).all() and (self.neighbors[live] < self.n).all()
+
+
+def kind_offsets(kind: str, n_requested: int) -> Optional[np.ndarray]:
+    """ANALYTIC modular displacement classes for the arithmetic lattice
+    kinds, honest (batched) semantics — the same sorted-unique
+    ``(neighbor - node) mod pop`` set ``stencil_offsets`` scans out of a
+    materialized adjacency, computed in O(kinds) from the builder's own
+    geometry instead of O(N * deg) over a neighbor tensor. This is what
+    lets a host-SHARDED build (``build_topology(..., rows=...)``) serve
+    the offset-structured sharded compositions without any host ever
+    materializing the global adjacency (ISSUE 15); equality with the
+    adjacency scan is pinned per kind across a size sweep in
+    tests/test_hostmem.py. None for kinds with no arithmetic
+    displacement structure (full is implicit; imp kinds carry random
+    long-range edges; the builder rng is sequential anyway)."""
+    if kind == "full" or kind in ("imp2d", "imp3d"):
+        return None
+    cands: list[int] = []
+    if kind in ("line", "ring", "ref2d"):
+        if kind == "ref2d":
+            side = math.ceil(math.sqrt(n_requested))
+            pop = side * side
+        else:
+            pop = n_requested
+        cands = [1, pop - 1]
+    elif kind == "grid2d":
+        side = math.ceil(math.sqrt(n_requested))
+        pop = side * side
+        cands = [1, pop - 1, side, pop - side]
+    elif kind == "grid3d":
+        g = _cube_side(n_requested)
+        pop = g**3
+        cands = [m * s % pop for m in (1, g, g * g) for s in (1, pop - 1)]
+    elif kind == "torus3d":
+        if n_requested < 8:
+            raise ValueError(
+                "torus3d needs at least 8 nodes (cube side >= 2)"
+            )
+        g = _cube_side(n_requested, min_side=2)
+        pop = g**3
+        # Per axis (multiplier m in {1, g, g^2}): interior steps +-m and
+        # the wrap edges' +-m*(g-1) — which coincide with -+m*... at
+        # small g; np.unique collapses the duplicates exactly like the
+        # adjacency scan does.
+        cands = [
+            m * s % pop
+            for m in (1, g, g * g)
+            for s in (1, pop - 1, g - 1, pop - (g - 1))
+        ]
+    else:
+        return None
+    if pop < 2:
+        return None
+    offs = np.unique(np.asarray(cands, dtype=np.int64) % pop)
+    offs = offs[offs != 0]
+    return offs.astype(np.int32) if offs.size else None
 
 
 def stencil_offsets(topo: Topology, max_offsets: int = 16) -> Optional[np.ndarray]:
@@ -94,6 +168,15 @@ def stencil_offsets(topo: Topology, max_offsets: int = 16) -> Optional[np.ndarra
     """
     if topo.implicit or topo.n < 2:
         return None
+    if topo.partial:
+        # Host-sharded build (ISSUE 15): the adjacency slice cannot see
+        # every displacement class, so the offsets come from the analytic
+        # per-kind derivation — pinned equal to this function's scan over
+        # the full build in tests/test_hostmem.py.
+        offs = kind_offsets(topo.kind, topo.n_requested)
+        if offs is None or offs.size > max_offsets:
+            return None
+        return offs
     cols = np.arange(topo.max_deg)[None, :]
     live = cols < topo.degree[:, None]
     ids = np.arange(topo.n, dtype=np.int64)[:, None]
@@ -408,9 +491,184 @@ _BUILDERS = {
 }
 
 
-def build_topology(kind: str, n: int, *, seed: int = 0, semantics: str = "batched") -> Topology:
+# Below this population the row-range path just builds the full adjacency
+# and slices it — degenerate small-geometry cases (side/g < 3 change
+# max_deg) stay exactly the full builder's, and the O(N) transient is
+# trivial at this size. Above it the ranged builders construct rows
+# [lo, hi) directly, O(hi - lo) host memory.
+_RANGED_FALLBACK_POP = 1 << 14
+
+
+def _ranged_slice(kind: str, pop: int, lo: int, hi: int, n: int) -> Topology:
+    """Rows [lo, hi) of one arithmetic lattice kind, built directly —
+    never materializing the other rows. Row slot ORDER replicates the
+    full builders exactly (the compact append order of _pack rows), so a
+    ranged build concatenated over a partition of [0, pop) is
+    byte-identical to the full build (pinned in tests/test_hostmem.py)."""
+    count = hi - lo
+    if kind in ("line", "ref2d"):
+        nbr = np.zeros((count, 2), np.int32)
+        deg = np.full((count,), 2, np.int32)
+        ids = np.arange(lo, hi, dtype=np.int32)
+        nbr[:, 0] = ids - 1
+        nbr[:, 1] = ids + 1
+        if count and lo == 0:
+            nbr[0] = (1, 0)
+            deg[0] = 1
+        if count and hi == pop:
+            nbr[-1] = (pop - 2, 0)
+            deg[-1] = 1
+        return Topology(kind, pop, n, pop, 2, nbr, deg, rows_built=(lo, hi))
+    if kind == "ring":
+        ids = np.arange(lo, hi, dtype=np.int64)
+        nbr = np.stack([(ids - 1) % pop, (ids + 1) % pop], axis=1)
+        deg = np.full((count,), 2, np.int32)
+        return Topology(
+            kind, pop, n, pop, 2, nbr.astype(np.int32), deg,
+            rows_built=(lo, hi),
+        )
+    if kind == "torus3d":
+        g = _cube_side(n, min_side=2)
+        z_mul = g * g
+        idx = np.arange(lo, hi)
+        x = idx % g
+        y = (idx // g) % g
+        z = idx // z_mul
+        nbr = np.stack(
+            [
+                z * z_mul + y * g + (x - 1) % g,
+                z * z_mul + y * g + (x + 1) % g,
+                z * z_mul + ((y - 1) % g) * g + x,
+                z * z_mul + ((y + 1) % g) * g + x,
+                ((z - 1) % g) * z_mul + y * g + x,
+                ((z + 1) % g) * z_mul + y * g + x,
+            ],
+            axis=1,
+        ).astype(np.int32)
+        deg = np.full((count,), 6, np.int32)
+        return Topology(kind, pop, n, pop, 6, nbr, deg, rows_built=(lo, hi))
+    if kind == "grid2d":
+        side = math.ceil(math.sqrt(n))
+        rows = []
+        for i in range(lo, hi):
+            y, x = divmod(i, side)
+            r = []
+            if x > 0:
+                r.append(i - 1)
+            if x < side - 1:
+                r.append(i + 1)
+            if y > 0:
+                r.append(i - side)
+            if y < side - 1:
+                r.append(i + side)
+            rows.append(r)
+        return _pack_slice(rows, kind, n, pop, 4, lo, hi)
+    if kind == "grid3d":
+        g = _cube_side(n)
+        z_mul = g * g
+        rows = []
+        for i in range(lo, hi):
+            z, rem = divmod(i, z_mul)
+            y, x = divmod(rem, g)
+            r = []
+            if x > 0:
+                r.append(i - 1)
+            if x < g - 1:
+                r.append(i + 1)
+            if y > 0:
+                r.append(i - g)
+            if y < g - 1:
+                r.append(i + g)
+            if z > 0:
+                r.append(i - z_mul)
+            if z < g - 1:
+                r.append(i + z_mul)
+            rows.append(r)
+        return _pack_slice(rows, kind, n, pop, 6, lo, hi)
+    raise AssertionError(f"unreachable ranged kind {kind!r}")
+
+
+def _pack_slice(rows: list, kind: str, n: int, pop: int, max_deg: int,
+                lo: int, hi: int) -> Topology:
+    neighbors = np.zeros((hi - lo, max_deg), dtype=np.int32)
+    degree = np.zeros((hi - lo,), dtype=np.int32)
+    for i, r in enumerate(rows):
+        degree[i] = len(r)
+        neighbors[i, : len(r)] = r
+    topo = Topology(
+        kind, pop, n, pop, max_deg, neighbors, degree, rows_built=(lo, hi)
+    )
+    topo.validate()
+    return topo
+
+
+def _build_rows(kind: str, n: int, seed: int, semantics: str,
+                rows: tuple) -> Topology:
+    """Host-sharded construction (ISSUE 15): only global rows [lo, hi) of
+    the adjacency are ever materialized. ``rows=(0, 0)`` yields a
+    SPEC-ONLY topology (population/target/offset structure, empty
+    adjacency slice) — all the offset-structured sharded compositions
+    consume."""
+    if semantics == "reference":
+        raise ValueError(
+            "host-sharded construction (rows=) serves batched semantics "
+            "only — reference mode is a small-N validation path; build "
+            "the full adjacency"
+        )
+    if kind in ("imp2d", "imp3d"):
+        raise ValueError(
+            "imp kinds draw their random long-range edges from a "
+            "sequential host rng — a row-range build would change the "
+            "topology; build the full adjacency (rows=None)"
+        )
+    if kind not in _BUILDERS:
+        raise ValueError(f"unknown topology kind {kind!r}")
+    if kind == "full":
+        # Implicit: there is no adjacency to shard — the normal build is
+        # already O(1) host memory.
+        return build_full(n, False)
+    # Population exactly as the full builder would round it.
+    if kind in ("line", "ring"):
+        pop = n
+    elif kind in ("grid2d", "ref2d"):
+        pop = math.ceil(math.sqrt(n)) ** 2
+    elif kind == "grid3d":
+        pop = _cube_side(n) ** 3
+    elif kind == "torus3d":
+        if n < 8:
+            raise ValueError(
+                "torus3d needs at least 8 nodes (cube side >= 2)"
+            )
+        pop = _cube_side(n, min_side=2) ** 3
+    lo, hi = rows
+    if not (0 <= lo <= hi <= pop):
+        raise ValueError(
+            f"rows=({lo}, {hi}) out of range for the {pop}-node build"
+        )
+    if pop <= _RANGED_FALLBACK_POP:
+        full = _BUILDERS[kind](n, 0, False)
+        sliced = dataclasses.replace(
+            full,
+            neighbors=full.neighbors[lo:hi].copy(),
+            degree=full.degree[lo:hi].copy(),
+            rows_built=(lo, hi),
+        )
+        sliced.validate()
+        return sliced
+    return _ranged_slice(kind, pop, lo, hi, n)
+
+
+def build_topology(kind: str, n: int, *, seed: int = 0,
+                   semantics: str = "batched",
+                   rows: Optional[tuple] = None) -> Topology:
     """Dispatch to a builder — the TPU-native analog of the `match topology`
-    at program.fs:150, as a pure function instead of a side-effecting script."""
+    at program.fs:150, as a pure function instead of a side-effecting
+    script. ``rows=(lo, hi)`` builds only that global row slice of the
+    adjacency (host-sharded construction, ISSUE 15): O(hi - lo) host
+    memory, byte-identical rows, analytic ``stencil_offsets``; arithmetic
+    lattice kinds + full only, batched semantics only."""
+    if rows is not None:
+        return _build_rows(kind, n, seed, semantics, rows)
     if kind not in _BUILDERS:
         raise ValueError(f"unknown topology kind {kind!r}")
     return _BUILDERS[kind](n, seed, semantics == "reference")

@@ -1136,6 +1136,18 @@ def run_stencil_hbm_sharded(
     halo path — benchmarks/comm_audit.py pins it); CPU/interpret backends
     keep the batched-ppermute wire. Bitwise transport-invariant.
 
+    Fresh starts build their state planes HOST-SHARDED (ISSUE 15,
+    parallel/mesh.put_rows — each process materializes only its own
+    devices' rows; tests/test_hostmem.py pins no global-N intermediate),
+    and a SPEC-ONLY topology (build_topology rows=(0, 0)) suffices: the
+    composition reads the analytic displacement classes, never a
+    neighbor row. The mesh may span OS processes
+    (parallel/mesh.initialize_distributed): placement goes through the
+    process-safe parallel/mesh.put_global path, and under a
+    multi-process mesh the VMEM composition's plan refuses so the
+    dispatch routes HERE at any population
+    (tests/test_multiprocess.py pins the gloo runs bitwise).
+
     ``probe(chunk_sharded, args)``, when given, receives the jitted chunk
     program and example arguments and its return value replaces the run
     (benchmarks/comm_audit.py's trace hook — no execution happens)."""
@@ -1149,6 +1161,7 @@ def run_stencil_hbm_sharded(
     from ..ops import sampling
     from ..ops.fused import round_keys
     from . import halo as halo_mod
+    from . import mesh as mesh_mod
     from . import overlap as overlap_mod
     from .fused_sharded import global_verdict_step
     from .mesh import NODE_AXIS, make_mesh
@@ -1207,17 +1220,58 @@ def run_stencil_hbm_sharded(
             outs.append(full.reshape(R_glob, LANES))
         return tuple(outs)
 
-    if start_state is not None:
-        st0 = jax.tree.map(np.asarray, start_state)
-    elif pushsum:
-        st0 = pushsum_mod.init_state(n, jnp.float32, cfg.initial_term_round)
-    else:
-        st0 = gossip_mod.init_state(
-            n, draw_leader(key, topo, cfg),
-            leader_counts_receipt=cfg.reference and topo.kind == "full",
+    def fresh_planes_sharded():
+        """Host-SHARDED fresh-start planes (ISSUE 15): every plane is a
+        pure function of the global row index (push-sum s_i = i, w = 1,
+        term = initial; gossip all-zero but the drawn leader), so each
+        process materializes ONLY its own devices' rows through
+        mesh.put_rows — no canonical state and no global-N host array on
+        the build path (tests/test_hostmem.py pins it). Values are
+        exactly to_planes(init_state(...))'s, bitwise."""
+        shp = (R_glob, LANES)
+        flat_ids = mesh_mod.flat_id_rows(LANES)
+
+        def const_rows(value, dt):
+            return mesh_mod.const_row_builder(value, dt, LANES)
+
+        if pushsum:
+            term0 = cfg.initial_term_round
+
+            def s_rows(lo, hi):
+                ids = flat_ids(lo, hi)
+                return np.where(ids < n, ids, 0).astype(np.float32)
+
+            builders = (
+                (np.float32, s_rows),
+                (np.float32, const_rows(1.0, np.float32)),
+                (np.int32, const_rows(term0, np.int32)),
+                (np.int32, const_rows(0, np.int32)),
+            )
+        else:
+            leader = int(draw_leader(key, topo, cfg))
+
+            def act_rows(lo, hi):
+                return (flat_ids(lo, hi) == leader).astype(np.int32)
+
+            builders = (
+                (np.int32, const_rows(0, np.int32)),
+                (np.int32, act_rows),
+                (np.int32, const_rows(0, np.int32)),
+            )
+        return tuple(
+            mesh_mod.put_rows(shard_rows, shp, dt, fn)
+            for dt, fn in builders
         )
-    planes0 = tuple(jax.device_put(p, shard_rows) for p in to_planes(st0))
-    done0 = bool(np.asarray(st0.conv).sum() >= target)
+
+    if start_state is None:
+        planes0 = fresh_planes_sharded()
+        done0 = bool(0 >= target)  # fresh conv plane is all-false
+    else:
+        st0 = jax.tree.map(np.asarray, start_state)
+        planes0 = tuple(
+            mesh_mod.put_global(p, shard_rows) for p in to_planes(st0)
+        )
+        done0 = bool(np.asarray(st0.conv).sum() >= target)
 
     perm_fwd = [(d, (d + 1) % n_dev) for d in range(n_dev)]
     perm_bwd = [(d, (d - 1) % n_dev) for d in range(n_dev)]
@@ -1318,7 +1372,7 @@ def run_stencil_hbm_sharded(
     )
 
     def rep_put(x):
-        return jax.device_put(x, repl)
+        return mesh_mod.put_global(x, repl)
 
     kd_dev = rep_put(np.asarray(key_data_host))
     rnd0 = rep_put(np.int32(start_round))

@@ -163,6 +163,20 @@ def plan_imp_hbm_sharded_shape(kind: str, n: int, cfg: SimConfig,
     plan-level BENCH_TABLES "topology ceilings" imp rows hardware-free."""
     if kind not in ("imp2d", "imp3d"):
         return f"topology {kind!r} is not an imp (lattice+extra) kind"
+    if jax.process_count() > 1:
+        # Multi-process support matrix (ISSUE 15): the imp composition's
+        # replicated class planes are placed with single-process
+        # jax.device_put, and its adjacency build is host-global (the imp
+        # rng is sequential). Multi-process meshes serve the chunked
+        # sharded engine on imp kinds (delivery='pool' there runs the
+        # sharded dynamic-roll composition), or the HBM-streaming /
+        # replicated-pool2 compositions on lattice/full kinds.
+        return (
+            "the imp x HBM x sharded composition is single-process; "
+            "multi-process meshes serve the chunked sharded engine "
+            "(drop the engine override) — or the HBM-streaming sharded / "
+            "replicated-pool2 compositions on lattice/full kinds"
+        )
     if cfg.delivery != "pool":
         return (
             "the imp x HBM x sharded composition serves the pooled "

@@ -81,6 +81,18 @@ from ..analysis.wire_specs import C, Regions, WireSpec
 
 def plan_fused_pool_sharded(topo: Topology, cfg: SimConfig, n_dev: int):
     """(rows_loc, layout) or a string reason why the composition can't run."""
+    if jax.process_count() > 1:
+        # Multi-process support matrix (ISSUE 15): the VMEM replicated
+        # pool composition places its planes with single-process
+        # jax.device_put; the implicit-full dispatch falls through to the
+        # replicated-pool2 composition (parallel/pool2_sharded.py), which
+        # serves multi-process meshes.
+        return (
+            "the VMEM replicated pool composition is single-process; "
+            "under a multi-process mesh the dispatch serves the "
+            "replicated-pool2 composition (parallel/pool2_sharded.py) "
+            "instead"
+        )
     if cfg.delivery != "pool":
         return (
             "the fused pool composition requires delivery='pool' (the same "

@@ -148,6 +148,19 @@ def threefry_bits_rows(k1, k2, global_rows, cols: int):
 
 def plan_fused_sharded(topo: Topology, cfg: SimConfig, n_dev: int):
     """(H_rows, rows_loc, CR, layout) or a string reason why not."""
+    if jax.process_count() > 1:
+        # Multi-process support matrix (ISSUE 15): this composition's
+        # VMEM-resident planes are placed with single-process
+        # jax.device_put; the dispatch falls through to the HBM-streaming
+        # sharded composition (parallel/fused_hbm_sharded.py), which
+        # serves multi-process meshes — as do the chunked sharded engine
+        # and the replicated-pool2 composition.
+        return (
+            "the VMEM fused x sharded composition is single-process; "
+            "under a multi-process mesh the dispatch serves the "
+            "HBM-streaming sharded composition "
+            "(parallel/fused_hbm_sharded.py) instead"
+        )
     if topo.implicit:
         return (
             "implicit (full) topology has no displacement structure for "

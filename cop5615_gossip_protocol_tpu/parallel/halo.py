@@ -354,6 +354,153 @@ def exchange_rows_batched(planes, H: int, axis: str, n_dev: int):
     )
 
 
+def band_segments(rows_loc: int, n_dev: int) -> int:
+    """Segment count of the banded reduce_scatter wire: each banded
+    delivery is issued as this many independent reduce_scatters over
+    row SLICES of the band, so the per-collective send operand is
+    [n_dev * rows_loc / n_seg, LANES] instead of the O(N) full-length
+    contribution buffer a single collective would need.
+    gcd(rows_loc, n_dev) — the largest segment count that both slices
+    the band into whole rows and is bounded by the mesh: on power-of-two
+    meshes over the 512-multiple pool layouts this is n_dev exactly and
+    the operand is the O(N/P) shard size; a smaller common divisor (a
+    mesh width not dividing the shard) inflates the operand by
+    n_dev/n_seg, which the plan's scatter_buf budget accounts for using
+    this same function. The ONE home for the count, shared by the wire
+    builder, the plan's budget, and the WIRE_SPEC environment
+    (analysis/wire_specs.wire_env), so declaration and program cannot
+    drift."""
+    import math
+
+    return math.gcd(rows_loc, n_dev)
+
+
+def _band_segment_buffer(rolled, low, base, seg_lo: int, rows_seg: int,
+                         rows_loc: int, n_dev: int, axis: str):
+    """Per-sender reduce_scatter operand for ONE segment of a banded row
+    delivery: the [n_dev * rows_seg, LANES] buffer whose receiver-r chunk
+    holds THIS shard's rows of band offsets [seg_lo, seg_lo + rows_seg)
+    of receiver r's band (zeros elsewhere).
+
+    Band semantics: receiver r's core band is global rows
+    [r*rows_loc + base, (r+1)*rows_loc + base) mod R of the row-sharded
+    plane (R = n_dev * rows_loc, ``base`` a replicated traced scalar in
+    [0, R)). Every global row lands in exactly ONE receiver cell across
+    the segments, so each reduce_scatter sum has a single nonzero
+    contributor per cell — adding exact zeros — and the delivered rows
+    are bitwise copies of the source rows for int and float planes alike.
+
+    Geometry: sender s's contribution to receiver r covers band offsets u
+    with (shift_r + u) mod R < rows_loc, shift_r = ((r-s)*rows_loc + base)
+    mod R. Because R ≡ 0 (mod rows_loc), every nonzero chunk is the SAME
+    local circular roll by a = base mod rows_loc (``rolled``,
+    precomputed once per plane), masked to its piece: the low band
+    offsets (u < rows_loc - a, the precomputed ``low`` column) when
+    shift_r < rows_loc, the high ones when shift_r wraps
+    (> R - rows_loc)."""
+    R = n_dev * rows_loc
+    s = lax.axis_index(axis).astype(jnp.int32)
+    zero = jnp.zeros((), rolled.dtype)
+    seg = rolled[seg_lo:seg_lo + rows_seg]
+    low_seg = low[seg_lo:seg_lo + rows_seg]
+    chunks = []
+    for r in range(n_dev):
+        shift = lax.rem(
+            (jnp.int32(r) - s) * jnp.int32(rows_loc) + base + jnp.int32(R),
+            jnp.int32(R),
+        )
+        mask = jnp.where(
+            shift < jnp.int32(rows_loc), low_seg,
+            jnp.where(shift > jnp.int32(R - rows_loc), ~low_seg, False),
+        )
+        chunks.append(jnp.where(mask, seg, zero))
+    return jnp.concatenate(chunks, axis=0)
+
+
+def scatter_band_rows(plane_bases, rows_loc: int, margin: int, axis: str,
+                      n_dev: int, batched: bool = True):
+    """The replicated-pool2 reduce_scatter wire (ISSUE 15): deliver each
+    device one [rows_loc + margin, LANES] BAND per (plane, base) item —
+    the O(N/P + margins) row range its pool-slot windows actually consume
+    — instead of all-gathering the full O(N) summary copy.
+
+    ``plane_bases`` is a list of (plane_loc [rows_loc, LANES], base)
+    items; items sharing a base (push-sum's s/w pair per slot) should be
+    adjacent so the batched schedule groups them. Core rows arrive via
+    ``band_segments`` segmented ``lax.psum_scatter`` calls (the
+    reduce_scatter primitive; single nonzero contributor per cell, see
+    _band_segment_buffer — bitwise-exact, and the per-collective operand
+    stays O(N/P)); margin rows — the first ``margin`` rows of the NEXT
+    device's band — via one ppermute volley around the ring.
+    ``batched=True`` (the overlap schedule) groups same-base items into
+    one reduce_scatter per (base, segment) and packs ALL margins into a
+    single ppermute; ``batched=False`` issues per-item collectives. Same
+    bytes, same values either way.
+
+    margin <= rows_loc required (the margin comes from ONE ring
+    neighbor); callers' plans enforce it. With n_dev == 1 there is no
+    wire at all — the band is a local roll plus its own wrap rows."""
+    if n_dev == 1:
+        out = []
+        for p, base in plane_bases:
+            full = jnp.roll(p, -base, axis=0)
+            out.append(jnp.concatenate([full, full[:margin]], axis=0))
+        return out
+    n_seg = band_segments(rows_loc, n_dev)
+    rows_seg = rows_loc // n_seg
+
+    def rs_group(group):
+        """Segmented reduce_scatters for items sharing a base."""
+        base = group[0][1]
+        a = lax.rem(base, jnp.int32(rows_loc))
+        u = lax.broadcasted_iota(jnp.int32, (rows_loc, 1), 0)
+        low = u < jnp.int32(rows_loc) - a
+        rolleds = [jnp.roll(p, -a, axis=0) for p, _ in group]
+        seg_cores = []
+        for si in range(n_seg):
+            bufs = jnp.stack([
+                _band_segment_buffer(
+                    rolled, low, base, si * rows_seg, rows_seg,
+                    rows_loc, n_dev, axis,
+                )
+                for rolled in rolleds
+            ])
+            seg_cores.append(lax.psum_scatter(
+                bufs, axis, scatter_dimension=1, tiled=True
+            ))
+        cores = jnp.concatenate(seg_cores, axis=1)
+        return [cores[i] for i in range(len(group))]
+
+    if batched:
+        groups: list = []
+        for item in plane_bases:
+            if groups and groups[-1][0][1] is item[1]:
+                groups[-1].append(item)
+            else:
+                groups.append([item])
+        cores = [c for g in groups for c in rs_group(g)]
+        stack = jnp.stack([
+            c[:margin] if c.dtype == jnp.int32
+            else lax.bitcast_convert_type(c[:margin], jnp.int32)
+            for c in cores
+        ])
+        recv = lax.ppermute(stack, axis, _ring_perm(n_dev, -1))
+        return [
+            jnp.concatenate([
+                c,
+                recv[i] if c.dtype == jnp.int32
+                else lax.bitcast_convert_type(recv[i], c.dtype),
+            ], axis=0)
+            for i, c in enumerate(cores)
+        ]
+    out = []
+    for p, base in plane_bases:
+        (core,) = rs_group([(p, base)])
+        recv = lax.ppermute(core[:margin], axis, _ring_perm(n_dev, -1))
+        out.append(jnp.concatenate([core, recv], axis=0))
+    return out
+
+
 def gather_rows_batched(planes, axis: str):
     """All-gather node-sharded [rows_loc, LANES] planes into full
     [R_glob, LANES] copies with ONE all_gather for ALL planes (bitcast to

@@ -36,6 +36,74 @@ def make_mesh(n_devices: int | None = None) -> Mesh:
     return Mesh(np.asarray(devices[:n_devices]), (NODE_AXIS,))
 
 
+def put_global(host_array, sharding):
+    """Host array -> global device array under ``sharding``, process-safe.
+
+    Single-process meshes shard straight from host memory (`jax.device_put`
+    — wrapping in jnp.asarray first would commit the whole array to the
+    default device before resharding, a transient full-size HBM spike at
+    the 16M-node scale). When the mesh spans OS processes
+    (initialize_distributed) the sharding is not fully addressable and
+    `jax.device_put` cannot build the global array: every process instead
+    materializes its own addressable shards from the (deterministically
+    rebuilt) host array via `jax.make_array_from_callback`. Extracted from
+    parallel/sharded.py's dev_put (ISSUE 15) so every sharded composition
+    shares the one multi-process placement path."""
+    host_array = np.asarray(host_array)
+    if sharding.is_fully_addressable:
+        return jax.device_put(host_array, sharding)
+    return jax.make_array_from_callback(
+        host_array.shape, sharding, lambda idx: host_array[idx]
+    )
+
+
+def put_rows(sharding, shape, dtype, rows_fn):
+    """Host-SHARDED construction of a row-sharded [rows, ...] device array:
+    ``rows_fn(lo, hi) -> np.ndarray[hi-lo, ...]`` builds ONLY the requested
+    row range, and `jax.make_array_from_callback` invokes it once per
+    addressable shard — so peak host memory is O(rows / n_processes ...
+    per-device shard), never the global array (ISSUE 15 tentpole: a 2^30
+    plane build must not materialize on one host). Works on single- and
+    multi-process meshes alike (the callback path is addressable-shard
+    local in both)."""
+    rows = shape[0]
+
+    def build(idx):
+        rs = idx[0]
+        lo = rs.start or 0
+        hi = rows if rs.stop is None else rs.stop
+        block = rows_fn(lo, hi)
+        rest = tuple(idx[1:])
+        if rest:
+            block = block[(slice(None),) + rest]
+        return np.ascontiguousarray(block.astype(dtype, copy=False))
+
+    return jax.make_array_from_callback(tuple(shape), sharding, build)
+
+
+def flat_id_rows(lanes: int):
+    """(lo, hi) -> [hi - lo, lanes] int64 global FLAT ids for a
+    row-of-lanes plane layout — the shared ingredient of the host-sharded
+    fresh-plane builders (push-sum's s_i = i, gossip's leader membership,
+    pad masks are all pure functions of the flat id). One home (ISSUE 15)
+    so the compositions' per-shard builders cannot drift in id math."""
+    def ids(lo: int, hi: int):
+        return np.arange(
+            lo * lanes, hi * lanes, dtype=np.int64
+        ).reshape(hi - lo, lanes)
+
+    return ids
+
+
+def const_row_builder(value, dtype, lanes: int):
+    """rows_fn filling every cell with ``value`` — the constant planes
+    (w = 1, term = initial, conv = 0) of a host-sharded fresh start."""
+    def build(lo: int, hi: int):
+        return np.full((hi - lo, lanes), value, dtype)
+
+    return build
+
+
 def initialize_distributed(
     coordinator_address: str | None = None,
     num_processes: int | None = None,

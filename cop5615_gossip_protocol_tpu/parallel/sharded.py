@@ -94,6 +94,7 @@ from ..ops.topology import Topology, imp_split
 from ..utils import compat
 from . import halo as halo_mod
 from ..analysis.wire_specs import C, Regions, WireSpec
+from . import mesh as mesh_mod
 from .mesh import NODE_AXIS, make_mesh
 
 
@@ -238,22 +239,9 @@ def run_sharded(
         )
 
     def dev_put(host_array, sharding=shard):
-        """Host -> global device array. When the mesh spans processes
-        (jax.distributed multi-host: parallel/mesh.initialize_distributed)
-        the sharding is not fully addressable and `jax.device_put` cannot
-        build the global array; every process instead materializes its own
-        addressable shards from the (deterministically rebuilt) host array.
-        """
-        host_array = np.asarray(host_array)
-        if sharding.is_fully_addressable:
-            # Shard straight from host memory: wrapping in jnp.asarray first
-            # would commit the whole array to the default device before
-            # resharding — a transient full-size single-device HBM spike at
-            # the 16M-node scale (~450 MB of neighbor tables).
-            return jax.device_put(host_array, sharding)
-        return jax.make_array_from_callback(
-            host_array.shape, sharding, lambda idx: host_array[idx]
-        )
+        """Host -> global device array, process-safe — the one placement
+        path shared by every sharded composition (parallel/mesh.put_global)."""
+        return mesh_mod.put_global(host_array, sharding)
 
     valid = dev_put(np.arange(n_pad) < n)
     if topo.implicit or imp_plan is not None:

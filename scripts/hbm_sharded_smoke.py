@@ -161,6 +161,28 @@ def main() -> int:
     print(f"[hbm-sharded-smoke] replicated-pool2 full bitwise OK "
           f"({rounds_full} rounds, informed {int(np.asarray(grab['b'].count).astype(bool).sum())})")
 
+    # Banded reduce_scatter wire (ISSUE 15): each device receives only
+    # the O(N/P + margins) summary bands its pool-slot windows consume
+    # (segmented psum_scatters + one margin ppermute volley) instead of
+    # the full gathered copy — forced at 2 devices (auto would pick the
+    # gather wire on a mesh narrower than the pool) and bitwise the SAME
+    # chunked oracle, executing the band path end-to-end on every push.
+    r3 = run_pool2_sharded(
+        topo_full,
+        SimConfig(n=n_full, topology="full", algorithm="gossip",
+                  delivery="pool", engine="fused", n_devices=2,
+                  chunk_rounds=1, max_rounds=rounds_full,
+                  pool2_wire="reduce_scatter"),
+        mesh=make_mesh(2), on_chunk=lambda r, s: grab.update(c=s),
+    )
+    assert r1.rounds == r3.rounds == rounds_full, (r1.rounds, r3.rounds)
+    for f in ("count", "active", "conv"):
+        a = np.asarray(getattr(grab["a"], f))
+        c = np.asarray(getattr(grab["c"], f))[:n_full]
+        assert (a == c).all(), f"pool2 reduce_scatter-wire {f} diverged"
+    print("[hbm-sharded-smoke] replicated-pool2 reduce_scatter wire "
+          "bitwise OK")
+
     # --- MXU matmul tier (ISSUE 12) ------------------------------------
     # Same rounds, same stream: the pool2-sharded composition with the
     # per-shard one-hot MXU blend must be bitwise the chunked pool

@@ -1,0 +1,141 @@
+"""multihost-smoke (ISSUE 15): the two-OS-process gloo bring-up on every
+push — one composition driven bitwise against its single-process oracle,
+plus the comm-audit table of the multi-process-serving compositions as a
+CI artifact.
+
+Flow per composition:
+  1. run the single-process 8-virtual-device oracle in THIS process;
+  2. spawn TWO coordinated OS processes of the public CLI over a gloo
+     coordinator (tests/_mp.py — the same harness the slow pytest pins
+     use), each hosting half the global mesh;
+  3. assert the lead record's (rounds, converged_count) match exactly —
+     gossip state is integer and the stream is process-count-invariant.
+
+Compositions driven: the chunked sharded engine (torus3d halo wire) and
+replicated-pool2 via delivery='matmul' (its banded reduce_scatter wire
+crossing the process boundary; 8 capped rounds — interpret mode).
+
+SKIP-GATED like the slow pytest suite: a jaxlib whose CPU client has no
+cross-process collectives (no gloo) exits 0 with a loud SKIP line — any
+OTHER child failure fails the job.
+
+Usage: python scripts/multihost_smoke.py [--audit-json FILE --audit-md FILE]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import tempfile
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--audit-json", type=Path, default=None)
+    ap.add_argument("--audit-md", type=Path, default=None)
+    args = ap.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from cop5615_gossip_protocol_tpu.utils import compat
+
+    jax.config.update("jax_threefry_partitionable", True)
+    compat.set_host_device_count(8)
+
+    from tests._mp import SkipUnsupported, spawn_procs
+
+    from cop5615_gossip_protocol_tpu import SimConfig, build_topology
+    from cop5615_gossip_protocol_tpu.models.runner import run
+
+    # Comm-audit artifact first (works with or without gloo): the traced
+    # wire tables of the compositions the multi-process tier serves —
+    # chunked sharded, HBM-streaming sharded, and replicated-pool2 (both
+    # wires; the banded reduce_scatter rows carry the ISSUE 15 recv-bytes
+    # delta).
+    from benchmarks.comm_audit import table as audit_table
+
+    from cop5615_gossip_protocol_tpu.analysis.trace import audit_engine
+
+    cells = (
+        ("sharded", "torus3d", "gossip", 4096, 8, {}),
+        ("hbm-sharded", "torus3d", "gossip", 125000, 2,
+         {"engine": "fused", "chunk_rounds": 8}),
+        ("pool2-sharded", "full", "gossip", 262144, 8,
+         {"engine": "fused", "delivery": "pool"}),
+        ("pool2-sharded", "full", "gossip", 262144, 8,
+         {"engine": "fused", "delivery": "pool",
+          "pool2_wire": "all_gather"}),
+    )
+    reports = [
+        audit_engine(engine, topo, algo, n, n_dev, True, extra)
+        for engine, topo, algo, n, n_dev, extra in cells
+    ]
+    md = "\n".join(
+        ["# multihost-smoke comm audit (multi-process-serving "
+         "compositions)", ""] + audit_table(reports)
+    )
+    print(md)
+    if args.audit_md:
+        args.audit_md.write_text(md + "\n")
+    if args.audit_json:
+        with open(args.audit_json, "w") as f:
+            for r in reports:
+                f.write(json.dumps(r.to_record()) + "\n")
+
+    def drive(label, cli_args, oracle, expect_rc=(0,)):
+        with tempfile.TemporaryDirectory() as td:
+            rec, _logs = spawn_procs(
+                Path(td), cli_args, n_procs=2, devices=8,
+                expect_rc=expect_rc, timeout=600,
+            )
+        assert rec["rounds"] == oracle.rounds, (
+            label, rec["rounds"], oracle.rounds
+        )
+        assert rec["converged_count"] == oracle.converged_count, label
+        print(f"[multihost-smoke] {label} bitwise OK "
+              f"({rec['rounds']} rounds, conv {rec['converged_count']})")
+
+    try:
+        n = 4096
+        ref = run(
+            build_topology("torus3d", n),
+            SimConfig(n=n, topology="torus3d", algorithm="gossip",
+                      n_devices=8),
+        )
+        drive("chunked sharded torus3d", [str(n), "torus3d", "gossip"], ref)
+
+        n2 = 262_144
+        from cop5615_gossip_protocol_tpu.parallel.mesh import make_mesh
+        from cop5615_gossip_protocol_tpu.parallel.pool2_sharded import (
+            run_pool2_sharded,
+        )
+
+        ref2 = run_pool2_sharded(
+            build_topology("full", n2),
+            SimConfig(n=n2, topology="full", algorithm="gossip",
+                      delivery="matmul", engine="fused", chunk_rounds=1,
+                      max_rounds=8, n_devices=8),
+            mesh=make_mesh(8),
+        )
+        drive(
+            "replicated-pool2 (reduce_scatter wire)",
+            [str(n2), "full", "gossip", "--delivery", "matmul",
+             "--engine", "fused", "--max-rounds", "8",
+             "--chunk-rounds", "1"],
+            ref2, expect_rc={0, 1},
+        )
+    except SkipUnsupported as e:
+        print(f"[multihost-smoke] SKIP (gloo runs): {e}")
+        return 0
+    print("[multihost-smoke] all compositions bitwise across processes")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

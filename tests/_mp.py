@@ -1,0 +1,112 @@
+"""Reusable N-process jax.distributed spawn harness (ISSUE 15).
+
+Generalizes tests/test_multiprocess.py's original two-process spawner into
+the one helper every cross-process parity pin uses: spawn N OS processes
+of the public CLI over a gloo coordinator, join them, skip-gate on
+runtimes whose jaxlib CPU client has no cross-process collectives, and
+pass any OTHER child failure through loudly with both processes' logs.
+
+scripts/multihost_smoke.py drives the same flow outside pytest (the
+multihost-smoke CI job), via ``spawn_procs``'s SkipUnsupported signal.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+
+# Older jaxlib CPU clients have no cross-process collectives at all (no
+# gloo); the child dies with exactly this XLA error. An explicit skip gate
+# keeps the suite honest on such runtimes — any OTHER child failure still
+# fails the test.
+NO_CPU_MULTIPROCESS = "aren't implemented on the CPU backend"
+
+
+class SkipUnsupported(RuntimeError):
+    """The runtime has no CPU multiprocess collectives — callers outside
+    pytest (scripts/multihost_smoke.py) catch this and report SKIP."""
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_one(pid: int, n_procs: int, port: int, args: list[str],
+               jsonl: Path, devices: int):
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("PYTHONPATH", "JAX_PLATFORMS", "XLA_FLAGS")}
+    # A clean JAX env: repo importable, no remote-TPU site hook, CPU only.
+    env["PYTHONPATH"] = str(REPO)
+    env["JAX_PLATFORMS"] = "cpu"
+    cmd = [
+        sys.executable, "-m", "cop5615_gossip_protocol_tpu", *args,
+        "--platform", "cpu", "--devices", str(devices),
+        "--coordinator", f"127.0.0.1:{port}",
+        "--num-processes", str(n_procs), "--process-id", str(pid),
+        "--jsonl", str(jsonl),
+    ]
+    return subprocess.Popen(
+        cmd, cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+
+
+def spawn_procs(tmp_path: Path, args: list[str], *, n_procs: int = 2,
+                devices: int = 8, expect_rc=(0,), timeout: int = 300):
+    """Run ``args`` through the CLI as ``n_procs`` coordinated OS
+    processes sharing one ``devices``-wide global mesh.
+
+    Returns (lead_record, logs): the LEAD process's last --jsonl record
+    plus every process's combined stdout/stderr text. Raises
+    SkipUnsupported when the runtime lacks gloo CPU collectives; asserts
+    (with all logs) when any child exits outside ``expect_rc`` — a
+    non-lead crash can never hide behind a healthy lead."""
+    port = free_port()
+    outs = [tmp_path / f"rec{pid}.jsonl" for pid in range(n_procs)]
+    procs = [
+        _spawn_one(pid, n_procs, port, args, outs[pid], devices)
+        for pid in range(n_procs)
+    ]
+    logs = []
+    for pr in procs:
+        try:
+            out_bytes, _ = pr.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for p2 in procs:
+                p2.kill()
+            raise
+        logs.append(out_bytes.decode(errors="replace"))
+    if any(NO_CPU_MULTIPROCESS in log for log in logs):
+        raise SkipUnsupported(
+            "this jaxlib's CPU backend has no multiprocess collectives "
+            f"({NO_CPU_MULTIPROCESS!r})"
+        )
+    bad = [
+        (i, pr.returncode) for i, pr in enumerate(procs)
+        if pr.returncode not in expect_rc
+    ]
+    assert not bad, (bad, logs)
+    return json.loads(outs[0].read_text().splitlines()[-1]), logs
+
+
+def spawn_pair(tmp_path: Path, args: list[str], *, expect_rc=(0,),
+               timeout: int = 300, devices: int = 8):
+    """Two-process form — the shape every current pin uses. Translates
+    SkipUnsupported into a pytest skip."""
+    import pytest
+
+    try:
+        return spawn_procs(
+            tmp_path, args, n_procs=2, devices=devices,
+            expect_rc=expect_rc, timeout=timeout,
+        )
+    except SkipUnsupported as e:
+        pytest.skip(str(e))

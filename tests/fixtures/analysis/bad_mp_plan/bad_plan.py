@@ -1,0 +1,21 @@
+"""AST-scanned lint fixture: a multi-process plan refusal that dead-ends.
+
+Never imported. The plan function refuses a multi-process mesh without
+naming any serving composition — the ISSUE 15 support-matrix rule the
+``check_multiprocess_refusals`` lint enforces; the second return names
+the chunked sharded engine and must NOT fire.
+"""
+
+
+def plan_bad_composition(topo, cfg, n_dev):
+    if cfg.processes > 1:
+        return (
+            "this thing is single-process only; nothing more to say"
+            # lint: refusal-dead-end — no composition named
+        )
+    if cfg.processes > 2:
+        return (
+            "this plan is single-process; multi-process meshes serve "
+            "the chunked sharded engine instead"  # must NOT fire
+        )
+    return (1, 2, 3)

@@ -6,65 +6,35 @@ The reference's only nod at distribution is an unused Akka.Cluster package
 reference (project3.fsproj:13-15, never configured — SURVEY.md C14). Here
 two processes each host half the global device mesh and run the SAME
 shard_map collective program via the public CLI (`--coordinator
---num-processes --process-id`); the per-round halo ppermutes and the psum
-convergence predicate cross the process boundary. The oracle is the
-single-process 8-virtual-device run: gossip state is integer, and the
-random stream is device-count- and process-count-invariant by construction
+--num-processes --process-id`); the per-round halo ppermutes / banded
+reduce_scatters / summary gathers and the psum convergence predicate all
+cross the process boundary. The oracle is the single-process
+8-virtual-device run: gossip state is integer, and the random stream is
+device-count- and process-count-invariant by construction
 (ops/sampling.py), so rounds and converged counts must match exactly.
+
+Spawning/skip-gating/child-failure passthrough live in tests/_mp.py
+(ISSUE 15 satellite) — the same harness scripts/multihost_smoke.py drives
+in CI. ISSUE 15 extends the covered compositions to the ring compositions
+that hold the ceilings: the HBM-streaming sharded composition
+(fused_hbm_sharded — under a multi-process mesh the VMEM composition's
+plan refuses and the dispatch routes here at any population) and
+replicated-pool2 (pool2_sharded, both delivery wires).
 """
 
 from __future__ import annotations
 
-import json
-import os
-import subprocess
-import sys
-from pathlib import Path
-
 import pytest
+
+from tests._mp import spawn_pair
 
 from cop5615_gossip_protocol_tpu import SimConfig, build_topology
 from cop5615_gossip_protocol_tpu.models.runner import run
-
-REPO = Path(__file__).resolve().parents[1]
 
 # Two-OS-process jax.distributed runs: minutes of subprocess spawns on a
 # capable runtime, and pure spawn overhead where the CPU backend lacks
 # multiprocess collectives — outside the tier-1 budget either way.
 pytestmark = pytest.mark.slow
-
-# Older jaxlib CPU clients have no cross-process collectives at all (no
-# gloo); the child dies with exactly this XLA error. An explicit skip gate
-# keeps the suite honest on such runtimes — any OTHER child failure still
-# fails the test.
-_NO_CPU_MULTIPROCESS = "aren't implemented on the CPU backend"
-
-
-def _skip_if_unsupported(logs: list[str]) -> None:
-    if any(_NO_CPU_MULTIPROCESS in log for log in logs):
-        pytest.skip(
-            "this jaxlib's CPU backend has no multiprocess collectives "
-            f"({_NO_CPU_MULTIPROCESS!r})"
-        )
-
-
-def _spawn(pid: int, port: int, args: list[str], jsonl: Path):
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("PYTHONPATH", "JAX_PLATFORMS", "XLA_FLAGS")}
-    # A clean JAX env: repo importable, no remote-TPU site hook, CPU only.
-    env["PYTHONPATH"] = str(REPO)
-    env["JAX_PLATFORMS"] = "cpu"
-    cmd = [
-        sys.executable, "-m", "cop5615_gossip_protocol_tpu", *args,
-        "--platform", "cpu", "--devices", "8",
-        "--coordinator", f"127.0.0.1:{port}",
-        "--num-processes", "2", "--process-id", str(pid),
-        "--jsonl", str(jsonl),
-    ]
-    return subprocess.Popen(
-        cmd, cwd=REPO, env=env,
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-    )
 
 
 def test_two_process_sharded_matches_single_process(tmp_path):
@@ -75,38 +45,13 @@ def test_two_process_sharded_matches_single_process(tmp_path):
     )
     assert ref.converged
 
-    port = 21000 + os.getpid() % 9000
-    outs = [tmp_path / f"rec{pid}.jsonl" for pid in range(2)]
-    procs = [
-        _spawn(pid, port, [str(n), "torus3d", "gossip"], outs[pid])
-        for pid in range(2)
-    ]
-    logs = []
-    for pr in procs:
-        out_bytes, _ = pr.communicate(timeout=300)
-        logs.append(out_bytes.decode(errors="replace"))
-    _skip_if_unsupported(logs)
-    assert all(pr.returncode == 0 for pr in procs), logs
-
-    rec0 = json.loads(outs[0].read_text().splitlines()[-1])
+    rec0, logs = spawn_pair(tmp_path, [str(n), "torus3d", "gossip"])
     assert rec0["rounds"] == ref.rounds
     assert rec0["converged_count"] == ref.converged_count
     assert rec0["converged"] is True
     # Non-lead process runs every collective but stays silent on stdout.
     assert "Convergence Time" in logs[0]
     assert "Convergence Time" not in logs[1]
-
-
-def _run_pair(tmp_path, port, cli_args, expect_rc=(0,), timeout=300):
-    outs = [tmp_path / f"rec{pid}.jsonl" for pid in range(2)]
-    procs = [_spawn(pid, port, cli_args, outs[pid]) for pid in range(2)]
-    logs = []
-    for pr in procs:
-        out_bytes, _ = pr.communicate(timeout=timeout)
-        logs.append(out_bytes.decode(errors="replace"))
-    _skip_if_unsupported(logs)
-    assert all(pr.returncode in expect_rc for pr in procs), logs
-    return json.loads(outs[0].read_text().splitlines()[-1])
 
 
 def test_two_process_pool_gossip_exact(tmp_path):
@@ -123,9 +68,8 @@ def test_two_process_pool_gossip_exact(tmp_path):
                   delivery="pool", n_devices=8),
     )
     assert ref.converged
-    rec0 = _run_pair(
-        tmp_path, 21000 + (os.getpid() + 77) % 9000,
-        [str(n), "full", "gossip", "--delivery", "pool"],
+    rec0, _ = spawn_pair(
+        tmp_path, [str(n), "full", "gossip", "--delivery", "pool"]
     )
     assert rec0["rounds"] == ref.rounds
     assert rec0["converged_count"] == ref.converged_count
@@ -138,15 +82,12 @@ def test_two_process_checkpoint_resume(tmp_path):
     # Gossip integer state + process-invariant stream => the resumed pair
     # must land on the uninterrupted pair's exact round count.
     n = 4096
-    full = _run_pair(
-        tmp_path, 21000 + (os.getpid() + 231) % 9000,
-        [str(n), "torus3d", "gossip"],
-    )
+    full, _ = spawn_pair(tmp_path, [str(n), "torus3d", "gossip"])
     assert full["converged"] is True
 
     ck = tmp_path / "state.npz"
-    halted = _run_pair(
-        tmp_path, 21000 + (os.getpid() + 308) % 9000,
+    halted, _ = spawn_pair(
+        tmp_path,
         [str(n), "torus3d", "gossip", "--max-rounds", "24",
          "--chunk-rounds", "8", "--checkpoint", str(ck)],
         expect_rc={1},  # capped before convergence
@@ -154,8 +95,8 @@ def test_two_process_checkpoint_resume(tmp_path):
     assert halted["converged"] is False
     assert ck.exists()
 
-    resumed = _run_pair(
-        tmp_path, 21000 + (os.getpid() + 385) % 9000,
+    resumed, _ = spawn_pair(
+        tmp_path,
         [str(n), "torus3d", "gossip", "--chunk-rounds", "8",
          "--resume", str(ck)],
     )
@@ -164,51 +105,92 @@ def test_two_process_checkpoint_resume(tmp_path):
 
 
 def test_two_process_fused_sharded_lattice(tmp_path):
-    # VERDICT r3 #8: the fused x sharded composition under REAL two-OS-
-    # process collectives. At chunk_rounds=1 the per-shard Pallas chunks
-    # (interpret mode on CPU) + halo ppermutes must reproduce the
-    # single-process 8-virtual-device run exactly — gossip state is
-    # integer, so rounds and counts match bit-for-bit. Population: the
-    # smallest torus whose layout splits into whole 512-row tiles on 8
-    # devices (128^3 -> 16384 rows) — large for interpret mode, but the
-    # run is capped at 8 rounds (measured: both fused two-process tests
-    # together finish in ~60 s).
+    # VERDICT r3 #8, re-homed by ISSUE 15: under a multi-process mesh the
+    # VMEM fused x sharded plan REFUSES (single-process device_put) and
+    # the dispatch routes to the HBM-streaming sharded composition — so
+    # this drives fused_hbm_sharded's cross-process wires (batched halo
+    # ppermute pair + deferred verdict psum) at a population the VMEM
+    # composition would otherwise own. Bitwise the single-process
+    # composition it lands on. 128^3 -> 16384 rows over 8 devices; capped
+    # at 8 rounds (interpret mode).
     n = 128**3
     args = [str(n), "torus3d", "gossip", "--engine", "fused",
             "--chunk-rounds", "1", "--max-rounds", "8"]
-    ref = run(
+    # Spawn first: the no-gloo skip gate fires before the (expensive)
+    # interpret-mode single-process oracle is computed.
+    rec0, _ = spawn_pair(
+        tmp_path, args,
+        expect_rc={0, 1},  # capped before convergence
+        timeout=600,
+    )
+    from cop5615_gossip_protocol_tpu.parallel.fused_hbm_sharded import (
+        run_stencil_hbm_sharded,
+    )
+    from cop5615_gossip_protocol_tpu.parallel.mesh import make_mesh
+
+    ref = run_stencil_hbm_sharded(
         build_topology("torus3d", n),
         SimConfig(n=n, topology="torus3d", algorithm="gossip",
                   engine="fused", chunk_rounds=1, max_rounds=8,
                   n_devices=8),
-    )
-    rec0 = _run_pair(
-        tmp_path, 21000 + (os.getpid() + 462) % 9000, args,
-        expect_rc={0, 1},  # capped before convergence
-        timeout=600,
+        mesh=make_mesh(8),
     )
     assert rec0["rounds"] == ref.rounds
     assert rec0["converged_count"] == ref.converged_count
 
 
-def test_two_process_fused_pool_sharded(tmp_path):
-    # The implicit-full pool composition across processes: one all_gather
-    # of the send planes per round now crosses the process boundary.
-    # Gossip ints: the two-process run must match the single-process mesh
-    # (itself bitwise the single-device fused pool engine) exactly.
-    n = 2**20
-    args = [str(n), "full", "gossip", "--delivery", "pool",
-            "--engine", "fused", "--max-rounds", "12"]
-    ref = run(
+def test_two_process_fused_hbm_sharded_ring(tmp_path):
+    # ISSUE 15 acceptance: the HBM-streaming sharded composition under
+    # the two-OS-process gloo mesh, bitwise the single-process virtual
+    # mesh (which the slow suite pins bitwise the chunked engine). The
+    # ring wire: ONE batched halo ppermute pair + the deferred verdict
+    # psum per super-step, now crossing the process boundary. 2^20 nodes
+    # -> 8192 rows -> 1024-row shards (the hbm plan needs whole
+    # processing tiles per shard; 65536 would leave 64-row shards).
+    n = 1 << 20
+    args = [str(n), "ring", "gossip", "--engine", "fused",
+            "--chunk-rounds", "2", "--max-rounds", "8"]
+    rec0, _ = spawn_pair(tmp_path, args, expect_rc={0, 1}, timeout=600)
+    from cop5615_gossip_protocol_tpu.parallel.fused_hbm_sharded import (
+        run_stencil_hbm_sharded,
+    )
+    from cop5615_gossip_protocol_tpu.parallel.mesh import make_mesh
+
+    ref = run_stencil_hbm_sharded(
+        build_topology("ring", n),
+        SimConfig(n=n, topology="ring", algorithm="gossip",
+                  engine="fused", chunk_rounds=2, max_rounds=8,
+                  n_devices=8),
+        mesh=make_mesh(8),
+    )
+    assert rec0["rounds"] == ref.rounds
+    assert rec0["converged_count"] == ref.converged_count
+
+
+@pytest.mark.parametrize("wire", ["reduce_scatter", "all_gather"])
+def test_two_process_pool2_sharded_exact(tmp_path, wire):
+    # ISSUE 15 acceptance: replicated-pool2 under the two-OS-process gloo
+    # mesh, BOTH delivery wires, bitwise the single-process virtual mesh.
+    # delivery='matmul' routes the implicit-full fused dispatch straight
+    # to the pool2 composition at any population; gossip ints pin the
+    # banded reduce_scatter / summary all_gather + verdict psum across
+    # the process boundary exactly.
+    n = 262_144
+    args = [str(n), "full", "gossip", "--delivery", "matmul",
+            "--engine", "fused", "--max-rounds", "8",
+            "--chunk-rounds", "1", "--pool2-wire", wire]
+    rec0, _ = spawn_pair(tmp_path, args, expect_rc={0, 1}, timeout=600)
+    from cop5615_gossip_protocol_tpu.parallel.mesh import make_mesh
+    from cop5615_gossip_protocol_tpu.parallel.pool2_sharded import (
+        run_pool2_sharded,
+    )
+
+    ref = run_pool2_sharded(
         build_topology("full", n),
         SimConfig(n=n, topology="full", algorithm="gossip",
-                  delivery="pool", engine="fused", max_rounds=12,
-                  n_devices=8),
-    )
-    rec0 = _run_pair(
-        tmp_path, 21000 + (os.getpid() + 539) % 9000, args,
-        expect_rc={0, 1},
-        timeout=600,
+                  delivery="matmul", engine="fused", chunk_rounds=1,
+                  max_rounds=8, n_devices=8, pool2_wire=wire),
+        mesh=make_mesh(8),
     )
     assert rec0["rounds"] == ref.rounds
     assert rec0["converged_count"] == ref.converged_count
@@ -230,9 +212,8 @@ def test_two_process_pool_pushsum(tmp_path):
                   delivery="pool", n_devices=8),
     )
     assert ref.converged
-    rec0 = _run_pair(
-        tmp_path, 21000 + (os.getpid() + 154) % 9000,
-        [str(n), "full", "push-sum", "--delivery", "pool"],
+    rec0, _ = spawn_pair(
+        tmp_path, [str(n), "full", "push-sum", "--delivery", "pool"]
     )
     assert rec0["converged"] is True
     assert rec0["converged_count"] == n

@@ -432,3 +432,46 @@ def test_trend_table_renders_and_applies_idempotently(tmp_path):
     text2 = (root / "BENCH_TABLES.md").read_text()
     assert text2.count(trend.SECTION_HEADER) == 1
     assert text2 == text1
+
+
+def test_trend_ceilings_apply_idempotent_and_preserves_serving(tmp_path):
+    # ISSUE 15 satellite: the ceilings section has its own header and its
+    # own idempotent apply, and a bare --apply (no --serving flags, no
+    # --ceilings) must preserve BOTH the previously applied serving pin
+    # and the previously applied ceilings section — a regen can't drop
+    # the r14 serving row or the ceilings table (the PR 9
+    # pin-preservation rule, extended).
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+    from benchmarks import trend
+
+    root = tmp_path
+    (root / "BENCH_r01.json").write_text(json.dumps({
+        "parsed": {"value": 100.0, "wall_s": 1.5, "compile_s": 2.0,
+                   "vs_baseline": 10.0}}))
+    (root / "BENCH_TABLES.md").write_text("# tables\n\n## existing\nrow\n")
+    rc = trend.main(["--root", str(root), "--serving", "1:4321",
+                     "--ceilings", "--apply"])
+    assert rc == 0
+    text1 = (root / "BENCH_TABLES.md").read_text()
+    assert trend.CEILINGS_HEADER in text1
+    assert "replicated-pool2 (reduce_scatter)" in text1
+    assert "replicated-pool2 (all_gather)" in text1
+    assert "Host-sharded construction" in text1
+    assert "4,321" in text1
+    # Second apply WITH ceilings: byte-identical (the plan functions are
+    # pure — same table both times).
+    rc = trend.main(["--root", str(root), "--serving", "1:4321",
+                     "--ceilings", "--apply"])
+    assert (root / "BENCH_TABLES.md").read_text() == text1
+    # Bare apply (no --serving, no --ceilings): the serving pin survives
+    # via the parse-back path, the ceilings section is left untouched.
+    rc = trend.main(["--root", str(root), "--apply"])
+    assert rc == 0
+    text3 = (root / "BENCH_TABLES.md").read_text()
+    assert "4,321" in text3
+    assert text3.count(trend.CEILINGS_HEADER) == 1
+    assert "replicated-pool2 (reduce_scatter)" in text3
+    assert "## existing" in text3

@@ -71,12 +71,63 @@ def test_plan_accepts_and_ceiling_past_2_28():
                 _cfg(n, algorithm=algorithm, n_devices=8), 8
             )
             assert not isinstance(plan, str), (algorithm, n, plan)
-    # and refuses honestly where the gathered copy itself cannot fit
+    # and refuses honestly where the summary planes themselves cannot fit
     big = 1 << 33
-    reason = plan_pool2_sharded(
-        build_topology("full", big), _cfg(big, n_devices=8), 8
+    for wire, marker in (
+        ("reduce_scatter", "reduce_scatter wire"),
+        ("all_gather", "gathered"),
+    ):
+        reason = plan_pool2_sharded(
+            build_topology("full", big),
+            _cfg(big, n_devices=8, pool2_wire=wire), 8,
+        )
+        assert isinstance(reason, str) and marker in reason
+
+
+def test_plan_resolves_pool2_wire_by_mesh_width():
+    # ISSUE 15: auto picks the banded reduce_scatter wire exactly when
+    # the mesh is wider than the pool (each band then undercuts the full
+    # gathered copy); explicit values force either wire, and the plan
+    # returns the RESOLVED wire so dispatch and declaration (analysis/
+    # wire_specs.wire_env) share one decision.
+    topo = build_topology("full", N)
+    assert plan_pool2_sharded(topo, _cfg(N, n_devices=2), 2)[3] == (
+        "all_gather"
     )
-    assert isinstance(reason, str) and "gathered" in reason
+    assert plan_pool2_sharded(topo, _cfg(N, n_devices=8), 8)[3] == (
+        "reduce_scatter"
+    )
+    assert plan_pool2_sharded(
+        topo, _cfg(N, n_devices=2, pool2_wire="reduce_scatter"), 2
+    )[3] == "reduce_scatter"
+    assert plan_pool2_sharded(
+        topo, _cfg(N, n_devices=8, pool2_wire="all_gather"), 8
+    )[3] == "all_gather"
+
+
+def test_band_margin_and_starts_geometry():
+    # The band geometry invariants the reduce_scatter kernel relies on:
+    # margin covers the mirror rows (16) plus — at padded populations —
+    # the 8-aligned slack between the d and d+Z window starts, and every
+    # band start is 8-aligned in [0, R).
+    from cop5615_gossip_protocol_tpu.ops.fused_pool import (
+        build_pool_layout,
+    )
+    from cop5615_gossip_protocol_tpu.parallel.pool2_sharded import (
+        band_margin,
+        band_starts,
+    )
+
+    lay0 = build_pool_layout(N)  # Z == 0
+    assert lay0.n_pad == N and band_margin(lay0) == 16
+    layz = build_pool_layout(N - 1000)  # Z == 1000
+    z = layz.n_pad - layz.n
+    assert z == 1000
+    assert band_margin(layz) == 16 + ((z // 128 + 8 + 7) // 8) * 8
+    offs = jnp.asarray([1, 127, 128, layz.n - 1], jnp.int32)
+    starts = np.asarray(band_starts(offs, layz))
+    assert ((starts % 8) == 0).all()
+    assert ((starts >= 0) & (starts < layz.rows)).all()
 
 
 def test_plan_gating_reasons():
@@ -182,6 +233,44 @@ def test_pushsum_global_termination_exact(force_pool2):
                         delta=1e-1, max_rounds=500, n_devices=2))
     assert r1.rounds == r2.rounds
     assert r1.converged_count == r2.converged_count
+
+
+@pytest.mark.slow
+def test_reduce_scatter_wire_bitwise_vs_all_gather(force_pool2):
+    # ISSUE 15 acceptance: the banded reduce_scatter wire is a pure
+    # reorganization of who holds which summary rows — trajectories are
+    # BITWISE the all_gather composition's on the interpret oracle at 2
+    # AND 4 devices, both schedules. Gossip ints pin the stream exactly;
+    # the run-level (rounds, converged_count) equality then pins the
+    # whole trajectory (count monotonicity).
+    topo = build_topology("full", N)
+    ref = run(topo, _cfg(N, n_devices=2, pool2_wire="all_gather"))
+    for nd in (2, 4):
+        for ov in (True, False):
+            r = run(topo, _cfg(N, n_devices=nd, overlap_collectives=ov,
+                               pool2_wire="reduce_scatter"))
+            assert (r.rounds, r.converged_count) == (
+                ref.rounds, ref.converged_count
+            ), (nd, ov)
+
+
+@pytest.mark.slow
+def test_reduce_scatter_wire_pushsum_state_bitwise(force_pool2):
+    # Push-sum float state to the last bit across the two wires, at a
+    # PADDED population (Z > 0) so the straddle/wrap window reads the
+    # band's anchor variant — the subtlest band-geometry path.
+    n = N - 1000
+    topo = build_topology("full", n)
+    final = {}
+    for wire in ("all_gather", "reduce_scatter"):
+        r = run(topo, _cfg(n, algorithm="push-sum", n_devices=4,
+                           max_rounds=48, pool2_wire=wire),
+                on_chunk=_grab(final, wire))
+        assert r.rounds == 48
+    for f in ("s", "w", "term", "conv"):
+        a = np.asarray(getattr(final["all_gather"], f))[:n]
+        b = np.asarray(getattr(final["reduce_scatter"], f))[:n]
+        assert (a != b).sum() == 0, f
 
 
 @pytest.mark.slow

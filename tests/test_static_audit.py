@@ -318,6 +318,17 @@ def test_lint_refusal_fires_on_fixture():
     ]
 
 
+def test_lint_multiprocess_refusal_fires_on_dead_end():
+    # ISSUE 15: a plan function refusing a multi-process mesh without
+    # naming a serving composition fires; the one that routes to the
+    # chunked sharded engine must not.
+    findings = lint_rules.check_multiprocess_refusals(
+        FIXTURES / "bad_mp_plan"
+    )
+    assert [f.rule for f in findings] == ["refusal-dead-end"]
+    assert "plan_bad_composition" in findings[0].where
+
+
 def test_lints_clean_on_real_tree():
     assert lint_rules.run_lints() == []
 
