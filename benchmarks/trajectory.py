@@ -66,6 +66,22 @@ def revival_rounds(recs: list[dict]) -> list[int]:
     return [r["rounds"] for r in recs if r.get("revived", 0) > 0]
 
 
+def byzantine_onset_rounds(recs: list[dict]) -> list[int]:
+    """Rounds where adversaries turned: the cumulative ``byzantine`` count
+    (telemetry schema v3) increased over the previous record. Empty for
+    honest traces. Onsets, not every adversarial round — the count is
+    monotone, so once positive every later round is adversarial and
+    marking them all would bury the signal."""
+    out = []
+    prev = 0
+    for r in recs:
+        b = r.get("byzantine", 0)
+        if b > prev:
+            out.append(r["rounds"])
+        prev = b
+    return out
+
+
 def ascii_curve(recs: list[dict], denominator: int,
                 width: int = 64, height: int = 12) -> list[str]:
     """Converged fraction (y, 0..100%) vs rounds (x) on a width x height
@@ -77,18 +93,26 @@ def ascii_curve(recs: list[dict], denominator: int,
     Crash-recovery traces (any record with a ``revived`` count) get a
     marker row under the axis: ``^`` in every column where a revival
     landed, plus a summary line of the rejoin rounds — the shape of the
-    curve is only interpretable next to when the population grew back."""
+    curve is only interpretable next to when the population grew back.
+    Adversarial traces (telemetry schema v3's ``byzantine`` count) get
+    the same treatment with ``!`` at each onset round — a plateau or
+    regression in the curve reads differently once you can see the
+    adversaries turning."""
     first = recs[0]["rounds"]
     last = recs[-1]["rounds"]
     span = max(last - first + 1, 1)
     cols = [0.0] * width
     revive_cols = [False] * width
+    byz_cols = [False] * width
+    onsets = set(byzantine_onset_rounds(recs))
     for r in recs:
         x = min(width - 1, (r["rounds"] - first) * width // span)
         frac = r["converged_count"] / max(denominator, 1)
         cols[x] = max(cols[x], frac)
         if r.get("revived", 0) > 0:
             revive_cols[x] = True
+        if r["rounds"] in onsets:
+            byz_cols[x] = True
     # Forward-fill empty buckets (fewer rounds than columns).
     running = 0.0
     for x in range(width):
@@ -116,6 +140,20 @@ def ascii_curve(recs: list[dict], denominator: int,
         shown = ", ".join(f"{r:,}" for r in revs[:12])
         more = f" (+{len(revs) - 12} more)" if len(revs) > 12 else ""
         lines.append(f"       ^ revivals at rounds: {shown}{more}")
+    byz = sorted(onsets)
+    if byz:
+        # Marker row sits directly under the axis, above any revival row.
+        lines.insert(
+            height + 1,
+            "       " + "".join("!" if m else " " for m in byz_cols),
+        )
+        shown = ", ".join(f"{r:,}" for r in byz[:12])
+        more = f" (+{len(byz) - 12} more)" if len(byz) > 12 else ""
+        final_ct = max(r.get("byzantine", 0) for r in recs)
+        lines.append(
+            f"       ! byzantine onsets at rounds: {shown}{more} "
+            f"({final_ct:,} adversaries by the final round)"
+        )
     return lines
 
 
@@ -140,6 +178,12 @@ def analyze(recs: list[dict], population: int | None = None) -> dict:
         # where revivals landed and the total rejoin count.
         "revival_rounds": revival_rounds(recs),
         "revived_total": sum(r.get("revived", 0) for r in recs),
+        # Adversarial annotation (telemetry schema v3 traces): rounds where
+        # the cumulative byzantine count grew, and its final value.
+        "byzantine_onset_rounds": byzantine_onset_rounds(recs),
+        "byzantine_final": max(
+            (r.get("byzantine", 0) for r in recs), default=0
+        ),
     }
     if "estimate_mae" in final:
         out["estimate_mae_final"] = final["estimate_mae"]

@@ -51,6 +51,10 @@ CEILINGS_HEADER = (
     "## Topology ceilings past one chip "
     "(plan-level, benchmarks/trend.py --ceilings)"
 )
+BYZANTINE_HEADER = (
+    "## Convergence degradation under Byzantine attack "
+    "(benchmarks/trend.py --byzantine)"
+)
 
 
 def load_snapshots(root: Path) -> dict:
@@ -356,6 +360,93 @@ def render_matmul_tier() -> str:
     ])
 
 
+def render_byzantine() -> str:
+    """The ISSUE 16 convergence-degradation campaign: push-sum under the
+    mass_inflate attack, swept over Byzantine fraction x topology x
+    countermeasure, all on the chunked engine on this box's CPU. Every
+    run is fully seeded (the adversary plane is config-pure,
+    ops/faults.byzantine_plane), so the section regenerates
+    byte-identically — numbers here are records, not estimates.
+
+    Column semantics differ by design: the ``none`` column runs WITH the
+    mass-conservation sentinel (--mass-tolerance 1e-3) — unmitigated
+    adversaries are a DETECTION story, and the cell reports the exact
+    round the sentinel tripped. The ``clip``/``trim`` columns run without
+    it (config-enforced: robust aggregation discards weight by design,
+    so robust_agg excludes mass_tolerance) — mitigation is a CONVERGENCE
+    story, and the cells report rounds + estimate MAE. ``trim`` needs
+    the full topology's uniform pool-slot channels (config-enforced),
+    so the torus3d rows mark it n/a."""
+    sys.path.insert(0, str(REPO))
+    import warnings
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_threefry_partitionable", True)
+    from cop5615_gossip_protocol_tpu import SimConfig, build_topology, run
+
+    fractions = (0.0, 0.02, 0.05, 0.10)
+    topologies = (("full", 256, {"delivery": "pool"}), ("torus3d", 216, {}))
+    max_rounds = 1500
+
+    def cell(topo_name, n, extra, frac, agg):
+        kw = dict(
+            n=n, topology=topo_name, algorithm="push-sum", seed=0,
+            engine="chunked", chunk_rounds=64, max_rounds=max_rounds,
+            byzantine_rate=frac, byzantine_mode="mass_inflate",
+            robust_agg=agg, **extra,
+        )
+        if agg == "none":
+            kw["mass_tolerance"] = 1e-3
+        topo = build_topology(topo_name, n)
+        with warnings.catch_warnings():
+            # robust_agg without adversaries (the fraction-0 control rows)
+            # fires the SimConfig lint warning by design.
+            warnings.simplefilter("ignore")
+            r = run(topo, SimConfig(**kw))
+        if r.outcome == "unhealthy":
+            return f"unhealthy @ r {r.unhealthy_round}"
+        mae = f"MAE {r.estimate_mae:.2f}"
+        if r.outcome == "converged":
+            return f"{r.rounds} r, {mae}"
+        return f"no conv ({r.outcome}, {r.rounds} r), {mae}"
+
+    lines = [
+        BYZANTINE_HEADER,
+        "",
+        "Push-sum under the mass_inflate attack on the chunked engine "
+        "(CPU, fully seeded — regenerates byte-identically). The `none` "
+        "column runs with the mass-conservation sentinel "
+        "(--mass-tolerance 1e-3): the cell is the exact round detection "
+        "fired. The `clip`/`trim` columns run the countermeasure instead "
+        "(robust_agg excludes mass_tolerance by config) and report "
+        "rounds to convergence + estimate MAE against the true mean. "
+        "trim is full-topology-only (uniform pool-slot channels). trim "
+        "never biases but DISCARDS weight every round (ops/delivery."
+        "deliver_pool_trimmed), so a run it fails to converge in time "
+        "underflows its total float32 weight to zero — a 'no conv' trim "
+        "cell with a garbage MAE is that failure mode, recorded.",
+        "",
+        "| topology | byz fraction | none (+ sentinel) | clip | trim |",
+        "|---|---|---|---|---|",
+    ]
+    for topo_name, n, extra in topologies:
+        for frac in fractions:
+            row = [cell(topo_name, n, extra, frac, "none"),
+                   cell(topo_name, n, extra, frac, "clip")]
+            if topo_name == "full":
+                row.append(cell(topo_name, n, extra, frac, "trim"))
+            else:
+                row.append("n/a")
+            lines.append(
+                f"| {topo_name} n={n} | {frac:.0%} | " + " | ".join(row)
+                + " |"
+            )
+    lines.append("")
+    return "\n".join(lines)
+
+
 def apply_to_bench_tables(table_md: str, bench_tables: Path,
                           header: str = SECTION_HEADER) -> None:
     """Idempotently install/replace one generated section: everything
@@ -408,6 +499,13 @@ def main(argv=None) -> int:
                     "n=1024 plus the pool-aggregation op pair, on this "
                     "box's CPU (on-chip regen pending); with --apply the "
                     "section installs into BENCH_TABLES.md idempotently")
+    ap.add_argument("--byzantine", action="store_true",
+                    help="run and append the Byzantine convergence-"
+                    "degradation campaign (ISSUE 16): push-sum under "
+                    "mass_inflate over fraction x topology x "
+                    "countermeasure, fully seeded so repeated applies are "
+                    "byte-identical; with --apply the section installs "
+                    "into BENCH_TABLES.md idempotently")
     args = ap.parse_args(argv)
 
     revs = load_snapshots(args.root)
@@ -450,11 +548,14 @@ def main(argv=None) -> int:
     # extended to the ceilings section by ISSUE 15;
     # tests/test_obs.py pins the idempotence).
     ceilings_md = render_ceilings() if args.ceilings else None
+    byzantine_md = render_byzantine() if args.byzantine else None
     out = table
     if ceilings_md is not None:
         out = out + "\n" + ceilings_md
     if matmul_md is not None:
         out = out + "\n" + matmul_md
+    if byzantine_md is not None:
+        out = out + "\n" + byzantine_md
     print(out)
     if args.md:
         args.md.write_text(out + "\n")
@@ -469,6 +570,11 @@ def main(argv=None) -> int:
             apply_to_bench_tables(
                 matmul_md, args.root / "BENCH_TABLES.md",
                 header=MATMUL_HEADER,
+            )
+        if byzantine_md is not None:
+            apply_to_bench_tables(
+                byzantine_md, args.root / "BENCH_TABLES.md",
+                header=BYZANTINE_HEADER,
             )
         print(f"[trend] applied to {args.root / 'BENCH_TABLES.md'}",
               file=sys.stderr)

@@ -111,6 +111,23 @@ SINGLE_GRID = (
     ("chunked", "full", "push-sum", 1024, 1, {"delivery": "matmul"}),
     ("fused", "full", "gossip", 4096, 1,
      {"engine": "fused", "delivery": "matmul"}),
+    # Byzantine adversary plane (ISSUE 16): the chunked round bodies with
+    # send-time corruption / post-freeze overrides, the robust-clip inbox
+    # bound, and both fused carriers (stencil + pool) with the plane as an
+    # extra VMEM input. The sharded compositions refuse the plane
+    # (models/runner.py), so no AUDIT_GRID rows exist — these cells pin
+    # that the plane changes no wire structure anywhere it runs.
+    ("chunked", "ring", "gossip", 1001, 1,
+     {"byzantine_rate": 0.05, "byzantine_mode": "stale_rumor"}),
+    ("chunked", "full", "push-sum", 1024, 1,
+     {"byzantine_rate": 0.05, "byzantine_mode": "mass_inflate",
+      "robust_agg": "clip"}),
+    ("fused", "torus3d", "push-sum", 4096, 1,
+     {"engine": "fused", "chunk_rounds": 8, "byzantine_rate": 0.05,
+      "byzantine_mode": "mass_inflate"}),
+    ("fused", "full", "gossip", 4096, 1,
+     {"engine": "fused", "delivery": "pool", "byzantine_rate": 0.05,
+      "byzantine_mode": "garble"}),
 )
 
 # Serving batch-engine cells (ISSUE 14): the vmapped continuous chunk +

@@ -49,6 +49,7 @@ def registry() -> dict:
         "round-indices": (0, ROUND_REGION_END),
         "CRASH_TAG": (faults.CRASH_TAG, faults.CRASH_TAG + 1),
         "REVIVE_TAG": (faults.REVIVE_TAG, faults.REVIVE_TAG + 1),
+        "BYZ_TAG": (faults.BYZ_TAG, faults.BYZ_TAG + 1),
         "REPLICA_TAG0": (
             sweep.REPLICA_TAG0, sweep.REPLICA_TAG0 + sweep.MAX_REPLICAS,
         ),

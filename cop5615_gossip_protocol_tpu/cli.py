@@ -154,6 +154,31 @@ def build_parser() -> argparse.ArgumentParser:
                    "parked (s, w) mass (conserving); fresh = reset to "
                    "(s=x_i, w=0), discarding parked mass (the modeled "
                    "fault)")
+    p.add_argument("--byzantine-rate", type=float, default=0.0,
+                   help="adversarial plane: probability each node is "
+                   "Byzantine from round 0 (adversaries stay ALIVE and "
+                   "count toward quorum; behavior per --byzantine-mode)")
+    p.add_argument("--byzantine-schedule", type=str, default=None,
+                   metavar="ROUND:COUNT,...",
+                   help="deterministic adversary onsets: turn COUNT "
+                   "uniformly random nodes Byzantine at each listed round "
+                   "(mutually exclusive with --byzantine-rate)")
+    p.add_argument("--byzantine-mode",
+                   choices=["mass_inflate", "mass_deflate", "stale_rumor",
+                            "garble"],
+                   default="mass_inflate",
+                   help="what adversaries do: push-sum wire corruption "
+                   "(mass_inflate = send the unhalved state, mass_deflate "
+                   "= send negated mass, garble = swap s/w channels); "
+                   "gossip state corruption (stale_rumor = perpetual rumor "
+                   "re-injection, garble = fake convergence)")
+    p.add_argument("--robust-agg", choices=["none", "clip", "trim"],
+                   default="none",
+                   help="push-sum countermeasure (chunked engine): bound "
+                   "per-round accepted contributions — clip scales each "
+                   "received (s, w) pair to a dynamic envelope; trim drops "
+                   "the largest-|w| pool contribution channel "
+                   "(delivery='pool')")
     p.add_argument("--mass-tolerance", type=float, default=None,
                    help="health sentinel (push-sum, chunked/sharded "
                    "engines): every round also checks state finiteness and "
@@ -311,6 +336,10 @@ def _main_refsim(args, parser) -> int:
         "--revive-rate/--revive-schedule": changed("revive_rate")
         or changed("revive_schedule"),
         "--rejoin": changed("rejoin"),
+        "--byzantine-rate/--byzantine-schedule": changed("byzantine_rate")
+        or changed("byzantine_schedule"),
+        "--byzantine-mode": changed("byzantine_mode"),
+        "--robust-agg": changed("robust_agg"),
         "--mass-tolerance": changed("mass_tolerance"),
         "--strict-engine": changed("strict_engine"),
         "--dup-rate": changed("dup_rate"),
@@ -493,6 +522,10 @@ def main(argv: Optional[list[str]] = None) -> int:
             revive_rate=args.revive_rate,
             revive_schedule=args.revive_schedule,
             rejoin=args.rejoin,
+            byzantine_rate=args.byzantine_rate,
+            byzantine_schedule=args.byzantine_schedule,
+            byzantine_mode=args.byzantine_mode,
+            robust_agg=args.robust_agg,
             dup_rate=args.dup_rate,
             delay_rounds=args.delay_rounds,
             quorum=args.quorum,
@@ -617,6 +650,14 @@ def main(argv: Optional[list[str]] = None) -> int:
                 revive_schedule=cfg.revive_schedule,
                 rejoin=cfg.rejoin if cfg.revive_model else None,
                 quorum=cfg.quorum,
+            )
+        if cfg.byzantine_model:
+            events.emit(
+                "byzantine-model-applied",
+                byzantine_rate=cfg.byzantine_rate,
+                byzantine_schedule=cfg.byzantine_schedule,
+                byzantine_mode=cfg.byzantine_mode,
+                robust_agg=cfg.robust_agg,
             )
 
     # The chunk-boundary hook API is CHECKPOINT-ONLY: a hook reads retired

@@ -202,6 +202,48 @@ class SimConfig:
     # conservation intentionally breaks, like dup_rate).
     rejoin: str = "restore"
 
+    # Byzantine adversaries (ops/faults.byzantine_plane, the third seeded
+    # plane): with byzantine_rate F each node independently turns
+    # adversarial from round 0 with probability F; byzantine_schedule
+    # "round:count,..." turns exactly count uniformly random distinct
+    # nodes at each listed round instead. Adversaries are ALIVE — they
+    # send every round and count toward the quorum's live set (lying
+    # about convergence is part of the attack surface; quorum < 1.0 is a
+    # legitimate countermeasure for gossip stale_rumor). Chunked engine
+    # first-class plus the fused stencil/pool kernels; every other
+    # composition refuses loudly.
+    byzantine_rate: float = 0.0
+    byzantine_schedule: str | None = None
+
+    # Adversary behavior. Push-sum modes corrupt the sent (s, w) WIRE pair
+    # (the node's own kept state follows the honest update, so corruption
+    # is purely what neighbors receive): "mass_inflate" — the sent pair is
+    # the UNHALVED state (a copy of the node's mass is injected every
+    # round; the ratio is preserved, so the run converges to a biased
+    # estimate unless the sentinel or robust_agg intervenes);
+    # "mass_deflate" — the sent pair negated (mass drained);
+    # "garble" — the s/w channels swapped (finite, NaN-free garbage).
+    # Gossip modes corrupt protocol STATE: "stale_rumor" — perpetual rumor
+    # re-injection after local convergence (count pinned 0, active pinned
+    # 1 — the node spams forever and never converges); "garble" — fake
+    # convergence reported to the termination predicate (conv latched 1
+    # regardless of receipts). Mode x algorithm validity is enforced at
+    # config time.
+    byzantine_mode: str = "mass_inflate"
+
+    # Robust-aggregation countermeasure (push-sum, chunked engine):
+    # bounds the per-round contributions a RECEIVER accepts. "clip" —
+    # each received (s, w) pair is scaled down to a dynamic envelope (cap
+    # proportional to the receiver's own kept weight; negative-w
+    # contributions are zeroed), pair-consistent so honest ratios pass
+    # through unchanged; "trim" — drop the single largest-|w| per-slot
+    # contribution channel before absorbing (pool delivery only: the pool
+    # tier's sampled contributions arrive as pool_size distinct
+    # channels); "none" (default) accepts everything. Clip/trim DISCARD
+    # mass by design, so robust_agg excludes mass_tolerance (like
+    # dup_rate does).
+    robust_agg: str = "none"
+
     # Per round, each sent message is additionally delivered twice with
     # this probability — at-least-once delivery. For push-sum duplicated
     # mass is CREATED (total mass inflates by the duplicate): that loss of
@@ -219,6 +261,10 @@ class SimConfig:
     # run: sum(conv & alive) >= quorum_need(sum(alive), quorum)
     # (ops/faults.quorum_need). Only meaningful with a crash model — the
     # legacy converged_count >= target predicate rules otherwise.
+    # Byzantine nodes COUNT AS LIVE here: adversaries keep sending, so
+    # excluding them from the live set would let the quorum predicate
+    # silently neutralize stale_rumor/garble attacks the campaign is
+    # measuring.
     quorum: float = 1.0
 
     # Stall watchdog: terminate with outcome="stalled" after this many
@@ -373,9 +419,9 @@ class SimConfig:
                     "revive_rate and revive_schedule are mutually exclusive "
                     "(the schedule IS the recovery process)"
                 )
-            from .ops.faults import parse_crash_schedule
+            from .ops.faults import parse_schedule
 
-            parse_crash_schedule(self.revive_schedule)  # same grammar
+            parse_schedule(self.revive_schedule, "revive")  # same grammar
         if self.revive_model and not self.crash_model:
             raise ValueError(
                 "revive_rate/revive_schedule describe how CRASHED nodes "
@@ -386,6 +432,71 @@ class SimConfig:
             raise ValueError(
                 f"unknown rejoin {self.rejoin!r}; expected restore|fresh"
             )
+        if not (0.0 <= self.byzantine_rate < 1.0):
+            raise ValueError("byzantine_rate must be in [0, 1)")
+        if self.byzantine_schedule is not None:
+            if self.byzantine_rate > 0:
+                raise ValueError(
+                    "byzantine_rate and byzantine_schedule are mutually "
+                    "exclusive (the schedule IS the adversary onset process)"
+                )
+            from .ops.faults import parse_schedule
+
+            parse_schedule(self.byzantine_schedule, "byzantine")  # same grammar
+        if self.byzantine_mode not in (
+            "mass_inflate", "mass_deflate", "stale_rumor", "garble"
+        ):
+            raise ValueError(
+                f"unknown byzantine_mode {self.byzantine_mode!r}; expected "
+                "mass_inflate|mass_deflate|stale_rumor|garble"
+            )
+        if self.byzantine_model:
+            valid_modes = (
+                ("mass_inflate", "mass_deflate", "garble")
+                if self.algorithm == "push-sum"
+                else ("stale_rumor", "garble")
+            )
+            if self.byzantine_mode not in valid_modes:
+                raise ValueError(
+                    f"byzantine_mode {self.byzantine_mode!r} does not apply "
+                    f"to algorithm {self.algorithm!r}: push-sum adversaries "
+                    "corrupt the sent (s, w) wire pair "
+                    "(mass_inflate|mass_deflate|garble); gossip adversaries "
+                    "corrupt protocol state (stale_rumor|garble)"
+                )
+        if self.robust_agg not in ("none", "clip", "trim"):
+            raise ValueError(
+                f"unknown robust_agg {self.robust_agg!r}; expected "
+                "none|clip|trim"
+            )
+        if self.robust_agg != "none":
+            if self.algorithm != "push-sum":
+                raise ValueError(
+                    "robust_agg bounds the push-sum (s, w) contributions a "
+                    "receiver accepts; gossip receipts carry no mass to "
+                    "clip or trim"
+                )
+            if self.mass_tolerance is not None:
+                raise ValueError(
+                    "robust_agg contradicts mass_tolerance: clip/trim "
+                    "DISCARD suspect mass by design, so the conservation "
+                    "sentinel would trip on the countermeasure, not "
+                    "corruption"
+                )
+            if self.robust_agg == "trim" and self.delivery != "pool":
+                raise ValueError(
+                    "robust_agg='trim' drops the largest-|w| channel among "
+                    "the pool tier's per-slot sampled contributions; other "
+                    "deliveries accumulate a single inbox with no channels "
+                    "to trim — use delivery='pool' or robust_agg='clip'"
+                )
+            if self.robust_agg == "trim" and self.topology != "full":
+                raise ValueError(
+                    "robust_agg='trim' applies to the implicit full "
+                    "topology's uniform pool-slot channels; the imp "
+                    "lattice+pool delivery mixes channel classes with no "
+                    "single slot order to trim over — use robust_agg='clip'"
+                )
         if not (0 <= self.delay_rounds <= 64):
             raise ValueError(
                 f"delay_rounds must be in [0, 64], got {self.delay_rounds} "
@@ -456,12 +567,17 @@ class SimConfig:
                 "structure to trace — use batched semantics"
             )
         if self.semantics == "reference" and (
-            self.crash_model or self.dup_rate > 0 or self.delay_rounds > 0
+            self.crash_model
+            or self.dup_rate > 0
+            or self.delay_rounds > 0
+            or self.byzantine_model
+            or self.robust_agg != "none"
         ):
             raise ValueError(
-                "crash/dup/delay fault models contradict reference "
-                "semantics — the reference models zero faults "
-                "(program.fs has no failure path); use batched semantics"
+                "crash/dup/delay/byzantine fault models (and robust_agg) "
+                "contradict reference semantics — the reference models zero "
+                "faults (program.fs has no failure path); use batched "
+                "semantics"
             )
         if self.crash_model and self.termination == "global":
             raise ValueError(
@@ -617,6 +733,13 @@ class SimConfig:
         return self.revive_rate > 0.0 or self.revive_schedule is not None
 
     @property
+    def byzantine_model(self) -> bool:
+        """True when nodes can lie (ops/faults.byzantine_plane is non-None).
+        Byzantine nodes are ALIVE: they send every round and count toward
+        the quorum's live set — independent of the crash model."""
+        return self.byzantine_rate > 0.0 or self.byzantine_schedule is not None
+
+    @property
     def lint_warnings(self) -> tuple[str, ...]:
         """Valid-but-suspect combinations, as human-readable strings — the
         single source of both the conditions and the texts. The CLI prints
@@ -630,6 +753,13 @@ class SimConfig:
                 "crash_rate/crash_schedule, or use target_frac to relax a "
                 "fault-free target"
             )
+        if self.robust_agg != "none" and not self.byzantine_model:
+            out.append(
+                "robust_agg without a byzantine model bounds contributions "
+                "that are all honest — pure overhead that can only discard "
+                "legitimate mass; set byzantine_rate/byzantine_schedule, or "
+                "drop --robust-agg"
+            )
         return tuple(out)
 
     @property
@@ -641,6 +771,7 @@ class SimConfig:
             or self.crash_model
             or self.dup_rate > 0.0
             or self.delay_rounds > 0
+            or self.byzantine_model
         )
 
     @property

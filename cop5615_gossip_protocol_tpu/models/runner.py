@@ -312,6 +312,106 @@ def make_revive_fn(cfg: SimConfig, n: int, life):
     return revive_fn
 
 
+def _byz_dev(cfg: SimConfig, n: int):
+    """Device copy of the adversary plane (ops/faults.byzantine_plane), or
+    None without a byzantine model. Config-pure like the churn planes —
+    every engine rebuilds the identical plane, checkpoints never store
+    it."""
+    byz = faults_mod.byzantine_plane(cfg, n)
+    return None if byz is None else jnp.asarray(byz)
+
+
+def make_byz_send_fn(cfg: SimConfig, byz):
+    """Push-sum wire corruption at send-time (cfg.byzantine_mode): the
+    adversary's KEPT state follows the honest update (s_keep/w_keep are
+    untouched) — only the pair handed to delivery is corrupted.
+    mass_inflate sends the UNHALVED state (a copy of the node's mass is
+    injected per round, ratio preserved); mass_deflate negates the sent
+    pair (mass drained); garble swaps the s/w channels (finite, NaN-free
+    garbage). ``send_ok`` is already alive/gate-masked, so dead or gated
+    adversaries stay silent like honest nodes. None for gossip / without
+    a plane."""
+    if byz is None or cfg.algorithm != "push-sum":
+        return None
+    mode = cfg.byzantine_mode
+
+    def corrupt(s_send, w_send, state, send_ok, round_idx):
+        lying = faults_mod.byzantine_at(byz, round_idx) & send_ok
+        if mode == "mass_inflate":
+            return (
+                jnp.where(lying, state.s, s_send),
+                jnp.where(lying, state.w, w_send),
+            )
+        if mode == "mass_deflate":
+            return (
+                jnp.where(lying, -s_send, s_send),
+                jnp.where(lying, -w_send, w_send),
+            )
+        # garble: the channels swapped — finite garbage, wire unchanged.
+        return (
+            jnp.where(lying, w_send, s_send),
+            jnp.where(lying, s_send, w_send),
+        )
+
+    return corrupt
+
+
+def make_byz_override_fn(cfg: SimConfig, byz, life):
+    """Gossip adversary behavior as a state override applied at the END of
+    the round body, after _freeze_dead — the fused kernels apply it at
+    the same position, so cross-engine trajectories stay bitwise.
+    stale_rumor pins count 0 / active 1 / conv 0 (perpetual rumor
+    re-injection after local convergence — the node spams forever and
+    never converges); garble latches conv 1 (fake convergence reported to
+    the termination predicate). Dead adversaries stay frozen. None for
+    push-sum / without a plane."""
+    if byz is None or cfg.algorithm == "push-sum":
+        return None
+    mode = cfg.byzantine_mode
+
+    def override(state, round_idx):
+        lying = faults_mod.byzantine_at(byz, round_idx)
+        if life is not None:
+            lying = lying & faults_mod.alive_at(
+                life.death, round_idx, life.revive
+            )
+        if mode == "stale_rumor":
+            return gossip_mod.GossipState(
+                count=jnp.where(lying, jnp.int32(0), state.count),
+                active=state.active | lying,
+                conv=state.conv & ~lying,
+            )
+        # garble
+        return state._replace(conv=state.conv | lying)
+
+    return override
+
+
+def make_robust_clip_fn(cfg: SimConfig):
+    """--robust-agg clip (push-sum, chunked engine): bound the aggregate
+    (s, w) inbox a receiver accepts this round to a dynamic envelope —
+    cap = 2 * max(w_keep, 1), proportional to the receiver's own kept
+    weight. Pair-consistent: both channels scale together, so the inbox
+    ratio (and with it the estimate) passes through unchanged — clipping
+    discards WEIGHT, never injects bias. Non-positive-w inboxes are
+    rejected outright (mass_deflate's signature). None unless
+    robust_agg == 'clip' (trim lives in the pool delivery,
+    ops/delivery.deliver_pool_trimmed)."""
+    if cfg.robust_agg != "clip" or cfg.algorithm != "push-sum":
+        return None
+
+    def clip(inbox_s, inbox_w, w_keep):
+        dt = inbox_w.dtype
+        one = jnp.ones((), dt)
+        cap = jnp.asarray(2.0, dt) * jnp.maximum(w_keep, one)
+        over = inbox_w > cap
+        scale = jnp.where(over, cap / jnp.where(over, inbox_w, one), one)
+        scale = jnp.where(inbox_w > 0, scale, jnp.zeros((), dt))
+        return inbox_s * scale, inbox_w * scale
+
+    return clip
+
+
 def _done_predicate(cfg: SimConfig, life, target: int):
     """The while-loop termination predicate, as ``done(state, round_idx)``
     with round_idx the round JUST EXECUTED. Legacy: converged_count >=
@@ -423,6 +523,10 @@ def make_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array):
     deliver_fn = resolve_deliver_fn(topo, cfg)
     life = _life_dev(cfg, n)
     revive_fn = make_revive_fn(cfg, n, life)
+    byz = _byz_dev(cfg, n)
+    corrupt_fn = make_byz_send_fn(cfg, byz)
+    byz_override = make_byz_override_fn(cfg, byz, life)
+    clip_fn = make_robust_clip_fn(cfg)
 
     def _rejoin(state, round_idx):
         """Revival-round reset, applied at round-body entry (see
@@ -496,14 +600,24 @@ def make_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array):
                 s_send, w_send, s_keep, w_keep = pushsum_mod.halve_and_send(
                     state.s, state.w, send_ok
                 )
+                if corrupt_fn is not None:
+                    # Corruption happens at send-time: the lie is what
+                    # enters the ring, so it arrives D rounds later like
+                    # any other in-flight message.
+                    s_send, w_send = corrupt_fn(
+                        s_send, w_send, state, send_ok, round_idx
+                    )
                 fresh = jnp.stack([df(s_send, targets), df(w_send, targets)])
                 slot = lax.rem(round_idx, jnp.int32(D))
                 arrive = lax.dynamic_index_in_dim(
                     ring, slot, axis=0, keepdims=False
                 )
                 ring = lax.dynamic_update_index_in_dim(ring, fresh, slot, 0)
+                in_s, in_w = arrive[0], arrive[1]
+                if clip_fn is not None:
+                    in_s, in_w = clip_fn(in_s, in_w, w_keep)
                 new = pushsum_mod.absorb(
-                    state, s_keep, w_keep, arrive[0], arrive[1], delta,
+                    state, s_keep, w_keep, in_s, in_w, delta,
                     term_rounds, cfg.termination == "global",
                 )
                 return (_freeze_dead(life, state, new, round_idx), ring)
@@ -515,10 +629,36 @@ def make_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array):
                 targets, send_ok, dup = targets_and_gate(
                     round_idx, key_data, *targs
                 )
-                new = pushsum_mod.round_from_targets(
-                    state, targets, send_ok, n, delta, term_rounds,
-                    make_df(dup), cfg.termination == "global",
-                )
+                if corrupt_fn is None and clip_fn is None:
+                    new = pushsum_mod.round_from_targets(
+                        state, targets, send_ok, n, delta, term_rounds,
+                        make_df(dup), cfg.termination == "global",
+                    )
+                else:
+                    # round_from_targets inlined so the wire pair can be
+                    # corrupted after the halve and the inbox clipped
+                    # before the absorb — identical op sequence otherwise.
+                    df = make_df(dup)
+                    with jax.named_scope("pushsum_halve"):
+                        s_send, w_send, s_keep, w_keep = (
+                            pushsum_mod.halve_and_send(
+                                state.s, state.w, send_ok
+                            )
+                        )
+                    if corrupt_fn is not None:
+                        s_send, w_send = corrupt_fn(
+                            s_send, w_send, state, send_ok, round_idx
+                        )
+                    with jax.named_scope("pushsum_deliver"):
+                        in_s = df(s_send, targets)
+                        in_w = df(w_send, targets)
+                    if clip_fn is not None:
+                        in_s, in_w = clip_fn(in_s, in_w, w_keep)
+                    with jax.named_scope("pushsum_absorb"):
+                        new = pushsum_mod.absorb(
+                            state, s_keep, w_keep, in_s, in_w, delta,
+                            term_rounds, cfg.termination == "global",
+                        )
                 return _freeze_dead(life, state, new, round_idx)
 
     else:
@@ -547,7 +687,10 @@ def make_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array):
                 )
                 ring = lax.dynamic_update_index_in_dim(ring, fresh, slot, 0)
                 new = gossip_mod.absorb(state, arrive, rumor_target, suppress)
-                return (_freeze_dead(life, state, new, round_idx), ring)
+                new = _freeze_dead(life, state, new, round_idx)
+                if byz_override is not None:
+                    new = byz_override(new, round_idx)
+                return (new, ring)
 
         else:
 
@@ -560,7 +703,10 @@ def make_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array):
                     state, targets, send_ok, n, rumor_target, suppress,
                     make_df(dup),
                 )
-                return _freeze_dead(life, state, new, round_idx)
+                new = _freeze_dead(life, state, new, round_idx)
+                if byz_override is not None:
+                    new = byz_override(new, round_idx)
+                return new
 
     return round_fn, state0, key_data, topo_args
 
@@ -577,13 +723,23 @@ def _make_pool_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array, dty
     key_data, key_impl = sampling.key_split(base_key)
     life = _life_dev(cfg, n)
     revive_fn = make_revive_fn(cfg, n, life)
+    byz = _byz_dev(cfg, n)
+    corrupt_fn = make_byz_send_fn(cfg, byz)
+    byz_override = make_byz_override_fn(cfg, byz, life)
+    clip_fn = make_robust_clip_fn(cfg)
+    trim = cfg.robust_agg == "trim"
     matmul = cfg.delivery == "matmul"
 
     def deliver_channels(channels, choice, offs):
         """The round's delivery mechanism: masked rolls (pool) or the
         blocked one-hot dot_general over the SAME implied targets
         (matmul — the MXU tier). Integer channels are bitwise-identical
-        either way; floats differ only by summation order."""
+        either way; floats differ only by summation order. robust_agg=
+        'trim' swaps in the trimmed pool aggregation (deliver_pool minus
+        each receiver's largest-|w| slot channel); config restricts trim
+        to delivery='pool'."""
+        if trim:
+            return delivery_mod.deliver_pool_trimmed(channels, choice, offs)
         if matmul:
             ids = jnp.arange(n, dtype=jnp.int32)
             targets = sampling.targets_pool(choice, offs, ids, n)
@@ -623,13 +779,20 @@ def _make_pool_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array, dty
                 s_send, w_send, s_keep, w_keep = pushsum_mod.halve_and_send(
                     state.s, state.w, send_ok
                 )
+            if corrupt_fn is not None:
+                s_send, w_send = corrupt_fn(
+                    s_send, w_send, state, send_ok, round_idx
+                )
             with jax.named_scope("pushsum_deliver"):
                 inbox = deliver_channels(
                     jnp.stack([s_send, w_send]), choice, offs
                 )
+            in_s, in_w = inbox[0], inbox[1]
+            if clip_fn is not None:
+                in_s, in_w = clip_fn(in_s, in_w, w_keep)
             with jax.named_scope("pushsum_absorb"):
                 new = pushsum_mod.absorb(
-                    state, s_keep, w_keep, inbox[0], inbox[1], delta,
+                    state, s_keep, w_keep, in_s, in_w, delta,
                     term_rounds, cfg.termination == "global",
                 )
             return _freeze_dead(life, state, new, round_idx)
@@ -653,7 +816,10 @@ def _make_pool_round_fn(topo: Topology, cfg: SimConfig, base_key: jax.Array, dty
                 # Suppression is receiver-side (models/gossip.absorb): no
                 # pool_lookup backward rolls needed.
                 new = gossip_mod.absorb(state, inbox, rumor_target, suppress)
-            return _freeze_dead(life, state, new, round_idx)
+            new = _freeze_dead(life, state, new, round_idx)
+            if byz_override is not None:
+                new = byz_override(new, round_idx)
+            return new
 
     return round_fn, state0, key_data, ()
 
@@ -710,6 +876,10 @@ def _make_imp_pool_round_fn(
     lattice_offsets = tuple(int(q) for q in split.lattice_offsets)
     life = _life_dev(cfg, n)
     revive_fn = make_revive_fn(cfg, n, life)
+    byz = _byz_dev(cfg, n)
+    corrupt_fn = make_byz_send_fn(cfg, byz)
+    byz_override = make_byz_override_fn(cfg, byz, life)
+    clip_fn = make_robust_clip_fn(cfg)
     matmul = cfg.delivery == "matmul"
 
     def deliver_channels(channels, d, is_extra, choice, offs):
@@ -760,13 +930,20 @@ def _make_imp_pool_round_fn(
                 s_send, w_send, s_keep, w_keep = pushsum_mod.halve_and_send(
                     state.s, state.w, send_ok
                 )
+            if corrupt_fn is not None:
+                s_send, w_send = corrupt_fn(
+                    s_send, w_send, state, send_ok, round_idx
+                )
             with jax.named_scope("pushsum_deliver"):
                 inbox = deliver_channels(
                     jnp.stack([s_send, w_send]), d, is_extra, choice, offs
                 )
+            in_s, in_w = inbox[0], inbox[1]
+            if clip_fn is not None:
+                in_s, in_w = clip_fn(in_s, in_w, w_keep)
             with jax.named_scope("pushsum_absorb"):
                 new = pushsum_mod.absorb(
-                    state, s_keep, w_keep, inbox[0], inbox[1], delta,
+                    state, s_keep, w_keep, in_s, in_w, delta,
                     term_rounds, cfg.termination == "global",
                 )
             return _freeze_dead(life, state, new, round_idx)
@@ -788,7 +965,10 @@ def _make_imp_pool_round_fn(
                 )[0]
             with jax.named_scope("gossip_absorb"):
                 new = gossip_mod.absorb(state, inbox, rumor_target, suppress)
-            return _freeze_dead(life, state, new, round_idx)
+            new = _freeze_dead(life, state, new, round_idx)
+            if byz_override is not None:
+                new = byz_override(new, round_idx)
+            return new
 
     return round_fn, state0, key_data, topo_args
 
@@ -1091,6 +1271,20 @@ def _run_fused(
             "telemetry counters run in the fused stencil and pool kernels "
             f"only; the {variant!r} tier does not carry the counter block — "
             "use engine='chunked' or a telemetry-capable population"
+        )
+    if cfg.byzantine_model and variant not in ("stencil", "pool"):
+        # Same defense-in-depth as telemetry: the adversary plane is an
+        # extra VMEM operand of those two kernels only.
+        raise ValueError(
+            "the byzantine adversary plane is threaded through the fused "
+            f"stencil and pool kernels only; the {variant!r} tier does "
+            "not carry it — use engine='chunked'"
+        )
+    if cfg.robust_agg != "none":
+        raise ValueError(
+            "robust aggregation runs in the chunked XLA round bodies; "
+            "the fused kernels do not implement clip/trim — use "
+            "engine='chunked'"
         )
 
     def chunk_call(state_dev, rnd, done, cap):
@@ -1446,6 +1640,20 @@ def _run_resolved(
                     "fused compositions do not carry it — drop the engine "
                     "override"
                 )
+            if cfg.byzantine_model:
+                raise ValueError(
+                    "the byzantine adversary plane is threaded through "
+                    "the chunked engine and the single-device fused "
+                    "stencil/pool kernels; the sharded fused compositions "
+                    "do not carry the plane — drop the engine override"
+                )
+            if cfg.robust_agg != "none":
+                raise ValueError(
+                    "robust aggregation (--robust-agg) bounds inboxes in "
+                    "the chunked XLA round bodies only; the sharded fused "
+                    "compositions do not carry it — drop the engine "
+                    "override"
+                )
             if topo.kind in ("imp2d", "imp3d") and cfg.delivery == "matmul":
                 raise ValueError(
                     "engine='fused' with delivery='matmul' on imp kinds "
@@ -1570,6 +1778,14 @@ def _run_resolved(
                 "engine, the fused pool kernels, and the replicated-pool2 "
                 "composition (engine='fused') — drop n_devices or use "
                 "delivery='pool'"
+            )
+        if cfg.byzantine_model or cfg.robust_agg != "none":
+            raise ValueError(
+                "the byzantine adversary plane and robust aggregation run "
+                "on the single-device chunked engine (and, for the plane, "
+                "the fused stencil/pool kernels); the sharded XLA "
+                "composition does not thread them through its shard-mapped "
+                "round body — drop n_devices"
             )
         # delivery='stencil' is legal under sharding: the halo-exchange plan
         # (parallel/halo.py) implements it as local shifts + boundary
@@ -1720,6 +1936,28 @@ def _run_resolved(
             reason = (
                 "the health sentinel (--mass-tolerance) runs in the "
                 "chunked/sharded XLA round bodies only"
+            )
+            auto_ok = False
+        if cfg.byzantine_model and reason is None and variant not in (
+            "stencil", "pool"
+        ):
+            # The adversary plane rides as an extra VMEM operand in the
+            # whole-array stencil and pool kernels; the streaming HBM/imp
+            # tiers do not thread it. auto demotes to the chunked engine;
+            # engine='fused' fails loudly below.
+            reason = (
+                "the byzantine adversary plane rides the fused "
+                f"stencil/pool kernels only (selected tier: {variant!r}); "
+                "other tiers run it on the chunked engine"
+            )
+            auto_ok = False
+        if cfg.robust_agg != "none" and reason is None:
+            # clip/trim bound contributions in the XLA round bodies; no
+            # fused kernel implements them. auto demotes; engine='fused'
+            # fails loudly below.
+            reason = (
+                "robust aggregation (--robust-agg) bounds inboxes in the "
+                "chunked XLA round bodies only"
             )
             auto_ok = False
         if cfg.engine == "fused":

@@ -305,3 +305,47 @@ def deliver_pool(channels, choice, offsets):
         inbox = inbox + jnp.roll(masked, offsets[k], axis=1)
     return inbox
 
+
+def deliver_pool_trimmed(channels, choice, offsets):
+    """``deliver_pool`` minus, per receiver with two or more contributing
+    slots, the largest-|w| pool-slot contribution — the
+    --robust-agg='trim' countermeasure (push-sum only; ``channels`` is
+    the [2, n] (s, w) stack, w in row 1).
+
+    Each of the K pool slots lands on a receiver as one masked roll — a
+    distinct contribution channel — so trimmed aggregation can drop the
+    most extreme channel BEFORE the sum: a byzantine sender inflating (or
+    draining — the max is over |w|) through any single slot contributes
+    nothing to the receiver's accepted inbox that round. The (s, w) pair
+    of the dropped slot is removed together, so the surviving aggregate
+    stays pair-consistent and unbiased. A receiver's SOLE contribution is
+    kept: pool in-degree is ~Poisson(1), so trimming singletons would
+    sever most receivers' only mixing path and halt convergence outright
+    — the guard trades per-round protection against lone adversarial
+    hits for a protocol that still mixes. Streaming max keeps memory at
+    O(C·n) — no [K, C, n] materialization — and the surviving slots
+    accumulate in the same static slot order as deliver_pool. Trimming
+    discards honest weight whenever the dropped maximum was legitimate,
+    which slows mixing but never biases it; mass_tolerance is excluded
+    at config time because accepted mass is no longer conserved by
+    construction.
+    """
+    inbox = jnp.zeros_like(channels)
+    zero = jnp.zeros((), channels.dtype)
+    best = jnp.zeros_like(channels)
+    # -1 sentinel: slot 0 always becomes the initial "largest" even when
+    # its contribution is zero — dropping a zero channel is a no-op.
+    best_absw = jnp.full(channels.shape[1:], -1.0, channels.dtype)
+    contribs = jnp.zeros(channels.shape[1:], jnp.int32)
+    for k in range(offsets.shape[0]):
+        masked = jnp.where((choice == k)[None, :], channels, zero)
+        contrib = jnp.roll(masked, offsets[k], axis=1)
+        inbox = inbox + contrib
+        absw = jnp.abs(contrib[1])
+        contribs = contribs + (absw > 0).astype(jnp.int32)
+        better = absw > best_absw
+        best = jnp.where(better[None, :], contrib, best)
+        best_absw = jnp.maximum(best_absw, absw)
+    drop = contribs >= 2
+    return inbox - jnp.where(drop[None, :], best, zero)
+

@@ -67,6 +67,31 @@ Message faults
     buffer (models/runner.py) — in-flight mass lives in the ring, so
     conservation holds over state + ring.
 
+Byzantine adversaries (``--byzantine-rate`` / ``--byzantine-schedule``)
+    The third seeded plane: every node gets an **adversary onset round** —
+    an int32 plane derived from ``PRNGKey(cfg.seed)`` + BYZ_TAG, NEVER
+    where the node stays honest. Node ``i`` is adversarial during round
+    ``r`` iff ``byz[i] <= r`` (``byzantine_at``); once turned, a node
+    never reverts. ``byzantine_rate`` F turns each node adversarial from
+    round 0 independently with probability F; ``byzantine_schedule``
+    "round:count,..." turns exactly ``count`` uniformly random distinct
+    nodes at each listed round. Unlike crashed nodes, adversaries are
+    ALIVE: they send every round, count toward the quorum's live set, and
+    (deliberately) toward the converged target when a mode latches their
+    conv plane — lying about convergence is part of the attack surface.
+    What an adversary sends/reports is the ``byzantine_mode``
+    (SimConfig): push-sum wire corruption (``mass_inflate`` — the sent
+    (s, w) pair is the UNHALVED state, injecting a copy of the node's
+    mass each round; ``mass_deflate`` — the sent pair negated, draining
+    mass; ``garble`` — the s/w channels swapped, finite NaN-free
+    garbage), or gossip state corruption (``stale_rumor`` — the node
+    re-injects the rumor forever: count pinned 0, active pinned 1, never
+    converges; ``garble`` — fake convergence: conv latched 1 toward the
+    termination predicate regardless of receipts). Corruption is
+    elementwise at send/absorb time — the delivery wire is untouched
+    (the static-audit WIRE_SPECs must not change). The countermeasure
+    (``--robust-agg``) bounds what RECEIVERS accept; see models/runner.py.
+
 Base-key fold_in TAG MAP (the canonical home — every other module's tag
 comment points here). MACHINE-VERIFIED since ISSUE 11: the static auditor
 rebuilds this map from the real constants and proves the regions pairwise
@@ -83,6 +108,7 @@ IMP_CHOICE_TAG) are a different stream level entirely:
                           exactly to keep this region closed)
     CRASH_TAG             2**30 + 0xDEAD        death-plane draw
     REVIVE_TAG            2**30 + 0xA11FE       revival-plane draw
+    BYZ_TAG               2**30 + 0xBAD0        byzantine-plane draw
     REPLICA_TAG0 + r      2**30 + 2**29 + r     replica keys, r < 4096
                           (models/sweep.py; replica 0 rides the base key)
     LANE_FILLER_TAG0 + i  2**30 + 2**29 + 4096 + i   serving batch FILLER
@@ -117,6 +143,12 @@ CRASH_TAG = 2**30 + 0xDEAD
 # never be bitwise the death draw.
 REVIVE_TAG = 2**30 + 0xA11FE
 
+# Byzantine-plane fold_in tag — the third seeded plane's draw. Same region
+# as CRASH_TAG/REVIVE_TAG, pairwise distinct from both (the analysis
+# checker re-proves disjointness from the real constants — analysis/
+# tags.py registry; tests/test_recovery.py sweeps all three pairs).
+BYZ_TAG = 2**30 + 0xBAD0
+
 # Death round of a node that never crashes / revival round of a node that
 # never rejoins. Above any reachable round (max_rounds <= 2**30, enforced
 # by SimConfig).
@@ -133,8 +165,11 @@ class LifePlanes(NamedTuple):
     revive: Optional[object]  # int32 [n] or None
 
 
-def parse_crash_schedule(spec: str) -> tuple[tuple[int, int], ...]:
-    """Parse "round:count,round:count,..." into sorted (round, count) pairs.
+def parse_schedule(spec: str, kind: str = "crash") -> tuple[tuple[int, int], ...]:
+    """Parse "round:count,round:count,..." into sorted (round, count) pairs
+    — the ONE grammar shared by the crash, revive, and byzantine schedules.
+    ``kind`` only names the schedule in the error texts; the wording
+    template is pinned here once (tests pin it through every caller).
 
     Rounds must be distinct non-negative ints, counts positive. Raises
     ValueError with the offending token — the CLI surfaces it verbatim.
@@ -147,26 +182,32 @@ def parse_crash_schedule(spec: str) -> tuple[tuple[int, int], ...]:
         parts = token.split(":")
         if len(parts) != 2:
             raise ValueError(
-                f"crash schedule entry {token!r} is not 'round:count'"
+                f"{kind} schedule entry {token!r} is not 'round:count'"
             )
         try:
             rnd, count = int(parts[0]), int(parts[1])
         except ValueError:
             raise ValueError(
-                f"crash schedule entry {token!r} is not 'round:count' "
+                f"{kind} schedule entry {token!r} is not 'round:count' "
                 "with integer fields"
             ) from None
         if rnd < 0:
-            raise ValueError(f"crash schedule round {rnd} must be >= 0")
+            raise ValueError(f"{kind} schedule round {rnd} must be >= 0")
         if count <= 0:
-            raise ValueError(f"crash schedule count {count} must be > 0")
+            raise ValueError(f"{kind} schedule count {count} must be > 0")
         events.append((rnd, count))
     if not events:
-        raise ValueError(f"crash schedule {spec!r} has no entries")
+        raise ValueError(f"{kind} schedule {spec!r} has no entries")
     rounds = [r for r, _ in events]
     if len(set(rounds)) != len(rounds):
-        raise ValueError(f"crash schedule {spec!r} repeats a round")
+        raise ValueError(f"{kind} schedule {spec!r} repeats a round")
     return tuple(sorted(events))
+
+
+def parse_crash_schedule(spec: str) -> tuple[tuple[int, int], ...]:
+    """The crash-schedule spelling of ``parse_schedule`` (kept as the
+    public name SimConfig and the tests import)."""
+    return parse_schedule(spec, "crash")
 
 
 def death_plane(cfg, n: int):
@@ -247,7 +288,7 @@ def _revival_plane_cached(
     if revive_schedule is not None:
         # Deterministic rejoin: at each listed round, the first `count`
         # still-dead nodes in a fixed uniform permutation order rejoin.
-        events = parse_crash_schedule(revive_schedule)  # same grammar
+        events = parse_schedule(revive_schedule, "revive")  # same grammar
         perm = np.asarray(jax.random.permutation(key, n))
         assigned = np.zeros((n,), bool)
         for rnd, count in events:
@@ -271,6 +312,57 @@ def _revival_plane_cached(
     rev = death.astype(np.int64) + dead_time.astype(np.int64)
     revive[dead] = np.clip(rev, 0, int(NEVER)).astype(np.int32)[dead]
     return revive
+
+
+def byzantine_plane(cfg, n: int):
+    """int32 [n] adversary onset rounds (np.ndarray), or None when the
+    config has no Byzantine model. NEVER where the node stays honest.
+
+    Derived from ``PRNGKey(cfg.seed)`` + BYZ_TAG only — a pure function of
+    (cfg, n) like the death/revival planes, so every engine rebuilds the
+    identical plane (the fused kernels bake it as a kernel constant) and
+    checkpoints never store it (--resume rebuilds from config alone; the
+    chaos harness proves that end to end). Memoized; treat the returned
+    array as READ-ONLY."""
+    if not cfg.byzantine_model:
+        return None
+    return _byzantine_plane_cached(
+        cfg.seed, cfg.byzantine_rate, cfg.byzantine_schedule, n
+    )
+
+
+@functools.lru_cache(maxsize=4)
+def _byzantine_plane_cached(
+    seed: int, byzantine_rate: float, byzantine_schedule, n: int
+):
+    import jax
+    import jax.numpy as jnp
+
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), BYZ_TAG)
+    if byzantine_schedule is not None:
+        events = parse_schedule(byzantine_schedule, "byzantine")
+        total = sum(c for _, c in events)
+        if total > n:
+            raise ValueError(
+                f"byzantine schedule turns {total} nodes but the "
+                f"population is {n}"
+            )
+        perm = np.asarray(jax.random.permutation(key, n))
+        byz = np.full((n,), NEVER, np.int32)
+        off = 0
+        for rnd, count in events:
+            byz[perm[off : off + count]] = rnd
+            off += count
+        return byz
+    # Rate form: each node independently turns adversarial FROM ROUND 0
+    # with probability F — a fixed adversarial fraction, the quantity the
+    # degradation campaign sweeps (trend.py --byzantine). A per-round
+    # geometric onset would conflate fraction with time; the schedule form
+    # covers staged onsets.
+    u = np.asarray(jax.random.uniform(key, (n,), jnp.float32))
+    return np.where(u < np.float32(byzantine_rate), 0, int(NEVER)).astype(
+        np.int32
+    )
 
 
 def life_planes(cfg, n: int) -> Optional[LifePlanes]:
@@ -302,6 +394,24 @@ def pad_revival_plane(revive: np.ndarray, n_pad: int) -> np.ndarray:
     return np.concatenate(
         [revive, np.full((n_pad - revive.shape[0],), NEVER, np.int32)]
     )
+
+
+def pad_byzantine_plane(byz: np.ndarray, n_pad: int) -> np.ndarray:
+    """Pad to n_pad with NEVER: padded slots are honest (and dead — the
+    death plane pads them with round 0), so adversary-count reductions
+    over padded layouts equal the unpadded count without extra masking."""
+    if byz.shape[0] == n_pad:
+        return byz
+    return np.concatenate(
+        [byz, np.full((n_pad - byz.shape[0],), NEVER, np.int32)]
+    )
+
+
+def byzantine_at(byz, round_idx):
+    """bool adversary mask for round ``round_idx`` (both may be traced):
+    adversarial exactly from the onset round on — a turned node never
+    reverts."""
+    return byz <= round_idx
 
 
 def alive_at(death, round_idx, revive=None):

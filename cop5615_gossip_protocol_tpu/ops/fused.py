@@ -301,6 +301,22 @@ def build_revive2d(cfg: SimConfig, n: int, n_pad: int):
     )
 
 
+def build_byz2d(cfg: SimConfig, n: int, n_pad: int):
+    """[n_pad // 128, 128] int32 adversary plane for a fused kernel, or
+    None without a byzantine model. Padded with NEVER — pad slots are
+    honest forever (ops/faults.pad_byzantine_plane), so in-kernel
+    byzantine reductions equal the real adversary count with no extra
+    masking."""
+    byz = faults_mod.byzantine_plane(cfg, n)
+    if byz is None:
+        return None
+    return jnp.asarray(
+        faults_mod.pad_byzantine_plane(byz, n_pad).reshape(
+            n_pad // LANES, LANES
+        )
+    )
+
+
 def alive_plane(death_ref, revive_ref, round_idx):
     """In-kernel alive mask over whole [R, 128] churn-plane refs —
     faults.alive_at on VMEM refs (revive_ref None without a recovery
@@ -421,6 +437,13 @@ def make_pushsum_chunk(
     fresh_rejoin = cfg.rejoin == "fresh"
     init_term = np.int32(cfg.initial_term_round)
     quorum = cfg.quorum
+    # Adversary plane (ops/faults.byzantine_plane) as an extra VMEM
+    # operand; corruption at send-time in the round body, mirroring
+    # models/runner.make_byz_send_fn. Python-level flag — a byzantine-free
+    # config traces the identical kernel as before.
+    byz2d = build_byz2d(cfg, topo.n, layout.n_pad)
+    byzantine = byz2d is not None
+    byz_mode = cfg.byzantine_mode
     # Telemetry plane (ops/telemetry.py): each active grid step folds one
     # counter row into a VMEM scratch register; every grid step copies it
     # to that step's row of the counter-block output. Python-level flag —
@@ -435,6 +458,7 @@ def make_pushsum_chunk(
         disp_ref, deg_ref = next(it), next(it)
         death_ref = next(it) if crashed else None
         revive_ref = next(it) if revived else None
+        byz_ref = next(it) if byzantine else None
         s0, w0, t0, c0 = next(it), next(it), next(it), next(it)
         s_o, w_o, t_o, c_o, meta_o = (
             next(it), next(it), next(it), next(it), next(it)
@@ -504,15 +528,30 @@ def make_pushsum_chunk(
             zero = jnp.float32(0)
             s_send = jnp.where(send_ok, s * jnp.float32(0.5), zero)
             w_send = jnp.where(send_ok, w * jnp.float32(0.5), zero)
+            s_wire, w_wire = s_send, w_send
+            if byzantine:
+                # Wire corruption at send-time (models/runner.
+                # make_byz_send_fn, same ordering): the kept state follows
+                # the honest halve — only the delivered pair lies.
+                lying = (byz_ref[:] <= rnd) & send_ok
+                if byz_mode == "mass_inflate":
+                    s_wire = jnp.where(lying, s, s_send)
+                    w_wire = jnp.where(lying, w, w_send)
+                elif byz_mode == "mass_deflate":
+                    s_wire = jnp.where(lying, -s_send, s_send)
+                    w_wire = jnp.where(lying, -w_send, w_send)
+                else:  # garble: the channels swapped
+                    s_wire = jnp.where(lying, w_send, s_send)
+                    w_wire = jnp.where(lying, s_send, w_send)
             inbox_s = jnp.zeros_like(s)
             inbox_w = jnp.zeros_like(w)
             for d_mod, shift in layout.shifts:
                 m = disp == d_mod
                 inbox_s = inbox_s + _flat_roll(
-                    jnp.where(m, s_send, zero), shift, interpret
+                    jnp.where(m, s_wire, zero), shift, interpret
                 )
                 inbox_w = inbox_w + _flat_roll(
-                    jnp.where(m, w_send, zero), shift, interpret
+                    jnp.where(m, w_wire, zero), shift, interpret
                 )
             # Absorb — mirrors models/pushsum.absorb (program.fs:119-143).
             s_new = (s - s_send) + inbox_s
@@ -610,9 +649,16 @@ def make_pushsum_chunk(
                     )
                     if revived else jnp.int32(0)
                 )
+                byz_ct = (
+                    jnp.sum(
+                        (byz_ref[:] <= rnd).astype(jnp.int32),
+                        dtype=jnp.int32,
+                    )
+                    if byzantine else jnp.int32(0)
+                )
                 trow[:] = telemetry_row(
                     [conv_ct, live, gap, 0.0, mae, mass, drops, 0.0,
-                     revived_ct]
+                     revived_ct, byz_ct]
                 )
 
         if telemetry:
@@ -669,6 +715,9 @@ def make_pushsum_chunk(
         if revived:
             in_specs.append(plane)
             operands.append(revive2d)
+        if byzantine:
+            in_specs.append(plane)
+            operands.append(byz2d)
         in_specs += [plane] * 4
         operands += [s, w, t, c]
         out_shape = [f32, f32, i32, i32, jax.ShapeDtypeStruct((1,), jnp.int32)]
@@ -726,6 +775,12 @@ def make_gossip_chunk(topo: Topology, cfg: SimConfig, *, interpret: bool = False
     revived = revive2d is not None
     quorum = cfg.quorum
     telemetry = cfg.telemetry  # see make_pushsum_chunk: Python-level flag
+    # Gossip adversaries override protocol state at the END of the round
+    # body, after the crash freeze — the same position as the chunked
+    # engine's make_byz_override_fn, so trajectories stay bitwise.
+    byz2d = build_byz2d(cfg, topo.n, layout.n_pad)
+    byzantine = byz2d is not None
+    byz_mode = cfg.byzantine_mode
 
     def kernel(*refs):
         it = iter(refs)
@@ -734,6 +789,7 @@ def make_gossip_chunk(topo: Topology, cfg: SimConfig, *, interpret: bool = False
         disp_ref, deg_ref = next(it), next(it)
         death_ref = next(it) if crashed else None
         revive_ref = next(it) if revived else None
+        byz_ref = next(it) if byzantine else None
         n0, a0, c0 = next(it), next(it), next(it)
         n_o, a_o, c_o, meta_o = next(it), next(it), next(it), next(it)
         tele_o = next(it) if telemetry else None
@@ -806,6 +862,21 @@ def make_gossip_chunk(topo: Topology, cfg: SimConfig, *, interpret: bool = False
                 (a_v[:] != 0) | (inbox > 0), jnp.int32(1), jnp.int32(0)
             )
             conv_new = jnp.where(count_new >= rumor_target, jnp.int32(1), jnp.int32(0))
+            if byzantine:
+                # Post-freeze state override (models/runner.
+                # make_byz_override_fn): applied every adversarial round —
+                # conv is recomputed from count each absorb, so a one-time
+                # override would decay. Dead adversaries stay frozen; pad
+                # lanes carry NEVER and are never lying.
+                lying = byz_ref[:] <= rnd
+                if crashed:
+                    lying = lying & alive
+                if byz_mode == "stale_rumor":
+                    count_new = jnp.where(lying, jnp.int32(0), count_new)
+                    active_new = jnp.where(lying, jnp.int32(1), active_new)
+                    conv_new = jnp.where(lying, jnp.int32(0), conv_new)
+                else:  # garble: fake convergence
+                    conv_new = jnp.where(lying, jnp.int32(1), conv_new)
             n_v[:] = count_new
             a_v[:] = active_new
             c_v[:] = conv_new
@@ -842,9 +913,16 @@ def make_gossip_chunk(topo: Topology, cfg: SimConfig, *, interpret: bool = False
                     )
                     if revived else jnp.int32(0)
                 )
+                byz_ct = (
+                    jnp.sum(
+                        (byz_ref[:] <= rnd).astype(jnp.int32),
+                        dtype=jnp.int32,
+                    )
+                    if byzantine else jnp.int32(0)
+                )
                 trow[:] = telemetry_row(
                     [conv_ct, live, gap, act, 0.0, 0.0, drops, 0.0,
-                     revived_ct]
+                     revived_ct, byz_ct]
                 )
 
         if telemetry:
@@ -890,6 +968,9 @@ def make_gossip_chunk(topo: Topology, cfg: SimConfig, *, interpret: bool = False
         if revived:
             in_specs.append(plane)
             operands.append(revive2d)
+        if byzantine:
+            in_specs.append(plane)
+            operands.append(byz2d)
         in_specs += [plane] * 3
         operands += [cnt, act, cv]
         out_shape = [i32, i32, i32, jax.ShapeDtypeStruct((1,), jnp.int32)]

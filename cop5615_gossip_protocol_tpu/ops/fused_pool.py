@@ -56,6 +56,7 @@ from ..config import SimConfig
 from ..utils import compat
 from . import faults as faults_mod
 from .fused import (
+    build_byz2d,
     build_death2d,
     build_revive2d,
     clamp_cap_and_pad,
@@ -362,7 +363,8 @@ def _copy_in(pairs, sems):
 def absorb_pushsum_tile(r0, padm, inbox_s, inbox_w,
                         s_v, w_v, t_v, c_v, ds_v, dw_v,
                         delta, term_rounds, global_term: bool = False,
-                        count_mask=None, alive=None):
+                        count_mask=None, alive=None,
+                        send_s=None, send_w=None):
     """One tile of models/pushsum.absorb (program.fs:119-143) against VMEM
     state planes: s_keep = s - s_send (sends read back from the first copy
     of the doubled planes), term advances only on receipt, conv latches,
@@ -387,13 +389,23 @@ def absorb_pushsum_tile(r0, padm, inbox_s, inbox_w,
     ``alive`` (optional [TILE, 128] bool) applies the crash-stop freeze
     (ops/faults.py): dead lanes keep term/conv while s/w still absorb —
     delivered mass parks on them. The return value then counts conv AMONG
-    LIVE lanes only (the quorum numerator), not all conv lanes."""
+    LIVE lanes only (the quorum numerator), not all conv lanes.
+
+    ``send_s``/``send_w`` (optional [TILE, 128] f32) override the send pair
+    subtracted for the keep update. Under a byzantine model the doubled
+    planes hold the CORRUPTED wire pair (delivery must see the lie) while
+    the kept state must follow the honest halve — the pool kernel inverts
+    the corruption per tile and passes the honest sends here."""
     inbox_s = jnp.where(padm, 0.0, inbox_s)
     inbox_w = jnp.where(padm, 0.0, inbox_w)
     s_t = s_v[pl.ds(r0, TILE), :]
     w_t = w_v[pl.ds(r0, TILE), :]
-    s_new = (s_t - ds_v[pl.ds(r0, TILE), :]) + inbox_s
-    w_new = (w_t - dw_v[pl.ds(r0, TILE), :]) + inbox_w
+    if send_s is None:
+        send_s = ds_v[pl.ds(r0, TILE), :]
+    if send_w is None:
+        send_w = dw_v[pl.ds(r0, TILE), :]
+    s_new = (s_t - send_s) + inbox_s
+    w_new = (w_t - send_w) + inbox_w
     if global_term:
         ratio_old = s_t / w_t
         tol = delta * jnp.maximum(jnp.abs(ratio_old), jnp.float32(1))
@@ -522,6 +534,13 @@ def make_pushsum_pool_chunk(
     fresh_rejoin = cfg.rejoin == "fresh"
     init_term = np.int32(cfg.initial_term_round)
     quorum = cfg.quorum
+    # Adversary plane (ops/faults.byzantine_plane) as an extra VMEM
+    # operand; the doubled send planes carry the CORRUPTED wire pair and
+    # the absorb inverts the corruption per tile to recover the honest
+    # keep (every mode's inversion is fp-exact: *0.5, negate, swap).
+    byz2d = build_byz2d(cfg, topo.n, layout.n_pad)
+    byzantine = byz2d is not None
+    byz_mode = cfg.byzantine_mode
     # Telemetry plane (ops/telemetry.py): per-round counter rows folded
     # into a scratch register in the absorb phase and copied out one row
     # per grid step. Python-level flag — off traces the identical kernel.
@@ -535,6 +554,7 @@ def make_pushsum_pool_chunk(
         offs_ref = next(it)
         death_ref = next(it) if crashed else None
         revive_ref = next(it) if revived else None
+        byz_ref = next(it) if byzantine else None
         s0, w0, t0, c0 = next(it), next(it), next(it), next(it)
         s_o, w_o, t_o, c_o, meta_o = (
             next(it), next(it), next(it), next(it), next(it)
@@ -635,6 +655,22 @@ def make_pushsum_pool_chunk(
                     blocked = blocked | ~alive_tile(r0, rnd)
                 ss = jnp.where(blocked, 0.0, s_v[pl.ds(r0, TILE), :] * 0.5)
                 ws = jnp.where(blocked, 0.0, w_v[pl.ds(r0, TILE), :] * 0.5)
+                if byzantine:
+                    # Wire corruption at send-time (models/runner.
+                    # make_byz_send_fn): the doubled planes carry the lie;
+                    # p2 inverts it to recover the honest keep.
+                    lying = (byz_ref[pl.ds(r0, TILE), :] <= rnd) & ~blocked
+                    if byz_mode == "mass_inflate":
+                        ss = jnp.where(lying, s_v[pl.ds(r0, TILE), :], ss)
+                        ws = jnp.where(lying, w_v[pl.ds(r0, TILE), :], ws)
+                    elif byz_mode == "mass_deflate":
+                        ss = jnp.where(lying, -ss, ss)
+                        ws = jnp.where(lying, -ws, ws)
+                    else:  # garble: the channels swapped
+                        ss, ws = (
+                            jnp.where(lying, ws, ss),
+                            jnp.where(lying, ss, ws),
+                        )
                 ds_v[pl.ds(r0, TILE), :] = ss
                 ds_v[pl.ds(R + r0, TILE), :] = ss
                 dw_v[pl.ds(r0, TILE), :] = ws
@@ -663,10 +699,28 @@ def make_pushsum_pool_chunk(
                     inbox_s = inbox_s + s1
                     inbox_w = inbox_w + w1
                 alive_t = alive_tile(r0, rnd) if crashed else None
+                send_s = send_w = None
+                if byzantine:
+                    # Recover the honest send from the corrupted wire pair
+                    # (fp-exact inversions; blocked lanes hold 0, and every
+                    # inversion maps 0 -> 0, so no blocked mask is needed).
+                    lt = byz_ref[pl.ds(r0, TILE), :] <= rnd
+                    ds_t = ds_v[pl.ds(r0, TILE), :]
+                    dw_t = dw_v[pl.ds(r0, TILE), :]
+                    if byz_mode == "mass_inflate":
+                        send_s = jnp.where(lt, ds_t * 0.5, ds_t)
+                        send_w = jnp.where(lt, dw_t * 0.5, dw_t)
+                    elif byz_mode == "mass_deflate":
+                        send_s = jnp.where(lt, -ds_t, ds_t)
+                        send_w = jnp.where(lt, -dw_t, dw_t)
+                    else:  # garble
+                        send_s = jnp.where(lt, dw_t, ds_t)
+                        send_w = jnp.where(lt, ds_t, dw_t)
                 return acc + absorb_pushsum_tile(
                     r0, padm, inbox_s, inbox_w,
                     s_v, w_v, t_v, c_v, ds_v, dw_v, delta, term_rounds,
                     global_term=global_term, alive=alive_t,
+                    send_s=send_s, send_w=send_w,
                 )
 
             total = lax.fori_loop(0, T, p2, jnp.int32(0))
@@ -719,9 +773,16 @@ def make_pushsum_pool_chunk(
                     )
                     if revived else jnp.int32(0)
                 )
+                byz_ct = (
+                    jnp.sum(
+                        (byz_ref[:] <= rnd).astype(jnp.int32),
+                        dtype=jnp.int32,
+                    )
+                    if byzantine else jnp.int32(0)
+                )
                 trow[:] = telemetry_row(
                     [conv_ct, live, gap, 0.0, mae, mass, drops, 0.0,
-                     revived_ct]
+                     revived_ct, byz_ct]
                 )
 
         if telemetry:
@@ -768,6 +829,9 @@ def make_pushsum_pool_chunk(
         if revived:
             in_specs.append(pl.BlockSpec((R, LANES), lambda k: (0, 0)))
             operands.append(revive2d)
+        if byzantine:
+            in_specs.append(pl.BlockSpec((R, LANES), lambda k: (0, 0)))
+            operands.append(byz2d)
         in_specs += [pl.BlockSpec(memory_space=pl.ANY)] * 4
         operands += [s, w, t, c]
         out_shape = [f32, f32, i32, i32, jax.ShapeDtypeStruct((1,), jnp.int32)]
@@ -838,6 +902,12 @@ def make_gossip_pool_chunk(
     revived = revive2d is not None
     quorum = cfg.quorum
     telemetry = cfg.telemetry  # see make_pushsum_pool_chunk
+    # Gossip adversaries override protocol state post-absorb, post-freeze
+    # (models/runner.make_byz_override_fn position) — applied per tile in
+    # p2 with the tile's conv count recomputed after the override.
+    byz2d = build_byz2d(cfg, topo.n, layout.n_pad)
+    byzantine = byz2d is not None
+    byz_mode = cfg.byzantine_mode
 
     def kernel(*refs):
         it = iter(refs)
@@ -846,6 +916,7 @@ def make_gossip_pool_chunk(
         offs_ref = next(it)
         death_ref = next(it) if crashed else None
         revive_ref = next(it) if revived else None
+        byz_ref = next(it) if byzantine else None
         n0, a0, c0 = next(it), next(it), next(it)
         n_o, a_o, c_o, meta_o = next(it), next(it), next(it), next(it)
         tele_o = next(it) if telemetry else None
@@ -950,10 +1021,39 @@ def make_gossip_pool_chunk(
                     g = gather_plain_modn(dch_v, d, t, jflat)
                     inbox = inbox + jnp.where(g == slot, jnp.int32(1), jnp.int32(0))
                 alive_t = alive_tile(r0, rnd) if crashed else None
-                return acc + absorb_gossip_tile(
+                tile_ct = absorb_gossip_tile(
                     r0, padm, inbox, n_v, a_v, c_v, rumor_target, suppress,
                     alive=alive_t,
                 )
+                if byzantine:
+                    # Post-absorb state override (the chunked engine's
+                    # make_byz_override_fn position): applied every round
+                    # from onset because absorb recomputes conv from count.
+                    # Pads carry NEVER in the plane, so ~padm is implied.
+                    lying = byz_ref[pl.ds(r0, TILE), :] <= rnd
+                    if crashed:
+                        lying = lying & alive_t
+                    if byz_mode == "stale_rumor":
+                        n_v[pl.ds(r0, TILE), :] = jnp.where(
+                            lying, jnp.int32(0), n_v[pl.ds(r0, TILE), :]
+                        )
+                        a_v[pl.ds(r0, TILE), :] = jnp.where(
+                            lying, jnp.int32(1), a_v[pl.ds(r0, TILE), :]
+                        )
+                        c_v[pl.ds(r0, TILE), :] = jnp.where(
+                            lying, jnp.int32(0), c_v[pl.ds(r0, TILE), :]
+                        )
+                    else:  # garble: report fake convergence
+                        c_v[pl.ds(r0, TILE), :] = jnp.where(
+                            lying, jnp.int32(1), c_v[pl.ds(r0, TILE), :]
+                        )
+                    # Recount post-override so done_flag matches the chunked
+                    # done predicate (which sees the overridden state).
+                    conv_t = c_v[pl.ds(r0, TILE), :]
+                    if crashed:
+                        conv_t = jnp.where(alive_t, conv_t, jnp.int32(0))
+                    tile_ct = jnp.sum(conv_t, dtype=jnp.int32)
+                return acc + tile_ct
 
             total = lax.fori_loop(0, T, p2, jnp.int32(0))
             flags[1] = flags[1] + 1
@@ -982,9 +1082,16 @@ def make_gossip_pool_chunk(
                     )
                     if revived else jnp.int32(0)
                 )
+                byz_ct = (
+                    jnp.sum(
+                        (byz_ref[:] <= rnd).astype(jnp.int32),
+                        dtype=jnp.int32,
+                    )
+                    if byzantine else jnp.int32(0)
+                )
                 trow[:] = telemetry_row(
                     [conv_ct, live, gap, act, 0.0, 0.0, drops, 0.0,
-                     revived_ct]
+                     revived_ct, byz_ct]
                 )
 
         if telemetry:
@@ -1031,6 +1138,9 @@ def make_gossip_pool_chunk(
         if revived:
             in_specs.append(pl.BlockSpec((R, LANES), lambda k: (0, 0)))
             operands.append(revive2d)
+        if byzantine:
+            in_specs.append(pl.BlockSpec((R, LANES), lambda k: (0, 0)))
+            operands.append(byz2d)
         in_specs += [pl.BlockSpec(memory_space=pl.ANY)] * 3
         operands += [cnt, act, cv]
         out_shape = [i32, i32, i32, jax.ShapeDtypeStruct((1,), jnp.int32)]

@@ -35,7 +35,11 @@ import jax.numpy as jnp
 #   4 — revival-plane draws (ops/faults.REVIVE_TAG): crash-recovery configs
 #       consume a new base-key stream for the rejoin rounds; crash-stop and
 #       fault-free configs draw exactly the v3 streams
-STREAM_VERSION = 4
+#   5 — byzantine adversary plane (ops/faults.BYZ_TAG): adversarial configs
+#       consume a new fold_in stream for onset-round draws; configs without
+#       a byzantine model draw exactly the v4 streams (utils/checkpoint.py
+#       load() is per-version sensitive on the same split)
+STREAM_VERSION = 5
 
 
 def round_key(base_key: jax.Array, round_idx: jax.Array | int) -> jax.Array:

@@ -48,6 +48,11 @@ the 16.8M-node tiers round their counts in the last bits):
                        0 without one. Cumulative revivals are the running
                        sum; the trajectory analyzer annotates these rounds
                        on the ASCII curve.
+    9 byzantine_count  nodes adversarial DURING this round (schema v3,
+                       byzantine model — ops/faults.byzantine_plane;
+                       onset-round plane, so the count is monotone
+                       non-decreasing); 0 without one. The trajectory
+                       analyzer marks adversarial rounds on the curve.
 
 Engine support: the chunked XLA engine, the sharded engine (rows are
 in-trace ``psum`` reductions, so every device carries the identical
@@ -73,7 +78,9 @@ from .topology import Topology
 
 # 2 — revived_count column appended (crash-recovery churn); columns 0-7
 #     keep their v1 meanings.
-SCHEMA_VERSION = 2
+# 3 — byzantine_count column appended (adversarial plane); columns 0-8
+#     keep their v2 meanings.
+SCHEMA_VERSION = 3
 
 COLUMNS = (
     "converged_count",
@@ -85,6 +92,7 @@ COLUMNS = (
     "drop_count",
     "dup_count",
     "revived_count",
+    "byzantine_count",
 )
 N_COLS = len(COLUMNS)
 
@@ -97,6 +105,7 @@ COL_MASS = 5
 COL_DROPS = 6
 COL_DUPS = 7
 COL_REVIVED = 8
+COL_BYZ = 9
 
 
 def true_mean(n: int) -> float:
@@ -127,6 +136,8 @@ def make_row_fn(topo: Topology, cfg: SimConfig, base_key):
         None if planes is None or planes.revive is None
         else jnp.asarray(planes.revive)
     )
+    byz = faults_mod.byzantine_plane(cfg, n)
+    byz_dev = None if byz is None else jnp.asarray(byz)
     _, key_impl = sampling.key_split(base_key)
     quorum = cfg.quorum
     fault_rate = cfg.fault_rate
@@ -176,11 +187,16 @@ def make_row_fn(topo: Topology, cfg: SimConfig, base_key):
             revived = jnp.sum(
                 faults_mod.revived_at(revive_dev, round_idx).astype(jnp.int32)
             ).astype(jnp.float32)
+        byz_ct = jnp.float32(0)
+        if byz_dev is not None:
+            byz_ct = jnp.sum(
+                faults_mod.byzantine_at(byz_dev, round_idx).astype(jnp.int32)
+            ).astype(jnp.float32)
         return jnp.stack([
             conv_ct.astype(jnp.float32),
             live.astype(jnp.float32),
             gap.astype(jnp.float32),
-            act, mae, mass, drops, dups, revived,
+            act, mae, mass, drops, dups, revived, byz_ct,
         ])
 
     return row_fn
@@ -269,13 +285,14 @@ def make_sharded_row_fn(
             revived = psum_i(
                 faults_mod.revived_at(revive_loc, round_idx)
             ).astype(jnp.float32)
-        # dup_count: the sharded engine rejects --dup-rate, so the column
-        # is structurally 0 here.
+        # dup_count and byzantine_count: the sharded engine rejects
+        # --dup-rate and the byzantine model, so both columns are
+        # structurally 0 here.
         return jnp.stack([
             conv_ct.astype(jnp.float32),
             live.astype(jnp.float32),
             gap.astype(jnp.float32),
-            act, mae, mass, drops, jnp.float32(0), revived,
+            act, mae, mass, drops, jnp.float32(0), revived, jnp.float32(0),
         ])
 
     return row_fn
@@ -312,6 +329,11 @@ def rows_to_trace_records(
         # non-churn traces keep the exact legacy record shape.
         if row.shape[0] > COL_REVIVED and row[COL_REVIVED] > 0:
             rec["revived"] = int(row[COL_REVIVED])
+        # Adversarial annotation (schema v3 rows only): emitted only on
+        # rounds where adversaries are active, so pre-byzantine traces
+        # keep the exact prior record shape.
+        if row.shape[0] > COL_BYZ and row[COL_BYZ] > 0:
+            rec["byzantine"] = int(row[COL_BYZ])
         out.append(rec)
     return out
 

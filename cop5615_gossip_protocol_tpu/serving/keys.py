@@ -70,6 +70,7 @@ _NORMALIZED_FIELDS = frozenset({
     "termination", "pool_size", "quorum", "rejoin",
     "fault_rate", "crash_rate", "crash_schedule",
     "revive_rate", "revive_schedule", "dup_rate", "delay_rounds",
+    "byzantine_rate", "byzantine_schedule", "byzantine_mode", "robust_agg",
 })
 
 # Topology kinds whose neighbor tensors depend on the build seed (the
@@ -104,6 +105,15 @@ def fault_class(cfg: SimConfig) -> tuple:
             out.append((
                 "revive", cfg.revive_rate, cfg.revive_schedule, rejoin,
             ))
+    if cfg.byzantine_model:
+        # Like the crash planes, the adversary plane derives from
+        # PRNGKey(seed) and is baked into the traced round body as a
+        # device constant — byzantine engines are per-seed too. The mode
+        # and countermeasure change the round body itself.
+        out.append((
+            "byzantine", cfg.byzantine_rate, cfg.byzantine_schedule,
+            cfg.byzantine_mode, cfg.robust_agg, cfg.seed,
+        ))
     return tuple(out)
 
 
@@ -128,6 +138,13 @@ def compile_class(cfg: SimConfig) -> tuple:
         # field, so a matmul-tier request always lands in its own bucket.
         ("pool_size",
          cfg.pool_size if cfg.delivery in ("pool", "matmul") else None),
+        # robust_agg is applied by the receiver whether or not adversaries
+        # exist (the lint warns, but the traced absorb differs), so it
+        # must split keys even when fault_class says fault-free.
+        ("robust_agg", cfg.robust_agg),
+        # byzantine_mode only reaches the trace through fault_class (it is
+        # consulted solely when a plane exists), so it normalizes away
+        # here — a fault-free config ignores it entirely.
     )
     return items + normalized + (("faults", fault_class(cfg)),)
 

@@ -77,7 +77,10 @@ def load(path: str | Path):
     # fault-gate draws — a fault-free v2 pool checkpoint resumes bitwise
     # under v3; v3 -> v4 only ADDED the revival-plane stream — every
     # pre-revival config replays bitwise under v4, and a revival config
-    # written before v4 cannot exist (the flags did not). Checkpoints from
+    # written before v4 cannot exist (the flags did not); v4 -> v5 likewise
+    # only ADDED the byzantine adversary-plane stream, so a v4 checkpoint
+    # without a byzantine model loads bitwise under v5 and a byzantine
+    # config refuses any pre-v5 archive. Checkpoints from
     # a NEWER stream than this build reject on any sensitivity (their
     # derivations are unknown here).
     # The matmul tier consumes the IDENTICAL packed pool-choice stream as
@@ -89,11 +92,13 @@ def load(path: str | Path):
     )
     gate_sensitive = cfg.fault_rate > 0 or cfg.dup_rate > 0
     revive_sensitive = cfg.revive_model
+    byz_sensitive = cfg.byzantine_model
     sv = 0 if stream is None else stream
     invalid = (
         (pool_sensitive and sv < 2)
         or (gate_sensitive and sv < 3)
         or (revive_sensitive and sv < 4)
+        or (byz_sensitive and sv < 5)
         # A NEWER stream than this build: what changed is unknowable here,
         # so no sensitivity classification applies — always refuse.
         or sv > STREAM_VERSION

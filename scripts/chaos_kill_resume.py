@@ -18,6 +18,11 @@ What it proves (the crash-only-restarts story, CI-enforced):
    run whose first-choice engine dies environmentally walks
    fused/sharded -> chunked/single-device (models/runner.run), emits a
    structured engine-degraded event, and still returns the right answer.
+5. **Byzantine kill-resume** (ISSUE 16) — the same kill-resume contract
+   with 16 mass_inflate adversaries turning at round 500 under the clip
+   countermeasure: the adversary plane is never checkpointed, so bitwise
+   equality with the control proves the resumed process rebuilt the
+   identical plane (same nodes, same onset) from the config alone.
 
 Usage: python scripts/chaos_kill_resume.py [--ladder-only] [--kill-after S]
 """
@@ -43,13 +48,23 @@ CONFIG = ["1600", "line", "push-sum", "--seed", "3", "--platform", "cpu",
           "--chunk-rounds", "256", "--max-rounds", "400000",
           "--delivery", "scatter"]
 
+# The Byzantine variant of the same run (ISSUE 16): 16 adversaries turn at
+# round 500 in mass_inflate mode, bounded by the clip countermeasure (the
+# sentinel is config-excluded under robust_agg). mass_inflate preserves the
+# sender's s/w RATIO, so the line still converges — what the kill tests is
+# that the adversary plane is NEVER checkpointed: the resumed process must
+# rebuild the identical 16 adversaries (and their onset round) from the
+# config alone, or the bitwise-resume invariant breaks.
+BYZ_EXTRA = ["--byzantine-schedule", "500:16",
+             "--byzantine-mode", "mass_inflate", "--robust-agg", "clip"]
 
-def _cli(extra, env=None):
+
+def _cli(extra, env=None, config=CONFIG):
     e = dict(os.environ, JAX_PLATFORMS="cpu")
     if env:
         e.update(env)
     return subprocess.Popen(
-        [sys.executable, "-m", "cop5615_gossip_protocol_tpu", *CONFIG,
+        [sys.executable, "-m", "cop5615_gossip_protocol_tpu", *config,
          *extra],
         cwd=REPO, env=e, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
     )
@@ -68,15 +83,16 @@ def fail(msg):
     sys.exit(1)
 
 
-def kill_resume(kill_after: float) -> None:
+def kill_resume(kill_after: float, config=CONFIG,
+                label: str = "kill-resume") -> None:
     tmp = Path(tempfile.mkdtemp(prefix="gossip_chaos_"))
     ck = tmp / "ck.npz"
     ev = tmp / "events.jsonl"
     rec_victim = tmp / "victim.jsonl"
     rec_control = tmp / "control.jsonl"
 
-    print("[chaos] control run (uninterrupted)...")
-    p = _cli(["--quiet", "--jsonl", str(rec_control)])
+    print(f"[chaos] {label}: control run (uninterrupted)...")
+    p = _cli(["--quiet", "--jsonl", str(rec_control)], config=config)
     out, err = p.communicate(timeout=1800)
     if p.returncode != 0:
         fail(f"control run failed rc={p.returncode}: {err.decode()[-800:]}")
@@ -88,7 +104,7 @@ def kill_resume(kill_after: float) -> None:
               "--events", str(ev), "--resume", "auto",
               "--jsonl", str(rec_victim)]
     print("[chaos] victim run, waiting for first checkpoint then SIGKILL...")
-    p = _cli(common)
+    p = _cli(common, config=config)
     deadline = time.time() + 600
     while not ck.exists() and time.time() < deadline:
         if p.poll() is not None:
@@ -108,7 +124,7 @@ def kill_resume(kill_after: float) -> None:
              "after completion, nothing was tested")
 
     print("[chaos] resuming with --resume auto...")
-    p = _cli(common)
+    p = _cli(common, config=config)
     out, err = p.communicate(timeout=1800)
     if p.returncode != 0:
         fail(f"resume run failed rc={p.returncode}: {err.decode()[-800:]}")
@@ -146,7 +162,7 @@ def kill_resume(kill_after: float) -> None:
         if victim[field] != control[field]:
             fail(f"bitwise-resume invariant broken: {field} "
                  f"{victim[field]!r} != control {control[field]!r}")
-    print(f"[chaos] kill-resume OK: rounds={victim['rounds']} bitwise-equal "
+    print(f"[chaos] {label} OK: rounds={victim['rounds']} bitwise-equal "
           f"to control, event log consistent ({len(events)} events)")
 
 
@@ -214,6 +230,8 @@ def main(argv=None) -> int:
     ladder()
     if not args.ladder_only:
         kill_resume(args.kill_after)
+        kill_resume(args.kill_after, config=CONFIG + BYZ_EXTRA,
+                    label="byzantine kill-resume")
     print("[chaos] all scenarios passed")
     return 0
 

@@ -1,0 +1,378 @@
+"""Byzantine adversary plane (ISSUE 16 tentpole): seeded adversaries,
+robust push-sum aggregation, and the detection/mitigation pair.
+
+Pinned contracts:
+
+- the adversary plane is config-pure and seeded (ops/faults.byzantine_plane
+  off BYZ_TAG — disjointness is machine-verified in analysis/tags.py and
+  swept in tests/test_recovery.py); schedule counts are exact;
+- mode x algorithm validity is config-enforced: push-sum adversaries
+  corrupt the sent (s, w) wire pair, gossip adversaries corrupt protocol
+  state — the cross pairings are hard errors;
+- the acceptance pair: unmitigated mass_inflate trips the mass sentinel to
+  outcome="unhealthy" at the EXACT onset round, and the same attack under
+  --robust-agg clip converges with a bounded estimate MAE;
+- gossip stale_rumor adversaries never converge (they reset to susceptible
+  every round); garble adversaries fake convergence and poison the
+  predicate;
+- cross-engine parity: gossip trajectories under attack are bitwise
+  chunked <-> fused (stencil and pool carriers); push-sum mass accounting
+  agrees to float32 ulp scale;
+- every composition that does not carry the plane refuses loudly, naming
+  the serving composition (PR 10 rule, lint-enforced), and engine='auto'
+  demotes to chunked instead;
+- serving/keys.py folds the byzantine class (and robust_agg) into the
+  bucket key; telemetry schema v3 reports byzantine_count; the trajectory
+  analyzer marks adversarial onsets;
+- the "round:count" schedule grammar is ONE helper shared by the crash,
+  revive, and byzantine schedules with the error wording pinned once.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cop5615_gossip_protocol_tpu import SimConfig, build_topology
+from cop5615_gossip_protocol_tpu.models.runner import run
+from cop5615_gossip_protocol_tpu.ops import faults, telemetry as telemetry_mod
+
+
+def _run2(cfg):
+    """(RunResult, final device state) via the chunk hook."""
+    topo = build_topology(cfg.topology, cfg.n, seed=cfg.seed)
+    final = {}
+    r = run(topo, cfg, on_chunk=lambda rd, s: final.update(state=s))
+    return r, final.get("state")
+
+
+def _state_eq(sa, sb, float_atol=0.0):
+    for x, y in zip(jax.tree.leaves(sa), jax.tree.leaves(sb)):
+        x, y = np.asarray(x), np.asarray(y)
+        if x.dtype.kind == "f" and float_atol:
+            np.testing.assert_allclose(x, y, atol=float_atol, rtol=0)
+        else:
+            np.testing.assert_array_equal(x, y)
+
+
+# ----------------------------------------------------------------- config
+
+
+def test_mode_algorithm_validity_is_config_enforced():
+    # Push-sum adversaries corrupt the wire pair; gossip adversaries
+    # corrupt protocol state. The cross pairings are hard errors.
+    for mode in ("mass_inflate", "mass_deflate", "garble"):
+        SimConfig(n=64, topology="full", algorithm="push-sum",
+                  byzantine_rate=0.1, byzantine_mode=mode)
+    for mode in ("stale_rumor", "garble"):
+        SimConfig(n=64, topology="full", algorithm="gossip",
+                  byzantine_rate=0.1, byzantine_mode=mode)
+    with pytest.raises(ValueError, match="does not apply"):
+        SimConfig(n=64, topology="full", algorithm="gossip",
+                  byzantine_rate=0.1, byzantine_mode="mass_inflate")
+    with pytest.raises(ValueError, match="does not apply"):
+        SimConfig(n=64, topology="full", algorithm="push-sum",
+                  byzantine_rate=0.1, byzantine_mode="stale_rumor")
+
+
+def test_rate_and_schedule_mutually_exclusive():
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        SimConfig(n=64, topology="full", algorithm="push-sum",
+                  byzantine_rate=0.1, byzantine_schedule="4:3")
+
+
+def test_robust_agg_restrictions():
+    # trim needs the full topology's uniform pool-slot channels.
+    with pytest.raises(ValueError, match="trim"):
+        SimConfig(n=64, topology="ring", algorithm="push-sum",
+                  byzantine_rate=0.1, robust_agg="trim")
+    # robust aggregation discards weight by design, so the conservation
+    # sentinel is config-excluded.
+    with pytest.raises(ValueError, match="robust_agg"):
+        SimConfig(n=64, topology="full", algorithm="push-sum",
+                  byzantine_rate=0.1, robust_agg="clip",
+                  mass_tolerance=1e-3)
+
+
+def test_robust_agg_without_byzantine_lints():
+    with pytest.warns(RuntimeWarning, match="robust_agg without"):
+        cfg = SimConfig(n=64, topology="full", algorithm="push-sum",
+                        robust_agg="clip")
+    assert any("robust_agg" in w for w in cfg.lint_warnings)
+    # --byzantine-* without a crash model is fine: adversaries are ALIVE
+    # (they send every round and count toward quorum), no lint fires.
+    cfg = SimConfig(n=64, topology="full", algorithm="push-sum",
+                    byzantine_rate=0.1)
+    assert not any("byzantine" in w for w in cfg.lint_warnings)
+
+
+def test_schedule_grammar_shared_wording():
+    # Satellite: ONE parse helper for crash/revive/byzantine, the error
+    # wording pinned here through every caller — only the kind differs.
+    cases = [
+        (dict(crash_schedule="4;3"), "crash"),
+        (dict(crash_rate=0.01, revive_schedule="4;3"), "revive"),
+        (dict(byzantine_schedule="4;3", byzantine_mode="garble"),
+         "byzantine"),
+    ]
+    for kw, kind in cases:
+        with pytest.raises(
+            ValueError,
+            match=f"{kind} schedule entry '4;3' is not 'round:count'",
+        ):
+            SimConfig(n=64, topology="full", **kw)
+    with pytest.raises(ValueError, match="byzantine schedule count"):
+        faults.parse_schedule("4:0", kind="byzantine")
+
+
+# ------------------------------------------------------------------ plane
+
+
+def test_byzantine_plane_schedule_counts_and_at():
+    cfg = SimConfig(n=200, topology="full", algorithm="push-sum",
+                    byzantine_schedule="3:10,7:5", seed=5)
+    byz = faults.byzantine_plane(cfg, 200)
+    assert int((byz == 3).sum()) == 10
+    assert int((byz == 7).sum()) == 5
+    assert int((byz == faults.NEVER).sum()) == 185
+    at = np.asarray(faults.byzantine_at(jnp.asarray(byz), 6))
+    assert int(at.sum()) == 10
+    at = np.asarray(faults.byzantine_at(jnp.asarray(byz), 7))
+    assert int(at.sum()) == 15
+    # Pads are honest forever.
+    padded = faults.pad_byzantine_plane(byz, 256)
+    assert (padded[200:] == faults.NEVER).all()
+
+
+# ------------------------------------------- the acceptance pair (push-sum)
+
+
+def test_mass_inflate_unhealthy_at_exact_round_then_clip_converges():
+    # Unmitigated mass_inflate must trip the conservation sentinel at the
+    # EXACT round the adversaries turn; the same attack under clip
+    # converges with a pinned estimate-MAE bound.
+    base = dict(n=256, topology="full", algorithm="push-sum", seed=0,
+                delivery="pool", chunk_rounds=32, max_rounds=2000,
+                byzantine_schedule="12:8", byzantine_mode="mass_inflate")
+    r = run(build_topology("full", 256),
+            SimConfig(**base, mass_tolerance=1e-3))
+    assert r.outcome == "unhealthy"
+    assert r.unhealthy_round == 12
+    assert not r.converged
+
+    r2 = run(build_topology("full", 256),
+             SimConfig(**base, robust_agg="clip"))
+    assert r2.outcome == "converged"
+    # n=256 values 0..255: true mean 127.5. Unmitigated estimates diverge
+    # without bound; clipped ones stay within a few units.
+    assert r2.estimate_mae < 5.0
+
+
+def test_trim_bounds_the_same_attack_on_full_pool():
+    base = dict(n=256, topology="full", algorithm="push-sum", seed=1,
+                delivery="pool", chunk_rounds=32, max_rounds=2000,
+                byzantine_rate=0.05, byzantine_mode="mass_inflate")
+    r = run(build_topology("full", 256), SimConfig(**base, robust_agg="trim"))
+    assert r.outcome == "converged"
+    assert r.estimate_mae < 10.0
+
+
+# ----------------------------------------------------------- gossip modes
+
+
+def test_gossip_stale_rumor_adversaries_never_converge():
+    cfg = SimConfig(n=128, topology="full", algorithm="gossip", seed=2,
+                    byzantine_schedule="4:6", byzantine_mode="stale_rumor",
+                    chunk_rounds=32, max_rounds=400)
+    r, state = _run2(cfg)
+    # 6 adversaries re-inject forever: the full-population target is
+    # unreachable, and exactly the adversary set stays unconverged.
+    assert r.outcome != "converged"
+    byz = faults.byzantine_plane(cfg, 128)
+    conv = np.asarray(state.conv).astype(bool)
+    assert (~conv[byz != faults.NEVER]).all()
+    assert conv[byz == faults.NEVER].all()
+
+
+def test_gossip_garble_fakes_convergence():
+    cfg = SimConfig(n=128, topology="full", algorithm="gossip", seed=2,
+                    byzantine_schedule="4:6", byzantine_mode="garble",
+                    chunk_rounds=32, max_rounds=400)
+    honest = dataclasses_replace(cfg, byzantine_schedule=None)
+    r, _ = _run2(cfg)
+    rh, _ = _run2(honest)
+    # Fake convergence reports can only pull the predicate EARLIER.
+    assert r.outcome == "converged"
+    assert r.rounds <= rh.rounds
+
+
+def dataclasses_replace(cfg, **kw):
+    import dataclasses
+
+    return dataclasses.replace(cfg, **kw)
+
+
+# ------------------------------------------------------ cross-engine parity
+
+
+@pytest.mark.slow  # interpret-mode run pair; see tier-1 budget note in test_fused.py
+@pytest.mark.parametrize("mode,topo_kind,extra", [
+    ("stale_rumor", "ring", {}),
+    ("garble", "full", {"delivery": "pool"}),
+])
+def test_gossip_byzantine_bitwise_chunked_vs_fused(mode, topo_kind, extra):
+    cfg = SimConfig(n=256, topology=topo_kind, algorithm="gossip", seed=7,
+                    byzantine_rate=0.05, byzantine_mode=mode,
+                    chunk_rounds=32, max_rounds=300, **extra)
+    ra, sa = _run2(dataclasses_replace(cfg, engine="chunked"))
+    rb, sb = _run2(dataclasses_replace(cfg, engine="fused"))
+    assert (ra.outcome, ra.rounds, ra.converged_count) == \
+        (rb.outcome, rb.rounds, rb.converged_count)
+    _state_eq(sa, sb)
+
+
+@pytest.mark.slow  # interpret-mode run pair; see tier-1 budget note in test_fused.py
+@pytest.mark.parametrize("mode", ["mass_inflate", "mass_deflate", "garble"])
+def test_pushsum_byzantine_mass_parity_chunked_vs_fused_pool(mode):
+    cfg = SimConfig(n=300, topology="full", algorithm="push-sum", seed=5,
+                    delivery="pool", byzantine_rate=0.04,
+                    byzantine_mode=mode, chunk_rounds=32, max_rounds=60)
+    ra, sa = _run2(dataclasses_replace(cfg, engine="chunked"))
+    rb, sb = _run2(dataclasses_replace(cfg, engine="fused"))
+    assert ra.rounds == rb.rounds
+    # Mass accounting across the corrupted-wire/honest-keep split: the
+    # fused pool kernel inverts the corruption per tile (fp-exact ops), so
+    # the engines' total mass agrees at float32 ulp scale.
+    ma = float(np.asarray(sa.s, np.float64).sum())
+    mb = float(np.asarray(sb.s, np.float64).sum())
+    assert abs(ma - mb) <= 2 * np.spacing(np.float32(abs(ma) + 1.0)) * 300
+    _state_eq(sa, sb, float_atol=1e-4)
+
+
+@pytest.mark.slow  # interpret-mode run pair; see tier-1 budget note in test_fused.py
+def test_pushsum_byzantine_stencil_parity_with_crash_revive():
+    cfg = SimConfig(n=256, topology="ring", algorithm="push-sum", seed=3,
+                    byzantine_rate=0.04, byzantine_mode="mass_inflate",
+                    crash_rate=0.02, revive_rate=0.3,
+                    chunk_rounds=32, max_rounds=60)
+    ra, sa = _run2(dataclasses_replace(cfg, engine="chunked"))
+    rb, sb = _run2(dataclasses_replace(cfg, engine="fused"))
+    assert ra.rounds == rb.rounds
+    _state_eq(sa, sb, float_atol=1e-4)
+
+
+# -------------------------------------------------------------- refusals
+
+
+def test_sharded_xla_refuses_byzantine_and_robust_agg():
+    topo = build_topology("full", 128)
+    cfg = SimConfig(n=128, topology="full", algorithm="push-sum",
+                    byzantine_rate=0.1, n_devices=2, strict_engine=True)
+    with pytest.raises(ValueError, match="sharded XLA composition"):
+        run(topo, cfg)
+    cfg = SimConfig(n=128, topology="full", algorithm="push-sum",
+                    robust_agg="clip", byzantine_rate=0.1, n_devices=2,
+                    strict_engine=True)
+    with pytest.raises(ValueError, match="chunked"):
+        run(topo, cfg)
+
+
+def test_auto_engine_demotes_to_chunked_under_byzantine():
+    # engine='auto' on a composition whose fused tier cannot carry the
+    # plane or the countermeasure must demote, not crash: the chunked
+    # round bodies own both.
+    topo = build_topology("line", 256)
+    cfg = SimConfig(n=256, topology="line", algorithm="push-sum",
+                    delivery="scatter", byzantine_rate=0.05,
+                    byzantine_mode="mass_inflate", robust_agg="clip",
+                    chunk_rounds=32, max_rounds=50)
+    r = run(topo, cfg)
+    assert r.rounds == 50  # ran (on the chunked engine), no refusal
+
+
+def test_explicit_fused_refuses_robust_agg_naming_chunked():
+    # The fused tiers never implement clip/trim: engine='auto' demotes to
+    # the chunked engine, an EXPLICIT fused request fails loudly naming it.
+    topo = build_topology("full", 256)
+    cfg = SimConfig(n=256, topology="full", algorithm="push-sum",
+                    delivery="pool", byzantine_rate=0.05, robust_agg="clip",
+                    engine="fused", strict_engine=True, chunk_rounds=32,
+                    max_rounds=40)
+    with pytest.raises(ValueError, match="chunked XLA round bodies"):
+        run(topo, cfg)
+
+
+# ------------------------------------------------------- serving bucketing
+
+
+def test_keys_fold_byzantine_class_and_robust_agg():
+    from cop5615_gossip_protocol_tpu.serving import keys
+
+    base = dict(n=128, topology="full", algorithm="push-sum")
+    honest = SimConfig(**base)
+    byz = SimConfig(**base, byzantine_rate=0.1,
+                    byzantine_mode="mass_inflate")
+    fc = keys.fault_class(byz)
+    assert any(isinstance(t, tuple) and t and t[0] == "byzantine"
+               for t in fc)
+    assert keys.fault_class(honest) == ("fault-free",)
+    # robust_agg splits compile classes even when fault-free (the traced
+    # absorb differs; the lint warns but the key must not collide).
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        clipped = SimConfig(**base, robust_agg="clip")
+    assert keys.compile_class(clipped) != keys.compile_class(honest)
+    # Mode changes the byzantine class.
+    byz2 = SimConfig(**base, byzantine_rate=0.1,
+                     byzantine_mode="mass_deflate")
+    assert keys.fault_class(byz) != keys.fault_class(byz2)
+
+
+# ----------------------------------------------------- telemetry + markers
+
+
+def test_telemetry_reports_byzantine_count_and_trace_field():
+    cfg = SimConfig(n=128, topology="full", algorithm="push-sum", seed=4,
+                    byzantine_schedule="5:7", byzantine_mode="mass_inflate",
+                    robust_agg="clip", telemetry=True, chunk_rounds=16,
+                    max_rounds=40)
+    r = run(build_topology("full", 128), cfg)
+    rows = np.asarray(r.telemetry.data)
+    byz_col = rows[:, telemetry_mod.COL_BYZ]
+    # Zero before the onset round, exactly 7 adversaries from it on.
+    nz = np.nonzero(byz_col)[0]
+    assert nz.size > 0
+    assert (byz_col[:nz[0]] == 0).all()
+    assert (byz_col[nz[0]:] == 7).all()
+    assert 4 <= nz[0] <= 6  # the onset row (round indexing convention)
+    recs = r.telemetry.to_trace_records("push-sum")
+    marked = [rec for rec in recs if rec.get("byzantine")]
+    assert marked and all(rec["byzantine"] == 7 for rec in marked)
+
+    # The trajectory analyzer picks up the onset and marks the curve.
+    import sys
+    from pathlib import Path
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+    from benchmarks import trajectory
+
+    a = trajectory.analyze(recs, population=128)
+    assert a["byzantine_final"] == 7
+    assert len(a["byzantine_onset_rounds"]) == 1
+    curve = trajectory.ascii_curve(recs, 128)
+    assert any("byzantine onsets" in ln for ln in curve)
+    assert any("!" in ln for ln in curve)
+
+
+def test_fused_telemetry_byzantine_column_matches_chunked():
+    cfg = SimConfig(n=256, topology="ring", algorithm="gossip", seed=6,
+                    byzantine_schedule="3:9", byzantine_mode="garble",
+                    telemetry=True, chunk_rounds=16, max_rounds=48)
+    ra, _ = _run2(dataclasses_replace(cfg, engine="chunked"))
+    rb, _ = _run2(dataclasses_replace(cfg, engine="fused"))
+    a = np.asarray(ra.telemetry.data)[:, telemetry_mod.COL_BYZ]
+    b = np.asarray(rb.telemetry.data)[:, telemetry_mod.COL_BYZ]
+    n = min(len(a), len(b))
+    np.testing.assert_array_equal(a[:n], b[:n])
+    assert a.max() == 9

@@ -64,14 +64,16 @@ def test_rejoin_validated():
 def test_planes_deterministic_and_tag_disjoint_seeded_sweep():
     # Seeded property sweep over random (seed, rate/schedule) draws: the
     # planes must rebuild identically (every engine derives them from the
-    # config alone), revival must strictly follow death, and the two
-    # draws must be tag-disjoint — distinct tags, and visibly different
-    # streams off the same base key.
-    assert faults.CRASH_TAG != faults.REVIVE_TAG
+    # config alone), revival must strictly follow death, and the three
+    # draws (crash, revive, byzantine — ISSUE 16) must be tag-disjoint —
+    # distinct tags, and visibly different streams off the same base key.
+    assert len({faults.CRASH_TAG, faults.REVIVE_TAG, faults.BYZ_TAG}) == 3
     assert 2**30 <= faults.CRASH_TAG < 2**30 + 2**29
     assert 2**30 <= faults.REVIVE_TAG < 2**30 + 2**29
+    assert 2**30 <= faults.BYZ_TAG < 2**30 + 2**29
     from cop5615_gossip_protocol_tpu.models.sweep import REPLICA_TAG0
     assert faults.REVIVE_TAG < REPLICA_TAG0
+    assert faults.BYZ_TAG < REPLICA_TAG0
 
     rng = np.random.default_rng(0)
     for trial in range(8):
@@ -84,30 +86,46 @@ def test_planes_deterministic_and_tag_disjoint_seeded_sweep():
                 n=n, topology="full", seed=seed,
                 crash_schedule=f"2:{kill}",
                 revive_schedule=f"{int(rng.integers(3, 20))}:{rej}",
+                byzantine_schedule=f"{int(rng.integers(1, 30))}:"
+                f"{int(rng.integers(1, n // 4))}",
+                byzantine_mode="garble",
             )
         else:
             cfg = SimConfig(
                 n=n, topology="full", seed=seed,
                 crash_rate=float(rng.uniform(0.001, 0.05)),
                 revive_rate=float(rng.uniform(0.01, 0.5)),
+                byzantine_rate=float(rng.uniform(0.01, 0.2)),
+                byzantine_mode="garble",
             )
         a = faults.life_planes(cfg, n)
+        abyz = faults.byzantine_plane(cfg, n)
         faults._death_plane_cached.cache_clear()
         faults._revival_plane_cached.cache_clear()
+        faults._byzantine_plane_cached.cache_clear()
         b = faults.life_planes(cfg, n)
         np.testing.assert_array_equal(a.death, b.death)
         np.testing.assert_array_equal(a.revive, b.revive)
+        np.testing.assert_array_equal(abyz, faults.byzantine_plane(cfg, n))
         # Revival strictly after death; never-dead nodes never revive.
         assert ((a.revive == faults.NEVER) | (a.revive > a.death)).all()
         assert (a.revive[a.death == faults.NEVER] == faults.NEVER).all()
-        # Tag disjointness as an observable: the uniform draw under the
-        # revive tag differs from the crash tag's on the same base key.
+        # Schedule-form adversary counts are exact.
+        if cfg.byzantine_schedule:
+            rnd_s, ct_s = cfg.byzantine_schedule.split(":")
+            assert int((abyz == int(rnd_s)).sum()) == int(ct_s)
+            assert int((abyz != faults.NEVER).sum()) == int(ct_s)
+        # Tag disjointness as an observable: the uniform draws under the
+        # three tags pairwise differ on the same base key.
         key = jax.random.PRNGKey(seed)
-        u_crash = jax.random.uniform(
-            jax.random.fold_in(key, faults.CRASH_TAG), (n,))
-        u_rev = jax.random.uniform(
-            jax.random.fold_in(key, faults.REVIVE_TAG), (n,))
-        assert not np.array_equal(np.asarray(u_crash), np.asarray(u_rev))
+        u = {
+            tag: np.asarray(jax.random.uniform(
+                jax.random.fold_in(key, tag), (n,)))
+            for tag in (faults.CRASH_TAG, faults.REVIVE_TAG, faults.BYZ_TAG)
+        }
+        assert not np.array_equal(u[faults.CRASH_TAG], u[faults.REVIVE_TAG])
+        assert not np.array_equal(u[faults.CRASH_TAG], u[faults.BYZ_TAG])
+        assert not np.array_equal(u[faults.REVIVE_TAG], u[faults.BYZ_TAG])
 
 
 def test_revive_schedule_exact_counts_and_overflow():
@@ -309,6 +327,36 @@ def test_checkpoint_stream_v4_sensitivity(tmp_path):
     with np.load(path2) as z:
         arrays = {k: z[k] for k in z.files}
     arrays["__stream__"] = np.asarray(3)
+    np.savez_compressed(path2, **arrays)
+    _, rnds, _ = ckpt.load(path2)
+    assert rnds == 8
+
+
+def test_checkpoint_stream_v5_sensitivity(tmp_path):
+    # ISSUE 16, the same per-version rule one notch up: v4 -> v5 only
+    # ADDED the byzantine adversary-plane stream, so a byzantine config
+    # refuses any pre-v5 archive while a v4 checkpoint without a
+    # byzantine model still loads under v5.
+    from cop5615_gossip_protocol_tpu.models import pushsum as ps
+    cfg = SimConfig(n=64, topology="full", algorithm="push-sum",
+                    byzantine_rate=0.05, byzantine_mode="mass_inflate")
+    st = ps.init_state(64, jnp.float32, 0)
+    path = tmp_path / "old_byz.npz"
+    ckpt.save(path, st, 8, cfg)
+    with np.load(path) as z:
+        arrays = {k: z[k] for k in z.files}
+    arrays["__stream__"] = np.asarray(4)
+    np.savez_compressed(path, **arrays)
+    with pytest.raises(ValueError, match="stream"):
+        ckpt.load(path)
+    # Same v4 marker, no byzantine model: loads fine (the added stream is
+    # never consumed).
+    cfg_honest = dataclasses.replace(cfg, byzantine_rate=0.0)
+    path2 = tmp_path / "old_honest.npz"
+    ckpt.save(path2, st, 8, cfg_honest)
+    with np.load(path2) as z:
+        arrays = {k: z[k] for k in z.files}
+    arrays["__stream__"] = np.asarray(4)
     np.savez_compressed(path2, **arrays)
     _, rnds, _ = ckpt.load(path2)
     assert rnds == 8
