@@ -40,6 +40,9 @@ sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 from cop5615_gossip_protocol_tpu.analysis.jaxpr_walk import (  # noqa: E402,F401
     COLLECTIVE_PRIMS,
     REMOTE_DMA,
+    WIRE_PRIMS,
+    body_recv_bytes,
+    body_wire_bytes,
     count_collectives,
 )
 from cop5615_gossip_protocol_tpu.analysis.matrix import AUDIT_GRID  # noqa: E402
@@ -63,8 +66,9 @@ def table(reports) -> list[str]:
     # (the output avals) — the honest column for asymmetric collectives:
     # an all_gather receives the n_dev-wide copy, a reduce_scatter only
     # the local shard. The replicated-pool2 O(N) -> O(N/P + margins)
-    # band-wire delta (ISSUE 15) shows up in recv bytes.
-    wire_prims = ("ppermute", "all_gather", "reduce_scatter", REMOTE_DMA)
+    # band-wire delta (ISSUE 15) shows up in recv bytes. Both columns are
+    # computed by the shared jaxpr_walk reducers — the same formula the
+    # cost model's wire term uses (ISSUE 17).
     out = [
         "| engine | topology | algorithm | overlap | mechanism "
         "| ppermute/step | psum/step | all_gather/step "
@@ -73,8 +77,8 @@ def table(reports) -> list[str]:
         "|---|---|---|---|---|---|---|---|---|---|---|---|---|",
     ]
     for r in reports:
-        wire_bytes = sum(r.body_bytes(p) for p in wire_prims)
-        recv_bytes = sum(r.body_bytes_out(p) for p in wire_prims)
+        wire_bytes = body_wire_bytes(r.counts)
+        recv_bytes = body_recv_bytes(r.counts)
         setup = sum(r.setup_count(p) for p in COLLECTIVE_PRIMS)
         out.append(
             f"| {r.engine} | {r.topology} | {r.algorithm} "

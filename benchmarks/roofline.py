@@ -224,9 +224,57 @@ def section() -> list[str]:
     return out
 
 
-def main() -> int:
+def export_models() -> dict:
+    """Machine-readable export of the roofline MODEL (no hardware
+    needed): the roof constants, the per-node-per-round compute linear
+    forms the cost model scores with (analysis/cost.COMPUTE_MODELS — one
+    home, re-exported here so the calibration artifact and the model can
+    be diffed offline), and the POINTS byte/op models. The measured
+    us/round column still needs the chip (``section()``)."""
+    from cop5615_gossip_protocol_tpu.analysis.cost import COMPUTE_MODELS
+
+    return {
+        "schema": 1,
+        "roofs": {
+            "hbm_gbs": HBM_ROOF_GBS,
+            "vpu_ops_per_s": VPU_ROOF_OPS,
+            "mxu_flops_per_s": MXU_ROOF_FLOPS,
+        },
+        "compute_models": COMPUTE_MODELS,
+        "points": [
+            {
+                "label": label, "kind": kind, "algorithm": algo, "n": n,
+                "overrides": overrides, "bound_class": klass,
+                "model_bytes_per_node_round": model_b,
+                "model_vpu_ops_per_node_round": model_ops,
+                "model_mxu_flops_per_node_round": model_mxu,
+            }
+            for (label, kind, algo, n, overrides, klass, model_b,
+                 model_ops, model_mxu, _why) in POINTS
+        ],
+    }
+
+
+def main(argv=None) -> int:
+    import argparse
+    import json
+
     import jax
 
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--json", type=str, default=None, metavar="FILE",
+                    help="write the roofline MODEL (roof constants + "
+                    "linear forms + POINTS models) as JSON — "
+                    "hardware-free; the measured table still needs the "
+                    "chip")
+    args = ap.parse_args(argv)
+
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(export_models(), f, indent=2, sort_keys=True)
+        print(f"[roofline] wrote {args.json}", file=sys.stderr)
+        if jax.default_backend() != "tpu":
+            return 0
     if jax.default_backend() != "tpu":
         print("roofline accounting needs the real chip", file=sys.stderr)
         return 2

@@ -241,6 +241,25 @@ def _trajectory_section(seed: int, trajectory_path: str, grid_n) -> list[str]:
 def generate(out_path: str, seed: int, grid_n, scale_n, platform_note: str,
              replicas: int = 0, us_pairs: int = 3,
              us_budgets=None, trajectory_path: str | None = None) -> None:
+    """_generate with the warm-pool tally GUARANTEED: the hit/miss line
+    prints even when a cell degrades down the engine ladder or an error
+    aborts the suite mid-run — the pool evidence the autotuner's
+    amortization term relies on used to vanish on exactly the
+    interesting (degraded) runs."""
+    try:
+        _generate(out_path, seed, grid_n, scale_n, platform_note,
+                  replicas=replicas, us_pairs=us_pairs,
+                  us_budgets=us_budgets, trajectory_path=trajectory_path)
+    finally:
+        from cop5615_gossip_protocol_tpu.serving import pool as pool_mod
+
+        print(f"[suite] warm-engine pool: {pool_mod.default_pool().stats()}",
+              flush=True)
+
+
+def _generate(out_path: str, seed: int, grid_n, scale_n, platform_note: str,
+              replicas: int = 0, us_pairs: int = 3,
+              us_budgets=None, trajectory_path: str | None = None) -> None:
     lines = [
         "# BENCH_TABLES — old vs new on the reference's own grid",
         "",
@@ -430,10 +449,6 @@ def generate(out_path: str, seed: int, grid_n, scale_n, platform_note: str,
     )
     lines.append("")
     Path(out_path).write_text("\n".join(lines))
-    from cop5615_gossip_protocol_tpu.serving import pool as pool_mod
-
-    print(f"[suite] warm-engine pool: {pool_mod.default_pool().stats()}",
-          flush=True)
     print(f"[suite] wrote {out_path}")
 
 
@@ -556,6 +571,109 @@ def _northstar_section(seed: int) -> list[str]:
     return out
 
 
+def _calibrate(quick: bool) -> dict:
+    """Schema-v1 calibration from REAL runs on the current host (ISSUE
+    17): microbench floors (dispatch, addressing, rolls, one-hot MXU
+    blend) plus one fused-kernel probe round measured through the same
+    differential timing the bench tables use — so the vpu_op_ns floor is
+    the backend-honest number (Pallas interpret mode on CPU, compiled on
+    TPU), which is what keeps CPU plan choices on the chunked engines."""
+    import jax
+
+    from benchmarks.compare import engine_us_per_round
+    from benchmarks.microbench import collect as micro_collect
+    from cop5615_gossip_protocol_tpu.analysis import cost
+
+    micro = micro_collect(quick=quick)
+    # The fused probe runs the in-kernel threefry, which replicates the
+    # partitionable stream only — same pin the execution suites use.
+    jax.config.update("jax_threefry_partitionable", True)
+    probe_n, probe_k = 4_096, 2
+    print("[suite] autotune: probing the fused pool round "
+          f"(n={probe_n}, K={probe_k})", flush=True)
+    us = engine_us_per_round(
+        "full", "push-sum", probe_n, engine="fused", delivery="pool",
+        pool_size=probe_k, r1=4, r2=12,
+    )
+    fused_probe = {"n": probe_n, "pool_size": probe_k, "us_per_round": us}
+    return {
+        "schema": cost.CALIBRATION_SCHEMA,
+        "host": {
+            "backend": jax.default_backend(),
+            "device_kind": getattr(jax.devices()[0], "device_kind",
+                                   "unknown"),
+            "device_count": len(jax.devices()),
+        },
+        "floors": cost.derive_floors(micro, fused_probe),
+        "provenance": {
+            "generated_by": "python benchmarks/suite.py --autotune",
+            "date": datetime.date.today().isoformat(),
+            "microbench_quick": bool(quick),
+            "fused_probe": fused_probe,
+        },
+    }
+
+
+def _autotune(args) -> int:
+    """suite --autotune: regenerate analysis/calibration.json from real
+    microbench/roofline-model probes on this host, then render the
+    ranked plan decision table over the BENCH/serving cells
+    (cost.AUTOTUNE_CELLS) as the --out markdown artifact. With
+    --calibration FILE the measurement leg is skipped and selection runs
+    against the fixed table — the CI determinism check renders twice and
+    diffs."""
+    import json
+
+    from cop5615_gossip_protocol_tpu.analysis import cost
+    from cop5615_gossip_protocol_tpu.utils.compat import (
+        set_host_device_count,
+    )
+
+    # The sharded cells trace their wire term on a virtual mesh; request
+    # enough host devices BEFORE the first computation initializes the
+    # backend (CPU-only knob — a real TPU mesh is unaffected). Cells the
+    # host still cannot serve render as explicit SKIPPED rows.
+    try:
+        set_host_device_count(
+            max((ov.get("n_devices") or 1)
+                for _, _, _, ov in cost.AUTOTUNE_CELLS)
+        )
+    except RuntimeError:
+        pass  # backend already initialized; SKIPPED rows say so
+    # Candidate legality consults the same support predicates as the
+    # dispatch, and the fused tiers' in-kernel threefry requires the
+    # partitionable stream — pin it (the execution suites' standard
+    # runtime) so selection never depends on the ambient flag.
+    import jax
+
+    jax.config.update("jax_threefry_partitionable", True)
+
+    out = Path(
+        "PLAN_TABLE.md" if args.out == "BENCH_TABLES.md" else args.out
+    )
+    if args.calibration:
+        cal = cost.load_calibration(args.calibration)
+        print(f"[suite] autotune: fixed calibration {args.calibration}",
+              flush=True)
+    else:
+        cal = _calibrate(quick=args.quick or args.smoke)
+        cost.CALIBRATION_PATH.write_text(
+            json.dumps(cal, indent=2, sort_keys=True) + "\n"
+        )
+        print(f"[suite] wrote {cost.CALIBRATION_PATH}", flush=True)
+    lines = (
+        ["# Plan selection — measured-cost autotuner decision table", "",
+         f"Floors: {json.dumps(cal['floors'], sort_keys=True)}", ""]
+        + cost.render_plan_table(
+            cal, say=lambda m: print(f"[suite] autotune: {m}", flush=True)
+        )
+        + [""]
+    )
+    out.write_text("\n".join(lines))
+    print(f"[suite] wrote {out}")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--out", default="BENCH_TABLES.md")
@@ -577,6 +695,15 @@ def main(argv=None) -> int:
                     help="skip the persistent XLA compilation cache "
                     "(enabled by default so repeated suite runs stop "
                     "re-paying compile)")
+    ap.add_argument("--autotune", action="store_true",
+                    help="regenerate analysis/calibration.json from real "
+                    "microbench probes on this host and write the ranked "
+                    "plan decision table (--out, default PLAN_TABLE.md) "
+                    "instead of BENCH_TABLES (ISSUE 17)")
+    ap.add_argument("--calibration", type=str, default=None, metavar="FILE",
+                    help="with --autotune: skip measurement and run "
+                    "selection against this fixed calibration file (the "
+                    "CI determinism check)")
     ap.add_argument("--trajectory", type=str, default=None, metavar="FILE",
                     help="run the smallest grid cell with the telemetry "
                     "plane on, write its per-round trajectory JSONL here, "
@@ -588,9 +715,6 @@ def main(argv=None) -> int:
 
     if args.platform == "cpu":
         jax.config.update("jax_platforms", "cpu")
-        platform_note = "CPU (forced)"
-    else:
-        platform_note = jax.devices()[0].platform
     if not args.no_compile_cache:
         from cop5615_gossip_protocol_tpu.utils.compat import (
             enable_compilation_cache,
@@ -598,6 +722,14 @@ def main(argv=None) -> int:
 
         print(f"[suite] compile cache: {enable_compilation_cache()}",
               flush=True)
+    if args.autotune:
+        # Dispatch before anything probes jax.devices(): _autotune must
+        # request the virtual mesh ahead of backend initialization.
+        return _autotune(args)
+    platform_note = (
+        "CPU (forced)" if args.platform == "cpu"
+        else jax.devices()[0].platform
+    )
     if args.smoke:
         grid_n = (min(baseline_data.GRID_N),)
     elif args.quick:

@@ -55,6 +55,10 @@ BYZANTINE_HEADER = (
     "## Convergence degradation under Byzantine attack "
     "(benchmarks/trend.py --byzantine)"
 )
+PLAN_HEADER = (
+    "## Plan selection — measured-cost autotuner "
+    "(benchmarks/trend.py --autotune)"
+)
 
 
 def load_snapshots(root: Path) -> dict:
@@ -447,6 +451,42 @@ def render_byzantine() -> str:
     return "\n".join(lines)
 
 
+def render_autotune() -> str:
+    """The ISSUE 17 plan-selection section: the measured-cost autotuner's
+    decision table over analysis/cost.AUTOTUNE_CELLS, rendered against
+    the COMMITTED calibration (analysis/calibration.json) — so the
+    section is deterministic (records of the committed decision, not
+    fresh measurements) and a re-apply is byte-identical until the
+    calibration file itself is regenerated (benchmarks/suite.py
+    --autotune)."""
+    sys.path.insert(0, str(REPO))
+    from cop5615_gossip_protocol_tpu.analysis import cost, matrix
+
+    # The sharded cells trace their wire term on an 8-device virtual
+    # mesh; pin the tracing runtime before JAX initializes a backend.
+    matrix.setup_tracing_runtime()
+    cal = cost.load_calibration()
+    lines = [
+        PLAN_HEADER,
+        "",
+        "Plan choices scored by the measured cost model "
+        "(analysis/cost.py): per-round compute from roofline linear "
+        "forms x microbench-calibrated floors, per-round wire from the "
+        "candidate's TRACED receive bytes x the calibrated byte cost, "
+        "plus the amortized dispatch floor. Rendered against the "
+        "committed `analysis/calibration.json` "
+        f"(schema v{cal.get('schema')}, host: "
+        f"{cal.get('host', {}).get('device_kind', '?')}) — regenerate "
+        "with `python benchmarks/suite.py --autotune`. The hand ladder "
+        "stays the oracle: an `agree=**NO**` row is a bug "
+        "(tests/test_autotune.py pins the parity sweep).",
+        "",
+    ]
+    lines += cost.render_plan_table(cal)
+    lines.append("")
+    return "\n".join(lines)
+
+
 def apply_to_bench_tables(table_md: str, bench_tables: Path,
                           header: str = SECTION_HEADER) -> None:
     """Idempotently install/replace one generated section: everything
@@ -506,6 +546,14 @@ def main(argv=None) -> int:
                     "countermeasure, fully seeded so repeated applies are "
                     "byte-identical; with --apply the section installs "
                     "into BENCH_TABLES.md idempotently")
+    ap.add_argument("--autotune", action="store_true",
+                    help="render and append the plan-selection decision "
+                    "table (ISSUE 17): the measured-cost autotuner's "
+                    "ranked plans over analysis/cost.AUTOTUNE_CELLS "
+                    "against the COMMITTED analysis/calibration.json "
+                    "(deterministic — no fresh measurement); with "
+                    "--apply the section installs into BENCH_TABLES.md "
+                    "idempotently")
     args = ap.parse_args(argv)
 
     revs = load_snapshots(args.root)
@@ -549,6 +597,7 @@ def main(argv=None) -> int:
     # tests/test_obs.py pins the idempotence).
     ceilings_md = render_ceilings() if args.ceilings else None
     byzantine_md = render_byzantine() if args.byzantine else None
+    autotune_md = render_autotune() if args.autotune else None
     out = table
     if ceilings_md is not None:
         out = out + "\n" + ceilings_md
@@ -556,6 +605,8 @@ def main(argv=None) -> int:
         out = out + "\n" + matmul_md
     if byzantine_md is not None:
         out = out + "\n" + byzantine_md
+    if autotune_md is not None:
+        out = out + "\n" + autotune_md
     print(out)
     if args.md:
         args.md.write_text(out + "\n")
@@ -575,6 +626,11 @@ def main(argv=None) -> int:
             apply_to_bench_tables(
                 byzantine_md, args.root / "BENCH_TABLES.md",
                 header=BYZANTINE_HEADER,
+            )
+        if autotune_md is not None:
+            apply_to_bench_tables(
+                autotune_md, args.root / "BENCH_TABLES.md",
+                header=PLAN_HEADER,
             )
         print(f"[trend] applied to {args.root / 'BENCH_TABLES.md'}",
               file=sys.stderr)

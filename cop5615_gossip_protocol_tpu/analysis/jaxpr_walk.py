@@ -35,6 +35,16 @@ COLLECTIVE_PRIMS = (
 # the halo path carries NO XLA collective while still shipping bytes.
 REMOTE_DMA = "remote_dma"
 
+# The payload-moving subset the wire/recv byte columns sum: every prim
+# that ships neighbor/band payload between devices. psum is deliberately
+# absent — the tables report it in its own count column, and its result
+# aval equals its operand aval so it would double-count the contribution
+# buffer rather than measure delivered payload. This tuple + the two
+# reducers below are THE formula: benchmarks/comm_audit.py's table and
+# analysis/cost.py's wire term both call them (ISSUE 17 satellite — one
+# formula, pinned equal in tests/test_autotune.py).
+WIRE_PRIMS = ("ppermute", "all_gather", "reduce_scatter", REMOTE_DMA)
+
 # Host round-trips: each of these forces a device->host sync (or a host
 # callback) every time it executes. Inside a chunk-loop body that is once
 # per ROUND — the exact per-dispatch cost the chunked drivers exist to
@@ -160,6 +170,28 @@ def collect_collectives(jaxpr) -> dict:
 
     walk(jaxpr, visit)
     return counts
+
+
+def _body_sum(counts: dict, field: str) -> int:
+    body = counts.get("body", {})
+    return sum(body.get(p, {}).get(field, 0) for p in WIRE_PRIMS)
+
+
+def body_wire_bytes(counts: dict) -> int:
+    """Per-step bytes each device FEEDS the wire primitives (operand
+    avals), summed over ``WIRE_PRIMS`` in the body region of a
+    ``collect_collectives`` result."""
+    return _body_sum(counts, "bytes")
+
+
+def body_recv_bytes(counts: dict) -> int:
+    """Per-step bytes each device RECEIVES from the wire primitives
+    (result avals) — the honest column for asymmetric collectives: an
+    all_gather receives the n_dev-wide copy, a reduce_scatter only the
+    local shard. The replicated-pool2 O(N) -> O(N/P + margins) band-wire
+    delta (ISSUE 15) lives here, and the cost model's wire term is
+    ``body_recv_bytes(counts) * wire_byte_ns``."""
+    return _body_sum(counts, "bytes_out")
 
 
 def count_collectives(fn, args) -> dict:

@@ -130,6 +130,46 @@ SINGLE_GRID = (
       "byzantine_mode": "garble"}),
 )
 
+# Representative plan='auto' mesh requests (ISSUE 17): the winners
+# resolve against the COMMITTED calibration (analysis/calibration.json)
+# and the resulting engine rows are audited exactly like hand-picked
+# AUDIT_GRID rows — the acceptance hook "the static auditor verifies the
+# chosen plan's wire". (topology, algorithm, n, n_devices, extra cfg.)
+AUTOTUNE_AUDIT = (
+    ("torus3d", "gossip", 4096, 8, {}),
+    ("full", "push-sum", 262144, 8, {"engine": "fused",
+                                     "delivery": "pool"}),
+    ("full", "push-sum", 262144, 2, {"engine": "fused",
+                                     "delivery": "matmul"}),
+    ("full", "push-sum", 262144, 8, {"engine": "fused",
+                                     "delivery": "matmul"}),
+)
+
+
+def autotuned_cells() -> tuple:
+    """AUDIT_GRID-style rows for the plans the autotuner CHOOSES on the
+    AUTOTUNE_AUDIT requests: resolve plan='auto' with the committed
+    calibration, translate each winner into (engine, ..., extra) with
+    the winner's forcing overrides (e.g. the chosen pool2_wire) pinned —
+    so the full matrix audits the autotuned plans' wire with the same
+    checkers, specs, and schedule pairing as every hand row."""
+    from cop5615_gossip_protocol_tpu import SimConfig, build_topology
+
+    from . import cost
+
+    rows = []
+    for topo_name, algo, n, n_dev, extra in AUTOTUNE_AUDIT:
+        cfg = SimConfig(n=n, topology=topo_name, algorithm=algo,
+                        plan="auto", n_devices=n_dev, **extra)
+        topo = build_topology(topo_name, n)
+        decision = cost.choose(topo, cfg)
+        engine = decision.winner.name.split(":")[0]
+        cell_extra = dict(extra)
+        cell_extra.update(decision.winner.override_dict)
+        rows.append((engine, topo_name, algo, n, n_dev, cell_extra))
+    return tuple(rows)
+
+
 # Serving batch-engine cells (ISSUE 14): the vmapped continuous chunk +
 # the lane-refill program, traced through models.sweep.probe_batch_programs.
 # The refill path's contract is the host-sync WHOLE-program check — the
@@ -205,6 +245,13 @@ def audit_matrix(grid=None, single_grid=None, quick: bool = False,
     findings: list[Finding] = []
     grid = AUDIT_GRID if grid is None else grid
     single_grid = SINGLE_GRID if single_grid is None else single_grid
+    if grid is AUDIT_GRID and not quick:
+        # Full audits also walk the AUTOTUNED plans (ISSUE 17): resolve
+        # the plan='auto' requests against the committed calibration and
+        # audit whatever the cost model picked with the same checkers as
+        # the hand rows above.
+        say("resolve autotuned plans (analysis/calibration.json)")
+        grid = grid + autotuned_cells()
 
     # Sharded cells, paired by schedule (and by transport for dma rows).
     wire_reports: dict[tuple, trace.AuditReport] = {}

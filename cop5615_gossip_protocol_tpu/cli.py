@@ -224,6 +224,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="round engine: chunked (XLA while_loop) vs fused (Pallas "
                    "multi-round kernel, VMEM-resident state); auto fuses on TPU "
                    "where eligible")
+    p.add_argument("--plan", choices=["hand", "auto"], default="hand",
+                   help="plan selection: hand (the maintained dispatch "
+                   "ladder) vs auto (the measured cost model — "
+                   "analysis/cost.py scores the legal candidates from "
+                   "analysis/calibration.json floors, picks the winner, "
+                   "and logs a plan-chosen event with the ranked table)")
     p.add_argument("--devices", type=int, default=None,
                    help="shard the node dimension over this many devices")
     p.add_argument("--platform", choices=["auto", "cpu", "tpu"], default="auto",
@@ -349,6 +355,7 @@ def _main_refsim(args, parser) -> int:
         "--delivery": changed("delivery"),
         "--pool-size": changed("pool_size"),
         "--engine": changed("engine"),
+        "--plan": changed("plan"),
         "--devices": changed("devices"),
         "--platform": changed("platform"),
         "--x64": changed("x64"),
@@ -535,6 +542,7 @@ def main(argv: Optional[list[str]] = None) -> int:
             delivery=args.delivery,
             pool_size=args.pool_size,
             engine=args.engine,
+            plan=args.plan,
             n_devices=args.devices,
             # Config-level so sweep-engine contracts (e.g. --replicas with
             # --engine fused) fail HERE, before topology build.

@@ -313,6 +313,18 @@ class SimConfig:
     # "auto" = fused on TPU where eligible, else chunked.
     engine: str = "auto"
 
+    # Plan selection policy (ISSUE 17). "hand" (default) = the runner's
+    # maintained dispatch ladder picks the engine/composition/wire.
+    # "auto" = the measured cost model (analysis/cost.py) enumerates the
+    # legal candidates the refusal rules admit, scores each from the
+    # calibrated floors in analysis/calibration.json (regenerate with
+    # `python benchmarks/suite.py --autotune`), and the runner executes
+    # the winner — logging a structured `plan-chosen` event with the
+    # ranked table. The hand rules stay the oracle: tests pin that the
+    # autotuner reproduces the ladder's choice on every BENCH/serving
+    # cell under the committed calibration.
+    plan: str = "hand"
+
     # Delivery strategy: "scatter" = scatter-add (any topology), "stencil" =
     # masked circular shifts (offset-structured topologies only — line, ring,
     # grids, tori; ops/topology.stencil_offsets), "pool" = offset-pool
@@ -635,6 +647,17 @@ class SimConfig:
         if self.engine not in ("auto", "chunked", "fused"):
             raise ValueError(
                 f"unknown engine {self.engine!r}; expected auto|chunked|fused"
+            )
+        if self.plan not in ("hand", "auto"):
+            raise ValueError(
+                f"unknown plan {self.plan!r}; expected hand|auto"
+            )
+        if self.plan == "auto" and self.semantics == "reference":
+            raise ValueError(
+                "plan='auto' scores the batched chunk engines "
+                "(analysis/cost.py); reference semantics runs its own "
+                "single-walk simulator with nothing to choose between — "
+                "use batched semantics or plan='hand'"
             )
         if not (1 <= self.replicas <= MAX_REPLICAS):
             raise ValueError(

@@ -1469,6 +1469,36 @@ def _engine_ladder(cfg: SimConfig) -> list:
     return rungs
 
 
+def _resolve_plan_auto(topo: Topology, cfg: SimConfig,
+                       on_event: Optional[Callable] = None) -> SimConfig:
+    """plan='auto' (ISSUE 17): consult the measured cost model
+    (analysis/cost.py — candidates enumerated by the SAME refusal rules
+    this dispatch applies, scored from the calibrated floors in
+    analysis/calibration.json) and return the winner's config: plan='hand'
+    plus the winner's forcing overrides, so the resolved run takes the
+    EXISTING dispatch path — the ladder, probe hook, and auditor all see
+    an ordinary hand config. The ranked table is reported through
+    ``on_event("plan-chosen", ...)`` (candidates, scores, winner); a
+    request no candidate serves raises ValueError with every refusal
+    reason, mirroring the hand dispatch's failure mode."""
+    from ..analysis import cost
+
+    decision = cost.choose(topo, cfg)
+    record = decision.event_record()
+    print(
+        f"plan-chosen: {record['winner']} "
+        f"(~{record['predicted_us_per_round']:.0f} us/round predicted; "
+        f"{len(record['candidates'])} candidate(s), "
+        f"{len(record['refused'])} refused)",
+        file=sys.stderr,
+    )
+    if on_event is not None:
+        on_event("plan-chosen", **record)
+    return dataclasses.replace(
+        cfg, plan="hand", **decision.winner.override_dict
+    )
+
+
 def run(
     topo: Topology,
     cfg: SimConfig,
@@ -1516,6 +1546,12 @@ def run(
 
     See _run_resolved for the hook/resume contracts.
     """
+    if cfg.plan == "auto":
+        # Resolve BEFORE the probe short-circuit so the static auditor
+        # audits the autotuned plan's wire exactly as it does hand-picked
+        # ones, and before the ladder so degradation rungs derive from
+        # the chosen plan.
+        cfg = _resolve_plan_auto(topo, cfg, on_event)
     if probe is not None:
         return _run_resolved(
             topo, cfg, key=key, on_chunk=on_chunk,

@@ -214,14 +214,33 @@ def padded_population(kind: str, n: int, seed: int = 0,
     return get_topology(kind, n, seed=seed, semantics=semantics).n
 
 
+def resolved_plan_label(cfg: SimConfig, topo: Topology) -> str:
+    """The plan the runner will actually execute for (cfg, topo):
+    "hand" for hand-planned configs, the cost model's winning candidate
+    name (e.g. "chunked", "pool2-sharded:reduce_scatter") for
+    plan='auto' (ISSUE 17). ``plan`` itself is a raw compile-class field
+    — conservatively key-splitting, see HOST_ONLY_FIELDS — but the
+    micro-batcher additionally pins the RESOLVED choice: two auto
+    requests whose calibration resolves them to different winners must
+    never co-batch onto one engine."""
+    if cfg.plan != "auto":
+        return "hand"
+    from ..analysis import cost
+
+    return cost.choose(topo, cfg).winner.name
+
+
 def serve_bucket_key(cfg: SimConfig, topo: Topology) -> tuple:
     """The micro-batcher's grouping key: the compiled-engine key plus the
     batch-wide host knobs (max_rounds — one shared round cap per vmapped
-    loop) and, for seed-built topologies, the build seed (co-batched lanes
-    share ONE neighbor tensor; its VALUES must match, not just shapes)."""
+    loop), for seed-built topologies the build seed (co-batched lanes
+    share ONE neighbor tensor; its VALUES must match, not just shapes),
+    and the RESOLVED plan (plan='auto' requests pin the cost model's
+    winner, not just the spelling of the knob)."""
     topo_seed = cfg.seed if topo.kind in SEED_BUILT_KINDS else None
     return canonical_key(cfg, topo) + (
         ("max_rounds", cfg.max_rounds), ("topo_seed", topo_seed),
+        ("plan", resolved_plan_label(cfg, topo)),
     )
 
 

@@ -1,0 +1,344 @@
+"""Measured-cost plan autotuner pins (ISSUE 17).
+
+The contract under test, in three legs:
+
+- **Parity**: with the COMMITTED calibration (analysis/calibration.json),
+  ``plan='auto'`` reproduces the hand ladder's choice on every
+  BENCH/serving cell in ``cost.AUTOTUNE_CELLS`` — every kind, both
+  algorithms, every delivery/wire tier, two sizes where the tier scales.
+  The hand rules stay the oracle; the model must agree, not replace.
+- **Fires direction**: the model is a real decision procedure, not a
+  replay — a seeded-BAD calibration (near-free VPU ops, ruinous HBM
+  bytes) must FLIP a known choice. A cost model that cannot change its
+  answer under different measurements is dead code.
+- **Shared wire formula** (satellite): comm_audit's recv-bytes reduction
+  is ONE library call (``jaxpr_walk.body_recv_bytes`` over
+  ``WIRE_PRIMS``) consumed by both the audit table and the cost model's
+  wire term — pinned value-equal against the open-coded sum on the
+  PR 15 replicated-pool2 n=2^18 / 8-device cell.
+"""
+
+import dataclasses
+import functools
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import jax  # noqa: E402
+
+from benchmarks.comm_audit import audit_engine  # noqa: E402
+
+from cop5615_gossip_protocol_tpu import (  # noqa: E402
+    SimConfig,
+    build_topology,
+)
+from cop5615_gossip_protocol_tpu.analysis import cost, jaxpr_walk  # noqa: E402
+from cop5615_gossip_protocol_tpu.models import runner  # noqa: E402
+from cop5615_gossip_protocol_tpu.serving import keys  # noqa: E402
+
+GOOD_FLOORS = {
+    "dispatch_us": 50.0,
+    "hbm_byte_ns": 0.01,
+    "vpu_op_ns": 1000.0,
+    "mxu_flop_ns": 0.01,
+    "addressing_ns_per_elem": 5.0,
+    "wire_byte_ns": 0.02,
+}
+
+
+def _cal(floors) -> dict:
+    return {"schema": cost.CALIBRATION_SCHEMA, "floors": dict(floors)}
+
+
+@functools.lru_cache(maxsize=None)
+def _cell(kind, algo, n, overrides_items):
+    cfg = SimConfig(n=n, topology=kind, algorithm=algo,
+                    **dict(overrides_items))
+    topo = build_topology(kind, n)
+    return topo, cfg
+
+
+def _cells():
+    for kind, algo, n, overrides in cost.AUTOTUNE_CELLS:
+        n_dev = overrides.get("n_devices") or 1
+        if n_dev > len(jax.devices()):
+            continue
+        yield kind, algo, n, overrides
+
+
+# ---------------------------------------------------------------------------
+# Calibration file: schema, validation, committed artifact.
+
+
+def test_committed_calibration_loads_and_validates():
+    cal = cost.load_calibration()
+    cost.validate_calibration(cal)
+    assert cal["schema"] == cost.CALIBRATION_SCHEMA
+    assert set(cost.FLOOR_KEYS) <= set(cal["floors"])
+
+
+def test_calibration_schema_mismatch_rejected():
+    with pytest.raises(ValueError, match="schema"):
+        cost.validate_calibration({"schema": 99, "floors": GOOD_FLOORS})
+
+
+def test_calibration_missing_floor_rejected():
+    floors = dict(GOOD_FLOORS)
+    del floors["vpu_op_ns"]
+    with pytest.raises(ValueError, match="vpu_op_ns"):
+        cost.validate_calibration(_cal(floors))
+
+
+@pytest.mark.parametrize("bad", [0.0, -1.0, float("nan"), float("inf")])
+def test_calibration_nonpositive_floor_rejected(bad):
+    floors = dict(GOOD_FLOORS, hbm_byte_ns=bad)
+    with pytest.raises(ValueError, match="hbm_byte_ns"):
+        cost.validate_calibration(_cal(floors))
+
+
+def test_calibration_file_on_disk_is_current_schema():
+    raw = json.loads(cost.CALIBRATION_PATH.read_text())
+    assert raw["schema"] == cost.CALIBRATION_SCHEMA
+    # Provenance must say where it came from, so a stale artifact is
+    # diagnosable from the file alone.
+    assert "generated_by" in raw.get("provenance", {})
+
+
+# ---------------------------------------------------------------------------
+# Config knob.
+
+
+def test_plan_knob_validates():
+    with pytest.raises(ValueError, match="unknown plan"):
+        SimConfig(n=64, topology="line", plan="bogus")
+
+
+def test_plan_auto_refuses_reference_semantics():
+    with pytest.raises(ValueError, match="reference"):
+        SimConfig(n=64, topology="line", plan="auto",
+                  semantics="reference")
+
+
+# ---------------------------------------------------------------------------
+# Tentpole leg 1: the autotuner reproduces the hand ladder on every
+# BENCH/serving cell (all kinds x algorithms x delivery/wire tiers, two
+# sizes) with the committed calibration.
+
+
+@pytest.mark.parametrize(
+    "kind,algo,n,overrides",
+    list(_cells()),
+    ids=lambda v: str(v).replace(" ", "") if not isinstance(v, dict)
+    else ",".join(f"{k}={v[k]}" for k in sorted(v)) or "defaults",
+)
+def test_parity_model_reproduces_hand_ladder(kind, algo, n, overrides):
+    topo, cfg = _cell(kind, algo, n, tuple(sorted(overrides.items())))
+    decision = cost.choose(topo, cfg)
+    assert decision.winner.name == cost.hand_choice(topo, cfg)
+    assert decision.predicted_us_per_round > 0
+
+
+def test_parity_sweep_covers_tiers_and_two_sizes():
+    """The sweep itself must stay representative: every topology kind,
+    both algorithms, every delivery tier, sharded cells on both wire
+    outcomes, and at least one tier at two sizes."""
+    cells = list(cost.AUTOTUNE_CELLS)
+    kinds = {c[0] for c in cells}
+    assert {"line", "ring", "grid2d", "grid3d", "torus3d", "full",
+            "imp2d", "imp3d"} <= kinds
+    assert {c[1] for c in cells} == {"gossip", "push-sum"}
+    deliveries = {c[3].get("delivery", "auto") for c in cells}
+    assert {"auto", "stencil", "pool", "matmul", "scatter"} <= deliveries
+    by_tier = {}
+    for kind, algo, n, ov in cells:
+        by_tier.setdefault((kind, ov.get("delivery", "auto")), set()).add(n)
+    assert any(len(ns) >= 2 for ns in by_tier.values())
+    assert any(c[3].get("n_devices") for c in cells)
+
+
+def test_hand_oracle_matches_executed_fused_variant():
+    """The oracle's fused:{variant} names are the DISPATCH's variants,
+    not a parallel taxonomy: probe the real runner on the fused-pinned
+    single-device cells and compare."""
+    for kind, algo, n, overrides in _cells():
+        if overrides.get("engine") != "fused" or "n_devices" in overrides:
+            continue
+        topo, cfg = _cell(kind, algo, n, tuple(sorted(overrides.items())))
+        seen = {}
+
+        def probe(fn, args, donate=None, **info):
+            seen.update(info)
+            return "probed"
+
+        assert runner.run(topo, cfg, probe=probe) == "probed"
+        assert cost.hand_choice(topo, cfg) == f"fused:{seen['variant']}"
+
+
+def test_pool2_wire_choice_flips_with_mesh_size():
+    """The wire term is measured, not assumed: the same n=2^18 matmul
+    request resolves all_gather at 2 devices (every band exceeds the
+    full copy) and reduce_scatter at 8 (O(N/P + margins) wins) — and the
+    model's per-candidate wire costs order accordingly."""
+    picks = {}
+    for n_dev in (2, 8):
+        topo, cfg = _cell(
+            "full", "push-sum", 262_144,
+            (("delivery", "matmul"), ("engine", "fused"),
+             ("n_devices", n_dev)),
+        )
+        decision = cost.choose(topo, cfg)
+        picks[n_dev] = decision.winner.name
+        wires = {s.candidate.name: s.wire_us for s in decision.scores}
+        assert set(wires) == {"pool2-sharded:all_gather",
+                              "pool2-sharded:reduce_scatter"}
+        cheaper = min(wires, key=wires.get)
+        assert decision.winner.name == cheaper
+    assert picks[2] == "pool2-sharded:all_gather"
+    assert picks[8] == "pool2-sharded:reduce_scatter"
+
+
+def test_no_candidate_raises_with_refusals():
+    # Sharded matmul on the chunked engine: the hand dispatch refuses,
+    # so the model must refuse too — with the reasons, not an empty
+    # table.
+    topo, cfg = _cell(
+        "full", "push-sum", 262_144,
+        (("delivery", "matmul"), ("engine", "chunked"),
+         ("n_devices", 2)),
+    )
+    with pytest.raises(ValueError, match="no legal candidate"):
+        cost.choose(topo, cfg, _cal(GOOD_FLOORS))
+
+
+# ---------------------------------------------------------------------------
+# Tentpole leg 2: the model FIRES in the right direction — a seeded-bad
+# calibration flips a known choice.
+
+
+def test_bad_calibration_flips_known_choice():
+    topo, cfg = _cell("full", "push-sum", 4_096,
+                      (("delivery", "pool"),))
+    good = cost.choose(topo, cfg)  # committed calibration
+    assert good.winner.name == "chunked" == cost.hand_choice(topo, cfg)
+
+    # A host where VPU ops are near-free and HBM/addressing traffic is
+    # ruinous: the fused pool kernel (pure VPU form) must now beat the
+    # chunked engine (HBM + addressing form).
+    bad = _cal(dict(GOOD_FLOORS, vpu_op_ns=1e-6, hbm_byte_ns=1e3,
+                    addressing_ns_per_elem=1e3))
+    flipped = cost.choose(topo, cfg, bad)
+    assert flipped.winner.name == "fused:pool"
+    assert {s.candidate.name for s in flipped.scores} == \
+        {s.candidate.name for s in good.scores}
+
+
+def test_decision_is_deterministic_for_fixed_calibration():
+    topo, cfg = _cell("full", "push-sum", 4_096,
+                      (("delivery", "pool"),))
+    cal = _cal(GOOD_FLOORS)
+    a = cost.choose(topo, cfg, cal).event_record()
+    b = cost.choose(topo, cfg, cal).event_record()
+    assert a == b
+
+
+# ---------------------------------------------------------------------------
+# Runner integration: plan='auto' resolves through the public entry,
+# reports the ranked table as a structured event, and executes.
+
+
+def test_runner_plan_auto_emits_plan_chosen_event():
+    topo, cfg = _cell("line", "gossip", 64, (("plan", "auto"),))
+    events = []
+
+    def on_event(name, **record):
+        events.append((name, record))
+
+    def probe(fn, args, donate=None, **info):
+        return "probed"
+
+    assert runner.run(topo, cfg, probe=probe, on_event=on_event) == "probed"
+    chosen = [r for nm, r in events if nm == "plan-chosen"]
+    assert len(chosen) == 1
+    rec = chosen[0]
+    assert rec["winner"] == "chunked"
+    assert rec["predicted_us_per_round"] > 0
+    names = [c["plan"] for c in rec["candidates"]]
+    assert names[0] == "chunked" and "fused:stencil" in names
+    for c in rec["candidates"]:
+        assert set(c) >= {"plan", "compute_us", "wire_us", "dispatch_us",
+                          "total_us"}
+
+
+def test_runner_plan_auto_executes_end_to_end():
+    topo, cfg = _cell(
+        "line", "gossip", 64,
+        (("max_rounds", 600), ("plan", "auto"), ("seed", 0)),
+    )
+    hand_cfg = dataclasses.replace(cfg, plan="hand")
+    auto = runner.run(topo, cfg)
+    hand = runner.run(topo, hand_cfg)
+    # Same winner => identical simulation, round for round.
+    assert auto.rounds == hand.rounds
+    assert auto.outcome == hand.outcome
+
+
+def test_serve_bucket_key_pins_resolved_plan():
+    topo, cfg = _cell("line", "gossip", 64, (("plan", "auto"),))
+    label = keys.resolved_plan_label(cfg, topo)
+    assert label == cost.choose(topo, cfg).winner.name == "chunked"
+    assert ("plan", "chunked") in keys.serve_bucket_key(cfg, topo)
+    hand_cfg = dataclasses.replace(cfg, plan="hand")
+    assert ("plan", "hand") in keys.serve_bucket_key(hand_cfg, topo)
+
+
+# ---------------------------------------------------------------------------
+# Satellite: ONE recv-bytes formula, shared by the audit table and the
+# cost model's wire term — pinned on the PR 15 n=2^18 / 8-device cell.
+
+
+def test_recv_bytes_library_matches_table_formula():
+    rep = audit_engine(
+        "pool2-sharded", "full", "push-sum", 262_144, 8, True,
+        {"engine": "fused", "delivery": "pool"},
+    )
+    body = rep.counts.get("body", {})
+    open_coded_recv = sum(
+        body.get(p, {}).get("bytes_out", 0) for p in jaxpr_walk.WIRE_PRIMS
+    )
+    open_coded_wire = sum(
+        body.get(p, {}).get("bytes", 0) for p in jaxpr_walk.WIRE_PRIMS
+    )
+    assert jaxpr_walk.body_recv_bytes(rep.counts) == open_coded_recv > 0
+    assert jaxpr_walk.body_wire_bytes(rep.counts) == open_coded_wire > 0
+    # The banded reduce_scatter wire's signature quantity survives the
+    # refactor: per-device received bytes stay BELOW the full-copy
+    # gather (bytes ships the payload, bytes_out what one device keeps).
+    assert jaxpr_walk.body_recv_bytes(rep.counts) < \
+        jaxpr_walk.body_wire_bytes(rep.counts)
+
+
+def test_wire_prims_exclude_psum():
+    # psum is deliberately NOT a wire prim: it has its own table column,
+    # and folding it in would double-count the verdict reduction.
+    assert "psum" not in jaxpr_walk.WIRE_PRIMS
+
+
+# ---------------------------------------------------------------------------
+# Ranked-table artifact: deterministic render, skips are explicit.
+
+
+def test_render_plan_table_deterministic_and_all_agree():
+    cal = cost.load_calibration()
+    lines_a = cost.render_plan_table(cal)
+    lines_b = cost.render_plan_table(cal)
+    assert lines_a == lines_b
+    assert not any("**NO**" in ln for ln in lines_a)
+    # Cells the host cannot trace are SKIPPED loudly, never dropped:
+    # every AUTOTUNE_CELLS row appears in the summary.
+    summary = "\n".join(lines_a)
+    for kind, algo, n, ov in cost.AUTOTUNE_CELLS:
+        assert cost.cell_label(kind, algo, n, ov) in summary
