@@ -275,6 +275,7 @@ class FleetProc(ServerProc):
         self.port = -1
         self.jsonl_port = -1
         self.worker_pids: dict = {}
+        self.worker_ports: dict = {}  # wid -> worker HTTP port (direct scrapes)
         self._tail: list = []
         self._await_ready()
 
@@ -320,6 +321,10 @@ class FleetProc(ServerProc):
                 if "fleet-workers" in rec:
                     self.worker_pids = {
                         wid: info["pid"]
+                        for wid, info in rec["fleet-workers"].items()
+                    }
+                    self.worker_ports = {
+                        wid: info["port"]
                         for wid, info in rec["fleet-workers"].items()
                     }
             if line.startswith("FLEET "):
@@ -417,6 +422,26 @@ class ClosedLoopClient(threading.Thread):
         self.sent = 0       # distinct requests sent (retries excluded)
         self.answered = 0   # distinct requests that got a terminal verdict
         self.terminal: dict = {}  # terminal-verdict tally, chaos mode
+        # Fleet-front reroute accounting (ISSUE 18): Σ of the per-response
+        # reroute stamps, and the rerouted payloads themselves (their
+        # front spans must show retry_s > 0).
+        self.reroutes = 0
+        self.rerouted_responses: list = []
+
+    def _tally_fleet(self, payload: dict) -> None:
+        """Sum the front's per-response reroute stamp at RECEIVE time —
+        retried 429s included — so the client-side sum equals the front's
+        ``reroutes`` counter delta exactly (each failed forward attempt
+        increments the counter once and lands once in some response's
+        ``fleet.reroutes``)."""
+        fl = payload.get("fleet")
+        if not isinstance(fl, dict):
+            return
+        n = int(fl.get("reroutes") or 0)
+        if n > 0:
+            self.reroutes += n
+            if len(self.rerouted_responses) < 64:
+                self.rerouted_responses.append(payload)
 
     def _body(self, i: int, user: int = 0) -> dict:
         # Each user walks the trace at its own offset so one wave spans
@@ -469,6 +494,7 @@ class ClosedLoopClient(threading.Thread):
                 resp = conn.getresponse()
                 payload = json.loads(resp.read())
                 status = resp.status
+                self._tally_fleet(payload)
             except OSError as e:
                 if self.chaos:
                     # A connection torn down before the SEND completed is
@@ -537,6 +563,8 @@ class ClosedLoopClient(threading.Thread):
                     self.errors.append("jsonl connection closed")
                     break
                 payload = json.loads(line)
+                if self.users == 1:
+                    self._tally_fleet(payload)
                 if self.users > 1:
                     lat = time.monotonic() - t0
                     members = payload.get("responses")
@@ -545,6 +573,7 @@ class ClosedLoopClient(threading.Thread):
                     else:
                         for m in members:
                             self.latencies.append(lat)
+                            self._tally_fleet(m)
                             self._classify(m.get("status"), m)
                     i += 1
                     continue
@@ -671,18 +700,23 @@ def check_telemetry_responses(responses: list) -> int:
     return checked
 
 
-def scrape_metrics(server) -> dict:
-    """GET /metrics and parse the Prometheus exposition — a malformed
-    line fails here, loudly (utils/obs.parse_prometheus)."""
-    from cop5615_gossip_protocol_tpu.utils import obs
-
-    conn = http.client.HTTPConnection(server.host, server.port, timeout=30)
+def scrape_metrics_text(host: str, port: int) -> str:
+    """GET /metrics, asserting 200 — raw exposition text."""
+    conn = http.client.HTTPConnection(host, port, timeout=30)
     conn.request("GET", "/metrics")
     resp = conn.getresponse()
     text = resp.read().decode()
     conn.close()
     assert resp.status == 200, resp.status
-    return obs.parse_prometheus(text)
+    return text
+
+
+def scrape_metrics(server) -> dict:
+    """GET /metrics and parse the Prometheus exposition — a malformed
+    line fails here, loudly (utils/obs.parse_prometheus)."""
+    from cop5615_gossip_protocol_tpu.utils import obs
+
+    return obs.parse_prometheus(scrape_metrics_text(server.host, server.port))
 
 
 def check_metrics_identities(parsed: dict) -> dict:
@@ -762,6 +796,76 @@ def check_trace_join(response: dict, events_path: str) -> list:
     done = next(e for e in joined if e["event"] == "request-completed")
     assert done["spans"] == response["serving"]["spans"], (done, response)
     return joined
+
+
+def check_federated_identities(front_parsed: dict, per_worker: dict) -> dict:
+    """The federation identity (ISSUE 18): every summed counter on the
+    front's federated /metrics equals the sum of the same family scraped
+    DIRECTLY from each worker, the bucket-merged service histogram's
+    count equals the fleet-wide completion total, and per-worker gauges
+    re-appear under their ``worker`` label. Exact at quiescence (the
+    counters are frozen, so the two scrape instants can't disagree)."""
+    from cop5615_gossip_protocol_tpu.utils.obs import metric_value as mv
+
+    vals = {}
+    for name in ("received", "admitted", "rejected", "invalid",
+                 "completed", "failed", "batched_requests",
+                 "shed", "timed_out", "timed_out_dispatched"):
+        fam = f"gossip_tpu_serving_{name}_total"
+        fed = mv(front_parsed, fam)
+        per = sum(mv(p, fam) or 0.0 for p in per_worker.values())
+        assert fed == per, (fam, fed, per)
+        vals[name] = fed
+    fed_count = mv(front_parsed, "gossip_tpu_serving_service_seconds_count")
+    per_count = sum(
+        mv(p, "gossip_tpu_serving_service_seconds_count") or 0.0
+        for p in per_worker.values()
+    )
+    assert fed_count == per_count == vals["completed"], (
+        fed_count, per_count, vals
+    )
+    for wid in per_worker:
+        g = mv(front_parsed, "gossip_tpu_serving_in_flight", worker=wid)
+        assert g is not None, (wid, "in_flight gauge missing worker label")
+    return vals
+
+
+def check_fleet_trace_join(response: dict, front_events_path: str,
+                           worker_events_prefix: str) -> dict:
+    """One trace_id joins BOTH halves of the front->worker hop from the
+    two event logs alone (ISSUE 18): the owning worker's admission ->
+    batch-retired -> request-completed lifecycle (check_trace_join) plus
+    the front's front-request-completed carrying the front span clocks."""
+    from cop5615_gossip_protocol_tpu.serving.admission import (
+        FRONT_SPAN_NAMES,
+    )
+    from cop5615_gossip_protocol_tpu.utils.events import read_events
+
+    fl = response.get("fleet") or {}
+    tid = fl.get("trace_id")
+    assert tid, response
+    assert response["serving"]["trace_id"] == tid, (
+        "front and worker disagree on the trace id", response
+    )
+    wid = fl["worker"]
+    worker_joined = check_trace_join(
+        response, f"{worker_events_prefix}.{wid}.jsonl"
+    )
+    front_joined = [
+        e for e in read_events(front_events_path)
+        if e.get("trace_id") == tid
+    ]
+    kinds = [e["event"] for e in front_joined]
+    assert kinds.count("front-request-completed") == 1, kinds
+    done = next(
+        e for e in front_joined if e["event"] == "front-request-completed"
+    )
+    assert done["worker"] == wid, (done, wid)
+    assert set(done["spans"]) == set(FRONT_SPAN_NAMES), done
+    return {
+        "worker_events": [e["event"] for e in worker_joined],
+        "front_events": kinds,
+    }
 
 
 def check_stats(stats: dict, min_buckets: int = 2) -> None:
@@ -917,6 +1021,152 @@ def run_metrics_smoke(args) -> int:
             "",
         ]) + "\n")
     print("[loadgen] metrics-smoke passed", flush=True)
+    return 0
+
+
+def run_metrics_smoke_fleet(args) -> int:
+    """The ``--metrics-smoke --fleet N`` CI leg (ISSUE 18): the front's
+    FEDERATED /metrics stays parseable under load; at quiescence every
+    summed counter equals the sum of direct per-worker scrapes and the
+    bucket-merged histogram count equals the fleet-wide completions
+    (check_federated_identities); the front-local gossip_tpu_fleet_*
+    series are live; and a sampled response's trace_id joins across BOTH
+    event logs — the front's and the owning worker's
+    (check_fleet_trace_join)."""
+    import shutil
+    import tempfile
+
+    from cop5615_gossip_protocol_tpu.utils import obs
+
+    workers = args.fleet
+    tmpdir = tempfile.mkdtemp(prefix="fleet_obs_")
+    front_events = os.path.join(tmpdir, "front.jsonl")
+    worker_prefix = os.path.join(tmpdir, "worker")
+    print(f"[loadgen] metrics-smoke --fleet {workers}: front events "
+          f"{front_events}, worker events {worker_prefix}.<wid>.jsonl",
+          flush=True)
+    fleet = FleetProc(
+        workers=workers,
+        extra_args=("--events", front_events,
+                    "--worker-events", worker_prefix),
+        platform=args.platform, window_ms=args.window_ms,
+        max_lanes=args.max_lanes,
+    )
+    record: dict = {}
+    try:
+        clients = min(args.clients, 32)
+        warm_width_ladder(fleet, clients, conns=clients)
+
+        live = {"scrapes": 0, "error": None, "stop": False}
+
+        def scraper():
+            while not live["stop"]:
+                try:
+                    scrape_metrics(fleet)  # federated: front + N workers
+                    live["scrapes"] += 1
+                except Exception as e:  # noqa: BLE001 — reported below
+                    live["error"] = f"{type(e).__name__}: {e}"
+                    return
+                time.sleep(0.25)
+
+        th = threading.Thread(target=scraper)
+        th.start()
+        # conns == clients keeps every response on the single-request
+        # path (the one the front stamps spans on and logs
+        # front-request-completed for — the trace-join sample).
+        phase = drive(fleet, clients=clients, conns=clients,
+                      duration_s=min(args.duration, 8.0))
+        live["stop"] = True
+        th.join(timeout=10)
+        assert live["error"] is None, (
+            f"live federated scrape failed: {live['error']}"
+        )
+        assert live["scrapes"] >= 2, "scraper never ran under traffic"
+        assert phase["requests"] > 0 and not phase["errors"], (
+            phase["errors"], phase["error_samples"]
+        )
+        print(f"[loadgen] {live['scrapes']} live federated /metrics "
+              f"scrapes parsed under {phase['rps']:,.0f} req/s", flush=True)
+
+        # Quiesced: the federation identities against DIRECT per-worker
+        # scrapes (the front must re-expose exactly what the workers hold).
+        front_parsed = obs.parse_prometheus(
+            scrape_metrics_text(fleet.host, fleet.port)
+        )
+        per_worker = {
+            wid: obs.parse_prometheus(scrape_metrics_text(fleet.host, port))
+            for wid, port in fleet.worker_ports.items()
+        }
+        vals = check_federated_identities(front_parsed, per_worker)
+        print(f"[loadgen] federation identities hold over {workers} "
+              f"workers: {vals}", flush=True)
+
+        alive = obs.metric_value(
+            front_parsed, "gossip_tpu_fleet_workers_alive"
+        )
+        assert alive == workers, (alive, workers)
+        arc_total = sum(
+            obs.metric_value(
+                front_parsed, "gossip_tpu_fleet_ring_arc_fraction",
+                worker=wid,
+            ) or 0.0
+            for wid in fleet.worker_ports
+        )
+        assert abs(arc_total - 1.0) < 1e-9, arc_total
+        responded = obs.metric_value(
+            front_parsed, "gossip_tpu_fleet_responded_total"
+        )
+        assert responded is not None and responded > 0, responded
+
+        n_spans = check_span_closure(phase["responses"])
+        sample = phase["responses"][0]
+        join = check_fleet_trace_join(sample, front_events, worker_prefix)
+        tid = sample["fleet"]["trace_id"]
+        print(f"[loadgen] trace {tid} joins front "
+              f"{join['front_events']} + worker {join['worker_events']}",
+              flush=True)
+
+        record = {
+            "workers": workers,
+            "live_scrapes": live["scrapes"],
+            "rps": phase["rps"],
+            "requests": phase["requests"],
+            "identities": vals,
+            "workers_alive": alive,
+            "span_closure_checked": n_spans,
+            "trace_id": tid,
+            "trace_join": join,
+        }
+        final = fleet.shutdown()
+        check_fleet_stats(final)
+    finally:
+        if fleet.proc.poll() is None:
+            fleet.proc.kill()
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+    if args.json:
+        Path(args.json).write_text(json.dumps(record, indent=2))
+    if args.md:
+        Path(args.md).write_text("\n".join([
+            f"## Federated metrics smoke (benchmarks/loadgen.py "
+            f"--metrics-smoke --fleet {workers})",
+            "",
+            f"- {record['live_scrapes']} live federated /metrics scrapes "
+            f"parsed under {record['rps']:,.0f} req/s",
+            f"- summed counters equal direct per-worker sums and the "
+            f"bucket-merged histogram count equals completions: "
+            f"{record['identities']}",
+            f"- front-local series live ({record['workers_alive']:.0f} "
+            "workers alive, ring arc fractions sum to 1)",
+            f"- span breakdown sums to service latency (<=5%) on "
+            f"{record['span_closure_checked']} responses",
+            f"- trace {record['trace_id']} joins front "
+            f"{' -> '.join(record['trace_join']['front_events'])} and "
+            f"worker "
+            f"{' -> '.join(record['trace_join']['worker_events'])}",
+            "",
+        ]) + "\n")
+    print("[loadgen] metrics-smoke --fleet passed", flush=True)
     return 0
 
 
@@ -1465,6 +1715,12 @@ def run_chaos_fleet(args) -> int:
         print(f"[loadgen] chaos-fleet: victim {victim} (pid {victim_pid}) "
               f"owns {probe['algorithm']}/{probe['topology']}", flush=True)
 
+        # Reroute baseline AFTER warm + probe, BEFORE the chaos drive:
+        # from here on only the chaos pool talks to the front, so the
+        # counter delta must equal the client-measured reroute sum
+        # exactly (ISSUE 18 satellite).
+        reroutes_before = fleet.stats()["front"]["reroutes"]
+
         kill_after = 3.0
         sigterm_after = 9.0
         deadline = time.monotonic() + sigterm_after + 3.0
@@ -1512,6 +1768,30 @@ def run_chaos_fleet(args) -> int:
         front = final["front"]
         assert front["worker_failures"] >= 1, front
         assert front["reroutes"] >= 1, front
+        # ISSUE 18 satellite: the front's reroute counter moved by
+        # EXACTLY the reroutes the clients measured on their response
+        # stamps, and every rerouted response clocks its failed attempts
+        # in the front's retry_s span.
+        measured_reroutes = sum(c.reroutes for c in pool)
+        assert front["reroutes"] - reroutes_before == measured_reroutes, (
+            front["reroutes"], reroutes_before, measured_reroutes
+        )
+        assert measured_reroutes >= 1, "kill produced no observed reroutes"
+        rerouted = [r for c in pool for r in c.rerouted_responses]
+        assert rerouted, "no rerouted response payloads retained"
+        for r in rerouted:
+            spans = (r.get("fleet") or {}).get("spans")
+            if spans is None:
+                continue  # group-forwarded member: front spans ride
+                # single-request responses only
+            assert spans["retry_s"] > 0.0, r
+        n_retry = sum(
+            1 for r in rerouted if (r.get("fleet") or {}).get("spans")
+        )
+        assert n_retry >= 1, "no rerouted response carried front spans"
+        print(f"[loadgen] chaos-fleet: reroute identity exact "
+              f"({measured_reroutes} measured == counter delta), "
+              f"retry_s > 0 on {n_retry} rerouted responses", flush=True)
         check_fleet_stats(final)
         live = [wid for wid, s in final["workers"].items()
                 if isinstance(s, dict) and "received" in s]
@@ -1524,6 +1804,8 @@ def run_chaos_fleet(args) -> int:
             "sent": sent, "answered": answered, "terminal": terminal,
             "victim": victim, "front": front,
             "live_workers": live,
+            "measured_reroutes": measured_reroutes,
+            "rerouted_with_retry_s": n_retry,
         }
     finally:
         if fleet.proc.poll() is None:
@@ -1546,6 +1828,10 @@ def run_chaos_fleet(args) -> int:
             f"{record['front']['worker_failures']} worker failures, "
             f"{record['front']['reroutes']} reroutes, front "
             "received == responded exactly",
+            f"- reroute identity exact: {record['measured_reroutes']} "
+            "client-measured reroutes == the front counter delta; "
+            f"retry_s > 0 on {record['rerouted_with_retry_s']} rerouted "
+            "responses' front spans",
             f"- {len(record['live_workers'])} surviving workers drained "
             "with exact /stats identities",
             "",
@@ -1632,6 +1918,8 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     if args.metrics_smoke:
+        if args.fleet:
+            return run_metrics_smoke_fleet(args)
         return run_metrics_smoke(args)
     if args.chaos:
         return run_chaos_serve(args)

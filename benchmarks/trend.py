@@ -59,6 +59,10 @@ PLAN_HEADER = (
     "## Plan selection — measured-cost autotuner "
     "(benchmarks/trend.py --autotune)"
 )
+STEP_TIMING_HEADER = (
+    "## Measured vs predicted — per-super-step timing "
+    "(benchmarks/trend.py --step-timing)"
+)
 
 
 def load_snapshots(root: Path) -> dict:
@@ -487,6 +491,38 @@ def render_autotune() -> str:
     return "\n".join(lines)
 
 
+def render_step_timing() -> str:
+    """The ISSUE 18 feedback loop: run analysis/cost.STEP_TIMING_CELLS
+    with cfg.step_timing=True (clock-only retire timestamps from the
+    chunk driver) and join each cell's measured median us/round against
+    the autotuner's scored floor from the committed calibration. Unlike
+    --autotune this section IS a fresh measurement — the ratio column
+    moves with the host — so it reads as a calibration health check, not
+    a deterministic record; regenerate alongside `suite --autotune`."""
+    sys.path.insert(0, str(REPO))
+    from cop5615_gossip_protocol_tpu.analysis import cost
+
+    cal = cost.load_calibration()
+    lines = [
+        STEP_TIMING_HEADER,
+        "",
+        "Measured per-dispatch super-step wall (cfg.step_timing=True — "
+        "perf_counter retire stamps in models/pipeline.run_chunks, zero "
+        "extra syncs) vs the autotuner's scored floor for the same cell "
+        "(analysis/cost.measured_vs_predicted, committed "
+        "`analysis/calibration.json` "
+        f"schema v{cal.get('schema')}). A ratio far from 1 localizes a "
+        "stale floor or a wrong linear form; the ROADMAP item-5 hardware "
+        "campaign re-measures this table on chip.",
+        "",
+    ]
+    lines += cost.measured_vs_predicted(
+        cal, say=lambda s: print(f"[step-timing] {s}", file=sys.stderr)
+    )
+    lines.append("")
+    return "\n".join(lines)
+
+
 def apply_to_bench_tables(table_md: str, bench_tables: Path,
                           header: str = SECTION_HEADER) -> None:
     """Idempotently install/replace one generated section: everything
@@ -554,6 +590,14 @@ def main(argv=None) -> int:
                     "(deterministic — no fresh measurement); with "
                     "--apply the section installs into BENCH_TABLES.md "
                     "idempotently")
+    ap.add_argument("--step-timing", action="store_true",
+                    help="run and append the measured-vs-predicted "
+                    "step-timing table (ISSUE 18): per-super-step wall "
+                    "from cfg.step_timing=True runs of the comparison "
+                    "cells joined against the autotuner's scored floors "
+                    "(a fresh measurement, not a deterministic record); "
+                    "with --apply the section installs into "
+                    "BENCH_TABLES.md idempotently")
     args = ap.parse_args(argv)
 
     revs = load_snapshots(args.root)
@@ -598,6 +642,7 @@ def main(argv=None) -> int:
     ceilings_md = render_ceilings() if args.ceilings else None
     byzantine_md = render_byzantine() if args.byzantine else None
     autotune_md = render_autotune() if args.autotune else None
+    step_timing_md = render_step_timing() if args.step_timing else None
     out = table
     if ceilings_md is not None:
         out = out + "\n" + ceilings_md
@@ -607,6 +652,8 @@ def main(argv=None) -> int:
         out = out + "\n" + byzantine_md
     if autotune_md is not None:
         out = out + "\n" + autotune_md
+    if step_timing_md is not None:
+        out = out + "\n" + step_timing_md
     print(out)
     if args.md:
         args.md.write_text(out + "\n")
@@ -631,6 +678,11 @@ def main(argv=None) -> int:
             apply_to_bench_tables(
                 autotune_md, args.root / "BENCH_TABLES.md",
                 header=PLAN_HEADER,
+            )
+        if step_timing_md is not None:
+            apply_to_bench_tables(
+                step_timing_md, args.root / "BENCH_TABLES.md",
+                header=STEP_TIMING_HEADER,
             )
         print(f"[trend] applied to {args.root / 'BENCH_TABLES.md'}",
               file=sys.stderr)

@@ -266,7 +266,19 @@ def build_parser() -> argparse.ArgumentParser:
                    "wall budget (build/compile/dispatch/fetch/hook/"
                    "residual), per-chunk dispatch/fetch histograms, and "
                    "the warm-engine pool counters — the same vocabulary "
-                   "the serving plane serves at GET /metrics")
+                   "the serving plane serves at GET /metrics; under "
+                   "multi-process runs every process writes FILE.proc<k> "
+                   "and process 0 federates them (counters summed, gauges "
+                   "per-process, histograms bucket-merged) into FILE")
+    p.add_argument("--step-timing", action="store_true",
+                   help="clock super-step boundaries on the host "
+                   "(cfg.step_timing): per-dispatch wall histogram, "
+                   "straggler skew, and the measured side of the "
+                   "autotuner's measured-vs-predicted table "
+                   "(benchmarks/trend.py --step-timing); clock-only and "
+                   "OFF by default — refused loudly where it would force "
+                   "a host sync inside the overlapped super-step schedule "
+                   "(use --overlap-collectives off there)")
     p.add_argument("--telemetry", action="store_true",
                    help="enable the in-program telemetry plane "
                    "(ops/telemetry.py): per-ROUND counters accumulated on "
@@ -370,6 +382,7 @@ def _main_refsim(args, parser) -> int:
         "--telemetry": changed("telemetry"),
         "--events": changed("events"),
         "--metrics-dump": changed("metrics_dump"),
+        "--step-timing": changed("step_timing"),
     }
     bad = [flag for flag, set_ in inapplicable.items() if set_]
     if bad:
@@ -549,6 +562,7 @@ def main(argv: Optional[list[str]] = None) -> int:
             replicas=args.replicas,
             # --trace-convergence is the telemetry plane's serializer.
             telemetry=args.telemetry or bool(args.trace_convergence),
+            step_timing=args.step_timing,
         )
     except ValueError as e:
         print(f"Invalid: {e}", file=sys.stderr)
@@ -589,6 +603,9 @@ def main(argv: Optional[list[str]] = None) -> int:
             # fields (run_record schema v4); the sweep record has no
             # chunk_log/budget split to stamp.
             ("--metrics-dump", args.metrics_dump),
+            # Super-step timing reads the per-run chunk_log; the sweep
+            # record has none.
+            ("--step-timing", args.step_timing),
             # A deadline is a per-run SLO; the sweep's serial chunk loop
             # supports it via the API (run_batched_keys deadline=), but
             # the CLI sweep record has no per-replica outcome channel for
@@ -848,15 +865,27 @@ def main(argv: Optional[list[str]] = None) -> int:
         time.monotonic() + args.deadline_ms / 1e3
         if args.deadline_ms is not None else None
     )
+    # The metrics dump wants the autotuner's plan-chosen verdict even when
+    # no --events log is configured, so the event stream is teed: every
+    # (name, fields) pair is kept for observe_run_record, and forwarded to
+    # the durable log when one exists.
+    captured_events: list = []
+    on_run_event = None
+    if events is not None or args.metrics_dump:
+        def on_run_event(name, **fields):
+            if args.metrics_dump:
+                captured_events.append((name, dict(fields)))
+            if events is not None:
+                # engine-degraded events land in the log AT degradation
+                # time — a later crash still leaves the rung walk durable.
+                events.emit(name, **fields)
     try:
         with trace_ctx:
             result = run(
                 topo, cfg, on_chunk=on_chunk,
                 start_state=start_state, start_round=start_round,
                 on_telemetry=tele_writer,
-                # engine-degraded events land in the log AT degradation
-                # time — a later crash still leaves the rung walk durable.
-                on_event=events.emit if events is not None else None,
+                on_event=on_run_event,
                 deadline=deadline,
             )
     except (ValueError, NotImplementedError) as e:
@@ -889,15 +918,52 @@ def main(argv: Optional[list[str]] = None) -> int:
     if jax.process_index() == 0:
         print(metrics.reference_format(result))
     record = metrics.run_record(cfg, topo, result)
-    if args.metrics_dump and jax.process_index() == 0:
+    if cfg.step_timing:
+        # Per-super-step attribution (ISSUE 18): the chunk driver stamped
+        # retire clocks into the chunk_log; fold them into the report the
+        # measured-vs-predicted table and the metrics dump read, and ride
+        # it on the run record so --jsonl trend lines carry it too.
+        from .models import pipeline as pipeline_mod
+
+        st_report = pipeline_mod.step_timing_report(result.chunk_log)
+        if st_report is not None:
+            record["step_timing"] = st_report
+    if args.metrics_dump:
         # One scrape surface for one-shot runs (ISSUE 7): stamp the run
         # record + per-chunk splits into the process registry — which
         # already holds the warm-engine pool counters from this run — and
         # render the Prometheus text. Host-side post-processing only.
+        # Schema v6 additions (ISSUE 18): the telemetry trajectory's
+        # byzantine_count series, the autotuner's plan-chosen verdict, and
+        # the per-super-step wall histogram when --step-timing is on.
         from .utils import obs
 
-        obs.observe_run_record(record, chunk_log=result.chunk_log)
-        obs.dump(args.metrics_dump)
+        obs.observe_run_record(
+            record, chunk_log=result.chunk_log,
+            telemetry=result.telemetry, events=captured_events,
+        )
+        if cfg.step_timing and record.get("step_timing") is not None:
+            obs.observe_step_timing(record["step_timing"])
+        if jax.process_count() > 1 and args.metrics_dump != "-":
+            # Federated multi-process dump: every process writes its own
+            # exposition; process 0 barriers, reads the parts back, and
+            # merges them with the same obs.merge_prometheus the fleet
+            # front's GET /metrics federation uses (counters summed,
+            # gauges labelled per process, histograms bucket-merged).
+            from jax.experimental import multihost_utils
+
+            part = f"{args.metrics_dump}.proc{jax.process_index()}"
+            obs.dump(part)
+            multihost_utils.sync_global_devices("metrics-dump-parts")
+            if jax.process_index() == 0:
+                sources = {}
+                for k in range(jax.process_count()):
+                    with open(f"{args.metrics_dump}.proc{k}") as f:
+                        sources[str(k)] = f.read()
+                with open(args.metrics_dump, "w") as f:
+                    f.write(obs.merge_prometheus(sources, label="process"))
+        elif jax.process_index() == 0:
+            obs.dump(args.metrics_dump)
     if not args.quiet:
         print(json.dumps(record))
     if args.jsonl and jax.process_index() == 0:

@@ -306,6 +306,22 @@ class SimConfig:
     # reject it.
     telemetry: bool = False
 
+    # Per-super-step runtime attribution (ISSUE 18): when on, the chunk
+    # driver (models/pipeline.run_chunks) additionally stamps a
+    # perf_counter retire timestamp + retire-to-retire wall on every
+    # chunk_log entry — clock-only host reads at boundaries the driver
+    # already observes, so donation and speculative pipelining are
+    # untouched and the off state traces the bitwise-identical program
+    # (a Python-level flag like telemetry). pipeline.step_timing_report
+    # turns the log into the measured-vs-predicted table the autotuner's
+    # calibration is judged against (analysis/cost.measured_vs_predicted,
+    # trend.py --step-timing). The sharded FUSED compositions refuse it
+    # under cfg.overlap_collectives: their super-step loop defers each
+    # termination psum under the next kernel (parallel/overlap.py), and
+    # per-step timing there would force the deferred verdict to drain —
+    # a host sync inside the overlap window.
+    step_timing: bool = False
+
     # Round engine: "chunked" = jit'd lax.while_loop dispatching one fused
     # XLA round program per round; "fused" = the Pallas multi-round kernel
     # (ops/fused.py — whole chunks of rounds with VMEM-resident state and

@@ -188,6 +188,7 @@ def run_chunks(
     on_aux: Optional[Callable[[int, int, object], None]] = None,
     health0=None,
     should_cancel: Optional[Callable[[int], bool]] = None,
+    step_timing: bool = False,
 ) -> ChunkLoopResult:
     """Drive ``dispatch(state, rnd, done, round_end) -> (state, rnd, done)``
     to termination with up to ``depth`` chunks in flight.
@@ -226,6 +227,15 @@ def run_chunks(
     dispatched at boundary k targets ``min(start + (k+1)*stride,
     max_rounds)`` — the identical schedule the serial loop produces,
     because a non-terminal chunk always runs to its round_end exactly.
+
+    ``step_timing`` (ISSUE 18, cfg.step_timing): when True each chunk_log
+    entry additionally records ``t_retire`` (perf_counter at the retire)
+    and ``wall_s`` (retire-to-retire wall; the first entry measures from
+    loop entry) — the per-dispatch super-step wall the autotuner's
+    measured-vs-predicted table reads (``step_timing_report``). Clock
+    reads at boundaries the loop already observes: no extra syncs, no
+    schedule change, and with the flag off chunk_log is byte-identical
+    to before (the off-path bitwise-neutrality pin).
     """
     depth = max(1, int(depth))
     if should_cancel is not None:
@@ -291,6 +301,7 @@ def run_chunks(
     final = head
     rounds = start_round
     done_b = False
+    t_prev_retire = time.perf_counter()
 
     def result(carry, spec: int, cancelled: bool = False) -> ChunkLoopResult:
         return ChunkLoopResult(
@@ -320,9 +331,13 @@ def run_chunks(
         fetch_s = time.perf_counter() - t0
         fetch_total += fetch_s
         retired_count += 1
-        chunk_log.append(
-            {"rounds": rounds, "dispatch_s": disp_s, "fetch_s": fetch_s}
-        )
+        entry = {"rounds": rounds, "dispatch_s": disp_s, "fetch_s": fetch_s}
+        if step_timing:
+            t_retire = time.perf_counter()
+            entry["t_retire"] = t_retire
+            entry["wall_s"] = t_retire - t_prev_retire
+            t_prev_retire = t_retire
+        chunk_log.append(entry)
         if on_retire is not None:
             with _TraceAnnotation("chunkloop.retire"):
                 t_hook = time.perf_counter()
@@ -353,3 +368,81 @@ def run_chunks(
         final = cur
         fill()
     return result(final, 0)
+
+
+# -------------------------------------------- step-timing post-processing
+
+
+def step_timing_report(chunk_log, start_round: int = 0,
+                       per_process_t=None) -> Optional[dict]:
+    """Turn a ``step_timing=True`` chunk_log into the per-dispatch
+    attribution record (ISSUE 18): the super-step wall list, measured
+    median/max us-per-round, and the straggler section. Pure host
+    arithmetic over an already-collected log — callable on any RunResult
+    whose run threaded the flag. Returns None when the log carries no
+    timing rows (the flag was off, or the loop never retired a chunk).
+
+    ``per_process_t`` (optional) is ``{process_index: [t_retire, ...]}``
+    per-process retire timestamps from a multi-process mesh (each process
+    runs its own driver over the same SPMD program, so boundary k is the
+    same super-step everywhere); it feeds :func:`straggler_report`.
+    Single-process runs report zero skew over one process."""
+    rows = [e for e in (chunk_log or ()) if "wall_s" in e]
+    if not rows:
+        return None
+    walls = [float(e["wall_s"]) for e in rows]
+    prev = start_round
+    per_round_us = []
+    rounds_list = []
+    for e, w in zip(rows, walls):
+        r = int(e["rounds"])
+        delta = r - prev
+        prev = r
+        rounds_list.append(r)
+        if delta > 0:
+            per_round_us.append(w / delta * 1e6)
+    srt = sorted(per_round_us)
+    straggler = (
+        straggler_report(per_process_t) if per_process_t else
+        {"processes": 1, "boundaries": len(rows),
+         "max_skew_s": 0.0, "median_skew_s": 0.0}
+    )
+    return {
+        "dispatches": len(rows),
+        "wall_s": walls,
+        "rounds": rounds_list,
+        "median_us_per_round": srt[len(srt) // 2] if srt else None,
+        "max_us_per_round": srt[-1] if srt else None,
+        "straggler": straggler,
+    }
+
+
+def straggler_report(per_process_t) -> dict:
+    """Per-device skew from per-process retire timestamps: boundary k's
+    skew is ``max_p t[p][k] - min_p t[p][k]`` (the SPMD chunk loop
+    retires the same super-step at boundary k on every process, so the
+    spread IS the straggler gap — the clocks only need to agree to the
+    skews being compared, which process-local perf_counter deltas off a
+    shared dispatch epoch give). Truncates to the shortest process log
+    (a process killed mid-run still yields a report)."""
+    cols = [list(map(float, ts)) for ts in (
+        per_process_t.values() if isinstance(per_process_t, dict)
+        else per_process_t
+    )]
+    cols = [c for c in cols if c]
+    if len(cols) < 2:
+        return {"processes": len(cols),
+                "boundaries": len(cols[0]) if cols else 0,
+                "max_skew_s": 0.0, "median_skew_s": 0.0}
+    n = min(len(c) for c in cols)
+    skews = [
+        max(c[k] for c in cols) - min(c[k] for c in cols)
+        for k in range(n)
+    ]
+    srt = sorted(skews)
+    return {
+        "processes": len(cols),
+        "boundaries": n,
+        "max_skew_s": srt[-1],
+        "median_skew_s": srt[len(srt) // 2],
+    }

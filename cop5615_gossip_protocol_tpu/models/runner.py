@@ -1391,6 +1391,7 @@ def _run_fused(
         on_retire=on_retire, should_stop=should_stop,
         on_aux=collector.on_aux if collector else None,
         should_cancel=_cancel_fn(deadline),
+        step_timing=cfg.step_timing,
     )
     run_s = time.perf_counter() - t1
 
@@ -2228,6 +2229,7 @@ def _run_resolved(
         on_aux=collector.on_aux if collector else None,
         health0=health0,
         should_cancel=_cancel_fn(deadline),
+        step_timing=cfg.step_timing,
     )
     run_s = time.perf_counter() - t1
 

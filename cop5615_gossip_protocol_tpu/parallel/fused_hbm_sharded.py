@@ -284,6 +284,13 @@ def plan_stencil_hbm_sharded(topo: Topology, cfg: SimConfig, n_dev: int):
             "the chunked/sharded XLA engines; this composition does not "
             "carry the counter block"
         )
+    if cfg.step_timing and cfg.overlap_collectives:
+        return (
+            "step_timing under the overlapped super-step schedule would "
+            "force the deferred termination psum to drain at every timed "
+            "boundary (a host sync inside the overlap window); use "
+            "overlap_collectives=False or step_timing=False"
+        )
     if cfg.faulted:
         # No failure-model support in this engine yet — rejecting on
         # the aggregate flag (not just fault_rate) keeps a crash/dup/
@@ -1447,6 +1454,7 @@ def run_stencil_hbm_sharded(
         stride=CR * 8, depth=cfg.pipeline_chunks, donate=donate,
         on_retire=on_retire, should_stop=should_stop,
         should_cancel=_cancel_fn(deadline),
+        step_timing=cfg.step_timing,
     )
     run_s = time.perf_counter() - t1
 

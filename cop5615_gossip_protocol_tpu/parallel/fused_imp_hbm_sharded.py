@@ -200,6 +200,13 @@ def plan_imp_hbm_sharded_shape(kind: str, n: int, cfg: SimConfig,
             "the chunked/sharded XLA engines; this composition does not "
             "carry the counter block"
         )
+    if cfg.step_timing and cfg.overlap_collectives:
+        return (
+            "step_timing under the overlapped super-step schedule would "
+            "force the deferred termination psum to drain at every timed "
+            "boundary (a host sync inside the overlap window); use "
+            "overlap_collectives=False or step_timing=False"
+        )
     if cfg.mass_tolerance is not None:
         return (
             "the health sentinel (--mass-tolerance) runs in the chunked "
@@ -1273,6 +1280,7 @@ def run_imp_hbm_sharded(
         stride=8, depth=cfg.pipeline_chunks, donate=donate,
         on_retire=on_retire, should_stop=should_stop,
         should_cancel=_cancel_fn(deadline),
+        step_timing=cfg.step_timing,
     )
     run_s = time.perf_counter() - t1
 

@@ -371,6 +371,7 @@ def run_fused_pool_sharded(
         stride=cfg.chunk_rounds, depth=cfg.pipeline_chunks, donate=donate,
         on_retire=on_retire, should_stop=should_stop,
         should_cancel=_cancel_fn(deadline),
+        step_timing=cfg.step_timing,
     )
     run_s = time.perf_counter() - t1
 

@@ -795,6 +795,7 @@ def run_sharded(
         on_aux=collector.on_aux if collector else None,
         health0=health0,
         should_cancel=_cancel_fn(deadline),
+        step_timing=cfg.step_timing,
     )
     run_s = time.perf_counter() - t1
 

@@ -55,12 +55,34 @@ one scrape.
 
 from __future__ import annotations
 
+import re
 import threading
 from typing import Optional
 
 from ..utils import obs
 
 SPAN_NAMES = ("queue_wait_s", "batch_assemble_s", "engine_s", "demux_s")
+
+# The fleet front's own span set (serving/fleet.py): ``route_s`` (ring
+# lookup + candidate order), ``connect_s`` (wire + worker-side overhead
+# outside the worker's measured service wall — the remainder of the
+# forward, mirroring how demux_s closes the worker partition), ``retry_s``
+# (wall burned on failed attempts, incremented per reroute with the
+# quarantine verdict attached to the event), ``reassemble_s`` (response
+# parse + fleet stamp). Front spans + worker SPAN_NAMES partition the
+# end-to-end wall of a fleet-routed request.
+FRONT_SPAN_NAMES = ("route_s", "connect_s", "retry_s", "reassemble_s")
+
+# trace_id wire format: what the worker accepts from a forwarding front
+# (or any upstream) in the request envelope. Hex-ish tokens only — a
+# trace_id lands verbatim in JSONL event logs and Prometheus label values,
+# so the admission edge refuses anything that could smuggle structure.
+_TRACE_ID_RE = re.compile(r"^[A-Za-z0-9_.:-]{1,64}$")
+
+
+def valid_trace_id(value) -> bool:
+    """True iff ``value`` is a well-formed envelope trace_id."""
+    return isinstance(value, str) and bool(_TRACE_ID_RE.match(value))
 
 # Priority classes, highest first — the executor serves them in this
 # order and the overload controller sheds from the BACK of the tuple

@@ -448,7 +448,8 @@ class MicroBatcher:
 
     def submit(self, cfg: SimConfig, want_telemetry: bool,
                priority: str = "batch",
-               deadline_ms: Optional[float] = None) -> ServeRequest:
+               deadline_ms: Optional[float] = None,
+               trace_id: Optional[str] = None) -> ServeRequest:
         """Admit one request into its priority class's bounded queue, or
         raise AdmissionError (the bounded-queue front, with the
         ``Retry-After`` hint). Topology build/lookup is cached
@@ -465,7 +466,11 @@ class MicroBatcher:
         # hot path.
         # Trace identity is minted BEFORE the capacity verdict: a rejected
         # request's admission-rejected event still carries a joinable id.
-        trace_id = uuid.uuid4().hex[:16]
+        # A forwarding front (serving/fleet.py) passes its own minted id
+        # so the worker's spans join the front's trace; the server edge
+        # has already validated the wire format (admission.valid_trace_id).
+        if trace_id is None:
+            trace_id = uuid.uuid4().hex[:16]
         topo_seed = (
             cfg.seed if cfg.topology in keys_mod.SEED_BUILT_KINDS else 0
         )

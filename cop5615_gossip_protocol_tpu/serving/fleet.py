@@ -40,6 +40,33 @@ fleet overhead in BENCH_TABLES; splicing raw response bytes through
 (metadata in front counters only) is the known next shave if the front
 ever becomes the bottleneck on a multi-core box.
 
+**Observability (ISSUE 18).** The front is a full citizen of the
+distributed observability plane:
+
+- **Trace propagation.** The front mints a ``trace_id`` (or honors a
+  valid client-supplied one) and injects it into the forwarded request
+  envelope; the worker's admission validates and keeps it
+  (serving/admission.valid_trace_id), so the worker's four spans join
+  the SAME trace. The front clocks its own span set —
+  ``route_s`` / ``connect_s`` / ``retry_s`` / ``reassemble_s``
+  (admission.FRONT_SPAN_NAMES) — and front spans + worker spans
+  partition the end-to-end wall exactly the way the worker's spans
+  partition its service wall. With ``--events`` the front writes its own
+  JSONL lifecycle log (front-request-rerouted / front-request-completed,
+  schema v6): one join across the front log and a worker's log
+  reconstructs a rerouted request's full lifecycle, killed attempt
+  included.
+- **Metrics federation.** ``GET /metrics`` on the front scrapes every
+  live worker's registry and re-exposes the union via
+  ``utils/obs.merge_prometheus``: counters sum across workers, gauges
+  re-expose per worker under a ``worker`` label, histograms bucket-merge
+  exactly (shared log-bucket geometry). Front-local series ride next to
+  the merge: ``gossip_tpu_fleet_*`` counters (received/responded/
+  forwards/reroutes/worker_failures/unrouteable/invalid), the front span
+  histograms, per-worker quarantine-state and ring-ownership gauges.
+  ``/metrics`` keeps answering 200 while draining — scraping a
+  lame-ducked front must never 503.
+
 Entry point::
 
     python -m cop5615_gossip_protocol_tpu.serving.fleet --workers 2
@@ -68,12 +95,16 @@ import subprocess
 import sys
 import threading
 import time
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
 from typing import Optional
 
+from ..utils import obs
+from ..utils.events import RunEventLog
 from . import keys as keys_mod
 from . import pool as pool_mod
+from .admission import FRONT_SPAN_NAMES, valid_trace_id
 from .server import RESPONSE_SCHEMA_VERSION, config_from_request
 
 REPO = Path(__file__).resolve().parents[2]
@@ -137,6 +168,23 @@ class HashRing:
                 if w not in seen:
                     seen.append(w)
             return seen
+
+    def arc_fractions(self) -> dict:
+        """Fraction of the hash space each worker owns — a key routes to
+        the first vnode at or after its hash, so vnode ``h`` owns the arc
+        (previous vnode, h]. The front's ring-ownership gauge."""
+        with self._lock:
+            if not self._points:
+                return {}
+            span = float(2 ** 64)
+            out = {w: 0.0 for w in self._workers}
+            for i, (h, w) in enumerate(self._points):
+                prev = (
+                    self._points[i - 1][0] if i
+                    else self._points[-1][0] - 2 ** 64
+                )
+                out[w] += (h - prev) / span
+            return out
 
 
 class WorkerProc:
@@ -263,6 +311,23 @@ class WorkerProc:
         conn.close()
         return out
 
+    def metrics(self) -> str:
+        """The worker's raw Prometheus exposition (the federation
+        scrape). Raises OSError on transport failure or a non-200 — the
+        front skips dead workers, never merges garbage."""
+        import http.client
+
+        conn = http.client.HTTPConnection(self.host, self.port, timeout=30)
+        conn.request("GET", "/metrics")
+        r = conn.getresponse()
+        text = r.read().decode()
+        conn.close()
+        if r.status != 200:
+            raise OSError(
+                f"worker {self.worker_id} /metrics -> {r.status}"
+            )
+        return text
+
     def shutdown(self, sig=signal.SIGTERM, timeout_s: float = 120.0) -> int:
         self.drop_conns()
         if self.proc.poll() is None:
@@ -297,7 +362,8 @@ class FleetFront:
     ``handle_line``/``handle_body``."""
 
     def __init__(self, workers: list, max_n: Optional[int] = None,
-                 quarantine_s: float = 5.0):
+                 quarantine_s: float = 5.0,
+                 events_path: Optional[str] = None):
         self.workers = {w.worker_id: w for w in workers}
         self.ring = HashRing()
         for w in workers:
@@ -306,9 +372,24 @@ class FleetFront:
             max_n if max_n is not None
             else os.environ.get("GOSSIP_TPU_SERVE_MAX_N", "") or 65536
         )
+        # The front's OWN registry (not the process default): in-process
+        # tests run a front next to worker ServingApps and the fleet
+        # series must never double-count into a worker's registry.
+        self.registry = obs.Registry()
+        # Front lifecycle event log (schema v6): the cross-process half
+        # of the trace join. None = no log (emit() guards).
+        self.events = (
+            RunEventLog(events_path) if events_path is not None else None
+        )
         # Worker membership circuit (the PR 8 machinery re-used at fleet
         # grain): open = routed around, half-open = one probe request.
-        self.quarantine = pool_mod.Quarantine(cooldown_s=quarantine_s)
+        self.quarantine = pool_mod.Quarantine(
+            cooldown_s=quarantine_s, registry=self.registry,
+            # Fleet-prefixed so the breaker series stay disjoint from the
+            # workers' own gossip_tpu_serving_* quarantine counters in the
+            # federated /metrics union (metrics_text).
+            prefix="gossip_tpu_fleet",
+        )
         self.draining = False
         self._lock = threading.Lock()
         self.counters = {
@@ -316,12 +397,70 @@ class FleetFront:
             "forwards": 0, "reroutes": 0, "worker_failures": 0,
             "unrouteable": 0,
         }
+        # Registry mirrors of the front counters (the dict stays the
+        # /stats + drain-line surface; the registry is the scrape
+        # surface) plus the front span histograms.
+        self._metric_counters = {
+            key: self.registry.counter(
+                f"gossip_tpu_fleet_{key}_total",
+                f"fleet front {key.replace('_', ' ')}",
+            )
+            for key in self.counters
+        }
+        self._span_hists = {
+            name: self.registry.histogram(
+                f"gossip_tpu_fleet_{name.replace('_s', '_seconds')}",
+                f"front {name} span (request routing wall split)",
+            )
+            for name in FRONT_SPAN_NAMES
+        }
+        self._e2e_hist = self.registry.histogram(
+            "gossip_tpu_fleet_request_seconds",
+            "end-to-end front wall per routed request",
+        )
+        # Pre-scrape collect: per-worker quarantine state (0 closed /
+        # 1 half-open / 2 open — the non-consuming state() read), ring
+        # arc ownership, and live-worker count. Runs OUTSIDE the registry
+        # lock per the obs ABBA rule.
+        g_quar = self.registry.gauge(
+            "gossip_tpu_fleet_worker_quarantine_state",
+            "0=closed 1=half-open 2=open (quarantine-as-membership)",
+            labels=("worker",),
+        )
+        g_arc = self.registry.gauge(
+            "gossip_tpu_fleet_ring_arc_fraction",
+            "fraction of the consistent-hash space owned by each worker",
+            labels=("worker",),
+        )
+        g_alive = self.registry.gauge(
+            "gossip_tpu_fleet_workers_alive", "worker processes alive"
+        )
+
+        def _collect() -> None:
+            state_code = {"closed": 0, "half-open": 1, "open": 2}
+            for wid in self.workers:
+                g_quar.set(
+                    state_code.get(self.quarantine.state(wid), 2),
+                    worker=wid,
+                )
+            for wid, frac in self.ring.arc_fractions().items():
+                g_arc.set(frac, worker=wid)
+            g_alive.set(
+                sum(1 for w in self.workers.values() if w.alive())
+            )
+
+        self.registry.add_collect(_collect)
         self._in_flight = 0
         self._idle = threading.Condition(self._lock)
 
     def _count(self, key: str, n: int = 1) -> None:
         with self._lock:
             self.counters[key] += n
+        self._metric_counters[key].inc(n)
+
+    def _emit(self, event: str, **fields) -> None:
+        if self.events is not None:
+            self.events.emit(event, **fields)
 
     # -- routing -----------------------------------------------------------
 
@@ -393,7 +532,21 @@ class FleetFront:
         self._count("responded")
         return out
 
+    def ensure_trace_id(self, body: dict) -> str:
+        """Mint (or honor) the request's trace identity IN PLACE: a valid
+        client-supplied ``trace_id`` rides through untouched (the client
+        owns the trace); anything else is replaced with a fresh front-
+        minted id — the workers' admission validates the same grammar, so
+        a forwarded id is never rejected downstream and the trace never
+        silently splits at the hop."""
+        tid = body.get("trace_id")
+        if not (isinstance(tid, str) and valid_trace_id(tid)):
+            tid = uuid.uuid4().hex[:16]
+            body["trace_id"] = tid
+        return tid
+
     def _route_one(self, body: dict) -> dict:
+        t_start = time.perf_counter()
         if self.draining:
             return {
                 "ok": False, "status": 503, "error": "shutting_down",
@@ -409,20 +562,62 @@ class FleetFront:
                 "detail": str(e),
                 "schema_version": RESPONSE_SCHEMA_VERSION,
             }
+        trace_id = self.ensure_trace_id(body)
+        route_s = time.perf_counter() - t_start
         raw = json.dumps(body).encode()
         attempts = 0
+        retry_s = 0.0
         for wid, probe in self._pick_workers(rkey):
+            t_attempt = time.perf_counter()
             try:
                 self._count("forwards")
                 out = self._forward(wid, probe, raw)
-            except OSError:
+            except OSError as e:
                 self._fail_worker(wid, probe)
                 attempts += 1
+                # retry_s accumulates the wall of every FAILED attempt —
+                # the span a rerouted response carries as proof the kill
+                # was observed (loadgen's chaos-fleet identity).
+                elapsed = time.perf_counter() - t_attempt
+                retry_s += elapsed
                 self._count("reroutes")
+                self._emit(
+                    "front-request-rerouted", trace_id=trace_id,
+                    worker=wid, attempt=attempts,
+                    quarantine=self.quarantine.state(wid),
+                    elapsed_s=elapsed, error=str(e),
+                )
                 continue
+            forward_s = time.perf_counter() - t_attempt
+            t_reassemble = time.perf_counter()
             resp = json.loads(out)
             resp.setdefault("status", 200)
-            resp["fleet"] = {"worker": wid, "reroutes": attempts}
+            # connect_s = the forward wall NOT accounted by the worker's
+            # own service_s: transport + the worker's front threads. With
+            # the worker's spans partitioning service_s, front spans +
+            # worker spans partition the end-to-end wall.
+            service_s = (
+                (resp.get("serving") or {}).get("service_ms", 0.0) / 1e3
+            )
+            connect_s = max(0.0, forward_s - service_s)
+            spans = {
+                "route_s": route_s, "connect_s": connect_s,
+                "retry_s": retry_s,
+                "reassemble_s": time.perf_counter() - t_reassemble,
+            }
+            resp["fleet"] = {
+                "worker": wid, "reroutes": attempts,
+                "trace_id": trace_id, "spans": spans,
+            }
+            for name, val in spans.items():
+                self._span_hists[name].observe(val)
+            wall_s = time.perf_counter() - t_start
+            self._e2e_hist.observe(wall_s)
+            self._emit(
+                "front-request-completed", trace_id=trace_id,
+                worker=wid, reroutes=attempts, spans=spans,
+                service_s=service_s, wall_s=wall_s,
+            )
             return resp
         self._count("unrouteable")
         return {
@@ -430,6 +625,14 @@ class FleetFront:
             "detail": "no live worker could serve this bucket "
             f"(after {attempts} candidates)",
             "schema_version": RESPONSE_SCHEMA_VERSION,
+            "fleet": {
+                "worker": None, "reroutes": attempts,
+                "trace_id": trace_id,
+                "spans": {
+                    "route_s": route_s, "connect_s": 0.0,
+                    "retry_s": retry_s, "reassemble_s": 0.0,
+                },
+            },
         }
 
     def handle_envelope(self, body: dict) -> dict:
@@ -466,6 +669,10 @@ class FleetFront:
                     "schema_version": RESPONSE_SCHEMA_VERSION,
                 }
                 continue
+            # Trace identity is minted per MEMBER before grouping, so a
+            # member rerouted through _route_one keeps the same id the
+            # group forward carried.
+            self.ensure_trace_id(m)
             order.setdefault(rkey, []).append(i)
         # Group routed members by their bucket's CURRENT home worker; the
         # probe verdict is consumed HERE (check() hands "probe" out once
@@ -500,7 +707,10 @@ class FleetFront:
                     raise OSError("malformed envelope from worker")
                 for i, part in zip(idxs, parts):
                     part.setdefault("status", 200)
-                    part["fleet"] = {"worker": wid, "reroutes": 0}
+                    part["fleet"] = {
+                        "worker": wid, "reroutes": 0,
+                        "trace_id": members[i].get("trace_id"),
+                    }
                     slots[i] = part
             except OSError:
                 self._fail_worker(wid, probe)
@@ -577,6 +787,33 @@ class FleetFront:
                     return False
                 self._idle.wait(timeout=remaining)
             return True
+
+    def metrics_text(self) -> str:
+        """The federated exposition (GET /metrics): every live worker's
+        registry scraped and merged by metric type — counters summed,
+        gauges re-exposed under a ``worker`` label, histograms
+        bucket-merged exactly (obs.merge_prometheus) — with the front's
+        own ``gossip_tpu_fleet_*`` series appended (disjoint family
+        names, so concatenation is a valid exposition). A dead or
+        unscrapeable worker is skipped and counted, never merged as
+        garbage. Works while draining: lame-duck must not blind the
+        scraper."""
+        sources = {}
+        skipped = 0
+        for wid, w in self.workers.items():
+            if not w.alive():
+                skipped += 1
+                continue
+            try:
+                sources[wid] = w.metrics()
+            except (OSError, ValueError):
+                skipped += 1
+        self.registry.gauge(
+            "gossip_tpu_fleet_scrape_skipped_workers",
+            "workers unreachable at the last federated scrape",
+        ).set(skipped)
+        merged = obs.merge_prometheus(sources) if sources else ""
+        return merged + self.registry.render()
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -658,6 +895,16 @@ class _FleetHttpHandler(BaseHTTPRequestHandler):
                                  "dead": dead})
         elif self.path == "/stats":
             self._send(200, self.front.snapshot())
+        elif self.path == "/metrics":
+            # Always 200, draining included — same contract as the
+            # workers' /metrics (scraping a lame duck must not 503).
+            data = self.front.metrics_text().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
         else:
             self._send(404, {"ok": False, "error": "not-found",
                              "detail": f"no such endpoint {self.path!r}"})
@@ -718,9 +965,20 @@ def make_front_servers(front: FleetFront, host: str, port: int,
 
 
 def spawn_workers(n: int, serve_args: list,
-                  env_extra: Optional[dict] = None) -> list:
+                  env_extra: Optional[dict] = None,
+                  extra_args_for=None) -> list:
+    """Spawn + await N workers. ``extra_args_for(worker_id)`` (optional)
+    returns per-worker serve.py flags — the fleet's ``--worker-events``
+    gives each worker its OWN event log path this way (two processes
+    appending one JSONL file would interleave)."""
     workers = [
-        WorkerProc(f"w{i}", serve_args, env_extra=env_extra)
+        WorkerProc(
+            f"w{i}",
+            serve_args + (
+                list(extra_args_for(f"w{i}")) if extra_args_for else []
+            ),
+            env_extra=env_extra,
+        )
         for i in range(n)
     ]
     try:
@@ -751,14 +1009,32 @@ def main(argv=None) -> int:
                     help="seconds a failed worker's circuit stays open "
                     "before a half-open probe request re-tries it")
     ap.add_argument("--max-n", type=int, default=None)
+    ap.add_argument("--events", default=None, metavar="FILE",
+                    help="front lifecycle event log (JSONL, schema v6): "
+                    "front-request-rerouted / front-request-completed — "
+                    "the cross-process half of the trace join")
+    ap.add_argument("--worker-events", default=None, metavar="PREFIX",
+                    help="give each worker --events PREFIX.<wid>.jsonl "
+                    "(separate files: N processes appending one JSONL "
+                    "would interleave)")
     ap.add_argument("--verbose", action="store_true")
     # Unrecognized flags pass through to each worker's serve.py.
     args, worker_args = ap.parse_known_args(argv)
     worker_args = [a for a in worker_args if a != "--"]
 
-    workers = spawn_workers(args.workers, worker_args)
+    extra_args_for = None
+    if args.worker_events:
+        prefix = args.worker_events
+
+        def extra_args_for(wid):  # noqa: F811 — the optional hook
+            return ["--events", f"{prefix}.{wid}.jsonl"]
+
+    workers = spawn_workers(
+        args.workers, worker_args, extra_args_for=extra_args_for
+    )
     front = FleetFront(
-        workers, max_n=args.max_n, quarantine_s=args.worker_quarantine
+        workers, max_n=args.max_n, quarantine_s=args.worker_quarantine,
+        events_path=args.events,
     )
     httpd, jsonld = make_front_servers(
         front, args.host, args.port, args.jsonl_port,

@@ -157,20 +157,25 @@ class Quarantine:
     Thread-safe; time injectable for tests via the ``now`` arguments."""
 
     def __init__(self, cooldown_s: float = 30.0,
-                 registry: obs.Registry | None = None):
+                 registry: obs.Registry | None = None,
+                 prefix: str = "gossip_tpu_serving"):
         self.cooldown_s = float(cooldown_s)
         self._lock = threading.Lock()
         # key -> [state, t_open] with state in {"open", "half-open"}.
         self._keys: dict = {}
         reg = registry if registry is not None else obs.default_registry()
+        # ``prefix`` keeps family names disjoint when two breakers meet in
+        # one exposition: the fleet front quarantines WORKERS under
+        # gossip_tpu_fleet_* while each worker's engine breaker keeps the
+        # gossip_tpu_serving_* names the front federates (fleet.py).
         self._c_tripped = reg.counter(
-            "gossip_tpu_serving_quarantined_total",
+            f"{prefix}_quarantined_total",
             "circuit-breaker trips (wedged dispatch -> bucket quarantined)")
         self._c_recovered = reg.counter(
-            "gossip_tpu_serving_quarantine_recovered_total",
+            f"{prefix}_quarantine_recovered_total",
             "half-open probes that closed a quarantined circuit")
         self._g_open = reg.gauge(
-            "gossip_tpu_serving_quarantined_open",
+            f"{prefix}_quarantined_open",
             "circuits currently open or half-open")
 
     def trip(self, key, cooldown_s: float | None = None,

@@ -71,7 +71,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
 
 from ..config import SimConfig, normalize_algorithm, normalize_topology
-from .admission import PRIORITIES, AdmissionError, ServingStats
+from .admission import (
+    PRIORITIES, AdmissionError, ServingStats, valid_trace_id,
+)
 from .batcher import MicroBatcher
 
 REQUEST_SCHEMA_VERSION = 2
@@ -245,10 +247,24 @@ class ServingApp:
                 "ok": False, "error": "invalid-config", "detail": str(e),
                 "schema_version": RESPONSE_SCHEMA_VERSION,
             }
+        # Envelope trace propagation (ISSUE 18): a forwarding front (or any
+        # upstream) may carry its minted trace_id in the body; the worker
+        # honors it so its four spans join the SAME trace. A present but
+        # malformed id is a 400 — trace_ids land verbatim in event logs
+        # and metric labels, so the edge refuses junk loudly rather than
+        # minting a fresh id and silently splitting the trace.
+        trace_id = body.get("trace_id") if isinstance(body, dict) else None
+        if trace_id is not None and not valid_trace_id(trace_id):
+            self.stats.on_invalid()
+            return 400, {
+                "ok": False, "error": "invalid-trace-id",
+                "detail": "trace_id must match [A-Za-z0-9_.:-]{1,64}",
+                "schema_version": RESPONSE_SCHEMA_VERSION,
+            }
         try:
             return 0, self.batcher.submit(
                 cfg, want_telemetry, priority=priority,
-                deadline_ms=deadline_ms,
+                deadline_ms=deadline_ms, trace_id=trace_id,
             )
         except AdmissionError as e:
             self.stats.on_rejected()

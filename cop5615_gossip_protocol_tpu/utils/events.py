@@ -81,10 +81,35 @@ serving/batcher.ServeRequest.emit):
                           identity is minted BEFORE the capacity verdict)
   server-stop             final /stats snapshot
 
+Fleet-front vocabulary (schema v6 — emitted by ``serving/fleet.py`` into
+its own ``--events`` log; the front is a separate process from every
+worker, so a request that crosses the hop leaves events in TWO logs
+joined by one ``trace_id``):
+
+  front-request-rerouted  one forward attempt failed (schema v6):
+                          trace_id, the failed worker, attempt index,
+                          the quarantine verdict recorded for that worker
+                          ("open"/"half-open" after the trip), elapsed_s
+                          spent on the dead attempt — the killed-worker
+                          leg of a rerouted request's lifecycle
+  front-request-completed the front returned a terminal response
+                          (schema v6): trace_id, the serving worker,
+                          reroutes, the front span breakdown (route_s /
+                          connect_s / retry_s / reassemble_s —
+                          admission.FRONT_SPAN_NAMES), the worker's
+                          reported service_s, and the end-to-end wall_s;
+                          front spans + worker spans partition wall_s
+
 The v4 trace join (ISSUE 7): one ``trace_id`` links request-admitted ->
 batch-retired -> request-completed in this log AND the response's own
 event stream/span breakdown, so one JSONL join reconstructs any request's
-lifecycle from admission to response.
+lifecycle from admission to response. The v6 join (ISSUE 18) extends it
+across the fleet hop: the front mints (or honors) the trace_id, forwards
+it in the request envelope, and the worker's admission validates and
+keeps it — so front-request-* events here and the worker's
+request-admitted/request-completed events carry ONE id, and a join over
+both logs reconstructs a rerouted request end to end, killed attempt
+included.
 
 Consumers detect format drift via ``schema_version`` — bump EVENT_SCHEMA_
 VERSION whenever a field changes meaning, never reuse a name. History:
@@ -97,7 +122,9 @@ events, trace_id stamped on every serving event, span timings on
 batch-retired/request-completed; 5 — the serving resilience plane
 (ISSUE 8): server-drain, request-timeout, request-shed, executor-stuck,
 engine-quarantined, quarantine-half-open, quarantine-recovered event
-types; admission-rejected gains retry_after_s + priority.
+types; admission-rejected gains retry_after_s + priority; 6 — the fleet
+front's cross-process trace events (ISSUE 18): front-request-rerouted +
+front-request-completed, trace_id propagated over the front->worker hop.
 """
 
 from __future__ import annotations
@@ -107,7 +134,7 @@ from pathlib import Path
 
 from . import metrics
 
-EVENT_SCHEMA_VERSION = 5
+EVENT_SCHEMA_VERSION = 6
 
 
 class RunEventLog:

@@ -340,6 +340,29 @@ def default_registry() -> Registry:
 
 # ---------------------------------------------------------------- parsing
 
+def _parse_sample(line: str, lineno: int):
+    """One exposition sample line -> ``(name, label-items-tuple, value)``;
+    raises the loud ValueError both parsers share."""
+    try:
+        if "{" in line:
+            name, rest = line.split("{", 1)
+            lbl_text, val_text = rest.rsplit("}", 1)
+            labels = []
+            for part in _split_labels(lbl_text):
+                k, v = part.split("=", 1)
+                labels.append((k, _unescape(v[1:-1])))
+            key = tuple(labels)
+        else:
+            name, val_text = line.rsplit(None, 1)
+            key = ()
+        value = float(val_text)
+    except (ValueError, IndexError) as e:
+        raise ValueError(
+            f"unparseable exposition line {lineno}: {line!r} ({e})"
+        ) from e
+    return name.strip(), key, value
+
+
 def parse_prometheus(text: str) -> Dict[str, Dict[tuple, float]]:
     """Parse exposition text back into ``{name: {label-items-tuple:
     value}}`` — the CI metrics-smoke job and the tests consume /metrics
@@ -351,25 +374,145 @@ def parse_prometheus(text: str) -> Dict[str, Dict[tuple, float]]:
         line = line.strip()
         if not line or line.startswith("#"):
             continue
-        try:
-            if "{" in line:
-                name, rest = line.split("{", 1)
-                lbl_text, val_text = rest.rsplit("}", 1)
-                labels = []
-                for part in _split_labels(lbl_text):
-                    k, v = part.split("=", 1)
-                    labels.append((k, _unescape(v[1:-1])))
-                key = tuple(labels)
-            else:
-                name, val_text = line.rsplit(None, 1)
-                key = ()
-            value = float(val_text)
-        except (ValueError, IndexError) as e:
-            raise ValueError(
-                f"unparseable exposition line {lineno}: {line!r} ({e})"
-            ) from e
-        out.setdefault(name.strip(), {})[key] = value
+        name, key, value = _parse_sample(line, lineno)
+        out.setdefault(name, {})[key] = value
     return out
+
+
+def parse_prometheus_typed(text: str):
+    """Like :func:`parse_prometheus` but RETAINS the ``# TYPE``/``# HELP``
+    headers — returns ``(series, types, helps)`` where ``types`` maps
+    family name -> kind ("counter"/"gauge"/"histogram") and ``helps`` maps
+    family name -> help text. The merger needs the kind to know whether a
+    series sums (counter), re-exposes per source (gauge), or bucket-merges
+    (histogram); the suffix-blind untyped parse cannot tell."""
+    series: Dict[str, Dict[tuple, float]] = {}
+    types: Dict[str, str] = {}
+    helps: Dict[str, str] = {}
+    for lineno, line in enumerate(text.splitlines(), 1):
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(None, 3)
+            if len(parts) < 4:
+                raise ValueError(
+                    f"unparseable TYPE line {lineno}: {line!r}"
+                )
+            types[parts[2]] = parts[3]
+            continue
+        if line.startswith("# HELP "):
+            parts = line.split(None, 3)
+            if len(parts) >= 3:
+                helps[parts[2]] = parts[3] if len(parts) > 3 else ""
+            continue
+        if line.startswith("#"):
+            continue
+        name, key, value = _parse_sample(line, lineno)
+        series.setdefault(name, {})[key] = value
+    return series, types, helps
+
+
+def _sample_line(name: str, key: tuple, val: float) -> str:
+    if key:
+        lbl = ",".join(f'{k}="{_escape(str(v))}"' for k, v in key)
+        return f"{name}{{{lbl}}} {_fmt(val)}"
+    return f"{name} {_fmt(val)}"
+
+
+def merge_prometheus(sources, label: str = "worker") -> str:
+    """Merge N expositions into one, by metric TYPE (the federation core
+    behind the fleet front's ``GET /metrics`` and the multi-process
+    ``--metrics-dump``):
+
+    - **counters** sum per label set across sources (the front-exposed
+      total equals the arithmetic sum of per-source scrapes — the CI
+      federated-identity pin);
+    - **gauges** (and untyped series) re-expose per source with a
+      ``label`` label added (a gauge is an instantaneous per-process
+      value; summing lane widths across workers would be a lie);
+    - **histograms** bucket-merge: cumulative per-``le`` counts, ``_sum``
+      and ``_count`` sum — EXACT because every registry histogram shares
+      the log-bucket geometry (DEFAULT_LO/GROWTH/BUCKETS); sources whose
+      ``le`` sets differ raise loudly instead of merging inexactly.
+
+    ``sources`` is ``{source_id: exposition_text}`` (or an iterable of
+    pairs). Output is deterministic: families sorted by name, HELP/TYPE
+    retained from the first source that declared them."""
+    items = sources.items() if isinstance(sources, dict) else sources
+    parsed: Dict[str, Dict[str, Dict[tuple, float]]] = {}
+    types: Dict[str, str] = {}
+    helps: Dict[str, str] = {}
+    for src, text in items:
+        s, t, h = parse_prometheus_typed(text)
+        parsed[str(src)] = s
+        for fam, kind in t.items():
+            if types.setdefault(fam, kind) != kind:
+                raise ValueError(
+                    f"metric {fam!r} declared as {types[fam]!r} and "
+                    f"{kind!r} across sources — refusing to merge"
+                )
+        for fam, help_ in h.items():
+            helps.setdefault(fam, help_)
+    hist_children: Dict[str, str] = {}
+    for fam, kind in types.items():
+        if kind == "histogram":
+            for suffix in ("_bucket", "_sum", "_count"):
+                hist_children[fam + suffix] = fam
+    fams = set(types)
+    for s in parsed.values():
+        for name in s:
+            fams.add(hist_children.get(name, name))
+    out = []
+    for fam in sorted(fams):
+        kind = types.get(fam, "gauge")  # untyped series: per-source gauge
+        out.append(f"# HELP {fam} {helps.get(fam, '')}")
+        out.append(f"# TYPE {fam} {kind}")
+        if kind == "counter":
+            merged: Dict[tuple, float] = {}
+            for s in parsed.values():
+                for key, val in s.get(fam, {}).items():
+                    merged[key] = merged.get(key, 0.0) + val
+            for key in sorted(merged):
+                out.append(_sample_line(fam, key, merged[key]))
+        elif kind == "histogram":
+            buckets: Dict[str, float] = {}
+            total_sum = 0.0
+            total_count = 0.0
+            le_sets = set()
+            for s in parsed.values():
+                b = s.get(fam + "_bucket", {})
+                if b:
+                    le_sets.add(frozenset(dict(k)["le"] for k in b))
+                for key, val in b.items():
+                    le = dict(key)["le"]
+                    buckets[le] = buckets.get(le, 0.0) + val
+                total_sum += sum(s.get(fam + "_sum", {}).values())
+                total_count += sum(s.get(fam + "_count", {}).values())
+            if len(le_sets) > 1:
+                raise ValueError(
+                    f"histogram {fam!r} bucket geometry differs across "
+                    "sources — bucket-merge would be inexact"
+                )
+
+            def _le_key(le: str) -> float:
+                return math.inf if le == "+Inf" else float(le)
+
+            for le in sorted(buckets, key=_le_key):
+                out.append(
+                    f'{fam}_bucket{{le="{_escape(le)}"}} '
+                    f"{_fmt(buckets[le])}"
+                )
+            out.append(f"{fam}_sum {_fmt(total_sum)}")
+            out.append(f"{fam}_count {_fmt(total_count)}")
+        else:
+            for src in sorted(parsed):
+                fam_series = parsed[src].get(fam, {})
+                for key in sorted(fam_series):
+                    out.append(_sample_line(
+                        fam, ((label, src),) + tuple(key), fam_series[key]
+                    ))
+    return "\n".join(out) + "\n"
 
 
 def _unescape(v: str) -> str:
@@ -427,12 +570,20 @@ def metric_value(parsed: dict, name: str, **labels) -> Optional[float]:
 # ------------------------------------------------- one-shot run reporting
 
 def observe_run_record(record: dict, chunk_log=None,
-                       registry: Optional[Registry] = None) -> Registry:
+                       registry: Optional[Registry] = None,
+                       telemetry=None, events=None) -> Registry:
     """Stamp one structured run record (utils/metrics.run_record, schema
     >= 4) into a registry — the CLI ``--metrics-dump`` path: a one-shot
     run exposes the same vocabulary a served request does, so ROADMAP
     consumers scrape one format regardless of how the run was launched.
-    Purely host-side post-processing of already-fetched numbers."""
+    Purely host-side post-processing of already-fetched numbers.
+
+    ``telemetry`` (a TelemetryTrajectory, duck-typed: ``.columns`` +
+    ``.data``) surfaces the PR 16 fault plane: byzantine node-round
+    aggregates become gauges. ``events`` (an iterable of ``(name,
+    fields)`` pairs captured from the run's ``on_event`` stream) surfaces
+    the PR 17 autotuner verdict: the ``plan-chosen`` event becomes a
+    labeled counter plus the predicted-floor gauge."""
     reg = registry if registry is not None else default_registry()
     runs = reg.counter(
         "gossip_tpu_runs_total", "completed one-shot runs", ("outcome",)
@@ -478,6 +629,71 @@ def observe_run_record(record: dict, chunk_log=None,
     ):
         disp_h.observe(entry.get("dispatch_s", 0.0))
         fetch_h.observe(entry.get("fetch_s", 0.0))
+    # PR 16 series: byzantine node-rounds from the telemetry trajectory
+    # (column sum = adversarial node-rounds; rows with count > 0 = rounds
+    # under attack). Duck-typed so this module stays importable sans jax.
+    if telemetry is not None and getattr(telemetry, "data", None) is not None:
+        columns = tuple(getattr(telemetry, "columns", ()))
+        if "byzantine_count" in columns:
+            col = telemetry.data[:, columns.index("byzantine_count")]
+            reg.gauge(
+                "gossip_tpu_run_byzantine_node_rounds",
+                "sum over rounds of the byzantine node count (last run)",
+            ).set(float(col.sum()))
+            reg.gauge(
+                "gossip_tpu_run_byzantine_rounds",
+                "rounds with at least one byzantine node (last run)",
+            ).set(float((col > 0).sum()))
+    # PR 17 series: the autotuner's structured plan-chosen event.
+    for name, fields in events or ():
+        if name != "plan-chosen":
+            continue
+        reg.counter(
+            "gossip_tpu_plan_chosen_total",
+            "autotuner decisions by winning plan", ("winner",)
+        ).inc(winner=str(fields.get("winner", "unknown")))
+        predicted = fields.get("predicted_us_per_round")
+        if predicted is not None:
+            reg.gauge(
+                "gossip_tpu_plan_predicted_us_per_round",
+                "autotuner-scored floor for the chosen plan (last run)",
+            ).set(float(predicted))
+    return reg
+
+
+def observe_step_timing(report: dict,
+                        registry: Optional[Registry] = None) -> Registry:
+    """Stamp a ``step_timing`` report (models/runner, cfg.step_timing=True)
+    into a registry: the per-super-step wall histogram the autotuner's
+    measured-vs-predicted table reads, plus straggler-skew gauges under
+    multi-process meshes. Post-hoc host arithmetic only."""
+    reg = registry if registry is not None else default_registry()
+    wall_h = reg.histogram(
+        "gossip_tpu_superstep_wall_seconds",
+        "per-dispatch super-step wall (chunk retire to retire)",
+    )
+    for w in report.get("wall_s") or ():
+        wall_h.observe(float(w))
+    for field, help_ in (
+        ("median_us_per_round", "measured median us/round (last run)"),
+        ("max_us_per_round", "measured max us/round (last run)"),
+    ):
+        val = report.get(field)
+        if val is not None:
+            reg.gauge(f"gossip_tpu_superstep_{field}", help_).set(float(val))
+    straggler = report.get("straggler") or {}
+    for field, help_ in (
+        ("max_skew_s", "max per-process super-step skew seconds"),
+        ("median_skew_s", "median per-process super-step skew seconds"),
+    ):
+        val = straggler.get(field)
+        if val is not None:
+            # Suffix-only rewrite: replace() would also hit the "_s" in
+            # "_skew" and mangle the family name.
+            reg.gauge(
+                f"gossip_tpu_superstep_straggler_{field[:-2]}_seconds",
+                help_ + " (last run)",
+            ).set(float(val))
     return reg
 
 

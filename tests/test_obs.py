@@ -434,6 +434,529 @@ def test_trend_table_renders_and_applies_idempotently(tmp_path):
     assert text2 == text1
 
 
+# ----------------------------- federation + distributed tracing (ISSUE 18)
+
+
+def test_label_escape_render_parse_render_byte_stable():
+    # Satellite 3: the exposition escape (_escape) and the parser's
+    # unescape (_unescape) are exact inverses at the BYTE level — render
+    # -> parse -> rebuild -> render reproduces the original text for
+    # label values containing backslashes, newlines, quotes, and the
+    # adversarial backslash-then-n (which must stay two characters, not
+    # collapse to a newline).
+    r1 = obs.Registry()
+    g1 = r1.gauge("weird", "escape torture", labels=("val",))
+    for i, v in enumerate((
+        "a\\b",        # literal backslash
+        "a\nb",        # real newline
+        'q"uote',      # double quote
+        "back\\nslash",  # backslash + n, NOT a newline
+        'mix\\"\n\\\\',  # all three, adjacent
+    )):
+        g1.set(i, val=v)
+    text1 = r1.render()
+    series, types, helps = obs.parse_prometheus_typed(text1)
+    assert types == {"weird": "gauge"}
+    r2 = obs.Registry()
+    g2 = r2.gauge("weird", helps["weird"], labels=("val",))
+    for key, value in series["weird"].items():
+        g2.set(value, **dict(key))
+    assert r2.render() == text1
+
+
+def test_merge_prometheus_by_type_and_determinism():
+    # The federation core: counters sum, gauges re-expose per source
+    # under the added label, histograms bucket-merge exactly.
+    def source(bump):
+        r = obs.Registry()
+        r.counter("t_total", "c").inc(3 + bump)
+        r.gauge("lanes", "g").set(4 + bump)
+        h = r.histogram("lat_seconds", "h")
+        h.observe(0.01)
+        h.observe(0.1 + bump)
+        return r.render()
+
+    a, b = source(0), source(2)
+    merged = obs.merge_prometheus({"w0": a, "w1": b})
+    fed = obs.parse_prometheus(merged)
+    assert obs.metric_value(fed, "t_total") == 3 + 5
+    assert obs.metric_value(fed, "lanes", worker="w0") == 4
+    assert obs.metric_value(fed, "lanes", worker="w1") == 6
+    assert obs.metric_value(fed, "lanes") is None  # never summed
+    assert obs.metric_value(fed, "lat_seconds_count") == 4
+    assert obs.metric_value(fed, "lat_seconds_sum") == pytest.approx(
+        obs.metric_value(obs.parse_prometheus(a), "lat_seconds_sum")
+        + obs.metric_value(obs.parse_prometheus(b), "lat_seconds_sum"))
+    # Bucket-merge is per-le EXACT, not just count-exact.
+    pa, pb = obs.parse_prometheus(a), obs.parse_prometheus(b)
+    for key, val in fed["lat_seconds_bucket"].items():
+        le = dict(key)["le"]
+        assert val == (
+            obs.metric_value(pa, "lat_seconds_bucket", le=le)
+            + obs.metric_value(pb, "lat_seconds_bucket", le=le)), le
+    # Deterministic: same sources -> byte-identical merge, and the dump
+    # federation path can re-merge a merge of one source stably.
+    assert obs.merge_prometheus({"w0": a, "w1": b}) == merged
+    # The same merger federates --metrics-dump parts by process index.
+    by_proc = obs.parse_prometheus(
+        obs.merge_prometheus({"0": a, "1": b}, label="process"))
+    assert obs.metric_value(by_proc, "lanes", process="1") == 6
+
+
+def test_merge_prometheus_rejects_geometry_and_type_conflicts():
+    r1 = obs.Registry()
+    r1.histogram("h_seconds", "h", lo=1e-4, n_buckets=8).observe(0.01)
+    r2 = obs.Registry()
+    r2.histogram("h_seconds", "h", lo=1e-3, n_buckets=10).observe(0.01)
+    with pytest.raises(ValueError, match="bucket geometry differs"):
+        obs.merge_prometheus({"a": r1.render(), "b": r2.render()})
+    r3 = obs.Registry()
+    r3.counter("x_total", "c").inc()
+    r4 = obs.Registry()
+    r4.gauge("x_total", "g").set(1)
+    with pytest.raises(ValueError, match="refusing to merge"):
+        obs.merge_prometheus({"a": r3.render(), "b": r4.render()})
+
+
+def test_observe_run_record_telemetry_and_plan_events():
+    # Satellite 2: --metrics-dump observes the PR 16 byzantine telemetry
+    # aggregates and the PR 17 plan-chosen event.
+    import numpy as np
+
+    class FakeTelemetry:
+        columns = ("rounds", "byzantine_count")
+        data = np.array([[1, 0], [2, 3], [3, 0], [4, 4]])
+
+    reg = obs.Registry()
+    obs.observe_run_record(
+        {"outcome": "converged", "rounds": 4},
+        chunk_log=(), registry=reg, telemetry=FakeTelemetry(),
+        events=[
+            ("run-start", {}),
+            ("plan-chosen", {"winner": "chunked",
+                             "predicted_us_per_round": 9.25}),
+        ],
+    )
+    parsed = obs.parse_prometheus(reg.render())
+    assert obs.metric_value(
+        parsed, "gossip_tpu_run_byzantine_node_rounds") == 7
+    assert obs.metric_value(
+        parsed, "gossip_tpu_run_byzantine_rounds") == 2
+    assert obs.metric_value(
+        parsed, "gossip_tpu_plan_chosen_total", winner="chunked") == 1
+    assert obs.metric_value(
+        parsed, "gossip_tpu_plan_predicted_us_per_round") == 9.25
+
+
+def test_metrics_endpoint_stays_200_while_draining():
+    # Satellite 1: scraping a lame duck must never 503 — /healthz flips,
+    # /metrics keeps answering with the full exposition.
+    import http.client
+
+    app = ServingApp(window_s=0.05, max_lanes=8, min_lanes=1)
+    httpd = make_server(app, "127.0.0.1", 0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        status, _resp = app.handle_run(
+            {"schema_version": 1, "n": 32, "topology": "full",
+             "algorithm": "gossip", "seed": 11})
+        assert status == 200
+        app.begin_drain(0.1)
+        host, port = httpd.server_address[:2]
+        conn = http.client.HTTPConnection(host, port, timeout=30)
+        conn.request("GET", "/healthz")
+        r = conn.getresponse()
+        body = json.loads(r.read())
+        assert r.status == 503 and body["draining"] is True
+        conn.request("GET", "/metrics")
+        r = conn.getresponse()
+        text = r.read().decode()
+        conn.close()
+        assert r.status == 200
+        assert r.getheader("Content-Type", "").startswith("text/plain")
+        parsed = obs.parse_prometheus(text)
+        assert obs.metric_value(
+            parsed, "gossip_tpu_serving_completed_total") == 1
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        app.close()
+
+
+def test_hash_ring_arc_fractions_sum_to_one():
+    from cop5615_gossip_protocol_tpu.serving.fleet import HashRing
+
+    ring = HashRing()
+    for wid in ("w0", "w1", "w2"):
+        ring.add(wid)
+    fracs = ring.arc_fractions()
+    assert set(fracs) == {"w0", "w1", "w2"}
+    assert all(f > 0 for f in fracs.values())
+    assert sum(fracs.values()) == pytest.approx(1.0, abs=1e-9)
+    ring.remove("w1")
+    fracs = ring.arc_fractions()
+    assert set(fracs) == {"w0", "w2"}
+    assert sum(fracs.values()) == pytest.approx(1.0, abs=1e-9)
+
+
+class _FakeWorker:
+    """The FleetFront worker interface over an in-process ServingApp —
+    the tier-1 stand-in for a serve.py OS process (same request_line /
+    metrics / alive contract WorkerProc implements over sockets)."""
+
+    def __init__(self, worker_id, app):
+        self.worker_id = worker_id
+        self.app = app
+        self.killed = False
+
+    def alive(self):
+        return not self.killed
+
+    def request_line(self, raw):
+        if self.killed:
+            raise OSError(f"worker {self.worker_id} is dead")
+        status, resp = self.app.handle_run(json.loads(raw))
+        resp = dict(resp)
+        resp.setdefault("status", status)
+        return json.dumps(resp).encode()
+
+    def drop_conns(self):
+        pass
+
+    def metrics(self):
+        if self.killed:
+            raise OSError(f"worker {self.worker_id} is dead")
+        return self.app.metrics_text()
+
+
+def test_fleet_trace_join_reroute_and_federated_metrics(tmp_path):
+    # The ISSUE 18 acceptance pin, in-process: a 2-worker fleet, the
+    # bucket's home worker killed between requests, the rerouted request
+    # carrying ONE trace id whose lifecycle joins across the front's and
+    # the worker's event logs; front spans + worker service partition the
+    # end-to-end wall within 5% FROM THE EVENT LOGS ALONE; and the
+    # federated /metrics union holds its identities with a dead worker
+    # skipped-and-counted.
+    from cop5615_gossip_protocol_tpu.serving.admission import (
+        FRONT_SPAN_NAMES,
+    )
+    from cop5615_gossip_protocol_tpu.serving.fleet import FleetFront
+
+    front_ev = tmp_path / "front.jsonl"
+    apps = {
+        wid: ServingApp(
+            window_s=0.05, max_lanes=8, min_lanes=1,
+            event_log=RunEventLog(tmp_path / f"worker.{wid}.jsonl"),
+        )
+        for wid in ("w0", "w1")
+    }
+    workers = {wid: _FakeWorker(wid, app) for wid, app in apps.items()}
+    front = FleetFront(list(workers.values()), quarantine_s=60.0,
+                       events_path=str(front_ev))
+    try:
+        body = {"schema_version": 1, "n": 32, "topology": "full",
+                "algorithm": "gossip", "seed": 5}
+        r1 = front.handle_body(dict(body))
+        assert r1.get("status", 200) == 200, r1
+        owner = r1["fleet"]["worker"]
+        survivor = "w1" if owner == "w0" else "w0"
+        assert r1["fleet"]["reroutes"] == 0
+        assert r1["fleet"]["trace_id"] == r1["serving"]["trace_id"]
+
+        # Kill the bucket's home worker; the SAME bucket (full is not
+        # seed-built — a different seed keeps the bucket key) must
+        # re-route to the survivor with the kill observed in retry_s.
+        workers[owner].killed = True
+        r2 = front.handle_body(
+            dict(body, seed=6, trace_id="client-trace-42"))
+        assert r2.get("status", 200) == 200, r2
+        fl = r2["fleet"]
+        assert fl["worker"] == survivor
+        assert fl["reroutes"] == 1
+        assert fl["trace_id"] == "client-trace-42"  # client id honored
+        assert r2["serving"]["trace_id"] == "client-trace-42"
+        assert set(fl["spans"]) == set(FRONT_SPAN_NAMES)
+        assert fl["spans"]["retry_s"] > 0.0
+        assert front.counters["reroutes"] == 1
+        assert front.counters["worker_failures"] == 1
+        assert front.quarantine.state(owner) == "open"
+
+        # -- the cross-process join, from the event logs alone ------------
+        fev = read_events(front_ev)
+        rerouted = [e for e in fev if e["event"] == "front-request-rerouted"]
+        assert len(rerouted) == 1
+        assert rerouted[0]["trace_id"] == "client-trace-42"
+        assert rerouted[0]["worker"] == owner  # names the killed attempt
+        assert rerouted[0]["attempt"] == 1
+        done = [e for e in fev
+                if e["event"] == "front-request-completed"
+                and e["trace_id"] == "client-trace-42"]
+        assert len(done) == 1
+        done = done[0]
+        assert done["worker"] == survivor and done["reroutes"] == 1
+        assert set(done["spans"]) == set(FRONT_SPAN_NAMES)
+        # Front spans + the worker's service wall partition the
+        # end-to-end wall (the 5% acceptance bar).
+        gap = abs(sum(done["spans"].values()) + done["service_s"]
+                  - done["wall_s"])
+        assert gap <= 0.05 * done["wall_s"], done
+        # The worker half: admitted -> batch-retired -> completed under
+        # the SAME id, in the survivor's own log.
+        wev = read_events(tmp_path / f"worker.{survivor}.jsonl")
+        kinds = [e["event"] for e in wev
+                 if e.get("trace_id") == "client-trace-42"
+                 or "client-trace-42" in (e.get("trace_ids") or ())]
+        assert kinds.count("request-admitted") == 1, kinds
+        assert kinds.count("batch-retired") == 1, kinds
+        assert kinds.count("request-completed") == 1, kinds
+        assert kinds.index("request-admitted") < kinds.index(
+            "batch-retired") < kinds.index("request-completed")
+
+        # -- the federated scrape with a dead worker ----------------------
+        fed = obs.parse_prometheus(front.metrics_text())
+
+        def mv(name, **labels):
+            return obs.metric_value(fed, name, **labels)
+
+        # Only the survivor is scrapeable: its serving counters ARE the
+        # federated counters; the dead worker is skipped and counted.
+        assert mv("gossip_tpu_serving_completed_total") == 1
+        assert mv("gossip_tpu_fleet_scrape_skipped_workers") == 1
+        assert mv("gossip_tpu_fleet_workers_alive") == 1
+        # Gauges re-expose per worker under the added label.
+        assert mv("gossip_tpu_serving_in_flight",
+                  worker=survivor) == 0
+        # Quarantine-as-membership state gauge: 2=open for the corpse.
+        assert mv("gossip_tpu_fleet_worker_quarantine_state",
+                  worker=owner) == 2
+        assert mv("gossip_tpu_fleet_worker_quarantine_state",
+                  worker=survivor) == 0
+        # Front identities: exactly one response per request; the dead
+        # attempt shows up as forwards - responded.
+        assert mv("gossip_tpu_fleet_received_total") == 2
+        assert mv("gossip_tpu_fleet_responded_total") == 2
+        assert mv("gossip_tpu_fleet_forwards_total") == 3
+        assert mv("gossip_tpu_fleet_reroutes_total") == 1
+        assert mv("gossip_tpu_fleet_worker_failures_total") == 1
+        # Ring ownership sums to 1 (both workers still own arcs — the
+        # quarantine routes around, membership churn is not removal).
+        arcs = [v for k, v in
+                fed["gossip_tpu_fleet_ring_arc_fraction"].items()]
+        assert sum(arcs) == pytest.approx(1.0, abs=1e-9)
+        # Every successful routed request observed all four front spans.
+        for span in ("route", "connect", "retry", "reassemble"):
+            assert mv(f"gossip_tpu_fleet_{span}_seconds_count") == 2, span
+        assert mv("gossip_tpu_fleet_request_seconds_count") == 2
+        # Satellite 1, fleet half: the federated scrape keeps working
+        # while the front drains (lame-duck must not blind the scraper).
+        front.draining = True
+        fed2 = obs.parse_prometheus(front.metrics_text())
+        assert obs.metric_value(
+            fed2, "gossip_tpu_fleet_received_total") == 2
+    finally:
+        for app in apps.values():
+            app.close()
+
+
+# --------------------------- per-super-step attribution (ISSUE 18 leg c)
+
+
+def test_step_timing_report_and_straggler_units():
+    from cop5615_gossip_protocol_tpu.models import pipeline as pipeline_mod
+
+    log = [
+        {"rounds": 8, "wall_s": 0.08},
+        {"rounds": 16, "wall_s": 0.24},
+        {"other": True},  # a non-timed row (e.g. off-path entry) is skipped
+        {"rounds": 24, "wall_s": 0.08},
+    ]
+    rep = pipeline_mod.step_timing_report(log)
+    assert rep["dispatches"] == 3
+    assert rep["rounds"] == [8, 16, 24]
+    # per-round us: [10000, 30000, 10000] -> median 10000, max 30000.
+    assert rep["median_us_per_round"] == pytest.approx(10000.0)
+    assert rep["max_us_per_round"] == pytest.approx(30000.0)
+    assert rep["straggler"]["processes"] == 1
+    assert rep["straggler"]["max_skew_s"] == 0.0
+    # No timed rows -> None (the off-path contract).
+    assert pipeline_mod.step_timing_report([{"rounds": 8}]) is None
+    assert pipeline_mod.step_timing_report([]) is None
+    # The multi-process skew join: boundary skews [0.1, 0.4, 0.2].
+    st = pipeline_mod.straggler_report(
+        {0: [1.0, 2.0, 3.0], 1: [1.1, 2.4, 3.2]})
+    assert st["processes"] == 2 and st["boundaries"] == 3
+    assert st["max_skew_s"] == pytest.approx(0.4)
+    assert st["median_skew_s"] == pytest.approx(0.2)
+    # Truncates to the shortest log (a killed process still reports).
+    st = pipeline_mod.straggler_report({0: [1.0, 2.0, 3.0], 1: [1.5]})
+    assert st["boundaries"] == 1 and st["max_skew_s"] == pytest.approx(0.5)
+    assert pipeline_mod.straggler_report({0: [1.0, 2.0]})["max_skew_s"] == 0.0
+
+
+def test_step_timing_off_path_is_neutral():
+    # The flag is clock-only: identical protocol outcome, and the OFF
+    # path's chunk_log carries no timing keys at all (bitwise-neutral
+    # program — the flag never reaches the traced computation).
+    from cop5615_gossip_protocol_tpu.models import pipeline as pipeline_mod
+
+    topo = build_topology("full", 64)
+    base = dict(n=64, topology="full", algorithm="gossip", seed=3,
+                chunk_rounds=8)
+    off = run(topo, SimConfig(**base))
+    on = run(topo, SimConfig(**base, step_timing=True))
+    assert off.rounds == on.rounds
+    assert off.converged == on.converged
+    assert off.converged_count == on.converged_count
+    assert all("wall_s" not in e and "t_retire" not in e
+               for e in off.chunk_log)
+    assert len(on.chunk_log) >= 2
+    assert all("wall_s" in e for e in on.chunk_log)
+    assert pipeline_mod.step_timing_report(off.chunk_log) is None
+    rep = pipeline_mod.step_timing_report(on.chunk_log)
+    assert rep["dispatches"] == len(on.chunk_log)
+    assert rep["median_us_per_round"] > 0
+
+
+def test_step_timing_refused_under_overlap_schedule():
+    # The composition contract: under overlap_collectives the deferred
+    # termination psum would have to drain at every timed boundary, so
+    # the sharded fused planner refuses LOUDLY instead of silently
+    # serializing the overlap window.
+    topo = build_topology("torus3d", 125000)
+    cfg = SimConfig(n=125000, topology="torus3d", algorithm="gossip",
+                    engine="fused", n_devices=2, chunk_rounds=8,
+                    max_rounds=3000, overlap_collectives=True,
+                    step_timing=True)
+    with pytest.raises(ValueError, match="step_timing under the overlapped"):
+        run(topo, cfg)
+
+
+def test_observe_step_timing_series():
+    reg = obs.Registry()
+    obs.observe_step_timing(
+        {"dispatches": 3, "wall_s": [0.1, 0.2, 0.3],
+         "rounds": [8, 16, 24],
+         "median_us_per_round": 12500.0, "max_us_per_round": 37500.0,
+         "straggler": {"processes": 2, "boundaries": 3,
+                       "max_skew_s": 0.4, "median_skew_s": 0.2}},
+        registry=reg,
+    )
+    parsed = obs.parse_prometheus(reg.render())
+    assert obs.metric_value(
+        parsed, "gossip_tpu_superstep_wall_seconds_count") == 3
+    assert obs.metric_value(
+        parsed, "gossip_tpu_superstep_wall_seconds_sum") == pytest.approx(0.6)
+    assert obs.metric_value(
+        parsed, "gossip_tpu_superstep_median_us_per_round") == 12500.0
+    assert obs.metric_value(
+        parsed, "gossip_tpu_superstep_max_us_per_round") == 37500.0
+    assert obs.metric_value(
+        parsed, "gossip_tpu_superstep_straggler_max_skew_seconds") == 0.4
+    assert obs.metric_value(
+        parsed, "gossip_tpu_superstep_straggler_median_skew_seconds") == 0.2
+
+
+def test_cli_step_timing_metrics_dump(tmp_path, capsys):
+    from cop5615_gossip_protocol_tpu.cli import main
+
+    out = tmp_path / "st.prom"
+    rc = main(["64", "full", "gossip", "--quiet", "--chunk-rounds", "16",
+               "--step-timing", "--metrics-dump", str(out)])
+    capsys.readouterr()
+    assert rc == 0
+    parsed = obs.parse_prometheus(out.read_text())
+    assert obs.metric_value(
+        parsed, "gossip_tpu_superstep_wall_seconds_count") >= 1
+    assert obs.metric_value(
+        parsed, "gossip_tpu_superstep_median_us_per_round") > 0
+
+
+def test_cli_step_timing_rejected_for_replica_sweeps(capsys):
+    from cop5615_gossip_protocol_tpu.cli import main
+
+    rc = main(["64", "full", "gossip", "--replicas", "2", "--step-timing"])
+    err = capsys.readouterr().err
+    assert rc == 2 and "--step-timing" in err
+
+
+def test_measured_vs_predicted_joins_with_stub_measure():
+    # The join is testable without touching an engine: inject measure().
+    from cop5615_gossip_protocol_tpu.analysis import cost
+
+    cal = cost.load_calibration()
+    cells = (
+        ("full", "gossip", 64, {}),
+        ("full", "gossip", 64, {"n_devices": 16}),  # > host devices
+        ("line", "gossip", 64, {}),
+    )
+    measured_cfgs = []
+
+    def fake_measure(topo, cfg):
+        measured_cfgs.append((cfg.topology, cfg.n))
+        assert cfg.step_timing  # the cell runs with the flag threaded
+        if cfg.topology == "line":
+            return None  # a run that never retired a timed chunk
+        return {"dispatches": 2, "wall_s": [0.1, 0.1], "rounds": [8, 16],
+                "median_us_per_round": 50.0, "max_us_per_round": 80.0,
+                "straggler": {"processes": 1, "boundaries": 2,
+                              "max_skew_s": 0.0, "median_skew_s": 0.0}}
+
+    rows = cost.measured_vs_predicted(cal, cells=cells,
+                                      measure=fake_measure)
+    assert len(rows) == 2 + len(cells)  # header + rule + one row per cell
+    assert "| 50.00 " in rows[2] and "| 80.00 " in rows[2]
+    assert "SKIPPED" in rows[3]  # never silently dropped
+    assert "UNMEASURED" in rows[4]
+    # The skipped cell was never measured.
+    assert measured_cfgs == [("full", 64), ("line", 64)]
+
+
+def test_trend_step_timing_applies_idempotently(tmp_path, monkeypatch,
+                                                capsys):
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+    from benchmarks import trend
+    from cop5615_gossip_protocol_tpu.analysis import cost
+
+    def canned(calibration=None, cells=None, measure=None, say=None):
+        return [
+            "| cell | plan | predicted us/round "
+            "| measured median us/round | measured max "
+            "| ratio meas/pred |",
+            "|---|---|---|---|---|---|",
+            "| full/gossip/n=64 | chunked | 10.00 | 12.00 | 15.00 "
+            "| 1.20 |",
+        ]
+
+    monkeypatch.setattr(cost, "measured_vs_predicted", canned)
+    root = tmp_path
+    (root / "BENCH_r01.json").write_text(json.dumps({
+        "parsed": {"value": 100.0, "wall_s": 1.5, "compile_s": 2.0,
+                   "vs_baseline": 10.0}}))
+    (root / "BENCH_TABLES.md").write_text("# tables\n\n## existing\nrow\n")
+    rc = trend.main(["--root", str(root), "--step-timing", "--apply"])
+    capsys.readouterr()
+    assert rc == 0
+    text1 = (root / "BENCH_TABLES.md").read_text()
+    assert trend.STEP_TIMING_HEADER in text1
+    assert "| full/gossip/n=64 | chunked | 10.00 |" in text1
+    assert "## existing" in text1
+    rc = trend.main(["--root", str(root), "--step-timing", "--apply"])
+    capsys.readouterr()
+    assert rc == 0
+    text2 = (root / "BENCH_TABLES.md").read_text()
+    assert text2.count(trend.STEP_TIMING_HEADER) == 1
+    assert text2 == text1
+    # A bare --apply preserves the previously applied section.
+    rc = trend.main(["--root", str(root), "--apply"])
+    capsys.readouterr()
+    assert rc == 0
+    text3 = (root / "BENCH_TABLES.md").read_text()
+    assert text3.count(trend.STEP_TIMING_HEADER) == 1
+
+
 def test_trend_ceilings_apply_idempotent_and_preserves_serving(tmp_path):
     # ISSUE 15 satellite: the ceilings section has its own header and its
     # own idempotent apply, and a bare --apply (no --serving flags, no

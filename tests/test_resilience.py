@@ -505,8 +505,10 @@ def test_front_timeout_claims_never_counts_completed(monkeypatch):
 # ---------------------------------------------------------- schema pins
 
 
-def test_event_schema_v5():
-    assert EVENT_SCHEMA_VERSION == 5
+def test_event_schema_v6():
+    # v6: the fleet front's lifecycle events (front-request-rerouted /
+    # front-request-completed) joined the vocabulary (ISSUE 18).
+    assert EVENT_SCHEMA_VERSION == 6
 
 
 def test_healthz_lame_duck_and_drain_rejections():
