@@ -63,6 +63,10 @@ STEP_TIMING_HEADER = (
     "## Measured vs predicted — per-super-step timing "
     "(benchmarks/trend.py --step-timing)"
 )
+DURABILITY_HEADER = (
+    "## Checkpoint durability overhead "
+    "(benchmarks/trend.py --durability)"
+)
 
 
 def load_snapshots(root: Path) -> dict:
@@ -523,6 +527,81 @@ def render_step_timing() -> str:
     return "\n".join(lines)
 
 
+def render_durability() -> str:
+    """The ISSUE 19 durability-overhead record: what the durable state
+    plane (utils/checkpoint — per-array digests, sidecar, generation
+    bookkeeping) costs, vs state size and algorithm. Archive bytes and
+    rounds are deterministic records; the wall columns are fresh
+    measurements on this box (a health check like --step-timing, not a
+    byte-stable record). The resume column is the crash-only-restarts
+    payoff: wall of a run resumed from the midpoint checkpoint vs the
+    uninterrupted run (both post-compile)."""
+    sys.path.insert(0, str(REPO))
+    import statistics
+    import tempfile
+    import time as _time
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_threefry_partitionable", True)
+    from cop5615_gossip_protocol_tpu import SimConfig, build_topology, run
+    from cop5615_gossip_protocol_tpu.utils import checkpoint as ckpt
+
+    cells = (
+        ("gossip", 256, 32),
+        ("gossip", 4096, 32),
+        ("push-sum", 256, 32),
+        ("push-sum", 4096, 32),
+    )
+    lines = [
+        DURABILITY_HEADER,
+        "",
+        "Per-checkpoint cost of the durable state plane "
+        "(utils/checkpoint.save: compressed npz + SHA-256 per array + "
+        "digest sidecar; load re-verifies every digest before "
+        "deserializing state). `write overhead` is the summed save wall "
+        "as a fraction of the run's post-compile wall at one checkpoint "
+        "per chunk boundary — the worst-case `--checkpoint-every 1` "
+        "cadence. Chunked engine, full topology, this box's CPU.",
+        "",
+        "| cell | rounds | archive KiB | write ms (med) | "
+        "verify+load ms | write overhead | resume wall / cold wall |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    tmp = Path(tempfile.mkdtemp(prefix="gossip_trend_durability_"))
+    for alg, n, chunk in cells:
+        cfg = SimConfig(n=n, topology="full", algorithm=alg,
+                        chunk_rounds=chunk, max_rounds=4000)
+        topo = build_topology("full", n)
+        snaps = []
+        res = run(topo, cfg, on_chunk=lambda r, s: snaps.append((r, s)))
+        path = tmp / f"{alg.replace('-', '')}-{n}.npz"
+        writes, nbytes = [], 0
+        for r, s in snaps:
+            info = ckpt.save(path, s, r, cfg)
+            writes.append(info["write_s"])
+            nbytes = info["bytes"]
+        t0 = _time.perf_counter()
+        ckpt.load(path)
+        load_s = _time.perf_counter() - t0
+        overhead = sum(writes) / max(res.run_s, 1e-9)
+        mid_r, mid_s = snaps[len(snaps) // 2]
+        ckpt.save(path, mid_s, mid_r, cfg)
+        st, rnds, cfg2 = ckpt.load(path)
+        resumed = run(topo, cfg2, start_state=st, start_round=rnds)
+        ratio = resumed.run_s / max(res.run_s, 1e-9)
+        lines.append(
+            f"| {alg} full n={n} | {res.rounds} | {nbytes / 1024:.1f} | "
+            f"{statistics.median(writes) * 1e3:.2f} | {load_s * 1e3:.2f} "
+            f"| {overhead:.1%} | {ratio:.2f} |"
+        )
+        print(f"[durability] {alg} n={n}: rounds={res.rounds} "
+              f"bytes={nbytes} saves={len(writes)}", file=sys.stderr)
+    lines.append("")
+    return "\n".join(lines)
+
+
 def apply_to_bench_tables(table_md: str, bench_tables: Path,
                           header: str = SECTION_HEADER) -> None:
     """Idempotently install/replace one generated section: everything
@@ -598,6 +677,13 @@ def main(argv=None) -> int:
                     "(a fresh measurement, not a deterministic record); "
                     "with --apply the section installs into "
                     "BENCH_TABLES.md idempotently")
+    ap.add_argument("--durability", action="store_true",
+                    help="run and append the checkpoint-durability "
+                    "overhead table (ISSUE 19): per-checkpoint write / "
+                    "verify+load walls, archive bytes and the resume-vs-"
+                    "cold-start ratio vs state size (a fresh measurement "
+                    "for the wall columns); with --apply the section "
+                    "installs into BENCH_TABLES.md idempotently")
     args = ap.parse_args(argv)
 
     revs = load_snapshots(args.root)
@@ -643,6 +729,7 @@ def main(argv=None) -> int:
     byzantine_md = render_byzantine() if args.byzantine else None
     autotune_md = render_autotune() if args.autotune else None
     step_timing_md = render_step_timing() if args.step_timing else None
+    durability_md = render_durability() if args.durability else None
     out = table
     if ceilings_md is not None:
         out = out + "\n" + ceilings_md
@@ -654,6 +741,8 @@ def main(argv=None) -> int:
         out = out + "\n" + autotune_md
     if step_timing_md is not None:
         out = out + "\n" + step_timing_md
+    if durability_md is not None:
+        out = out + "\n" + durability_md
     print(out)
     if args.md:
         args.md.write_text(out + "\n")
@@ -683,6 +772,11 @@ def main(argv=None) -> int:
             apply_to_bench_tables(
                 step_timing_md, args.root / "BENCH_TABLES.md",
                 header=STEP_TIMING_HEADER,
+            )
+        if durability_md is not None:
+            apply_to_bench_tables(
+                durability_md, args.root / "BENCH_TABLES.md",
+                header=DURABILITY_HEADER,
             )
         print(f"[trend] applied to {args.root / 'BENCH_TABLES.md'}",
               file=sys.stderr)

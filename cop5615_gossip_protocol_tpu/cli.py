@@ -304,6 +304,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="write round-state checkpoints to this .npz path")
     p.add_argument("--checkpoint-every", type=int, default=1,
                    help="checkpoint every K chunks (with --checkpoint)")
+    p.add_argument("--checkpoint-keep", type=int, default=1,
+                   help="retain this many checkpoint generations "
+                   "(utils/checkpoint.py): K >= 2 writes numbered "
+                   "<stem>.gNNNNNN.npz generations with a manifest and "
+                   "keeps the plain path linked to the newest, so a torn "
+                   "or bit-flipped latest write costs one interval, not "
+                   "the run; 1 (default) is the legacy single-file layout")
+    p.add_argument("--strict-checkpoint", action="store_true",
+                   help="fail fast when a checkpoint write fails (OSError "
+                   "at the chunk-boundary hook) instead of the default "
+                   "policy of emitting checkpoint-failed + continuing "
+                   "with that interval's checkpoint lost "
+                   "(models/pipeline.run_chunks hook_error)")
     p.add_argument("--resume", type=str, default=None,
                    help="resume from a checkpoint .npz, or 'auto' to restart "
                    "from the --checkpoint sidecar when it exists (fresh run "
@@ -376,7 +389,9 @@ def _main_refsim(args, parser) -> int:
         "--num-processes/--process-id": changed("num_processes")
         or changed("process_id"),
         "--profile": changed("profile"),
-        "--checkpoint": changed("checkpoint") or changed("checkpoint_every"),
+        "--checkpoint": changed("checkpoint") or changed("checkpoint_every")
+        or changed("checkpoint_keep"),
+        "--strict-checkpoint": changed("strict_checkpoint"),
         "--resume": changed("resume"),
         "--trace-convergence": changed("trace_convergence"),
         "--telemetry": changed("telemetry"),
@@ -552,6 +567,7 @@ def main(argv: Optional[list[str]] = None) -> int:
             stall_chunks=args.stall_chunks,
             mass_tolerance=args.mass_tolerance,
             strict_engine=args.strict_engine,
+            strict_checkpoint=args.strict_checkpoint,
             delivery=args.delivery,
             pool_size=args.pool_size,
             engine=args.engine,
@@ -720,11 +736,17 @@ def main(argv: Optional[list[str]] = None) -> int:
                 state = type(state)(
                     *(np.asarray(x)[: topo.n] for x in state)
                 )
-                ckpt.save(args.checkpoint, state, rounds, cfg)
+                info = ckpt.save(
+                    args.checkpoint, state, rounds, cfg,
+                    keep=args.checkpoint_keep,
+                )
                 if events is not None:
                     events.emit(
                         "checkpoint-written", rounds=rounds,
-                        path=args.checkpoint,
+                        path=info["path"],
+                        generation=info["generation"],
+                        bytes=info["bytes"],
+                        write_s=info["write_s"],
                     )
 
         hooks.append(checkpoint_hook)
@@ -751,20 +773,51 @@ def main(argv: Optional[list[str]] = None) -> int:
                 file=sys.stderr,
             )
             return 2
-        from pathlib import Path
-
-        resume_path = args.checkpoint if Path(args.checkpoint).exists() else None
+        # Generation-aware existence probe: a quarantined or torn newest
+        # file may leave the plain path dangling while an older intact
+        # generation is still resumable.
+        resume_path = (
+            args.checkpoint if ckpt.candidate_paths(args.checkpoint)
+            else None
+        )
     if resume_path:
         import dataclasses
         import zipfile
 
+        def _quarantine_event(**fields):
+            if events is not None:
+                events.emit("checkpoint-corrupt-quarantined", **fields)
+            print(
+                f"checkpoint generation {fields.get('path')} quarantined: "
+                f"{fields.get('reason')}",
+                file=sys.stderr,
+            )
+
         # Beyond ValueError (stream-version mismatch, bad config), a kill
         # can leave a truncated .npz or a missing sidecar: BadZipFile /
         # OSError / KeyError. ckpt.save is atomic-rename so this is rare,
-        # but --resume auto exists precisely for killed runs — it falls
-        # back to a fresh start; an explicit path still fails loudly.
+        # but --resume auto exists precisely for killed runs — it walks
+        # generations newest-first (corrupt ones quarantined with a
+        # structured event, ISSUE 19) and falls back to a fresh start only
+        # when no intact generation remains; an explicit path still fails
+        # loudly.
         try:
-            start_state, start_round, saved_cfg = ckpt.load(resume_path)
+            if args.resume == "auto":
+                hit = ckpt.load_latest_intact(
+                    resume_path, on_event=_quarantine_event
+                )
+                if hit is None:
+                    print(
+                        f"checkpoint {resume_path} has no intact "
+                        "generation; starting fresh",
+                        file=sys.stderr,
+                    )
+                    resume_path = None
+                else:
+                    start_state, start_round, saved_cfg, hit_info = hit
+                    resume_path = hit_info["path"]
+            else:
+                start_state, start_round, saved_cfg = ckpt.load(resume_path)
         except (ValueError, OSError, KeyError, zipfile.BadZipFile) as e:
             if args.resume == "auto":
                 print(
@@ -793,7 +846,8 @@ def main(argv: Optional[list[str]] = None) -> int:
                       "pool2_wire": cfg.pool2_wire,
                       "telemetry": cfg.telemetry,
                       "mass_tolerance": cfg.mass_tolerance,
-                      "strict_engine": cfg.strict_engine}
+                      "strict_engine": cfg.strict_engine,
+                      "strict_checkpoint": cfg.strict_checkpoint}
         if dataclasses.replace(saved_cfg, **loop_knobs) != cfg:
             print(
                 "Invalid: checkpoint config mismatch — resume requires the "
@@ -895,6 +949,11 @@ def main(argv: Optional[list[str]] = None) -> int:
 
     if events is not None:
         events.emit_chunks(result.chunk_log)
+        # Lost-interval checkpoint writes the driver survived under the
+        # ISSUE 19 continue policy, in boundary order (the registry
+        # counter was bumped at failure time in run_chunks).
+        for fail in result.hook_failures or ():
+            events.emit("checkpoint-failed", **fail)
         if result.outcome == "stalled":
             events.emit("watchdog-fired", rounds=result.rounds)
         if result.outcome == "unhealthy":

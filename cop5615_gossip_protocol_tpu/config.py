@@ -294,6 +294,14 @@ class SimConfig:
     # so CI never silently degrades).
     strict_engine: bool = False
 
+    # Fail-fast checkpoint I/O: an OSError inside the chunk-boundary
+    # checkpoint hook (full disk, torn mount) aborts the run instead of
+    # the default lose-one-interval-and-continue policy
+    # (models/pipeline.run_chunks hook_error; ISSUE 19). Python-level
+    # loop knob like strict_engine — never part of the traced program,
+    # exempt from the resume config-mismatch check.
+    strict_checkpoint: bool = False
+
     # In-program telemetry plane (ops/telemetry.py): the chunk program
     # accumulates one per-round counter row (converged/live counts, quorum
     # gap, active count or estimate MAE, mass residual, drop/dup events) on

@@ -111,6 +111,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import sys
 import time
 from typing import Callable, Optional
 
@@ -170,6 +171,10 @@ class ChunkLoopResult:
     # outcome="deadline_exceeded"; the carry is the retired (partial)
     # state and ``rounds`` stays exact.
     cancelled: bool = False
+    # on_retire OSErrors survived under hook_error="continue" (ISSUE 19):
+    # {"rounds", "error"} per failed boundary, in order. The runner lifts
+    # it onto RunResult so the CLI can emit checkpoint-failed events.
+    hook_failures: list = dataclasses.field(default_factory=list)
 
 
 def run_chunks(
@@ -189,6 +194,7 @@ def run_chunks(
     health0=None,
     should_cancel: Optional[Callable[[int], bool]] = None,
     step_timing: bool = False,
+    hook_error: str = "raise",
 ) -> ChunkLoopResult:
     """Drive ``dispatch(state, rnd, done, round_end) -> (state, rnd, done)``
     to termination with up to ``depth`` chunks in flight.
@@ -236,7 +242,23 @@ def run_chunks(
     reads at boundaries the loop already observes: no extra syncs, no
     schedule change, and with the flag off chunk_log is byte-identical
     to before (the off-path bitwise-neutrality pin).
+
+    ``hook_error`` (ISSUE 19) is the checkpoint-hook I/O failure policy:
+    ``on_retire`` is where checkpoint writes happen, and an OSError there
+    (full disk, injected ENOSPC) used to propagate into the engines'
+    degradation ladder — which deliberately does NOT degrade on OSError,
+    so the run died for an observability-plane failure. Under
+    ``"continue"`` (what the engines pass unless cfg.strict_checkpoint)
+    the loop records the failure in ``ChunkLoopResult.hook_failures``,
+    bumps the ``gossip_tpu_checkpoint_failed_total`` registry counter,
+    warns on stderr and keeps simulating — losing a checkpoint interval,
+    never the run. ``"raise"`` (the default, and --strict-checkpoint)
+    restores fail-fast. Only OSError is policy-managed; any other hook
+    exception propagates unchanged.
     """
+    if hook_error not in ("raise", "continue"):
+        raise ValueError(
+            f"hook_error must be 'raise' or 'continue', got {hook_error!r}")
     depth = max(1, int(depth))
     if should_cancel is not None:
         # Speculation would push the cancel horizon out by the pipeline
@@ -263,6 +285,7 @@ def run_chunks(
     hook_total = 0.0
     aux_total = 0.0
     chunk_log: list = []
+    hook_failures: list = []
 
     def fill() -> None:
         """Top the pipeline up. Chunks whose round_end would not advance
@@ -313,6 +336,7 @@ def run_chunks(
             chunk_log=chunk_log,
             health=int(carry[3]) if has_health else None,
             cancelled=cancelled,
+            hook_failures=hook_failures,
         )
 
     while inflight:
@@ -341,8 +365,32 @@ def run_chunks(
         if on_retire is not None:
             with _TraceAnnotation("chunkloop.retire"):
                 t_hook = time.perf_counter()
-                on_retire(rounds, cur[0])
-                hook_total += time.perf_counter() - t_hook
+                try:
+                    on_retire(rounds, cur[0])
+                except OSError as e:
+                    if hook_error != "continue":
+                        raise
+                    hook_failures.append({
+                        "rounds": rounds,
+                        "error": f"{type(e).__name__}: {e}",
+                    })
+                    print(
+                        f"[pipeline] chunk-boundary hook failed at "
+                        f"rounds={rounds}: {e} — continuing (this interval's "
+                        "checkpoint is lost; --strict-checkpoint fails fast)",
+                        file=sys.stderr,
+                    )
+                    try:
+                        from ..utils import obs as obs_mod
+                        obs_mod.default_registry().counter(
+                            "gossip_tpu_checkpoint_failed_total",
+                            "chunk-boundary checkpoint-hook I/O failures "
+                            "survived under hook_error='continue'",
+                        ).inc()
+                    except Exception:  # noqa: BLE001 — metrics must not kill
+                        pass
+                finally:
+                    hook_total += time.perf_counter() - t_hook
         if done_b or rounds >= max_rounds:
             # Overshoot chunks are bitwise no-ops, so the newest carry IS
             # this one — and under donation it is the one with live buffers.

@@ -115,6 +115,11 @@ class RunResult:
     # (the run-event log's chunk-retired events, utils/events.py).
     telemetry: Optional[object] = None
     chunk_log: Optional[list] = None
+    # Checkpoint-hook I/O failures the driver survived under the ISSUE 19
+    # continue policy ({"rounds", "error"} per lost interval) — the CLI
+    # turns them into checkpoint-failed events. None when every hook
+    # succeeded (the overwhelmingly common case).
+    hook_failures: Optional[list] = None
 
     @property
     def wall_ms(self) -> float:
@@ -127,7 +132,7 @@ class RunResult:
         rec = {
             f.name: getattr(self, f.name)
             for f in dataclasses.fields(self)
-            if f.name not in ("telemetry", "chunk_log")
+            if f.name not in ("telemetry", "chunk_log", "hook_failures")
         }
         rec["wall_ms"] = self.wall_ms
         rec["rounds_per_sec"] = self.rounds / self.run_s if self.run_s > 0 else None
@@ -1112,6 +1117,8 @@ def _finalize_result(
         result.hook_s = loop.hook_s
         result.aux_s = loop.aux_s
         result.chunk_log = loop.chunk_log
+        if getattr(loop, "hook_failures", None):
+            result.hook_failures = list(loop.hook_failures)
     if collector is not None:
         result.telemetry = collector.finalize()
     return result
@@ -1392,6 +1399,7 @@ def _run_fused(
         on_aux=collector.on_aux if collector else None,
         should_cancel=_cancel_fn(deadline),
         step_timing=cfg.step_timing,
+        hook_error=("raise" if cfg.strict_checkpoint else "continue"),
     )
     run_s = time.perf_counter() - t1
 
@@ -2230,6 +2238,7 @@ def _run_resolved(
         health0=health0,
         should_cancel=_cancel_fn(deadline),
         step_timing=cfg.step_timing,
+        hook_error=("raise" if cfg.strict_checkpoint else "continue"),
     )
     run_s = time.perf_counter() - t1
 

@@ -372,6 +372,7 @@ def run_fused_pool_sharded(
         on_retire=on_retire, should_stop=should_stop,
         should_cancel=_cancel_fn(deadline),
         step_timing=cfg.step_timing,
+        hook_error=("raise" if cfg.strict_checkpoint else "continue"),
     )
     run_s = time.perf_counter() - t1
 

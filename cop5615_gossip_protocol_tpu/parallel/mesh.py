@@ -7,7 +7,19 @@ Here the backend is a `jax.sharding.Mesh` with a single ``"nodes"`` axis:
 each device owns a contiguous shard of the node dimension, cross-shard
 message traffic is XLA collectives (`psum_scatter`, `all_gather`, `psum`)
 riding ICI within a slice and DCN across slices — no hand-written transport.
-"""
+
+The elastic re-placement contract (ISSUE 19): state leaves this module
+only in GLOBAL row order (checkpoints store host-side global arrays,
+utils/checkpoint) and re-enters exclusively through `put_global` /
+`put_rows` against whatever mesh the RESUMING process built — so a
+checkpoint cut at P devices owes nothing to that mesh and resumes at any
+P' (shrink, grow, down to one device) by re-placement alone. Trajectory
+bitwiseness across the move is pinned in tests/test_recovery.py
+(test_elastic_mesh_resume_bitwise): exact for integer gossip state
+everywhere, and for push-sum float32 state within the sharded family —
+the single-device chunked engine preserves denormals the sharded
+all-reduce flushes to zero, the one documented P'=1 caveat (README
+"Durability")."""
 
 from __future__ import annotations
 

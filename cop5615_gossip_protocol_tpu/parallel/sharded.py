@@ -796,6 +796,7 @@ def run_sharded(
         health0=health0,
         should_cancel=_cancel_fn(deadline),
         step_timing=cfg.step_timing,
+        hook_error=("raise" if cfg.strict_checkpoint else "continue"),
     )
     run_s = time.perf_counter() - t1
 

@@ -23,7 +23,22 @@ seconds since the log was opened):
                           transient retries preceded it — emitted AT
                           degradation time, so a later crash still leaves
                           the walk durable (schema v2)
-  checkpoint-written      rounds + path, at each sidecar write
+  checkpoint-written      rounds + path, at each checkpoint write; v7
+                          adds generation (the monotonic index), bytes
+                          (compressed archive size) and write_s (the
+                          save wall) from utils/checkpoint.save
+  checkpoint-corrupt-     resume-time quarantine (schema v7): a
+  quarantined             generation failed digest verification and was
+                          renamed to *.corrupt — path, structured
+                          reason, corrupt_arrays (named by per-array
+                          digest), quarantined (the renamed files);
+                          load_latest_intact fell back past it
+  checkpoint-failed       a chunk-boundary checkpoint write failed and
+                          the run continued under the default
+                          lose-one-interval policy (schema v7;
+                          models/pipeline.run_chunks hook_error):
+                          rounds + the OSError text — emitted post-run
+                          from RunResult.hook_failures, in order
   chunk-retired           per retired chunk, in order: rounds at the
                           boundary plus the driver's dispatch_s/fetch_s
                           timing split (models/pipeline.ChunkLoopResult
@@ -124,7 +139,10 @@ batch-retired/request-completed; 5 — the serving resilience plane
 engine-quarantined, quarantine-half-open, quarantine-recovered event
 types; admission-rejected gains retry_after_s + priority; 6 — the fleet
 front's cross-process trace events (ISSUE 18): front-request-rerouted +
-front-request-completed, trace_id propagated over the front->worker hop.
+front-request-completed, trace_id propagated over the front->worker hop;
+7 — the durable-state plane (ISSUE 19): checkpoint-corrupt-quarantined +
+checkpoint-failed event types, checkpoint-written gains
+generation/bytes/write_s.
 """
 
 from __future__ import annotations
@@ -134,7 +152,7 @@ from pathlib import Path
 
 from . import metrics
 
-EVENT_SCHEMA_VERSION = 6
+EVENT_SCHEMA_VERSION = 7
 
 
 class RunEventLog:

@@ -23,8 +23,20 @@ What it proves (the crash-only-restarts story, CI-enforced):
    countermeasure: the adversary plane is never checkpointed, so bitwise
    equality with the control proves the resumed process rebuilt the
    identical plane (same nodes, same onset) from the config alone.
+6. **I/O-fault corruption legs** (ISSUE 19, ``--corrupt``) — the
+   GOSSIP_TPU_CKPT_FAULT injector corrupts a checkpoint write in a real
+   CLI subprocess:
+   ``torn``/``flip`` truncate / bit-flip the just-renamed archive and kill
+   the process (exit 17/19); the resume must QUARANTINE the corrupt
+   generation (one checkpoint-corrupt-quarantined event, ``*.corrupt``
+   files on disk), fall back to the newest intact generation, and finish
+   bitwise-equal to the control. ``enospc`` makes every save from the
+   third on fail with injected ENOSPC; the run must CONTINUE under the
+   default lose-one-interval policy (checkpoint-failed events post-run)
+   and still match the control bitwise.
 
 Usage: python scripts/chaos_kill_resume.py [--ladder-only] [--kill-after S]
+       python scripts/chaos_kill_resume.py --corrupt torn [--out-dir D]
 """
 
 from __future__ import annotations
@@ -166,6 +178,127 @@ def kill_resume(kill_after: float, config=CONFIG,
           f"to control, event log consistent ({len(events)} events)")
 
 
+# Exit codes the env-gated fault injector uses for its simulated
+# post-write kills (utils/checkpoint._env_fault).
+FAULT_RC = {"torn": 17, "flip": 19}
+
+
+def corrupt_leg(mode: str, out_dir=None) -> None:
+    """One --corrupt leg: inject a checkpoint I/O fault via
+    GOSSIP_TPU_CKPT_FAULT in a real CLI subprocess and assert the
+    recovery (torn/flip) or continue-under-failure (enospc) contract."""
+    if out_dir is None:
+        tmp = Path(tempfile.mkdtemp(prefix=f"gossip_chaos_{mode}_"))
+    else:
+        tmp = Path(out_dir)
+        tmp.mkdir(parents=True, exist_ok=True)
+        for stale in list(tmp.glob("ck*")) + list(tmp.glob("*.jsonl")):
+            stale.unlink()
+    ck = tmp / "ck.npz"
+    ev = tmp / "events.jsonl"
+    rec_victim = tmp / "victim.jsonl"
+    rec_control = tmp / "control.jsonl"
+
+    print(f"[chaos] corrupt-{mode}: control run (uninterrupted)...")
+    p = _cli(["--quiet", "--jsonl", str(rec_control)])
+    out, err = p.communicate(timeout=1800)
+    if p.returncode != 0:
+        fail(f"control run failed rc={p.returncode}: {err.decode()[-800:]}")
+    control = _read_jsonl(rec_control)[-1]
+    print(f"[chaos] control: rounds={control['rounds']} "
+          f"outcome={control['outcome']}")
+
+    common = ["--quiet", "--checkpoint", str(ck), "--checkpoint-every", "1",
+              "--checkpoint-keep", "3", "--events", str(ev),
+              "--resume", "auto", "--jsonl", str(rec_victim)]
+    # Fault the third save (zero-indexed 2) so two intact generations
+    # precede the corruption; enospc fails every save from there on.
+    spec = {"torn": "torn:2", "flip": "flip:2",
+            "enospc": "enospc:2:1000000"}[mode]
+    print(f"[chaos] corrupt-{mode}: victim with "
+          f"GOSSIP_TPU_CKPT_FAULT={spec}...")
+    p = _cli(common, env={"GOSSIP_TPU_CKPT_FAULT": spec})
+    out, err = p.communicate(timeout=1800)
+
+    if mode == "enospc":
+        # The lose-one-interval policy end to end: the run keeps going
+        # past every failed save, converges with exit 0, and reports the
+        # failures as post-run checkpoint-failed events.
+        if p.returncode != 0:
+            fail(f"enospc victim failed rc={p.returncode} — the default "
+                 f"hook_error=continue policy should have absorbed the "
+                 f"injected ENOSPC: {err.decode()[-800:]}")
+        events = _read_jsonl(ev)
+        fails = [e for e in events if e["event"] == "checkpoint-failed"]
+        if not fails:
+            fail("no checkpoint-failed events despite injected ENOSPC")
+        if any("ENOSPC" not in f["error"] and "No space" not in f["error"]
+               for f in fails):
+            fail(f"checkpoint-failed error text surprising: {fails[:2]}")
+        ends = [e for e in events if e["event"] == "run-end"]
+        if len(ends) != 1 or ends[0]["outcome"] != "converged":
+            fail(f"want 1 converged run-end, got {ends}")
+        victim = _read_jsonl(rec_victim)[-1]
+        for field in ("rounds", "converged_count", "outcome",
+                      "estimate_mae", "converged"):
+            if victim[field] != control[field]:
+                fail(f"enospc continue policy changed the run: {field} "
+                     f"{victim[field]!r} != control {control[field]!r}")
+        print(f"[chaos] corrupt-enospc OK: {len(fails)} failed saves "
+              f"absorbed, run bitwise-equal to control")
+        return
+
+    want_rc = FAULT_RC[mode]
+    if p.returncode != want_rc:
+        fail(f"corrupt-{mode} victim exited rc={p.returncode}, want "
+             f"{want_rc} (the injected post-write kill): "
+             f"{err.decode()[-800:]}")
+    if any(e["event"] == "run-end" for e in _read_jsonl(ev)):
+        fail("victim's event log already has run-end — the fault landed "
+             "after completion, nothing was tested")
+
+    print(f"[chaos] corrupt-{mode}: resuming with --resume auto "
+          f"(fault env cleared)...")
+    p = _cli(common)
+    out, err = p.communicate(timeout=1800)
+    if p.returncode != 0:
+        fail(f"resume run failed rc={p.returncode}: {err.decode()[-800:]}")
+
+    events = _read_jsonl(ev)
+    quar = [e for e in events
+            if e["event"] == "checkpoint-corrupt-quarantined"]
+    if len(quar) != 1:
+        fail(f"want exactly 1 checkpoint-corrupt-quarantined event, "
+             f"got {len(quar)}")
+    for fld in ("path", "reason", "quarantined"):
+        if fld not in quar[0]:
+            fail(f"quarantine event missing {fld!r}: {quar[0]}")
+    if not list(tmp.glob("*.corrupt")):
+        fail("no *.corrupt quarantine artifacts on disk")
+    resumes = [e for e in events if e["event"] == "resume"]
+    if len(resumes) != 1:
+        fail(f"want exactly 1 resume event, got {len(resumes)}")
+    ck_rounds = {e["rounds"] for e in events
+                 if e["event"] == "checkpoint-written"}
+    if resumes[0]["rounds"] not in ck_rounds:
+        fail(f"resume round {resumes[0]['rounds']} matches no "
+             f"checkpoint-written round {sorted(ck_rounds)}")
+    ends = [e for e in events if e["event"] == "run-end"]
+    if len(ends) != 1 or ends[0]["outcome"] != "converged":
+        fail(f"want 1 converged run-end, got {ends}")
+    victim = _read_jsonl(rec_victim)[-1]
+    for field in ("rounds", "converged_count", "outcome", "estimate_mae",
+                  "converged"):
+        if victim[field] != control[field]:
+            fail(f"bitwise-resume invariant broken after corrupt-{mode}: "
+                 f"{field} {victim[field]!r} != control "
+                 f"{control[field]!r}")
+    print(f"[chaos] corrupt-{mode} OK: quarantined "
+          f"({quar[0]['reason'][:60]}...), resumed from round "
+          f"{resumes[0]['rounds']}, bitwise-equal to control "
+          f"({len(events)} events)")
+
+
 def ladder() -> None:
     """Exercise the degradation ladder with a real (injected) engine
     failure: sharded dispatch dies environmentally, the run must complete
@@ -226,7 +359,20 @@ def main(argv=None) -> int:
     ap.add_argument("--kill-after", type=float, default=2.0,
                     help="extra seconds after the first checkpoint before "
                     "the SIGKILL lands")
+    ap.add_argument("--corrupt", action="append",
+                    choices=sorted({"torn", "flip", "enospc"}),
+                    help="run only the named I/O-fault corruption leg(s) "
+                    "(repeatable) instead of the kill-resume scenarios")
+    ap.add_argument("--out-dir", default=None,
+                    help="working directory for --corrupt legs (kept, so "
+                    "CI can upload events.jsonl + *.corrupt artifacts); "
+                    "default: fresh tempdir")
     args = ap.parse_args(argv)
+    if args.corrupt:
+        for mode in args.corrupt:
+            corrupt_leg(mode, out_dir=args.out_dir)
+        print("[chaos] all scenarios passed")
+        return 0
     ladder()
     if not args.ladder_only:
         kill_resume(args.kill_after)

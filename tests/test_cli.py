@@ -198,6 +198,9 @@ def test_checkpoint_rejects_mismatched_stream_version(tmp_path):
         else:
             data["__stream__"] = np.int64(version)
         np.savez_compressed(p, **data)
+        # Re-bless the integrity digests (ISSUE 19) so the stream-version
+        # rule is what fires, not the corrupt-archive refusal.
+        ckpt._refresh_digests(p)
 
     rewrite_stream(1)
     with pytest.raises(ValueError, match="stream version"):

@@ -361,6 +361,9 @@ def test_matmul_checkpoint_stream_guard(tmp_path):
         data = {k: z[k] for k in z.files}
     data["__stream__"] = np.int64(1)
     np.savez_compressed(p, **data)
+    # Re-bless the integrity digests (ISSUE 19) so the stream-version
+    # rule is what fires, not the corrupt-archive refusal.
+    ckpt._refresh_digests(p)
     with pytest.raises(ValueError, match="stream version"):
         ckpt.load(p)
 

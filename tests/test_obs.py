@@ -998,3 +998,123 @@ def test_trend_ceilings_apply_idempotent_and_preserves_serving(tmp_path):
     assert text3.count(trend.CEILINGS_HEADER) == 1
     assert "replicated-pool2 (reduce_scatter)" in text3
     assert "## existing" in text3
+
+
+# ------------------------------- the durable-state plane (ISSUE 19, v7)
+
+
+def test_checkpoint_metrics_registry_pins(tmp_path):
+    # utils/checkpoint instruments the process-global registry: write /
+    # verify / load wall histograms, bytes-written counter, generation
+    # gauge, and the quarantine counter. Pin deltas (the registry
+    # accumulates across tests in one process).
+    import numpy as np
+
+    from cop5615_gossip_protocol_tpu.utils import checkpoint as ckpt
+
+    reg = obs.default_registry()
+
+    def val(name):
+        v = obs.metric_value(obs.parse_prometheus(reg.render()), name)
+        return 0.0 if v is None else v
+
+    before = {n: val(n) for n in (
+        "gossip_tpu_checkpoint_write_seconds_count",
+        "gossip_tpu_checkpoint_verify_seconds_count",
+        "gossip_tpu_checkpoint_load_seconds_count",
+        "gossip_tpu_checkpoint_bytes_written_total",
+        "gossip_tpu_checkpoint_quarantined_total",
+    )}
+
+    topo = build_topology("full", 64)
+    cfg = SimConfig(n=64, topology="full", algorithm="push-sum",
+                    chunk_rounds=8)
+    snaps = []
+    run(topo, cfg, on_chunk=lambda r, s: snaps.append((r, s)))
+    path = tmp_path / "ck.npz"
+    info = ckpt.save(path, snaps[0][1], snaps[0][0], cfg, keep=2)
+    ckpt.save(path, snaps[1][1], snaps[1][0], cfg, keep=2)
+    ckpt.load(path)
+
+    assert val("gossip_tpu_checkpoint_write_seconds_count") == \
+        before["gossip_tpu_checkpoint_write_seconds_count"] + 2
+    assert val("gossip_tpu_checkpoint_verify_seconds_count") == \
+        before["gossip_tpu_checkpoint_verify_seconds_count"] + 1
+    assert val("gossip_tpu_checkpoint_load_seconds_count") == \
+        before["gossip_tpu_checkpoint_load_seconds_count"] + 1
+    assert val("gossip_tpu_checkpoint_bytes_written_total") >= \
+        before["gossip_tpu_checkpoint_bytes_written_total"] + 2 * info["bytes"] * 0.5
+    assert val("gossip_tpu_checkpoint_generation") == 1.0  # newest index
+
+    # Quarantine bumps its counter: corrupt the newest generation and walk.
+    newest = ckpt.candidate_paths(path)[0]
+    newest.write_bytes(newest.read_bytes()[:128])
+    assert ckpt.load_latest_intact(path) is not None
+    assert val("gossip_tpu_checkpoint_quarantined_total") == \
+        before["gossip_tpu_checkpoint_quarantined_total"] + 1
+
+
+def test_event_vocabulary_v7_checkpoint_events(tmp_path):
+    # The v7 vocabulary additions ride the same JSONL plane as v6: every
+    # line carries schema_version 7 and read_events round-trips the new
+    # checkpoint-written fields plus the two new event types.
+    from cop5615_gossip_protocol_tpu.utils.events import EVENT_SCHEMA_VERSION
+
+    assert EVENT_SCHEMA_VERSION == 7
+
+    log = tmp_path / "events.jsonl"
+    ev = RunEventLog(log)
+    ev.emit("checkpoint-written", rounds=32, path="ck.g000001.npz",
+            generation=1, bytes=2048, write_s=0.01)
+    ev.emit("checkpoint-corrupt-quarantined", path="ck.g000001.npz",
+            reason="data archive is unreadable (truncated or torn write)",
+            corrupt_arrays=[], quarantined=["ck.g000001.npz.corrupt"])
+    ev.emit("checkpoint-failed", rounds=64,
+            error="OSError: [Errno 28] No space left on device")
+    recs = read_events(log)
+    assert [r["event"] for r in recs] == [
+        "checkpoint-written", "checkpoint-corrupt-quarantined",
+        "checkpoint-failed"]
+    assert all(r["schema_version"] == 7 for r in recs)
+    written = recs[0]
+    assert {"generation", "bytes", "write_s", "rounds", "path"} <= set(written)
+    assert set(recs[1]) >= {"path", "reason", "corrupt_arrays", "quarantined"}
+    assert set(recs[2]) >= {"rounds", "error"}
+
+
+def test_trend_durability_section_applies_idempotently(tmp_path):
+    # ISSUE 19 satellite: the durability section has its own header and
+    # rides the same idempotent apply as every generated section. The
+    # render itself is a fresh measurement (not re-run here — the chaos
+    # CI job exercises the real legs); what tier-1 pins is the install
+    # machinery: applying one rendered section twice is byte-stable and
+    # preserves every neighboring section.
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+    from benchmarks import trend
+
+    bench = tmp_path / "BENCH_TABLES.md"
+    bench.write_text("# tables\n\n## existing\nrow\n\n"
+                     f"{trend.STEP_TIMING_HEADER}\nold step rows\n")
+    section = (f"{trend.DURABILITY_HEADER}\n\nprose\n\n"
+               "| cell | rounds |\n|---|---|\n| gossip full n=256 | 33 |\n")
+    trend.apply_to_bench_tables(section, bench,
+                                header=trend.DURABILITY_HEADER)
+    text1 = bench.read_text()
+    assert text1.count(trend.DURABILITY_HEADER) == 1
+    assert "## existing" in text1 and "old step rows" in text1
+    trend.apply_to_bench_tables(section, bench,
+                                header=trend.DURABILITY_HEADER)
+    assert bench.read_text() == text1
+    # A replacement render swaps the section in place.
+    trend.apply_to_bench_tables(
+        section.replace("| gossip full n=256 | 33 |",
+                        "| gossip full n=256 | 34 |"),
+        bench, header=trend.DURABILITY_HEADER)
+    text3 = bench.read_text()
+    assert text3.count(trend.DURABILITY_HEADER) == 1
+    assert "| gossip full n=256 | 34 |" in text3
+    assert "| gossip full n=256 | 33 |" not in text3
+    assert "old step rows" in text3

@@ -318,6 +318,7 @@ def test_checkpoint_stream_v4_sensitivity(tmp_path):
         arrays = {k: z[k] for k in z.files}
     arrays["__stream__"] = np.asarray(3)
     np.savez_compressed(path, **arrays)
+    ckpt._refresh_digests(path)  # rewrite in place: re-bless the digests
     with pytest.raises(ValueError, match="stream"):
         ckpt.load(path)
     # Same vintage marker, no revive model: loads fine.
@@ -328,6 +329,7 @@ def test_checkpoint_stream_v4_sensitivity(tmp_path):
         arrays = {k: z[k] for k in z.files}
     arrays["__stream__"] = np.asarray(3)
     np.savez_compressed(path2, **arrays)
+    ckpt._refresh_digests(path2)
     _, rnds, _ = ckpt.load(path2)
     assert rnds == 8
 
@@ -347,6 +349,7 @@ def test_checkpoint_stream_v5_sensitivity(tmp_path):
         arrays = {k: z[k] for k in z.files}
     arrays["__stream__"] = np.asarray(4)
     np.savez_compressed(path, **arrays)
+    ckpt._refresh_digests(path)
     with pytest.raises(ValueError, match="stream"):
         ckpt.load(path)
     # Same v4 marker, no byzantine model: loads fine (the added stream is
@@ -358,6 +361,7 @@ def test_checkpoint_stream_v5_sensitivity(tmp_path):
         arrays = {k: z[k] for k in z.files}
     arrays["__stream__"] = np.asarray(4)
     np.savez_compressed(path2, **arrays)
+    ckpt._refresh_digests(path2)
     _, rnds, _ = ckpt.load(path2)
     assert rnds == 8
 
@@ -420,3 +424,354 @@ def test_replica_sweep_shares_config_pure_planes():
     solo = run(topo, cfg)
     assert sweep.rounds[0] == solo.rounds
     assert sweep.converged[0] == solo.converged
+
+
+# ------------------------------------- durable state plane (ISSUE 19)
+#
+# Checkpoint integrity (per-array SHA-256 + data/config digests in the
+# sidecar), generation retention, load_latest_intact quarantine-and-fall-
+# back, the kill-at-every-fault-point property, the chunk-boundary
+# checkpoint-failure policy, and elastic mesh-shrink/grow resume.
+
+
+class SimulatedCrash(BaseException):
+    """A kill injected at a checkpoint fault point. BaseException on
+    purpose: the engines' graceful-degradation ladder catches Exception
+    rungs, and a simulated process death must end the save exactly where
+    it fired rather than being retried or degraded around."""
+
+
+def _assert_states_bitwise(got, want, label=""):
+    for f in want._fields:
+        a = np.asarray(getattr(got, f))
+        b = np.asarray(getattr(want, f))
+        assert np.array_equal(a, b), (label, f)
+
+
+# ----------------------------------------------- integrity + quarantine
+
+
+def _pushsum_checkpoint(tmp_path, rounds=8, **save_kw):
+    cfg = SimConfig(n=64, topology="full", algorithm="push-sum",
+                    max_rounds=500, chunk_rounds=8)
+    topo = build_topology("full", 64)
+    snaps = []
+    run(topo, cfg, on_chunk=lambda r, s: snaps.append((r, s)))
+    path = tmp_path / "ck.npz"
+    r0, st0 = snaps[0]
+    ckpt.save(path, st0, rounds, cfg, **save_kw)
+    return path, cfg, st0
+
+
+def test_checkpoint_mispair_window_refused(tmp_path):
+    # The ISSUE 19 bugfix pin. Before this PR save() renamed the sidecar
+    # BEFORE the data archive, so a kill between the two renames left a
+    # NEW sidecar paired with the OLD archive — and load() used the stale
+    # state silently. Construct that exact window: two saves to the same
+    # plain path, then put the first save's archive back under the second
+    # save's sidecar.
+    path, cfg, st0 = _pushsum_checkpoint(tmp_path, rounds=8)
+    old_archive = path.read_bytes()
+    ckpt.save(path, st0, 16, cfg)
+    path.write_bytes(old_archive)  # the historical torn-rename window
+    with pytest.raises(ckpt.CheckpointIntegrityError, match="mispaired"):
+        ckpt.load(path)
+
+
+def test_checkpoint_new_rename_order_window_refused(tmp_path):
+    # The window the NEW rename order (data first) can leave behind: a
+    # kill after the archive rename but before the sidecar rename pairs
+    # the new archive with the OLD sidecar. Also refused — the sidecar's
+    # data_sha256 no longer matches.
+    path, cfg, st0 = _pushsum_checkpoint(tmp_path, rounds=8)
+
+    def kill(point, _path):
+        if point == "after-data-rename":
+            raise SimulatedCrash(point)
+
+    ckpt.FAULT_HOOK = kill
+    try:
+        with pytest.raises(SimulatedCrash):
+            ckpt.save(path, st0, 16, cfg)
+    finally:
+        ckpt.FAULT_HOOK = None
+    with pytest.raises(ckpt.CheckpointIntegrityError, match="mispaired"):
+        ckpt.load(path)
+
+
+def test_checkpoint_bitflip_names_corrupt_array(tmp_path):
+    # A valid zip whose content silently changed (bit rot after the
+    # digests were recorded): the refusal names the corrupt array — a
+    # structured verdict, never a numpy traceback.
+    path, cfg, st0 = _pushsum_checkpoint(tmp_path, rounds=8)
+    with np.load(path, allow_pickle=False) as z:
+        arrays = {k: z[k] for k in z.files}
+    victim = next(k for k in arrays if not k.startswith("__"))
+    flipped = arrays[victim].copy()
+    flipped.reshape(-1).view(np.uint8)[0] ^= 0x40
+    arrays[victim] = flipped
+    np.savez_compressed(path, **arrays)  # digests deliberately NOT refreshed
+    with pytest.raises(ckpt.CheckpointIntegrityError) as ei:
+        ckpt.load(path)
+    assert victim in ei.value.corrupt_arrays
+    assert [k for k in ei.value.corrupt_arrays if k != victim] == []
+
+
+def test_checkpoint_corrupt_sidecar_refused(tmp_path):
+    path, cfg, st0 = _pushsum_checkpoint(tmp_path, rounds=8)
+    sidecar = path.with_suffix(path.suffix + ".json")
+    sidecar.write_text(sidecar.read_text()[:-20])  # torn sidecar write
+    with pytest.raises(ckpt.CheckpointIntegrityError, match="sidecar"):
+        ckpt.load(path)
+
+
+def test_load_latest_intact_quarantines_and_falls_back(tmp_path):
+    # Two generations, newest truncated mid-archive: load_latest_intact
+    # renames the broken pair to *.corrupt, emits one structured
+    # quarantine event, and returns the older intact generation.
+    cfg = SimConfig(n=64, topology="full", algorithm="push-sum",
+                    max_rounds=500, chunk_rounds=8)
+    topo = build_topology("full", 64)
+    snaps = []
+    run(topo, cfg, on_chunk=lambda r, s: snaps.append((r, s)))
+    path = tmp_path / "ck.npz"
+    ckpt.save(path, snaps[0][1], snaps[0][0], cfg, keep=3)
+    ckpt.save(path, snaps[1][1], snaps[1][0], cfg, keep=3)
+    gens = ckpt.candidate_paths(path)
+    newest = gens[0]
+    newest.write_bytes(newest.read_bytes()[:200])  # torn write
+
+    events = []
+    hit = ckpt.load_latest_intact(path, on_event=lambda **f: events.append(f))
+    assert hit is not None
+    st, rnds, cfg2, info = hit
+    assert rnds == snaps[0][0]
+    assert info["generation"] == 0
+    _assert_states_bitwise(st, snaps[0][1], label="fallback-state")
+
+    [ev] = events
+    assert set(ev) >= {"path", "reason", "corrupt_arrays", "quarantined"}
+    assert "unreadable" in ev["reason"]
+    assert all(p.endswith(".corrupt") for p in ev["quarantined"])
+    assert newest not in ckpt.candidate_paths(path)
+    assert list(tmp_path.glob("*.corrupt"))
+
+
+def test_load_latest_intact_none_when_nothing_intact(tmp_path):
+    path, cfg, st0 = _pushsum_checkpoint(tmp_path, rounds=8)
+    path.write_bytes(path.read_bytes()[:100])
+    events = []
+    assert ckpt.load_latest_intact(
+        path, on_event=lambda **f: events.append(f)) is None
+    assert len(events) == 1
+
+
+def test_checkpoint_generation_retention(tmp_path):
+    # keep=K prunes beyond K generations; the manifest and the plain-path
+    # link always track the newest; generation indices are monotonic.
+    import json
+
+    path, cfg, st0 = _pushsum_checkpoint(tmp_path, rounds=8, keep=2)
+    for rounds in (16, 24, 32):
+        info = ckpt.save(path, st0, rounds, cfg, keep=2)
+    assert info["generation"] == 3  # zero-indexed, monotonic
+    gens = ckpt.candidate_paths(path)
+    assert len(gens) == 2  # pruned to keep=2 (plain path is a symlink)
+    manifest = json.loads((tmp_path / "ck.manifest.json").read_text())
+    assert sorted(e["generation"] for e in manifest["generations"]) == [2, 3]
+    assert {e["generation"]: e["rounds"] for e in manifest["generations"]}[3] == 32
+    assert path.is_symlink()
+    st, rnds, cfg2 = ckpt.load(path)
+    assert rnds == 32
+
+
+# ------------------------------------------- kill-at-every-fault-point
+
+
+_DURABLE_CFGS = {
+    "gossip-crash-revive": dict(
+        n=256, topology="full", algorithm="gossip",
+        crash_schedule="3:40", revive_schedule="8:40", quorum=0.95,
+        max_rounds=2000, chunk_rounds=8, n_devices=2),
+    "push-sum": dict(
+        n=256, topology="full", algorithm="push-sum",
+        max_rounds=2000, chunk_rounds=8, n_devices=2),
+}
+
+
+@pytest.fixture(scope="module", params=sorted(_DURABLE_CFGS))
+def durable_control(request):
+    """One uninterrupted control per config: (name, cfg, topo, result,
+    boundary snapshots). Module-scoped — the sweep below replays resumes
+    against it at every fault point without re-running the control."""
+    name = request.param
+    cfg = SimConfig(**_DURABLE_CFGS[name])
+    topo = build_topology(cfg.topology, cfg.n)
+    snaps = []
+    res = run(topo, cfg, on_chunk=lambda r, s: snaps.append((r, s)))
+    assert res.outcome == "converged"
+    assert len(snaps) >= 3
+    return name, cfg, topo, res, snaps
+
+
+@pytest.mark.parametrize("point", ckpt.FAULT_POINTS)
+def test_kill_at_every_fault_point_recovers_bitwise(
+        durable_control, point, tmp_path):
+    # THE durability property: a kill at ANY fault point of a checkpoint
+    # write leaves the store recoverable — load_latest_intact returns an
+    # intact generation (quarantining any broken pair with a structured
+    # event, never a traceback) and the resumed run finishes bitwise-
+    # equal to the uninterrupted control.
+    name, cfg, topo, control, snaps = durable_control
+    path = tmp_path / "ck.npz"
+    r0, st0 = snaps[0]
+    r1, st1 = snaps[1]
+    ckpt.save(path, st0, r0, cfg, keep=3)  # one known-intact generation
+
+    def kill(p, _path):
+        if p == point:
+            raise SimulatedCrash(p)
+
+    ckpt.FAULT_HOOK = kill
+    try:
+        with pytest.raises(SimulatedCrash):
+            ckpt.save(path, st1, r1, cfg, keep=3)
+    finally:
+        ckpt.FAULT_HOOK = None
+
+    events = []
+    hit = ckpt.load_latest_intact(path, on_event=lambda **f: events.append(f))
+    assert hit is not None, (name, point)
+    st, rnds, cfg2, info = hit
+    assert rnds in (r0, r1), (name, point)
+    for ev in events:
+        assert set(ev) >= {"path", "reason", "corrupt_arrays", "quarantined"}
+
+    resumed_snaps = []
+    resumed = run(topo, cfg2, start_state=st, start_round=rnds,
+                  on_chunk=lambda r, s: resumed_snaps.append((r, s)))
+    assert (resumed.rounds, resumed.converged_count, resumed.outcome) == (
+        control.rounds, control.converged_count, control.outcome), (name, point)
+    want = dict(snaps)
+    fr, fs = resumed_snaps[-1]
+    _assert_states_bitwise(fs, want[fr], label=(name, point))
+
+
+# ------------------------------- chunk-boundary checkpoint I/O failure
+
+
+def test_checkpoint_hook_failure_continues_by_default():
+    # models/pipeline.run_chunks hook_error policy: an OSError from the
+    # chunk-boundary hook (a failed checkpoint write) loses one interval,
+    # records the failure on RunResult.hook_failures, and the run's
+    # result is untouched.
+    import errno
+
+    cfg = SimConfig(**_DURABLE_CFGS["push-sum"])
+    topo = build_topology(cfg.topology, cfg.n)
+    control = run(topo, cfg)
+
+    calls = []
+
+    def flaky_hook(rounds, st):
+        calls.append(rounds)
+        if len(calls) == 2:
+            raise OSError(errno.ENOSPC, "No space left on device")
+
+    res = run(topo, cfg, on_chunk=flaky_hook)
+    assert (res.rounds, res.converged_count, res.outcome) == (
+        control.rounds, control.converged_count, control.outcome)
+    [fail] = res.hook_failures
+    assert fail["rounds"] == calls[1]
+    assert "OSError" in fail["error"]
+    assert control.hook_failures is None  # clean runs don't carry the field
+
+
+def test_strict_checkpoint_restores_fail_fast():
+    import errno
+
+    cfg = dataclasses.replace(
+        SimConfig(**_DURABLE_CFGS["push-sum"]), strict_checkpoint=True)
+    topo = build_topology(cfg.topology, cfg.n)
+
+    def flaky_hook(rounds, st):
+        raise OSError(errno.ENOSPC, "No space left on device")
+
+    with pytest.raises(OSError):
+        run(topo, cfg, on_chunk=flaky_hook)
+
+
+def test_env_fault_enospc_spec(tmp_path, monkeypatch):
+    # The GOSSIP_TPU_CKPT_FAULT chaos gate: enospc:<nth>[:<count>] makes
+    # the nth save (zero-indexed) raise ENOSPC — the same failure the
+    # policy test above injects, but reachable from a subprocess without
+    # touching code.
+    monkeypatch.setenv(ckpt.FAULT_ENV, "enospc:1:1")
+    ckpt._ENV_STATE["saves"] = 0
+    ckpt._ENV_STATE["enospc_left"] = None
+    path, cfg, st0 = _pushsum_checkpoint(tmp_path, rounds=8)  # save 0: ok
+    with pytest.raises(OSError) as ei:
+        ckpt.save(path, st0, 16, cfg)  # save 1: ENOSPC
+    assert ei.value.errno == __import__("errno").ENOSPC
+    ckpt.save(path, st0, 24, cfg)  # save 2: budget spent, ok again
+    st, rnds, _ = ckpt.load(path)
+    assert rnds == 24
+
+
+# ------------------------------------- elastic mesh-shrink/grow resume
+
+
+_ELASTIC_CASES = [
+    # (label, extra config, P -> P'). Gossip state is integer so the cut
+    # moves across the single-device boundary bitwise; push-sum float32
+    # state is pinned within the sharded family (the single-device chunked
+    # engine preserves denormals the sharded all-reduce flushes to zero,
+    # so P'=1 for push-sum is numerically-close, not bitwise — see README
+    # Durability).
+    ("scatter-gossip-shrink-to-1",
+     dict(algorithm="gossip", crash_schedule="3:40", revive_schedule="8:40",
+          quorum=0.95), 2, 1),
+    ("scatter-gossip-grow",
+     dict(algorithm="gossip", crash_schedule="3:40", revive_schedule="8:40",
+          quorum=0.95), 2, 4),
+    ("scatter-pushsum-shrink", dict(algorithm="push-sum"), 4, 2),
+    ("scatter-pushsum-grow", dict(algorithm="push-sum"), 2, 4),
+    ("pool-gossip-shrink", dict(algorithm="gossip", delivery="pool"), 4, 2),
+    ("pool-pushsum-grow",
+     dict(algorithm="push-sum", delivery="pool"), 2, 4),
+]
+
+
+@pytest.mark.parametrize("label,kw,p_from,p_to",
+                         _ELASTIC_CASES, ids=[c[0] for c in _ELASTIC_CASES])
+def test_elastic_mesh_resume_bitwise(label, kw, p_from, p_to, tmp_path):
+    # A checkpoint cut at P devices resumes at P' devices (shrink, grow,
+    # and down to a single device) bitwise-equal to an uninterrupted run
+    # at P': checkpoints are stored in global row order and re-placed
+    # through parallel/mesh.put_rows / put_global at load, so the on-disk
+    # format owes nothing to the mesh that wrote it.
+    cfg_from = SimConfig(n=256, topology="full", max_rounds=2000,
+                         chunk_rounds=8, n_devices=p_from, **kw)
+    topo = build_topology("full", 256)
+
+    snaps = []
+    src = run(topo, cfg_from, on_chunk=lambda r, s: snaps.append((r, s)))
+    assert src.outcome == "converged"
+    r0, st0 = snaps[1]
+    path = tmp_path / "ck.npz"
+    ckpt.save(path, st0, r0, cfg_from)
+    st, rnds, saved_cfg = ckpt.load(path)
+
+    cfg_to = dataclasses.replace(saved_cfg, n_devices=p_to)
+    ctl_snaps = []
+    control = run(topo, cfg_to, on_chunk=lambda r, s: ctl_snaps.append((r, s)))
+
+    res_snaps = []
+    resumed = run(topo, cfg_to, start_state=st, start_round=rnds,
+                  on_chunk=lambda r, s: res_snaps.append((r, s)))
+    assert (resumed.rounds, resumed.converged_count, resumed.outcome) == (
+        control.rounds, control.converged_count, control.outcome), label
+    want = dict(ctl_snaps)
+    assert res_snaps and all(r in want for r, _ in res_snaps)
+    for r, s in res_snaps:
+        _assert_states_bitwise(s, want[r], label=(label, r))

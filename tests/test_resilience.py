@@ -505,10 +505,13 @@ def test_front_timeout_claims_never_counts_completed(monkeypatch):
 # ---------------------------------------------------------- schema pins
 
 
-def test_event_schema_v6():
+def test_event_schema_v7():
     # v6: the fleet front's lifecycle events (front-request-rerouted /
     # front-request-completed) joined the vocabulary (ISSUE 18).
-    assert EVENT_SCHEMA_VERSION == 6
+    # v7: the durable-state plane's checkpoint-corrupt-quarantined /
+    # checkpoint-failed events + the enriched checkpoint-written
+    # (generation/bytes/write_s) joined it (ISSUE 19).
+    assert EVENT_SCHEMA_VERSION == 7
 
 
 def test_healthz_lame_duck_and_drain_rejections():
